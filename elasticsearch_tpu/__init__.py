@@ -1,0 +1,17 @@
+"""elasticsearch_tpu — a TPU-native distributed search engine.
+
+A from-scratch framework with the capabilities of Elasticsearch (reference surveyed in
+SURVEY.md): sharded + replicated full-text indices, JSON query DSL with Lucene-exact
+BM25/TF-IDF scoring, aggregations, two-phase scatter/gather search, NRT indexing with a
+write-ahead log, master-elected cluster state, peer recovery, snapshot/restore, REST API.
+
+TPU-first architecture: postings live as packed device tensors, the query-phase scoring
+loop is batched JAX/Pallas compute with `lax.top_k`, and cross-shard reduces (global
+top-k, distributed IDF stats) are mesh collectives instead of coordinator loops. The host
+side (cluster state, routing, durability, REST) is pure Python + C-extension hot paths.
+"""
+
+from .version import CURRENT as VERSION  # noqa: F401
+from .common.settings import Settings  # noqa: F401
+
+__version__ = str(VERSION)

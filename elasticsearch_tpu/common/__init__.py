@@ -1,0 +1,2 @@
+from .settings import Settings, DynamicSettings, prepare_settings  # noqa: F401
+from .errors import SearchEngineError  # noqa: F401

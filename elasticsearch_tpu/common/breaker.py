@@ -1,0 +1,89 @@
+"""Memory circuit breaker.
+
+Analogue of common/breaker/MemoryCircuitBreaker.java + the fielddata breaker service
+(indices/fielddata/breaker/InternalCircuitBreakerService.java): estimates bytes before a
+large allocation (device postings pack, fielddata load, aggregation arrays) and trips with
+CircuitBreakingError instead of OOMing the host or HBM."""
+
+from __future__ import annotations
+
+import threading
+
+from .errors import CircuitBreakingError
+from .units import parse_ratio_or_bytes
+
+
+class MemoryCircuitBreaker:
+    def __init__(self, limit_bytes: int, overhead: float = 1.0, name: str = "fielddata"):
+        self.name = name
+        self.limit = int(limit_bytes)
+        self.overhead = overhead
+        self._used = 0
+        self._trip_count = 0
+        self._lock = threading.Lock()
+
+    def add_estimate_and_maybe_break(self, bytes_: int, label: str = "") -> int:
+        with self._lock:
+            new_used = self._used + bytes_
+            if self.limit > 0 and new_used * self.overhead > self.limit:
+                self._trip_count += 1
+                raise CircuitBreakingError(
+                    f"[{self.name}] data for [{label}] would be larger than limit of "
+                    f"[{self.limit}] bytes (estimated [{new_used}])"
+                )
+            self._used = new_used
+            return self._used
+
+    def add_without_breaking(self, bytes_: int) -> int:
+        with self._lock:
+            self._used += bytes_
+            return self._used
+
+    def release(self, bytes_: int):
+        self.add_without_breaking(-bytes_)
+
+    @property
+    def used(self) -> int:
+        return self._used
+
+    @property
+    def trip_count(self) -> int:
+        return self._trip_count
+
+
+class CircuitBreakerService:
+    """Registry of named breakers; budget defaults follow the reference's
+    indices.fielddata.breaker.limit (80% of heap → here: of a configured budget)."""
+
+    def __init__(self, settings=None, total_budget_bytes: int = 8 << 30):
+        from .settings import Settings
+
+        settings = settings or Settings.EMPTY
+        limit = parse_ratio_or_bytes(
+            settings.get("indices.fielddata.breaker.limit"), total_budget_bytes, default="80%"
+        )
+        overhead = settings.get_float("indices.fielddata.breaker.overhead", 1.03)
+        self.breakers: dict[str, MemoryCircuitBreaker] = {
+            "fielddata": MemoryCircuitBreaker(limit, overhead, "fielddata"),
+            "request": MemoryCircuitBreaker(
+                parse_ratio_or_bytes(
+                    settings.get("indices.breaker.request.limit"), total_budget_bytes, default="40%"
+                ),
+                1.0,
+                "request",
+            ),
+        }
+
+    def breaker(self, name: str = "fielddata") -> MemoryCircuitBreaker:
+        return self.breakers[name]
+
+    def stats(self) -> dict:
+        return {
+            name: {
+                "limit_size_in_bytes": b.limit,
+                "estimated_size_in_bytes": b.used,
+                "overhead": b.overhead,
+                "tripped": b.trip_count,
+            }
+            for name, b in self.breakers.items()
+        }

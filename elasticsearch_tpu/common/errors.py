@@ -1,0 +1,212 @@
+"""Exception hierarchy.
+
+TPU-native analogue of the reference's ElasticsearchException tree
+(/root/reference/src/main/java/org/elasticsearch/ElasticsearchException.java and the
+per-subsystem subclasses). Each exception knows its REST status so the HTTP layer can map
+failures to structured JSON errors the way rest/BytesRestResponse does.
+"""
+
+from __future__ import annotations
+
+
+class SearchEngineError(Exception):
+    """Root of the framework exception tree."""
+
+    status = 500
+
+    def __init__(self, message: str = "", *, cause: Exception | None = None):
+        super().__init__(message)
+        self.message = message
+        self.cause = cause
+
+    def to_dict(self) -> dict:
+        d = {"type": type(self).__name__, "reason": self.message}
+        if self.cause is not None:
+            d["caused_by"] = {"type": type(self.cause).__name__, "reason": str(self.cause)}
+        return d
+
+
+class IllegalArgumentError(SearchEngineError):
+    status = 400
+
+
+class ParsingError(IllegalArgumentError):
+    """Bad query / mapping / settings body (ref: QueryParsingException, MapperParsingException)."""
+
+
+class MapperParsingError(ParsingError):
+    pass
+
+
+class QueryParsingError(ParsingError):
+    pass
+
+
+class DocumentMissingError(SearchEngineError):
+    status = 404
+
+
+class IndexMissingError(SearchEngineError):
+    status = 404
+
+    def __init__(self, index: str):
+        super().__init__(f"no such index [{index}]")
+        self.index = index
+
+
+class IndexAlreadyExistsError(SearchEngineError):
+    status = 400
+
+    def __init__(self, index: str):
+        super().__init__(f"index [{index}] already exists")
+        self.index = index
+
+
+class TypeMissingError(SearchEngineError):
+    status = 404
+
+
+class ShardNotFoundError(SearchEngineError):
+    status = 404
+
+
+class IndexShardMissingError(ShardNotFoundError):
+    pass
+
+
+class IllegalIndexShardStateError(SearchEngineError):
+    status = 409
+
+
+class VersionConflictError(SearchEngineError):
+    """Optimistic-concurrency failure (ref: index/engine/VersionConflictEngineException.java)."""
+
+    status = 409
+
+    def __init__(self, uid: str, current: int, provided: int):
+        super().__init__(
+            f"version conflict for [{uid}]: current [{current}], provided [{provided}]"
+        )
+        self.current = current
+        self.provided = provided
+
+
+class DocumentAlreadyExistsError(SearchEngineError):
+    status = 409
+
+
+class EngineClosedError(SearchEngineError):
+    status = 503
+
+
+class FlushNotAllowedError(SearchEngineError):
+    status = 503
+
+
+class NodeNotConnectedError(SearchEngineError):
+    status = 503
+
+
+class TransportError(SearchEngineError):
+    status = 503
+
+
+class ActionNotFoundError(TransportError):
+    status = 400
+
+
+class ReceiveTimeoutError(TransportError):
+    status = 503
+
+
+class MasterNotDiscoveredError(SearchEngineError):
+    status = 503
+
+
+class ClusterBlockError(SearchEngineError):
+    """Operation rejected by a cluster-level block (ref: cluster/block/ClusterBlockException.java)."""
+
+    status = 503
+
+    def __init__(self, blocks):
+        super().__init__(f"blocked by: {[str(b) for b in blocks]}")
+        self.blocks = blocks
+
+
+class NoShardAvailableError(SearchEngineError):
+    status = 503
+
+
+class UnavailableShardsError(SearchEngineError):
+    status = 503
+
+
+class ReduceSearchPhaseError(SearchEngineError):
+    pass
+
+
+class SearchPhaseExecutionError(SearchEngineError):
+    status = 503
+
+    def __init__(self, phase: str, message: str, shard_failures=()):
+        super().__init__(f"phase [{phase}] failed: {message}")
+        self.phase = phase
+        self.shard_failures = list(shard_failures)
+
+
+class SearchContextMissingError(SearchEngineError):
+    status = 404
+
+    def __init__(self, context_id: int):
+        super().__init__(f"no search context found for id [{context_id}]")
+
+
+class CircuitBreakingError(SearchEngineError):
+    """Memory circuit breaker tripped (ref: common/breaker/CircuitBreakingException.java)."""
+
+    status = 429
+
+
+class SnapshotError(SearchEngineError):
+    pass
+
+
+class SnapshotMissingError(SnapshotError):
+    status = 404
+
+
+class RepositoryError(SearchEngineError):
+    pass
+
+
+class RepositoryMissingError(RepositoryError):
+    status = 404
+
+
+class InvalidAliasNameError(IllegalArgumentError):
+    pass
+
+
+class InvalidIndexNameError(IllegalArgumentError):
+    pass
+
+
+class InvalidTypeNameError(IllegalArgumentError):
+    pass
+
+
+class ScriptError(SearchEngineError):
+    status = 400
+
+
+class PercolateError(SearchEngineError):
+    pass
+
+
+class TimestampParsingError(ParsingError):
+    pass
+
+
+class RoutingMissingError(IllegalArgumentError):
+    def __init__(self, index: str, type_: str, id_: str):
+        super().__init__(f"routing is required for [{index}]/[{type_}]/[{id_}]")

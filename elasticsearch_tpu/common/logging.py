@@ -1,0 +1,65 @@
+"""Logging facade.
+
+Analogue of common/logging/ESLogger.java + Loggers.java: component loggers with optional
+node/index/shard prefixes, and dynamically updatable levels (the reference exposes
+`logger.*` cluster settings; we expose set_level)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_ROOT = "estpu"
+_configured = False
+
+
+def _ensure_configured():
+    global _configured
+    if _configured:
+        return
+    root = logging.getLogger(_ROOT)
+    if not root.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(
+            logging.Formatter("[%(asctime)s][%(levelname)-5s][%(name)s] %(message)s", "%Y-%m-%dT%H:%M:%S")
+        )
+        root.addHandler(h)
+        root.setLevel(logging.INFO)
+        root.propagate = False
+    _configured = True
+
+
+class PrefixLogger(logging.LoggerAdapter):
+    def process(self, msg, kwargs):
+        return f"{self.extra['prefix']} {msg}", kwargs
+
+
+def get_logger(component: str, node: str | None = None, shard=None):
+    """`get_logger("index.engine", node="node_1", shard=("idx", 3))` →
+    logger named estpu.index.engine with "[node_1][idx][3]" prefix."""
+    _ensure_configured()
+    logger = logging.getLogger(f"{_ROOT}.{component}")
+    prefix_parts = []
+    if node:
+        prefix_parts.append(f"[{node}]")
+    if shard is not None:
+        index, shard_id = shard
+        prefix_parts.append(f"[{index}][{shard_id}]")
+    if prefix_parts:
+        return PrefixLogger(logger, {"prefix": "".join(prefix_parts)})
+    return logger
+
+
+def set_level(component: str, level: str):
+    """Dynamically change a component's level ("logger.index.engine": "debug")."""
+    _ensure_configured()
+    name = _ROOT if component in ("", "_root") else f"{_ROOT}.{component}"
+    logging.getLogger(name).setLevel(getattr(logging, level.upper()))
+
+
+def apply_logger_settings(settings):
+    for key, value in settings.as_dict().items():
+        if key.startswith("logger."):
+            set_level(key[len("logger."):], str(value))
+        elif key == "logger":
+            set_level("", str(value))

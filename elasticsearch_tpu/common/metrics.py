@@ -1,0 +1,147 @@
+"""Metrics primitives: counters, means, meters, EWMA.
+
+Analogue of common/metrics/{CounterMetric,MeanMetric,MeterMetric,EWMA}.java. Thread-safe
+via a lock per metric (the reference uses LongAdder/atomics)."""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+
+class CounterMetric:
+    __slots__ = ("_lock", "_count")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def inc(self, n: int = 1):
+        with self._lock:
+            self._count += n
+
+    def dec(self, n: int = 1):
+        with self._lock:
+            self._count -= n
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+
+class MeanMetric:
+    """Tracks (count, sum) — e.g. query count + total time."""
+
+    __slots__ = ("_lock", "_count", "_sum")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._sum = 0.0
+
+    def inc(self, value: float):
+        with self._lock:
+            self._count += 1
+            self._sum += value
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+
+class EWMA:
+    """Exponentially-weighted moving average (ref: common/metrics/EWMA.java)."""
+
+    def __init__(self, alpha: float, interval_s: float):
+        self._alpha = alpha
+        self._interval = interval_s
+        self._rate = 0.0
+        self._uncounted = 0
+        self._initialized = False
+        self._lock = threading.Lock()
+
+    @classmethod
+    def one_minute(cls, tick_s: float = 5.0) -> "EWMA":
+        return cls(1 - math.exp(-tick_s / 60.0), tick_s)
+
+    def update(self, n: int = 1):
+        with self._lock:
+            self._uncounted += n
+
+    def tick(self):
+        with self._lock:
+            instant_rate = self._uncounted / self._interval
+            self._uncounted = 0
+            if self._initialized:
+                self._rate += self._alpha * (instant_rate - self._rate)
+            else:
+                self._rate = instant_rate
+                self._initialized = True
+
+    @property
+    def rate(self) -> float:
+        return self._rate
+
+
+class MeterMetric:
+    """Throughput meter with 1m EWMA (ref: common/metrics/MeterMetric.java)."""
+
+    def __init__(self):
+        self._counter = CounterMetric()
+        self._ewma = EWMA.one_minute()
+        self._start = time.monotonic()
+        self._last_tick = self._start
+
+    def mark(self, n: int = 1):
+        self._counter.inc(n)
+        self._ewma.update(n)
+        now = time.monotonic()
+        if now - self._last_tick >= 5.0:
+            self._ewma.tick()
+            self._last_tick = now
+
+    @property
+    def count(self) -> int:
+        return self._counter.count
+
+    @property
+    def one_minute_rate(self) -> float:
+        return self._ewma.rate
+
+    @property
+    def mean_rate(self) -> float:
+        elapsed = time.monotonic() - self._start
+        return self._counter.count / elapsed if elapsed > 0 else 0.0
+
+
+class StopWatch:
+    """Simple phase timer (ref: common/StopWatch.java) used by benches."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.tasks: list[tuple[str, float]] = []
+        self._current: str | None = None
+        self._start = 0.0
+
+    def start(self, task: str = ""):
+        self._current = task
+        self._start = time.monotonic()
+        return self
+
+    def stop(self):
+        assert self._current is not None
+        self.tasks.append((self._current, time.monotonic() - self._start))
+        self._current = None
+        return self
+
+    def total_time(self) -> float:
+        return sum(t for _, t in self.tasks)

@@ -1,0 +1,296 @@
+"""Hierarchical typed settings.
+
+TPU-native analogue of common/settings/ImmutableSettings.java in the reference: flat
+dotted keys, typed getters with defaults (`getAsInt/AsTime/AsBytesSize`), prefix slicing
+(`getByPrefix`), group extraction, and a builder. Loaded from YAML + overrides by the node
+(ref: node/internal/InternalSettingsPreparer.java). Dynamic (runtime-mutable) keys are
+whitelisted through DynamicSettings, mirroring ClusterDynamicSettingsModule.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import re
+from typing import Any, Callable, Iterator, Mapping
+
+from .errors import IllegalArgumentError
+from .units import parse_bytes, parse_time
+
+_TRUE = {"true", "1", "on", "yes"}
+_FALSE = {"false", "0", "off", "no"}
+
+
+def _flatten_dict(obj, prefix: str, out: dict):
+    if isinstance(obj, Mapping):
+        for k, v in obj.items():
+            _flatten_dict(v, f"{prefix}{k}." , out)
+    elif isinstance(obj, (list, tuple)):
+        out[prefix[:-1]] = list(obj)
+    else:
+        out[prefix[:-1]] = obj
+
+
+class Settings(Mapping[str, Any]):
+    """Immutable flat-keyed settings map with typed accessors."""
+
+    EMPTY: "Settings"
+
+    __slots__ = ("_map",)
+
+    def __init__(self, data: Mapping[str, Any] | None = None):
+        flat: dict[str, Any] = {}
+        if data:
+            _flatten_dict(dict(data), "", flat)
+        object.__setattr__(self, "_map", flat)
+
+    # Mapping protocol -------------------------------------------------------
+    def __getitem__(self, key: str) -> Any:
+        return self._map[key]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._map)
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def __repr__(self) -> str:
+        return f"Settings({self._map!r})"
+
+    # typed getters ----------------------------------------------------------
+    def get(self, key: str, default=None):
+        v = self._map.get(key, default)
+        return v
+
+    def get_str(self, key: str, default: str | None = None) -> str | None:
+        v = self._map.get(key)
+        return default if v is None else str(v)
+
+    def get_int(self, key: str, default: int | None = None) -> int | None:
+        v = self._map.get(key)
+        if v is None:
+            return default
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            raise IllegalArgumentError(f"failed to parse int setting [{key}] = [{v}]")
+
+    def get_float(self, key: str, default: float | None = None) -> float | None:
+        v = self._map.get(key)
+        if v is None:
+            return default
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            raise IllegalArgumentError(f"failed to parse float setting [{key}] = [{v}]")
+
+    def get_bool(self, key: str, default: bool | None = None) -> bool | None:
+        v = self._map.get(key)
+        if v is None:
+            return default
+        if isinstance(v, bool):
+            return v
+        s = str(v).lower()
+        if s in _TRUE:
+            return True
+        if s in _FALSE:
+            return False
+        raise IllegalArgumentError(f"failed to parse bool setting [{key}] = [{v}]")
+
+    def get_time(self, key: str, default=None) -> float | None:
+        v = self._map.get(key)
+        if v is None:
+            return parse_time(default) if isinstance(default, str) else default
+        return parse_time(v)
+
+    def get_bytes(self, key: str, default=None) -> int | None:
+        v = self._map.get(key)
+        if v is None:
+            return parse_bytes(default) if isinstance(default, str) else default
+        return parse_bytes(v)
+
+    def get_list(self, key: str, default: list | None = None) -> list:
+        v = self._map.get(key)
+        if v is None:
+            # also support key.0, key.1 style
+            idx = 0
+            items = []
+            while f"{key}.{idx}" in self._map:
+                items.append(self._map[f"{key}.{idx}"])
+                idx += 1
+            return items if items else (default or [])
+        if isinstance(v, (list, tuple)):
+            return list(v)
+        return [p.strip() for p in str(v).split(",") if p.strip()]
+
+    # structural -------------------------------------------------------------
+    def by_prefix(self, prefix: str) -> "Settings":
+        s = Settings()
+        s._map.update({k[len(prefix):]: v for k, v in self._map.items() if k.startswith(prefix)})
+        return s
+
+    def filtered(self, predicate: Callable[[str], bool]) -> "Settings":
+        s = Settings()
+        s._map.update({k: v for k, v in self._map.items() if predicate(k)})
+        return s
+
+    def groups(self, prefix: str) -> dict[str, "Settings"]:
+        """`groups("index.analysis.analyzer.")` → {"my_analyzer": Settings(...)}."""
+        if not prefix.endswith("."):
+            prefix += "."
+        out: dict[str, Settings] = {}
+        for k, v in self._map.items():
+            if k.startswith(prefix):
+                rest = k[len(prefix):]
+                if "." in rest:
+                    name, sub = rest.split(".", 1)
+                    out.setdefault(name, Settings())._map[sub] = v
+                else:
+                    out.setdefault(rest, Settings())._map[""] = v
+        return out
+
+    def as_dict(self) -> dict[str, Any]:
+        return dict(self._map)
+
+    def as_structured(self) -> dict:
+        """Re-nest flat keys into a tree (for REST responses)."""
+        root: dict = {}
+        for k, v in sorted(self._map.items()):
+            parts = k.split(".")
+            node = root
+            for p in parts[:-1]:
+                nxt = node.get(p)
+                if not isinstance(nxt, dict):
+                    nxt = {}
+                    node[p] = nxt
+                node = nxt
+            node[parts[-1]] = v
+        return root
+
+    # building ---------------------------------------------------------------
+    def merged(self, other: "Settings | Mapping | None") -> "Settings":
+        if not other:
+            return self
+        s = Settings()
+        s._map.update(self._map)
+        if isinstance(other, Settings):
+            s._map.update(other._map)
+        else:
+            _flatten_dict(dict(other), "", s._map)
+        return s
+
+    def without_prefix(self, prefix: str) -> "Settings":
+        s = Settings()
+        s._map.update({k: v for k, v in self._map.items() if not k.startswith(prefix)})
+        return s
+
+    @classmethod
+    def of(cls, **kwargs) -> "Settings":
+        s = cls()
+        s._map.update({k.replace("__", "."): v for k, v in kwargs.items()})
+        return s
+
+    @classmethod
+    def from_flat(cls, flat: Mapping[str, Any]) -> "Settings":
+        s = cls()
+        for k, v in flat.items():
+            if isinstance(v, Mapping):
+                _flatten_dict(v, k + ".", s._map)
+            else:
+                s._map[k] = v
+        return s
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "Settings":
+        try:
+            import yaml  # type: ignore
+
+            with open(path) as f:
+                data = yaml.safe_load(f) or {}
+        except ImportError:
+            with open(path) as f:
+                data = _parse_simple_yaml(f.read())
+        return cls(data)
+
+
+def _parse_simple_yaml(text: str) -> dict:
+    """Minimal YAML subset (nested maps, scalars, inline lists) — fallback when PyYAML
+    is unavailable. Good enough for elasticsearch.yml-style config files."""
+    root: dict = {}
+    stack: list[tuple[int, dict]] = [(-1, root)]
+    for raw in text.splitlines():
+        if not raw.strip() or raw.lstrip().startswith("#"):
+            continue
+        indent = len(raw) - len(raw.lstrip())
+        line = raw.strip()
+        while stack and indent <= stack[-1][0]:
+            stack.pop()
+        parent = stack[-1][1] if stack else root
+        if ":" not in line:
+            continue
+        key, _, val = line.partition(":")
+        key, val = key.strip(), val.strip()
+        if not val:
+            child: dict = {}
+            parent[key] = child
+            stack.append((indent, child))
+        else:
+            if val.startswith("[") and val.endswith("]"):
+                parent[key] = [p.strip().strip("'\"") for p in val[1:-1].split(",") if p.strip()]
+            else:
+                v = val.strip("'\"")
+                parent[key] = v
+    return root
+
+
+Settings.EMPTY = Settings()
+
+
+def prepare_settings(settings: Settings | Mapping | None = None,
+                     config_path: str | None = None) -> Settings:
+    """Assemble node settings: config file < explicit settings < env overrides.
+    Mirrors node/internal/InternalSettingsPreparer.prepareSettings."""
+    s = Settings.EMPTY
+    if config_path and os.path.exists(config_path):
+        s = s.merged(Settings.from_yaml(config_path))
+    if settings:
+        s = s.merged(settings if isinstance(settings, Settings) else Settings.from_flat(settings))
+    env = os.environ.get("ESTPU_SETTINGS")
+    if env:
+        s = s.merged(Settings.from_flat(json.loads(env)))
+    return s
+
+
+class DynamicSettings:
+    """Whitelist of runtime-updatable setting keys (supports * wildcards), with optional
+    per-key validators. Mirrors cluster/settings/DynamicSettings.java."""
+
+    def __init__(self):
+        self._patterns: dict[str, Callable[[str, Any], str | None] | None] = {}
+
+    def add(self, pattern: str, validator: Callable[[str, Any], str | None] | None = None):
+        self._patterns[pattern] = validator
+        return self
+
+    def is_dynamic(self, key: str) -> bool:
+        return any(
+            key == p or fnmatch.fnmatch(key, p) or (p.endswith(".") and key.startswith(p))
+            for p in self._patterns
+        )
+
+    def validate(self, key: str, value) -> str | None:
+        for p, validator in self._patterns.items():
+            if validator and (key == p or fnmatch.fnmatch(key, p)):
+                return validator(key, value)
+        return None
+
+
+_INDEX_NAME_RE = re.compile(r"^[^A-Z\\/*?\"<>| ,#]+$")
+
+
+def validate_index_name(name: str) -> None:
+    if not name or name.startswith(("_", "-", "+")) or not _INDEX_NAME_RE.match(name):
+        from .errors import InvalidIndexNameError
+
+        raise InvalidIndexNameError(f"invalid index name [{name}]")

@@ -1,0 +1,245 @@
+"""Binary wire/storage codec.
+
+Analogue of common/io/stream/{StreamInput,StreamOutput}.java: variable-length ints,
+length-prefixed UTF-8 strings, optional fields, maps/lists of primitives, and
+version-conditional framing. Every transport request/response and every on-disk record
+(translog ops, segment metadata, cluster state) goes through this codec, so a single
+round-trip test covers the whole wire surface (the reference's AssertingLocalTransport
+does exactly that — see SURVEY.md §4.3).
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+import zlib
+from typing import Any
+
+from .errors import SearchEngineError
+
+_NULL = 0xFF
+
+
+class StreamOutput:
+    __slots__ = ("_buf",)
+
+    def __init__(self):
+        self._buf = io.BytesIO()
+
+    # primitives -------------------------------------------------------------
+    def write_byte(self, b: int):
+        self._buf.write(bytes((b & 0xFF,)))
+
+    def write_bool(self, v: bool):
+        self.write_byte(1 if v else 0)
+
+    def write_int(self, v: int):
+        self._buf.write(struct.pack(">i", v))
+
+    def write_long(self, v: int):
+        self._buf.write(struct.pack(">q", v))
+
+    def write_float(self, v: float):
+        self._buf.write(struct.pack(">f", v))
+
+    def write_double(self, v: float):
+        self._buf.write(struct.pack(">d", v))
+
+    def write_vint(self, v: int):
+        """Unsigned varint, 7 bits per byte, little-group-first (Lucene/ES style)."""
+        assert v >= 0, v
+        while v & ~0x7F:
+            self.write_byte((v & 0x7F) | 0x80)
+            v >>= 7
+        self.write_byte(v)
+
+    def write_vlong(self, v: int):
+        self.write_vint(v)
+
+    def write_zlong(self, v: int):
+        """Zig-zag signed varint."""
+        self.write_vint((v << 1) if v >= 0 else ((-v) << 1) - 1)
+
+    def write_bytes(self, b: bytes):
+        self.write_vint(len(b))
+        self._buf.write(b)
+
+    def write_raw(self, b: bytes):
+        self._buf.write(b)
+
+    def write_string(self, s: str):
+        self.write_bytes(s.encode("utf-8"))
+
+    def write_optional_string(self, s: str | None):
+        if s is None:
+            self.write_bool(False)
+        else:
+            self.write_bool(True)
+            self.write_string(s)
+
+    def write_string_list(self, items):
+        self.write_vint(len(items))
+        for s in items:
+            self.write_string(s)
+
+    # generic ----------------------------------------------------------------
+    def write_value(self, v: Any):
+        """Tagged any-value encoding (analogue of StreamOutput.writeGenericValue)."""
+        if v is None:
+            self.write_byte(_NULL)
+        elif isinstance(v, bool):
+            self.write_byte(0)
+            self.write_bool(v)
+        elif isinstance(v, int):
+            self.write_byte(1)
+            self.write_zlong(v)
+        elif isinstance(v, float):
+            self.write_byte(2)
+            self.write_double(v)
+        elif isinstance(v, str):
+            self.write_byte(3)
+            self.write_string(v)
+        elif isinstance(v, bytes):
+            self.write_byte(4)
+            self.write_bytes(v)
+        elif isinstance(v, (list, tuple)):
+            self.write_byte(5)
+            self.write_vint(len(v))
+            for item in v:
+                self.write_value(item)
+        elif isinstance(v, dict):
+            self.write_byte(6)
+            self.write_vint(len(v))
+            for k, item in v.items():
+                self.write_string(str(k))
+                self.write_value(item)
+        else:
+            raise SearchEngineError(f"cannot serialize value of type {type(v)}")
+
+    def write_map(self, d: dict):
+        self.write_value(d)
+
+    def bytes(self) -> bytes:
+        return self._buf.getvalue()
+
+    def bytes_with_checksum(self) -> bytes:
+        payload = self.bytes()
+        return payload + struct.pack(">I", zlib.crc32(payload) & 0xFFFFFFFF)
+
+
+class StreamInput:
+    __slots__ = ("_buf", "_len")
+
+    def __init__(self, data: bytes):
+        self._buf = io.BytesIO(data)
+        self._len = len(data)
+
+    @classmethod
+    def with_checksum(cls, data: bytes) -> "StreamInput":
+        if len(data) < 4:
+            raise SearchEngineError("truncated checksummed stream")
+        payload, crc = data[:-4], struct.unpack(">I", data[-4:])[0]
+        if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            raise SearchEngineError("checksum mismatch on stream")
+        return cls(payload)
+
+    def _read(self, n: int) -> bytes:
+        b = self._buf.read(n)
+        if len(b) != n:
+            raise SearchEngineError("unexpected end of stream")
+        return b
+
+    def read_byte(self) -> int:
+        return self._read(1)[0]
+
+    def read_bool(self) -> bool:
+        return self.read_byte() != 0
+
+    def read_int(self) -> int:
+        return struct.unpack(">i", self._read(4))[0]
+
+    def read_long(self) -> int:
+        return struct.unpack(">q", self._read(8))[0]
+
+    def read_float(self) -> float:
+        return struct.unpack(">f", self._read(4))[0]
+
+    def read_double(self) -> float:
+        return struct.unpack(">d", self._read(8))[0]
+
+    def read_vint(self) -> int:
+        out = 0
+        shift = 0
+        while True:
+            b = self.read_byte()
+            out |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return out
+            shift += 7
+
+    def read_vlong(self) -> int:
+        return self.read_vint()
+
+    def read_zlong(self) -> int:
+        v = self.read_vint()
+        return (v >> 1) if not v & 1 else -((v + 1) >> 1)
+
+    def read_bytes(self) -> bytes:
+        return self._read(self.read_vint())
+
+    def read_string(self) -> str:
+        return self.read_bytes().decode("utf-8")
+
+    def read_optional_string(self) -> str | None:
+        return self.read_string() if self.read_bool() else None
+
+    def read_string_list(self) -> list[str]:
+        return [self.read_string() for _ in range(self.read_vint())]
+
+    def read_value(self) -> Any:
+        tag = self.read_byte()
+        if tag == _NULL:
+            return None
+        if tag == 0:
+            return self.read_bool()
+        if tag == 1:
+            return self.read_zlong()
+        if tag == 2:
+            return self.read_double()
+        if tag == 3:
+            return self.read_string()
+        if tag == 4:
+            return self.read_bytes()
+        if tag == 5:
+            return [self.read_value() for _ in range(self.read_vint())]
+        if tag == 6:
+            return {self.read_string(): self.read_value() for _ in range(self.read_vint())}
+        raise SearchEngineError(f"unknown value tag {tag}")
+
+    def read_map(self) -> dict:
+        v = self.read_value()
+        assert isinstance(v, dict)
+        return v
+
+    def remaining(self) -> int:
+        return self._len - self._buf.tell()
+
+
+class Streamable:
+    """Mixin: objects that serialize through StreamOutput/StreamInput."""
+
+    def write_to(self, out: StreamOutput) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    @classmethod
+    def read_from(cls, inp: StreamInput):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def to_bytes(self) -> bytes:
+        out = StreamOutput()
+        self.write_to(out)
+        return out.bytes()
+
+    @classmethod
+    def from_bytes(cls, data: bytes):
+        return cls.read_from(StreamInput(data))

@@ -1,0 +1,144 @@
+"""Named thread pools + scheduler.
+
+Analogue of threadpool/ThreadPool.java: named executors (search/index/bulk/get/management/
+generic/...) with individual sizes, a shared scheduler for periodic jobs (refresh, translog
+flush, fault-detection pings), per-pool stats, and dynamic resize.
+
+TPU note: device compute itself is dispatched asynchronously by JAX's runtime; these pools
+serve the HOST side — request fan-out, IO, recovery streaming, periodic maintenance.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+
+from .common.logging import get_logger
+
+logger = get_logger("threadpool")
+
+Names = (
+    "same",
+    "generic",
+    "get",
+    "index",
+    "bulk",
+    "search",
+    "suggest",
+    "percolate",
+    "management",
+    "flush",
+    "merge",
+    "refresh",
+    "warmer",
+    "snapshot",
+    "optimize",
+)
+
+_DEFAULT_SIZES = {
+    "generic": 8,
+    "get": 4,
+    "index": 4,
+    "bulk": 4,
+    "search": 8,
+    "suggest": 2,
+    "percolate": 2,
+    "management": 2,
+    "flush": 2,
+    "merge": 2,
+    "refresh": 2,
+    "warmer": 2,
+    "snapshot": 2,
+    "optimize": 1,
+}
+
+
+class _ScheduledTask:
+    def __init__(self, interval: float, fn, pool_submit, fixed_delay: bool = True):
+        self.interval = interval
+        self.fn = fn
+        self.cancelled = threading.Event()
+        self._submit = pool_submit
+
+    def cancel(self):
+        self.cancelled.set()
+
+
+class ThreadPool:
+    def __init__(self, settings=None):
+        from .common.settings import Settings
+
+        settings = settings or Settings.EMPTY
+        self._pools: dict[str, ThreadPoolExecutor] = {}
+        self._sizes: dict[str, int] = {}
+        self._stats = {name: {"completed": 0, "rejected": 0} for name in Names}
+        for name in Names:
+            if name == "same":
+                continue
+            size = settings.get_int(f"threadpool.{name}.size", _DEFAULT_SIZES.get(name, 2))
+            self._sizes[name] = size
+            self._pools[name] = ThreadPoolExecutor(max_workers=size, thread_name_prefix=f"estpu[{name}]")
+        self._scheduler_tasks: list[_ScheduledTask] = []
+        self._scheduler_thread = threading.Thread(target=self._scheduler_loop, daemon=True, name="estpu[scheduler]")
+        self._shutdown = threading.Event()
+        self._scheduler_thread.start()
+
+    # execution --------------------------------------------------------------
+    def executor(self, name: str) -> ThreadPoolExecutor:
+        return self._pools[name if name != "same" else "generic"]
+
+    def submit(self, name: str, fn, *args, **kwargs) -> Future:
+        """Run fn on the named pool. "same" runs inline (caller thread), like the
+        reference's ThreadPool.Names.SAME."""
+        if name == "same":
+            f: Future = Future()
+            try:
+                f.set_result(fn(*args, **kwargs))
+            except BaseException as e:  # noqa: BLE001 - mirror executor behavior
+                f.set_exception(e)
+            return f
+        self._stats[name]["completed"] += 1
+        return self._pools[name].submit(fn, *args, **kwargs)
+
+    # scheduling -------------------------------------------------------------
+    def schedule(self, delay_s: float, name: str, fn) -> threading.Timer:
+        t = threading.Timer(delay_s, lambda: self.submit(name, fn))
+        t.daemon = True
+        t.start()
+        return t
+
+    def schedule_with_fixed_delay(self, interval_s: float, fn, name: str = "generic") -> _ScheduledTask:
+        task = _ScheduledTask(interval_s, fn, lambda f: self.submit(name, f))
+        task._next = time.monotonic() + interval_s  # type: ignore[attr-defined]
+        self._scheduler_tasks.append(task)
+        return task
+
+    def _scheduler_loop(self):
+        while not self._shutdown.wait(0.05):
+            now = time.monotonic()
+            for task in list(self._scheduler_tasks):
+                if task.cancelled.is_set():
+                    self._scheduler_tasks.remove(task)
+                    continue
+                if now >= getattr(task, "_next", 0):
+                    task._next = now + task.interval  # type: ignore[attr-defined]
+                    try:
+                        task._submit(task.fn)
+                    except RuntimeError:
+                        return  # pool shut down
+
+    # lifecycle --------------------------------------------------------------
+    def shutdown(self):
+        self._shutdown.set()
+        for task in self._scheduler_tasks:
+            task.cancel()
+        for pool in self._pools.values():
+            pool.shutdown(wait=False, cancel_futures=True)
+
+    def stats(self) -> dict:
+        return {
+            name: {"threads": self._sizes.get(name, 0), **self._stats[name]}
+            for name in Names
+            if name != "same"
+        }

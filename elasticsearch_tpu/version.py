@@ -1,0 +1,36 @@
+"""Framework version.
+
+Mirrors the reference's version-carrying wire protocol
+(/root/reference/src/main/java/org/elasticsearch/Version.java): every node advertises a
+version; serialization and cluster-join checks branch on it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True, order=True)
+class Version:
+    major: int
+    minor: int
+    patch: int
+    snapshot: bool = field(default=False, compare=False)
+
+    @property
+    def id(self) -> int:
+        return self.major * 1_000_000 + self.minor * 10_000 + self.patch * 100
+
+    def __str__(self) -> str:
+        s = f"{self.major}.{self.minor}.{self.patch}"
+        return s + "-SNAPSHOT" if self.snapshot else s
+
+    @classmethod
+    def from_id(cls, vid: int) -> "Version":
+        return cls(vid // 1_000_000, (vid // 10_000) % 100, (vid // 100) % 100)
+
+    def on_or_after(self, other: "Version") -> bool:
+        return self.id >= other.id
+
+
+CURRENT = Version(0, 1, 0, snapshot=True)

@@ -1,0 +1,21 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh (the reference tests multi-node behavior with an
+in-JVM TestCluster — SURVEY.md §4.2; we test multi-chip sharding with virtual XLA host
+devices). Must be set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,162 @@
+"""Foundations tests: settings, units, smallfloat codec, wire codec, metrics, breaker."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.breaker import CircuitBreakerService
+from elasticsearch_tpu.common.errors import CircuitBreakingError, IllegalArgumentError
+from elasticsearch_tpu.common.settings import DynamicSettings, Settings
+from elasticsearch_tpu.common.smallfloat import (
+    byte315_to_float,
+    decode_norm_doclen,
+    encode_norm,
+    float_to_byte315,
+)
+from elasticsearch_tpu.common.stream import StreamInput, StreamOutput
+from elasticsearch_tpu.common.units import format_bytes, parse_bytes, parse_time
+
+
+class TestSettings:
+    def test_nested_flattening_and_typed_getters(self):
+        s = Settings({"index": {"number_of_shards": 5, "refresh_interval": "1s"},
+                      "node": {"name": "n1", "master": "true"}})
+        assert s.get_int("index.number_of_shards") == 5
+        assert s.get_time("index.refresh_interval") == 1.0
+        assert s.get_bool("node.master") is True
+        assert s.get_str("node.name") == "n1"
+        assert s.get_int("missing", 7) == 7
+
+    def test_prefix_and_groups(self):
+        s = Settings.from_flat({
+            "index.analysis.analyzer.my.type": "custom",
+            "index.analysis.analyzer.my.tokenizer": "standard",
+            "index.analysis.analyzer.other.type": "keyword",
+        })
+        groups = s.groups("index.analysis.analyzer.")
+        assert set(groups) == {"my", "other"}
+        assert groups["my"].get_str("type") == "custom"
+
+    def test_structured_roundtrip(self):
+        s = Settings.from_flat({"a.b.c": 1, "a.b.d": 2, "e": "x"})
+        assert s.as_structured() == {"a": {"b": {"c": 1, "d": 2}}, "e": "x"}
+
+    def test_merged_override(self):
+        s = Settings.from_flat({"a": 1, "b": 2}).merged({"b": 3})
+        assert s.get_int("b") == 3
+
+    def test_list_settings(self):
+        s = Settings.from_flat({"x": "a, b ,c", "y": ["p", "q"]})
+        assert s.get_list("x") == ["a", "b", "c"]
+        assert s.get_list("y") == ["p", "q"]
+
+    def test_dynamic_settings_whitelist(self):
+        d = DynamicSettings().add("cluster.routing.allocation.*").add("index.number_of_replicas")
+        assert d.is_dynamic("cluster.routing.allocation.enable")
+        assert d.is_dynamic("index.number_of_replicas")
+        assert not d.is_dynamic("index.number_of_shards")
+
+
+class TestUnits:
+    def test_bytes(self):
+        assert parse_bytes("1kb") == 1024
+        assert parse_bytes("512mb") == 512 * 1024 * 1024
+        assert parse_bytes("2g") == 2 * 1024**3
+        assert parse_bytes(100) == 100
+        assert format_bytes(1536) == "1.5kb"
+
+    def test_time(self):
+        assert parse_time("30s") == 30.0
+        assert parse_time("5m") == 300.0
+        assert parse_time("200ms") == 0.2
+        assert parse_time(1500) == 1.5  # bare numbers are millis
+        with pytest.raises(IllegalArgumentError):
+            parse_time("5parsecs")
+
+
+class TestSmallFloat:
+    """The 1-byte norm codec must match Lucene's byte315 semantics exactly —
+    hit-ordering parity depends on it (SURVEY.md §7 hard parts)."""
+
+    def test_known_values(self):
+        # 1/sqrt(1)=1.0 encodes to 124 and decodes back to 1.0 in Lucene's table
+        assert byte315_to_float(float_to_byte315(1.0))[0] == 1.0
+        # zero and negatives encode to 0
+        assert float_to_byte315(0.0)[0] == 0
+        assert float_to_byte315(-1.0)[0] == 0
+        assert byte315_to_float(np.uint8(0))[0] == 0.0
+
+    def test_roundtrip_is_idempotent_quantization(self):
+        vals = np.float32(1.0) / np.sqrt(np.arange(1, 10000, dtype=np.float32))
+        enc = float_to_byte315(vals)
+        dec = byte315_to_float(enc)
+        # re-encoding a decoded value must be a fixed point
+        assert np.array_equal(float_to_byte315(dec), enc)
+        # truncation error bounded by the stored mantissa bits (<25% relative)
+        assert np.all(np.abs(dec - vals) / vals < 0.25)
+
+    def test_doc_length_decode(self):
+        # a 100-term doc: norm = 1/10 → decode doclen ≈ 100 (quantized)
+        b = encode_norm(100)
+        dl = decode_norm_doclen(b)[0]
+        assert 70 <= dl <= 135
+
+    def test_monotonic(self):
+        # longer docs must never get a LARGER decoded norm
+        lengths = np.arange(1, 5000)
+        dec = byte315_to_float(encode_norm(lengths))
+        assert np.all(np.diff(dec) <= 0)
+
+
+class TestStream:
+    def test_primitives_roundtrip(self):
+        out = StreamOutput()
+        out.write_vint(0)
+        out.write_vint(127)
+        out.write_vint(128)
+        out.write_vint(300000)
+        out.write_zlong(-12345)
+        out.write_string("héllo wörld")
+        out.write_optional_string(None)
+        out.write_bool(True)
+        out.write_long(-(2**40))
+        out.write_double(3.14159)
+        inp = StreamInput(out.bytes())
+        assert inp.read_vint() == 0
+        assert inp.read_vint() == 127
+        assert inp.read_vint() == 128
+        assert inp.read_vint() == 300000
+        assert inp.read_zlong() == -12345
+        assert inp.read_string() == "héllo wörld"
+        assert inp.read_optional_string() is None
+        assert inp.read_bool() is True
+        assert inp.read_long() == -(2**40)
+        assert inp.read_double() == pytest.approx(3.14159)
+        assert inp.remaining() == 0
+
+    def test_generic_value_roundtrip(self):
+        doc = {"user": "kimchy", "age": 42, "tags": ["a", "b"], "nested": {"x": 1.5},
+               "flag": True, "none": None}
+        out = StreamOutput()
+        out.write_value(doc)
+        assert StreamInput(out.bytes()).read_value() == doc
+
+    def test_checksum_detects_corruption(self):
+        out = StreamOutput()
+        out.write_string("payload")
+        data = bytearray(out.bytes_with_checksum())
+        StreamInput.with_checksum(bytes(data))  # ok
+        data[2] ^= 0xFF
+        with pytest.raises(Exception):
+            StreamInput.with_checksum(bytes(data))
+
+
+class TestBreaker:
+    def test_trips_over_limit(self):
+        svc = CircuitBreakerService(total_budget_bytes=1000)
+        br = svc.breaker("fielddata")  # limit = 800
+        br.add_estimate_and_maybe_break(700, "field_a")
+        with pytest.raises(CircuitBreakingError):
+            br.add_estimate_and_maybe_break(200, "field_b")
+        br.release(700)
+        br.add_estimate_and_maybe_break(200, "field_b")
+        assert br.trip_count == 1
