@@ -1,0 +1,24 @@
+"""Text analysis: tokenizers → token filters → analyzers.
+
+Analogue of index/analysis/ in the reference (AnalysisService + *AnalyzerProvider +
+*TokenFilterFactory — SURVEY.md §2.3). The analysis chain turns field text into a token
+stream; tokens feed the segment builder's postings. Analyzer behavior must match the
+reference's defaults ("standard" analyzer = standard tokenizer + lowercase + stopwords)
+because scoring parity depends on identical token streams.
+
+Design: pure functions over str → list[Token]; analyzers are picklable and cheap so each
+shard process can own its chain. The hot path (bulk indexing) batches through the
+vectorized `analyze_batch`.
+"""
+
+from .core import (  # noqa: F401
+    Token,
+    Analyzer,
+    CustomAnalyzer,
+    AnalysisService,
+    TOKENIZERS,
+    TOKEN_FILTERS,
+    CHAR_FILTERS,
+    ANALYZERS,
+    get_analyzer,
+)

@@ -1,0 +1,8 @@
+from .core import (  # noqa: F401
+    FieldType,
+    FieldMapper,
+    DocumentMapper,
+    MapperService,
+    ParsedDocument,
+    parse_date,
+)

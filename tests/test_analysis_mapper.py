@@ -1,0 +1,170 @@
+"""Analysis chain + document mapper tests."""
+
+import pytest
+
+from elasticsearch_tpu.analysis import AnalysisService, get_analyzer
+from elasticsearch_tpu.common.errors import MapperParsingError
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.mapper import DocumentMapper, MapperService, parse_date
+from elasticsearch_tpu.mapper.core import parse_date_math, parse_ip, format_ip
+
+
+class TestAnalysis:
+    def test_standard_analyzer(self):
+        a = get_analyzer("standard")
+        assert a.terms("The Quick-Brown Fox, jumped! Over 2 dogs.") == [
+            "the", "quick", "brown", "fox", "jumped", "over", "2", "dogs"]
+
+    def test_whitespace_keeps_case_and_punct(self):
+        assert get_analyzer("whitespace").terms("Foo BAR-baz") == ["Foo", "BAR-baz"]
+
+    def test_keyword_analyzer(self):
+        assert get_analyzer("keyword").terms("New York") == ["New York"]
+
+    def test_stop_analyzer(self):
+        assert get_analyzer("stop").terms("the quick fox") == ["quick", "fox"]
+
+    def test_english_stems(self):
+        terms = get_analyzer("english").terms("the running dogs jumped")
+        assert terms == ["run", "dog", "jump"]
+
+    def test_positions_tracked(self):
+        toks = get_analyzer("standard").analyze("alpha beta gamma")
+        assert [(t.term, t.position) for t in toks] == [("alpha", 0), ("beta", 1), ("gamma", 2)]
+
+    def test_custom_analyzer_from_settings(self):
+        svc = AnalysisService(Settings.from_flat({
+            "index.analysis.analyzer.my.tokenizer": "whitespace",
+            "index.analysis.analyzer.my.filter": ["lowercase", "my_stop"],
+            "index.analysis.filter.my_stop.type": "stop",
+            "index.analysis.filter.my_stop.stopwords": ["foo"],
+        }))
+        assert svc.analyzer("my").terms("Foo BAR") == ["bar"]
+
+    def test_ngram_and_shingle(self):
+        svc = AnalysisService(Settings.from_flat({
+            "index.analysis.analyzer.ng.tokenizer": "standard",
+            "index.analysis.analyzer.ng.filter": ["lowercase", "eg"],
+            "index.analysis.filter.eg.type": "edge_ngram",
+            "index.analysis.filter.eg.min_gram": 2,
+            "index.analysis.filter.eg.max_gram": 4,
+            "index.analysis.analyzer.sh.tokenizer": "standard",
+            "index.analysis.analyzer.sh.filter": ["lowercase", "shingle"],
+        }))
+        assert svc.analyzer("ng").terms("hello") == ["he", "hel", "hell"]
+        assert "quick brown" in svc.analyzer("sh").terms("Quick Brown Fox")
+
+    def test_synonym_filter(self):
+        svc = AnalysisService(Settings.from_flat({
+            "index.analysis.analyzer.syn.tokenizer": "standard",
+            "index.analysis.analyzer.syn.filter": ["lowercase", "mysyn"],
+            "index.analysis.filter.mysyn.type": "synonym",
+            "index.analysis.filter.mysyn.synonyms": ["quick,fast"],
+        }))
+        assert set(svc.analyzer("syn").terms("quick")) == {"quick", "fast"}
+
+    def test_html_strip(self):
+        svc = AnalysisService(Settings.from_flat({
+            "index.analysis.analyzer.h.tokenizer": "standard",
+            "index.analysis.analyzer.h.char_filter": ["html_strip"],
+            "index.analysis.analyzer.h.filter": ["lowercase"],
+        }))
+        assert svc.analyzer("h").terms("<b>Bold</b> move") == ["bold", "move"]
+
+
+class TestDates:
+    def test_iso(self):
+        assert parse_date("2014-01-01") == 1388534400000
+        assert parse_date("2014-01-01T12:30:45Z") == 1388579445000
+        assert parse_date(1388534400000) == 1388534400000
+
+    def test_date_math(self):
+        now = 1388534400000
+        assert parse_date_math("now", now) == now
+        assert parse_date_math("now-1d", now) == now - 86400_000
+        assert parse_date_math("now/d", now + 3600_000) == now
+
+    def test_ip(self):
+        assert parse_ip("192.168.1.1") == (192 << 24) | (168 << 16) | (1 << 8) | 1
+        assert format_ip(parse_ip("10.0.0.255")) == "10.0.0.255"
+
+
+class TestMapper:
+    def _mapper(self, mapping=None):
+        return DocumentMapper("doc", mapping or {}, AnalysisService())
+
+    def test_parse_with_explicit_mapping(self):
+        m = self._mapper({"properties": {
+            "title": {"type": "string"},
+            "tag": {"type": "string", "index": "not_analyzed"},
+            "views": {"type": "long"},
+            "published": {"type": "date"},
+        }})
+        doc = m.parse({"title": "Hello World", "tag": "New York", "views": 42,
+                       "published": "2014-01-01"}, doc_id="1")
+        assert [t for t, _ in doc.postings["title"]] == ["hello", "world"]
+        assert doc.postings["tag"] == [("New York", 0)]
+        assert doc.doc_values_num["views"] == [42.0]
+        assert doc.doc_values_num["published"] == [1388534400000.0]
+        assert doc.field_lengths["title"] == 2
+        assert doc.uid == "doc#1"
+        # _all collects analyzed + keyword terms
+        assert "hello" in [t for t, _ in doc.postings["_all"]]
+
+    def test_dynamic_mapping(self):
+        m = self._mapper()
+        doc = m.parse({"name": "bob", "age": 30, "score": 1.5, "active": True,
+                       "joined": "2014-02-03"}, doc_id="1")
+        assert m.fields["name"].type == "string"
+        assert m.fields["age"].type == "long"
+        assert m.fields["score"].type == "double"
+        assert m.fields["active"].type == "boolean"
+        assert m.fields["joined"].type == "date"
+        assert doc.doc_values_num["active"] == [1.0]
+
+    def test_strict_dynamic_raises(self):
+        m = self._mapper({"dynamic": "strict", "properties": {"a": {"type": "string"}}})
+        with pytest.raises(MapperParsingError):
+            m.parse({"a": "x", "b": "boom"}, doc_id="1")
+
+    def test_object_flattening_and_nested(self):
+        m = self._mapper({"properties": {
+            "user": {"properties": {"name": {"type": "string"}}},
+            "comments": {"type": "nested", "properties": {"text": {"type": "string"}}},
+        }})
+        doc = m.parse({"user": {"name": "alice smith"},
+                       "comments": [{"text": "first post"}, {"text": "second"}]}, doc_id="1")
+        assert [t for t, _ in doc.postings["user.name"]] == ["alice", "smith"]
+        assert len(doc.nested_docs) == 2
+        path, sub = doc.nested_docs[0]
+        assert path == "comments"
+        assert [t for t, _ in sub.postings["comments.text"]] == ["first", "post"]
+
+    def test_multi_value_position_gap(self):
+        m = self._mapper({"properties": {"tags": {"type": "string"}}})
+        doc = m.parse({"tags": ["alpha beta", "gamma"]}, doc_id="1")
+        positions = [p for _, p in doc.postings["tags"]]
+        assert positions[0] == 0 and positions[1] == 1
+        assert positions[2] > positions[1] + 50  # gap between values
+
+    def test_copy_to(self):
+        m = self._mapper({"properties": {
+            "first": {"type": "string", "copy_to": "full_name"},
+            "last": {"type": "string", "copy_to": "full_name"},
+        }})
+        doc = m.parse({"first": "john", "last": "doe"}, doc_id="1")
+        assert [t for t, _ in doc.postings["full_name"]] == ["john", "doe"]
+
+    def test_merge_conflicts(self):
+        m = self._mapper({"properties": {"a": {"type": "string"}}})
+        conflicts = m.merge({"properties": {"a": {"type": "long"}}}, simulate=True)
+        assert conflicts and "different type" in conflicts[0]
+
+    def test_mapper_service_roundtrip(self):
+        svc = MapperService()
+        svc.put_mapping("doc", {"properties": {"title": {"type": "string"}}})
+        svc.mapper_for("doc").parse({"title": "x", "extra": 5}, doc_id="1")
+        out = svc.mappings_dict()
+        assert out["doc"]["properties"]["title"]["type"] == "string"
+        assert out["doc"]["properties"]["extra"]["type"] == "long"
+        assert svc.field_type("extra").type == "long"
