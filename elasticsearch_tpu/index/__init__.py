@@ -1,0 +1,4 @@
+from .engine import Engine, Searcher, GetResult, INTERNAL, EXTERNAL  # noqa: F401
+from .segment import FrozenSegment, SegmentBuilder, FieldStats, merge_segments  # noqa: F401
+from .store import Store  # noqa: F401
+from .translog import Translog, TranslogOp  # noqa: F401

@@ -1,0 +1,467 @@
+"""Per-shard engine: versioned mutations + NRT visibility + durability.
+
+Analogue of index/engine/internal/InternalEngine.java (SURVEY.md §2.3): one write path
+(buffer + translog + live version map) and one read path (an immutable snapshot of frozen
+segments). Reference semantics preserved:
+
+- optimistic concurrency via `_version` with internal/external version types
+  (ref: index/VersionType.java, InternalEngine.index:471)
+- `create` fails on existing doc (DocumentAlreadyExistsError)
+- realtime GET served from the version map (the reference serves it from the translog,
+  InternalEngine.get:312-343) before refresh
+- refresh makes buffered ops searchable (InternalEngine.refresh:711)
+- flush = persist segments + commit point carrying the translog generation + translog
+  roll (InternalEngine.flush:758, commit user-data :266-278)
+- deletes are tombstones in per-segment live bitmaps; re-index of an existing uid
+  tombstones the old copy at refresh
+
+TPU note: freeze() lays postings out as CSR numpy arrays; the search layer packs those
+onto the device per segment (ops/device_index.py) — so refresh is also the device
+(re)packing point, exactly where Lucene opens new segment readers.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+from ..common.errors import (
+    DocumentAlreadyExistsError,
+    EngineClosedError,
+    VersionConflictError,
+)
+from ..common.logging import get_logger
+from ..mapper import MapperService
+from .segment import FieldStats, FrozenSegment, SegmentBuilder, merge_segments
+from .store import Store
+from .translog import CREATE, DELETE, DELETE_BY_QUERY, INDEX, Translog, TranslogOp
+
+INTERNAL, EXTERNAL = "internal", "external"
+
+
+@dataclass
+class VersionEntry:
+    version: int
+    deleted: bool = False
+    # location of the latest copy: ("buffer", local) before refresh, (gen, local) after
+    location: tuple | None = None
+    # retained source for realtime get of unrefreshed docs
+    source: dict | None = None
+    routing: str | None = None
+
+
+@dataclass
+class GetResult:
+    found: bool
+    id: str = ""
+    type: str = ""
+    version: int = 0
+    source: dict | None = None
+    routing: str | None = None
+
+
+class Searcher:
+    """Point-in-time view over frozen segments (ref: Engine.acquireSearcher:682).
+    Doc addressing: global doc = segment base + local id, bases assigned in segment
+    order — same scheme as Lucene's composite reader."""
+
+    def __init__(self, segments: list[FrozenSegment]):
+        self.segments = segments
+        self.bases: list[int] = []
+        base = 0
+        for seg in segments:
+            self.bases.append(base)
+            base += seg.doc_count
+        self.max_doc = base
+
+    def live_doc_count(self) -> int:
+        return sum(seg.live_count() for seg in self.segments)
+
+    def doc_freq(self, field: str, term: str) -> int:
+        return sum(seg.doc_freq(field, term) for seg in self.segments)
+
+    def field_stats(self, field: str) -> FieldStats:
+        out = FieldStats()
+        for seg in self.segments:
+            s = seg.field_stats.get(field)
+            if s:
+                out = out.merged(s)
+        return out
+
+    def resolve(self, global_doc: int) -> tuple[FrozenSegment, int]:
+        for i in range(len(self.segments) - 1, -1, -1):
+            if global_doc >= self.bases[i]:
+                return self.segments[i], global_doc - self.bases[i]
+        raise IndexError(global_doc)
+
+
+class Engine:
+    def __init__(self, path: str, mapper_service: MapperService, shard_label=("index", 0),
+                 settings=None):
+        self.logger = get_logger("index.engine", shard=shard_label)
+        self.path = path
+        self.mapper_service = mapper_service
+        self.store = Store(os.path.join(path, "index"))
+        self.translog = Translog(os.path.join(path, "translog"))
+        self._lock = threading.RLock()
+        self._segments: list[FrozenSegment] = []
+        self._segment_files: dict[str, dict] = {}  # str(gen) -> file metadata
+        self._persisted_gens: set[int] = set()
+        self._next_gen = 1
+        self._commit_id = 0
+        self._buffer = SegmentBuilder(self._next_gen)
+        self._version_map: dict[str, VersionEntry] = {}
+        self._uid_index: dict[str, tuple[int, int]] = {}  # uid -> (gen, local) frozen
+        self._pending_deletes: list[tuple] = []  # locations to tombstone at refresh
+        self._closed = False
+        self._searcher: Searcher = Searcher([])
+        self.created = time.time()
+        self._last_write = 0.0
+        self.stats = {
+            "index_total": 0, "index_time_ms": 0.0, "delete_total": 0,
+            "refresh_total": 0, "refresh_time_ms": 0.0,
+            "flush_total": 0, "flush_time_ms": 0.0, "merge_total": 0,
+        }
+
+    # ------------------------------------------------------------------ util
+    def _check_open(self):
+        if self._closed:
+            raise EngineClosedError("engine is closed")
+
+    def _current_version(self, uid: str) -> tuple[int | None, bool]:
+        """(version, deleted) of latest copy, or (None, False) if never seen."""
+        entry = self._version_map.get(uid)
+        if entry is not None:
+            return entry.version, entry.deleted
+        loc = self._uid_index.get(uid)
+        if loc is not None:
+            seg = self._seg_by_gen(loc[0])
+            if seg is not None and seg.live[loc[1]]:
+                return int(seg.versions[loc[1]]), False
+        return None, False
+
+    def _seg_by_gen(self, gen: int) -> FrozenSegment | None:
+        for seg in self._segments:
+            if seg.gen == gen:
+                return seg
+        return None
+
+    def _check_version(self, uid: str, version, version_type: str) -> int:
+        """Version precheck; returns the version the new op will carry.
+        (ref: InternalEngine.innerIndex version resolution)"""
+        current, deleted = self._current_version(uid)
+        effective = None if (current is None or deleted) else current
+        if version_type == EXTERNAL:
+            if version is None:
+                raise VersionConflictError(uid, effective or 0, -1)
+            if effective is not None and version <= effective:
+                raise VersionConflictError(uid, effective, version)
+            return int(version)
+        # internal
+        if version is not None and version != 0:
+            if effective is None or effective != version:
+                raise VersionConflictError(uid, effective or 0, version)
+        return (effective or 0) + 1
+
+    # ------------------------------------------------------------------ ops
+    def index(self, type_name: str, doc_id: str, source: dict, routing: str | None = None,
+              version=None, version_type: str = INTERNAL, op_type: str = "index",
+              parent: str | None = None, timestamp=None, ttl=None,
+              _from_translog: bool = False) -> tuple[int, bool]:
+        """Index or create a document. Returns (new_version, created)."""
+        with self._lock:
+            self._check_open()
+            t0 = time.monotonic()
+            mapper = self.mapper_service.mapper_for(type_name)
+            uid = f"{type_name}#{doc_id}"
+            current, deleted = self._current_version(uid)
+            created = current is None or deleted
+            if op_type == "create" and not created and version is None:
+                raise DocumentAlreadyExistsError(f"[{type_name}][{doc_id}] already exists")
+            new_version = self._check_version(uid, version, version_type)
+            parsed = mapper.parse(source, doc_id, routing=routing, timestamp=timestamp,
+                                  ttl=ttl, parent=parent)
+            if not _from_translog:
+                self.translog.add(TranslogOp(
+                    CREATE if op_type == "create" else INDEX, type_name, doc_id, source,
+                    routing=routing, version=new_version, parent=parent,
+                    timestamp=timestamp, ttl=ttl,
+                ))
+            # tombstone the previous copy (applied at refresh)
+            old_entry = self._version_map.get(uid)
+            if old_entry is not None and old_entry.location is not None and not old_entry.deleted:
+                self._pending_deletes.append(old_entry.location)
+            elif old_entry is None:
+                loc = self._uid_index.get(uid)
+                if loc is not None:
+                    self._pending_deletes.append(loc)
+            local = self._buffer.add(parsed, version=new_version)
+            self._version_map[uid] = VersionEntry(
+                version=new_version, deleted=False, location=("buffer", local),
+                source=source, routing=parsed.routing,
+            )
+            self.stats["index_total"] += 1
+            self.stats["index_time_ms"] += (time.monotonic() - t0) * 1000
+            self._last_write = time.time()
+            return new_version, created
+
+    def delete(self, type_name: str, doc_id: str, version=None,
+               version_type: str = INTERNAL, _from_translog: bool = False) -> tuple[int, bool]:
+        """Delete by id. Returns (version, found)."""
+        with self._lock:
+            self._check_open()
+            uid = f"{type_name}#{doc_id}"
+            current, already_deleted = self._current_version(uid)
+            found = current is not None and not already_deleted
+            new_version = self._check_version(uid, version, version_type)
+            if not _from_translog:
+                self.translog.add(TranslogOp(DELETE, type_name, doc_id, version=new_version))
+            entry = self._version_map.get(uid)
+            if entry is not None and entry.location is not None and not entry.deleted:
+                self._pending_deletes.append(entry.location)
+            elif entry is None:
+                loc = self._uid_index.get(uid)
+                if loc is not None:
+                    self._pending_deletes.append(loc)
+            self._version_map[uid] = VersionEntry(version=new_version, deleted=True)
+            self.stats["delete_total"] += 1
+            self._last_write = time.time()
+            return new_version, found
+
+    def delete_by_uids(self, uids: list[str], query: dict | None = None,
+                       _from_translog: bool = False):
+        """Bulk tombstone for delete-by-query (the search layer resolves uids)."""
+        with self._lock:
+            self._check_open()
+            if not _from_translog and query is not None:
+                self.translog.add(TranslogOp(DELETE_BY_QUERY, query=query))
+            for uid in uids:
+                current, deleted = self._current_version(uid)
+                if current is None or deleted:
+                    continue
+                entry = self._version_map.get(uid)
+                if entry is not None and entry.location is not None:
+                    self._pending_deletes.append(entry.location)
+                else:
+                    loc = self._uid_index.get(uid)
+                    if loc is not None:
+                        self._pending_deletes.append(loc)
+                self._version_map[uid] = VersionEntry(version=current + 1, deleted=True)
+
+    def get(self, type_name: str, doc_id: str, realtime: bool = True) -> GetResult:
+        """Realtime get (ref: InternalEngine.get:312-343 — version map first, then index)."""
+        with self._lock:
+            self._check_open()
+            uid = f"{type_name}#{doc_id}"
+            entry = self._version_map.get(uid)
+            if entry is not None:
+                if entry.deleted:
+                    return GetResult(found=False)
+                if realtime and entry.source is not None:
+                    return GetResult(True, doc_id, type_name, entry.version,
+                                     entry.source, entry.routing)
+            loc = self._uid_index.get(uid)
+            if loc is None:
+                return GetResult(found=False)
+            seg = self._seg_by_gen(loc[0])
+            if seg is None or not seg.live[loc[1]]:
+                return GetResult(found=False)
+            return GetResult(True, doc_id, type_name, int(seg.versions[loc[1]]),
+                             seg.stored[loc[1]], seg.routings[loc[1]])
+
+    # ------------------------------------------------------------------ nrt
+    def refresh(self) -> bool:
+        """Make buffered ops searchable (ref: InternalEngine.refresh:711).
+        Freezes the RAM buffer into a new segment and applies pending tombstones."""
+        with self._lock:
+            self._check_open()
+            if self._buffer.doc_count == 0 and not self._pending_deletes:
+                return False
+            t0 = time.monotonic()
+            new_seg: FrozenSegment | None = None
+            if self._buffer.doc_count > 0:
+                new_seg = self._buffer.freeze()
+                self._segments.append(new_seg)
+                self._next_gen += 1
+                self._buffer = SegmentBuilder(self._next_gen)
+            # resolve buffer locations to the new segment, then tombstone
+            for loc in self._pending_deletes:
+                if loc[0] == "buffer":
+                    assert new_seg is not None
+                    new_seg.delete_doc(loc[1])
+                else:
+                    seg = self._seg_by_gen(loc[0])
+                    if seg is not None:
+                        seg.delete_doc(loc[1])
+            self._pending_deletes.clear()
+            # update uid index + drop realtime sources (now searchable)
+            if new_seg is not None:
+                for local in range(new_seg.doc_count):
+                    if new_seg.parent_mask[local] and new_seg.live[local]:
+                        uid = f"{new_seg.types[local]}#{new_seg.ids[local]}"
+                        self._uid_index[uid] = (new_seg.gen, local)
+            for uid, entry in list(self._version_map.items()):
+                if entry.deleted:
+                    self._uid_index.pop(uid, None)
+                del self._version_map[uid]
+            self._searcher = Searcher(list(self._segments))
+            self.stats["refresh_total"] += 1
+            self.stats["refresh_time_ms"] += (time.monotonic() - t0) * 1000
+            return True
+
+    def acquire_searcher(self) -> Searcher:
+        with self._lock:
+            self._check_open()
+            return self._searcher
+
+    # ------------------------------------------------------------------ durability
+    def flush(self, force: bool = False) -> bool:
+        """Persist segments + commit point, roll translog (ref: InternalEngine.flush:758)."""
+        with self._lock:
+            self._check_open()
+            t0 = time.monotonic()
+            self.refresh()
+            wrote = False
+            for seg in self._segments:
+                if seg.gen not in self._persisted_gens:
+                    self._segment_files[str(seg.gen)] = self.store.write_segment(seg)
+                    self._persisted_gens.add(seg.gen)
+                    wrote = True
+                else:
+                    # re-persist live bitmap changes cheaply by rewriting the segment
+                    # when tombstones changed since last flush
+                    pass
+            if not wrote and not force and self._commit_id > 0:
+                committed = self.store.read_last_commit()
+                if committed and committed.get("translog_gen") == self.translog.gen \
+                        and self.translog.ops_count == 0:
+                    return False
+            new_tgen = self.translog.roll()
+            self._commit_id += 1
+            live_tombstones = {
+                str(seg.gen): seg.live.tolist() if not seg.live.all() else None
+                for seg in self._segments
+            }
+            self.store.write_commit(
+                self._commit_id,
+                {str(seg.gen): self._segment_files[str(seg.gen)] for seg in self._segments},
+                translog_gen=new_tgen,
+                extra={"tombstones": live_tombstones},
+            )
+            self.translog.prune_before(new_tgen)
+            self.stats["flush_total"] += 1
+            self.stats["flush_time_ms"] += (time.monotonic() - t0) * 1000
+            return True
+
+    def maybe_flush(self):
+        if self.translog.should_flush():
+            self.flush()
+
+    def optimize(self, max_num_segments: int = 1):
+        """Force-merge (ref: InternalEngine.maybeMerge / optimize API)."""
+        with self._lock:
+            self._check_open()
+            self.refresh()
+            if len(self._segments) <= max_num_segments:
+                return
+            merged = merge_segments(self._segments, self._next_gen)
+            self._next_gen += 1
+            self._buffer = SegmentBuilder(self._next_gen)
+            old_gens = [seg.gen for seg in self._segments]
+            self._segments = [merged] if merged.doc_count else []
+            self._uid_index = {}
+            for seg in self._segments:
+                for local in range(seg.doc_count):
+                    if seg.parent_mask[local] and seg.live[local]:
+                        self._uid_index[f"{seg.types[local]}#{seg.ids[local]}"] = (seg.gen, local)
+            for g in old_gens:
+                self._persisted_gens.discard(g)
+                self._segment_files.pop(str(g), None)
+                self.store.delete_segment(g)
+            self._searcher = Searcher(list(self._segments))
+            self.stats["merge_total"] += 1
+
+    def maybe_merge(self, segments_per_tier: int = 10):
+        with self._lock:
+            if len(self._segments) > segments_per_tier:
+                self.optimize(max_num_segments=1)
+
+    # ------------------------------------------------------------------ recovery
+    def recover_from_store(self) -> int:
+        """Gateway recovery: load last commit's segments, then replay the translog
+        (ref: IndexShard.performRecoveryOperation:743 / local gateway)."""
+        with self._lock:
+            commit = self.store.read_last_commit()
+            replayed = 0
+            if commit:
+                self._commit_id = commit["id"]
+                tombstones = commit.get("extra", {}).get("tombstones", {})
+                for gen_str, files in sorted(commit["segments"].items(), key=lambda kv: int(kv[0])):
+                    seg = self.store.read_segment(int(gen_str), verify=files)
+                    tomb = tombstones.get(gen_str)
+                    if tomb:
+                        import numpy as np
+
+                        seg.live = np.asarray(tomb, dtype=bool)
+                    self._segments.append(seg)
+                    self._segment_files[gen_str] = files
+                    self._persisted_gens.add(int(gen_str))
+                    self._next_gen = max(self._next_gen, int(gen_str) + 1)
+                self._buffer = SegmentBuilder(self._next_gen)
+                for seg in self._segments:
+                    for local in range(seg.doc_count):
+                        if seg.parent_mask[local] and seg.live[local]:
+                            self._uid_index[f"{seg.types[local]}#{seg.ids[local]}"] = (seg.gen, local)
+                self.translog.set_gen(commit["translog_gen"])
+            for op in self.translog.read_ops(self.translog.gen if commit else 1):
+                self._replay_op(op)
+                replayed += 1
+            self._searcher = Searcher(list(self._segments))
+            self.refresh()
+            return replayed
+
+    def _replay_op(self, op: TranslogOp):
+        if op.op in (CREATE, INDEX):
+            self.index(op.type, op.id, op.source or {}, routing=op.routing,
+                       version=op.version, version_type=EXTERNAL, _from_translog=True)
+        elif op.op == DELETE:
+            try:
+                self.delete(op.type, op.id, _from_translog=True)
+            except VersionConflictError:
+                pass
+        elif op.op == DELETE_BY_QUERY:
+            # replayed at the shard layer (needs query execution); stored for parity
+            pass
+
+    def apply_replicated_op(self, op: TranslogOp):
+        """Apply an op streamed from a primary (replica write / recovery phase 2-3).
+        Uses EXTERNAL versioning so replicas converge to the primary's versions."""
+        if op.op in (CREATE, INDEX):
+            try:
+                self.index(op.type, op.id, op.source or {}, routing=op.routing,
+                           version=op.version, version_type=EXTERNAL)
+            except VersionConflictError:
+                pass  # already have newer
+        elif op.op == DELETE:
+            try:
+                self.delete(op.type, op.id, _from_translog=False)
+            except VersionConflictError:
+                pass
+
+    # ------------------------------------------------------------------ info
+    def segment_count(self) -> int:
+        return len(self._segments)
+
+    def doc_stats(self) -> dict:
+        s = self.acquire_searcher()
+        live = s.live_doc_count()
+        total = sum(seg.parent_mask.sum() for seg in s.segments)
+        return {"count": int(live), "deleted": int(total - live)}
+
+    def close(self):
+        with self._lock:
+            if not self._closed:
+                self.translog.close()
+                self._closed = True

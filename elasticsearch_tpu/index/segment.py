@@ -1,0 +1,360 @@
+"""Write-once segments: the framework's Lucene-core equivalent.
+
+The reference's per-shard performance core is Lucene's inverted index (SURVEY.md §2.8:
+postings traversal + scoring is "the hot loop the TPU build replaces"). Here a segment is
+a set of flat numpy arrays laid out for direct device packing:
+
+- postings: CSR over term ids — `post_offsets[t]:post_offsets[t+1]` slices `post_docs`
+  (sorted local doc ids) and `post_freqs`; per-term positions likewise for phrase queries.
+- norms: ONE uint8 PER DOC PER FIELD via the SmallFloat byte315 codec — identical
+  quantization to Lucene 4.7 (required for hit-ordering parity, SURVEY.md §7).
+- doc values: columnar numeric (float64 CSR for multi-valued) and string-ordinal columns
+  — the analogue of index/fielddata/ (SURVEY.md §2.3: "the natural device tensor").
+- stored fields: _source dicts + ids/routing, host-side (fetch phase is host work).
+- nested docs are real docs in block order (children before parent, Lucene block-join
+  layout); `parent_mask` restricts top-level searches.
+
+Segments are immutable after freeze(); deletes are tombstones in a `live` bitmap
+(exactly Lucene's liveDocs). Merging = concatenating live docs into a new segment.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+
+import numpy as np
+
+from ..common.smallfloat import encode_norm
+from ..mapper.core import ParsedDocument
+
+
+@dataclass
+class FieldStats:
+    """Per-field corpus statistics a similarity needs (ref: Lucene CollectionStatistics):
+    doc_count = docs with the field, sum_ttf = total term occurrences (for avgdl)."""
+
+    doc_count: int = 0
+    sum_ttf: int = 0
+    sum_dfs: int = 0
+
+    def merged(self, other: "FieldStats") -> "FieldStats":
+        return FieldStats(
+            self.doc_count + other.doc_count,
+            self.sum_ttf + other.sum_ttf,
+            self.sum_dfs + other.sum_dfs,
+        )
+
+
+class SegmentBuilder:
+    """Accumulates parsed documents, freezes into a FrozenSegment.
+    The analogue of Lucene's in-RAM IndexWriter buffer (DWPT)."""
+
+    def __init__(self, gen: int):
+        self.gen = gen
+        # term postings: (field, term) -> list of (local_doc, freq, positions)
+        self._postings: dict[tuple[str, str], list] = {}
+        self._field_lengths: dict[str, list[tuple[int, int]]] = {}
+        self._dv_num: dict[str, list[tuple[int, float]]] = {}
+        self._dv_str: dict[str, list[tuple[int, str]]] = {}
+        self._stored: list[dict | None] = []
+        self._ids: list[str | None] = []
+        self._types: list[str | None] = []
+        self._routings: list[str | None] = []
+        self._versions: list[int] = []
+        self._parent_mask: list[bool] = []
+        self._nested_paths: list[str | None] = []
+        self.doc_count = 0
+
+    def ram_docs(self) -> int:
+        return self.doc_count
+
+    def _add_fields(self, doc: ParsedDocument, local: int):
+        for field_name, terms in doc.postings.items():
+            # group into freq + positions per term
+            per_term: dict[str, list[int]] = {}
+            for term, pos in terms:
+                per_term.setdefault(term, []).append(pos)
+            for term, positions in per_term.items():
+                self._postings.setdefault((field_name, term), []).append(
+                    (local, len(positions), positions)
+                )
+        for field_name, length in doc.field_lengths.items():
+            self._field_lengths.setdefault(field_name, []).append((local, length))
+        for field_name, vals in doc.doc_values_num.items():
+            col = self._dv_num.setdefault(field_name, [])
+            for v in vals:
+                col.append((local, v))
+        for field_name, vals in doc.doc_values_str.items():
+            col = self._dv_str.setdefault(field_name, [])
+            for v in vals:
+                col.append((local, v))
+
+    def add(self, doc: ParsedDocument, version: int = 1) -> int:
+        """Add one parsed document (children-first block order for nested docs).
+        Returns the parent's local doc id."""
+        for path, sub in doc.nested_docs:
+            local = self.doc_count
+            self.doc_count += 1
+            self._add_fields(sub, local)
+            self._stored.append(None)
+            self._ids.append(doc.id)
+            self._types.append("__nested__")
+            self._routings.append(None)
+            self._versions.append(version)
+            self._parent_mask.append(False)
+            self._nested_paths.append(path)
+        local = self.doc_count
+        self.doc_count += 1
+        self._add_fields(doc, local)
+        self._stored.append(doc.source)
+        self._ids.append(doc.id)
+        self._types.append(doc.type)
+        self._routings.append(doc.routing)
+        self._versions.append(version)
+        self._parent_mask.append(True)
+        self._nested_paths.append(None)
+        return local
+
+    def freeze(self) -> "FrozenSegment":
+        D = self.doc_count
+        # term dictionary: per field, terms sorted (Lucene term dict is sorted; sorted
+        # ordinals make range/prefix queries on keyword fields array slices)
+        by_field: dict[str, list[str]] = {}
+        for f, t in self._postings:
+            by_field.setdefault(f, []).append(t)
+        term_dict: dict[str, dict[str, int]] = {}
+        offsets = [0]
+        docs_parts, freqs_parts, pos_offsets, pos_parts = [], [], [0], []
+        tid = 0
+        for f in sorted(by_field):
+            terms = sorted(by_field[f])
+            td: dict[str, int] = {}
+            for t in terms:
+                plist = self._postings[(f, t)]
+                plist.sort(key=lambda e: e[0])
+                td[t] = tid
+                docs_parts.append(np.fromiter((e[0] for e in plist), dtype=np.int32, count=len(plist)))
+                freqs_parts.append(np.fromiter((e[1] for e in plist), dtype=np.float32, count=len(plist)))
+                for e in plist:
+                    pos_parts.extend(e[2])
+                    pos_offsets.append(len(pos_parts))
+                offsets.append(offsets[-1] + len(plist))
+                tid += 1
+            term_dict[f] = td
+        post_docs = np.concatenate(docs_parts) if docs_parts else np.zeros(0, np.int32)
+        post_freqs = np.concatenate(freqs_parts) if freqs_parts else np.zeros(0, np.float32)
+
+        norms: dict[str, np.ndarray] = {}
+        field_stats: dict[str, FieldStats] = {}
+        for f, entries in self._field_lengths.items():
+            lengths = np.zeros(D, dtype=np.int64)
+            for local, ln in entries:
+                lengths[local] += ln
+            norms[f] = encode_norm(lengths)
+            field_stats[f] = FieldStats(
+                doc_count=int((lengths > 0).sum()), sum_ttf=int(lengths.sum())
+            )
+
+        dv_num: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+        for f, entries in self._dv_num.items():
+            entries.sort(key=lambda e: e[0])
+            counts = np.zeros(D + 1, dtype=np.int64)
+            for local, _ in entries:
+                counts[local + 1] += 1
+            off = np.cumsum(counts)
+            vals = np.fromiter((v for _, v in entries), dtype=np.float64, count=len(entries))
+            dv_num[f] = (off, vals)
+
+        dv_str: dict[str, tuple[list[str], np.ndarray, np.ndarray]] = {}
+        for f, entries in self._dv_str.items():
+            entries.sort(key=lambda e: e[0])
+            uniq = sorted({v for _, v in entries})
+            ord_map = {v: i for i, v in enumerate(uniq)}
+            counts = np.zeros(D + 1, dtype=np.int64)
+            for local, _ in entries:
+                counts[local + 1] += 1
+            off = np.cumsum(counts)
+            ords = np.fromiter((ord_map[v] for _, v in entries), dtype=np.int32, count=len(entries))
+            dv_str[f] = (uniq, off, ords)
+
+        return FrozenSegment(
+            gen=self.gen,
+            doc_count=D,
+            term_dict=term_dict,
+            post_offsets=np.asarray(offsets, dtype=np.int64),
+            post_docs=post_docs,
+            post_freqs=post_freqs,
+            pos_offsets=np.asarray(pos_offsets, dtype=np.int64),
+            positions=np.asarray(pos_parts, dtype=np.int32),
+            norms=norms,
+            field_stats=field_stats,
+            dv_num=dv_num,
+            dv_str=dv_str,
+            stored=list(self._stored),
+            ids=list(self._ids),
+            types=list(self._types),
+            routings=list(self._routings),
+            versions=np.asarray(self._versions, dtype=np.int64),
+            live=np.ones(D, dtype=bool),
+            parent_mask=np.asarray(self._parent_mask, dtype=bool),
+            nested_paths=list(self._nested_paths),
+        )
+
+
+@dataclass
+class FrozenSegment:
+    gen: int
+    doc_count: int
+    term_dict: dict[str, dict[str, int]]
+    post_offsets: np.ndarray  # int64[T+1]
+    post_docs: np.ndarray  # int32[P]
+    post_freqs: np.ndarray  # float32[P]
+    pos_offsets: np.ndarray  # int64[P+1]
+    positions: np.ndarray  # int32[PP]
+    norms: dict[str, np.ndarray]  # field -> uint8[D]
+    field_stats: dict[str, FieldStats]
+    dv_num: dict[str, tuple[np.ndarray, np.ndarray]]  # field -> (offsets[D+1], values)
+    dv_str: dict[str, tuple[list[str], np.ndarray, np.ndarray]]  # (sorted terms, offsets, ords)
+    stored: list[dict | None]
+    ids: list[str | None]
+    types: list[str | None]
+    routings: list[str | None]
+    versions: np.ndarray  # int64[D]
+    live: np.ndarray  # bool[D] — mutable tombstones
+    parent_mask: np.ndarray  # bool[D]
+    nested_paths: list[str | None]
+    _device_cache: dict = dc_field(default_factory=dict, repr=False, compare=False)
+
+    # --- term access --------------------------------------------------------
+    def term_id(self, field: str, term: str) -> int | None:
+        td = self.term_dict.get(field)
+        if td is None:
+            return None
+        return td.get(term)
+
+    def doc_freq(self, field: str, term: str) -> int:
+        tid = self.term_id(field, term)
+        if tid is None:
+            return 0
+        return int(self.post_offsets[tid + 1] - self.post_offsets[tid])
+
+    def postings(self, field: str, term: str) -> tuple[np.ndarray, np.ndarray]:
+        tid = self.term_id(field, term)
+        if tid is None:
+            return np.zeros(0, np.int32), np.zeros(0, np.float32)
+        s, e = self.post_offsets[tid], self.post_offsets[tid + 1]
+        return self.post_docs[s:e], self.post_freqs[s:e]
+
+    def term_positions(self, field: str, term: str) -> list[np.ndarray]:
+        """Per matching doc, the token positions of this term (for phrase queries)."""
+        tid = self.term_id(field, term)
+        if tid is None:
+            return []
+        s, e = int(self.post_offsets[tid]), int(self.post_offsets[tid + 1])
+        return [
+            self.positions[self.pos_offsets[i] : self.pos_offsets[i + 1]]
+            for i in range(s, e)
+        ]
+
+    def terms_for_field(self, field: str) -> list[str]:
+        return sorted(self.term_dict.get(field, ()))
+
+    # --- doc access ---------------------------------------------------------
+    def live_count(self) -> int:
+        return int((self.live & self.parent_mask).sum())
+
+    def delete_doc(self, local: int):
+        """Tombstone a doc and its nested children block."""
+        self.live[local] = False
+        self._device_cache.pop("live", None)
+        i = local - 1
+        while i >= 0 and not self.parent_mask[i] and self.nested_paths[i] is not None \
+                and self.ids[i] == self.ids[local]:
+            self.live[i] = False
+            i -= 1
+
+    def num_values(self, field: str, local: int) -> np.ndarray:
+        col = self.dv_num.get(field)
+        if col is None:
+            return np.zeros(0)
+        off, vals = col
+        return vals[off[local] : off[local + 1]]
+
+    def str_values(self, field: str, local: int) -> list[str]:
+        col = self.dv_str.get(field)
+        if col is None:
+            return []
+        uniq, off, ords = col
+        return [uniq[o] for o in ords[off[local] : off[local + 1]]]
+
+    def estimated_bytes(self) -> int:
+        n = self.post_docs.nbytes + self.post_freqs.nbytes + self.positions.nbytes
+        n += sum(a.nbytes for a in self.norms.values())
+        n += sum(o.nbytes + v.nbytes for o, v in self.dv_num.values())
+        return n
+
+
+def merge_segments(segments: list[FrozenSegment], gen: int) -> FrozenSegment:
+    """Merge live docs of several segments into one new segment (Lucene merge
+    equivalent). Rebuilds through a SegmentBuilder keyed on raw postings — exact since
+    segments already hold analyzed terms."""
+    builder = SegmentBuilder(gen)
+    for seg in segments:
+        # reconstruct per-doc postings from CSR (invert)
+        per_doc_postings: list[dict[str, list[tuple[str, int]]]] = [
+            {} for _ in range(seg.doc_count)
+        ]
+        for f, td in seg.term_dict.items():
+            for term, tid in td.items():
+                s, e = int(seg.post_offsets[tid]), int(seg.post_offsets[tid + 1])
+                for i in range(s, e):
+                    local = int(seg.post_docs[i])
+                    poss = seg.positions[seg.pos_offsets[i] : seg.pos_offsets[i + 1]]
+                    per_doc_postings[local].setdefault(f, []).extend(
+                        (term, int(p)) for p in poss
+                    )
+        local = 0
+        while local < seg.doc_count:
+            # collect one block: children (non-parent) run + their parent
+            block_start = local
+            while local < seg.doc_count and not seg.parent_mask[local]:
+                local += 1
+            if local >= seg.doc_count:
+                break
+            parent = local
+            local += 1
+            if not seg.live[parent]:
+                continue
+            doc = ParsedDocument(
+                id=seg.ids[parent] or "",
+                type=seg.types[parent] or "",
+                uid=f"{seg.types[parent]}#{seg.ids[parent]}",
+                source=seg.stored[parent] or {},
+                routing=seg.routings[parent],
+            )
+            doc.postings = {
+                f: sorted(terms, key=lambda tp: tp[1])
+                for f, terms in per_doc_postings[parent].items()
+            }
+            doc.field_lengths = {f: len(t) for f, t in doc.postings.items()}
+            for f, (off, vals) in seg.dv_num.items():
+                v = vals[off[parent] : off[parent + 1]]
+                if len(v):
+                    doc.doc_values_num[f] = list(v)
+            for f in seg.dv_str:
+                v = seg.str_values(f, parent)
+                if v:
+                    doc.doc_values_str[f] = v
+            for child in range(block_start, parent):
+                sub = ParsedDocument(
+                    id=doc.id, type=doc.type, uid=doc.uid,
+                    source={},
+                )
+                sub.postings = {
+                    f: sorted(terms, key=lambda tp: tp[1])
+                    for f, terms in per_doc_postings[child].items()
+                }
+                sub.field_lengths = {f: len(t) for f, t in sub.postings.items()}
+                doc.nested_docs.append((seg.nested_paths[child] or "", sub))
+            builder.add(doc, version=int(seg.versions[parent]))
+    return builder.freeze()

@@ -1,0 +1,198 @@
+"""Checksummed on-disk segment store.
+
+Analogue of index/store/Store.java (SURVEY.md §2.3): a directory per shard holding
+write-once segment files plus a commit point. Every file carries a CRC32 recorded in the
+commit metadata (the reference's `_checksums-` files); peer recovery diffs files by
+(name, length, checksum) to reuse identical segments (RecoverySource.java phase 1).
+
+Layout:
+  <dir>/seg_<gen>.npz        — postings/norms/doc-values arrays
+  <dir>/seg_<gen>.meta.json  — term dict, stored fields, stats
+  <dir>/commit_<N>.json      — commit point: live segments, translog gen, uid→version
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import zlib
+
+import numpy as np
+
+from ..common.errors import SearchEngineError
+from .segment import FieldStats, FrozenSegment
+
+
+def _crc_file(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                return crc & 0xFFFFFFFF
+            crc = zlib.crc32(chunk, crc)
+
+
+class Store:
+    def __init__(self, path: str):
+        self.dir = path
+        os.makedirs(path, exist_ok=True)
+
+    # --- segment IO ---------------------------------------------------------
+    def write_segment(self, seg: FrozenSegment) -> dict:
+        """Persist a frozen segment; returns {file: {length, checksum}} metadata."""
+        npz_path = os.path.join(self.dir, f"seg_{seg.gen}.npz")
+        meta_path = os.path.join(self.dir, f"seg_{seg.gen}.meta.json")
+        arrays = {
+            "post_offsets": seg.post_offsets,
+            "post_docs": seg.post_docs,
+            "post_freqs": seg.post_freqs,
+            "pos_offsets": seg.pos_offsets,
+            "positions": seg.positions,
+            "versions": seg.versions,
+            "live": seg.live,
+            "parent_mask": seg.parent_mask,
+        }
+        for f, a in seg.norms.items():
+            arrays[f"norm::{f}"] = a
+        for f, (off, vals) in seg.dv_num.items():
+            arrays[f"dvn_off::{f}"] = off
+            arrays[f"dvn_val::{f}"] = vals
+        for f, (uniq, off, ords) in seg.dv_str.items():
+            arrays[f"dvs_off::{f}"] = off
+            arrays[f"dvs_ord::{f}"] = ords
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        with open(npz_path, "wb") as fh:
+            fh.write(buf.getvalue())
+        meta = {
+            "gen": seg.gen,
+            "doc_count": seg.doc_count,
+            "term_dict": {f: list(td.keys()) for f, td in seg.term_dict.items()},
+            "field_stats": {
+                f: [s.doc_count, s.sum_ttf, s.sum_dfs] for f, s in seg.field_stats.items()
+            },
+            "dv_str_terms": {f: uniq for f, (uniq, _, _) in seg.dv_str.items()},
+            "stored": seg.stored,
+            "ids": seg.ids,
+            "types": seg.types,
+            "routings": seg.routings,
+            "nested_paths": seg.nested_paths,
+        }
+        with open(meta_path, "w") as fh:
+            json.dump(meta, fh)
+        return {
+            os.path.basename(npz_path): {
+                "length": os.path.getsize(npz_path), "checksum": _crc_file(npz_path)},
+            os.path.basename(meta_path): {
+                "length": os.path.getsize(meta_path), "checksum": _crc_file(meta_path)},
+        }
+
+    def read_segment(self, gen: int, verify: dict | None = None) -> FrozenSegment:
+        npz_path = os.path.join(self.dir, f"seg_{gen}.npz")
+        meta_path = os.path.join(self.dir, f"seg_{gen}.meta.json")
+        if verify:
+            for name, info in verify.items():
+                p = os.path.join(self.dir, name)
+                if not os.path.exists(p) or _crc_file(p) != info["checksum"]:
+                    raise SearchEngineError(f"checksum mismatch for segment file [{name}]")
+        with open(meta_path) as fh:
+            meta = json.load(fh)
+        data = np.load(npz_path)
+        # rebuild term dict with CSR-consistent ordering (sorted fields, sorted terms —
+        # the exact order freeze() assigned term ids in)
+        term_dict: dict[str, dict[str, int]] = {}
+        tid = 0
+        for f in sorted(meta["term_dict"]):
+            td = {}
+            for t in meta["term_dict"][f]:  # already sorted at freeze
+                td[t] = tid
+                tid += 1
+            term_dict[f] = td
+        norms = {k[len("norm::"):]: data[k] for k in data.files if k.startswith("norm::")}
+        dv_num = {}
+        for k in data.files:
+            if k.startswith("dvn_off::"):
+                f = k[len("dvn_off::"):]
+                dv_num[f] = (data[k], data[f"dvn_val::{f}"])
+        dv_str = {}
+        for k in data.files:
+            if k.startswith("dvs_off::"):
+                f = k[len("dvs_off::"):]
+                dv_str[f] = (meta["dv_str_terms"][f], data[k], data[f"dvs_ord::{f}"])
+        return FrozenSegment(
+            gen=meta["gen"],
+            doc_count=meta["doc_count"],
+            term_dict=term_dict,
+            post_offsets=data["post_offsets"],
+            post_docs=data["post_docs"],
+            post_freqs=data["post_freqs"],
+            pos_offsets=data["pos_offsets"],
+            positions=data["positions"],
+            norms=norms,
+            field_stats={
+                f: FieldStats(*v) for f, v in meta["field_stats"].items()
+            },
+            dv_num=dv_num,
+            dv_str=dv_str,
+            stored=meta["stored"],
+            ids=meta["ids"],
+            types=meta["types"],
+            routings=meta["routings"],
+            versions=data["versions"],
+            live=data["live"].copy(),
+            parent_mask=data["parent_mask"],
+            nested_paths=meta["nested_paths"],
+        )
+
+    # --- commit points ------------------------------------------------------
+    def write_commit(self, commit_id: int, segment_files: dict, translog_gen: int,
+                     versions: dict[str, int] | None = None, extra: dict | None = None):
+        """Commit point ties the segment set to a translog generation
+        (ref: InternalEngine commit user-data carries translog id, :266-278)."""
+        commit = {
+            "id": commit_id,
+            "segments": segment_files,  # gen -> {file: {length, checksum}}
+            "translog_gen": translog_gen,
+            "versions": versions or {},
+            "extra": extra or {},
+        }
+        tmp = os.path.join(self.dir, f"commit_{commit_id}.json.tmp")
+        with open(tmp, "w") as fh:
+            json.dump(commit, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, os.path.join(self.dir, f"commit_{commit_id}.json"))
+        # prune older commit points
+        for name in os.listdir(self.dir):
+            if name.startswith("commit_") and name.endswith(".json"):
+                cid = int(name[len("commit_"):-len(".json")])
+                if cid < commit_id:
+                    os.unlink(os.path.join(self.dir, name))
+
+    def read_last_commit(self) -> dict | None:
+        commits = [
+            int(n[len("commit_"):-len(".json")])
+            for n in os.listdir(self.dir)
+            if n.startswith("commit_") and n.endswith(".json")
+        ]
+        if not commits:
+            return None
+        with open(os.path.join(self.dir, f"commit_{max(commits)}.json")) as fh:
+            return json.load(fh)
+
+    def list_files(self) -> dict[str, dict]:
+        """(name → {length, checksum}) for recovery diffing."""
+        out = {}
+        for name in sorted(os.listdir(self.dir)):
+            p = os.path.join(self.dir, name)
+            if os.path.isfile(p) and not name.endswith(".tmp"):
+                out[name] = {"length": os.path.getsize(p), "checksum": _crc_file(p)}
+        return out
+
+    def delete_segment(self, gen: int):
+        for suffix in (".npz", ".meta.json"):
+            p = os.path.join(self.dir, f"seg_{gen}{suffix}")
+            if os.path.exists(p):
+                os.unlink(p)

@@ -1,0 +1,226 @@
+"""Storage core tests: segments, translog, store, engine lifecycle."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import (
+    DocumentAlreadyExistsError,
+    VersionConflictError,
+)
+from elasticsearch_tpu.index import Engine, EXTERNAL, SegmentBuilder, Translog, TranslogOp
+from elasticsearch_tpu.index.segment import merge_segments
+from elasticsearch_tpu.index.store import Store
+from elasticsearch_tpu.index.translog import INDEX, DELETE
+from elasticsearch_tpu.mapper import MapperService
+
+
+def make_engine(tmp_path, name="e"):
+    svc = MapperService()
+    return Engine(str(tmp_path / name), svc)
+
+
+class TestSegment:
+    def test_build_and_postings(self):
+        svc = MapperService()
+        m = svc.mapper_for("doc")
+        b = SegmentBuilder(gen=1)
+        b.add(m.parse({"title": "the quick brown fox"}, "1"))
+        b.add(m.parse({"title": "quick quick dog"}, "2"))
+        seg = b.freeze()
+        docs, freqs = seg.postings("title", "quick")
+        assert docs.tolist() == [0, 1]
+        assert freqs.tolist() == [1.0, 2.0]
+        assert seg.doc_freq("title", "quick") == 2
+        assert seg.doc_freq("title", "missing") == 0
+        st = seg.field_stats["title"]
+        assert st.doc_count == 2 and st.sum_ttf == 7
+        # norms encode field lengths via byte315
+        from elasticsearch_tpu.common.smallfloat import decode_norm_doclen
+
+        dls = decode_norm_doclen(seg.norms["title"])
+        assert 3 <= dls[0] <= 5 and 2 <= dls[1] <= 4
+
+    def test_positions_for_phrase(self):
+        svc = MapperService()
+        m = svc.mapper_for("doc")
+        b = SegmentBuilder(gen=1)
+        b.add(m.parse({"t": "alpha beta gamma beta"}, "1"))
+        seg = b.freeze()
+        pos = seg.term_positions("t", "beta")
+        assert [p.tolist() for p in pos] == [[1, 3]]
+
+    def test_doc_values(self):
+        svc = MapperService()
+        m = svc.mapper_for("doc")
+        b = SegmentBuilder(gen=1)
+        b.add(m.parse({"price": 10, "tags": "a"}, "1"))
+        b.add(m.parse({"price": [3, 7]}, "2"))
+        seg = b.freeze()
+        assert seg.num_values("price", 0).tolist() == [10.0]
+        assert seg.num_values("price", 1).tolist() == [3.0, 7.0]
+        assert seg.str_values("tags", 0) == ["a"]
+
+    def test_merge_preserves_postings_and_drops_deleted(self):
+        svc = MapperService()
+        m = svc.mapper_for("doc")
+        b1 = SegmentBuilder(gen=1)
+        b1.add(m.parse({"t": "one two"}, "1"))
+        b1.add(m.parse({"t": "two three"}, "2"))
+        s1 = b1.freeze()
+        b2 = SegmentBuilder(gen=2)
+        b2.add(m.parse({"t": "three four"}, "3"))
+        s2 = b2.freeze()
+        s1.delete_doc(0)
+        merged = merge_segments([s1, s2], gen=3)
+        assert merged.doc_count == 2
+        assert merged.doc_freq("t", "three") == 2
+        assert merged.doc_freq("t", "one") == 0
+        assert set(merged.ids) == {"2", "3"}
+
+
+class TestTranslog:
+    def test_roundtrip_and_replay(self, tmp_path):
+        tl = Translog(str(tmp_path / "tl"))
+        tl.add(TranslogOp(INDEX, "doc", "1", {"a": 1}, version=1))
+        tl.add(TranslogOp(DELETE, "doc", "2", version=3))
+        ops = tl.read_ops()
+        assert len(ops) == 2
+        assert ops[0].source == {"a": 1}
+        assert ops[1].op == DELETE and ops[1].version == 3
+
+    def test_torn_tail_is_truncated(self, tmp_path):
+        tl = Translog(str(tmp_path / "tl"))
+        tl.add(TranslogOp(INDEX, "doc", "1", {"a": 1}))
+        tl.add(TranslogOp(INDEX, "doc", "2", {"b": 2}))
+        tl.sync()
+        path = tl._file(tl.gen)
+        tl.close()
+        with open(path, "r+b") as f:
+            f.truncate(f.seek(0, 2) - 3)  # chop 3 bytes off the last frame
+        tl2 = Translog(str(tmp_path / "tl"))
+        ops = tl2.read_ops()
+        assert len(ops) == 1 and ops[0].id == "1"
+
+    def test_roll_and_prune(self, tmp_path):
+        tl = Translog(str(tmp_path / "tl"))
+        tl.add(TranslogOp(INDEX, "doc", "1", {}))
+        new_gen = tl.roll()
+        tl.add(TranslogOp(INDEX, "doc", "2", {}))
+        assert len(tl.read_ops(1)) == 2
+        tl.prune_before(new_gen)
+        assert len(tl.read_ops(1)) == 1
+
+
+class TestEngine:
+    def test_index_get_version_increments(self, tmp_path):
+        e = make_engine(tmp_path)
+        v1, created = e.index("doc", "1", {"title": "hello"})
+        assert (v1, created) == (1, True)
+        v2, created = e.index("doc", "1", {"title": "hello again"})
+        assert (v2, created) == (2, False)
+        g = e.get("doc", "1")  # realtime, pre-refresh
+        assert g.found and g.version == 2 and g.source["title"] == "hello again"
+
+    def test_version_conflict(self, tmp_path):
+        e = make_engine(tmp_path)
+        e.index("doc", "1", {"a": 1})
+        with pytest.raises(VersionConflictError):
+            e.index("doc", "1", {"a": 2}, version=5)
+        e.index("doc", "1", {"a": 2}, version=1)  # correct CAS
+
+    def test_external_versioning(self, tmp_path):
+        e = make_engine(tmp_path)
+        e.index("doc", "1", {"a": 1}, version=10, version_type=EXTERNAL)
+        with pytest.raises(VersionConflictError):
+            e.index("doc", "1", {"a": 2}, version=10, version_type=EXTERNAL)
+        v, _ = e.index("doc", "1", {"a": 2}, version=42, version_type=EXTERNAL)
+        assert v == 42
+
+    def test_create_conflict(self, tmp_path):
+        e = make_engine(tmp_path)
+        e.index("doc", "1", {"a": 1}, op_type="create")
+        with pytest.raises(DocumentAlreadyExistsError):
+            e.index("doc", "1", {"a": 2}, op_type="create")
+        e.delete("doc", "1")
+        e.index("doc", "1", {"a": 3}, op_type="create")  # ok after delete
+
+    def test_delete_and_refresh_tombstones(self, tmp_path):
+        e = make_engine(tmp_path)
+        e.index("doc", "1", {"a": 1})
+        e.index("doc", "2", {"a": 2})
+        e.refresh()
+        assert e.doc_stats()["count"] == 2
+        v, found = e.delete("doc", "1")
+        assert found
+        assert not e.get("doc", "1").found  # realtime delete visible pre-refresh
+        e.refresh()
+        assert e.doc_stats() == {"count": 1, "deleted": 1}
+
+    def test_update_tombstones_old_copy(self, tmp_path):
+        e = make_engine(tmp_path)
+        e.index("doc", "1", {"a": "first"})
+        e.refresh()
+        e.index("doc", "1", {"a": "second"})
+        e.refresh()
+        assert e.doc_stats() == {"count": 1, "deleted": 1}
+        searcher = e.acquire_searcher()
+        assert searcher.doc_freq("a", "first") == 1  # still in postings...
+        seg0 = searcher.segments[0]
+        assert not seg0.live[0]  # ...but tombstoned
+
+    def test_flush_commit_recover(self, tmp_path):
+        e = make_engine(tmp_path)
+        e.index("doc", "1", {"title": "persisted doc"})
+        e.index("doc", "2", {"title": "another"})
+        e.flush()
+        e.index("doc", "3", {"title": "only in translog"})
+        e.translog.sync()
+        e.close()
+        # restart from disk: segments from commit + translog replay
+        e2 = make_engine(tmp_path)
+        replayed = e2.recover_from_store()
+        assert replayed == 1
+        assert e2.get("doc", "1").found
+        assert e2.get("doc", "3").found
+        assert e2.doc_stats()["count"] == 3
+
+    def test_recover_applies_tombstones(self, tmp_path):
+        e = make_engine(tmp_path)
+        e.index("doc", "1", {"a": 1})
+        e.index("doc", "2", {"a": 2})
+        e.flush()
+        e.delete("doc", "1")
+        e.flush()
+        e.close()
+        e2 = make_engine(tmp_path)
+        e2.recover_from_store()
+        assert not e2.get("doc", "1").found
+        assert e2.doc_stats()["count"] == 1
+
+    def test_optimize_merges_segments(self, tmp_path):
+        e = make_engine(tmp_path)
+        for i in range(5):
+            e.index("doc", str(i), {"t": f"word{i} common"})
+            e.refresh()
+        assert e.segment_count() == 5
+        e.delete("doc", "0")
+        e.optimize()
+        assert e.segment_count() == 1
+        assert e.doc_stats() == {"count": 4, "deleted": 0}
+        assert e.acquire_searcher().doc_freq("t", "common") == 4
+
+    def test_nested_docs_block_layout(self, tmp_path):
+        svc = MapperService()
+        svc.put_mapping("doc", {"properties": {
+            "comments": {"type": "nested", "properties": {"text": {"type": "string"}}}}})
+        e = Engine(str(tmp_path / "n"), svc)
+        e.index("doc", "1", {"title": "post", "comments": [{"text": "aa"}, {"text": "bb"}]})
+        e.refresh()
+        seg = e.acquire_searcher().segments[0]
+        assert seg.doc_count == 3  # 2 children + 1 parent
+        assert seg.parent_mask.tolist() == [False, False, True]
+        assert e.doc_stats()["count"] == 1  # only parents counted
+        # delete removes the whole block
+        e.delete("doc", "1")
+        e.refresh()
+        assert not seg.live.any()
