@@ -235,8 +235,9 @@ class Engine:
         """Bulk tombstone for delete-by-query (the search layer resolves uids)."""
         with self._lock:
             self._check_open()
-            if not _from_translog and query is not None:
-                self.translog.add(TranslogOp(DELETE_BY_QUERY, query=query))
+            if not _from_translog:
+                self.translog.add(TranslogOp(DELETE_BY_QUERY, query=query,
+                                             source={"uids": list(uids)}))
             for uid in uids:
                 current, deleted = self._current_version(uid)
                 if current is None or deleted:
@@ -286,15 +287,21 @@ class Engine:
                 self._segments.append(new_seg)
                 self._next_gen += 1
                 self._buffer = SegmentBuilder(self._next_gen)
-            # resolve buffer locations to the new segment, then tombstone
+            # resolve buffer locations to the new segment, then tombstone.
+            # Older segments are tombstoned copy-on-write so searchers acquired before
+            # this refresh keep their immutable point-in-time live bitmap.
+            by_gen: dict[int, list[int]] = {}
             for loc in self._pending_deletes:
                 if loc[0] == "buffer":
                     assert new_seg is not None
                     new_seg.delete_doc(loc[1])
                 else:
-                    seg = self._seg_by_gen(loc[0])
-                    if seg is not None:
-                        seg.delete_doc(loc[1])
+                    by_gen.setdefault(loc[0], []).append(loc[1])
+            for gen, locals_ in by_gen.items():
+                for i, seg in enumerate(self._segments):
+                    if seg.gen == gen:
+                        self._segments[i] = seg.with_deletes(locals_)
+                        break
             self._pending_deletes.clear()
             # update uid index + drop realtime sources (now searchable)
             if new_seg is not None:
@@ -370,12 +377,26 @@ class Engine:
             self._next_gen += 1
             self._buffer = SegmentBuilder(self._next_gen)
             old_gens = [seg.gen for seg in self._segments]
+            any_persisted = any(g in self._persisted_gens for g in old_gens)
             self._segments = [merged] if merged.doc_count else []
             self._uid_index = {}
             for seg in self._segments:
                 for local in range(seg.doc_count):
                     if seg.parent_mask[local] and seg.live[local]:
                         self._uid_index[f"{seg.types[local]}#{seg.ids[local]}"] = (seg.gen, local)
+            if any_persisted:
+                # the last commit references the old segment files: persist the merged
+                # segment and write a NEW commit point BEFORE deleting them, or a crash
+                # here would make the commit unreadable with the translog already pruned
+                for seg in self._segments:
+                    self._segment_files[str(seg.gen)] = self.store.write_segment(seg)
+                    self._persisted_gens.add(seg.gen)
+                self._commit_id += 1
+                self.store.write_commit(
+                    self._commit_id,
+                    {str(seg.gen): self._segment_files[str(seg.gen)] for seg in self._segments},
+                    translog_gen=self.translog.gen,
+                )
             for g in old_gens:
                 self._persisted_gens.discard(g)
                 self._segment_files.pop(str(g), None)
@@ -424,16 +445,21 @@ class Engine:
 
     def _replay_op(self, op: TranslogOp):
         if op.op in (CREATE, INDEX):
-            self.index(op.type, op.id, op.source or {}, routing=op.routing,
-                       version=op.version, version_type=EXTERNAL, _from_translog=True)
+            try:
+                self.index(op.type, op.id, op.source or {}, routing=op.routing,
+                           version=op.version, version_type=EXTERNAL, _from_translog=True)
+            except VersionConflictError:
+                pass  # replay after delete can revisit a version; newest state wins
         elif op.op == DELETE:
             try:
                 self.delete(op.type, op.id, _from_translog=True)
             except VersionConflictError:
                 pass
         elif op.op == DELETE_BY_QUERY:
-            # replayed at the shard layer (needs query execution); stored for parity
-            pass
+            # the op carries the RESOLVED uids (plus the original query for parity/
+            # debugging), so replay needs no query execution at this layer
+            uids = (op.source or {}).get("uids", [])
+            self.delete_by_uids(uids, _from_translog=True)
 
     def apply_replicated_op(self, op: TranslogOp):
         """Apply an op streamed from a primary (replica write / recovery phase 2-3).

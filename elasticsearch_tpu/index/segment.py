@@ -264,7 +264,8 @@ class FrozenSegment:
         return int((self.live & self.parent_mask).sum())
 
     def delete_doc(self, local: int):
-        """Tombstone a doc and its nested children block."""
+        """Tombstone a doc and its nested children block (in place — use with_deletes
+        for copy-on-write semantics that preserve already-acquired searchers)."""
         self.live[local] = False
         self._device_cache.pop("live", None)
         i = local - 1
@@ -272,6 +273,24 @@ class FrozenSegment:
                 and self.ids[i] == self.ids[local]:
             self.live[i] = False
             i -= 1
+
+    def with_deletes(self, locals_to_delete) -> "FrozenSegment":
+        """Copy-on-write tombstoning: returns a NEW segment object sharing all large
+        arrays but with a fresh live bitmap (and a fresh packed-live device view), so a
+        previously acquired Searcher keeps an immutable point-in-time liveDocs — the
+        invariant Lucene readers guarantee (Engine.acquireSearcher semantics)."""
+        import dataclasses
+
+        new = dataclasses.replace(self, live=self.live.copy(),
+                                  _device_cache=dict(self._device_cache))
+        for local in locals_to_delete:
+            new.delete_doc(local)
+        # share the packed postings but give the new view its own live mask
+        packed = new._device_cache.get("packed")
+        if packed is not None:
+            new._device_cache["packed"] = dataclasses.replace(packed)
+            new._device_cache.pop("live", None)
+        return new
 
     def num_values(self, field: str, local: int) -> np.ndarray:
         col = self.dv_num.get(field)

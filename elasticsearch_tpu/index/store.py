@@ -64,8 +64,13 @@ class Store:
             arrays[f"dvs_ord::{f}"] = ords
         buf = io.BytesIO()
         np.savez(buf, **arrays)
+        # fsync segment data BEFORE any commit references it — a fsynced commit point
+        # over page-cache-only segment bytes would survive power loss while the data
+        # doesn't (and flush() prunes the translog that could rebuild it)
         with open(npz_path, "wb") as fh:
             fh.write(buf.getvalue())
+            fh.flush()
+            os.fsync(fh.fileno())
         meta = {
             "gen": seg.gen,
             "doc_count": seg.doc_count,
@@ -82,6 +87,8 @@ class Store:
         }
         with open(meta_path, "w") as fh:
             json.dump(meta, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
         return {
             os.path.basename(npz_path): {
                 "length": os.path.getsize(npz_path), "checksum": _crc_file(npz_path)},

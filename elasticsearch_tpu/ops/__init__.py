@@ -1,0 +1,2 @@
+from .device_index import PackedSegment, pack_segment  # noqa: F401
+from .scoring import TermBatch, score_term_batch, ScoreResult  # noqa: F401

@@ -1,0 +1,137 @@
+"""Device-resident packed postings.
+
+This is the TPU replacement for Lucene's on-heap postings traversal (SURVEY.md §2.8:
+"device-resident packed postings blocks, vmapped BM25 scoring, lax.top_k"). A frozen
+segment's CSR postings are re-blocked into fixed-shape device tensors:
+
+    blk_docs  : int32 [NB, B]   — local doc ids, padded with `doc_pad` (out of range)
+    blk_freqs : float32 [NB, B] — term frequencies, padded with 0
+
+Each term owns a contiguous run of blocks (`term_blk_start[t] .. term_blk_start[t+1]`),
+so a query term's postings are a static-shape slice of block indices — the host builds
+flat (query, block, weight) triples and the scoring kernel is pure gather + FMA +
+scatter-add, no data-dependent shapes (XLA-friendly by construction).
+
+Shapes are padded to power-of-two buckets (NB rows, D docs) so recompilation stops once
+the shape buckets stabilize — segment churn from NRT refresh reuses cached executables.
+
+Norm bytes stay uint8 on device; similarity-specific 256-entry decode tables are gathered
+at score time, preserving Lucene's exact 1-byte quantization.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+
+import numpy as np
+
+from ..index.segment import FrozenSegment
+
+BLOCK = 128  # lane width
+
+
+def _pow2_bucket(n: int, minimum: int = 128) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class PackedSegment:
+    """Device tensors + host lookup tables for one frozen segment."""
+
+    gen: int
+    doc_count: int  # real docs
+    doc_pad: int  # padded D (bucketed)
+    blk_docs: object  # jnp int32 [NBpad, B]
+    blk_freqs: object  # jnp float32 [NBpad, B]
+    term_blk_start: np.ndarray  # host int64 [T+1]
+    live_parent: object  # jnp bool [Dpad] — live & parent (searchable docs)
+    norm_bytes: dict  # field -> jnp uint8 [Dpad]
+    dv_single: dict = dc_field(default_factory=dict)  # field -> jnp float32/float64 [Dpad] single-valued fast path (NaN missing)
+    live_version: int = 0
+
+    def blocks_for_term(self, tid: int) -> tuple[int, int]:
+        return int(self.term_blk_start[tid]), int(self.term_blk_start[tid + 1])
+
+
+def pack_segment(seg: FrozenSegment, fields: list[str] | None = None,
+                 device_put=None) -> PackedSegment:
+    """Pack a frozen segment's postings + norms + single-valued numeric columns for
+    device execution. `fields` limits norm upload (None = all text fields)."""
+    import jax.numpy as jnp
+
+    put = device_put or (lambda x: jnp.asarray(x))
+
+    T = len(seg.post_offsets) - 1
+    counts = np.diff(seg.post_offsets)
+    nblks = (counts + BLOCK - 1) // BLOCK
+    blk_start = np.zeros(T + 1, dtype=np.int64)
+    np.cumsum(nblks, out=blk_start[1:])
+    NB = int(blk_start[-1])
+    # +1 guarantees at least one all-sentinel row past the real blocks — the scoring
+    # batch points its padding triples at row NBpad-1, which must never hold postings
+    NBpad = _pow2_bucket(NB + 1, 64)
+    Dpad = _pow2_bucket(max(seg.doc_count, 1), 128)
+
+    flat_docs = np.full(NBpad * BLOCK, Dpad, dtype=np.int32)  # pad → out-of-range slot
+    flat_freqs = np.zeros(NBpad * BLOCK, dtype=np.float32)
+    if len(seg.post_docs):
+        # slot of entry j of term t = (blk_start[t]*B) + (j - post_offsets[t])
+        within = np.arange(len(seg.post_docs), dtype=np.int64) - np.repeat(
+            seg.post_offsets[:-1], counts
+        )
+        slots = np.repeat(blk_start[:-1] * BLOCK, counts) + within
+        flat_docs[slots] = seg.post_docs
+        flat_freqs[slots] = seg.post_freqs
+
+    live_parent = np.zeros(Dpad, dtype=bool)
+    live_parent[: seg.doc_count] = seg.live & seg.parent_mask
+
+    norm_bytes = {}
+    for f, arr in seg.norms.items():
+        if fields is not None and f not in fields:
+            continue
+        padded = np.zeros(Dpad, dtype=np.uint8)
+        padded[: seg.doc_count] = arr
+        norm_bytes[f] = put(padded)
+
+    dv_single = {}
+    for f, (off, vals) in seg.dv_num.items():
+        counts_dv = np.diff(off)
+        if counts_dv.max(initial=0) <= 1:
+            col = np.full(Dpad, np.nan, dtype=np.float64)
+            has = counts_dv == 1
+            col[: seg.doc_count][has] = vals
+            dv_single[f] = put(col)
+
+    return PackedSegment(
+        gen=seg.gen,
+        doc_count=seg.doc_count,
+        doc_pad=Dpad,
+        blk_docs=put(flat_docs.reshape(NBpad, BLOCK)),
+        blk_freqs=put(flat_freqs.reshape(NBpad, BLOCK)),
+        term_blk_start=blk_start,
+        live_parent=put(live_parent),
+        norm_bytes=norm_bytes,
+        dv_single=dv_single,
+    )
+
+
+def packed_for(seg: FrozenSegment) -> PackedSegment:
+    """Per-segment cached packing; refreshes the live mask when tombstones changed."""
+    cache = seg._device_cache
+    packed: PackedSegment | None = cache.get("packed")
+    if packed is None:
+        packed = pack_segment(seg)
+        cache["packed"] = packed
+        cache["live"] = True
+    elif cache.get("live") is None:
+        import jax.numpy as jnp
+
+        live_parent = np.zeros(packed.doc_pad, dtype=bool)
+        live_parent[: seg.doc_count] = seg.live & seg.parent_mask
+        packed.live_parent = jnp.asarray(live_parent)
+        cache["live"] = True
+    return packed

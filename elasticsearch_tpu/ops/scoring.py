@@ -1,0 +1,203 @@
+"""Batched query scoring on device — the replacement for Lucene's QueryPhase hot loop.
+
+The reference's inner loop (search/query/QueryPhase.java:95-137: per-segment postings
+advance + Similarity.score + priority-queue insert) becomes ONE fused device program per
+(segment, query-batch):
+
+  1. gather postings blocks for every (query, term) pair            [M, B]
+  2. compute per-posting contributions (BM25 tfNorm / TF-IDF)       [M, B] FMA
+  3. scatter-add into dense per-query score accumulators            [Q, Dpad+1]
+  4. scatter-add packed match counters (should/must/must_not bits)  [Q, Dpad+1]
+  5. apply bool-query semantics (must coverage, minimum_should_match,
+     must_not exclusion), coord factor, live mask
+  6. lax.top_k per query                                            [Q, k]
+
+All shapes are static: M (triple count) is bucketed to powers of two, Dpad/NB come from
+the packed segment's buckets, so executables cache across refreshes. No data-dependent
+control flow — bool-query logic is mask arithmetic (XLA semantics, SURVEY header).
+
+Match-count packing: one int32 scatter carries three counters —
+  bit 0..9   : matched SHOULD clauses
+  bit 10..19 : matched MUST clauses
+  bit 20..29 : matched MUST_NOT clauses
+(queries are capped at 1023 clauses per group, far beyond the reference's default
+indices.query.bool.max_clause_count = 1024.)
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field as dc_field
+
+import numpy as np
+
+from .device_index import BLOCK, PackedSegment, _pow2_bucket
+
+GROUP_SHOULD, GROUP_MUST, GROUP_MUST_NOT = 0, 1, 2
+_MUST_SHIFT, _NOT_SHIFT = 10, 20
+
+MODE_BM25 = 0  # contribution = w * freq*(k1+1)/(freq + cache[normbyte])
+MODE_TFIDF = 1  # contribution = w * sqrt(freq) * cache[normbyte]
+MODE_CONST = 2  # contribution = w per matching term (constant-score / filters)
+
+
+@dataclass
+class TermBatch:
+    """Flattened (query, term, block) triples + per-query bool-semantics arrays.
+    Built host-side by the query planner (search/execute.py)."""
+
+    n_queries: int
+    # per triple (padded to bucket):
+    qidx: np.ndarray  # int32 [M]
+    blk: np.ndarray  # int32 [M] — block row in the packed segment (pad: NBpad-? safe row)
+    weight: np.ndarray  # float32 [M]
+    fidx: np.ndarray  # int32 [M] — index into the stacked norm/cache tables
+    group: np.ndarray  # int32 [M] — GROUP_*
+    tfmode: np.ndarray  # int32 [M] — MODE_* per clause (const-score clauses mix in)
+    # per query:
+    n_must: np.ndarray  # int32 [Q]
+    msm: np.ndarray  # int32 [Q] — minimum should matches
+    coord: np.ndarray  # float32 [Q, C+1] — coord factor by matched count (incl queryNorm)
+    # stacked per-field tables:
+    norm_fields: list = dc_field(default_factory=list)  # field names, order = fidx
+    caches: np.ndarray | None = None  # float32 [F, 256]
+
+
+@dataclass
+class ScoreResult:
+    scores: np.ndarray  # [Q, k] float32
+    docs: np.ndarray  # [Q, k] int32 (local doc ids; doc_count → pad/no hit)
+    total_hits: np.ndarray  # [Q] int64
+    max_score: np.ndarray  # [Q] float32
+
+
+def _score_batch_impl(blk_docs, blk_freqs, live_parent, norms_stack, caches,
+                      qidx, blk, weight, fidx, group, tfmode,
+                      n_must, msm, coord, *, n_queries: int, k: int, doc_pad: int):
+    import jax
+    import jax.numpy as jnp
+
+    Q = n_queries
+    docs = blk_docs[blk]  # [M, B] int32; padded rows → doc_pad sentinel
+    freqs = blk_freqs[blk]  # [M, B]
+    valid = docs < doc_pad
+    docs_safe = jnp.where(valid, docs, 0)
+
+    nb = norms_stack[fidx[:, None], docs_safe]  # [M, B] uint8
+    cache_vals = caches[fidx[:, None], nb.astype(jnp.int32)]  # [M, B]
+
+    # float op ORDER matters for bit-parity with the host scorer and Lucene:
+    # BM25  : (weight·freq) / (freq + cache)   [BM25Similarity scorer order]
+    # TFIDF : (sqrt(freq)·weight) · cache      [TFIDFSimilarity ExactSimScorer order]
+    mode = tfmode[:, None]
+    w = weight[:, None]
+    bm25 = (w * freqs) / (freqs + cache_vals)
+    tfidf = jnp.sqrt(freqs) * w * cache_vals
+    contrib = jnp.where(mode == MODE_BM25, bm25, jnp.where(mode == MODE_TFIDF, tfidf, w))
+    scoring = (group[:, None] != GROUP_MUST_NOT) & valid
+    contrib = jnp.where(scoring, contrib, 0.0)
+
+    counters = (
+        jnp.where(group == GROUP_SHOULD, 1, 0)
+        + jnp.where(group == GROUP_MUST, 1 << _MUST_SHIFT, 0)
+        + jnp.where(group == GROUP_MUST_NOT, 1 << _NOT_SHIFT, 0)
+    ).astype(jnp.int32)
+    counter_vals = jnp.where(valid, counters[:, None], 0)
+
+    qd = (qidx[:, None] * (doc_pad + 1))
+    flat_idx = jnp.where(valid, qd + docs_safe, Q * (doc_pad + 1))  # OOB → dropped
+
+    scores = jnp.zeros(Q * (doc_pad + 1), jnp.float32).at[flat_idx.reshape(-1)].add(
+        contrib.reshape(-1), mode="drop"
+    ).reshape(Q, doc_pad + 1)[:, :doc_pad]
+    counts = jnp.zeros(Q * (doc_pad + 1), jnp.int32).at[flat_idx.reshape(-1)].add(
+        counter_vals.reshape(-1), mode="drop"
+    ).reshape(Q, doc_pad + 1)[:, :doc_pad]
+
+    m_should = counts & 0x3FF
+    m_must = (counts >> _MUST_SHIFT) & 0x3FF
+    m_not = counts >> _NOT_SHIFT
+
+    match = (m_must == n_must[:, None]) & (m_should >= msm[:, None]) & (m_not == 0)
+    match = match & ((m_should + m_must) > 0) & live_parent[None, :doc_pad]
+
+    overlap = jnp.minimum(m_should + m_must, coord.shape[1] - 1)
+    coord_fac = jnp.take_along_axis(coord, overlap, axis=1)
+    scores = scores * coord_fac
+
+    neg_inf = jnp.float32(-jnp.inf)
+    masked = jnp.where(match, scores, neg_inf)
+    top_scores, top_docs = jax.lax.top_k(masked, k)
+    total = match.sum(axis=1, dtype=jnp.int64)
+    max_score = jnp.where(total > 0, jnp.max(jnp.where(match, scores, neg_inf), axis=1), jnp.nan)
+    top_docs = jnp.where(jnp.isfinite(top_scores), top_docs, doc_pad).astype(jnp.int32)
+    return top_scores, top_docs, total, max_score
+
+
+_compiled_cache: dict = {}
+
+
+def _get_compiled(n_queries: int, k: int, doc_pad: int):
+    import jax
+
+    key = (n_queries, k, doc_pad)
+    fn = _compiled_cache.get(key)
+    if fn is None:
+        def wrapper(*args):
+            return _score_batch_impl(*args, n_queries=n_queries, k=k, doc_pad=doc_pad)
+
+        fn = jax.jit(wrapper)
+        _compiled_cache[key] = fn
+    return fn
+
+
+def score_term_batch(packed: PackedSegment, batch: TermBatch, k: int) -> ScoreResult:
+    """Execute a term batch against one packed segment; returns per-query top-k with
+    local doc ids (doc_count/doc_pad sentinel = no hit)."""
+    import jax.numpy as jnp
+
+    Q = batch.n_queries
+    norms_stack = (
+        jnp.stack([packed.norm_bytes[f] for f in batch.norm_fields])
+        if batch.norm_fields
+        else jnp.zeros((1, packed.doc_pad), jnp.uint8)
+    )
+    caches = jnp.asarray(
+        batch.caches if batch.caches is not None else np.ones((1, 256), np.float32)
+    )
+    fn = _get_compiled(Q, min(k, packed.doc_pad), packed.doc_pad)
+    top_scores, top_docs, total, max_score = fn(
+        packed.blk_docs, packed.blk_freqs, packed.live_parent, norms_stack, caches,
+        jnp.asarray(batch.qidx), jnp.asarray(batch.blk), jnp.asarray(batch.weight),
+        jnp.asarray(batch.fidx), jnp.asarray(batch.group), jnp.asarray(batch.tfmode),
+        jnp.asarray(batch.n_must), jnp.asarray(batch.msm), jnp.asarray(batch.coord),
+    )
+    return ScoreResult(
+        scores=np.asarray(top_scores),
+        docs=np.asarray(top_docs),
+        total_hits=np.asarray(total),
+        max_score=np.asarray(max_score),
+    )
+
+
+def build_term_batch(entries: list, n_queries: int, n_must: np.ndarray, msm: np.ndarray,
+                     coord: np.ndarray, norm_fields: list[str], caches: np.ndarray,
+                     nb_pad_row: int) -> TermBatch:
+    """Assemble + bucket-pad the flat triple arrays.
+
+    `entries` = list of (qidx, blk_row, weight, fidx, group, tfmode); padding rows point
+    at `nb_pad_row` (a row of doc_pad sentinels — contributes nothing)."""
+    M = _pow2_bucket(max(len(entries), 1), 16)
+    qidx = np.zeros(M, np.int32)
+    blk = np.full(M, nb_pad_row, np.int32)
+    weight = np.zeros(M, np.float32)
+    fidx = np.zeros(M, np.int32)
+    group = np.zeros(M, np.int32)
+    tfmode = np.zeros(M, np.int32)
+    for i, (q, b, w, f, g, m) in enumerate(entries):
+        qidx[i], blk[i], weight[i], fidx[i], group[i], tfmode[i] = q, b, w, f, g, m
+    return TermBatch(
+        n_queries=n_queries, qidx=qidx, blk=blk, weight=weight, fidx=fidx, group=group,
+        tfmode=tfmode, n_must=n_must.astype(np.int32), msm=msm.astype(np.int32),
+        coord=coord.astype(np.float32), norm_fields=norm_fields, caches=caches,
+    )

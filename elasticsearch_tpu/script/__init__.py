@@ -1,0 +1,102 @@
+"""Scripting: a sandboxed expression language.
+
+Analogue of script/ScriptService.java (SURVEY.md §2.9 sidebars — mvel default in the
+reference). Instead of embedding a JVM expression language, scripts are a restricted
+Python-expression subset compiled through the `ast` module with a strict whitelist:
+names, numeric literals, arithmetic, comparisons, boolean ops, ternaries, math functions,
+`doc['field'].value` access, `_score`, and script params. No attribute access beyond the
+whitelist, no calls except whitelisted functions, no subscripts except on `doc`/params —
+so user scripts cannot escape (same spirit as the reference's sandboxed mvel).
+
+SURVEY.md §7 notes the design goal of lowering a compiled expression subset to XLA for
+device-side scoring; this module keeps the AST around (`CompiledScript.tree`) so a later
+round can lower simple arithmetic scripts to jnp column expressions.
+"""
+
+from __future__ import annotations
+
+import ast
+import math
+
+from ..common.errors import ScriptError
+
+_ALLOWED_FUNCS = {
+    "abs": abs, "min": min, "max": max, "round": round,
+    "sqrt": math.sqrt, "log": math.log, "log10": math.log10, "exp": math.exp,
+    "pow": pow, "floor": math.floor, "ceil": math.ceil,
+    "sin": math.sin, "cos": math.cos, "tan": math.tan,
+}
+
+_ALLOWED_NODES = (
+    ast.Expression, ast.BinOp, ast.UnaryOp, ast.BoolOp, ast.Compare, ast.IfExp,
+    ast.Name, ast.Load, ast.Constant, ast.Subscript, ast.Attribute, ast.Call,
+    ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod, ast.Pow,
+    ast.USub, ast.UAdd, ast.Not, ast.And, ast.Or,
+    ast.Eq, ast.NotEq, ast.Lt, ast.LtE, ast.Gt, ast.GtE, ast.In, ast.NotIn,
+)
+
+_ALLOWED_ATTRS = {"value", "values", "empty"}
+
+
+class CompiledScript:
+    def __init__(self, source: str, params: dict):
+        self.source = source
+        self.params = dict(params or {})
+        try:
+            self.tree = ast.parse(source, mode="eval")
+        except SyntaxError as e:
+            raise ScriptError(f"script compile error: {e}") from None
+        self._validate(self.tree)
+        self._code = compile(self.tree, "<script>", "eval")
+
+    def _validate(self, tree: ast.AST):
+        for node in ast.walk(tree):
+            if not isinstance(node, _ALLOWED_NODES):
+                raise ScriptError(
+                    f"disallowed construct [{type(node).__name__}] in script [{self.source}]"
+                )
+            if isinstance(node, ast.Attribute) and node.attr not in _ALLOWED_ATTRS:
+                raise ScriptError(f"disallowed attribute [{node.attr}]")
+            if isinstance(node, ast.Call):
+                if not isinstance(node.func, ast.Name) or node.func.id not in _ALLOWED_FUNCS:
+                    raise ScriptError("only whitelisted functions may be called")
+
+    def __call__(self, doc, _score: float = 0.0, **extra):
+        env = {"doc": doc, "_score": _score, **_ALLOWED_FUNCS, **self.params, **extra}
+        try:
+            return eval(self._code, {"__builtins__": {}}, env)  # noqa: S307 — sandboxed AST
+        except ScriptError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            raise ScriptError(f"script runtime error: {e}") from None
+
+
+_cache: dict[tuple, CompiledScript] = {}
+
+
+def compile_script(source: str, params: dict | None = None) -> CompiledScript:
+    key = (source, tuple(sorted((params or {}).items())))
+    try:
+        cs = _cache.get(key)
+    except TypeError:  # unhashable params
+        return CompiledScript(source, params or {})
+    if cs is None:
+        cs = CompiledScript(source, params or {})
+        _cache[key] = cs
+    return cs
+
+
+class ScriptService:
+    """Named/stored script registry + language dispatch (parity shell: the single
+    supported language is the sandboxed expression subset, like the reference's
+    default-language mvel registry)."""
+
+    def __init__(self, settings=None):
+        self._stored: dict[str, str] = {}
+
+    def put(self, name: str, source: str):
+        self._stored[name] = source
+
+    def compile(self, source_or_name: str, params: dict | None = None) -> CompiledScript:
+        source = self._stored.get(source_or_name, source_or_name)
+        return compile_script(source, params)

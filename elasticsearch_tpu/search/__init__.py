@@ -1,0 +1,9 @@
+from .queries import Query, parse_query, parse_filter  # noqa: F401
+from .execute import (  # noqa: F401
+    ShardContext,
+    TopDocs,
+    search_shard,
+    search_shard_batch,
+    count_shard,
+)
+from .similarity import SimilarityService, BM25Similarity, TFIDFSimilarity  # noqa: F401

@@ -1,0 +1,1188 @@
+"""Per-shard query planning + execution.
+
+The analogue of the reference's QueryPhase + Lucene Weight/Scorer machinery
+(search/query/QueryPhase.java:95-137, SURVEY.md §3.3 "north-star path"). Two paths:
+
+- **Device path** (the common case: match / term / terms / flat bool over terms —
+  exactly the queries in BASELINE.md configs): the query lowers to a flat clause list;
+  clauses from a whole QUERY BATCH are fused into one TermBatch per segment and executed
+  by ops/scoring.py in a single device program (gather → FMA → scatter → top_k).
+
+- **Host path** (everything else: phrase/positions, multi-term expansion, joins,
+  function_score internals, scripts): recursive numpy evaluation per segment producing
+  dense (scores float32[D], match bool[D]) with the SAME similarity math, so device and
+  host paths rank identically on queries both can run.
+
+Weight normalization mirrors Lucene: a pre-pass collects the sum of squared term weights
+(createWeight), queryNorm = 1/sqrt(ssw) if the index default similarity is TF-IDF
+(BM25Similarity.queryNorm ≡ 1), coord applied per matched-clause count.
+Term statistics (df, sumTotalTermFreq, maxDoc) are SHARD-level — summed over segments
+before weighting, like IndexSearcher's top-level stats; in multi-shard search the DFS
+phase swaps in cluster-level stats (parallel/dfs.py), the analogue of
+SearchPhaseController.aggregateDfs.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field as dc_field
+
+import numpy as np
+
+from ..common.errors import QueryParsingError
+from ..index.engine import Searcher
+from ..index.segment import FrozenSegment
+from .filters import Filter, MatchAllFilter, segment_mask
+from .queries import (
+    BoolQuery,
+    BoostingQuery,
+    CommonTermsQuery,
+    ConstantScoreQuery,
+    DisMaxQuery,
+    FilteredQuery,
+    FunctionScoreQuery,
+    FuzzyQuery,
+    HasChildQuery,
+    HasParentQuery,
+    IdsQuery,
+    IndicesQuery,
+    MatchAllQuery,
+    MatchQuery,
+    MoreLikeThisQuery,
+    MultiMatchQuery,
+    NestedQuery,
+    PhraseQuery,
+    PrefixQuery,
+    Query,
+    QueryStringQuery,
+    RangeQuery,
+    RegexpQuery,
+    SpanNearQuery,
+    SpanTermQuery,
+    TermQuery,
+    WildcardQuery,
+)
+from .similarity import BM25Similarity, SimilarityService, TFIDFSimilarity
+
+GROUP_SHOULD, GROUP_MUST, GROUP_MUST_NOT = 0, 1, 2
+MODE_BM25, MODE_TFIDF, MODE_CONST = 0, 1, 2
+
+
+class ShardContext:
+    """Shard-level stats + mapping access shared by planner and scorers."""
+
+    def __init__(self, searcher: Searcher, mapper_service, similarity_service=None,
+                 global_stats: dict | None = None):
+        self.searcher = searcher
+        self.mapper_service = mapper_service
+        self.similarity_service = similarity_service or SimilarityService(
+            mapper_service=mapper_service
+        )
+        # DFS-phase override: {"df": {(field, term): df}, "max_doc": N,
+        #                      "field_stats": {field: FieldStats}}
+        self.global_stats = global_stats or {}
+
+    @property
+    def max_doc(self) -> int:
+        return self.global_stats.get("max_doc", self.searcher.max_doc)
+
+    def doc_freq(self, field: str, term: str) -> int:
+        dfs = self.global_stats.get("df")
+        if dfs is not None and (field, term) in dfs:
+            return dfs[(field, term)]
+        return self.searcher.doc_freq(field, term)
+
+    def field_stats(self, field: str):
+        fs = self.global_stats.get("field_stats")
+        if fs is not None and field in fs:
+            return fs[field]
+        return self.searcher.field_stats(field)
+
+    def field_type(self, field: str):
+        return self.mapper_service.field_type(field)
+
+    def analyze(self, field: str, text: str) -> list[str]:
+        return self.mapper_service.search_analyzer_for(field).terms(text)
+
+    def analyze_tokens(self, field: str, text: str):
+        return self.mapper_service.search_analyzer_for(field).analyze(text)
+
+    def similarity_for(self, field: str):
+        return self.similarity_service.for_field(field)
+
+    @property
+    def default_similarity(self):
+        return self.similarity_service.default
+
+    def all_terms(self, field: str) -> list[str]:
+        terms: set[str] = set()
+        for seg in self.searcher.segments:
+            terms.update(seg.term_dict.get(field, ()))
+        return sorted(terms)
+
+
+@dataclass
+class TopDocs:
+    total: int
+    hits: list  # [(score, global_doc)]
+    max_score: float
+
+
+@dataclass
+class Clause:
+    field: str
+    term: str
+    boost: float
+    group: int  # GROUP_*
+
+
+@dataclass
+class FlatPlan:
+    """A query lowered to one flat weighted-term batch (device-executable)."""
+
+    clauses: list  # list[Clause]
+    msm: int
+    n_must: int
+    coord_enabled: bool
+    boost: float
+    query_norm: float = 1.0
+
+
+# ---------------------------------------------------------------------------
+# minimum_should_match parsing (ref: common/lucene/search/Queries.calculateMinShouldMatch)
+# ---------------------------------------------------------------------------
+
+
+def calculate_msm(spec, clause_count: int) -> int:
+    if spec is None:
+        return 0
+    if isinstance(spec, int):
+        result = spec
+    else:
+        s = str(spec).strip()
+        if "<" in s:
+            # "3<90%" — conditional combos separated by spaces
+            result = clause_count
+            for combo in s.split():
+                cond, _, value = combo.partition("<")
+                if clause_count > int(cond):
+                    result = _msm_value(value, clause_count)
+                    break
+            else:
+                result = clause_count
+        else:
+            result = _msm_value(s, clause_count)
+    # no upper clamp: msm > clause_count matches nothing (Lucene semantics)
+    return max(0, result)
+
+
+def _msm_value(s: str, clause_count: int) -> int:
+    s = s.strip()
+    if s.endswith("%"):
+        pct = float(s[:-1])
+        if pct < 0:
+            return clause_count + int(clause_count * pct / 100.0)
+        return int(clause_count * pct / 100.0)
+    v = int(s)
+    return clause_count + v if v < 0 else v
+
+
+# ---------------------------------------------------------------------------
+# flat lowering (device path)
+# ---------------------------------------------------------------------------
+
+
+def lower_flat(query: Query, ctx: ShardContext) -> FlatPlan | None:
+    """Lower a query to a flat clause list, or None if it needs the host path."""
+    if isinstance(query, TermQuery):
+        ft = ctx.field_type(query.field)
+        if ft is not None and ft.is_numeric:
+            return None  # numeric term → columnar filter, host path
+        return FlatPlan([Clause(query.field, str(query.value), query.boost, GROUP_SHOULD)],
+                        msm=1, n_must=0, coord_enabled=False, boost=1.0)
+    if isinstance(query, MatchQuery):
+        if query.fuzziness is not None:
+            return None
+        terms = ctx.analyze(query.field, query.text)
+        if not terms:
+            return FlatPlan([], msm=0, n_must=0, coord_enabled=False, boost=query.boost)
+        group = GROUP_MUST if query.operator == "and" else GROUP_SHOULD
+        clauses = [Clause(query.field, t, 1.0, group) for t in terms]
+        n_must = len(clauses) if group == GROUP_MUST else 0
+        msm = calculate_msm(query.minimum_should_match, len(clauses)) if group == GROUP_SHOULD else 0
+        if group == GROUP_SHOULD and msm == 0:
+            msm = 1
+        coord = len(clauses) > 1
+        return FlatPlan(clauses, msm=msm, n_must=n_must, coord_enabled=coord,
+                        boost=query.boost)
+    if isinstance(query, BoolQuery):
+        if query.filter:
+            return None
+        clauses: list[Clause] = []
+        n_scoring = 0
+        n_should = 0
+        for sub, group in (
+            [(q, GROUP_MUST) for q in query.must]
+            + [(q, GROUP_SHOULD) for q in query.should]
+            + [(q, GROUP_MUST_NOT) for q in query.must_not]
+        ):
+            term = _single_term(sub, ctx)
+            if term is None:
+                return None
+            field, t, boost = term
+            clauses.append(Clause(field, t, boost * (1.0 if group == GROUP_MUST_NOT else 1.0), group))
+            if group != GROUP_MUST_NOT:
+                n_scoring += 1
+            if group == GROUP_SHOULD:
+                n_should += 1
+        if n_scoring == 0:
+            # must_not-only bool matches all non-excluded docs — the kernel's
+            # "matched at least one scoring clause" gate can't express that; host path
+            return None
+        n_must = sum(1 for c in clauses if c.group == GROUP_MUST)
+        msm = calculate_msm(query.minimum_should_match, n_should)
+        if msm == 0 and n_should > 0 and n_must == 0:
+            msm = 1
+        coord = not query.disable_coord and n_scoring > 1
+        return FlatPlan(clauses, msm=msm, n_must=n_must, coord_enabled=coord,
+                        boost=query.boost)
+    return None
+
+
+def _single_term(query: Query, ctx: ShardContext):
+    """A sub-query usable as one flat clause: a term query or single-token match."""
+    if isinstance(query, TermQuery):
+        ft = ctx.field_type(query.field)
+        if ft is not None and ft.is_numeric:
+            return None
+        return (query.field, str(query.value), query.boost)
+    if isinstance(query, MatchQuery) and query.fuzziness is None:
+        terms = ctx.analyze(query.field, query.text)
+        if len(terms) == 1:
+            return (query.field, terms[0], query.boost)
+    return None
+
+
+def finalize_flat(plan: FlatPlan, ctx: ShardContext):
+    """Resolve clause weights against shard/global stats; returns per-clause arrays +
+    per-field norm caches, exactly the kernel's inputs."""
+    max_doc = ctx.max_doc
+    fields: list[str] = []
+    caches: list[np.ndarray] = []
+    field_idx: dict[str, int] = {}
+    resolved = []  # (field, term, weight, fidx, group, mode)
+    ssw = 0.0
+    for c in plan.clauses:
+        sim = ctx.similarity_for(c.field)
+        df = ctx.doc_freq(c.field, c.term)
+        if c.field not in field_idx:
+            field_idx[c.field] = len(fields)
+            fields.append(c.field)
+            caches.append(sim.norm_cache(ctx.field_stats(c.field), max_doc))
+        fi = field_idx[c.field]
+        if df <= 0:
+            resolved.append((c.field, c.term, 0.0, fi, c.group, MODE_BM25, 0))
+            continue
+        if isinstance(sim, BM25Similarity):
+            idf = sim.idf(df, max_doc)
+            w = np.float32(idf * c.boost * plan.boost * (sim.k1 + 1.0))
+            mode = MODE_BM25
+        else:
+            idf = TFIDFSimilarity.idf(df, max_doc)
+            w = np.float32(idf * idf * c.boost * plan.boost)  # queryNorm folded later
+            mode = MODE_TFIDF
+        if c.group != GROUP_MUST_NOT:
+            ssw += float((idf * c.boost * plan.boost) ** 2)
+        resolved.append((c.field, c.term, float(w), fi, c.group, mode, df))
+    qn = 1.0
+    if isinstance(ctx.default_similarity, TFIDFSimilarity) and ssw > 0:
+        qn = float(TFIDFSimilarity.query_norm(ssw))
+    out = []
+    for (f, t, w, fi, g, mode, df) in resolved:
+        out.append((f, t, w * qn if mode == MODE_TFIDF else w, fi, g, mode, df))
+    n_scoring = sum(1 for c in plan.clauses if c.group != GROUP_MUST_NOT)
+    coord = np.ones(max(n_scoring, 1) + 1, dtype=np.float32)
+    if plan.coord_enabled and isinstance(ctx.default_similarity, TFIDFSimilarity) and n_scoring > 0:
+        coord = np.arange(n_scoring + 1, dtype=np.float32) / np.float32(n_scoring)
+    return out, fields, np.stack(caches) if caches else None, coord
+
+
+# ---------------------------------------------------------------------------
+# batched device execution
+# ---------------------------------------------------------------------------
+
+
+def execute_flat_batch(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list[TopDocs]:
+    """Run a batch of flat plans through the device kernel, one launch per segment,
+    then merge per-segment top-k host-side (score desc, global doc asc — Lucene order)."""
+    from ..ops.device_index import packed_for
+    from ..ops.scoring import build_term_batch, score_term_batch
+
+    Q = len(plans)
+    finals = [finalize_flat(p, ctx) for p in plans]
+    all_fields: list[str] = []
+    field_idx: dict[str, int] = {}
+    cache_rows: list[np.ndarray] = []
+    for (resolved, fields, caches, _coord) in finals:
+        for i, f in enumerate(fields):
+            if f not in field_idx:
+                field_idx[f] = len(all_fields)
+                all_fields.append(f)
+                cache_rows.append(caches[i])
+    caches_stack = np.stack(cache_rows) if cache_rows else np.ones((1, 256), np.float32)
+    max_clauses = max(1, max(
+        (sum(1 for c in p.clauses if c.group != GROUP_MUST_NOT) for p in plans), default=1))
+    coord_tbl = np.ones((Q, max_clauses + 1), dtype=np.float32)
+    n_must = np.zeros(Q, np.int32)
+    msm = np.zeros(Q, np.int32)
+    for qi, (plan, (resolved, fields, caches, coord)) in enumerate(zip(plans, finals)):
+        coord_tbl[qi, : len(coord)] = coord
+        if len(coord) <= max_clauses:
+            coord_tbl[qi, len(coord):] = coord[-1]
+        n_must[qi] = plan.n_must
+        msm[qi] = plan.msm
+
+    per_query: list[list[tuple[float, int]]] = [[] for _ in range(Q)]
+    totals = np.zeros(Q, dtype=np.int64)
+    for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
+        packed = packed_for(seg)
+        entries = []
+        for qi, (resolved, _f, _c, _coord) in enumerate(finals):
+            for (f, t, w, _fi, g, mode, df) in resolved:
+                tid = seg.term_id(f, t)
+                if tid is None:
+                    continue
+                b0, b1 = packed.blocks_for_term(tid)
+                for b in range(b0, b1):
+                    entries.append((qi, b, w, field_idx[f], g, mode))
+        # queries whose fields lack norms in this segment still need the field rows
+        norm_fields = [f for f in all_fields]
+        missing = [f for f in norm_fields if f not in packed.norm_bytes]
+        if missing:
+            import jax.numpy as jnp
+
+            for f in missing:
+                packed.norm_bytes[f] = jnp.zeros(packed.doc_pad, dtype=jnp.uint8)
+        if not entries:
+            # still need totals for must_not/pure-miss semantics: no entries → no matches
+            continue
+        batch = build_term_batch(entries, Q, n_must, msm, coord_tbl, norm_fields,
+                                 caches_stack, nb_pad_row=packed.blk_docs.shape[0] - 1)
+        res = score_term_batch(packed, batch, k)
+        totals += res.total_hits
+        for qi in range(Q):
+            for j in range(res.docs.shape[1]):
+                d = int(res.docs[qi, j])
+                if d >= packed.doc_pad or not np.isfinite(res.scores[qi, j]):
+                    break
+                if d < seg.doc_count:
+                    per_query[qi].append((float(res.scores[qi, j]), base + d))
+    out = []
+    for qi in range(Q):
+        hits = sorted(per_query[qi], key=lambda h: (-h[0], h[1]))[:k]
+        out.append(TopDocs(
+            total=int(totals[qi]),
+            hits=hits,
+            max_score=hits[0][0] if hits else float("nan"),
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# host scorer (general path)
+# ---------------------------------------------------------------------------
+
+
+def _weight_prepass(query: Query, ctx: ShardContext) -> float:
+    """Sum of squared leaf weights (Lucene getValueForNormalization pre-pass)."""
+
+    def walk(q: Query, boost: float) -> float:
+        b = boost * getattr(q, "boost", 1.0)
+        if isinstance(q, TermQuery):
+            ft = ctx.field_type(q.field)
+            if ft is not None and ft.is_numeric:
+                return 0.0
+            df = ctx.doc_freq(q.field, str(q.value))
+            if df <= 0:
+                return 0.0
+            sim = ctx.similarity_for(q.field)
+            idf = sim.idf(df, ctx.max_doc)
+            return float((idf * b) ** 2)
+        if isinstance(q, MatchQuery):
+            total = 0.0
+            for t in ctx.analyze(q.field, q.text):
+                df = ctx.doc_freq(q.field, t)
+                if df > 0:
+                    sim = ctx.similarity_for(q.field)
+                    total += float((sim.idf(df, ctx.max_doc) * b) ** 2)
+            return total
+        if isinstance(q, PhraseQuery):
+            terms = [t.term for t in ctx.analyze_tokens(q.field, q.text)]
+            sim = ctx.similarity_for(q.field)
+            idf_sum = sum(
+                float(sim.idf(max(ctx.doc_freq(q.field, t), 0), ctx.max_doc))
+                for t in terms if ctx.doc_freq(q.field, t) > 0
+            )
+            return float((idf_sum * b) ** 2)
+        if isinstance(q, BoolQuery):
+            return sum(walk(s, b) for s in q.must + q.should)
+        if isinstance(q, DisMaxQuery):
+            return sum(walk(s, b) for s in q.queries)
+        if isinstance(q, FilteredQuery):
+            return walk(q.query, b)
+        if isinstance(q, (ConstantScoreQuery, MatchAllQuery, RangeQuery, PrefixQuery,
+                          WildcardQuery, RegexpQuery, FuzzyQuery, IdsQuery)):
+            return float(b * b)
+        if isinstance(q, FunctionScoreQuery) and q.query is not None:
+            return walk(q.query, b)
+        if isinstance(q, NestedQuery):
+            return walk(q.query, b)
+        return float(b * b)
+
+    return walk(query, 1.0)
+
+
+def query_norm_for(query: Query, ctx: ShardContext) -> float:
+    if not isinstance(ctx.default_similarity, TFIDFSimilarity):
+        return 1.0
+    ssw = _weight_prepass(query, ctx)
+    return float(TFIDFSimilarity.query_norm(ssw)) if ssw > 0 else 1.0
+
+
+class HostScorer:
+    """Recursive dense evaluation of one query against one segment.
+    Produces (scores float32[D], match bool[D]); live/parent masking happens in the
+    caller so nested/child evaluation can see non-parent docs."""
+
+    def __init__(self, ctx: ShardContext, seg: FrozenSegment, query_norm: float = 1.0):
+        self.ctx = ctx
+        self.seg = seg
+        self.qn = np.float32(query_norm)
+        self.D = seg.doc_count
+
+    # -- leaf helpers --------------------------------------------------------
+    def _term_scores(self, field: str, term: str, boost: float) -> tuple[np.ndarray, np.ndarray]:
+        seg, ctx = self.seg, self.ctx
+        scores = np.zeros(self.D, dtype=np.float32)
+        match = np.zeros(self.D, dtype=bool)
+        df = ctx.doc_freq(field, term)
+        docs, freqs = seg.postings(field, term)
+        if df <= 0 or len(docs) == 0:
+            return scores, match
+        sim = ctx.similarity_for(field)
+        norms = seg.norms.get(field)
+        nb = norms[docs] if norms is not None else np.zeros(len(docs), np.uint8)
+        cache = sim.norm_cache(ctx.field_stats(field), ctx.max_doc)
+        if isinstance(sim, BM25Similarity):
+            w = np.float32(sim.idf(df, ctx.max_doc) * boost * (sim.k1 + 1.0))
+            vals = w * freqs / (freqs + cache[nb])
+        else:
+            idf = TFIDFSimilarity.idf(df, ctx.max_doc)
+            w = np.float32(idf * idf * boost) * self.qn
+            vals = w * np.sqrt(freqs, dtype=np.float32) * cache[nb]
+        scores[docs] = vals.astype(np.float32)
+        match[docs] = True
+        return scores, match
+
+    def _const(self, mask: np.ndarray, boost: float) -> tuple[np.ndarray, np.ndarray]:
+        scores = np.where(mask, np.float32(boost * self.qn), np.float32(0.0)).astype(np.float32)
+        return scores, mask.copy()
+
+    def _mask(self, f: Filter) -> np.ndarray:
+        return segment_mask(self.seg, f, self.ctx)
+
+    # -- main dispatch -------------------------------------------------------
+    def eval(self, q: Query, boost: float = 1.0) -> tuple[np.ndarray, np.ndarray]:
+        b = boost * getattr(q, "boost", 1.0)
+        seg, ctx = self.seg, self.ctx
+
+        if isinstance(q, MatchAllQuery):
+            return self._const(np.ones(self.D, dtype=bool), b)
+
+        if isinstance(q, TermQuery):
+            ft = ctx.field_type(q.field)
+            if ft is not None and ft.is_numeric:
+                from .filters import TermFilter
+
+                return self._const(self._mask(TermFilter(q.field, q.value)), b)
+            return self._term_scores(q.field, str(q.value), b)
+
+        if isinstance(q, MatchQuery):
+            if q.fuzziness is not None:
+                terms = ctx.analyze(q.field, q.text)
+                subs = [FuzzyQuery(q.field, t, q.fuzziness, 0, q.max_expansions) for t in terms]
+                return self.eval(BoolQuery(should=subs, minimum_should_match=1), b)
+            terms = ctx.analyze(q.field, q.text)
+            if not terms:
+                return np.zeros(self.D, np.float32), np.zeros(self.D, bool)
+            sub = (BoolQuery(must=[TermQuery(q.field, t) for t in terms])
+                   if q.operator == "and"
+                   else BoolQuery(should=[TermQuery(q.field, t) for t in terms],
+                                  minimum_should_match=q.minimum_should_match or 1))
+            return self.eval(sub, b)
+
+        if isinstance(q, MultiMatchQuery):
+            subs = []
+            for fspec in q.fields:
+                if "^" in fspec:
+                    fname, fboost = fspec.split("^")
+                    fboost = float(fboost)
+                else:
+                    fname, fboost = fspec, 1.0
+                subs.append(MatchQuery(fname, q.text, operator=q.operator,
+                                       minimum_should_match=q.minimum_should_match,
+                                       boost=fboost))
+            if q.type in ("best_fields", "phrase", "phrase_prefix"):
+                return self.eval(DisMaxQuery(queries=subs, tie_breaker=q.tie_breaker), b)
+            return self.eval(BoolQuery(should=subs, minimum_should_match=1,
+                                       disable_coord=True), b)
+
+        if isinstance(q, BoolQuery):
+            return self._eval_bool(q, b)
+
+        if isinstance(q, FilteredQuery):
+            scores, match = self.eval(q.query, b)
+            fmask = self._mask(q.filter)
+            return np.where(fmask, scores, 0).astype(np.float32), match & fmask
+
+        if isinstance(q, ConstantScoreQuery):
+            if q.filter is not None:
+                return self._const(self._mask(q.filter), b)
+            _, match = self.eval(q.query, 1.0)
+            return self._const(match, b)
+
+        if isinstance(q, DisMaxQuery):
+            scores = np.zeros(self.D, np.float32)
+            best = np.zeros(self.D, np.float32)
+            total = np.zeros(self.D, np.float32)
+            match = np.zeros(self.D, bool)
+            for sub in q.queries:
+                s, m = self.eval(sub, b)
+                s = np.where(m, s, 0).astype(np.float32)
+                best = np.maximum(best, s)
+                total += s
+                match |= m
+            tie = np.float32(q.tie_breaker)
+            scores = best + tie * (total - best)
+            return np.where(match, scores, 0).astype(np.float32), match
+
+        if isinstance(q, RangeQuery):
+            from .filters import RangeFilter
+
+            return self._const(self._mask(RangeFilter(q.field, q.gte, q.gt, q.lte, q.lt)), b)
+
+        if isinstance(q, (PrefixQuery, WildcardQuery, RegexpQuery)):
+            return self._const(self._multi_term_mask(q), b)
+
+        if isinstance(q, FuzzyQuery):
+            terms = self._fuzzy_terms(q)
+            mask = np.zeros(self.D, bool)
+            for t in terms:
+                docs, _ = seg.postings(q.field, t)
+                mask[docs] = True
+            return self._const(mask, b)
+
+        if isinstance(q, IdsQuery):
+            from .filters import IdsFilter
+
+            return self._const(self._mask(IdsFilter(q.ids, q.types)), b)
+
+        if isinstance(q, PhraseQuery):
+            return self._eval_phrase(q, b)
+
+        if isinstance(q, QueryStringQuery):
+            return self.eval(parse_query_string(q, self.ctx), b)
+
+        if isinstance(q, CommonTermsQuery):
+            return self.eval(self._rewrite_common(q), b)
+
+        if isinstance(q, FunctionScoreQuery):
+            return self._eval_function_score(q, b)
+
+        if isinstance(q, NestedQuery):
+            mask, scores = child_match_to_parents(
+                seg, ctx, q.path, q.query, score_mode=q.score_mode, query_norm=float(self.qn)
+            )
+            return (scores * np.float32(b)).astype(np.float32), mask
+
+        if isinstance(q, (HasChildQuery, HasParentQuery)):
+            # resolved at shard level (cross-segment join) — executor special-cases;
+            # segment-local fallback: no match
+            return np.zeros(self.D, np.float32), np.zeros(self.D, bool)
+
+        if isinstance(q, BoostingQuery):
+            scores, match = self.eval(q.positive, b)
+            _, neg = self.eval(q.negative, 1.0)
+            scores = np.where(neg, scores * np.float32(q.negative_boost), scores)
+            return scores.astype(np.float32), match
+
+        if isinstance(q, MoreLikeThisQuery):
+            return self.eval(self._rewrite_mlt(q), b)
+
+        if isinstance(q, SpanTermQuery):
+            return self._term_scores(q.field, q.value, b)
+
+        if isinstance(q, SpanNearQuery):
+            terms = [c.value if isinstance(c, SpanTermQuery) else None for c in q.clauses]
+            fields = {c.field for c in q.clauses if isinstance(c, SpanTermQuery)}
+            if None in terms or len(fields) != 1:
+                raise QueryParsingError("span_near supports span_term clauses on one field")
+            pq = PhraseQuery(next(iter(fields)), " ".join(terms), slop=q.slop)
+            pq._pre_analyzed = terms  # type: ignore[attr-defined]
+            return self._eval_phrase(pq, b, in_order=q.in_order)
+
+        if isinstance(q, IndicesQuery):
+            # index targeting resolved at the shard level; here run the main query
+            return self.eval(q.query, b)
+
+        raise QueryParsingError(f"unsupported query type {type(q).__name__}")
+
+    # -- bool ---------------------------------------------------------------
+    def _eval_bool(self, q: BoolQuery, boost: float):
+        D = self.D
+        scores = np.zeros(D, np.float32)
+        matched_count = np.zeros(D, np.int32)
+        must_ok = np.ones(D, bool)
+        excluded = np.zeros(D, bool)
+        should_count = np.zeros(D, np.int32)
+        n_scoring = 0
+        for sub in q.must:
+            s, m = self.eval(sub, boost)
+            scores += np.where(m, s, 0).astype(np.float32)
+            must_ok &= m
+            matched_count += m
+            n_scoring += 1
+        for sub in q.should:
+            s, m = self.eval(sub, boost)
+            scores += np.where(m, s, 0).astype(np.float32)
+            should_count += m
+            matched_count += m
+            n_scoring += 1
+        for sub in q.must_not:
+            _, m = self.eval(sub, 1.0)
+            excluded |= m
+        fmask = np.ones(D, bool)
+        for f in q.filter:
+            fmask &= self._mask(f)
+        msm = calculate_msm(q.minimum_should_match, len(q.should))
+        if msm == 0 and q.should and not q.must:
+            msm = 1
+        match = must_ok & ~excluded & fmask & (should_count >= msm)
+        if not q.must and not q.should:
+            match = fmask & ~excluded  # filter/must_not-only bool matches all remaining
+            scores = np.where(match, np.float32(boost * q.boost * self.qn), 0).astype(np.float32)
+            return scores, match
+        match &= matched_count > 0
+        if (not q.disable_coord and n_scoring > 1
+                and isinstance(self.ctx.default_similarity, TFIDFSimilarity)):
+            coord = matched_count.astype(np.float32) / np.float32(n_scoring)
+            scores = scores * coord
+        return np.where(match, scores, 0).astype(np.float32), match
+
+    # -- multi-term ----------------------------------------------------------
+    def _multi_term_mask(self, q) -> np.ndarray:
+        seg = self.seg
+        mask = np.zeros(self.D, bool)
+        if isinstance(q, PrefixQuery):
+            pred = lambda t: t.startswith(q.prefix)  # noqa: E731
+        elif isinstance(q, WildcardQuery):
+            rex = re.compile(_wildcard_to_regex(q.pattern))
+            pred = lambda t: rex.fullmatch(t) is not None  # noqa: E731
+        else:
+            rex = re.compile(q.pattern)
+            pred = lambda t: rex.fullmatch(t) is not None  # noqa: E731
+        for term in seg.terms_for_field(q.field):
+            if pred(term):
+                docs, _ = seg.postings(q.field, term)
+                mask[docs] = True
+        return mask
+
+    def _fuzzy_terms(self, q: FuzzyQuery) -> list[str]:
+        max_edits = _fuzzy_max_edits(q.fuzziness, q.value)
+        out = []
+        for term in self.seg.terms_for_field(q.field):
+            if q.prefix_length and not term.startswith(q.value[: q.prefix_length]):
+                continue
+            if _within_edits(q.value, term, max_edits):
+                out.append(term)
+                if len(out) >= q.max_expansions:
+                    break
+        return out
+
+    # -- phrase --------------------------------------------------------------
+    def _eval_phrase(self, q: PhraseQuery, boost: float, in_order: bool = True):
+        seg, ctx = self.seg, self.ctx
+        scores = np.zeros(self.D, np.float32)
+        match = np.zeros(self.D, bool)
+        if hasattr(q, "_pre_analyzed"):
+            terms = list(q._pre_analyzed)  # type: ignore[attr-defined]
+            rel_pos = list(range(len(terms)))
+        else:
+            toks = ctx.analyze_tokens(q.field, q.text)
+            terms = [t.term for t in toks]
+            rel_pos = [t.position for t in toks]
+        if not terms:
+            return scores, match
+        if len(terms) == 1 and not q.prefix:
+            return self._term_scores(q.field, terms[0], boost)
+        last_terms = [terms[-1]]
+        if q.prefix:
+            last_terms = [t for t in seg.terms_for_field(q.field)
+                          if t.startswith(terms[-1])][: q.max_expansions] or []
+            if not last_terms:
+                return scores, match
+        # candidate docs: intersection of postings
+        doc_sets = []
+        for t in terms[:-1]:
+            docs, _ = seg.postings(q.field, t)
+            doc_sets.append(set(docs.tolist()))
+        last_docs: set = set()
+        for lt in last_terms:
+            docs, _ = seg.postings(q.field, lt)
+            last_docs.update(docs.tolist())
+        doc_sets.append(last_docs)
+        candidates = sorted(set.intersection(*doc_sets)) if doc_sets else []
+        if not candidates:
+            return scores, match
+        # positions check
+        pos_maps = []
+        for t in terms[:-1]:
+            pos_maps.append(_positions_by_doc(seg, q.field, t))
+        last_pos: dict[int, set] = {}
+        for lt in last_terms:
+            for d, ps in _positions_by_doc(seg, q.field, lt).items():
+                last_pos.setdefault(d, set()).update(ps)
+        sim = ctx.similarity_for(q.field)
+        norms = seg.norms.get(q.field)
+        cache = sim.norm_cache(ctx.field_stats(q.field), ctx.max_doc)
+        idf_sum = np.float32(sum(
+            float(sim.idf(ctx.doc_freq(q.field, t), ctx.max_doc))
+            for t in terms if ctx.doc_freq(q.field, t) > 0
+        ))
+        for d in candidates:
+            freq = _phrase_freq(
+                [pm.get(d, set()) for pm in pos_maps] + [last_pos.get(d, set())],
+                rel_pos, q.slop, in_order,
+            )
+            if freq <= 0:
+                continue
+            nb = norms[d] if norms is not None else 0
+            if isinstance(sim, BM25Similarity):
+                w = np.float32(idf_sum * boost * (sim.k1 + 1.0))
+                scores[d] = w * np.float32(freq) / (np.float32(freq) + cache[nb])
+            else:
+                w = np.float32(idf_sum * idf_sum * boost) * self.qn
+                scores[d] = w * np.sqrt(np.float32(freq)) * cache[nb]
+            match[d] = True
+        return scores, match
+
+    # -- rewrites ------------------------------------------------------------
+    def _rewrite_common(self, q: CommonTermsQuery) -> Query:
+        ctx = self.ctx
+        terms = ctx.analyze(q.field, q.text)
+        max_doc = max(ctx.max_doc, 1)
+        low, high = [], []
+        for t in terms:
+            df = ctx.doc_freq(q.field, t)
+            cutoff = q.cutoff_frequency
+            threshold = cutoff * max_doc if cutoff < 1.0 else cutoff
+            (high if df > threshold else low).append(TermQuery(q.field, t))
+        if not low:
+            op_group = q.high_freq_operator
+            return BoolQuery(must=high if op_group == "and" else [],
+                             should=high if op_group != "and" else [],
+                             minimum_should_match=q.minimum_should_match)
+        low_bool = BoolQuery(must=low if q.low_freq_operator == "and" else [],
+                             should=low if q.low_freq_operator != "and" else [],
+                             minimum_should_match=q.minimum_should_match)
+        if not high:
+            return low_bool
+        return BoolQuery(must=[low_bool], should=high, disable_coord=True)
+
+    def _rewrite_mlt(self, q: MoreLikeThisQuery) -> Query:
+        from collections import Counter
+
+        ctx = self.ctx
+        shoulds = []
+        for field in q.fields:
+            counts = Counter(ctx.analyze(field, q.like_text))
+            scored = []
+            for t, tf in counts.items():
+                if tf < q.min_term_freq:
+                    continue
+                df = ctx.doc_freq(field, t)
+                if df < q.min_doc_freq or df <= 0:
+                    continue
+                idf = TFIDFSimilarity.idf(df, ctx.max_doc)
+                scored.append((float(tf * idf), t))
+            scored.sort(reverse=True)
+            for _, t in scored[: q.max_query_terms]:
+                shoulds.append(TermQuery(field, t))
+        return BoolQuery(should=shoulds, minimum_should_match=q.minimum_should_match)
+
+    # -- function score ------------------------------------------------------
+    def _eval_function_score(self, q: FunctionScoreQuery, boost: float):
+        from .functions import apply_functions
+
+        if q.query is not None:
+            sub_scores, match = self.eval(q.query, 1.0)
+        elif q.filter is not None:
+            sub_scores, match = self._const(self._mask(q.filter), 1.0)
+        else:
+            sub_scores, match = self._const(np.ones(self.D, bool), 1.0)
+        scores = apply_functions(q, sub_scores, match, self.seg, self.ctx)
+        scores = (scores * np.float32(boost)).astype(np.float32)
+        if q.min_score is not None:
+            match = match & (scores >= np.float32(q.min_score))
+        return scores, match
+
+
+def _positions_by_doc(seg: FrozenSegment, field: str, term: str) -> dict[int, set]:
+    tid = seg.term_id(field, term)
+    if tid is None:
+        return {}
+    s, e = int(seg.post_offsets[tid]), int(seg.post_offsets[tid + 1])
+    out = {}
+    for i in range(s, e):
+        d = int(seg.post_docs[i])
+        out[d] = set(seg.positions[seg.pos_offsets[i]: seg.pos_offsets[i + 1]].tolist())
+    return out
+
+
+def _phrase_freq(pos_sets: list[set], rel_pos: list[int], slop: int, in_order: bool) -> int:
+    """Count phrase occurrences. slop=0: exact relative positions. slop>0: alignments
+    whose total displacement ≤ slop (greedy per anchor — matches Lucene for common
+    cases; documented approximation for pathological overlaps)."""
+    if not pos_sets or any(not s for s in pos_sets):
+        return 0
+    first = pos_sets[0]
+    count = 0
+    for p0 in sorted(first):
+        if slop == 0:
+            if all((p0 + rel_pos[i] - rel_pos[0]) in pos_sets[i] for i in range(1, len(pos_sets))):
+                count += 1
+        else:
+            total_disp = 0
+            ok = True
+            prev = p0
+            for i in range(1, len(pos_sets)):
+                expected = p0 + rel_pos[i] - rel_pos[0]
+                cands = pos_sets[i]
+                if in_order:
+                    cands = {c for c in cands if c > prev}
+                if not cands:
+                    ok = False
+                    break
+                nearest = min(cands, key=lambda c: abs(c - expected))
+                total_disp += abs(nearest - expected)
+                prev = nearest
+            if ok and total_disp <= slop:
+                count += 1
+    return count
+
+
+def _wildcard_to_regex(pattern: str) -> str:
+    out = []
+    for ch in pattern:
+        if ch == "*":
+            out.append(".*")
+        elif ch == "?":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return "".join(out)
+
+
+def _fuzzy_max_edits(fuzziness, value: str) -> int:
+    if fuzziness in ("AUTO", "auto", None):
+        n = len(value)
+        return 0 if n <= 2 else (1 if n <= 5 else 2)
+    try:
+        return int(float(fuzziness))
+    except (TypeError, ValueError):
+        return 1
+
+
+def _within_edits(a: str, b: str, max_edits: int) -> bool:
+    if abs(len(a) - len(b)) > max_edits:
+        return False
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i] + [0] * len(b)
+        row_min = i
+        for j, cb in enumerate(b, 1):
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + (ca != cb))
+            row_min = min(row_min, cur[j])
+        if row_min > max_edits:
+            return False
+        prev = cur
+    return prev[-1] <= max_edits
+
+
+# ---------------------------------------------------------------------------
+# query_string mini-parser (subset of Lucene syntax)
+# ---------------------------------------------------------------------------
+
+_QS_TOKEN = re.compile(
+    r"\s*(?:(\()|(\))|(AND\b|&&)|(OR\b|\|\|)|(NOT\b|!)|([+-])?"
+    r"(?:(\w[\w.]*):)?(?:\"([^\"]*)\"|([^\s()]+)))"
+)
+
+
+def parse_query_string(q: QueryStringQuery, ctx: ShardContext) -> Query:
+    """field:term, AND/OR/NOT, +/-, "phrases", wild*cards, (grouping — flattened)."""
+    default_fields = q.fields or [q.default_field]
+    must, should, must_not = [], [], []
+    pending_op = None
+    for m in _QS_TOKEN.finditer(q.query):
+        lparen, rparen, and_, or_, not_, sign, fname, phrase, word = m.groups()
+        if lparen or rparen:
+            continue
+        if and_:
+            # "a AND b": the left operand becomes required too
+            if should:
+                must.append(should.pop())
+            pending_op = "and"
+            continue
+        if or_:
+            pending_op = "or"
+            continue
+        if not_:
+            pending_op = "not"
+            continue
+        target_fields = [fname] if fname else default_fields
+        subs: list[Query] = []
+        for f in target_fields:
+            if phrase is not None:
+                subs.append(PhraseQuery(f, phrase))
+            elif word == "*":
+                subs.append(MatchAllQuery())
+            elif word and ("*" in word or "?" in word):
+                subs.append(WildcardQuery(f, word))
+            elif word and "~" in word:
+                base, _, fuzz = word.partition("~")
+                subs.append(FuzzyQuery(f, base, fuzz or "AUTO"))
+            elif word:
+                subs.append(MatchQuery(f, word))
+            else:
+                continue
+        node = subs[0] if len(subs) == 1 else DisMaxQuery(queries=subs)
+        if sign == "+" or pending_op == "and" or (pending_op is None and q.default_operator == "and"):
+            must.append(node)
+        elif sign == "-" or pending_op == "not":
+            must_not.append(node)
+        else:
+            should.append(node)
+        pending_op = None
+    if not must and not should and not must_not:
+        return MatchAllQuery()
+    if len(should) == 1 and not must and not must_not:
+        out = should[0]
+        out.boost = out.boost * q.boost
+        return out
+    return BoolQuery(must=must, should=should, must_not=must_not, boost=q.boost)
+
+
+# ---------------------------------------------------------------------------
+# nested / parent-child joins
+# ---------------------------------------------------------------------------
+
+
+def _parent_of_map(seg: FrozenSegment) -> np.ndarray:
+    cache = seg._device_cache
+    pm = cache.get("parent_of")
+    if pm is None:
+        pm = np.zeros(seg.doc_count, dtype=np.int64)
+        parent = -1
+        for local in range(seg.doc_count - 1, -1, -1):
+            if seg.parent_mask[local]:
+                parent = local
+            pm[local] = parent
+        cache["parent_of"] = pm
+    return pm
+
+
+def child_match_to_parents(seg: FrozenSegment, ctx: ShardContext, path: str, inner,
+                           score_mode: str = "none", query_norm: float = 1.0):
+    """Block-join: evaluate `inner` over nested child docs of `path`, aggregate to
+    parents (ref: index/search/nested/ block-join queries)."""
+    child_sel = np.asarray(
+        [p == path for p in seg.nested_paths], dtype=bool
+    )
+    if isinstance(inner, Filter):
+        cmask = segment_mask(seg, inner, ctx)
+        cscores = cmask.astype(np.float32)
+    else:
+        scorer = HostScorer(ctx, seg, query_norm)
+        cscores, cmask = scorer.eval(inner)
+    cmask = cmask & child_sel
+    parents = _parent_of_map(seg)
+    pmask = np.zeros(seg.doc_count, dtype=bool)
+    pscores = np.zeros(seg.doc_count, dtype=np.float32)
+    pcounts = np.zeros(seg.doc_count, dtype=np.int32)
+    idx = np.nonzero(cmask)[0]
+    if len(idx):
+        pidx = parents[idx]
+        valid = pidx >= 0
+        idx, pidx = idx[valid], pidx[valid]
+        pmask[pidx] = True
+        if score_mode in ("sum", "avg", "total"):
+            np.add.at(pscores, pidx, cscores[idx])
+            np.add.at(pcounts, pidx, 1)
+            if score_mode == "avg":
+                nz = pcounts > 0
+                pscores[nz] = pscores[nz] / pcounts[nz]
+        elif score_mode == "max":
+            np.maximum.at(pscores, pidx, cscores[idx])
+        else:
+            pscores[pidx] = 1.0
+    return pmask, pscores
+
+
+def host_match_mask(query: Query, seg: FrozenSegment, ctx: ShardContext) -> np.ndarray:
+    _, match = HostScorer(ctx, seg).eval(query)
+    return match
+
+
+# ---------------------------------------------------------------------------
+# shard-level entry points
+# ---------------------------------------------------------------------------
+
+
+def search_shard(ctx: ShardContext, query: Query, k: int, use_device: bool = True,
+                 extra_filter: Filter | None = None) -> TopDocs:
+    return search_shard_batch(ctx, [query], k, use_device=use_device,
+                              extra_filter=extra_filter)[0]
+
+
+def search_shard_batch(ctx: ShardContext, queries: list[Query], k: int,
+                       use_device: bool = True,
+                       extra_filter: Filter | None = None) -> list[TopDocs]:
+    """Execute a batch: flat-lowerable queries fused onto the device, the rest host."""
+    results: list[TopDocs | None] = [None] * len(queries)
+    flat_idx: list[int] = []
+    flat_plans: list[FlatPlan] = []
+    if extra_filter is None:
+        for i, q in enumerate(queries):
+            plan = lower_flat(q, ctx) if use_device else None
+            if plan is not None:
+                flat_idx.append(i)
+                flat_plans.append(plan)
+    if flat_plans:
+        for i, td in zip(flat_idx, execute_flat_batch(flat_plans, ctx, k)):
+            results[i] = td
+    for i, q in enumerate(queries):
+        if results[i] is None:
+            results[i] = _host_search(ctx, q, k, extra_filter)
+    return results  # type: ignore[return-value]
+
+
+def _shard_join(ctx: ShardContext, q: Query):
+    """Cross-segment parent/child join: returns per-segment (scores, match) overrides
+    for has_child / has_parent queries, else None."""
+    if not isinstance(q, (HasChildQuery, HasParentQuery)):
+        return None
+    from .filters import TermFilter
+
+    out = []
+    if isinstance(q, HasChildQuery):
+        # collect matching children's _parent ids across segments
+        parent_ids: dict[str, float] = {}
+        for seg in ctx.searcher.segments:
+            scorer = HostScorer(ctx, seg, 1.0)
+            s, m = scorer.eval(q.query)
+            m = m & np.asarray([t == q.child_type for t in seg.types], dtype=bool)
+            for local in np.nonzero(m)[0]:
+                pid = (seg.str_values("_parent", int(local)) or [None])[0]
+                if pid is None:
+                    continue
+                prev = parent_ids.get(pid, 0.0)
+                parent_ids[pid] = max(prev, float(s[local])) if q.score_mode == "max" \
+                    else prev + float(s[local])
+        for seg in ctx.searcher.segments:
+            match = np.zeros(seg.doc_count, bool)
+            scores = np.zeros(seg.doc_count, np.float32)
+            for local in range(seg.doc_count):
+                if seg.parent_mask[local] and seg.ids[local] in parent_ids:
+                    match[local] = True
+                    scores[local] = parent_ids[seg.ids[local]] if q.score_mode != "none" else 1.0
+            out.append((scores * np.float32(q.boost), match))
+        return out
+    # has_parent: find matching parents, then select children pointing at them
+    matched_parents: dict[str, float] = {}
+    for seg in ctx.searcher.segments:
+        scorer = HostScorer(ctx, seg, 1.0)
+        s, m = scorer.eval(q.query)
+        m = m & np.asarray([t == q.parent_type for t in seg.types], dtype=bool)
+        for local in np.nonzero(m)[0]:
+            matched_parents[str(seg.ids[local])] = float(s[local])
+    for seg in ctx.searcher.segments:
+        match = np.zeros(seg.doc_count, bool)
+        scores = np.zeros(seg.doc_count, np.float32)
+        for local in range(seg.doc_count):
+            pid = (seg.str_values("_parent", local) or [None])[0]
+            if pid is not None and pid in matched_parents:
+                match[local] = True
+                scores[local] = matched_parents[pid] if q.score_mode != "none" else 1.0
+
+        out.append((scores * np.float32(q.boost), match))
+    return out
+
+
+def _host_search(ctx: ShardContext, query: Query, k: int,
+                 extra_filter: Filter | None = None) -> TopDocs:
+    qn = query_norm_for(query, ctx)
+    all_scores: list[np.ndarray] = []
+    all_docs: list[np.ndarray] = []
+    total = 0
+    join = _shard_join(ctx, query)
+    for si, (seg, base) in enumerate(zip(ctx.searcher.segments, ctx.searcher.bases)):
+        if join is not None:
+            scores, match = join[si]
+        else:
+            scorer = HostScorer(ctx, seg, qn)
+            scores, match = scorer.eval(query)
+        match = match & seg.live & seg.parent_mask
+        if extra_filter is not None:
+            match = match & segment_mask(seg, extra_filter, ctx)
+        idx = np.nonzero(match)[0]
+        total += len(idx)
+        if len(idx):
+            all_scores.append(scores[idx])
+            all_docs.append(idx + base)
+    if not all_scores:
+        return TopDocs(0, [], float("nan"))
+    scores = np.concatenate(all_scores)
+    docs = np.concatenate(all_docs)
+    order = np.lexsort((docs, -scores))[:k]
+    hits = [(float(scores[i]), int(docs[i])) for i in order]
+    return TopDocs(total, hits, float(scores.max()))
+
+
+def count_shard(ctx: ShardContext, query: Query, extra_filter: Filter | None = None) -> int:
+    total = 0
+    for seg in ctx.searcher.segments:
+        match = host_match_mask(query, seg, ctx) & seg.live & seg.parent_mask
+        if extra_filter is not None:
+            match &= segment_mask(seg, extra_filter, ctx)
+        total += int(match.sum())
+    return total
+
+
+def match_masks(ctx: ShardContext, query: Query, extra_filter: Filter | None = None):
+    """Per-segment (scores, match) for aggregation/fetch sub-phases."""
+    qn = query_norm_for(query, ctx)
+    out = []
+    join = _shard_join(ctx, query)
+    for si, seg in enumerate(ctx.searcher.segments):
+        if join is not None:
+            scores, match = join[si]
+        else:
+            scorer = HostScorer(ctx, seg, qn)
+            scores, match = scorer.eval(query)
+        match = match & seg.live & seg.parent_mask
+        if extra_filter is not None:
+            match = match & segment_mask(seg, extra_filter, ctx)
+        out.append((scores, match))
+    return out

@@ -1,0 +1,470 @@
+"""Filter tree: non-scoring matchers evaluated per segment as boolean doc masks.
+
+Analogue of the reference's 29 filter parsers (index/query/*FilterParser.java —
+SURVEY.md §2.3) and its per-index weighted-LRU filter cache (index/cache/filter/).
+A filter evaluates to bool[doc_count] per segment; masks combine with numpy logical ops
+and feed the device scorer as a score mask (filters never contribute to scores, matching
+FilteredQuery/BooleanFilter semantics).
+
+Evaluation is host-side numpy over the segment's CSR postings / columnar doc values —
+cheap, and the per-(segment, filter-key) result is cached exactly like the reference's
+filter cache. Range/term filters over single-valued numeric columns additionally have a
+device fast path via PackedSegment.dv_single (used by function_score and sort).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field as dc_field
+from typing import Any
+
+import numpy as np
+
+from ..common.errors import QueryParsingError
+from ..index.segment import FrozenSegment
+from ..mapper.core import parse_date_math
+
+
+class Filter:
+    def key(self) -> str:
+        raise NotImplementedError
+
+    def evaluate(self, seg: FrozenSegment, ctx) -> np.ndarray:
+        raise NotImplementedError
+
+
+def segment_mask(seg: FrozenSegment, f: Filter, ctx) -> np.ndarray:
+    """Cached evaluation (the filter cache). ctx carries the mapper service."""
+    cache = seg._device_cache.setdefault("filters", {})
+    k = f.key()
+    m = cache.get(k)
+    if m is None:
+        m = f.evaluate(seg, ctx)
+        cache[k] = m
+    return m
+
+
+def _postings_mask(seg: FrozenSegment, field: str, term: str) -> np.ndarray:
+    mask = np.zeros(seg.doc_count, dtype=bool)
+    docs, _ = seg.postings(field, str(term))
+    mask[docs] = True
+    return mask
+
+
+def _num_column_mask(seg: FrozenSegment, field: str, pred) -> np.ndarray:
+    col = seg.dv_num.get(field)
+    mask = np.zeros(seg.doc_count, dtype=bool)
+    if col is None:
+        return mask
+    off, vals = col
+    if len(vals) == 0:
+        return mask
+    hit = pred(vals)
+    counts = np.diff(off)
+    doc_of_val = np.repeat(np.arange(seg.doc_count), counts)
+    np.logical_or.at(mask, doc_of_val, hit)
+    return mask
+
+
+@dataclass
+class TermFilter(Filter):
+    field: str
+    value: Any
+
+    def key(self):
+        return f"term:{self.field}:{self.value}"
+
+    def evaluate(self, seg, ctx):
+        ft = ctx.field_type(self.field)
+        if ft is not None and ft.is_numeric:
+            coerced = ft.coerce(self.value)
+            return _num_column_mask(seg, self.field, lambda v: v == float(coerced))
+        return _postings_mask(seg, self.field, _index_term(ctx, self.field, self.value))
+
+
+@dataclass
+class TermsFilter(Filter):
+    field: str
+    values: list
+
+    def key(self):
+        return f"terms:{self.field}:{sorted(map(str, self.values))!r}"
+
+    def evaluate(self, seg, ctx):
+        ft = ctx.field_type(self.field)
+        mask = np.zeros(seg.doc_count, dtype=bool)
+        if ft is not None and ft.is_numeric:
+            coerced = {float(ft.coerce(v)) for v in self.values}
+            arr = np.asarray(sorted(coerced))
+            return _num_column_mask(seg, self.field, lambda v: np.isin(v, arr))
+        for v in self.values:
+            mask |= _postings_mask(seg, self.field, _index_term(ctx, self.field, v))
+        return mask
+
+
+@dataclass
+class RangeFilter(Filter):
+    field: str
+    gte: Any = None
+    gt: Any = None
+    lte: Any = None
+    lt: Any = None
+
+    def key(self):
+        return f"range:{self.field}:{self.gte}:{self.gt}:{self.lte}:{self.lt}"
+
+    def _bounds_numeric(self, ft) -> tuple[float, float, bool, bool]:
+        def conv(v):
+            if ft is not None and ft.type == "date" and isinstance(v, str):
+                return float(parse_date_math(v))
+            return float(ft.coerce(v)) if ft is not None and ft.is_numeric else float(v)
+
+        lo, lo_inc = -np.inf, True
+        hi, hi_inc = np.inf, True
+        if self.gte is not None:
+            lo = conv(self.gte)
+        if self.gt is not None:
+            lo, lo_inc = conv(self.gt), False
+        if self.lte is not None:
+            hi = conv(self.lte)
+        if self.lt is not None:
+            hi, hi_inc = conv(self.lt), False
+        return lo, hi, lo_inc, hi_inc
+
+    def evaluate(self, seg, ctx):
+        ft = ctx.field_type(self.field)
+        if ft is None or ft.is_numeric:
+            lo, hi, lo_inc, hi_inc = self._bounds_numeric(ft)
+
+            def pred(v):
+                lower = v >= lo if lo_inc else v > lo
+                upper = v <= hi if hi_inc else v < hi
+                return lower & upper
+
+            return _num_column_mask(seg, self.field, pred)
+        # lexicographic range over the sorted term dictionary (keyword fields)
+        mask = np.zeros(seg.doc_count, dtype=bool)
+        for term in seg.terms_for_field(self.field):
+            if self.gte is not None and term < str(self.gte):
+                continue
+            if self.gt is not None and term <= str(self.gt):
+                continue
+            if self.lte is not None and term > str(self.lte):
+                break
+            if self.lt is not None and term >= str(self.lt):
+                break
+            mask |= _postings_mask(seg, self.field, term)
+        return mask
+
+
+@dataclass
+class PrefixFilter(Filter):
+    field: str
+    prefix: str
+
+    def key(self):
+        return f"prefix:{self.field}:{self.prefix}"
+
+    def evaluate(self, seg, ctx):
+        mask = np.zeros(seg.doc_count, dtype=bool)
+        for term in seg.terms_for_field(self.field):
+            if term.startswith(self.prefix):
+                mask |= _postings_mask(seg, self.field, term)
+            elif term > self.prefix and not term.startswith(self.prefix):
+                break
+        return mask
+
+
+@dataclass
+class ExistsFilter(Filter):
+    field: str
+
+    def key(self):
+        return f"exists:{self.field}"
+
+    def evaluate(self, seg, ctx):
+        mask = np.zeros(seg.doc_count, dtype=bool)
+        td = seg.term_dict.get(self.field)
+        if td:
+            for tid in td.values():
+                s, e = seg.post_offsets[tid], seg.post_offsets[tid + 1]
+                mask[seg.post_docs[s:e]] = True
+        col = seg.dv_num.get(self.field)
+        if col is not None:
+            off, _ = col
+            mask |= np.diff(off) > 0
+        scol = seg.dv_str.get(self.field)
+        if scol is not None:
+            _, off, _ = scol
+            mask |= np.diff(off) > 0
+        return mask
+
+
+@dataclass
+class MissingFilter(Filter):
+    field: str
+
+    def key(self):
+        return f"missing:{self.field}"
+
+    def evaluate(self, seg, ctx):
+        return ~ExistsFilter(self.field).evaluate(seg, ctx)
+
+
+@dataclass
+class IdsFilter(Filter):
+    ids: list
+    types: list = dc_field(default_factory=list)
+
+    def key(self):
+        return f"ids:{sorted(self.types)}:{sorted(map(str, self.ids))!r}"
+
+    def evaluate(self, seg, ctx):
+        mask = np.zeros(seg.doc_count, dtype=bool)
+        idset = set(map(str, self.ids))
+        for local in range(seg.doc_count):
+            if seg.parent_mask[local] and seg.ids[local] in idset:
+                if not self.types or seg.types[local] in self.types:
+                    mask[local] = True
+        return mask
+
+
+@dataclass
+class TypeFilter(Filter):
+    type: str
+
+    def key(self):
+        return f"type:{self.type}"
+
+    def evaluate(self, seg, ctx):
+        return np.asarray([t == self.type for t in seg.types], dtype=bool)
+
+
+@dataclass
+class MatchAllFilter(Filter):
+    def key(self):
+        return "match_all"
+
+    def evaluate(self, seg, ctx):
+        return np.ones(seg.doc_count, dtype=bool)
+
+
+@dataclass
+class BoolFilter(Filter):
+    must: list = dc_field(default_factory=list)
+    should: list = dc_field(default_factory=list)
+    must_not: list = dc_field(default_factory=list)
+
+    def key(self):
+        return (
+            "bool:" + "&".join(f.key() for f in self.must)
+            + "|" + ";".join(f.key() for f in self.should)
+            + "!" + ";".join(f.key() for f in self.must_not)
+        )
+
+    def evaluate(self, seg, ctx):
+        mask = np.ones(seg.doc_count, dtype=bool)
+        for f in self.must:
+            mask &= segment_mask(seg, f, ctx)
+        if self.should:
+            smask = np.zeros(seg.doc_count, dtype=bool)
+            for f in self.should:
+                smask |= segment_mask(seg, f, ctx)
+            mask &= smask
+        for f in self.must_not:
+            mask &= ~segment_mask(seg, f, ctx)
+        return mask
+
+
+@dataclass
+class NotFilter(Filter):
+    inner: Filter
+
+    def key(self):
+        return f"not:{self.inner.key()}"
+
+    def evaluate(self, seg, ctx):
+        return ~segment_mask(seg, self.inner, ctx)
+
+
+@dataclass
+class QueryWrapperFilter(Filter):
+    """Wraps a scoring query as a filter (ref: FQueryFilterParser / query filter)."""
+
+    query: Any  # Query — evaluated via the host scorer for its match mask
+
+    def key(self):
+        return f"query:{self.query!r}"
+
+    def evaluate(self, seg, ctx):
+        from .execute import host_match_mask
+
+        return host_match_mask(self.query, seg, ctx)
+
+
+@dataclass
+class NestedFilter(Filter):
+    path: str
+    inner: Any  # Query or Filter on child docs
+
+    def key(self):
+        return f"nested:{self.path}:{getattr(self.inner, 'key', lambda: repr(self.inner))()}"
+
+    def evaluate(self, seg, ctx):
+        from .execute import child_match_to_parents
+
+        return child_match_to_parents(seg, ctx, self.path, self.inner)[0]
+
+
+@dataclass
+class GeoDistanceFilter(Filter):
+    field: str
+    lat: float
+    lon: float
+    distance_m: float
+
+    def key(self):
+        return f"geodist:{self.field}:{self.lat}:{self.lon}:{self.distance_m}"
+
+    def evaluate(self, seg, ctx):
+        lat_col = seg.dv_num.get(f"{self.field}.lat")
+        lon_col = seg.dv_num.get(f"{self.field}.lon")
+        mask = np.zeros(seg.doc_count, dtype=bool)
+        if lat_col is None or lon_col is None:
+            return mask
+        off, lats = lat_col
+        _, lons = lon_col
+        d = haversine_m(self.lat, self.lon, lats, lons)
+        hit = d <= self.distance_m
+        counts = np.diff(off)
+        doc_of_val = np.repeat(np.arange(seg.doc_count), counts)
+        np.logical_or.at(mask, doc_of_val, hit)
+        return mask
+
+
+@dataclass
+class GeoBoundingBoxFilter(Filter):
+    field: str
+    top: float
+    left: float
+    bottom: float
+    right: float
+
+    def key(self):
+        return f"geobb:{self.field}:{self.top}:{self.left}:{self.bottom}:{self.right}"
+
+    def evaluate(self, seg, ctx):
+        lat_col = seg.dv_num.get(f"{self.field}.lat")
+        lon_col = seg.dv_num.get(f"{self.field}.lon")
+        mask = np.zeros(seg.doc_count, dtype=bool)
+        if lat_col is None or lon_col is None:
+            return mask
+        off, lats = lat_col
+        _, lons = lon_col
+        hit = (lats <= self.top) & (lats >= self.bottom)
+        if self.left <= self.right:
+            hit &= (lons >= self.left) & (lons <= self.right)
+        else:  # crossing the dateline
+            hit &= (lons >= self.left) | (lons <= self.right)
+        counts = np.diff(off)
+        doc_of_val = np.repeat(np.arange(seg.doc_count), counts)
+        np.logical_or.at(mask, doc_of_val, hit)
+        return mask
+
+
+@dataclass
+class ScriptFilter(Filter):
+    script: str
+    params: dict = dc_field(default_factory=dict)
+
+    def key(self):
+        return f"script:{self.script}:{sorted(self.params.items())!r}"
+
+    def evaluate(self, seg, ctx):
+        from ..script import compile_script
+
+        fn = compile_script(self.script, self.params)
+        mask = np.zeros(seg.doc_count, dtype=bool)
+        for local in range(seg.doc_count):
+            if seg.parent_mask[local]:
+                mask[local] = bool(fn(DocAccess(seg, local)))
+        return mask
+
+
+@dataclass
+class RegexpFilter(Filter):
+    field: str
+    pattern: str
+
+    def key(self):
+        return f"regexp:{self.field}:{self.pattern}"
+
+    def evaluate(self, seg, ctx):
+        rex = re.compile(self.pattern)
+        mask = np.zeros(seg.doc_count, dtype=bool)
+        for term in seg.terms_for_field(self.field):
+            if rex.fullmatch(term):
+                mask |= _postings_mask(seg, self.field, term)
+        return mask
+
+
+EARTH_RADIUS_M = 6371008.7714
+
+
+def haversine_m(lat1, lon1, lat2, lon2):
+    la1, lo1 = np.radians(lat1), np.radians(lon1)
+    la2, lo2 = np.radians(lat2), np.radians(lon2)
+    a = np.sin((la2 - la1) / 2) ** 2 + np.cos(la1) * np.cos(la2) * np.sin((lo2 - lo1) / 2) ** 2
+    return 2 * EARTH_RADIUS_M * np.arcsin(np.sqrt(np.clip(a, 0, 1)))
+
+
+_DIST_RE = re.compile(r"^\s*([\d.]+)\s*([a-zA-Z]*)\s*$")
+_DIST_UNITS = {
+    "m": 1.0, "meters": 1.0, "km": 1000.0, "kilometers": 1000.0,
+    "mi": 1609.344, "miles": 1609.344, "yd": 0.9144, "ft": 0.3048,
+    "in": 0.0254, "cm": 0.01, "mm": 0.001, "nmi": 1852.0, "": 1.0,
+}
+
+
+def parse_distance(s) -> float:
+    if isinstance(s, (int, float)):
+        return float(s)
+    m = _DIST_RE.match(str(s))
+    if not m:
+        raise QueryParsingError(f"failed to parse distance [{s}]")
+    return float(m.group(1)) * _DIST_UNITS.get(m.group(2).lower(), 1.0)
+
+
+class DocAccess:
+    """Per-doc field access for scripts: doc['field'].value style."""
+
+    def __init__(self, seg: FrozenSegment, local: int):
+        self.seg = seg
+        self.local = local
+
+    def __getitem__(self, field: str):
+        nums = self.seg.num_values(field, self.local)
+        if len(nums):
+            return FieldVal(list(nums))
+        return FieldVal(self.seg.str_values(field, self.local))
+
+
+class FieldVal:
+    def __init__(self, values: list):
+        self.values = values
+
+    @property
+    def value(self):
+        return self.values[0] if self.values else None
+
+    @property
+    def empty(self):
+        return not self.values
+
+
+def _index_term(ctx, field: str, value) -> str:
+    """How a term/terms filter value maps to an indexed token: not_analyzed fields keep
+    the raw value; analyzed fields take the single analyzed token (ES term filter
+    semantics: no analysis — we mirror that by using the raw value lowercased only when
+    the target field is analyzed with a lowercasing chain is NOT applied — raw match)."""
+    return str(value)

@@ -1,0 +1,154 @@
+"""Similarity (scoring models): Lucene-exact BM25 and classic TF-IDF.
+
+Analogue of index/similarity/ (SURVEY.md §2.3 — "the north-star intercept point"):
+per-field pluggable similarity configured via index settings/mappings, default TF-IDF,
+BM25 opt-in — matching the reference's SimilarityModule (BM25SimilarityProvider.java,
+DefaultSimilarityProvider.java).
+
+Exactness notes (hit-ordering parity, SURVEY.md §7 hard parts):
+- Norms are the byte315-quantized 1/sqrt(fieldLength) — common/smallfloat.py.
+- TF-IDF practical scoring (Lucene TFIDFSimilarity):
+    score(q,d) = coord(q,d) · Σ_t [ tf(freq) · idf(t)² · queryNorm · boost_t · norm(d) ]
+    tf = sqrt(freq); idf = 1 + ln(maxDocs/(docFreq+1));
+    queryNorm = 1/sqrt(Σ (idf·boost)²)  [rank-neutral but computed for score parity]
+    coord = overlap/maxOverlap for bool queries.
+- BM25 (Lucene 4.7 BM25Similarity, k1=1.2 b=0.75):
+    idf = ln(1 + (N - df + 0.5)/(df + 0.5))     [N = maxDoc]
+    tfNorm = freq·(k1+1) / (freq + k1·(1 - b + b·dl/avgdl))
+    avgdl = sumTotalTermFreq/maxDoc;  dl decoded from the 1-byte norm
+    score = Σ_t boost_t · idf_t · tfNorm   (no coord, no queryNorm)
+- All arithmetic float32, matching Lucene's float math.
+
+The similarity exposes two device-friendly artifacts per (field, query): a scalar
+per-term weight and a 256-entry norm-decode table, so the scoring kernel is pure
+gather/FMA — see ops/scoring.py.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..common.smallfloat import NORM_TABLE, decode_norm_doclen
+
+
+@dataclass
+class TermStats:
+    doc_freq: int
+    total_term_freq: int = 0
+
+
+class Similarity:
+    name = "base"
+
+    def term_weight(self, boost: float, df: int, max_docs: int) -> float:
+        raise NotImplementedError
+
+    def norm_cache(self, field_stats, max_docs: int) -> np.ndarray:
+        """256-entry table indexed by the norm byte; meaning is similarity-specific."""
+        raise NotImplementedError
+
+    def needs_coord(self) -> bool:
+        return False
+
+
+class TFIDFSimilarity(Similarity):
+    """Lucene DefaultSimilarity. term weight folds idf² (queryNorm applied separately
+    per query since it spans all terms)."""
+
+    name = "default"
+
+    @staticmethod
+    def idf(df: int, max_docs: int) -> float:
+        return np.float32(1.0 + math.log(max_docs / (df + 1.0)))
+
+    @staticmethod
+    def tf(freq: np.ndarray) -> np.ndarray:
+        return np.sqrt(freq, dtype=np.float32)
+
+    def term_weight(self, boost: float, df: int, max_docs: int) -> float:
+        # idf * boost = query-time weight; squared via the separate queryNorm pipeline:
+        # scorer value = queryWeight * idf = idf² * boost * queryNorm
+        return float(self.idf(df, max_docs) * boost)
+
+    def norm_cache(self, field_stats, max_docs: int) -> np.ndarray:
+        # TF-IDF: decoded norm multiplies the score directly
+        return NORM_TABLE.astype(np.float32)
+
+    def needs_coord(self) -> bool:
+        return True
+
+    @staticmethod
+    def query_norm(sum_sq_weights: float) -> float:
+        if sum_sq_weights <= 0:
+            return 1.0
+        return np.float32(1.0 / math.sqrt(sum_sq_weights))
+
+
+class BM25Similarity(Similarity):
+    name = "BM25"
+
+    def __init__(self, k1: float = 1.2, b: float = 0.75):
+        self.k1 = float(k1)
+        self.b = float(b)
+
+    @staticmethod
+    def idf(df: int, max_docs: int) -> float:
+        return np.float32(math.log(1.0 + (max_docs - df + 0.5) / (df + 0.5)))
+
+    def term_weight(self, boost: float, df: int, max_docs: int) -> float:
+        return float(self.idf(df, max_docs) * boost)
+
+    def norm_cache(self, field_stats, max_docs: int) -> np.ndarray:
+        """cache[b] = k1 * (1 - b + b * dl(byte)/avgdl) — the denominator addend, exactly
+        Lucene BM25Similarity's per-weight norm cache."""
+        sum_ttf = getattr(field_stats, "sum_ttf", 0) if field_stats else 0
+        avgdl = np.float32(1.0) if sum_ttf <= 0 or max_docs <= 0 else np.float32(sum_ttf / max_docs)
+        dl = decode_norm_doclen(np.arange(256, dtype=np.uint8))
+        return (self.k1 * (1.0 - self.b + self.b * dl / avgdl)).astype(np.float32)
+
+
+_REGISTRY = {
+    "default": TFIDFSimilarity,
+    "tfidf": TFIDFSimilarity,
+    "BM25": BM25Similarity,
+    "bm25": BM25Similarity,
+}
+
+
+class SimilarityService:
+    """Per-index similarity resolution (ref: index/similarity/SimilarityService.java):
+    named configs from `index.similarity.<name>.*` settings, per-field override via the
+    mapping's `similarity` key, default from `index.similarity.default.type`."""
+
+    def __init__(self, index_settings=None, mapper_service=None):
+        from ..common.settings import Settings
+
+        settings = index_settings or Settings.EMPTY
+        self.mapper_service = mapper_service
+        self._named: dict[str, Similarity] = {}
+        for name, conf in settings.groups("index.similarity.").items():
+            stype = conf.get_str("type", name)
+            self._named[name] = self._build(stype, conf)
+        self.default: Similarity = self._named.get("default", TFIDFSimilarity())
+
+    @staticmethod
+    def _build(stype: str, conf) -> Similarity:
+        cls = _REGISTRY.get(stype)
+        if cls is None:
+            from ..common.errors import IllegalArgumentError
+
+            raise IllegalArgumentError(f"unknown similarity type [{stype}]")
+        if cls is BM25Similarity:
+            return BM25Similarity(conf.get_float("k1", 1.2), conf.get_float("b", 0.75))
+        return cls()
+
+    def for_field(self, field: str) -> Similarity:
+        if self.mapper_service is not None:
+            ft = self.mapper_service.field_type(field)
+            sim_name = getattr(ft, "similarity", None) if ft else None
+            if sim_name and sim_name in self._named:
+                return self._named[sim_name]
+        return self.default

@@ -7,10 +7,17 @@ devices). Must be set before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-override: the container env pins JAX_PLATFORMS=axon (real TPU via tunnel) and jax
+# is already imported at interpreter startup by the axon sitecustomize hook, so a plain
+# environ set is not enough — update the live jax config too.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -220,7 +220,10 @@ class TestEngine:
         assert seg.doc_count == 3  # 2 children + 1 parent
         assert seg.parent_mask.tolist() == [False, False, True]
         assert e.doc_stats()["count"] == 1  # only parents counted
-        # delete removes the whole block
+        # delete removes the whole block — but copy-on-write: the OLD searcher's
+        # segment keeps its point-in-time live bitmap (Lucene reader semantics)
         e.delete("doc", "1")
         e.refresh()
-        assert not seg.live.any()
+        assert seg.live.all()  # old point-in-time view unchanged
+        new_seg = e.acquire_searcher().segments[0]
+        assert not new_seg.live.any()

@@ -1,0 +1,466 @@
+"""Single-shard search correctness.
+
+The load-bearing test is device-vs-host agreement: every flat-lowerable query must rank
+identically through the fused device kernel (ops/scoring.py) and the dense host scorer
+(search/execute.py HostScorer), and both must match an independent brute-force
+doc-at-a-time scorer written here with Lucene's published formulas.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.common.smallfloat import NORM_TABLE, decode_norm_doclen
+from elasticsearch_tpu.index import Engine
+from elasticsearch_tpu.mapper import MapperService
+from elasticsearch_tpu.search import ShardContext, parse_query, search_shard, search_shard_batch
+from elasticsearch_tpu.search.execute import count_shard
+from elasticsearch_tpu.search.similarity import SimilarityService
+
+DOCS = [
+    "the quick brown fox jumps over the lazy dog",
+    "quick brown foxes leap over lazy dogs in summer",
+    "the red fox and the brown bear",
+    "lazy afternoon with a quick snack",
+    "dogs and cats living together",
+    "the brown dog sleeps all day",
+    "fox",
+    "a a a a a a a a quick",
+    "brown brown brown fox fox quick",
+    "nothing relevant here at all",
+]
+
+
+def build_engine(tmp_path, similarity=None, docs=DOCS):
+    settings = Settings.from_flat(
+        {"index.similarity.default.type": similarity} if similarity else {}
+    )
+    svc = MapperService(settings)
+    e = Engine(str(tmp_path / "shard0"), svc)
+    for i, text in enumerate(docs):
+        e.index("doc", str(i), {"body": text, "num": i})
+        if i % 4 == 3:
+            e.refresh()  # force multiple segments
+    e.refresh()
+    ctx = ShardContext(e.acquire_searcher(), svc,
+                       SimilarityService(settings, mapper_service=svc))
+    return e, ctx
+
+
+def brute_force_scores(ctx, field, terms, similarity):
+    """Independent doc-at-a-time reference: Lucene practical scoring over all docs."""
+    searcher = ctx.searcher
+    max_doc = searcher.max_doc
+    out = {}
+    if similarity == "BM25":
+        stats = searcher.field_stats(field)
+        avgdl = stats.sum_ttf / max_doc
+        for seg, base in zip(searcher.segments, searcher.bases):
+            dl = decode_norm_doclen(seg.norms[field])
+            for t in terms:
+                df = searcher.doc_freq(field, t)
+                if df == 0:
+                    continue
+                idf = math.log(1.0 + (max_doc - df + 0.5) / (df + 0.5))
+                docs, freqs = seg.postings(field, t)
+                for d, f in zip(docs, freqs):
+                    if not (seg.live[d] and seg.parent_mask[d]):
+                        continue
+                    tfn = f * (1.2 + 1.0) / (f + 1.2 * (1 - 0.75 + 0.75 * dl[d] / avgdl))
+                    out[base + int(d)] = out.get(base + int(d), 0.0) + np.float32(idf * tfn)
+    else:
+        idfs = {}
+        for t in terms:
+            df = searcher.doc_freq(field, t)
+            if df > 0:
+                idfs[t] = 1.0 + math.log(max_doc / (df + 1.0))
+        ssw = sum(v * v for v in idfs.values())
+        qn = 1.0 / math.sqrt(ssw) if ssw > 0 else 1.0
+        matched_terms = {}
+        for seg, base in zip(searcher.segments, searcher.bases):
+            norms = NORM_TABLE[seg.norms[field]]
+            for t, idf in idfs.items():
+                docs, freqs = seg.postings(field, t)
+                for d, f in zip(docs, freqs):
+                    if not (seg.live[d] and seg.parent_mask[d]):
+                        continue
+                    g = base + int(d)
+                    out[g] = out.get(g, 0.0) + np.float32(
+                        idf * idf * qn * math.sqrt(f) * norms[d])
+                    matched_terms[g] = matched_terms.get(g, 0) + 1
+        if len(terms) > 1:  # coord
+            for g in out:
+                out[g] = np.float32(out[g] * matched_terms[g] / len([t for t in terms]))
+    return out
+
+
+def ranked(scores: dict, k=10):
+    return sorted(scores.items(), key=lambda kv: (-kv[1], kv[0]))[:k]
+
+
+def assert_hits_equivalent(a, b, rtol=3e-6):
+    """Device vs host hit-list equivalence: scores within a few ulps (XLA's f32 division
+    is reciprocal-based, ±1-2 ulp vs IEEE numpy/Java — see ops/scoring.py), ordering
+    identical except swaps among sub-ulp near-ties."""
+    assert len(a) == len(b), (a, b)
+    for i, ((sa, da), (sb, db)) in enumerate(zip(a, b)):
+        assert sa == pytest.approx(sb, rel=rtol, abs=1e-7), (i, a, b)
+        if da != db:
+            # permitted only if this is a near-tie neighborhood swap
+            others = {d for s, d in b if abs(s - sa) <= rtol * max(abs(sa), 1e-30) + 1e-7}
+            assert da in others, (i, a, b)
+
+
+@pytest.mark.parametrize("similarity", [None, "BM25"])
+class TestScoringParity:
+    def test_match_single_term(self, tmp_path, similarity):
+        e, ctx = build_engine(tmp_path, similarity)
+        q = parse_query({"match": {"body": "fox"}})
+        device = search_shard(ctx, q, 10, use_device=True)
+        host = search_shard(ctx, q, 10, use_device=False)
+        assert_hits_equivalent(device.hits, host.hits)
+        assert device.total == host.total
+        ref = ranked(brute_force_scores(ctx, "body", ["fox"], similarity or "default"))
+        assert [d for _, d in host.hits] == [d for d, _ in ref]
+        np.testing.assert_allclose([s for s, _ in host.hits], [s for _, s in ref], rtol=1e-6)
+
+    def test_match_multi_term_or(self, tmp_path, similarity):
+        e, ctx = build_engine(tmp_path, similarity)
+        q = parse_query({"match": {"body": "quick brown fox"}})
+        device = search_shard(ctx, q, 10, use_device=True)
+        host = search_shard(ctx, q, 10, use_device=False)
+        assert_hits_equivalent(device.hits, host.hits)
+        ref = ranked(brute_force_scores(ctx, "body", ["quick", "brown", "fox"],
+                                        similarity or "default"))
+        assert [d for _, d in host.hits] == [d for d, _ in ref]
+        np.testing.assert_allclose([s for s, _ in host.hits], [s for _, s in ref], rtol=1e-6)
+
+    def test_match_and_operator(self, tmp_path, similarity):
+        e, ctx = build_engine(tmp_path, similarity)
+        q = parse_query({"match": {"body": {"query": "quick brown", "operator": "and"}}})
+        device = search_shard(ctx, q, 10, use_device=True)
+        host = search_shard(ctx, q, 10, use_device=False)
+        assert_hits_equivalent(device.hits, host.hits)
+        # only docs with BOTH terms
+        for _, d in device.hits:
+            seg, local = ctx.searcher.resolve(d)
+            body = seg.stored[local]["body"]
+            assert "quick" in body and "brown" in body
+
+    def test_bool_must_should_must_not(self, tmp_path, similarity):
+        e, ctx = build_engine(tmp_path, similarity)
+        q = parse_query({"bool": {
+            "must": [{"term": {"body": "brown"}}],
+            "should": [{"term": {"body": "quick"}}, {"term": {"body": "fox"}}],
+            "must_not": [{"term": {"body": "bear"}}],
+        }})
+        device = search_shard(ctx, q, 10, use_device=True)
+        host = search_shard(ctx, q, 10, use_device=False)
+        assert_hits_equivalent(device.hits, host.hits)
+        assert device.total == host.total
+        for _, d in device.hits:
+            seg, local = ctx.searcher.resolve(d)
+            body = seg.stored[local]["body"]
+            assert "brown" in body and "bear" not in body
+
+    def test_minimum_should_match(self, tmp_path, similarity):
+        e, ctx = build_engine(tmp_path, similarity)
+        q = parse_query({"bool": {
+            "should": [{"term": {"body": "quick"}}, {"term": {"body": "brown"}},
+                       {"term": {"body": "fox"}}],
+            "minimum_should_match": 2,
+        }})
+        device = search_shard(ctx, q, 10, use_device=True)
+        host = search_shard(ctx, q, 10, use_device=False)
+        assert_hits_equivalent(device.hits, host.hits)
+        for _, d in device.hits:
+            seg, local = ctx.searcher.resolve(d)
+            body = seg.stored[local]["body"]
+            assert sum(t in body.split() or t + "s" in body or t in body
+                       for t in ("quick", "brown", "fox")) >= 2
+
+    def test_batch_matches_single(self, tmp_path, similarity):
+        e, ctx = build_engine(tmp_path, similarity)
+        queries = [
+            parse_query({"match": {"body": "fox"}}),
+            parse_query({"match": {"body": "lazy dog"}}),
+            parse_query({"match": {"body": {"query": "brown fox", "operator": "and"}}}),
+            parse_query({"term": {"body": "quick"}}),
+        ]
+        batch = search_shard_batch(ctx, queries, 10)
+        for q, td in zip(queries, batch):
+            single = search_shard(ctx, q, 10, use_device=False)
+            assert_hits_equivalent(td.hits, single.hits)
+
+
+class TestQueryTypes:
+    def test_term_vs_match_all_count(self, tmp_path):
+        e, ctx = build_engine(tmp_path)
+        assert search_shard(ctx, parse_query({"match_all": {}}), 20).total == len(DOCS)
+        assert count_shard(ctx, parse_query({"match_all": {}})) == len(DOCS)
+
+    def test_phrase(self, tmp_path):
+        e, ctx = build_engine(tmp_path)
+        td = search_shard(ctx, parse_query({"match_phrase": {"body": "quick brown"}}), 10)
+        found = set()
+        for _, d in td.hits:
+            seg, local = ctx.searcher.resolve(d)
+            found.add(seg.stored[local]["body"])
+            assert "quick brown" in seg.stored[local]["body"]
+        assert len(found) == 2  # docs 0 and 1
+
+    def test_phrase_with_slop(self, tmp_path):
+        e, ctx = build_engine(
+            tmp_path, docs=["the quick fox brown", "quick brown", "brown quick"])
+        td0 = search_shard(ctx, parse_query(
+            {"match_phrase": {"body": {"query": "quick brown", "slop": 0}}}), 10)
+        td2 = search_shard(ctx, parse_query(
+            {"match_phrase": {"body": {"query": "quick brown", "slop": 2}}}), 10)
+        assert td0.total == 1
+        assert td2.total >= 2
+
+    def test_prefix_wildcard_fuzzy(self, tmp_path):
+        e, ctx = build_engine(tmp_path)
+        # "fox" in docs 0,2,6,8; "foxes" in doc 1
+        assert search_shard(ctx, parse_query({"prefix": {"body": "fo"}}), 10).total == 5
+        assert search_shard(ctx, parse_query({"wildcard": {"body": "f*x"}}), 10).total == 4
+        assert search_shard(ctx, parse_query({"fuzzy": {"body": "foxs"}}), 10).total == 5
+        assert search_shard(ctx, parse_query({"regexp": {"body": "fox(es)?"}}), 10).total == 5
+
+    def test_range_on_numeric(self, tmp_path):
+        e, ctx = build_engine(tmp_path)
+        td = search_shard(ctx, parse_query({"range": {"num": {"gte": 3, "lt": 6}}}), 10)
+        assert td.total == 3
+        assert {d for _, d in td.hits} == {
+            next(g for g in [b + l for (seg, b) in zip(ctx.searcher.segments, ctx.searcher.bases)
+                             for l in range(seg.doc_count) if seg.ids[l] == str(i)])
+            for i in (3, 4, 5)
+        }
+
+    def test_filtered_query(self, tmp_path):
+        e, ctx = build_engine(tmp_path)
+        q = parse_query({"filtered": {
+            "query": {"match": {"body": "fox"}},
+            "filter": {"range": {"num": {"lte": 2}}},
+        }})
+        td = search_shard(ctx, q, 10)
+        assert td.total == 2  # docs 0 and 2 have "fox" and num<=2
+        # scores preserved from the inner query (filter doesn't score)
+        unfiltered = search_shard(ctx, parse_query({"match": {"body": "fox"}}), 10,
+                                  use_device=False)
+        scores = {d: s for s, d in unfiltered.hits}
+        for s, d in td.hits:
+            assert s == pytest.approx(scores[d], rel=1e-6)
+
+    def test_ids_and_terms(self, tmp_path):
+        e, ctx = build_engine(tmp_path)
+        td = search_shard(ctx, parse_query({"ids": {"values": ["1", "3"]}}), 10)
+        assert td.total == 2
+        td = search_shard(ctx, parse_query({"terms": {"body": ["bear", "cats"]}}), 10)
+        assert td.total == 2
+
+    def test_constant_score_and_bool_filter(self, tmp_path):
+        e, ctx = build_engine(tmp_path)
+        td = search_shard(ctx, parse_query(
+            {"constant_score": {"filter": {"term": {"body": "fox"}}, "boost": 3.0}}), 10)
+        assert td.total == 4
+        # Lucene semantics: standalone constant_score scores 1.0 — TF-IDF queryNorm
+        # (1/sqrt(boost²)) cancels the boost; boost matters only relative to siblings
+        assert all(s == pytest.approx(1.0) for s, _ in td.hits)
+
+    def test_dis_max(self, tmp_path):
+        # BM25 has no queryNorm, so sub-query scores compose without cross-clause
+        # normalization — comparable against standalone term queries
+        e, ctx = build_engine(tmp_path, similarity="BM25")
+        q = parse_query({"dis_max": {
+            "queries": [{"term": {"body": "fox"}}, {"term": {"body": "dog"}}],
+            "tie_breaker": 0.5,
+        }})
+        td = search_shard(ctx, q, 10, use_device=False)
+        t_fox = {d: s for s, d in search_shard(ctx, parse_query({"term": {"body": "fox"}}),
+                                               10, use_device=False).hits}
+        t_dog = {d: s for s, d in search_shard(ctx, parse_query({"term": {"body": "dog"}}),
+                                               10, use_device=False).hits}
+        for s, d in td.hits:
+            f, g = t_fox.get(d, 0.0), t_dog.get(d, 0.0)
+            expect = max(f, g) + 0.5 * (f + g - max(f, g))
+            assert s == pytest.approx(expect, rel=1e-5)
+
+    def test_query_string(self, tmp_path):
+        e, ctx = build_engine(tmp_path)
+        td = search_shard(ctx, parse_query(
+            {"query_string": {"query": "body:fox AND body:brown"}}), 10)
+        assert td.total == 3  # docs 0, 2, 8 contain both terms
+        td = search_shard(ctx, parse_query(
+            {"query_string": {"query": "fox -bear", "default_field": "body"}}), 10)
+        for _, d in td.hits:
+            seg, local = ctx.searcher.resolve(d)
+            assert "bear" not in seg.stored[local]["body"]
+
+    def test_exists_missing(self, tmp_path):
+        svc = MapperService()
+        e = Engine(str(tmp_path / "em"), svc)
+        e.index("doc", "1", {"a": "x", "b": 1})
+        e.index("doc", "2", {"a": "y"})
+        e.refresh()
+        ctx = ShardContext(e.acquire_searcher(), svc)
+        assert search_shard(ctx, parse_query(
+            {"constant_score": {"filter": {"exists": {"field": "b"}}}}), 10).total == 1
+        assert search_shard(ctx, parse_query(
+            {"constant_score": {"filter": {"missing": {"field": "b"}}}}), 10).total == 1
+
+    def test_deleted_docs_excluded(self, tmp_path):
+        e, ctx = build_engine(tmp_path)
+        e.delete("doc", "6")  # the bare "fox" doc
+        e.refresh()
+        ctx2 = ShardContext(e.acquire_searcher(), ctx.mapper_service, ctx.similarity_service)
+        for use_device in (True, False):
+            td = search_shard(ctx2, parse_query({"match": {"body": "fox"}}), 10,
+                              use_device=use_device)
+            assert td.total == 3
+            for _, d in td.hits:
+                seg, local = ctx2.searcher.resolve(d)
+                assert seg.ids[local] != "6"
+
+
+class TestFunctionScore:
+    def test_field_value_factor(self, tmp_path):
+        e, ctx = build_engine(tmp_path)
+        q = parse_query({"function_score": {
+            "query": {"match": {"body": "fox"}},
+            "field_value_factor": {"field": "num", "factor": 2.0},
+            "boost_mode": "replace",
+        }})
+        td = search_shard(ctx, q, 10)
+        for s, d in td.hits:
+            seg, local = ctx.searcher.resolve(d)
+            assert s == pytest.approx(2.0 * seg.num_values("num", local)[0])
+
+    def test_gauss_decay(self, tmp_path):
+        e, ctx = build_engine(tmp_path)
+        q = parse_query({"function_score": {
+            "query": {"match_all": {}},
+            "gauss": {"num": {"origin": 0, "scale": 5}},
+            "boost_mode": "replace",
+        }})
+        td = search_shard(ctx, q, 10)
+        for s, d in td.hits:
+            seg, local = ctx.searcher.resolve(d)
+            v = seg.num_values("num", local)[0]
+            sigma2 = -(5.0 ** 2) / (2.0 * math.log(0.5))
+            assert s == pytest.approx(math.exp(-(v ** 2) / (2 * sigma2)), rel=1e-5)
+
+    def test_script_score(self, tmp_path):
+        e, ctx = build_engine(tmp_path)
+        q = parse_query({"function_score": {
+            "query": {"match": {"body": "fox"}},
+            "script_score": {"script": "_score * doc['num'].value + 1"},
+            "boost_mode": "replace",
+        }})
+        td = search_shard(ctx, q, 10)
+        base = {d: s for s, d in search_shard(
+            ctx, parse_query({"match": {"body": "fox"}}), 10, use_device=False).hits}
+        for s, d in td.hits:
+            seg, local = ctx.searcher.resolve(d)
+            assert s == pytest.approx(base[d] * seg.num_values("num", local)[0] + 1, rel=1e-5)
+
+
+class TestNested:
+    def test_nested_query(self, tmp_path):
+        svc = MapperService()
+        svc.put_mapping("doc", {"properties": {
+            "comments": {"type": "nested", "properties": {
+                "text": {"type": "string"}, "stars": {"type": "long"}}}}})
+        e = Engine(str(tmp_path / "nested"), svc)
+        e.index("doc", "1", {"title": "post one",
+                             "comments": [{"text": "great stuff", "stars": 5},
+                                          {"text": "terrible", "stars": 1}]})
+        e.index("doc", "2", {"title": "post two",
+                             "comments": [{"text": "mediocre stuff", "stars": 3}]})
+        e.index("doc", "3", {"title": "no comments"})
+        e.refresh()
+        ctx = ShardContext(e.acquire_searcher(), svc)
+        td = search_shard(ctx, parse_query({"nested": {
+            "path": "comments",
+            "query": {"match": {"comments.text": "stuff"}}}}), 10)
+        ids = set()
+        for _, d in td.hits:
+            seg, local = ctx.searcher.resolve(d)
+            ids.add(seg.ids[local])
+        assert ids == {"1", "2"}
+        # nested filter inside bool
+        td = search_shard(ctx, parse_query({"bool": {
+            "must": [{"match_all": {}}],
+            "filter": [{"nested": {"path": "comments",
+                                   "query": {"range": {"comments.stars": {"gte": 4}}}}}],
+        }}), 10)
+        assert td.total == 1
+
+
+class TestEdgeCases:
+    """Regressions found by end-to-end probing."""
+
+    def test_empty_match_text_returns_no_hits(self, tmp_path):
+        e, ctx = build_engine(tmp_path)
+        for use_device in (True, False):
+            td = search_shard(ctx, parse_query({"match": {"body": ""}}), 5,
+                              use_device=use_device)
+            assert td.total == 0 and td.hits == []
+
+    def test_msm_exceeding_clause_count_matches_nothing(self, tmp_path):
+        e, ctx = build_engine(tmp_path)
+        q = parse_query({"bool": {"should": [{"term": {"body": "fox"}}],
+                                  "minimum_should_match": 5}})
+        for use_device in (True, False):
+            assert search_shard(ctx, q, 5, use_device=use_device).total == 0
+
+    def test_must_not_only_bool_matches_non_excluded(self, tmp_path):
+        e, ctx = build_engine(tmp_path)
+        q = parse_query({"bool": {"must_not": [{"term": {"body": "fox"}}]}})
+        for use_device in (True, False):
+            td = search_shard(ctx, q, 20, use_device=use_device)
+            assert td.total == len(DOCS) - 4  # docs 0,2,6,8 contain "fox"
+
+    def test_nested_filter_only_syntax(self, tmp_path):
+        svc = MapperService()
+        svc.put_mapping("doc", {"properties": {
+            "c": {"type": "nested", "properties": {"x": {"type": "string"}}}}})
+        e = Engine(str(tmp_path / "nf"), svc)
+        e.index("doc", "1", {"c": [{"x": "present"}]})
+        e.index("doc", "2", {"c": [{"y": "other"}]})
+        e.refresh()
+        ctx = ShardContext(e.acquire_searcher(), svc)
+        td = search_shard(ctx, parse_query(
+            {"nested": {"path": "c", "filter": {"exists": {"field": "c.x"}}}}), 10)
+        assert td.total == 1
+
+    def test_delete_by_query_survives_restart(self, tmp_path):
+        svc = MapperService()
+        e = Engine(str(tmp_path / "dbq"), svc)
+        e.index("doc", "1", {"t": "remove me"})
+        e.index("doc", "2", {"t": "keep me"})
+        e.refresh()
+        e.delete_by_uids(["doc#1"], query={"match": {"t": "remove"}})
+        e.refresh()
+        assert e.doc_stats()["count"] == 1
+        e.translog.sync()
+        e.close()
+        e2 = Engine(str(tmp_path / "dbq"), svc)
+        e2.recover_from_store()
+        assert e2.doc_stats()["count"] == 1  # deleted doc must NOT resurrect
+        assert not e2.get("doc", "1").found
+
+    def test_optimize_then_crash_recovers(self, tmp_path):
+        svc = MapperService()
+        e = Engine(str(tmp_path / "oc"), svc)
+        for i in range(4):
+            e.index("doc", str(i), {"t": f"word{i}"})
+            e.refresh()
+        e.flush()
+        e.optimize()  # must write a new commit before deleting old segment files
+        e.close()     # simulate crash-without-flush after optimize
+        e2 = Engine(str(tmp_path / "oc"), svc)
+        e2.recover_from_store()
+        assert e2.doc_stats()["count"] == 4
