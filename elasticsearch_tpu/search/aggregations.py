@@ -1,0 +1,876 @@
+"""Aggregations: collector-tree framework + metrics/bucket implementations.
+
+Analogue of search/aggregations/ (17k LoC — SURVEY.md §2.5): every aggregation defines a
+map-side collect over one segment's matching docs and a reduce-side merge of partial
+results — exactly the shape the reference uses (Aggregator / InternalAggregation) and
+exactly what distributes over shards as a collective reduce (SURVEY.md §5.7 "shard-level
+parallel reduce of aggregations").
+
+Implemented (registered like AggregationModule.java:54-73):
+  metrics : avg, sum, min, max, stats, extended_stats, value_count, cardinality,
+            percentiles, top_hits (single-shard), geo_bounds
+  buckets : terms, range, date_range, ip_range, histogram, date_histogram, filter,
+            filters, global, missing, nested, significant_terms (simplified scoring),
+            geo_distance
+Sub-aggregations nest arbitrarily (bucket → mask → child collect).
+
+Collect is vectorized numpy over columnar doc values (the fielddata analogue); the
+hot single-valued numeric cases (sum/avg/min/max/histogram) read the same columns the
+device keeps in PackedSegment.dv_single, so a later round can lower whole agg trees to
+segment_sum on device without changing this API.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import numpy as np
+
+from ..common.errors import QueryParsingError
+from ..mapper.core import parse_date_math
+from .filters import haversine_m, parse_distance, segment_mask
+from .queries import parse_filter, parse_query
+
+# ---------------------------------------------------------------------------
+# framework
+# ---------------------------------------------------------------------------
+
+
+class Agg:
+    """One aggregation node: collect(seg, ctx, mask) -> partial; merge(partials) ->
+    reduced; finalize(reduced) -> response dict."""
+
+    def __init__(self, name: str, spec: dict, subs: "dict[str, Agg] | None" = None):
+        self.name = name
+        self.spec = spec
+        self.subs = subs or {}
+
+    def collect(self, seg, ctx, mask: np.ndarray, scores: np.ndarray | None = None):
+        raise NotImplementedError
+
+    def merge(self, partials: list):
+        raise NotImplementedError
+
+    def finalize(self, merged) -> dict:
+        raise NotImplementedError
+
+    # helpers ---------------------------------------------------------------
+    def _collect_subs(self, seg, ctx, mask, scores=None) -> dict:
+        return {n: a.collect(seg, ctx, mask, scores) for n, a in self.subs.items()}
+
+    def _merge_subs(self, partial_list: list[dict]) -> dict:
+        return {
+            n: a.merge([p[n] for p in partial_list]) for n, a in self.subs.items()
+        }
+
+    def _finalize_subs(self, merged: dict) -> dict:
+        return {n: a.finalize(merged[n]) for n, a in self.subs.items()}
+
+
+def parse_aggs(spec: dict) -> dict[str, Agg]:
+    out: dict[str, Agg] = {}
+    for name, body in (spec or {}).items():
+        subs_spec = body.get("aggs") or body.get("aggregations") or {}
+        subs = parse_aggs(subs_spec)
+        kinds = [k for k in body if k not in ("aggs", "aggregations", "meta")]
+        if len(kinds) != 1:
+            raise QueryParsingError(f"aggregation [{name}] must have exactly one type")
+        kind = kinds[0]
+        cls = _AGG_REGISTRY.get(kind)
+        if cls is None:
+            raise QueryParsingError(f"unknown aggregation type [{kind}]")
+        out[name] = cls(name, body[kind], subs)
+    return out
+
+
+def run_aggs(aggs: dict[str, Agg], seg_masks: list, ctx) -> list[dict]:
+    """Collect partials per segment: seg_masks = [(seg, mask, scores)]."""
+    partials = []
+    for seg, mask, scores in seg_masks:
+        partials.append({n: a.collect(seg, ctx, mask, scores) for n, a in aggs.items()})
+    return partials
+
+
+def reduce_aggs(aggs: dict[str, Agg], partial_list: list[dict]) -> dict:
+    """Merge partials (across segments AND shards — same operation) + finalize."""
+    return {
+        n: a.finalize(a.merge([p[n] for p in partial_list])) for n, a in aggs.items()
+    }
+
+
+def _field_values(seg, field: str, mask: np.ndarray):
+    """(doc_idx_per_value, values) for numeric columns restricted to mask."""
+    col = seg.dv_num.get(field)
+    if col is None:
+        return np.zeros(0, np.int64), np.zeros(0)
+    off, vals = col
+    counts = np.diff(off)
+    doc_of_val = np.repeat(np.arange(seg.doc_count), counts)
+    sel = mask[doc_of_val]
+    return doc_of_val[sel], vals[sel]
+
+
+def _str_values(seg, field: str, mask: np.ndarray):
+    col = seg.dv_str.get(field)
+    if col is None:
+        return np.zeros(0, np.int64), []
+    uniq, off, ords = col
+    counts = np.diff(off)
+    doc_of_val = np.repeat(np.arange(seg.doc_count), counts)
+    sel = mask[doc_of_val]
+    return doc_of_val[sel], [uniq[o] for o in ords[sel]]
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+class _NumericAgg(Agg):
+    def _values(self, seg, ctx, mask):
+        field = self.spec.get("field")
+        vals: np.ndarray
+        if field:
+            _, vals = _field_values(seg, field, mask)
+        else:
+            script = self.spec.get("script")
+            if not script:
+                raise QueryParsingError(f"agg [{self.name}] requires field or script")
+            from ..script import compile_script
+            from .filters import DocAccess
+
+            fn = compile_script(script, self.spec.get("params", {}))
+            vals = np.asarray([
+                float(fn(DocAccess(seg, int(d)))) for d in np.nonzero(mask)[0]
+            ])
+        return vals
+
+
+class SumAgg(_NumericAgg):
+    def collect(self, seg, ctx, mask, scores=None):
+        return float(self._values(seg, ctx, mask).sum())
+
+    def merge(self, partials):
+        return float(sum(partials))
+
+    def finalize(self, merged):
+        return {"value": merged}
+
+
+class AvgAgg(_NumericAgg):
+    def collect(self, seg, ctx, mask, scores=None):
+        v = self._values(seg, ctx, mask)
+        return (float(v.sum()), int(len(v)))
+
+    def merge(self, partials):
+        return (sum(p[0] for p in partials), sum(p[1] for p in partials))
+
+    def finalize(self, merged):
+        s, c = merged
+        return {"value": (s / c) if c else None}
+
+
+class MinAgg(_NumericAgg):
+    def collect(self, seg, ctx, mask, scores=None):
+        v = self._values(seg, ctx, mask)
+        return float(v.min()) if len(v) else None
+
+    def merge(self, partials):
+        vals = [p for p in partials if p is not None]
+        return min(vals) if vals else None
+
+    def finalize(self, merged):
+        return {"value": merged}
+
+
+class MaxAgg(_NumericAgg):
+    def collect(self, seg, ctx, mask, scores=None):
+        v = self._values(seg, ctx, mask)
+        return float(v.max()) if len(v) else None
+
+    def merge(self, partials):
+        vals = [p for p in partials if p is not None]
+        return max(vals) if vals else None
+
+    def finalize(self, merged):
+        return {"value": merged}
+
+
+class ValueCountAgg(_NumericAgg):
+    def collect(self, seg, ctx, mask, scores=None):
+        field = self.spec.get("field")
+        if field and field in seg.dv_str:
+            _, vals = _str_values(seg, field, mask)
+            return len(vals)
+        return int(len(self._values(seg, ctx, mask)))
+
+    def merge(self, partials):
+        return int(sum(partials))
+
+    def finalize(self, merged):
+        return {"value": merged}
+
+
+class StatsAgg(_NumericAgg):
+    def collect(self, seg, ctx, mask, scores=None):
+        v = self._values(seg, ctx, mask)
+        if not len(v):
+            return (0, 0.0, None, None, 0.0)
+        return (int(len(v)), float(v.sum()), float(v.min()), float(v.max()),
+                float((v * v).sum()))
+
+    def merge(self, partials):
+        count = sum(p[0] for p in partials)
+        total = sum(p[1] for p in partials)
+        mins = [p[2] for p in partials if p[2] is not None]
+        maxs = [p[3] for p in partials if p[3] is not None]
+        sq = sum(p[4] for p in partials)
+        return (count, total, min(mins) if mins else None, max(maxs) if maxs else None, sq)
+
+    def finalize(self, merged):
+        count, total, mn, mx, _sq = merged
+        return {
+            "count": count, "sum": total, "min": mn, "max": mx,
+            "avg": (total / count) if count else None,
+        }
+
+
+class ExtendedStatsAgg(StatsAgg):
+    def finalize(self, merged):
+        count, total, mn, mx, sq = merged
+        out = {
+            "count": count, "sum": total, "min": mn, "max": mx,
+            "avg": (total / count) if count else None,
+            "sum_of_squares": sq,
+        }
+        if count:
+            variance = sq / count - (total / count) ** 2
+            out["variance"] = variance
+            out["std_deviation"] = math.sqrt(max(variance, 0.0))
+        else:
+            out["variance"] = None
+            out["std_deviation"] = None
+        return out
+
+
+class CardinalityAgg(Agg):
+    """Exact distinct count via value sets (the reference uses HyperLogLog++ for
+    bounded memory; exact is strictly more accurate at these scales, flagged for a
+    sketch swap when fields exceed the precision_threshold)."""
+
+    def collect(self, seg, ctx, mask, scores=None):
+        field = self.spec.get("field")
+        out: set = set()
+        if field in seg.dv_str:
+            _, vals = _str_values(seg, field, mask)
+            out.update(vals)
+        else:
+            _, vals = _field_values(seg, field, mask)
+            out.update(vals.tolist())
+        return out
+
+    def merge(self, partials):
+        out: set = set()
+        for p in partials:
+            out |= p
+        return out
+
+    def finalize(self, merged):
+        return {"value": len(merged)}
+
+
+class PercentilesAgg(_NumericAgg):
+    DEFAULT_PERCENTS = (1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0)
+
+    def collect(self, seg, ctx, mask, scores=None):
+        return self._values(seg, ctx, mask)
+
+    def merge(self, partials):
+        arrs = [p for p in partials if len(p)]
+        return np.concatenate(arrs) if arrs else np.zeros(0)
+
+    def finalize(self, merged):
+        percents = self.spec.get("percents", list(self.DEFAULT_PERCENTS))
+        values = {}
+        for p in percents:
+            values[f"{float(p)}"] = (
+                float(np.percentile(merged, p)) if len(merged) else None
+            )
+        return {"values": values}
+
+
+class TopHitsAgg(Agg):
+    def collect(self, seg, ctx, mask, scores=None):
+        size = int(self.spec.get("size", 3))
+        idx = np.nonzero(mask)[0]
+        s = scores[idx] if scores is not None else np.zeros(len(idx), np.float32)
+        order = np.lexsort((idx, -s))[:size]
+        return [
+            {"_id": seg.ids[int(idx[i])], "_type": seg.types[int(idx[i])],
+             "_score": float(s[i]), "_source": seg.stored[int(idx[i])]}
+            for i in order
+        ]
+
+    def merge(self, partials):
+        size = int(self.spec.get("size", 3))
+        all_hits = [h for p in partials for h in p]
+        all_hits.sort(key=lambda h: (-h["_score"], h["_id"]))
+        return all_hits[:size]
+
+    def finalize(self, merged):
+        return {"hits": {"total": len(merged), "hits": merged}}
+
+
+class GeoBoundsAgg(Agg):
+    def collect(self, seg, ctx, mask, scores=None):
+        field = self.spec.get("field")
+        _, lats = _field_values(seg, f"{field}.lat", mask)
+        _, lons = _field_values(seg, f"{field}.lon", mask)
+        if not len(lats):
+            return None
+        return (float(lats.max()), float(lons.min()), float(lats.min()), float(lons.max()))
+
+    def merge(self, partials):
+        ps = [p for p in partials if p is not None]
+        if not ps:
+            return None
+        return (max(p[0] for p in ps), min(p[1] for p in ps),
+                min(p[2] for p in ps), max(p[3] for p in ps))
+
+    def finalize(self, merged):
+        if merged is None:
+            return {}
+        top, left, bottom, right = merged
+        return {"bounds": {"top_left": {"lat": top, "lon": left},
+                           "bottom_right": {"lat": bottom, "lon": right}}}
+
+
+# ---------------------------------------------------------------------------
+# buckets
+# ---------------------------------------------------------------------------
+
+
+class _BucketAgg(Agg):
+    """Buckets = named doc masks; sub-aggs collect within each bucket mask."""
+
+    def _bucket_partial(self, seg, ctx, key, mask, scores):
+        return {
+            "key": key,
+            "doc_count": int(mask.sum()),
+            "subs": self._collect_subs(seg, ctx, mask, scores),
+        }
+
+    def _merge_buckets(self, partial_list: list[list[dict]], key_order=None):
+        by_key: dict = {}
+        for partial in partial_list:
+            for b in partial:
+                e = by_key.setdefault(b["key"], {"key": b["key"], "doc_count": 0, "subs": []})
+                e["doc_count"] += b["doc_count"]
+                e["subs"].append(b["subs"])
+        for e in by_key.values():
+            e["subs"] = self._merge_subs(e["subs"]) if e["subs"] else {}
+        return by_key
+
+    def _finalize_bucket(self, e: dict, key_name: str = "key") -> dict:
+        out = {key_name: e["key"], "doc_count": e["doc_count"]}
+        out.update(self._finalize_subs(e["subs"]))
+        return out
+
+
+class TermsAgg(_BucketAgg):
+    def collect(self, seg, ctx, mask, scores=None):
+        field = self.spec.get("field")
+        buckets = []
+        if field in seg.dv_str:
+            docs, vals = _str_values(seg, field, mask)
+            by_term: dict[str, list[int]] = {}
+            for d, v in zip(docs, vals):
+                by_term.setdefault(v, []).append(int(d))
+        else:
+            docs, nvals = _field_values(seg, field, mask)
+            by_term = {}
+            for d, v in zip(docs, nvals):
+                key = int(v) if float(v).is_integer() else float(v)
+                by_term.setdefault(key, []).append(int(d))
+        for term, doc_list in by_term.items():
+            bmask = np.zeros(seg.doc_count, dtype=bool)
+            bmask[doc_list] = True
+            bmask &= mask
+            buckets.append(self._bucket_partial(seg, ctx, term, bmask, scores))
+        return buckets
+
+    def merge(self, partials):
+        return self._merge_buckets(partials)
+
+    def finalize(self, merged):
+        size = int(self.spec.get("size", 10) or 0) or len(merged)
+        order_spec = self.spec.get("order", {"_count": "desc"})
+        (okey, odir), = order_spec.items() if isinstance(order_spec, dict) else [("_count", "desc")]
+        reverse = str(odir).lower() == "desc"
+        entries = list(merged.values())
+        if okey == "_count":
+            # secondary key: term ascending (stable tiebreak like the reference)
+            entries.sort(key=lambda e: e["key"])
+            entries.sort(key=lambda e: e["doc_count"], reverse=reverse)
+        elif okey in ("_term", "_key"):
+            entries.sort(key=lambda e: e["key"], reverse=reverse)
+        else:
+            # order by sub-agg value, e.g. "avg_price" or "stats.max"
+            path = okey.split(".")
+
+            def subval(e):
+                sub = self.subs.get(path[0])
+                if sub is None:
+                    return float("-inf")
+                d = sub.finalize(e["subs"][path[0]])
+                v = d.get(path[1]) if len(path) > 1 else d.get("value")
+                return v if v is not None else float("-inf")
+
+            entries.sort(key=subval, reverse=reverse)
+        min_count = int(self.spec.get("min_doc_count", 1))
+        entries = [e for e in entries if e["doc_count"] >= min_count]
+        return {"buckets": [self._finalize_bucket(e) for e in entries[:size]]}
+
+
+class RangeAgg(_BucketAgg):
+    key_is_date = False
+
+    def _convert(self, v):
+        if v is None:
+            return None
+        if self.key_is_date and isinstance(v, str):
+            return float(parse_date_math(v))
+        return float(v)
+
+    def collect(self, seg, ctx, mask, scores=None):
+        field = self.spec.get("field")
+        docs, vals = _field_values(seg, field, mask)
+        buckets = []
+        for r in self.spec.get("ranges", []):
+            frm = self._convert(r.get("from"))
+            to = self._convert(r.get("to"))
+            sel = np.ones(len(vals), dtype=bool)
+            if frm is not None:
+                sel &= vals >= frm
+            if to is not None:
+                sel &= vals < to
+            bmask = np.zeros(seg.doc_count, dtype=bool)
+            bmask[docs[sel]] = True
+            bmask &= mask
+            key = r.get("key") or f"{r.get('from', '*')}-{r.get('to', '*')}"
+            p = self._bucket_partial(seg, ctx, key, bmask, scores)
+            p["from"] = frm
+            p["to"] = to
+            buckets.append(p)
+        return buckets
+
+    def merge(self, partials):
+        merged = self._merge_buckets(partials)
+        # carry from/to through
+        for partial in partials:
+            for b in partial:
+                if b["key"] in merged:
+                    merged[b["key"]].setdefault("from", b.get("from"))
+                    merged[b["key"]].setdefault("to", b.get("to"))
+        return merged
+
+    def finalize(self, merged):
+        buckets = []
+        for e in merged.values():
+            out = self._finalize_bucket(e)
+            if e.get("from") is not None:
+                out["from"] = e["from"]
+            if e.get("to") is not None:
+                out["to"] = e["to"]
+            buckets.append(out)
+        return {"buckets": buckets}
+
+
+class DateRangeAgg(RangeAgg):
+    key_is_date = True
+
+
+class IpRangeAgg(RangeAgg):
+    def _convert(self, v):
+        from ..mapper.core import parse_ip
+
+        if v is None:
+            return None
+        return float(parse_ip(v)) if isinstance(v, str) else float(v)
+
+
+class HistogramAgg(_BucketAgg):
+    def _interval(self) -> float:
+        return float(self.spec.get("interval", 1))
+
+    def _key_for(self, vals: np.ndarray) -> np.ndarray:
+        interval = self._interval()
+        return np.floor(vals / interval) * interval
+
+    def collect(self, seg, ctx, mask, scores=None):
+        field = self.spec.get("field")
+        docs, vals = _field_values(seg, field, mask)
+        keys = self._key_for(vals)
+        buckets = []
+        for key in np.unique(keys):
+            sel = keys == key
+            bmask = np.zeros(seg.doc_count, dtype=bool)
+            bmask[docs[sel]] = True
+            bmask &= mask
+            buckets.append(self._bucket_partial(seg, ctx, float(key), bmask, scores))
+        return buckets
+
+    def merge(self, partials):
+        return self._merge_buckets(partials)
+
+    def finalize(self, merged):
+        entries = sorted(merged.values(), key=lambda e: e["key"])
+        min_count = int(self.spec.get("min_doc_count", 0 if "extended_bounds" in self.spec else 1))
+        if min_count == 0 and entries:
+            # fill empty buckets between min and max keys
+            interval = self._interval()
+            lo, hi = entries[0]["key"], entries[-1]["key"]
+            eb = self.spec.get("extended_bounds") or {}
+            lo = min(lo, eb["min"]) if "min" in eb else lo
+            hi = max(hi, eb["max"]) if "max" in eb else hi
+            have = {e["key"] for e in entries}
+            k = lo
+            while k <= hi + 1e-9:
+                if k not in have:
+                    entries.append({"key": k, "doc_count": 0,
+                                    "subs": self._merge_subs([])})
+                k += interval
+            entries.sort(key=lambda e: e["key"])
+        entries = [e for e in entries if e["doc_count"] >= min_count]
+        return {"buckets": [self._finalize_bucket(e) for e in entries]}
+
+
+_CAL_INTERVALS = {
+    "year": 365 * 86400_000, "quarter": 91 * 86400_000, "month": 30 * 86400_000,
+    "week": 7 * 86400_000, "day": 86400_000, "hour": 3600_000,
+    "minute": 60_000, "second": 1000,
+}
+
+
+class DateHistogramAgg(HistogramAgg):
+    def _interval(self) -> float:
+        spec = str(self.spec.get("interval", "day"))
+        if spec in _CAL_INTERVALS:
+            return float(_CAL_INTERVALS[spec])
+        from ..common.units import parse_time
+
+        return parse_time(spec) * 1000.0
+
+    def _key_for(self, vals: np.ndarray) -> np.ndarray:
+        spec = str(self.spec.get("interval", "day"))
+        if spec in ("month", "year", "quarter"):
+            # calendar-aware bucketing
+            import datetime as dt
+
+            out = np.empty(len(vals))
+            for i, v in enumerate(vals):
+                d = dt.datetime.fromtimestamp(v / 1000.0, dt.timezone.utc)
+                if spec == "year":
+                    d2 = dt.datetime(d.year, 1, 1, tzinfo=dt.timezone.utc)
+                elif spec == "quarter":
+                    d2 = dt.datetime(d.year, ((d.month - 1) // 3) * 3 + 1, 1,
+                                     tzinfo=dt.timezone.utc)
+                else:
+                    d2 = dt.datetime(d.year, d.month, 1, tzinfo=dt.timezone.utc)
+                out[i] = d2.timestamp() * 1000.0
+            return out
+        return super()._key_for(vals)
+
+    def finalize(self, merged):
+        out = super().finalize(merged)
+        import datetime as dt
+
+        for b in out["buckets"]:
+            b["key_as_string"] = dt.datetime.fromtimestamp(
+                b["key"] / 1000.0, dt.timezone.utc
+            ).strftime("%Y-%m-%dT%H:%M:%S.000Z")
+        return out
+
+
+class FilterAgg(_BucketAgg):
+    def collect(self, seg, ctx, mask, scores=None):
+        f = parse_filter(self.spec)
+        bmask = mask & segment_mask(seg, f, ctx)
+        return [self._bucket_partial(seg, ctx, "filter", bmask, scores)]
+
+    def merge(self, partials):
+        return self._merge_buckets(partials)
+
+    def finalize(self, merged):
+        e = next(iter(merged.values())) if merged else {"key": "filter", "doc_count": 0, "subs": self._merge_subs([])}
+        out = {"doc_count": e["doc_count"]}
+        out.update(self._finalize_subs(e["subs"]))
+        return out
+
+
+class FiltersAgg(_BucketAgg):
+    def collect(self, seg, ctx, mask, scores=None):
+        buckets = []
+        fspecs = self.spec.get("filters", {})
+        items = fspecs.items() if isinstance(fspecs, dict) else enumerate(fspecs)
+        for key, fs in items:
+            f = parse_filter(fs)
+            bmask = mask & segment_mask(seg, f, ctx)
+            buckets.append(self._bucket_partial(seg, ctx, key, bmask, scores))
+        return buckets
+
+    def merge(self, partials):
+        return self._merge_buckets(partials)
+
+    def finalize(self, merged):
+        return {"buckets": {
+            e["key"]: {k: v for k, v in self._finalize_bucket(e).items() if k != "key"}
+            for e in merged.values()
+        }}
+
+
+class GlobalAgg(_BucketAgg):
+    def collect(self, seg, ctx, mask, scores=None):
+        gmask = seg.live & seg.parent_mask
+        return [self._bucket_partial(seg, ctx, "global", gmask, scores)]
+
+    def merge(self, partials):
+        return self._merge_buckets(partials)
+
+    def finalize(self, merged):
+        e = next(iter(merged.values())) if merged else {"key": "global", "doc_count": 0, "subs": {}}
+        out = {"doc_count": e["doc_count"]}
+        out.update(self._finalize_subs(e["subs"]) if e["subs"] else {})
+        return out
+
+
+class MissingAgg(_BucketAgg):
+    def collect(self, seg, ctx, mask, scores=None):
+        from .filters import MissingFilter
+
+        f = MissingFilter(self.spec.get("field"))
+        bmask = mask & segment_mask(seg, f, ctx)
+        return [self._bucket_partial(seg, ctx, "missing", bmask, scores)]
+
+    def merge(self, partials):
+        return self._merge_buckets(partials)
+
+    def finalize(self, merged):
+        e = next(iter(merged.values())) if merged else {"key": "missing", "doc_count": 0, "subs": {}}
+        out = {"doc_count": e["doc_count"]}
+        out.update(self._finalize_subs(e["subs"]) if e["subs"] else {})
+        return out
+
+
+class NestedAgg(_BucketAgg):
+    """Switches the collection scope to nested child docs of `path` whose parents
+    match (ref: search/aggregations/bucket/nested/)."""
+
+    def collect(self, seg, ctx, mask, scores=None):
+        from .execute import _parent_of_map
+
+        path = self.spec.get("path")
+        child_sel = np.asarray([p == path for p in seg.nested_paths], dtype=bool)
+        parents = _parent_of_map(seg)
+        cmask = np.zeros(seg.doc_count, dtype=bool)
+        idx = np.nonzero(child_sel)[0]
+        if len(idx):
+            pidx = parents[idx]
+            ok = pidx >= 0
+            cmask[idx[ok]] = mask[pidx[ok]]
+        return [self._bucket_partial(seg, ctx, "nested", cmask, scores)]
+
+    def merge(self, partials):
+        return self._merge_buckets(partials)
+
+    def finalize(self, merged):
+        e = next(iter(merged.values())) if merged else {"key": "nested", "doc_count": 0, "subs": {}}
+        out = {"doc_count": e["doc_count"]}
+        out.update(self._finalize_subs(e["subs"]) if e["subs"] else {})
+        return out
+
+
+class GeoDistanceAgg(_BucketAgg):
+    def collect(self, seg, ctx, mask, scores=None):
+        field = self.spec.get("field")
+        origin = self.spec.get("origin") or self.spec.get("point") or self.spec.get("center")
+        if isinstance(origin, dict):
+            lat0, lon0 = float(origin["lat"]), float(origin["lon"])
+        elif isinstance(origin, str):
+            lat0, lon0 = (float(x) for x in origin.split(","))
+        else:
+            lon0, lat0 = float(origin[0]), float(origin[1])
+        unit = parse_distance("1" + self.spec.get("unit", "m"))
+        docs_lat, lats = _field_values(seg, f"{field}.lat", mask)
+        _, lons = _field_values(seg, f"{field}.lon", mask)
+        d = haversine_m(lat0, lon0, lats, lons) / unit
+        buckets = []
+        for r in self.spec.get("ranges", []):
+            frm, to = r.get("from"), r.get("to")
+            sel = np.ones(len(d), dtype=bool)
+            if frm is not None:
+                sel &= d >= float(frm)
+            if to is not None:
+                sel &= d < float(to)
+            bmask = np.zeros(seg.doc_count, dtype=bool)
+            bmask[docs_lat[sel]] = True
+            bmask &= mask
+            key = r.get("key") or f"{frm if frm is not None else '*'}-{to if to is not None else '*'}"
+            buckets.append(self._bucket_partial(seg, ctx, key, bmask, scores))
+        return buckets
+
+    def merge(self, partials):
+        return self._merge_buckets(partials)
+
+    def finalize(self, merged):
+        return {"buckets": [self._finalize_bucket(e) for e in merged.values()]}
+
+
+class SignificantTermsAgg(TermsAgg):
+    """Simplified significance: foreground/background frequency ratio scoring
+    (the reference uses JLH; same monotone intent, documented deviation)."""
+
+    def collect(self, seg, ctx, mask, scores=None):
+        buckets = super().collect(seg, ctx, mask, scores)
+        bg = seg.live & seg.parent_mask
+        field = self.spec.get("field")
+        for b in buckets:
+            if field in seg.dv_str:
+                uniq, off, ords = seg.dv_str[field]
+                try:
+                    o = uniq.index(b["key"]) if isinstance(uniq, list) else None
+                except ValueError:
+                    o = None
+                if o is not None:
+                    counts = np.diff(off)
+                    doc_of_val = np.repeat(np.arange(seg.doc_count), counts)
+                    sel = (ords == o) & bg[doc_of_val]
+                    b["bg_count"] = int(np.unique(doc_of_val[sel]).size)
+                else:
+                    b["bg_count"] = b["doc_count"]
+            else:
+                b["bg_count"] = b["doc_count"]
+        return buckets
+
+    def merge(self, partials):
+        merged = super().merge(partials)
+        for partial in partials:
+            for b in partial:
+                if b["key"] in merged:
+                    e = merged[b["key"]]
+                    e["bg_count"] = e.get("bg_count", 0) + b.get("bg_count", 0)
+        return merged
+
+    def finalize(self, merged):
+        entries = list(merged.values())
+        for e in entries:
+            bg = max(e.get("bg_count", e["doc_count"]), 1)
+            e["_score"] = e["doc_count"] / bg
+        entries.sort(key=lambda e: (-e["_score"], -e["doc_count"]))
+        size = int(self.spec.get("size", 10))
+        out = []
+        for e in entries[:size]:
+            b = self._finalize_bucket(e)
+            b["score"] = e["_score"]
+            b["bg_count"] = e.get("bg_count", e["doc_count"])
+            out.append(b)
+        return {"buckets": out}
+
+
+_AGG_REGISTRY: dict[str, type] = {
+    "sum": SumAgg,
+    "avg": AvgAgg,
+    "min": MinAgg,
+    "max": MaxAgg,
+    "value_count": ValueCountAgg,
+    "stats": StatsAgg,
+    "extended_stats": ExtendedStatsAgg,
+    "cardinality": CardinalityAgg,
+    "percentiles": PercentilesAgg,
+    "top_hits": TopHitsAgg,
+    "geo_bounds": GeoBoundsAgg,
+    "terms": TermsAgg,
+    "significant_terms": SignificantTermsAgg,
+    "range": RangeAgg,
+    "date_range": DateRangeAgg,
+    "ip_range": IpRangeAgg,
+    "histogram": HistogramAgg,
+    "date_histogram": DateHistogramAgg,
+    "filter": FilterAgg,
+    "filters": FiltersAgg,
+    "global": GlobalAgg,
+    "missing": MissingAgg,
+    "nested": NestedAgg,
+    "geo_distance": GeoDistanceAgg,
+}
+
+
+# ---------------------------------------------------------------------------
+# facets (legacy API) — mapped onto the agg framework (ref: search/facet/, 15k LoC,
+# superseded by aggs in the reference but still first-class in this snapshot)
+# ---------------------------------------------------------------------------
+
+
+def parse_facets(spec: dict) -> dict[str, tuple[Agg, str]]:
+    out = {}
+    for name, body in (spec or {}).items():
+        kinds = [k for k in body if k not in ("facet_filter", "global", "nested")]
+        if not kinds:
+            raise QueryParsingError(f"facet [{name}] missing type")
+        kind = kinds[0]
+        fspec = body[kind]
+        if kind == "terms":
+            agg = TermsAgg(name, fspec)
+        elif kind == "statistical":
+            agg = ExtendedStatsAgg(name, fspec)
+        elif kind in ("histogram",):
+            agg = HistogramAgg(name, fspec)
+        elif kind == "date_histogram":
+            agg = DateHistogramAgg(name, fspec)
+        elif kind == "range":
+            agg = RangeAgg(name, fspec)
+        elif kind == "geo_distance":
+            agg = GeoDistanceAgg(name, fspec)
+        elif kind in ("query",):
+            agg = FilterAgg(name, {"query": fspec})
+        elif kind in ("filter",):
+            agg = FilterAgg(name, fspec)
+        elif kind == "terms_stats":
+            agg = TermsAgg(name, {"field": fspec.get("key_field"),
+                                  "size": fspec.get("size", 10)},
+                           subs={"stats": StatsAgg("stats", {"field": fspec.get("value_field")})})
+        else:
+            raise QueryParsingError(f"unknown facet type [{kind}]")
+        out[name] = (agg, kind)
+    return out
+
+
+def facet_response(agg: Agg, kind: str, reduced: dict) -> dict:
+    """Convert an agg result into the legacy facet response shape."""
+    if kind == "terms":
+        return {"_type": "terms", "terms": [
+            {"term": b["key"], "count": b["doc_count"]} for b in reduced["buckets"]
+        ]}
+    if kind == "statistical":
+        return {"_type": "statistical", **{k: v for k, v in reduced.items()}}
+    if kind in ("histogram", "date_histogram"):
+        return {"_type": kind, "entries": [
+            {"key": b["key"], "count": b["doc_count"]} for b in reduced["buckets"]
+        ]}
+    if kind == "range":
+        return {"_type": "range", "ranges": [
+            {**b, "count": b.pop("doc_count")} for b in [dict(b) for b in reduced["buckets"]]
+        ]}
+    if kind in ("query", "filter"):
+        return {"_type": kind, "count": reduced["doc_count"]}
+    if kind == "geo_distance":
+        return {"_type": "geo_distance", "ranges": [
+            {**b, "count": b.pop("doc_count")} for b in [dict(b) for b in reduced["buckets"]]
+        ]}
+    if kind == "terms_stats":
+        return {"_type": "terms_stats", "entries": [
+            {"term": b["key"], "count": b["doc_count"], **b.get("stats", {})}
+            for b in reduced["buckets"]
+        ]}
+    return reduced

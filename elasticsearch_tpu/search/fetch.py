@@ -1,0 +1,264 @@
+"""Fetch phase: hydrate winning doc ids into hits.
+
+Analogue of search/fetch/ (SURVEY.md §2.5): _source loading + filtering (includes/
+excludes/partial), stored fields, script_fields, fielddata_fields, version, highlight,
+matched_queries, explain. Runs host-side — the fetch phase is IO/format work, not
+compute, so it stays off the device exactly as the reference keeps it out of the
+scoring loop.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from typing import Any
+
+import numpy as np
+
+from .queries import (
+    BoolQuery,
+    FilteredQuery,
+    MatchQuery,
+    MultiMatchQuery,
+    PhraseQuery,
+    Query,
+    QueryStringQuery,
+    TermQuery,
+)
+
+
+def filter_source(source: dict, includes, excludes) -> dict:
+    if not includes and not excludes:
+        return source
+
+    def walk(obj, path=""):
+        if not isinstance(obj, dict):
+            return obj
+        out = {}
+        for k, v in obj.items():
+            p = f"{path}{k}"
+            if isinstance(v, dict):
+                sub = walk(v, p + ".")
+                if sub or _included(p, includes, excludes):
+                    if not _excluded(p, excludes):
+                        out[k] = sub if isinstance(v, dict) else v
+            else:
+                if _included(p, includes, excludes) and not _excluded(p, excludes):
+                    out[k] = v
+        return out
+
+    return walk(source)
+
+
+def _included(path: str, includes, excludes) -> bool:
+    if not includes:
+        return True
+    return any(
+        fnmatch.fnmatch(path, pat) or pat.startswith(path + ".")
+        for pat in includes
+    )
+
+
+def _excluded(path: str, excludes) -> bool:
+    return any(fnmatch.fnmatch(path, pat) for pat in (excludes or []))
+
+
+def source_spec(body: dict):
+    """Parse the _source directive: bool / str / list / {includes, excludes}."""
+    spec = body.get("_source")
+    if spec is None:
+        return True, [], []
+    if spec is False:
+        return False, [], []
+    if spec is True:
+        return True, [], []
+    if isinstance(spec, str):
+        return True, [spec], []
+    if isinstance(spec, list):
+        return True, spec, []
+    return True, spec.get("includes") or spec.get("include") or [], \
+        spec.get("excludes") or spec.get("exclude") or []
+
+
+def extract_field(source: dict, path: str) -> list:
+    """Dotted-path field extraction from _source (for "fields": [...])."""
+    node: Any = source
+    for part in path.split("."):
+        if isinstance(node, list):
+            node = [n.get(part) for n in node if isinstance(n, dict)]
+        elif isinstance(node, dict):
+            node = node.get(part)
+        else:
+            return []
+        if node is None:
+            return []
+    if isinstance(node, list):
+        return [n for n in node if n is not None]
+    return [node]
+
+
+# ---------------------------------------------------------------------------
+# highlight (plain highlighter — ref: search/highlight/PlainHighlighter)
+# ---------------------------------------------------------------------------
+
+
+def query_terms_for_field(query: Query, field: str, ctx) -> set[str]:
+    out: set[str] = set()
+
+    def walk(q):
+        if isinstance(q, TermQuery) and q.field in (field, "_all"):
+            out.add(str(q.value).lower())
+        elif isinstance(q, MatchQuery) and q.field in (field, "_all"):
+            out.update(ctx.analyze(field, q.text))
+        elif isinstance(q, PhraseQuery) and q.field in (field, "_all"):
+            out.update(ctx.analyze(field, q.text))
+        elif isinstance(q, MultiMatchQuery):
+            for fspec in q.fields:
+                fname = fspec.split("^")[0]
+                if fname in (field, "_all"):
+                    out.update(ctx.analyze(field, q.text))
+        elif isinstance(q, BoolQuery):
+            for sub in q.must + q.should:
+                walk(sub)
+        elif isinstance(q, FilteredQuery):
+            walk(q.query)
+        elif isinstance(q, QueryStringQuery):
+            from .execute import parse_query_string
+
+            walk(parse_query_string(q, ctx))
+        elif hasattr(q, "query") and isinstance(getattr(q, "query"), Query):
+            walk(q.query)
+        elif hasattr(q, "queries"):
+            for sub in q.queries:
+                walk(sub)
+
+    walk(query)
+    return out
+
+
+def highlight_field(text: str, terms: set[str], ctx, field: str,
+                    fragment_size: int = 100, number_of_fragments: int = 5,
+                    pre_tag: str = "<em>", post_tag: str = "</em>") -> list[str]:
+    if not text or not terms:
+        return []
+    analyzer = ctx.mapper_service.search_analyzer_for(field)
+    tokens = analyzer.analyze(text)
+    spans = [(t.start, t.end) for t in tokens if t.term.lower() in terms]
+    if not spans:
+        return []
+    if number_of_fragments == 0:
+        # highlight whole field
+        return [_mark(text, spans, pre_tag, post_tag)]
+    fragments: list[tuple[int, int, list[tuple[int, int]]]] = []
+    for start, end in spans:
+        placed = False
+        for i, (fs, fe, fspans) in enumerate(fragments):
+            if start < fe:
+                fragments[i] = (fs, max(fe, min(len(text), start + fragment_size)), fspans + [(start, end)])
+                placed = True
+                break
+        if not placed:
+            fs = max(0, start - fragment_size // 4)
+            fe = min(len(text), fs + fragment_size)
+            fragments.append((fs, fe, [(start, end)]))
+    out = []
+    fragments.sort(key=lambda f: -len(f[2]))  # most matches first (Lucene frag scoring)
+    for fs, fe, fspans in fragments[:number_of_fragments]:
+        frag = text[fs:fe]
+        rel = [(s - fs, e - fs) for s, e in fspans if s >= fs and e <= fe]
+        out.append(_mark(frag, rel, pre_tag, post_tag))
+    return out
+
+
+def _mark(text: str, spans: list[tuple[int, int]], pre: str, post: str) -> str:
+    out = []
+    last = 0
+    for s, e in sorted(set(spans)):
+        if s < last:
+            continue
+        out.append(text[last:s])
+        out.append(pre)
+        out.append(text[s:e])
+        out.append(post)
+        last = e
+    out.append(text[last:])
+    return "".join(out)
+
+
+def build_highlights(query: Query, hl_spec: dict, seg, local: int, ctx) -> dict:
+    source = seg.stored[local] or {}
+    out = {}
+    global_pre = (hl_spec.get("pre_tags") or ["<em>"])[0]
+    global_post = (hl_spec.get("post_tags") or ["</em>"])[0]
+    for field, fopts in (hl_spec.get("fields") or {}).items():
+        fopts = fopts or {}
+        terms = query_terms_for_field(query, field, ctx)
+        vals = extract_field(source, field)
+        frags: list[str] = []
+        for v in vals:
+            frags.extend(highlight_field(
+                str(v), terms, ctx, field,
+                fragment_size=int(fopts.get("fragment_size", hl_spec.get("fragment_size", 100))),
+                number_of_fragments=int(fopts.get("number_of_fragments",
+                                                  hl_spec.get("number_of_fragments", 5))),
+                pre_tag=(fopts.get("pre_tags") or [global_pre])[0],
+                post_tag=(fopts.get("post_tags") or [global_post])[0],
+            ))
+        if frags:
+            out[field] = frags
+    return out
+
+
+# ---------------------------------------------------------------------------
+# hit assembly
+# ---------------------------------------------------------------------------
+
+
+def build_hit(seg, local: int, score: float, body: dict, query: Query, ctx,
+              index_name: str = "index", sort_values: list | None = None,
+              shard_id: int | None = None) -> dict:
+    hit: dict[str, Any] = {
+        "_index": index_name,
+        "_type": seg.types[local],
+        "_id": seg.ids[local],
+        "_score": None if score != score else score,  # NaN → null (sorted results)
+    }
+    if shard_id is not None:
+        hit["_shard"] = shard_id
+    enabled, includes, excludes = source_spec(body)
+    if enabled and seg.stored[local] is not None:
+        hit["_source"] = filter_source(seg.stored[local], includes, excludes)
+    if body.get("version"):
+        hit["_version"] = int(seg.versions[local])
+    fields_spec = body.get("fields") or body.get("stored_fields")
+    if fields_spec:
+        if isinstance(fields_spec, str):
+            fields_spec = [fields_spec]
+        fields_out = {}
+        for f in fields_spec:
+            if f == "_source":
+                continue
+            vals = extract_field(seg.stored[local] or {}, f)
+            if vals:
+                fields_out[f] = vals
+        if fields_out:
+            hit["fields"] = fields_out
+    script_fields = body.get("script_fields")
+    if script_fields:
+        from ..script import compile_script
+        from .filters import DocAccess
+
+        sf_out = hit.setdefault("fields", {})
+        for name, sspec in script_fields.items():
+            fn = compile_script(sspec.get("script", ""), sspec.get("params", {}))
+            try:
+                sf_out[name] = [fn(DocAccess(seg, local), _score=score if score == score else 0.0)]
+            except Exception:  # noqa: BLE001
+                sf_out[name] = [None]
+    if body.get("highlight"):
+        hl = build_highlights(query, body["highlight"], seg, local, ctx)
+        if hl:
+            hit["highlight"] = hl
+    if sort_values is not None:
+        hit["sort"] = sort_values
+    return hit

@@ -1,0 +1,388 @@
+"""Per-shard search service: full request bodies → query phase / fetch phase, scroll
+contexts, rescore — the analogue of search/SearchService.java + DefaultSearchContext
+(SURVEY.md §2.5): parse once, execute query phase (top docs + agg partials + suggest),
+keep the context alive for fetch/scroll, reap on keep-alive expiry.
+
+The query/fetch split exists for the same reason as the reference's: in multi-shard
+search only the GLOBAL top-k winners get hydrated (fetch), so the query phase returns
+doc ids + sort tuples only (TransportSearchQueryThenFetchAction — SURVEY.md §3.3)."""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field as dc_field
+
+import numpy as np
+
+from ..common.errors import QueryParsingError, SearchContextMissingError
+from .aggregations import facet_response, parse_aggs, parse_facets, reduce_aggs
+from .execute import (
+    HostScorer,
+    ShardContext,
+    TopDocs,
+    lower_flat,
+    execute_flat_batch,
+    match_masks,
+    query_norm_for,
+    search_shard,
+)
+from .fetch import build_hit
+from .filters import Filter, segment_mask
+from .queries import MatchAllQuery, Query, parse_filter, parse_query
+from .sorting import (
+    SortSpec,
+    apply_missing,
+    compare_sort_values,
+    parse_sort,
+    sort_key_column,
+    sort_values_for_docs,
+)
+from .suggest import run_suggest
+
+
+@dataclass
+class ParsedSearchRequest:
+    query: Query
+    post_filter: Filter | None
+    from_: int
+    size: int
+    sort: list  # list[SortSpec]
+    aggs: dict
+    facets: dict
+    suggest: dict | None
+    rescore: list
+    min_score: float | None
+    body: dict
+    track_scores: bool = False
+    explain: bool = False
+    timeout_s: float | None = None
+
+
+def parse_search_body(body: dict | None) -> ParsedSearchRequest:
+    body = body or {}
+    query = parse_query(body.get("query")) if body.get("query") else MatchAllQuery()
+    # top-level "filter" is the POST filter (applied to hits, not aggs/facets) —
+    # ref: DefaultSearchContext.parsedPostFilter
+    post_filter = parse_filter(body["filter"]) if body.get("filter") else \
+        parse_filter(body["post_filter"]) if body.get("post_filter") else None
+    rescore = body.get("rescore") or []
+    if isinstance(rescore, dict):
+        rescore = [rescore]
+    return ParsedSearchRequest(
+        query=query,
+        post_filter=post_filter,
+        from_=int(body.get("from", 0)),
+        size=int(body.get("size", 10)),
+        sort=parse_sort(body.get("sort")),
+        aggs=parse_aggs(body.get("aggs") or body.get("aggregations") or {}),
+        facets=parse_facets(body.get("facets") or {}),
+        suggest=body.get("suggest"),
+        rescore=rescore,
+        min_score=body.get("min_score"),
+        body=body,
+        track_scores=bool(body.get("track_scores", False)),
+        explain=bool(body.get("explain", False)),
+    )
+
+
+@dataclass
+class ShardQueryResult:
+    """Query-phase output for ONE shard (what travels back to the coordinating node
+    before the reduce — ref: QuerySearchResult)."""
+
+    total: int
+    # [(score, global_doc, sort_values|None)] — length ≤ from+size
+    docs: list
+    max_score: float
+    agg_partials: list = dc_field(default_factory=list)  # one partial dict per segment
+    facet_partials: list = dc_field(default_factory=list)
+    suggest: dict | None = None
+    context_id: int | None = None
+    shard_id: int = 0
+
+
+def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
+                        use_device: bool = True, shard_id: int = 0) -> ShardQueryResult:
+    k = req.from_ + req.size
+    needs_masks = bool(req.aggs or req.facets or req.sort or req.post_filter
+                       or req.rescore or req.min_score is not None)
+    suggest_out = run_suggest(ctx, req.suggest) if req.suggest else None
+
+    if not needs_masks:
+        plan = lower_flat(req.query, ctx) if use_device else None
+        if plan is not None:
+            td = execute_flat_batch([plan], ctx, max(k, 1))[0]
+            return ShardQueryResult(
+                total=td.total, docs=[(s, d, None) for s, d in td.hits],
+                max_score=td.max_score, suggest=suggest_out, shard_id=shard_id,
+            )
+        td = _host_topk(ctx, req, k)
+        return ShardQueryResult(total=td.total, docs=[(s, d, None) for s, d in td.hits],
+                                max_score=td.max_score, suggest=suggest_out,
+                                shard_id=shard_id)
+
+    # general path: dense per-segment masks drive sort/aggs/rescore
+    seg_results = match_masks(ctx, req.query)
+    seg_masks_for_aggs = []
+    all_entries = []  # (sortkeys..., score, global_doc, seg_idx, local)
+    total = 0
+    max_score = float("nan")
+    for si, ((scores, match), seg, base) in enumerate(
+        zip(seg_results, ctx.searcher.segments, ctx.searcher.bases)
+    ):
+        if req.min_score is not None:
+            match = match & (scores >= np.float32(req.min_score))
+        seg_masks_for_aggs.append((seg, match, scores))
+        hit_mask = match
+        if req.post_filter is not None:
+            hit_mask = match & segment_mask(seg, req.post_filter, ctx)
+        idx = np.nonzero(hit_mask)[0]
+        total += len(idx)
+        if not len(idx):
+            continue
+        seg_scores = scores[idx]
+        if len(seg_scores):
+            m = float(seg_scores.max())
+            max_score = m if max_score != max_score else max(max_score, m)
+        if req.sort:
+            keycols = []
+            for spec in req.sort:
+                col = apply_missing(sort_key_column(spec, seg, ctx, scores), spec)
+                keycols.append(col[idx] * (-1.0 if spec.reverse else 1.0))
+            for j, local in enumerate(idx):
+                all_entries.append(
+                    (tuple(kc[j] for kc in keycols), float(seg_scores[j]),
+                     base + int(local), si, int(local))
+                )
+        else:
+            for j, local in enumerate(idx):
+                all_entries.append(
+                    ((-float(seg_scores[j]),), float(seg_scores[j]),
+                     base + int(local), si, int(local))
+                )
+    all_entries.sort(key=lambda e: (e[0], e[2]))
+    top = all_entries[: max(k, 0)]
+
+    # rescore: re-rank the top window with the rescore queries
+    if req.rescore and top:
+        top = _apply_rescore(ctx, req, top)
+
+    docs = []
+    # per-segment grouped sort-value extraction for response "sort" arrays
+    if req.sort:
+        by_seg: dict[int, list[int]] = {}
+        for rank, (_, _s, g, si, local) in enumerate(top):
+            by_seg.setdefault(si, []).append(rank)
+        sort_vals_by_rank: dict[int, list] = {}
+        for si, ranks in by_seg.items():
+            seg = ctx.searcher.segments[si]
+            locals_ = np.asarray([top[r][4] for r in ranks])
+            scores_dense = seg_results[si][0]
+            vals = sort_values_for_docs(req.sort, seg, ctx, locals_, scores_dense)
+            for r, v in zip(ranks, vals):
+                sort_vals_by_rank[r] = v
+        for rank, (_, s, g, si, local) in enumerate(top):
+            score = s if req.track_scores or _score_in_sort(req.sort) else float("nan")
+            docs.append((score, g, sort_vals_by_rank[rank]))
+    else:
+        docs = [(s, g, None) for (_, s, g, _si, _l) in top]
+
+    agg_partials = []
+    facet_partials = []
+    if req.aggs:
+        agg_partials = [
+            {n: a.collect(seg, ctx, mask, scores) for n, a in req.aggs.items()}
+            for seg, mask, scores in seg_masks_for_aggs
+        ]
+    if req.facets:
+        facet_partials = [
+            {n: agg.collect(seg, ctx, mask, scores)
+             for n, (agg, _kind) in req.facets.items()}
+            for seg, mask, scores in seg_masks_for_aggs
+        ]
+    return ShardQueryResult(
+        total=total, docs=docs, max_score=max_score, agg_partials=agg_partials,
+        facet_partials=facet_partials, suggest=suggest_out, shard_id=shard_id,
+    )
+
+
+def _score_in_sort(sort: list) -> bool:
+    return any(s.kind == "score" for s in sort)
+
+
+def _host_topk(ctx: ShardContext, req: ParsedSearchRequest, k: int) -> TopDocs:
+    return search_shard(ctx, req.query, max(k, 1), use_device=False)
+
+
+def _apply_rescore(ctx: ShardContext, req: ParsedSearchRequest, top: list) -> list:
+    """ref: search/rescore/QueryRescorer — window top-N re-scored, combined by
+    score_mode with query/rescore weights, then re-sorted within the window."""
+    for rspec in req.rescore:
+        window = int(rspec.get("window_size", 10))
+        qspec = rspec.get("query", {})
+        rq = parse_query(qspec.get("rescore_query"))
+        qw = float(qspec.get("query_weight", 1.0))
+        rw = float(qspec.get("rescore_query_weight", 1.0))
+        mode = qspec.get("score_mode", "total")
+        qn = query_norm_for(rq, ctx)
+        window_entries = top[:window]
+        rest = top[window:]
+        by_seg: dict[int, list[int]] = {}
+        for i, (_, _s, _g, si, local) in enumerate(window_entries):
+            by_seg.setdefault(si, []).append(i)
+        new_entries = list(window_entries)
+        for si, idxs in by_seg.items():
+            seg = ctx.searcher.segments[si]
+            scorer = HostScorer(ctx, seg, qn)
+            rscores, rmatch = scorer.eval(rq)
+            for i in idxs:
+                key0, s, g, si2, local = window_entries[i]
+                if rmatch[local]:
+                    rs = float(rscores[local])
+                    if mode == "total":
+                        ns = s * qw + rs * rw
+                    elif mode == "multiply":
+                        ns = s * qw * rs * rw
+                    elif mode == "avg":
+                        ns = (s * qw + rs * rw) / 2.0
+                    elif mode == "max":
+                        ns = max(s * qw, rs * rw)
+                    elif mode == "min":
+                        ns = min(s * qw, rs * rw)
+                    else:
+                        raise QueryParsingError(f"unknown rescore score_mode [{mode}]")
+                else:
+                    ns = s * qw
+                new_entries[i] = ((-ns,), ns, g, si2, local)
+        new_entries.sort(key=lambda e: (e[0], e[2]))
+        top = new_entries + rest
+    return top
+
+
+def execute_fetch_phase(ctx: ShardContext, req: ParsedSearchRequest,
+                        docs: list, index_name: str = "index",
+                        shard_id: int | None = None) -> list[dict]:
+    """docs: [(score, global_doc, sort_values|None)] — the winners to hydrate."""
+    hits = []
+    for score, g, sort_values in docs:
+        seg, local = ctx.searcher.resolve(g)
+        hits.append(build_hit(seg, local, score, req.body, req.query, ctx,
+                              index_name=index_name, sort_values=sort_values,
+                              shard_id=shard_id))
+    return hits
+
+
+def reduce_and_respond(ctx: ShardContext, req: ParsedSearchRequest,
+                       result: ShardQueryResult, took_ms: int = 0,
+                       index_name: str = "index") -> dict:
+    """Single-shard convenience: query result → full response body."""
+    page = result.docs[req.from_: req.from_ + req.size]
+    hits = execute_fetch_phase(ctx, req, page, index_name=index_name)
+    resp: dict = {
+        "took": took_ms,
+        "timed_out": False,
+        "_shards": {"total": 1, "successful": 1, "failed": 0},
+        "hits": {
+            "total": result.total,
+            "max_score": None if result.max_score != result.max_score else result.max_score,
+            "hits": hits,
+        },
+    }
+    if req.aggs:
+        resp["aggregations"] = reduce_aggs(req.aggs, result.agg_partials)
+    if req.facets:
+        resp["facets"] = {
+            name: facet_response(agg, kind, agg.finalize(agg.merge(
+                [p[name] for p in result.facet_partials])))
+            for name, (agg, kind) in req.facets.items()
+        }
+    if result.suggest is not None:
+        resp["suggest"] = result.suggest
+    return resp
+
+
+# ---------------------------------------------------------------------------
+# search contexts (scroll / two-phase) — ref: SearchService's active contexts map
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SearchContextEntry:
+    ctx: ShardContext
+    req: ParsedSearchRequest
+    ordered_docs: list  # full sorted [(score, global_doc, sort_values)]
+    position: int
+    keep_alive_s: float
+    last_access: float
+    index_name: str = "index"
+
+
+class SearchService:
+    """Holds long-lived shard search contexts keyed by id (scroll); reaps expired ones
+    (ref: SearchService keep-alive reaper)."""
+
+    def __init__(self):
+        self._contexts: dict[int, SearchContextEntry] = {}
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+
+    def create_scroll(self, ctx: ShardContext, req: ParsedSearchRequest,
+                      keep_alive_s: float = 300.0, use_device: bool = True,
+                      index_name: str = "index") -> tuple[int, ShardQueryResult]:
+        # materialize the FULL ordering once; scroll pages through it
+        big = ParsedSearchRequest(**{**req.__dict__, "from_": 0,
+                                     "size": max(ctx.searcher.max_doc, 1)})
+        result = execute_query_phase(ctx, big, use_device=use_device)
+        cid = next(self._ids)
+        with self._lock:
+            self._contexts[cid] = SearchContextEntry(
+                ctx=ctx, req=req, ordered_docs=result.docs, position=0,
+                keep_alive_s=keep_alive_s, last_access=time.monotonic(),
+                index_name=index_name,
+            )
+        first = ShardQueryResult(
+            total=result.total, docs=result.docs[: req.size],
+            max_score=result.max_score, agg_partials=result.agg_partials,
+            facet_partials=result.facet_partials, suggest=result.suggest,
+            context_id=cid,
+        )
+        with self._lock:
+            self._contexts[cid].position = req.size
+        return cid, first
+
+    def scroll(self, cid: int) -> tuple[ShardQueryResult, bool]:
+        with self._lock:
+            entry = self._contexts.get(cid)
+            if entry is None:
+                raise SearchContextMissingError(cid)
+            entry.last_access = time.monotonic()
+            page = entry.ordered_docs[entry.position: entry.position + entry.req.size]
+            entry.position += entry.req.size
+            done = entry.position >= len(entry.ordered_docs)
+        return ShardQueryResult(
+            total=len(entry.ordered_docs), docs=page, max_score=float("nan"),
+            context_id=cid,
+        ), done
+
+    def entry(self, cid: int) -> SearchContextEntry:
+        with self._lock:
+            e = self._contexts.get(cid)
+            if e is None:
+                raise SearchContextMissingError(cid)
+            return e
+
+    def free(self, cid: int) -> bool:
+        with self._lock:
+            return self._contexts.pop(cid, None) is not None
+
+    def reap_expired(self):
+        now = time.monotonic()
+        with self._lock:
+            for cid, e in list(self._contexts.items()):
+                if now - e.last_access > e.keep_alive_s:
+                    del self._contexts[cid]
+
+    def active_contexts(self) -> int:
+        return len(self._contexts)

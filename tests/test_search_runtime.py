@@ -1,0 +1,271 @@
+"""Search runtime: aggregations, facets, sort, fetch/highlight, suggest, rescore, scroll."""
+
+import math
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index import Engine
+from elasticsearch_tpu.mapper import MapperService
+from elasticsearch_tpu.search import ShardContext
+from elasticsearch_tpu.search.service import (
+    SearchService,
+    execute_query_phase,
+    parse_search_body,
+    reduce_and_respond,
+)
+
+PRODUCTS = [
+    {"name": "red widget deluxe", "category": "widgets", "price": 10, "stock": 5,
+     "created": "2014-01-15", "loc": {"lat": 40.7, "lon": -74.0}},
+    {"name": "blue widget", "category": "widgets", "price": 20, "stock": 0,
+     "created": "2014-01-20", "loc": {"lat": 40.8, "lon": -73.9}},
+    {"name": "green gadget", "category": "gadgets", "price": 30, "stock": 7,
+     "created": "2014-02-05", "loc": {"lat": 34.0, "lon": -118.2}},
+    {"name": "red gadget pro", "category": "gadgets", "price": 40, "stock": 2,
+     "created": "2014-02-10", "loc": {"lat": 37.7, "lon": -122.4}},
+    {"name": "yellow gizmo", "category": "gizmos", "price": 50, "stock": 1,
+     "created": "2014-03-01", "loc": {"lat": 41.8, "lon": -87.6}},
+    {"name": "red gizmo mini widget", "category": "gizmos", "price": 60, "stock": 9,
+     "created": "2014-03-15", "loc": {"lat": 29.7, "lon": -95.3}},
+]
+
+
+@pytest.fixture()
+def ctx(tmp_path):
+    svc = MapperService()
+    svc.put_mapping("product", {"properties": {
+        "name": {"type": "string"},
+        "category": {"type": "string", "index": "not_analyzed"},
+        "price": {"type": "long"},
+        "stock": {"type": "long"},
+        "created": {"type": "date"},
+        "loc": {"type": "geo_point"},
+    }})
+    e = Engine(str(tmp_path / "products"), svc)
+    for i, p in enumerate(PRODUCTS):
+        e.index("product", str(i), p)
+        if i == 2:
+            e.refresh()  # two segments
+    e.refresh()
+    return ShardContext(e.acquire_searcher(), svc)
+
+
+def run(ctx, body):
+    req = parse_search_body(body)
+    result = execute_query_phase(ctx, req)
+    return reduce_and_respond(ctx, req, result)
+
+
+class TestAggregations:
+    def test_metrics(self, ctx):
+        r = run(ctx, {"size": 0, "aggs": {
+            "avg_price": {"avg": {"field": "price"}},
+            "sum_price": {"sum": {"field": "price"}},
+            "minmax": {"stats": {"field": "price"}},
+            "ext": {"extended_stats": {"field": "price"}},
+            "n": {"value_count": {"field": "price"}},
+            "card": {"cardinality": {"field": "category"}},
+            "pct": {"percentiles": {"field": "price", "percents": [50]}},
+        }})
+        a = r["aggregations"]
+        assert a["avg_price"]["value"] == pytest.approx(35.0)
+        assert a["sum_price"]["value"] == 210.0
+        assert a["minmax"] == {"count": 6, "sum": 210.0, "min": 10.0, "max": 60.0,
+                               "avg": 35.0}
+        assert a["ext"]["std_deviation"] == pytest.approx(math.sqrt(np.var([10, 20, 30, 40, 50, 60])))
+        assert a["n"]["value"] == 6
+        assert a["card"]["value"] == 3
+        assert a["pct"]["values"]["50.0"] == pytest.approx(35.0)
+
+    def test_terms_with_subagg_and_order(self, ctx):
+        r = run(ctx, {"size": 0, "aggs": {
+            "cats": {"terms": {"field": "category", "order": {"avg_price": "desc"}},
+                     "aggs": {"avg_price": {"avg": {"field": "price"}}}},
+        }})
+        buckets = r["aggregations"]["cats"]["buckets"]
+        assert [b["key"] for b in buckets] == ["gizmos", "gadgets", "widgets"]
+        assert buckets[0]["avg_price"]["value"] == pytest.approx(55.0)
+        assert buckets[0]["doc_count"] == 2
+
+    def test_terms_agg_respects_query(self, ctx):
+        r = run(ctx, {"query": {"match": {"name": "red"}}, "size": 0, "aggs": {
+            "cats": {"terms": {"field": "category"}}}})
+        buckets = {b["key"]: b["doc_count"] for b in r["aggregations"]["cats"]["buckets"]}
+        assert buckets == {"widgets": 1, "gadgets": 1, "gizmos": 1}
+
+    def test_range_histogram_date_histogram(self, ctx):
+        r = run(ctx, {"size": 0, "aggs": {
+            "ranges": {"range": {"field": "price", "ranges": [
+                {"to": 25}, {"from": 25, "to": 45}, {"from": 45}]}},
+            "hist": {"histogram": {"field": "price", "interval": 20}},
+            "by_month": {"date_histogram": {"field": "created", "interval": "month"}},
+        }})
+        a = r["aggregations"]
+        assert [b["doc_count"] for b in a["ranges"]["buckets"]] == [2, 2, 2]
+        hist = {b["key"]: b["doc_count"] for b in a["hist"]["buckets"]}
+        assert hist == {0.0: 1, 20.0: 2, 40.0: 2, 60.0: 1}
+        months = [b["key_as_string"][:7] for b in a["by_month"]["buckets"]]
+        assert months == ["2014-01", "2014-02", "2014-03"]
+        assert [b["doc_count"] for b in a["by_month"]["buckets"]] == [2, 2, 2]
+
+    def test_filter_global_missing(self, ctx):
+        r = run(ctx, {"query": {"term": {"category": "widgets"}}, "size": 0, "aggs": {
+            "expensive": {"filter": {"range": {"price": {"gte": 15}}}},
+            "all_docs": {"global": {}, "aggs": {"n": {"value_count": {"field": "price"}}}},
+        }})
+        a = r["aggregations"]
+        assert a["expensive"]["doc_count"] == 1  # only blue widget among widgets
+        assert a["all_docs"]["doc_count"] == 6  # global escapes the query
+        assert a["all_docs"]["n"]["value"] == 6
+
+    def test_filters_agg(self, ctx):
+        r = run(ctx, {"size": 0, "aggs": {"groups": {"filters": {"filters": {
+            "cheap": {"range": {"price": {"lt": 30}}},
+            "red": {"query": {"match": {"name": "red"}}},
+        }}}}})
+        b = r["aggregations"]["groups"]["buckets"]
+        assert b["cheap"]["doc_count"] == 2
+        assert b["red"]["doc_count"] == 3
+
+    def test_geo_distance_agg(self, ctx):
+        r = run(ctx, {"size": 0, "aggs": {"near_nyc": {"geo_distance": {
+            "field": "loc", "origin": {"lat": 40.7, "lon": -74.0}, "unit": "km",
+            "ranges": [{"to": 100}, {"from": 100}]}}}})  # noqa: E501
+        buckets = r["aggregations"]["near_nyc"]["buckets"]
+        assert buckets[0]["doc_count"] == 2  # the two NYC-ish widgets
+        assert buckets[1]["doc_count"] == 4
+
+    def test_top_hits(self, ctx):
+        r = run(ctx, {"size": 0, "aggs": {
+            "cats": {"terms": {"field": "category", "order": {"_term": "asc"}},
+                     "aggs": {"top": {"top_hits": {"size": 1}}}}}})
+        buckets = r["aggregations"]["cats"]["buckets"]
+        assert buckets[0]["key"] == "gadgets"
+        assert len(buckets[0]["top"]["hits"]["hits"]) == 1
+
+    def test_facets_legacy_api(self, ctx):
+        r = run(ctx, {"size": 0, "facets": {
+            "cats": {"terms": {"field": "category"}},
+            "price_stats": {"statistical": {"field": "price"}},
+        }})
+        f = r["facets"]
+        assert f["cats"]["_type"] == "terms"
+        assert {t["term"]: t["count"] for t in f["cats"]["terms"]} == {
+            "widgets": 2, "gadgets": 2, "gizmos": 2}
+        assert f["price_stats"]["avg"] == pytest.approx(35.0)
+
+
+class TestSort:
+    def test_field_sort_asc_desc(self, ctx):
+        r = run(ctx, {"sort": [{"price": "desc"}], "size": 3})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["5", "4", "3"]
+        assert r["hits"]["hits"][0]["sort"] == [60.0]
+        r = run(ctx, {"sort": [{"price": "asc"}], "size": 2})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["0", "1"]
+
+    def test_sort_with_score_tiebreak(self, ctx):
+        r = run(ctx, {"query": {"match": {"name": "red"}},
+                      "sort": [{"category": "asc"}, "_score"], "size": 10})
+        ids = [h["_id"] for h in r["hits"]["hits"]]
+        assert ids[0] == "3"  # gadgets first alphabetically
+
+    def test_geo_distance_sort(self, ctx):
+        r = run(ctx, {"sort": [{"_geo_distance": {
+            "loc": {"lat": 40.7, "lon": -74.0}, "order": "asc", "unit": "km"}}],
+            "size": 3})
+        assert [h["_id"] for h in r["hits"]["hits"]][:2] == ["0", "1"]
+
+    def test_from_pagination(self, ctx):
+        r = run(ctx, {"sort": [{"price": "asc"}], "from": 2, "size": 2})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["2", "3"]
+        assert r["hits"]["total"] == 6
+
+
+class TestFetch:
+    def test_source_filtering(self, ctx):
+        r = run(ctx, {"query": {"ids": {"values": ["0"]}},
+                      "_source": {"includes": ["name", "price"]}})
+        src = r["hits"]["hits"][0]["_source"]
+        assert set(src) == {"name", "price"}
+        r = run(ctx, {"query": {"ids": {"values": ["0"]}}, "_source": False})
+        assert "_source" not in r["hits"]["hits"][0]
+
+    def test_fields_and_version(self, ctx):
+        r = run(ctx, {"query": {"ids": {"values": ["2"]}},
+                      "fields": ["category", "price"], "version": True})
+        h = r["hits"]["hits"][0]
+        assert h["fields"] == {"category": ["gadgets"], "price": [30]}
+        assert h["_version"] == 1
+
+    def test_script_fields(self, ctx):
+        r = run(ctx, {"query": {"ids": {"values": ["1"]}}, "script_fields": {
+            "double_price": {"script": "doc['price'].value * 2"}}})
+        assert r["hits"]["hits"][0]["fields"]["double_price"] == [40.0]
+
+    def test_highlight(self, ctx):
+        r = run(ctx, {"query": {"match": {"name": "red"}},
+                      "highlight": {"fields": {"name": {}}}})
+        for h in r["hits"]["hits"]:
+            assert "<em>red</em>" in h["highlight"]["name"][0]
+
+    def test_post_filter_does_not_affect_aggs(self, ctx):
+        r = run(ctx, {"query": {"match_all": {}},
+                      "filter": {"term": {"category": "widgets"}},
+                      "aggs": {"cats": {"terms": {"field": "category"}}}})
+        assert r["hits"]["total"] == 2  # post filter applied to hits
+        assert len(r["aggregations"]["cats"]["buckets"]) == 3  # but not to aggs
+
+    def test_min_score(self, ctx):
+        r_all = run(ctx, {"query": {"match": {"name": "red widget"}}, "size": 10})
+        scores = [h["_score"] for h in r_all["hits"]["hits"]]
+        cutoff = sorted(scores)[len(scores) // 2]
+        r = run(ctx, {"query": {"match": {"name": "red widget"}}, "min_score": cutoff,
+                      "size": 10})
+        assert all(h["_score"] >= cutoff for h in r["hits"]["hits"])
+        assert r["hits"]["total"] == sum(1 for s in scores if s >= cutoff)
+
+
+class TestRescore:
+    def test_rescore_total(self, ctx):
+        base = run(ctx, {"query": {"match": {"name": "red"}}, "size": 10})
+        r = run(ctx, {"query": {"match": {"name": "red"}}, "size": 10, "rescore": {
+            "window_size": 10,
+            "query": {"rescore_query": {"match": {"name": "widget"}},
+                      "query_weight": 1.0, "rescore_query_weight": 100.0},
+        }})
+        # docs matching "widget" must jump ahead
+        top = r["hits"]["hits"][0]
+        assert "widget" in top["_source"]["name"]
+        assert top["_score"] > base["hits"]["hits"][0]["_score"]
+
+
+class TestSuggest:
+    def test_term_suggester(self, ctx):
+        r = run(ctx, {"size": 0, "suggest": {
+            "fix": {"text": "widgit", "term": {"field": "name"}}}})
+        opts = r["suggest"]["fix"][0]["options"]
+        assert opts and opts[0]["text"] == "widget"
+
+    def test_phrase_suggester(self, ctx):
+        r = run(ctx, {"size": 0, "suggest": {
+            "fix": {"text": "red widgit", "phrase": {"field": "name"}}}})
+        texts = [o["text"] for o in r["suggest"]["fix"][0]["options"]]
+        assert "red widget" in texts
+
+
+class TestScroll:
+    def test_scroll_pages_through_everything(self, ctx):
+        svc = SearchService()
+        req = parse_search_body({"query": {"match_all": {}}, "size": 2,
+                                 "sort": [{"price": "asc"}]})
+        cid, first = svc.create_scroll(ctx, req)
+        seen = [d[1] for d in first.docs]
+        done = False
+        while not done:
+            page, done = svc.scroll(cid)
+            seen.extend(d[1] for d in page.docs)
+        assert len(seen) == 6 and len(set(seen)) == 6
+        assert svc.free(cid)
+        with pytest.raises(Exception):
+            svc.scroll(cid)
