@@ -1,0 +1,1 @@
+from .mesh_search import ShardedIndex, MeshSearchExecutor, build_sharded_index  # noqa: F401

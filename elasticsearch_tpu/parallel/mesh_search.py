@@ -1,0 +1,477 @@
+"""Mesh search: every shard on its own device, one SPMD program per query batch.
+
+This is the TPU-native replacement for the reference's coordinator-loop scatter/gather
+(SURVEY.md §5.8): an N-shard index maps 1:1 onto an N-device mesh axis "shards", and the
+three distributed phases of a search become collectives INSIDE one jitted program:
+
+  DFS phase      → df/maxDoc/sumTTF psum over the shards axis
+                   (ref: DfsPhase + SearchPhaseController.aggregateDfs — an all-reduce)
+  query phase    → per-shard fused scoring (same math as ops/scoring.py)
+  top-k merge    → all_gather of per-shard top-k, then a second lax.top_k
+                   (ref: SearchPhaseController.sortDocs — the coordinator merge)
+
+Tie-breaking matches Lucene's merge: candidates are gathered shard-major, and XLA's
+top_k prefers lower indices on equal scores, so equal-score hits order by (shard asc,
+doc asc) exactly like the reference.
+
+A second mesh axis "replicas" data-parallelizes the QUERY BATCH — the direct analogue of
+the reference's replica groups serving different requests concurrently (read scaling),
+but as one SPMD program instead of a load balancer.
+
+Mesh layout (scaling-book recipe: pick a mesh, annotate shardings, let XLA insert
+collectives):
+    mesh  = Mesh(devices.reshape(R, S), ("replicas", "shards"))
+    index arrays  [S, ...]        → P("shards", ...)   replicated over "replicas"
+    query entries [R, S, M, ...]  → P("replicas", "shards", ...)
+    outputs       [R, Qd, k]      → P("replicas", ...)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+
+import numpy as np
+
+from ..common.smallfloat import decode_norm_doclen, NORM_TABLE
+from ..index.engine import Searcher
+from ..ops.device_index import BLOCK, _pow2_bucket
+from ..search.execute import (
+    GROUP_MUST_NOT,
+    MODE_BM25,
+    MODE_TFIDF,
+    Clause,
+    FlatPlan,
+    ShardContext,
+    lower_flat,
+)
+from ..search.similarity import BM25Similarity, TFIDFSimilarity
+
+_MUST_SHIFT, _NOT_SHIFT = 10, 20
+
+
+# ---------------------------------------------------------------------------
+# packing: searchers → stacked mesh arrays
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ShardCSR:
+    """One shard's postings flattened across its segments (doc ids rebased)."""
+
+    term_ids: dict  # (field, term) -> tid
+    post_offsets: np.ndarray
+    post_docs: np.ndarray
+    post_freqs: np.ndarray
+    norms: dict  # field -> uint8[D]
+    doc_count: int
+    live_parent: np.ndarray
+    max_doc: int
+    sum_ttf: dict  # field -> int
+    field_doc_count: dict
+
+
+def _combine_segments(searcher: Searcher) -> ShardCSR:
+    """Concatenate a shard's segments into one CSR (host-side, at mesh-pack time)."""
+    term_ids: dict = {}
+    rows: dict = {}
+    D = searcher.max_doc
+    norms_fields = set()
+    for seg in searcher.segments:
+        norms_fields.update(seg.norms)
+    norms = {f: np.zeros(D, dtype=np.uint8) for f in norms_fields}
+    live = np.zeros(D, dtype=bool)
+    sum_ttf: dict = {}
+    field_doc_count: dict = {}
+    for seg, base in zip(searcher.segments, searcher.bases):
+        live[base: base + seg.doc_count] = seg.live & seg.parent_mask
+        for f, arr in seg.norms.items():
+            norms[f][base: base + seg.doc_count] = arr
+        for f, st in seg.field_stats.items():
+            sum_ttf[f] = sum_ttf.get(f, 0) + st.sum_ttf
+            field_doc_count[f] = field_doc_count.get(f, 0) + st.doc_count
+        for f, td in seg.term_dict.items():
+            for term, tid in td.items():
+                s, e = int(seg.post_offsets[tid]), int(seg.post_offsets[tid + 1])
+                key = (f, term)
+                row = rows.get(key)
+                if row is None:
+                    rows[key] = [seg.post_docs[s:e] + base], [seg.post_freqs[s:e]]
+                else:
+                    row[0].append(seg.post_docs[s:e] + base)
+                    row[1].append(seg.post_freqs[s:e])
+    offsets = [0]
+    docs_parts, freqs_parts = [], []
+    for i, (key, (dparts, fparts)) in enumerate(sorted(rows.items())):
+        term_ids[key] = i
+        d = np.concatenate(dparts)
+        docs_parts.append(d)
+        freqs_parts.append(np.concatenate(fparts))
+        offsets.append(offsets[-1] + len(d))
+    return ShardCSR(
+        term_ids=term_ids,
+        post_offsets=np.asarray(offsets, dtype=np.int64),
+        post_docs=np.concatenate(docs_parts) if docs_parts else np.zeros(0, np.int32),
+        post_freqs=np.concatenate(freqs_parts) if freqs_parts else np.zeros(0, np.float32),
+        norms=norms,
+        doc_count=D,
+        live_parent=live,
+        max_doc=D,
+        sum_ttf=sum_ttf,
+        field_doc_count=field_doc_count,
+    )
+
+
+@dataclass
+class ShardedIndex:
+    """N shards packed to COMMON shapes and stacked along the mesh "shards" axis."""
+
+    n_shards: int
+    doc_pad: int
+    nb_pad: int
+    fields: list  # norm field order (fidx)
+    blk_docs: object  # [S, NB, B] int32 (device, sharded)
+    blk_freqs: object  # [S, NB, B] f32
+    norms: object  # [S, F, Dpad] uint8
+    live: object  # [S, Dpad] bool
+    shard_term_blocks: list  # per shard: (field, term) -> (blk_start, blk_end)
+    shard_term_df: list  # per shard: (field, term) -> df
+    max_doc: np.ndarray  # [S] int32 (host; also fed to psum)
+    sum_ttf: np.ndarray  # [S, F] f32
+    mesh: object = None
+
+    def global_max_doc(self) -> int:
+        return int(self.max_doc.sum())
+
+
+def build_sharded_index(searchers: list[Searcher], fields: list[str],
+                        mesh=None) -> ShardedIndex:
+    """Pack each shard to the max bucket shapes and stack; place on `mesh` axis
+    "shards" when given (device_put with NamedSharding), else host arrays."""
+    import jax
+    import jax.numpy as jnp
+
+    csrs = [_combine_segments(s) for s in searchers]
+    S = len(csrs)
+    doc_pad = _pow2_bucket(max(max(c.doc_count for c in csrs), 1), 128)
+    nb_needed = []
+    for c in csrs:
+        counts = np.diff(c.post_offsets)
+        nb_needed.append(int(((counts + BLOCK - 1) // BLOCK).sum()))
+    nb_pad = _pow2_bucket(max(nb_needed) + 1, 64)
+
+    blk_docs = np.full((S, nb_pad, BLOCK), doc_pad, dtype=np.int32)
+    blk_freqs = np.zeros((S, nb_pad, BLOCK), dtype=np.float32)
+    norms = np.zeros((S, len(fields), doc_pad), dtype=np.uint8)
+    live = np.zeros((S, doc_pad), dtype=bool)
+    shard_term_blocks = []
+    shard_term_df = []
+    max_doc = np.zeros(S, dtype=np.int32)
+    sum_ttf = np.zeros((S, len(fields)), dtype=np.float32)
+
+    for si, c in enumerate(csrs):
+        counts = np.diff(c.post_offsets)
+        nblks = (counts + BLOCK - 1) // BLOCK
+        blk_start = np.zeros(len(counts) + 1, dtype=np.int64)
+        np.cumsum(nblks, out=blk_start[1:])
+        flat_docs = blk_docs[si].reshape(-1)
+        flat_freqs = blk_freqs[si].reshape(-1)
+        if len(c.post_docs):
+            within = np.arange(len(c.post_docs), dtype=np.int64) - np.repeat(
+                c.post_offsets[:-1], counts)
+            slots = np.repeat(blk_start[:-1] * BLOCK, counts) + within
+            flat_docs[slots] = c.post_docs
+            flat_freqs[slots] = c.post_freqs
+        tb = {}
+        tdf = {}
+        for key, tid in c.term_ids.items():
+            tb[key] = (int(blk_start[tid]), int(blk_start[tid + 1]))
+            tdf[key] = int(counts[tid])
+        shard_term_blocks.append(tb)
+        shard_term_df.append(tdf)
+        live[si, : c.doc_count] = c.live_parent
+        for fi, f in enumerate(fields):
+            if f in c.norms:
+                norms[si, fi, : c.doc_count] = c.norms[f]
+            sum_ttf[si, fi] = c.sum_ttf.get(f, 0)
+        max_doc[si] = c.max_doc
+
+    def put(arr, spec):
+        if mesh is None:
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    from jax.sharding import PartitionSpec as P
+
+    spec = P("shards") if mesh is not None else None
+    return ShardedIndex(
+        n_shards=S, doc_pad=doc_pad, nb_pad=nb_pad, fields=list(fields),
+        blk_docs=put(blk_docs, spec),
+        blk_freqs=put(blk_freqs, spec),
+        norms=put(norms, spec),
+        live=put(live, spec),
+        shard_term_blocks=shard_term_blocks,
+        shard_term_df=shard_term_df,
+        max_doc=max_doc,
+        sum_ttf=sum_ttf,
+        mesh=mesh,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the SPMD program
+# ---------------------------------------------------------------------------
+
+
+def _mesh_score_program(k: int, n_queries: int, doc_pad: int, similarity_kind: int,
+                        k1: float, b: float):
+    """Returns the shard_map-able function (static shapes closed over)."""
+    import jax
+    import jax.numpy as jnp
+
+    DL_TABLE = jnp.asarray(decode_norm_doclen(np.arange(256, dtype=np.uint8)))
+    NORM_DECODE = jnp.asarray(NORM_TABLE.astype(np.float32))
+
+    def program(blk_docs, blk_freqs, norms, live,  # local shard slices [1, ...]
+                qidx, blk, clause_id, fidx, group, tfmode,  # entries [1, M]
+                df_local, boost, clause_qidx, clause_scoring,  # clauses [1?, C]
+                max_doc_local, sum_ttf_local,  # [1], [1, F]
+                n_must, msm, coord):  # per query [Qd], [Qd], [Qd, C+1]
+        blk_docs = blk_docs[0]
+        blk_freqs = blk_freqs[0]
+        norms_l = norms[0]
+        live_l = live[0]
+        qidx, blk, clause_id = qidx[0], blk[0], clause_id[0]
+        fidx, group, tfmode = fidx[0], group[0], tfmode[0]
+        df_local = df_local[0]
+
+        # ---- DFS phase: global stats as collectives over the shards axis ----
+        df_g = jax.lax.psum(df_local.astype(jnp.float32), "shards")  # [C]
+        N = jax.lax.psum(max_doc_local[0].astype(jnp.float32), "shards")  # scalar
+        ttf_g = jax.lax.psum(sum_ttf_local[0], "shards")  # [F]
+
+        if similarity_kind == 0:  # BM25
+            idf = jnp.log(1.0 + (N - df_g + 0.5) / (df_g + 0.5))
+            weight_c = idf * boost * jnp.float32(k1 + 1.0)
+            qn_per_query = jnp.ones(n_queries, jnp.float32)
+        else:  # TF-IDF
+            idf = 1.0 + jnp.log(N / (df_g + 1.0))
+            w_unnorm = idf * boost
+            ssw = jnp.zeros(n_queries, jnp.float32).at[clause_qidx].add(
+                jnp.where(clause_scoring & (df_g > 0), w_unnorm * w_unnorm, 0.0))
+            qn_per_query = jnp.where(ssw > 0, 1.0 / jnp.sqrt(ssw), 1.0)
+            weight_c = idf * idf * boost
+        weight_c = jnp.where(df_g > 0, weight_c, 0.0)
+
+        # per-field norm caches from global stats
+        avgdl = jnp.where(ttf_g > 0, ttf_g / jnp.maximum(N, 1.0), 1.0)  # [F]
+        bm25_cache = jnp.float32(k1) * (1.0 - b + b * DL_TABLE[None, :] / avgdl[:, None])
+
+        # ---- query phase: fused scoring (same pipeline as ops/scoring.py) ----
+        docs = blk_docs[blk]  # [M, B]
+        freqs = blk_freqs[blk]
+        valid = docs < doc_pad
+        docs_safe = jnp.where(valid, docs, 0)
+        nb = norms_l[fidx[:, None], docs_safe].astype(jnp.int32)
+        w = weight_c[clause_id]  # [M]
+        if similarity_kind == 1:
+            w = w * qn_per_query[qidx]
+        w = w[:, None]
+        if similarity_kind == 0:
+            cache_vals = bm25_cache[fidx[:, None], nb]
+            contrib = (w * freqs) / (freqs + cache_vals)
+        else:
+            contrib = jnp.sqrt(freqs) * w * NORM_DECODE[nb]
+        scoring = (group[:, None] != GROUP_MUST_NOT) & valid
+        contrib = jnp.where(scoring, contrib, 0.0)
+
+        counters = (
+            jnp.where(group == 0, 1, 0)
+            + jnp.where(group == 1, 1 << _MUST_SHIFT, 0)
+            + jnp.where(group == 2, 1 << _NOT_SHIFT, 0)
+        ).astype(jnp.int32)
+        counter_vals = jnp.where(valid, counters[:, None], 0)
+        flat_idx = jnp.where(valid, qidx[:, None] * (doc_pad + 1) + docs_safe,
+                             n_queries * (doc_pad + 1))
+        scores = jnp.zeros(n_queries * (doc_pad + 1), jnp.float32).at[
+            flat_idx.reshape(-1)].add(contrib.reshape(-1), mode="drop"
+        ).reshape(n_queries, doc_pad + 1)[:, :doc_pad]
+        counts = jnp.zeros(n_queries * (doc_pad + 1), jnp.int32).at[
+            flat_idx.reshape(-1)].add(counter_vals.reshape(-1), mode="drop"
+        ).reshape(n_queries, doc_pad + 1)[:, :doc_pad]
+
+        m_should = counts & 0x3FF
+        m_must = (counts >> _MUST_SHIFT) & 0x3FF
+        m_not = counts >> _NOT_SHIFT
+        match = (m_must == n_must[:, None]) & (m_should >= msm[:, None]) & (m_not == 0)
+        match = match & ((m_should + m_must) > 0) & live_l[None, :]
+
+        overlap = jnp.minimum(m_should + m_must, coord.shape[1] - 1)
+        scores = scores * jnp.take_along_axis(coord, overlap, axis=1)
+
+        neg_inf = jnp.float32(-jnp.inf)
+        masked = jnp.where(match, scores, neg_inf)
+        local_scores, local_docs = jax.lax.top_k(masked, k)  # [Qd, k]
+        shard_idx = jax.lax.axis_index("shards")
+        local_ids = jnp.where(
+            jnp.isfinite(local_scores),
+            shard_idx * doc_pad + local_docs,
+            jnp.int32(-1),
+        )
+
+        # ---- reduce phase: global top-k via all_gather (shard-major → Lucene
+        # tie-break order), totals via psum ----
+        g_scores = jax.lax.all_gather(local_scores, "shards")  # [S, Qd, k]
+        g_ids = jax.lax.all_gather(local_ids, "shards")
+        S = g_scores.shape[0]
+        g_scores = jnp.transpose(g_scores, (1, 0, 2)).reshape(n_queries, S * k)
+        g_ids = jnp.transpose(g_ids, (1, 0, 2)).reshape(n_queries, S * k)
+        top_scores, pos = jax.lax.top_k(g_scores, k)
+        top_ids = jnp.take_along_axis(g_ids, pos, axis=1)
+        totals = jax.lax.psum(match.sum(axis=1).astype(jnp.int32), "shards")
+        return (top_scores[None], top_ids[None], totals[None])
+
+    return program
+
+
+@dataclass
+class MeshTopDocs:
+    scores: np.ndarray  # [Q, k]
+    shard: np.ndarray  # [Q, k] (-1 = no hit)
+    doc: np.ndarray  # [Q, k] local doc id within shard
+    totals: np.ndarray  # [Q]
+
+
+class MeshSearchExecutor:
+    """Executes flat query plans against a ShardedIndex on a device mesh.
+
+    mesh axes: "shards" (index partition, required) and optionally "replicas"
+    (query-batch data parallelism)."""
+
+    def __init__(self, index: ShardedIndex, mesh, similarity="BM25",
+                 k1: float = 1.2, b: float = 0.75):
+        self.index = index
+        self.mesh = mesh
+        self.similarity_kind = 0 if str(similarity).upper() == "BM25" else 1
+        self.k1, self.b = k1, b
+        self._compiled: dict = {}
+
+    # -- host-side batch assembly -------------------------------------------
+    def _assemble(self, plans: list[FlatPlan]):
+        """Global clause table + per-shard entry arrays."""
+        idx = self.index
+        clauses = []  # (qi, field, term, boost, group, mode)
+        for qi, plan in enumerate(plans):
+            for c in plan.clauses:
+                mode = MODE_BM25 if self.similarity_kind == 0 else MODE_TFIDF
+                clauses.append((qi, c.field, c.term, c.boost * plan.boost, c.group, mode))
+        C = max(len(clauses), 1)
+        boost = np.zeros(C, np.float32)
+        clause_qidx = np.zeros(C, np.int32)
+        clause_scoring = np.zeros(C, bool)
+        fidx_c = np.zeros(C, np.int32)
+        group_c = np.zeros(C, np.int32)
+        df_local = np.zeros((idx.n_shards, C), np.int32)
+        field_pos = {f: i for i, f in enumerate(idx.fields)}
+        for ci, (qi, f, t, bst, grp, mode) in enumerate(clauses):
+            boost[ci] = bst
+            clause_qidx[ci] = qi
+            clause_scoring[ci] = grp != GROUP_MUST_NOT
+            fidx_c[ci] = field_pos.get(f, 0)
+            group_c[ci] = grp
+            for si in range(idx.n_shards):
+                df_local[si, ci] = idx.shard_term_df[si].get((f, t), 0)
+        # entries per shard
+        per_shard_entries: list[list] = [[] for _ in range(idx.n_shards)]
+        for ci, (qi, f, t, bst, grp, mode) in enumerate(clauses):
+            for si in range(idx.n_shards):
+                rng = idx.shard_term_blocks[si].get((f, t))
+                if rng is None:
+                    continue
+                for blk_row in range(rng[0], rng[1]):
+                    per_shard_entries[si].append(
+                        (qi, blk_row, ci, field_pos.get(f, 0), grp, mode))
+        M = _pow2_bucket(max(max((len(e) for e in per_shard_entries), default=1), 1), 16)
+        S = idx.n_shards
+        qidx = np.zeros((S, M), np.int32)
+        blk = np.full((S, M), idx.nb_pad - 1, np.int32)
+        clause_id = np.zeros((S, M), np.int32)
+        fidx = np.zeros((S, M), np.int32)
+        group = np.zeros((S, M), np.int32)
+        tfmode = np.zeros((S, M), np.int32)
+        for si, entries in enumerate(per_shard_entries):
+            for i, (qi, b_, ci, fi, g, m) in enumerate(entries):
+                qidx[si, i], blk[si, i], clause_id[si, i] = qi, b_, ci
+                fidx[si, i], group[si, i], tfmode[si, i] = fi, g, m
+        # per-query bool semantics
+        Q = len(plans)
+        n_scoring_max = max(
+            (sum(1 for c in p.clauses if c.group != GROUP_MUST_NOT) for p in plans),
+            default=1) or 1
+        n_must = np.zeros(Q, np.int32)
+        msm = np.zeros(Q, np.int32)
+        coord = np.ones((Q, n_scoring_max + 1), np.float32)
+        for qi, p in enumerate(plans):
+            n_must[qi] = p.n_must
+            msm[qi] = p.msm
+            n_sc = sum(1 for c in p.clauses if c.group != GROUP_MUST_NOT)
+            if p.coord_enabled and self.similarity_kind == 1 and n_sc > 0:
+                row = np.arange(n_scoring_max + 1, dtype=np.float32) / np.float32(n_sc)
+                coord[qi] = np.minimum(row, 1.0)
+                coord[qi, : n_sc + 1] = np.arange(n_sc + 1, dtype=np.float32) / np.float32(n_sc)
+        return (qidx, blk, clause_id, fidx, group, tfmode, df_local, boost,
+                clause_qidx, clause_scoring, n_must, msm, coord)
+
+    def search(self, plans: list[FlatPlan], k: int) -> MeshTopDocs:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        try:
+            from jax import shard_map  # jax >= 0.7 public API
+        except ImportError:  # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map
+
+        idx = self.index
+        Q = len(plans)
+        (qidx, blk, clause_id, fidx, group, tfmode, df_local, boost, clause_qidx,
+         clause_scoring, n_must, msm, coord) = self._assemble(plans)
+
+        key = (Q, k, qidx.shape[1], coord.shape[1])
+        fn = self._compiled.get(key)
+        if fn is None:
+            program = _mesh_score_program(k, Q, idx.doc_pad, self.similarity_kind,
+                                          self.k1, self.b)
+            fn = shard_map(
+                program, mesh=self.mesh,
+                in_specs=(
+                    P("shards"), P("shards"), P("shards"), P("shards"),  # index
+                    P("shards"), P("shards"), P("shards"), P("shards"), P("shards"), P("shards"),  # entries
+                    P("shards"), P(), P(), P(),  # clause tables (df sharded)
+                    P("shards"), P("shards"),  # stats
+                    P(), P(), P(),  # per-query
+                ),
+                out_specs=(P(), P(), P()),
+                check_vma=False,
+            )
+            fn = jax.jit(fn)
+            self._compiled[key] = fn
+        S = idx.n_shards
+        top_scores, top_ids, totals = fn(
+            idx.blk_docs, idx.blk_freqs, idx.norms, idx.live,
+            jnp.asarray(qidx), jnp.asarray(blk), jnp.asarray(clause_id),
+            jnp.asarray(fidx), jnp.asarray(group), jnp.asarray(tfmode),
+            jnp.asarray(df_local), jnp.asarray(boost), jnp.asarray(clause_qidx),
+            jnp.asarray(clause_scoring),
+            jnp.asarray(idx.max_doc), jnp.asarray(idx.sum_ttf),
+            jnp.asarray(n_must), jnp.asarray(msm), jnp.asarray(coord),
+        )
+        top_scores = np.asarray(top_scores)[0]
+        top_ids = np.asarray(top_ids)[0]
+        totals = np.asarray(totals)[0]
+        shard = np.where(top_ids >= 0, top_ids // idx.doc_pad, -1)
+        doc = np.where(top_ids >= 0, top_ids % idx.doc_pad, -1)
+        shard = np.where(np.isfinite(top_scores), shard, -1)
+        doc = np.where(shard >= 0, doc, -1)
+        return MeshTopDocs(scores=top_scores, shard=shard, doc=doc, totals=totals)
