@@ -1,0 +1,1090 @@
+"""Action layer: the API kernel over transport.
+
+Analogue of action/ (69k LoC — SURVEY.md §2.6). Each API is a transport action
+implementing one of the reference's interaction patterns (action/support/):
+
+- master-node  (TransportMasterNodeOperationAction): forwarded to the elected master,
+  which mutates cluster state through the single-threaded executor → publish.
+  [create/delete/open/close index, mappings, settings, aliases, templates, reroute]
+- replication  (TransportShardReplicationOperationAction): route to primary by djb2,
+  write-consistency precheck, primary op, fan to assigned replicas, ack.
+  [index, delete, bulk per-shard groups, update (get-modify-reindex on primary)]
+- single-shard (TransportSingleShardOperationAction): one active copy, realtime.
+  [get, multi_get, explain, termvector-lite]
+- scatter-gather (TransportSearchTypeAction): one copy per shard group, per-shard
+  query phase (+ optional DFS pre-phase), controller reduce, fetch winners, per-shard
+  failover to the next copy on failure.
+  [search (query_then_fetch / dfs_query_then_fetch / count / scan), msearch, count,
+   suggest, delete_by_query (broadcast), refresh/flush/optimize (broadcast)]
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+import time
+import uuid
+
+from .common.errors import (
+    DocumentMissingError,
+    IndexAlreadyExistsError,
+    IndexMissingError,
+    MasterNotDiscoveredError,
+    NoShardAvailableError,
+    SearchEngineError,
+    UnavailableShardsError,
+    VersionConflictError,
+)
+from .common.logging import get_logger
+from .common.settings import Settings, validate_index_name
+from .cluster.allocation import new_index_routing
+from .cluster.service import HIGH, URGENT
+from .cluster.state import (
+    BLOCK_INDEX_CLOSED,
+    ClusterState,
+    IndexMetaData,
+    IndexTemplateMetaData,
+    ShardRouting,
+)
+from .index.translog import CREATE, DELETE, INDEX, TranslogOp
+from .indices_service import ACTION_SHARD_FAILED, ACTION_SHARD_STARTED
+from .search.controller import (
+    aggregate_dfs,
+    collect_dfs,
+    DfsResult,
+    merge_responses,
+    sort_docs,
+)
+from .search.execute import ShardContext
+from .search.queries import parse_query
+from .search.service import (
+    ParsedSearchRequest,
+    ShardQueryResult,
+    execute_fetch_phase,
+    execute_query_phase,
+    parse_search_body,
+)
+
+A_CREATE_INDEX = "indices:admin/create"
+A_DELETE_INDEX = "indices:admin/delete"
+A_OPEN_INDEX = "indices:admin/open"
+A_CLOSE_INDEX = "indices:admin/close"
+A_PUT_MAPPING = "indices:admin/mapping/put"
+A_UPDATE_SETTINGS = "indices:admin/settings/update"
+A_ALIASES = "indices:admin/aliases"
+A_PUT_TEMPLATE = "indices:admin/template/put"
+A_DELETE_TEMPLATE = "indices:admin/template/delete"
+A_CLUSTER_SETTINGS = "cluster:admin/settings/update"
+A_REROUTE = "cluster:admin/reroute"
+A_MAPPING_UPDATED = "internal:cluster/mapping_updated"
+
+A_INDEX_PRIMARY = "indices:data/write/index[p]"
+A_INDEX_REPLICA = "indices:data/write/index[r]"
+A_DELETE_PRIMARY = "indices:data/write/delete[p]"
+A_DELETE_REPLICA = "indices:data/write/delete[r]"
+A_BULK_SHARD = "indices:data/write/bulk[s]"
+A_GET = "indices:data/read/get[s]"
+A_QUERY_PHASE = "indices:data/read/search[phase/query]"
+A_FETCH_PHASE = "indices:data/read/search[phase/fetch]"
+A_DFS_PHASE = "indices:data/read/search[phase/dfs]"
+A_SHARD_BROADCAST = "indices:admin/broadcast[s]"
+
+
+class ActionModule:
+    """Registers every handler on one node + provides coordinator entry points."""
+
+    def __init__(self, node):
+        self.node = node
+        self.transport = node.transport
+        self.cluster_service = node.cluster_service
+        self.indices = node.indices
+        self.routing = node.operation_routing
+        self.allocation = node.allocation
+        self.logger = get_logger("action", node=node.name)
+        t = self.transport
+        # master-node actions
+        for action, fn in [
+            (A_CREATE_INDEX, self._m_create_index),
+            (A_DELETE_INDEX, self._m_delete_index),
+            (A_OPEN_INDEX, self._m_open_index),
+            (A_CLOSE_INDEX, self._m_close_index),
+            (A_PUT_MAPPING, self._m_put_mapping),
+            (A_UPDATE_SETTINGS, self._m_update_settings),
+            (A_ALIASES, self._m_aliases),
+            (A_PUT_TEMPLATE, self._m_put_template),
+            (A_DELETE_TEMPLATE, self._m_delete_template),
+            (A_CLUSTER_SETTINGS, self._m_cluster_settings),
+            (A_REROUTE, self._m_reroute),
+            (A_MAPPING_UPDATED, self._m_mapping_updated),
+            (ACTION_SHARD_STARTED, self._m_shard_started),
+            (ACTION_SHARD_FAILED, self._m_shard_failed),
+        ]:
+            t.register_handler(action, self._master_wrap(action, fn))
+        # data-path actions
+        t.register_handler(A_INDEX_PRIMARY, self._p_index)
+        t.register_handler(A_INDEX_REPLICA, self._r_index)
+        t.register_handler(A_DELETE_PRIMARY, self._p_delete)
+        t.register_handler(A_DELETE_REPLICA, self._r_delete)
+        t.register_handler(A_BULK_SHARD, self._p_bulk_shard)
+        t.register_handler(A_GET, self._s_get)
+        t.register_handler(A_QUERY_PHASE, self._s_query_phase)
+        t.register_handler(A_FETCH_PHASE, self._s_fetch_phase)
+        t.register_handler(A_DFS_PHASE, self._s_dfs_phase)
+        t.register_handler(A_SHARD_BROADCAST, self._s_broadcast)
+
+    # ================= master-node pattern =================
+    def _master_wrap(self, action, fn):
+        def handler(request, channel):
+            state = self.cluster_service.state
+            if state.nodes.master_id is None:
+                raise MasterNotDiscoveredError("no master")
+            if state.nodes.master_id != self.node.node_id:
+                # forward to master (ref: TransportMasterNodeOperationAction)
+                master = state.nodes.master
+                return self.transport.submit_request(master.transport_address, action,
+                                                     request, timeout=30.0)
+            return fn(request, channel)
+
+        return handler
+
+    def _submit(self, source, fn, priority=HIGH, timeout=30.0) -> ClusterState:
+        return self.cluster_service.submit_state_update_task(source, fn, priority) \
+            .result(timeout)
+
+    def _m_create_index(self, request, channel):
+        index = request["index"]
+        validate_index_name(index)
+        body = request.get("body") or {}
+
+        def update(state: ClusterState) -> ClusterState:
+            if state.metadata.has_index(index):
+                raise IndexAlreadyExistsError(index)
+            settings = dict(body.get("settings") or {})
+            mappings = dict(body.get("mappings") or {})
+            aliases = dict(body.get("aliases") or {})
+            # apply matching templates lowest order first (ref: IndexTemplateMetaData)
+            for tpl in state.metadata.templates_for(index):
+                merged = dict(tpl.settings_map)
+                merged.update(Settings.from_flat(settings).as_dict())
+                settings = merged
+                import json as _json
+
+                for ttype, m in tpl.mappings:
+                    mappings.setdefault(ttype, _json.loads(m) if isinstance(m, str) else m)
+                for a, spec in tpl.aliases:
+                    aliases.setdefault(a, spec)
+            flat = Settings.from_flat(settings).as_dict()
+            flat.setdefault("index.number_of_shards",
+                            int(flat.pop("number_of_shards", 5)))
+            flat.setdefault("index.number_of_replicas",
+                            int(flat.pop("number_of_replicas", 1)))
+            meta = IndexMetaData(
+                name=index, settings_map=tuple(sorted(flat.items())),
+            )
+            for t, m in mappings.items():
+                meta = meta.with_mapping(t, m)
+            if aliases:
+                meta = meta.with_aliases(aliases)
+            new = state.next_version(
+                metadata=state.metadata.with_index(meta),
+                routing_table=state.routing_table.with_index(
+                    new_index_routing(index, meta.number_of_shards,
+                                      meta.number_of_replicas)),
+            )
+            return self.allocation.reroute(new)
+
+        self._submit(f"create-index[{index}]", update, priority=URGENT)
+        ok = self._wait_for_active_primaries(index, timeout=10.0)
+        return {"acknowledged": True, "index": index, "primaries_active": ok}
+
+    def _m_delete_index(self, request, channel):
+        indices = self.cluster_service.state.metadata.resolve_indices(request["index"])
+
+        def update(state: ClusterState) -> ClusterState:
+            md, rt = state.metadata, state.routing_table
+            for index in indices:
+                md = md.without_index(index)
+                rt = rt.without_index(index)
+            return state.next_version(metadata=md, routing_table=rt)
+
+        self._submit(f"delete-index{indices}", update, priority=URGENT)
+        return {"acknowledged": True}
+
+    def _m_open_index(self, request, channel):
+        return self._set_index_state(request["index"], "open")
+
+    def _m_close_index(self, request, channel):
+        return self._set_index_state(request["index"], "close")
+
+    def _set_index_state(self, index_expr, target):
+        indices = self.cluster_service.state.metadata.resolve_indices(index_expr)
+
+        def update(state: ClusterState) -> ClusterState:
+            md, rt, blocks = state.metadata, state.routing_table, state.blocks
+            from dataclasses import replace as _replace
+
+            for index in indices:
+                meta = md.require_index(index)
+                md = md.with_index(_replace(meta, state=target, version=meta.version + 1))
+                if target == "close":
+                    rt = rt.without_index(index)
+                    blocks = blocks.with_index_block(index, BLOCK_INDEX_CLOSED)
+                else:
+                    rt = rt.with_index(new_index_routing(
+                        index, meta.number_of_shards, meta.number_of_replicas))
+                    blocks = blocks.without_index(index)
+            new = state.next_version(metadata=md, routing_table=rt, blocks=blocks)
+            return self.allocation.reroute(new)
+
+        self._submit(f"{target}-index{indices}", update, priority=URGENT)
+        return {"acknowledged": True}
+
+    def _m_put_mapping(self, request, channel):
+        indices = self.cluster_service.state.metadata.resolve_indices(request["index"])
+        type_name = request["type"]
+        mapping = request["body"].get(type_name, request["body"])
+
+        def update(state: ClusterState) -> ClusterState:
+            md = state.metadata
+            for index in indices:
+                meta = md.require_index(index)
+                existing = meta.mapping(type_name) or {}
+                # validate merge via a throwaway mapper (conflicts raise)
+                from .mapper import MapperService as MS
+
+                svc = MS(meta.settings)
+                if existing:
+                    svc.put_mapping(type_name, existing)
+                svc.put_mapping(type_name, mapping)
+                merged_out = svc.mappings_dict()[type_name]
+                md = md.with_index(meta.with_mapping(type_name, merged_out))
+            return state.next_version(metadata=md)
+
+        self._submit(f"put-mapping[{indices}/{type_name}]", update)
+        return {"acknowledged": True}
+
+    def _m_mapping_updated(self, request, channel):
+        """Dynamic-mapping propagation from data nodes (ref: MappingUpdatedAction)."""
+        return self._m_put_mapping(
+            {"index": request["index"], "type": request["type"],
+             "body": request["mapping"]}, channel)
+
+    def _m_update_settings(self, request, channel):
+        indices = self.cluster_service.state.metadata.resolve_indices(request["index"])
+        flat = Settings.from_flat(request["body"].get("settings", request["body"])).as_dict()
+        normalized = {}
+        for k, v in flat.items():
+            normalized[k if k.startswith("index.") else f"index.{k}"] = v
+
+        def update(state: ClusterState) -> ClusterState:
+            md = state.metadata
+            rt = state.routing_table
+            for index in indices:
+                meta = md.require_index(index)
+                old_replicas = meta.number_of_replicas
+                meta = meta.with_settings(normalized)
+                md = md.with_index(meta)
+                if meta.number_of_replicas != old_replicas:
+                    rt = self._resize_replicas(rt, index, meta.number_of_replicas)
+            new = state.next_version(metadata=md, routing_table=rt)
+            return self.allocation.reroute(new)
+
+        self._submit(f"update-settings{indices}", update)
+        return {"acknowledged": True}
+
+    @staticmethod
+    def _resize_replicas(rt, index, target):
+        from dataclasses import replace as _replace
+
+        from .cluster.state import IndexRoutingTable, IndexShardRoutingTable
+
+        table = rt.index(index)
+        groups = []
+        for grp in table.shards:
+            primary = [s for s in grp.shards if s.primary]
+            replicas = [s for s in grp.shards if not s.primary]
+            while len(replicas) > target:
+                replicas.pop()
+            while len(replicas) < target:
+                replicas.append(ShardRouting(index, grp.shards[0].shard_id, None, False))
+            groups.append(IndexShardRoutingTable(tuple(primary + replicas)))
+        return rt.with_index(IndexRoutingTable(index, tuple(groups)))
+
+    def _m_aliases(self, request, channel):
+        actions = request["body"].get("actions", [])
+
+        def update(state: ClusterState) -> ClusterState:
+            md = state.metadata
+            for entry in actions:
+                (op, spec), = entry.items()
+                index = spec["index"]
+                alias = spec["alias"]
+                meta = md.require_index(index)
+                aliases = dict(meta.aliases)
+                if op == "add":
+                    aliases[alias] = {k: v for k, v in spec.items()
+                                      if k in ("filter", "index_routing", "search_routing", "routing")}
+                elif op == "remove":
+                    aliases.pop(alias, None)
+                md = md.with_index(meta.with_aliases(aliases))
+            return state.next_version(metadata=md)
+
+        self._submit("aliases", update)
+        return {"acknowledged": True}
+
+    def _m_put_template(self, request, channel):
+        name = request["name"]
+        body = request["body"]
+
+        def update(state: ClusterState) -> ClusterState:
+            tpl = IndexTemplateMetaData(
+                name=name, template=body.get("template", "*"),
+                order=int(body.get("order", 0)),
+                settings_map=tuple(sorted(
+                    Settings.from_flat(body.get("settings", {})).as_dict().items())),
+                mappings=tuple((t, __import__("json").dumps(m))
+                               for t, m in (body.get("mappings") or {}).items()),
+                aliases=tuple(sorted((body.get("aliases") or {}).items())),
+            )
+            return state.next_version(metadata=state.metadata.with_template(tpl))
+
+        self._submit(f"put-template[{name}]", update)
+        return {"acknowledged": True}
+
+    def _m_delete_template(self, request, channel):
+        name = request["name"]
+
+        def update(state: ClusterState) -> ClusterState:
+            return state.next_version(metadata=state.metadata.without_template(name))
+
+        self._submit(f"delete-template[{name}]", update)
+        return {"acknowledged": True}
+
+    def _m_cluster_settings(self, request, channel):
+        body = request["body"]
+
+        def update(state: ClusterState) -> ClusterState:
+            md = state.metadata
+            from dataclasses import replace as _replace
+
+            transient = dict(md.transient_settings)
+            transient.update(Settings.from_flat(body.get("transient", {})).as_dict())
+            persistent = dict(md.persistent_settings)
+            persistent.update(Settings.from_flat(body.get("persistent", {})).as_dict())
+            md = _replace(md, transient_settings=tuple(sorted(transient.items())),
+                          persistent_settings=tuple(sorted(persistent.items())),
+                          version=md.version + 1)
+            return state.next_version(metadata=md)
+
+        self._submit("cluster-settings", update)
+        return {"acknowledged": True,
+                "transient": body.get("transient", {}),
+                "persistent": body.get("persistent", {})}
+
+    def _m_reroute(self, request, channel):
+        commands = (request.get("body") or {}).get("commands", [])
+
+        def update(state: ClusterState) -> ClusterState:
+            from dataclasses import replace as _replace
+
+            for entry in commands:
+                (cmd, spec), = entry.items()
+                index, shard = spec["index"], int(spec["shard"])
+                table = state.routing_table.index(index)
+                group = table.shard(shard)
+                shards = list(group.shards)
+                if cmd in ("move",):
+                    for i, s in enumerate(shards):
+                        if s.node_id == spec["from_node"] and s.active:
+                            shards[i] = _replace(s, node_id=spec["to_node"],
+                                                 state="INITIALIZING")
+                elif cmd in ("cancel",):
+                    for i, s in enumerate(shards):
+                        if s.node_id == spec.get("node") and not s.primary:
+                            shards[i] = _replace(s, node_id=None, state="UNASSIGNED")
+                elif cmd in ("allocate", "allocate_replica"):
+                    for i, s in enumerate(shards):
+                        if not s.assigned and not s.primary:
+                            shards[i] = _replace(s, node_id=spec["node"],
+                                                 state="INITIALIZING")
+                            break
+                from .cluster.state import IndexRoutingTable, IndexShardRoutingTable
+
+                groups = list(table.shards)
+                groups[shard] = IndexShardRoutingTable(tuple(shards))
+                state = state.next_version(routing_table=state.routing_table.with_index(
+                    IndexRoutingTable(index, tuple(groups))))
+            return self.allocation.reroute(state)
+
+        new_state = self._submit("reroute", update, priority=URGENT)
+        return {"acknowledged": True, "state_version": new_state.version}
+
+    def _m_shard_started(self, request, channel):
+        shard = ShardRouting.from_dict(request["shard"])
+
+        def update(state: ClusterState) -> ClusterState:
+            return self.allocation.apply_started_shards(state, [shard])
+
+        self._submit(f"shard-started[{shard.index}][{shard.shard_id}]", update,
+                     priority=URGENT)
+        return {"ok": True}
+
+    def _m_shard_failed(self, request, channel):
+        shard = ShardRouting.from_dict(request["shard"])
+
+        def update(state: ClusterState) -> ClusterState:
+            return self.allocation.apply_failed_shard(state, shard)
+
+        self._submit(f"shard-failed[{shard.index}][{shard.shard_id}]", update,
+                     priority=URGENT)
+        return {"ok": True}
+
+    def _wait_for_active_primaries(self, index: str, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            table = self.cluster_service.state.routing_table.index(index)
+            if table is not None and table.primaries_active():
+                return True
+            time.sleep(0.02)
+        return False
+
+    # ================= replication pattern =================
+    def _resolve_index_write(self, index: str) -> str:
+        state = self.cluster_service.state
+        if not state.metadata.has_index(index):
+            # write to an alias targeting exactly one index
+            resolved = state.metadata.resolve_indices(index)
+            if len(resolved) == 1:
+                return resolved[0]
+            raise IndexMissingError(index)
+        return index
+
+    def index_doc(self, index: str, type_name: str, doc_id: str | None, source: dict,
+                  routing=None, version=None, version_type="internal",
+                  op_type="index", refresh=False, consistency="quorum",
+                  auto_create=True) -> dict:
+        state = self.cluster_service.state
+        if not state.metadata.has_index(index) and auto_create:
+            try:
+                resolved = state.metadata.resolve_indices(index)
+                index = resolved[0] if len(resolved) == 1 else index
+            except IndexMissingError:
+                try:
+                    self.transport.submit_request(
+                        self.node.local_node, A_CREATE_INDEX,
+                        {"index": index, "body": {}}, timeout=30.0)
+                except IndexAlreadyExistsError:
+                    pass
+                state = self.cluster_service.state
+        index = self._resolve_index_write(index)
+        if doc_id is None:
+            doc_id = uuid.uuid4().hex[:20]
+        req = {"index": index, "type": type_name, "id": doc_id, "source": source,
+               "routing": routing, "version": version, "version_type": version_type,
+               "op_type": op_type, "refresh": refresh, "consistency": consistency}
+        return self._route_to_primary(index, doc_id, routing, A_INDEX_PRIMARY, req)
+
+    def delete_doc(self, index: str, type_name: str, doc_id: str, routing=None,
+                   version=None, refresh=False) -> dict:
+        index = self._resolve_index_write(index)
+        req = {"index": index, "type": type_name, "id": doc_id, "routing": routing,
+               "version": version, "refresh": refresh}
+        return self._route_to_primary(index, doc_id, routing, A_DELETE_PRIMARY, req)
+
+    def update_doc(self, index: str, type_name: str, doc_id: str, body: dict,
+                   routing=None, retry_on_conflict: int = 0) -> dict:
+        """Get-modify-reindex on the coordinator with CAS retry
+        (ref: TransportUpdateAction.java:212-270)."""
+        index = self._resolve_index_write(index)
+        attempts = retry_on_conflict + 1
+        last_error = None
+        for _ in range(attempts):
+            try:
+                current = self.get_doc(index, type_name, doc_id, routing=routing)
+                if not current["found"]:
+                    if "upsert" in body:
+                        return self.index_doc(index, type_name, doc_id, body["upsert"],
+                                              routing=routing, op_type="create")
+                    raise DocumentMissingError(f"[{index}][{type_name}][{doc_id}] missing")
+                source = dict(current["_source"])
+                if "script" in body:
+                    from .script import compile_script
+
+                    class _Ctx:
+                        pass
+
+                    cs = compile_script(body["script"], body.get("params", {}))
+                    # scripts mutate `ctx.source` — expression-only language, so we
+                    # expose merge semantics: result dict replaces source
+                    result = cs(_SourceDoc(source), _score=0.0, ctx={"_source": source})
+                    if isinstance(result, dict):
+                        source = result
+                elif "doc" in body:
+                    _deep_merge(source, body["doc"])
+                return self.index_doc(index, type_name, doc_id, source, routing=routing,
+                                      version=current["_version"])
+            except VersionConflictError as e:
+                last_error = e
+        raise last_error
+
+    def _route_to_primary(self, index: str, doc_id: str, routing, action, req) -> dict:
+        state = self.cluster_service.state
+        state.blocks.check("write", index)
+        deadline = time.monotonic() + 10.0
+        while True:
+            group = self.routing.index_shard(state, index, doc_id, routing)
+            primary = group.primary
+            if primary is not None and primary.active:
+                node = state.nodes.get(primary.node_id)
+                req["shard"] = primary.shard_id
+                try:
+                    return self.transport.submit_request(node, action, req, timeout=30.0)
+                except (NoShardAvailableError, SearchEngineError) as e:
+                    if isinstance(e, VersionConflictError) or time.monotonic() > deadline:
+                        raise
+            if time.monotonic() > deadline:
+                raise UnavailableShardsError(
+                    f"primary not active for [{index}] doc [{doc_id}]")
+            # wait for the next cluster state (ref: retry on cluster state change)
+            time.sleep(0.05)
+            state = self.cluster_service.state
+
+    def _check_consistency(self, index: str, shard_id: int, consistency: str):
+        """ref: write consistency precheck :393-408 — quorum/one/all of the group."""
+        state = self.cluster_service.state
+        group = state.routing_table.index(index).shard(shard_id)
+        size = group.size()
+        active = len(group.active_shards())
+        if consistency == "one":
+            required = 1
+        elif consistency == "all":
+            required = size
+        else:
+            required = size // 2 + 1 if size > 2 else 1
+        if active < required:
+            raise UnavailableShardsError(
+                f"not enough active copies for [{index}][{shard_id}]: "
+                f"{active} < required {required}")
+
+    def _p_index(self, request, channel):
+        index, shard_id = request["index"], request["shard"]
+        self._check_consistency(index, shard_id, request.get("consistency", "quorum"))
+        shard = self.indices.index_service(index).shard(shard_id)
+        mapper = shard.engine.mapper_service.mapper_for(request["type"])
+        known_before = set(mapper.fields)
+        version, created = shard.engine.index(
+            request["type"], request["id"], request["source"],
+            routing=request.get("routing"), version=request.get("version"),
+            version_type=request.get("version_type", "internal"),
+            op_type=request.get("op_type", "index"),
+        )
+        if set(mapper.fields) - known_before:
+            # dynamic mapping grew: propagate to master → cluster state
+            # (ref: MappingUpdatedAction via TransportIndexAction.java:278-290)
+            try:
+                self.transport.submit_request(
+                    self.node.local_node, A_MAPPING_UPDATED,
+                    {"index": index, "type": request["type"],
+                     "mapping": mapper.to_mapping()}, timeout=10.0)
+            except SearchEngineError as e:
+                self.logger.warning("mapping update propagation failed: %s", e)
+        self._replicate(index, shard_id, A_INDEX_REPLICA,
+                        {**request, "version": version, "version_type": "external"})
+        if request.get("refresh"):
+            shard.engine.refresh()
+        shard.engine.maybe_flush()
+        return {"_index": index, "_type": request["type"], "_id": request["id"],
+                "_version": version, "created": created}
+
+    def _r_index(self, request, channel):
+        shard = self.indices.index_service(request["index"]).shard(request["shard"])
+        try:
+            shard.engine.index(
+                request["type"], request["id"], request["source"],
+                routing=request.get("routing"), version=request.get("version"),
+                version_type="external",
+            )
+        except VersionConflictError:
+            pass  # replica already has a newer copy
+        if request.get("refresh"):
+            shard.engine.refresh()
+        return {"ok": True}
+
+    def _p_delete(self, request, channel):
+        index, shard_id = request["index"], request["shard"]
+        shard = self.indices.index_service(index).shard(shard_id)
+        version, found = shard.engine.delete(
+            request["type"], request["id"], version=request.get("version"))
+        self._replicate(index, shard_id, A_DELETE_REPLICA, dict(request))
+        if request.get("refresh"):
+            shard.engine.refresh()
+        return {"_index": index, "_type": request["type"], "_id": request["id"],
+                "_version": version, "found": found}
+
+    def _r_delete(self, request, channel):
+        shard = self.indices.index_service(request["index"]).shard(request["shard"])
+        try:
+            shard.engine.delete(request["type"], request["id"])
+        except (VersionConflictError, SearchEngineError):
+            pass
+        return {"ok": True}
+
+    def _replicate(self, index: str, shard_id: int, action: str, request: dict):
+        """Fan the op to every assigned replica; failures fail the shard upward
+        (ref: :245 fan-out + ShardStateAction on replica error)."""
+        state = self.cluster_service.state
+        group = state.routing_table.index(index).shard(shard_id)
+        for replica in group.replicas():
+            if not replica.assigned:
+                continue
+            node = state.nodes.get(replica.node_id)
+            if node is None:
+                continue
+            try:
+                self.transport.submit_request(node, action, request, timeout=30.0)
+            except SearchEngineError as e:
+                self.logger.warning("replica [%s][%d] on %s failed: %s — reporting",
+                                    index, shard_id, replica.node_id, e)
+                try:
+                    self.transport.submit_request(
+                        self.node.local_node, ACTION_SHARD_FAILED,
+                        {"shard": replica.to_dict(), "reason": str(e)}, timeout=10.0)
+                except SearchEngineError:
+                    pass
+
+    def bulk(self, operations: list[dict], refresh=False) -> dict:
+        """Coordinator: group ops per (index, shard) → one A_BULK_SHARD per group
+        (ref: TransportShardBulkAction per-shard sub-batches)."""
+        t0 = time.monotonic()
+        state = self.cluster_service.state
+        prepared = []
+        for i, op in enumerate(operations):
+            (op_name, meta) = next(iter(op["action"].items()))
+            index = meta.get("_index")
+            type_name = meta.get("_type", "_default_")
+            doc_id = meta.get("_id") or uuid.uuid4().hex[:20]
+            routing = meta.get("_routing") or meta.get("routing")
+            if not state.metadata.has_index(index):
+                self.index_doc(index, type_name, doc_id, op.get("source") or {},
+                               routing=routing,
+                               op_type="create" if op_name == "create" else "index")
+                prepared.append((i, None, {"_index": index, "_type": type_name,
+                                           "_id": doc_id, "_version": 1,
+                                           "status": 201, "op": op_name}))
+                state = self.cluster_service.state
+                continue
+            shard_id = self.routing.shard_id(state, index, doc_id, routing)
+            prepared.append((i, (index, shard_id),
+                             {"op": op_name, "index": index, "type": type_name,
+                              "id": doc_id, "routing": routing,
+                              "source": op.get("source"),
+                              "version": meta.get("_version"),
+                              "body": op.get("source")}))
+        by_shard: dict = {}
+        for i, key, item in prepared:
+            if key is not None:
+                by_shard.setdefault(key, []).append((i, item))
+        results: dict[int, dict] = {i: item for i, key, item in prepared if key is None}
+        for (index, shard_id), items in by_shard.items():
+            group = state.routing_table.index(index).shard(shard_id)
+            primary = group.primary
+            node = state.nodes.get(primary.node_id) if primary and primary.assigned else None
+            if node is None:
+                for i, item in items:
+                    results[i] = {"error": "primary unavailable", "status": 503, **item}
+                continue
+            try:
+                resp = self.transport.submit_request(
+                    node, A_BULK_SHARD,
+                    {"index": index, "shard": shard_id, "refresh": refresh,
+                     "items": [item for _, item in items]}, timeout=60.0)
+                for (i, _item), r in zip(items, resp["items"]):
+                    results[i] = r
+            except SearchEngineError as e:
+                for i, item in items:
+                    results[i] = {"error": str(e), "status": 503}
+        items_out = [results[i] for i in range(len(operations))]
+        errors = any("error" in r for r in items_out)
+        return {"took": int((time.monotonic() - t0) * 1000), "errors": errors,
+                "items": [{r.pop("op", "index"): r} for r in items_out]}
+
+    def _p_bulk_shard(self, request, channel):
+        index, shard_id = request["index"], request["shard"]
+        shard = self.indices.index_service(index).shard(shard_id)
+        out = []
+        for item in request["items"]:
+            op = item.get("op", "index")
+            try:
+                if op in ("index", "create"):
+                    version, created = shard.engine.index(
+                        item["type"], item["id"], item.get("source") or {},
+                        routing=item.get("routing"), version=item.get("version"),
+                        op_type="create" if op == "create" else "index")
+                    out.append({"_index": index, "_type": item["type"], "_id": item["id"],
+                                "_version": version,
+                                "status": 201 if created else 200, "op": op})
+                elif op == "delete":
+                    version, found = shard.engine.delete(item["type"], item["id"])
+                    out.append({"_index": index, "_type": item["type"], "_id": item["id"],
+                                "_version": version, "found": found,
+                                "status": 200 if found else 404, "op": op})
+                elif op == "update":
+                    body = item.get("source") or {}
+                    r = self.update_doc(index, item["type"], item["id"], body,
+                                        routing=item.get("routing"))
+                    out.append({**r, "status": 200, "op": op})
+                else:
+                    out.append({"error": f"unknown bulk op [{op}]", "status": 400, "op": op})
+            except SearchEngineError as e:
+                out.append({"_index": index, "_type": item.get("type"),
+                            "_id": item.get("id"), "error": e.to_dict(),
+                            "status": e.status, "op": op})
+        # replicas get individual replicated ops (simple + idempotent via versions)
+        state = self.cluster_service.state
+        group = state.routing_table.index(index).shard(shard_id)
+        for replica in group.replicas():
+            if not replica.assigned:
+                continue
+            node = state.nodes.get(replica.node_id)
+            if node is None:
+                continue
+            for item, r in zip(request["items"], out):
+                if "error" in r:
+                    continue
+                try:
+                    if item.get("op") in ("index", "create", "update"):
+                        self.transport.submit_request(node, A_INDEX_REPLICA, {
+                            "index": index, "shard": shard_id, "type": item["type"],
+                            "id": item["id"], "source": item.get("source") or {},
+                            "routing": item.get("routing"),
+                            "version": r.get("_version"), "version_type": "external",
+                        }, timeout=30.0)
+                    elif item.get("op") == "delete":
+                        self.transport.submit_request(node, A_DELETE_REPLICA, {
+                            "index": index, "shard": shard_id, "type": item["type"],
+                            "id": item["id"],
+                        }, timeout=30.0)
+                except SearchEngineError:
+                    pass
+        if request.get("refresh"):
+            shard.engine.refresh()
+        shard.engine.maybe_flush()
+        return {"items": out}
+
+    # ================= single-shard reads =================
+    def get_doc(self, index: str, type_name: str, doc_id: str, routing=None,
+                realtime=True, preference=None) -> dict:
+        state = self.cluster_service.state
+        state.blocks.check("read", index)
+        index = state.metadata.resolve_indices(index)[0]
+        copy = self.routing.get_shard_copy(state, index, doc_id, routing, preference)
+        node = state.nodes.get(copy.node_id)
+        return self.transport.submit_request(node, A_GET, {
+            "index": index, "shard": copy.shard_id, "type": type_name, "id": doc_id,
+            "realtime": realtime}, timeout=10.0)
+
+    def _s_get(self, request, channel):
+        shard = self.indices.index_service(request["index"]).shard(request["shard"])
+        r = shard.engine.get(request["type"], request["id"],
+                             realtime=request.get("realtime", True))
+        out = {"_index": request["index"], "_type": request["type"],
+               "_id": request["id"], "found": r.found}
+        if r.found:
+            out["_version"] = r.version
+            out["_source"] = r.source
+        return out
+
+    def multi_get(self, docs: list[dict]) -> dict:
+        out = []
+        for d in docs:
+            try:
+                out.append(self.get_doc(d["_index"], d.get("_type", "_all"), d["_id"],
+                                        routing=d.get("routing")))
+            except SearchEngineError as e:
+                out.append({"_index": d.get("_index"), "_id": d.get("_id"),
+                            "error": e.to_dict()})
+        return {"docs": out}
+
+    # ================= scatter-gather search =================
+    def search(self, index_expr, body: dict | None = None, search_type="query_then_fetch",
+               routing=None, preference=None) -> dict:
+        t0 = time.monotonic()
+        state = self.cluster_service.state
+        indices = state.metadata.resolve_indices(index_expr)
+        # filtered aliases compose into the query (ref: filtered alias handling)
+        alias_filters = {i: state.metadata.alias_filter(i, index_expr) for i in indices}
+        req = parse_search_body(body)
+        shards = self.routing.search_shards(state, indices, routing, preference)
+        dfs_stats = None
+        if search_type in ("dfs_query_then_fetch", "dfs_query_and_fetch"):
+            dfs_results = []
+            for copy in shards:
+                node = state.nodes.get(copy.node_id)
+                r = self.transport.submit_request(node, A_DFS_PHASE, {
+                    "index": copy.index, "shard": copy.shard_id, "body": body or {},
+                }, timeout=30.0)
+                dfs_results.append(DfsResult(
+                    shard_id=copy.shard_id, max_doc=r["max_doc"],
+                    term_df={(f, t): v for f, t, v in r["term_df"]},
+                    field_stats={f: _fs_from(l) for f, l in r["field_stats"].items()},
+                ))
+            agg = aggregate_dfs(dfs_results)
+            dfs_stats = {
+                "max_doc": agg["max_doc"],
+                "term_df": [[f, t, v] for (f, t), v in agg["df"].items()],
+                "field_stats": {f: [s.doc_count, s.sum_ttf, s.sum_dfs]
+                                for f, s in agg["field_stats"].items()},
+            }
+        results: list[ShardQueryResult] = []
+        failures = []
+        shard_nodes = {}
+        for copy in shards:
+            r, used = self._query_with_failover(state, copy, body, alias_filters,
+                                                dfs_stats, failures)
+            if r is not None:
+                results.append(r)
+                shard_nodes[(r.shard_id, id(r))] = used
+        merged = sort_docs(req, results)
+        page = merged.hits[req.from_: req.from_ + req.size]
+        # fetch phase: winners only, grouped per shard
+        by_shard: dict = {}
+        for rank, (score, shard_id, doc, sort_values) in enumerate(page):
+            by_shard.setdefault(shard_id, []).append((rank, score, doc, sort_values))
+        fetched: dict[int, dict] = {}
+        for shard_id, entries in by_shard.items():
+            result = next(r for r in results if r.shard_id == shard_id)
+            node = shard_nodes[(result.shard_id, id(result))]
+            r = self.transport.submit_request(node, A_FETCH_PHASE, {
+                "index": result.index_name if hasattr(result, "index_name") else
+                         getattr(result, "index", None) or self._shard_index(shards, shard_id),
+                "shard": shard_id, "body": body or {},
+                "docs": [[score, doc, sort_values] for (_rank, score, doc, sort_values) in entries],
+            }, timeout=30.0)
+            for (rank, *_), hit in zip(entries, r["hits"]):
+                fetched[rank] = hit
+        hits = [fetched[r] for r in sorted(fetched)]
+        return merge_responses(req, merged, results, hits,
+                               took_ms=int((time.monotonic() - t0) * 1000),
+                               total_shards=len(shards),
+                               successful=len(results), failures=failures)
+
+    @staticmethod
+    def _shard_index(shards, shard_id):
+        for s in shards:
+            if s.shard_id == shard_id:
+                return s.index
+        return None
+
+    def _query_with_failover(self, state, copy: ShardRouting, body, alias_filters,
+                             dfs_stats, failures):
+        """Per-shard failover to the next active copy (ref: performFirstPhase:292)."""
+        group = state.routing_table.index(copy.index).shard(copy.shard_id)
+        candidates = [copy] + [s for s in group.active_shards() if s.node_id != copy.node_id]
+        last_err = None
+        for candidate in candidates:
+            node = state.nodes.get(candidate.node_id)
+            if node is None:
+                continue
+            try:
+                r = self.transport.submit_request(node, A_QUERY_PHASE, {
+                    "index": candidate.index, "shard": candidate.shard_id,
+                    "body": body or {},
+                    "alias_filter": alias_filters.get(candidate.index),
+                    "dfs": dfs_stats,
+                }, timeout=60.0)
+                result = ShardQueryResult(
+                    total=r["total"],
+                    docs=[tuple(d) for d in r["docs"]],
+                    max_score=r["max_score"] if r["max_score"] is not None else float("nan"),
+                    agg_partials=_decode_partials(r.get("agg_partials")),
+                    facet_partials=_decode_partials(r.get("facet_partials")),
+                    suggest=r.get("suggest"),
+                    shard_id=candidate.shard_id,
+                )
+                result.index_name = candidate.index  # type: ignore[attr-defined]
+                return result, node
+            except SearchEngineError as e:
+                last_err = e
+                continue
+        failures.append({"index": copy.index, "shard": copy.shard_id,
+                         "reason": str(last_err)})
+        return None, None
+
+    def _shard_ctx(self, index: str, shard_id: int, dfs: dict | None = None) -> ShardContext:
+        svc = self.indices.index_service(index)
+        shard = svc.shard(shard_id)
+        global_stats = None
+        if dfs:
+            global_stats = {
+                "max_doc": dfs["max_doc"],
+                "df": {(f, t): v for f, t, v in dfs["term_df"]},
+                "field_stats": {f: _fs_from(l) for f, l in dfs["field_stats"].items()},
+            }
+        return ShardContext(shard.engine.acquire_searcher(), svc.mapper_service,
+                            svc.similarity_service, global_stats)
+
+    def _s_query_phase(self, request, channel):
+        index, shard_id = request["index"], request["shard"]
+        body = dict(request.get("body") or {})
+        alias_filter = request.get("alias_filter")
+        if alias_filter:
+            query = body.get("query") or {"match_all": {}}
+            body["query"] = {"filtered": {"query": query, "filter": alias_filter}}
+        req = parse_search_body(body)
+        ctx = self._shard_ctx(index, shard_id, request.get("dfs"))
+        result = execute_query_phase(ctx, req, shard_id=shard_id)
+        return {
+            "total": result.total,
+            "docs": [[s, d, sv] for (s, d, sv) in result.docs],
+            "max_score": None if result.max_score != result.max_score else result.max_score,
+            "agg_partials": _encode_partials(result.agg_partials),
+            "facet_partials": _encode_partials(result.facet_partials),
+            "suggest": result.suggest,
+        }
+
+    def _s_fetch_phase(self, request, channel):
+        ctx = self._shard_ctx(request["index"], request["shard"])
+        req = parse_search_body(request.get("body") or {})
+        docs = [(s, d, sv) for s, d, sv in request["docs"]]
+        hits = execute_fetch_phase(ctx, req, docs, index_name=request["index"],
+                                   shard_id=request["shard"])
+        return {"hits": hits}
+
+    def _s_dfs_phase(self, request, channel):
+        ctx = self._shard_ctx(request["index"], request["shard"])
+        body = request.get("body") or {}
+        query = parse_query(body.get("query")) if body.get("query") else None
+        from .search.queries import MatchAllQuery
+
+        dfs = collect_dfs(ctx, query or MatchAllQuery(), shard_id=request["shard"])
+        return {
+            "max_doc": dfs.max_doc,
+            "term_df": [[f, t, v] for (f, t), v in dfs.term_df.items()],
+            "field_stats": {f: [s.doc_count, s.sum_ttf, s.sum_dfs]
+                            for f, s in dfs.field_stats.items()},
+        }
+
+    def count(self, index_expr, body=None) -> dict:
+        r = self.search(index_expr, {**(body or {}), "size": 0})
+        return {"count": r["hits"]["total"], "_shards": r["_shards"]}
+
+    def delete_by_query(self, index_expr, body) -> dict:
+        """Broadcast: resolve matching uids per shard, tombstone (ref: delete_by_query
+        replication action — here resolved per shard then replicated)."""
+        state = self.cluster_service.state
+        indices = state.metadata.resolve_indices(index_expr)
+        total = 0
+        for index in indices:
+            table = state.routing_table.index(index)
+            for group in table.shards:
+                for copy in [s for s in group.active_shards()]:
+                    node = state.nodes.get(copy.node_id)
+                    r = self.transport.submit_request(node, A_SHARD_BROADCAST, {
+                        "index": index, "shard": copy.shard_id, "op": "delete_by_query",
+                        "body": body}, timeout=30.0)
+                    if copy.primary:
+                        total += r.get("deleted", 0)
+        return {"_indices": {i: {"deleted": total} for i in indices}}
+
+    def broadcast(self, index_expr, op: str) -> dict:
+        """refresh / flush / optimize across all shard copies."""
+        state = self.cluster_service.state
+        indices = state.metadata.resolve_indices(index_expr) if index_expr else \
+            state.metadata.index_names()
+        total = 0
+        ok = 0
+        for index in indices:
+            table = state.routing_table.index(index)
+            if table is None:
+                continue
+            for group in table.shards:
+                for copy in group.active_shards():
+                    total += 1
+                    node = state.nodes.get(copy.node_id)
+                    try:
+                        self.transport.submit_request(node, A_SHARD_BROADCAST, {
+                            "index": index, "shard": copy.shard_id, "op": op,
+                        }, timeout=30.0)
+                        ok += 1
+                    except SearchEngineError:
+                        pass
+        return {"_shards": {"total": total, "successful": ok, "failed": total - ok}}
+
+    def _s_broadcast(self, request, channel):
+        shard = self.indices.index_service(request["index"]).shard(request["shard"])
+        op = request["op"]
+        if op == "refresh":
+            shard.engine.refresh()
+            return {"ok": True}
+        if op == "flush":
+            shard.engine.flush()
+            return {"ok": True}
+        if op == "optimize":
+            shard.engine.optimize()
+            return {"ok": True}
+        if op == "clear_cache":
+            for seg in shard.engine.acquire_searcher().segments:
+                seg._device_cache.pop("filters", None)
+            return {"ok": True}
+        if op == "delete_by_query":
+            ctx = self._shard_ctx(request["index"], request["shard"])
+            from .search.execute import host_match_mask
+            from .search.queries import parse_query as pq
+
+            query = pq((request.get("body") or {}).get("query"))
+            uids = []
+            for seg in ctx.searcher.segments:
+                mask = host_match_mask(query, seg, ctx) & seg.live & seg.parent_mask
+                import numpy as np
+
+                for local in np.nonzero(mask)[0]:
+                    uids.append(f"{seg.types[local]}#{seg.ids[local]}")
+            shard.engine.delete_by_uids(uids, query=(request.get("body") or {}).get("query"))
+            shard.engine.refresh()
+            return {"ok": True, "deleted": len(uids)}
+        raise SearchEngineError(f"unknown broadcast op [{op}]")
+
+
+class _SourceDoc:
+    """doc[...] access over a plain source dict (for update scripts)."""
+
+    def __init__(self, source: dict):
+        self._source = source
+
+    def __getitem__(self, field):
+        from .search.filters import FieldVal
+
+        v = self._source.get(field)
+        if v is None:
+            return FieldVal([])
+        return FieldVal(v if isinstance(v, list) else [v])
+
+
+def _deep_merge(dst: dict, src: dict):
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _deep_merge(dst[k], v)
+        else:
+            dst[k] = v
+
+
+def _fs_from(lst):
+    from .index.segment import FieldStats
+
+    return FieldStats(*lst)
+
+
+def _encode_partials(partials):
+    """Agg partials cross the wire pickled+b64 (they contain numpy arrays/sets;
+    a typed codec replaces this when the TCP transport hardens)."""
+    import pickle
+
+    return base64.b64encode(pickle.dumps(partials)).decode("ascii") if partials else None
+
+
+def _decode_partials(blob):
+    import pickle
+
+    if not blob:
+        return []
+    return pickle.loads(base64.b64decode(blob))

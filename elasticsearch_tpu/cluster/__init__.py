@@ -1,0 +1,19 @@
+from .state import (  # noqa: F401
+    ClusterState,
+    ClusterBlocks,
+    DiscoveryNode,
+    DiscoveryNodes,
+    IndexMetaData,
+    IndexRoutingTable,
+    IndexShardRoutingTable,
+    MetaData,
+    RoutingTable,
+    ShardRouting,
+    UNASSIGNED,
+    INITIALIZING,
+    STARTED,
+    RELOCATING,
+)
+from .routing import OperationRouting, djb2_hash  # noqa: F401
+from .allocation import AllocationService  # noqa: F401
+from .service import ClusterService  # noqa: F401

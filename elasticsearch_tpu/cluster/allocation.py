@@ -1,0 +1,347 @@
+"""Shard allocation: deciders + balanced allocator + reroute.
+
+Analogue of cluster/routing/allocation/ (SURVEY.md §2.2): AllocationService.reroute
+assigns UNASSIGNED shards (primaries first), applyStartedShards moves INITIALIZING →
+STARTED, applyFailedShard fails a copy (promoting a replica to primary when the primary
+dies). Placement is gated by a decider chain (ref: decider/*.java — 18 deciders; the
+load-bearing ones implemented):
+
+  SameShardDecider        — never two copies of a shard on one node
+  ReplicaAfterPrimary     — replicas wait for an active primary
+  EnableDecider           — cluster.routing.allocation.enable = all|primaries|none
+  FilterDecider           — include/exclude by node name/attrs
+  AwarenessDecider        — spread copies across zones (node attr)
+  ThrottlingDecider       — bounded concurrent recoveries per node
+  DiskThresholdDecider    — skip nodes over the disk watermark (injected usages)
+
+and placed by BalancedShardsAllocator: weight(node) = shard_count + index_spread factor
+(ref: allocator/BalancedShardsAllocator.java's weighted balance, simplified to its two
+dominant terms). Pure functions over ClusterState — unit-testable with no nodes, the
+same trick as ElasticsearchAllocationTestCase (SURVEY.md §4.5).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from dataclasses import replace
+
+from ..common.logging import get_logger
+from ..common.settings import Settings
+from .state import (
+    INITIALIZING,
+    RELOCATING,
+    STARTED,
+    UNASSIGNED,
+    ClusterState,
+    IndexRoutingTable,
+    IndexShardRoutingTable,
+    ShardRouting,
+)
+
+YES, NO, THROTTLE = "YES", "NO", "THROTTLE"
+
+
+class Decider:
+    name = "base"
+
+    def can_allocate(self, shard: ShardRouting, node_id: str, ctx: "AllocationContext") -> str:
+        return YES
+
+
+class SameShardDecider(Decider):
+    name = "same_shard"
+
+    def can_allocate(self, shard, node_id, ctx):
+        for s in ctx.shards_on_node(node_id):
+            if s.shard_key() == shard.shard_key():
+                return NO
+        return YES
+
+
+class ReplicaAfterPrimaryDecider(Decider):
+    name = "replica_after_primary_active"
+
+    def can_allocate(self, shard, node_id, ctx):
+        if shard.primary:
+            return YES
+        group = ctx.state.routing_table.index(shard.index).shard(shard.shard_id)
+        p = group.primary
+        return YES if p is not None and p.active else NO
+
+
+class EnableDecider(Decider):
+    name = "enable"
+
+    def can_allocate(self, shard, node_id, ctx):
+        mode = ctx.settings.get_str("cluster.routing.allocation.enable", "all")
+        if mode == "none":
+            return NO
+        if mode == "primaries" and not shard.primary:
+            return NO
+        if mode == "new_primaries" and not shard.primary:
+            return NO
+        return YES
+
+
+class FilterDecider(Decider):
+    name = "filter"
+
+    def can_allocate(self, shard, node_id, ctx):
+        node = ctx.state.nodes.get(node_id)
+        if node is None:
+            return NO
+        for scope, settings in (("cluster.routing.allocation", ctx.settings),
+                                (f"index.routing.allocation", ctx.index_settings(shard.index))):
+            for rule, positive in (("include", True), ("require", True), ("exclude", False)):
+                prefix = f"{scope}.{rule}."
+                for key in settings:
+                    if not key.startswith(prefix):
+                        continue
+                    attr = key[len(prefix):]
+                    patterns = [p.strip() for p in str(settings[key]).split(",") if p.strip()]
+                    value = node.name if attr == "_name" else (
+                        node.id if attr == "_id" else node.attr(attr, ""))
+                    matched = any(fnmatch.fnmatch(str(value), p) for p in patterns)
+                    if rule == "exclude" and matched:
+                        return NO
+                    if rule == "require" and not matched:
+                        return NO
+                    if rule == "include" and patterns and not matched:
+                        return NO
+        return YES
+
+
+class AwarenessDecider(Decider):
+    name = "awareness"
+
+    def can_allocate(self, shard, node_id, ctx):
+        attrs = ctx.settings.get_list("cluster.routing.allocation.awareness.attributes")
+        if not attrs:
+            return YES
+        node = ctx.state.nodes.get(node_id)
+        if node is None:
+            return NO
+        group = ctx.state.routing_table.index(shard.index).shard(shard.shard_id)
+        copies = group.size()
+        for attr in attrs:
+            values = {n.attr(attr) for n in ctx.state.nodes.data_nodes() if n.attr(attr)}
+            if not values:
+                continue
+            per_zone_cap = -(-copies // len(values))  # ceil
+            my_zone = node.attr(attr)
+            in_zone = sum(
+                1 for s in group.assigned_shards()
+                if s.node_id != shard.node_id
+                and (n := ctx.state.nodes.get(s.node_id)) is not None
+                and n.attr(attr) == my_zone
+            )
+            if in_zone >= per_zone_cap:
+                return NO
+        return YES
+
+
+class ThrottlingDecider(Decider):
+    name = "throttling"
+
+    def can_allocate(self, shard, node_id, ctx):
+        limit = ctx.settings.get_int(
+            "cluster.routing.allocation.node_concurrent_recoveries", 2)
+        initializing = sum(
+            1 for s in ctx.shards_on_node(node_id) if s.state == INITIALIZING
+        )
+        return THROTTLE if initializing >= limit else YES
+
+
+class DiskThresholdDecider(Decider):
+    name = "disk_threshold"
+
+    def can_allocate(self, shard, node_id, ctx):
+        if not ctx.settings.get_bool("cluster.routing.allocation.disk.threshold_enabled", True):
+            return YES
+        usage = ctx.disk_usages.get(node_id)
+        if usage is None:
+            return YES
+        high = ctx.settings.get_float("cluster.routing.allocation.disk.watermark.high", 0.90)
+        return NO if usage >= high else YES
+
+
+DEFAULT_DECIDERS = (
+    SameShardDecider(),
+    ReplicaAfterPrimaryDecider(),
+    EnableDecider(),
+    FilterDecider(),
+    AwarenessDecider(),
+    ThrottlingDecider(),
+    DiskThresholdDecider(),
+)
+
+
+class AllocationContext:
+    def __init__(self, state: ClusterState, settings: Settings,
+                 disk_usages: dict | None = None):
+        self.state = state
+        self.settings = settings
+        self.disk_usages = disk_usages or {}
+        self._by_node: dict[str, list[ShardRouting]] = {}
+        for s in state.routing_table.all_shards():
+            if s.node_id:
+                self._by_node.setdefault(s.node_id, []).append(s)
+
+    def shards_on_node(self, node_id: str) -> list[ShardRouting]:
+        return self._by_node.get(node_id, [])
+
+    def index_settings(self, index: str) -> Settings:
+        meta = self.state.metadata.index(index)
+        return meta.settings if meta else Settings.EMPTY
+
+    def replace_shard(self, old: ShardRouting, new: ShardRouting):
+        if old.node_id:
+            lst = self._by_node.get(old.node_id, [])
+            if old in lst:
+                lst.remove(old)
+        if new.node_id:
+            self._by_node.setdefault(new.node_id, []).append(new)
+
+
+class AllocationService:
+    """ref: AllocationService.java:52 — reroute/applyStartedShards/applyFailedShard."""
+
+    def __init__(self, settings: Settings | None = None, deciders=DEFAULT_DECIDERS):
+        self.settings = settings or Settings.EMPTY
+        self.deciders = deciders
+        self.logger = get_logger("cluster.allocation")
+        self.disk_usages: dict[str, float] = {}
+
+    # --- decider chain ------------------------------------------------------
+    def _decide(self, shard: ShardRouting, node_id: str, ctx: AllocationContext) -> str:
+        throttled = False
+        for d in self.deciders:
+            v = d.can_allocate(shard, node_id, ctx)
+            if v == NO:
+                return NO
+            if v == THROTTLE:
+                throttled = True
+        return THROTTLE if throttled else YES
+
+    # --- weight (BalancedShardsAllocator, simplified) -----------------------
+    @staticmethod
+    def _weight(ctx: AllocationContext, node_id: str, index: str) -> float:
+        shards_on = len(ctx.shards_on_node(node_id))
+        index_on = sum(1 for s in ctx.shards_on_node(node_id) if s.index == index)
+        return 0.45 * shards_on + 0.55 * index_on
+
+    # --- operations ---------------------------------------------------------
+    def reroute(self, state: ClusterState) -> ClusterState:
+        """Assign as many UNASSIGNED shards as deciders allow; primaries first."""
+        ctx = AllocationContext(state, self._merged_settings(state), self.disk_usages)
+        data_nodes = [n.id for n in state.nodes.data_nodes()]
+        if not data_nodes:
+            return state
+        new_tables: dict[str, list[list[ShardRouting]]] = {}
+        changed = False
+        for name, table in state.routing_table.indices:
+            groups = []
+            for grp in table.shards:
+                shards = list(grp.shards)
+                for order in (True, False):  # primaries first, then replicas
+                    for i, s in enumerate(shards):
+                        if s.state != UNASSIGNED or s.primary != order:
+                            continue
+                        candidates = [
+                            nid for nid in data_nodes
+                            if self._decide(s, nid, ctx) == YES
+                        ]
+                        if not candidates:
+                            continue
+                        best = min(candidates,
+                                   key=lambda nid: (self._weight(ctx, nid, s.index), nid))
+                        new = replace(s, node_id=best, state=INITIALIZING,
+                                      unassigned_reason=None)
+                        shards[i] = new
+                        ctx.replace_shard(s, new)
+                        changed = True
+                groups.append(shards)
+            new_tables[name] = groups
+        if not changed:
+            return state
+        return self._rebuild(state, new_tables)
+
+    def apply_started_shards(self, state: ClusterState, started: list[ShardRouting]) -> ClusterState:
+        keys = {(s.index, s.shard_id, s.node_id) for s in started}
+        new_tables = {}
+        changed = False
+        for name, table in state.routing_table.indices:
+            groups = []
+            for grp in table.shards:
+                shards = []
+                for s in grp.shards:
+                    if s.state == INITIALIZING and (s.index, s.shard_id, s.node_id) in keys:
+                        shards.append(replace(s, state=STARTED))
+                        changed = True
+                    else:
+                        shards.append(s)
+                groups.append(shards)
+            new_tables[name] = groups
+        if not changed:
+            return state
+        return self.reroute(self._rebuild(state, new_tables))
+
+    def apply_failed_shard(self, state: ClusterState, failed: ShardRouting) -> ClusterState:
+        """Remove the failed copy; promote an active replica when a primary dies;
+        schedule a fresh UNASSIGNED copy (ref: AllocationService.applyFailedShard:91)."""
+        new_tables = {}
+        for name, table in state.routing_table.indices:
+            groups = []
+            for grp in table.shards:
+                shards = list(grp.shards)
+                for i, s in enumerate(shards):
+                    if (s.index, s.shard_id, s.node_id) == (failed.index, failed.shard_id, failed.node_id):
+                        was_primary = s.primary
+                        shards[i] = replace(s, node_id=None, state=UNASSIGNED,
+                                            primary=False, unassigned_reason="failed")
+                        if was_primary:
+                            promoted = False
+                            for j, r in enumerate(shards):
+                                if j != i and r.active and not r.primary:
+                                    shards[j] = replace(r, primary=True)
+                                    promoted = True
+                                    break
+                            if not promoted:
+                                # no live replica: the unassigned copy becomes the primary
+                                shards[i] = replace(shards[i], primary=True)
+                groups.append(shards)
+            new_tables[name] = groups
+        return self.reroute(self._rebuild(state, new_tables))
+
+    def remove_node(self, state: ClusterState, node_id: str) -> ClusterState:
+        """Node left/died: every shard on it fails (ref: node-leave handling)."""
+        for s in list(state.routing_table.all_shards()):
+            if s.node_id == node_id:
+                state = self.apply_failed_shard(state, s)
+        return state
+
+    # --- helpers ------------------------------------------------------------
+    def _merged_settings(self, state: ClusterState) -> Settings:
+        return self.settings.merged(
+            Settings.from_flat(dict(state.metadata.persistent_settings))
+        ).merged(Settings.from_flat(dict(state.metadata.transient_settings)))
+
+    @staticmethod
+    def _rebuild(state: ClusterState, new_tables: dict) -> ClusterState:
+        rt = state.routing_table
+        for name, groups in new_tables.items():
+            rt = rt.with_index(IndexRoutingTable(
+                name, tuple(IndexShardRoutingTable(tuple(g)) for g in groups)))
+        return state.next_version(routing_table=rt)
+
+
+def new_index_routing(index: str, num_shards: int, num_replicas: int) -> IndexRoutingTable:
+    groups = []
+    for sid in range(num_shards):
+        shards = [ShardRouting(index, sid, None, True, UNASSIGNED,
+                               unassigned_reason="index_created")]
+        for _ in range(num_replicas):
+            shards.append(ShardRouting(index, sid, None, False, UNASSIGNED,
+                                       unassigned_reason="index_created"))
+        groups.append(IndexShardRoutingTable(tuple(shards)))
+    return IndexRoutingTable(index, tuple(groups))

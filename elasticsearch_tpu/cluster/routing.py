@@ -1,0 +1,108 @@
+"""Operation routing: doc → shard, search shard selection.
+
+Analogue of cluster/routing/operation/plain/PlainOperationRouting.java (SURVEY.md §2.2):
+shard_id = djb2(routing ?: id) % num_shards — the exact DJB2 hash
+(hash/djb/DjbHashFunction.java:28), because shard placement of every document depends on
+it and it is frozen at index creation (hash stability).
+
+searchShards picks ONE copy per replication group honoring `preference`
+(_primary/_local/_only_node:x/session key), default round-robin over active copies.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from ..common.errors import IndexShardMissingError, NoShardAvailableError
+from .state import ClusterState, IndexShardRoutingTable, ShardRouting
+
+
+def djb2_hash(value: str) -> int:
+    """DJB2 exactly as the reference computes it (32-bit overflow semantics)."""
+    h = 5381
+    for ch in value:
+        h = ((h << 5) + h + ord(ch)) & 0xFFFFFFFF
+    # Java int is signed; modulo uses absolute value downstream
+    if h >= 0x80000000:
+        h -= 0x100000000
+    return h
+
+
+class OperationRouting:
+    def __init__(self):
+        self._rr = itertools.count()
+
+    @staticmethod
+    def shard_id(state: ClusterState, index: str, doc_id: str,
+                 routing: str | None = None) -> int:
+        meta = state.metadata.require_index(index)
+        h = djb2_hash(routing if routing is not None else doc_id)
+        return abs(h) % meta.number_of_shards
+
+    def index_shard(self, state: ClusterState, index: str, doc_id: str,
+                    routing: str | None = None) -> IndexShardRoutingTable:
+        table = state.routing_table.index(index)
+        if table is None:
+            raise IndexShardMissingError(f"no routing for index [{index}]")
+        return table.shard(self.shard_id(state, index, doc_id, routing))
+
+    def get_shard_copy(self, state: ClusterState, index: str, doc_id: str,
+                       routing: str | None = None,
+                       preference: str | None = None) -> ShardRouting:
+        """A single active copy for reads (get/explain — single-shard pattern)."""
+        group = self.index_shard(state, index, doc_id, routing)
+        return self._select(group, state, preference)
+
+    def search_shards(self, state: ClusterState, indices: list[str],
+                      routing: str | None = None,
+                      preference: str | None = None) -> list[ShardRouting]:
+        """One active copy of every relevant shard group (ref: searchShards:103-146)."""
+        out = []
+        for index in indices:
+            table = state.routing_table.index(index)
+            if table is None:
+                continue
+            meta = state.metadata.require_index(index)
+            if routing is not None:
+                shard_ids = {abs(djb2_hash(r)) % meta.number_of_shards
+                             for r in str(routing).split(",")}
+            else:
+                shard_ids = range(len(table.shards))
+            for sid in shard_ids:
+                group = table.shard(sid)
+                out.append(self._select(group, state, preference))
+        return out
+
+    def _select(self, group: IndexShardRoutingTable, state: ClusterState,
+                preference: str | None) -> ShardRouting:
+        active = group.active_shards()
+        if not active:
+            raise NoShardAvailableError(
+                f"no active copy for [{group.shards[0].index}][{group.shards[0].shard_id}]"
+                if group.shards else "empty shard group"
+            )
+        if preference:
+            if preference == "_primary":
+                for s in active:
+                    if s.primary:
+                        return s
+                raise NoShardAvailableError("primary not active")
+            if preference == "_local" and state.nodes.local_id:
+                for s in active:
+                    if s.node_id == state.nodes.local_id:
+                        return s
+            if preference.startswith("_only_node:"):
+                node_id = preference.split(":", 1)[1]
+                for s in active:
+                    if s.node_id == node_id:
+                        return s
+                raise NoShardAvailableError(f"no copy on node [{node_id}]")
+            if preference.startswith("_prefer_node:"):
+                node_id = preference.split(":", 1)[1]
+                for s in active:
+                    if s.node_id == node_id:
+                        return s
+            # arbitrary session key → stable copy choice
+            idx = abs(djb2_hash(preference)) % len(active)
+            return active[idx]
+        return active[next(self._rr) % len(active)]

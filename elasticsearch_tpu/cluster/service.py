@@ -1,0 +1,154 @@
+"""ClusterService: the single-threaded prioritized state-update executor.
+
+Analogue of cluster/service/InternalClusterService.java (SURVEY.md §2.2): ALL cluster
+state mutations run on ONE thread in priority order — the reference's core race-freedom
+invariant (InternalClusterService.java:75,130), kept verbatim. Tasks take the current
+state and return a new one; if the version advanced, the state is published (master) or
+applied locally, and listeners fire with a ClusterChangedEvent.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field as dc_field
+from typing import Callable
+
+from ..common.logging import get_logger
+from .state import ClusterState
+
+URGENT, HIGH, NORMAL, LOW = 0, 1, 2, 3
+
+
+@dataclass(order=True)
+class _Task:
+    priority: int
+    seq: int
+    source: str = dc_field(compare=False)
+    fn: Callable = dc_field(compare=False)
+    future: Future = dc_field(compare=False)
+    insertion_time: float = dc_field(compare=False, default=0.0)
+
+
+@dataclass
+class ClusterChangedEvent:
+    source: str
+    previous_state: ClusterState
+    state: ClusterState
+
+    def nodes_added(self):
+        prev = {n.id for n in self.previous_state.nodes.nodes}
+        return [n for n in self.state.nodes.nodes if n.id not in prev]
+
+    def nodes_removed(self):
+        cur = {n.id for n in self.state.nodes.nodes}
+        return [n for n in self.previous_state.nodes.nodes if n.id not in cur]
+
+    def routing_changed(self) -> bool:
+        return self.previous_state.routing_table != self.state.routing_table
+
+    def metadata_changed(self) -> bool:
+        return self.previous_state.metadata != self.state.metadata
+
+
+class ClusterService:
+    def __init__(self, node_name: str = "node", publish: Callable | None = None):
+        self.logger = get_logger("cluster.service", node=node_name)
+        self._state = ClusterState()
+        self._listeners: list[Callable[[ClusterChangedEvent], None]] = []
+        self._queue: list[_Task] = []
+        self._seq = itertools.count()
+        self._cv = threading.Condition()
+        self._publish = publish  # master-side: fn(new_state) → fan to nodes
+        self._stopped = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"estpu[{node_name}][clusterService]")
+        self._thread.start()
+
+    # --- state access -------------------------------------------------------
+    @property
+    def state(self) -> ClusterState:
+        return self._state
+
+    def add_listener(self, listener: Callable[[ClusterChangedEvent], None]):
+        self._listeners.append(listener)
+
+    def set_publisher(self, publish: Callable):
+        self._publish = publish
+
+    # --- task submission ----------------------------------------------------
+    def submit_state_update_task(self, source: str, fn: Callable[[ClusterState], ClusterState],
+                                 priority: int = NORMAL) -> Future:
+        """fn runs ON the cluster-state thread; returns the resulting state."""
+        fut: Future = Future()
+        task = _Task(priority, next(self._seq), source, fn, fut, time.monotonic())
+        with self._cv:
+            if self._stopped:
+                fut.set_exception(RuntimeError("cluster service stopped"))
+                return fut
+            heapq.heappush(self._queue, task)
+            self._cv.notify()
+        return fut
+
+    def apply_new_state(self, source: str, new_state: ClusterState) -> Future:
+        """Non-master path: a published state arrives — apply if newer
+        (version monotonicity guard, ref: ZenDiscovery publish handling)."""
+
+        def apply(current: ClusterState) -> ClusterState:
+            if new_state.version <= current.version and current.nodes.master_id is not None \
+                    and new_state.version != 0:
+                return current
+            return new_state
+
+        return self.submit_state_update_task(source, apply, priority=URGENT)
+
+    def pending_tasks(self) -> list[dict]:
+        with self._cv:
+            return [
+                {"source": t.source, "priority": t.priority,
+                 "time_in_queue_millis": int((time.monotonic() - t.insertion_time) * 1000)}
+                for t in sorted(self._queue)
+            ]
+
+    # --- the single thread --------------------------------------------------
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._queue and not self._stopped:
+                    self._cv.wait(0.1)
+                if self._stopped and not self._queue:
+                    return
+                task = heapq.heappop(self._queue)
+            try:
+                previous = self._state
+                new_state = task.fn(previous)
+                if new_state is None:
+                    new_state = previous
+                changed = new_state is not previous and new_state != previous
+                if changed:
+                    # master republishes; non-master tasks only apply locally
+                    if self._publish is not None and \
+                            new_state.nodes.master_id == new_state.nodes.local_id and \
+                            new_state.nodes.local_id is not None:
+                        self._publish(new_state)
+                    self._state = new_state
+                    event = ClusterChangedEvent(task.source, previous, new_state)
+                    for listener in list(self._listeners):
+                        try:
+                            listener(event)
+                        except Exception as e:  # noqa: BLE001
+                            self.logger.warning("listener failed on [%s]: %s", task.source, e)
+                else:
+                    self._state = new_state
+                task.future.set_result(self._state)
+            except Exception as e:  # noqa: BLE001
+                task.future.set_exception(e)
+
+    def close(self):
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        self._thread.join(timeout=2)

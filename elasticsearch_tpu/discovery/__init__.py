@@ -1,0 +1,1 @@
+from .zen import ZenDiscovery, ElectMasterService  # noqa: F401

@@ -1,0 +1,114 @@
+"""Local gateway: cluster metadata persistence + startup recovery gating.
+
+Analogue of gateway/ (SURVEY.md §2.13/§5.4): every master-eligible node persists the
+cluster MetaData (indices, mappings, templates, settings) on each change
+(LocalGatewayMetaState); on a fresh cluster start, the elected master restores the
+persisted metadata once `gateway.recover_after_nodes` nodes are present
+(GatewayService.java:84-113), holding the STATE_NOT_RECOVERED block until then. Shard
+data itself recovers from each node's store (engine commit points + translog), which is
+the LocalGatewayShardsState analogue.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from .cluster.state import BLOCK_STATE_NOT_RECOVERED, ClusterState, MetaData
+from .cluster.allocation import new_index_routing
+from .common.logging import get_logger
+from .common.settings import Settings
+
+
+class LocalGateway:
+    def __init__(self, data_path: str, cluster_service, settings: Settings | None = None,
+                 node_name: str = "node"):
+        self.dir = os.path.join(data_path, "_state")
+        os.makedirs(self.dir, exist_ok=True)
+        self.cluster_service = cluster_service
+        self.settings = settings or Settings.EMPTY
+        self.recover_after_nodes = self.settings.get_int("gateway.recover_after_nodes", 1)
+        self.logger = get_logger("gateway", node=node_name)
+        self._recovered = False
+        self._lock = threading.Lock()
+        cluster_service.add_listener(self._on_change)
+
+    @property
+    def meta_path(self) -> str:
+        return os.path.join(self.dir, "metadata.json")
+
+    # persistence ------------------------------------------------------------
+    def _on_change(self, event):
+        if event.metadata_changed():
+            self.persist_now()
+
+    def persist_now(self):
+        try:
+            state = self.cluster_service.state
+            tmp = self.meta_path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(state.metadata.to_dict(), fh)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self.meta_path)
+        except Exception as e:  # noqa: BLE001
+            self.logger.warning("metadata persist failed: %s", e)
+
+    def load_metadata(self) -> MetaData | None:
+        if not os.path.exists(self.meta_path):
+            return None
+        with open(self.meta_path) as fh:
+            return MetaData.from_dict(json.load(fh))
+
+    # recovery ---------------------------------------------------------------
+    def maybe_recover(self):
+        """Master-side: restore persisted metadata once enough nodes joined."""
+        with self._lock:
+            if self._recovered:
+                return
+            state = self.cluster_service.state
+            if state.nodes.master_id != state.nodes.local_id or state.nodes.local_id is None:
+                self._recovered = True  # non-masters receive state via publish
+                return
+            if state.nodes.size < self.recover_after_nodes:
+                self.logger.info("waiting for %d nodes before recovery (have %d)",
+                                 self.recover_after_nodes, state.nodes.size)
+                return
+            persisted = self.load_metadata()
+            self._recovered = True
+            if persisted is None or not persisted.index_names():
+                return
+
+            def update(current: ClusterState) -> ClusterState:
+                md = current.metadata
+                rt = current.routing_table
+                for name in persisted.index_names():
+                    if md.has_index(name):
+                        continue
+                    meta = persisted.index(name)
+                    md = md.with_index(meta)
+                    if meta.state == "open":
+                        rt = rt.with_index(new_index_routing(
+                            name, meta.number_of_shards, meta.number_of_replicas))
+                for tname, tpl in persisted.templates:
+                    md = md.with_template(tpl)
+                new = current.next_version(
+                    metadata=md, routing_table=rt,
+                    blocks=current.blocks.without_global(BLOCK_STATE_NOT_RECOVERED))
+                from .cluster.allocation import AllocationService
+
+                return new
+
+            fut = self.cluster_service.submit_state_update_task("gateway-recovery", update)
+            fut.result(10)
+            # allocation of restored shards happens via the normal reroute path
+            self.cluster_service.submit_state_update_task(
+                "gateway-post-recovery-reroute",
+                lambda s: _reroute(s))
+
+
+def _reroute(state: ClusterState) -> ClusterState:
+    from .cluster.allocation import AllocationService
+
+    return AllocationService().reroute(state)

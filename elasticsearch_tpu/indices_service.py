@@ -1,0 +1,290 @@
+"""Node-level index management + the cluster-state reconciler.
+
+Analogues (SURVEY.md §2.4):
+- IndicesService: creates/removes per-index IndexService instances (mapper + similarity
+  + per-shard engines) on THIS node.
+- IndicesClusterStateService (indices/cluster/IndicesClusterStateService.java — "THE
+  reconciler"): on every ClusterChangedEvent, diff local shards vs the routing table:
+  create missing shards, remove de-assigned ones, kick off recovery (primary: from the
+  local store/gateway; replica: peer recovery from the primary's node), then report
+  shard-started to the master (ShardStateAction).
+- Peer recovery (indices/recovery/Recovery{Source,Target}.java): phase1 copies the
+  primary's flushed segment files (checksummed, reusing identical files), phase2 replays
+  the live translog, phase3 is the final catch-up under the engine lock.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import threading
+from dataclasses import dataclass, field as dc_field
+
+from .common.errors import IndexShardMissingError, SearchEngineError
+from .common.logging import get_logger
+from .common.settings import Settings
+from .index.engine import Engine
+from .index.translog import TranslogOp, CREATE, INDEX, DELETE
+from .mapper import MapperService
+from .search.similarity import SimilarityService
+from .cluster.state import INITIALIZING, STARTED, ClusterState, ShardRouting
+
+ACTION_SHARD_STARTED = "internal:cluster/shard/started"
+ACTION_SHARD_FAILED = "internal:cluster/shard/failed"
+ACTION_RECOVERY_FILES = "internal:index/shard/recovery/files"
+ACTION_RECOVERY_TRANSLOG = "internal:index/shard/recovery/translog"
+
+# shard lifecycle (ref: IndexShardState CREATED→RECOVERING→POST_RECOVERY→STARTED)
+CREATED, RECOVERING, POST_RECOVERY, SHARD_STARTED, CLOSED = (
+    "CREATED", "RECOVERING", "POST_RECOVERY", "STARTED", "CLOSED")
+
+
+@dataclass
+class IndexShard:
+    index: str
+    shard_id: int
+    engine: Engine
+    primary: bool
+    state: str = CREATED
+    recovery_info: dict = dc_field(default_factory=dict)
+
+
+class IndexService:
+    """Per-index node-local container: mapper/analysis/similarity + shards."""
+
+    def __init__(self, name: str, index_settings: Settings, mappings: dict,
+                 data_path: str):
+        self.name = name
+        self.settings = index_settings
+        self.mapper_service = MapperService(index_settings)
+        for type_name, mapping in (mappings or {}).items():
+            self.mapper_service.put_mapping(type_name, mapping)
+        self.similarity_service = SimilarityService(index_settings,
+                                                   mapper_service=self.mapper_service)
+        self.data_path = data_path
+        self.shards: dict[int, IndexShard] = {}
+
+    def shard(self, shard_id: int) -> IndexShard:
+        s = self.shards.get(shard_id)
+        if s is None:
+            raise IndexShardMissingError(f"[{self.name}][{shard_id}] missing on this node")
+        return s
+
+    def create_shard(self, shard_id: int, primary: bool) -> IndexShard:
+        path = os.path.join(self.data_path, self.name, str(shard_id))
+        engine = Engine(path, self.mapper_service, shard_label=(self.name, shard_id),
+                        settings=self.settings)
+        shard = IndexShard(self.name, shard_id, engine, primary)
+        self.shards[shard_id] = shard
+        return shard
+
+    def remove_shard(self, shard_id: int):
+        shard = self.shards.pop(shard_id, None)
+        if shard is not None:
+            shard.state = CLOSED
+            shard.engine.close()
+
+
+class IndicesService:
+    def __init__(self, node_id: str, node_name: str, data_path: str, transport,
+                 cluster_service):
+        self.node_id = node_id
+        self.data_path = data_path
+        self.transport = transport
+        self.cluster_service = cluster_service
+        self.indices: dict[str, IndexService] = {}
+        self.logger = get_logger("indices", node=node_name)
+        self._lock = threading.RLock()
+        transport.register_handler(ACTION_RECOVERY_FILES, self._handle_recovery_files)
+        transport.register_handler(ACTION_RECOVERY_TRANSLOG, self._handle_recovery_translog)
+        cluster_service.add_listener(self.cluster_changed)
+
+    # ------------------------------------------------------------ access
+    def index_service(self, name: str) -> IndexService:
+        svc = self.indices.get(name)
+        if svc is None:
+            from .common.errors import IndexMissingError
+
+            raise IndexMissingError(name)
+        return svc
+
+    def shard_or_none(self, index: str, shard_id: int) -> IndexShard | None:
+        svc = self.indices.get(index)
+        return svc.shards.get(shard_id) if svc else None
+
+    # ------------------------------------------------------------ reconciler
+    def cluster_changed(self, event):
+        state: ClusterState = event.state
+        with self._lock:
+            self._apply_state(state)
+
+    def _apply_state(self, state: ClusterState):
+        # 1. remove indices deleted from metadata
+        meta_names = set(state.metadata.index_names())
+        for name in list(self.indices):
+            if name not in meta_names:
+                svc = self.indices.pop(name)
+                for sid in list(svc.shards):
+                    svc.remove_shard(sid)
+                self.logger.info("removed index [%s]", name)
+        # 2. per assigned shard on this node: create + recover
+        my_shards: dict[tuple, ShardRouting] = {}
+        for s in state.routing_table.all_shards():
+            if s.node_id == self.node_id and s.state in (INITIALIZING, STARTED):
+                my_shards[(s.index, s.shard_id)] = s
+        # remove local shards no longer assigned here
+        for name, svc in list(self.indices.items()):
+            for sid in list(svc.shards):
+                if (name, sid) not in my_shards:
+                    svc.remove_shard(sid)
+                    self.logger.info("removed shard [%s][%d]", name, sid)
+        for (index, sid), routing in my_shards.items():
+            meta = state.metadata.index(index)
+            if meta is None:
+                continue
+            svc = self.indices.get(index)
+            if svc is None:
+                svc = IndexService(index, meta.settings, meta.mappings_dict(),
+                                   os.path.join(self.data_path, "indices"))
+                self.indices[index] = svc
+            else:
+                # apply new mappings from metadata (mapping updates propagate via state)
+                for t, m in meta.mappings_dict().items():
+                    try:
+                        svc.mapper_service.put_mapping(t, m)
+                    except SearchEngineError:
+                        pass
+            local = svc.shards.get(sid)
+            if local is None and routing.state == INITIALIZING:
+                shard = svc.create_shard(sid, routing.primary)
+                threading.Thread(
+                    target=self._recover_shard, args=(shard, routing, state),
+                    daemon=True, name=f"estpu-recover[{index}][{sid}]",
+                ).start()
+            elif local is not None:
+                local.primary = routing.primary
+
+    # ------------------------------------------------------------ recovery
+    def _recover_shard(self, shard: IndexShard, routing: ShardRouting,
+                       state: ClusterState):
+        shard.state = RECOVERING
+        try:
+            if routing.primary:
+                replayed = shard.engine.recover_from_store()
+                self.logger.info("recovered primary [%s][%d] from store (%d ops)",
+                                 shard.index, shard.shard_id, replayed)
+            else:
+                self._peer_recover(shard, state)
+            shard.state = POST_RECOVERY
+            shard.engine.refresh()
+            self._report_started(routing)
+            shard.state = SHARD_STARTED
+        except Exception as e:  # noqa: BLE001
+            self.logger.warning("recovery failed [%s][%d]: %s", shard.index,
+                                shard.shard_id, e)
+            self._report_failed(routing, str(e))
+
+    def _peer_recover(self, shard: IndexShard, state: ClusterState):
+        """Replica recovery from the primary's node (3-phase, ref: RecoverySource)."""
+        group = state.routing_table.index(shard.index).shard(shard.shard_id)
+        primary = group.primary
+        if primary is None or not primary.assigned:
+            raise SearchEngineError("no primary to recover from")
+        primary_node = state.nodes.get(primary.node_id)
+        if primary_node is None:
+            raise SearchEngineError("primary node not in cluster")
+        # phase 1: segment files (diffed by checksum)
+        local_files = shard.engine.store.list_files()
+        resp = self.transport.submit_request(
+            primary_node.transport_address, ACTION_RECOVERY_FILES,
+            {"index": shard.index, "shard": shard.shard_id,
+             "have": {n: f["checksum"] for n, f in local_files.items()}},
+            timeout=60.0)
+        store_dir = shard.engine.store.dir
+        for name, b64 in resp["files"].items():
+            with open(os.path.join(store_dir, name), "wb") as fh:
+                fh.write(base64.b64decode(b64))
+        reused = resp.get("reused", 0)
+        shard.recovery_info = {"files": len(resp["files"]), "reused": reused}
+        shard.engine.recover_from_store()
+        # phase 2/3: translog ops since the primary's snapshot
+        resp2 = self.transport.submit_request(
+            primary_node.transport_address, ACTION_RECOVERY_TRANSLOG,
+            {"index": shard.index, "shard": shard.shard_id}, timeout=60.0)
+        for op_b64 in resp2["ops"]:
+            op = TranslogOp.decode(base64.b64decode(op_b64))
+            shard.engine.apply_replicated_op(op)
+        self.logger.info("peer-recovered [%s][%d]: %d files (%d reused), %d translog ops",
+                         shard.index, shard.shard_id, len(resp["files"]), reused,
+                         len(resp2["ops"]))
+
+    def _handle_recovery_files(self, request, channel):
+        """Primary side of phase 1: flush, diff, stream missing files."""
+        shard = self.shard_or_none(request["index"], request["shard"])
+        if shard is None:
+            raise IndexShardMissingError(f"[{request['index']}][{request['shard']}]")
+        shard.engine.flush(force=True)
+        files = shard.engine.store.list_files()
+        have = request.get("have", {})
+        out = {}
+        reused = 0
+        for name, info in files.items():
+            if have.get(name) == info["checksum"]:
+                reused += 1
+                continue
+            with open(os.path.join(shard.engine.store.dir, name), "rb") as fh:
+                out[name] = base64.b64encode(fh.read()).decode("ascii")
+        return {"files": out, "reused": reused}
+
+    def _handle_recovery_translog(self, request, channel):
+        shard = self.shard_or_none(request["index"], request["shard"])
+        if shard is None:
+            raise IndexShardMissingError(f"[{request['index']}][{request['shard']}]")
+        ops = shard.engine.translog.snapshot()
+        return {"ops": [base64.b64encode(op.encode()).decode("ascii") for op in ops]}
+
+    # ------------------------------------------------------------ shard state
+    def _report_started(self, routing: ShardRouting):
+        self._send_to_master(ACTION_SHARD_STARTED, {"shard": routing.to_dict()})
+
+    def _report_failed(self, routing: ShardRouting, reason: str):
+        self._send_to_master(ACTION_SHARD_FAILED,
+                             {"shard": routing.to_dict(), "reason": reason})
+
+    def _send_to_master(self, action: str, body: dict, retries: int = 10):
+        import time
+
+        for _ in range(retries):
+            master = self.cluster_service.state.nodes.master
+            if master is not None:
+                try:
+                    self.transport.submit_request(master.transport_address, action, body,
+                                                  timeout=5.0)
+                    return
+                except SearchEngineError:
+                    pass
+            time.sleep(0.1)
+        self.logger.warning("could not reach master for %s", action)
+
+    def stats(self) -> dict:
+        out = {}
+        for name, svc in self.indices.items():
+            shards = {}
+            for sid, shard in svc.shards.items():
+                shards[sid] = {
+                    "state": shard.state,
+                    "primary": shard.primary,
+                    "docs": shard.engine.doc_stats(),
+                    "segments": shard.engine.segment_count(),
+                    "translog": shard.engine.translog.stats(),
+                    "indexing": {k: v for k, v in shard.engine.stats.items()},
+                }
+            out[name] = {"shards": shards}
+        return out
+
+    def close(self):
+        with self._lock:
+            for svc in self.indices.values():
+                for sid in list(svc.shards):
+                    svc.remove_shard(sid)
+            self.indices.clear()

@@ -1,0 +1,348 @@
+"""Node assembly + client.
+
+Analogue of node/internal/InternalNode.java (SURVEY.md §2.12): builds every service in
+dependency order (threadpool → transport → cluster service → allocation → indices →
+actions → discovery → gateway), starts discovery, and exposes a Client facade (the
+NodeClient shape: one method per action, routed through the local transport).
+
+An in-process multi-node cluster (nodes sharing a LocalTransportRegistry) is the direct
+analogue of the reference's TestCluster (SURVEY.md §4.2) — and also the single-host
+production topology: one node process per host, shards on the TPU mesh.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+import uuid
+
+from .actions import ActionModule
+from .cluster.allocation import AllocationService
+from .cluster.routing import OperationRouting
+from .cluster.service import ClusterService
+from .cluster.state import BLOCK_STATE_NOT_RECOVERED, DiscoveryNode
+from .common.errors import SearchEngineError
+from .common.logging import get_logger
+from .common.settings import Settings, prepare_settings
+from .discovery.zen import ZenDiscovery
+from .gateway import LocalGateway
+from .indices_service import IndicesService
+from .threadpool import ThreadPool
+from .transport.local import DEFAULT_REGISTRY, LocalTransport
+from .transport.service import TransportService
+
+
+class Node:
+    def __init__(self, name: str | None = None, settings=None, registry=None,
+                 data_path: str | None = None):
+        self.settings = prepare_settings(settings)
+        self.name = name or self.settings.get_str("node.name") or f"node_{uuid.uuid4().hex[:6]}"
+        self.node_id = self.settings.get_str("node.id") or self.name
+        self.data_path = data_path or self.settings.get_str("path.data") or \
+            tempfile.mkdtemp(prefix=f"estpu_{self.name}_")
+        self.logger = get_logger("node", node=self.name)
+        self.registry = registry or DEFAULT_REGISTRY
+        address = f"local://{self.node_id}"
+        attrs = tuple(sorted(
+            (k[len("node.attr."):], str(v)) for k, v in self.settings.as_dict().items()
+            if k.startswith("node.attr.")
+        ))
+        self.local_node = DiscoveryNode(
+            id=self.node_id, name=self.name, transport_address=address, attrs=attrs,
+            master_eligible=self.settings.get_bool("node.master", True),
+            data=self.settings.get_bool("node.data", True),
+        )
+        self.threadpool = ThreadPool(self.settings)
+        self.transport = TransportService(LocalTransport(address, self.registry),
+                                          self.local_node, self.threadpool)
+        self.cluster_service = ClusterService(self.name)
+        self.allocation = AllocationService(self.settings)
+        self.operation_routing = OperationRouting()
+        self.indices = IndicesService(self.node_id, self.name, self.data_path,
+                                      self.transport, self.cluster_service)
+        self.gateway = LocalGateway(self.data_path, self.cluster_service,
+                                    self.settings, node_name=self.name)
+        self.actions = ActionModule(self)
+        self.discovery = ZenDiscovery(self.local_node, self.transport,
+                                      self.cluster_service, self.allocation,
+                                      self.settings)
+        self.discovery.on_joined = None
+        self._started = False
+        self._closed = False
+
+    # ------------------------------------------------------------------ lifecycle
+    def start(self, seeds: list[str] | None = None) -> "Node":
+        """ref: InternalNode.start:210-235 — services then discovery then gateway."""
+        addresses = seeds if seeds is not None else self.registry.addresses()
+        self.discovery.start(addresses)
+        self.gateway.maybe_recover()
+        self._started = True
+        self.logger.info("started (master=%s)",
+                         self.cluster_service.state.nodes.master_id)
+        return self
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self.discovery.leave()
+        self.discovery.stop()
+        self.gateway.persist_now()
+        self.indices.close()
+        self.cluster_service.close()
+        self.transport.close()
+        self.threadpool.shutdown()
+
+    def is_master(self) -> bool:
+        s = self.cluster_service.state
+        return s.nodes.master_id == self.node_id
+
+    def client(self) -> "Client":
+        return Client(self)
+
+    # test/ops helper
+    def wait_for_master(self, timeout: float = 5.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.cluster_service.state.nodes.master_id is not None:
+                return True
+            time.sleep(0.02)
+        return False
+
+
+class Client:
+    """One method per action (ref: client/Client.java + admin facades)."""
+
+    def __init__(self, node: Node):
+        self.node = node
+        self.actions = node.actions
+
+    # --- document APIs ------------------------------------------------------
+    def index(self, index, doc_type, body, id=None, routing=None, version=None,
+              version_type="internal", op_type="index", refresh=False):
+        return self.actions.index_doc(index, doc_type, id, body, routing=routing,
+                                      version=version, version_type=version_type,
+                                      op_type=op_type, refresh=refresh)
+
+    def create(self, index, doc_type, body, id=None, **kw):
+        return self.index(index, doc_type, body, id=id, op_type="create", **kw)
+
+    def get(self, index, doc_type, id, routing=None, realtime=True, preference=None):
+        return self.actions.get_doc(index, doc_type, id, routing=routing,
+                                    realtime=realtime, preference=preference)
+
+    def mget(self, docs):
+        return self.actions.multi_get(docs)
+
+    def delete(self, index, doc_type, id, routing=None, version=None, refresh=False):
+        return self.actions.delete_doc(index, doc_type, id, routing=routing,
+                                       version=version, refresh=refresh)
+
+    def update(self, index, doc_type, id, body, routing=None, retry_on_conflict=0):
+        return self.actions.update_doc(index, doc_type, id, body, routing=routing,
+                                       retry_on_conflict=retry_on_conflict)
+
+    def bulk(self, operations, refresh=False):
+        return self.actions.bulk(operations, refresh=refresh)
+
+    def delete_by_query(self, index, body):
+        return self.actions.delete_by_query(index, body)
+
+    # --- search APIs --------------------------------------------------------
+    def search(self, index=None, body=None, search_type="query_then_fetch",
+               routing=None, preference=None):
+        return self.actions.search(index or "_all", body, search_type=search_type,
+                                   routing=routing, preference=preference)
+
+    def msearch(self, requests):
+        responses = []
+        for header, body in requests:
+            try:
+                responses.append(self.search(header.get("index", "_all"), body))
+            except SearchEngineError as e:
+                responses.append({"error": e.to_dict(), "status": e.status})
+        return {"responses": responses}
+
+    def count(self, index=None, body=None):
+        return self.actions.count(index or "_all", body)
+
+    def suggest(self, index, body):
+        r = self.search(index, {"size": 0, "suggest": body})
+        return r.get("suggest", {})
+
+    def explain(self, index, doc_type, id, body):
+        r = self.search(index, {"query": {"bool": {
+            "must": [body.get("query", {"match_all": {}})],
+            "filter": [{"ids": {"values": [id]}}]}}, "size": 1})
+        matched = r["hits"]["total"] > 0
+        out = {"_index": index, "_type": doc_type, "_id": id, "matched": matched}
+        if matched:
+            out["explanation"] = {"value": r["hits"]["hits"][0]["_score"],
+                                  "description": "score of matching document"}
+        return out
+
+    # --- indices admin ------------------------------------------------------
+    def create_index(self, index, body=None):
+        return self._local(A("indices:admin/create"), {"index": index, "body": body or {}})
+
+    def delete_index(self, index):
+        return self._local(A("indices:admin/delete"), {"index": index})
+
+    def open_index(self, index):
+        return self._local(A("indices:admin/open"), {"index": index})
+
+    def close_index(self, index):
+        return self._local(A("indices:admin/close"), {"index": index})
+
+    def put_mapping(self, index, doc_type, body):
+        return self._local(A("indices:admin/mapping/put"),
+                           {"index": index, "type": doc_type, "body": body})
+
+    def get_mapping(self, index=None, doc_type=None):
+        state = self.node.cluster_service.state
+        out = {}
+        for name in state.metadata.resolve_indices(index or "_all"):
+            meta = state.metadata.index(name)
+            mappings = meta.mappings_dict()
+            if doc_type:
+                mappings = {t: m for t, m in mappings.items() if t == doc_type}
+            out[name] = {"mappings": mappings}
+        return out
+
+    def update_settings(self, index, body):
+        return self._local(A("indices:admin/settings/update"),
+                           {"index": index, "body": body})
+
+    def get_settings(self, index=None):
+        state = self.node.cluster_service.state
+        return {
+            name: {"settings": state.metadata.index(name).settings.as_structured()}
+            for name in state.metadata.resolve_indices(index or "_all")
+        }
+
+    def update_aliases(self, body):
+        return self._local(A("indices:admin/aliases"), {"body": body})
+
+    def get_aliases(self, index=None):
+        state = self.node.cluster_service.state
+        return {
+            name: {"aliases": state.metadata.index(name).aliases_dict()}
+            for name in state.metadata.resolve_indices(index or "_all")
+        }
+
+    def put_template(self, name, body):
+        return self._local(A("indices:admin/template/put"), {"name": name, "body": body})
+
+    def delete_template(self, name):
+        return self._local(A("indices:admin/template/delete"), {"name": name})
+
+    def get_template(self, name=None):
+        state = self.node.cluster_service.state
+        out = {}
+        for n, t in state.metadata.templates:
+            if name is None or n == name:
+                out[n] = t.to_dict()
+        return out
+
+    def refresh(self, index=None):
+        return self.actions.broadcast(index, "refresh")
+
+    def flush(self, index=None):
+        return self.actions.broadcast(index, "flush")
+
+    def optimize(self, index=None):
+        return self.actions.broadcast(index, "optimize")
+
+    def clear_cache(self, index=None):
+        return self.actions.broadcast(index, "clear_cache")
+
+    def exists_index(self, index) -> bool:
+        try:
+            return bool(self.node.cluster_service.state.metadata.resolve_indices(index))
+        except SearchEngineError:
+            return False
+
+    def stats(self, index=None):
+        return self.node.indices.stats()
+
+    # --- cluster admin ------------------------------------------------------
+    def cluster_health(self, index=None, wait_for_status=None, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while True:
+            h = self._health(index)
+            if wait_for_status is None or _status_at_least(h["status"], wait_for_status) \
+                    or time.monotonic() > deadline:
+                h["timed_out"] = wait_for_status is not None and not _status_at_least(
+                    h["status"], wait_for_status)
+                return h
+            time.sleep(0.05)
+
+    def _health(self, index=None):
+        state = self.node.cluster_service.state
+        shards = [s for s in state.routing_table.all_shards()
+                  if index is None or s.index == index]
+        total = len(shards)
+        active = sum(1 for s in shards if s.active)
+        primaries = [s for s in shards if s.primary]
+        active_primaries = sum(1 for s in primaries if s.active)
+        relocating = sum(1 for s in shards if s.state == "RELOCATING")
+        initializing = sum(1 for s in shards if s.state == "INITIALIZING")
+        unassigned = sum(1 for s in shards if s.state == "UNASSIGNED")
+        if active_primaries < len(primaries):
+            status = "red"
+        elif active < total:
+            status = "yellow"
+        else:
+            status = "green"
+        return {
+            "cluster_name": state.cluster_name,
+            "status": status,
+            "number_of_nodes": state.nodes.size,
+            "number_of_data_nodes": len(state.nodes.data_nodes()),
+            "active_primary_shards": active_primaries,
+            "active_shards": active,
+            "relocating_shards": relocating,
+            "initializing_shards": initializing,
+            "unassigned_shards": unassigned,
+        }
+
+    def cluster_state(self):
+        return self.node.cluster_service.state.to_dict()
+
+    def cluster_reroute(self, body=None):
+        return self._local(A("cluster:admin/reroute"), {"body": body or {}})
+
+    def cluster_update_settings(self, body):
+        return self._local(A("cluster:admin/settings/update"), {"body": body})
+
+    def pending_tasks(self):
+        return {"tasks": self.node.cluster_service.pending_tasks()}
+
+    def nodes_info(self):
+        state = self.node.cluster_service.state
+        return {"cluster_name": state.cluster_name,
+                "nodes": {n.id: n.to_dict() for n in state.nodes.nodes}}
+
+    def nodes_stats(self):
+        return {"nodes": {self.node.node_id: {
+            "indices": self.node.indices.stats(),
+            "transport": self.node.transport.stats,
+            "thread_pool": self.node.threadpool.stats(),
+        }}}
+
+    # --- plumbing -----------------------------------------------------------
+    def _local(self, action, body):
+        return self.node.transport.submit_request(self.node.local_node, action, body,
+                                                  timeout=30.0)
+
+
+def A(name: str) -> str:
+    return name
+
+
+def _status_at_least(status: str, wanted: str) -> bool:
+    order = {"red": 0, "yellow": 1, "green": 2}
+    return order.get(status, 0) >= order.get(wanted, 0)

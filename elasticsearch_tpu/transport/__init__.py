@@ -1,0 +1,2 @@
+from .service import TransportService, TransportRequestHandler, fut_result  # noqa: F401
+from .local import LocalTransport  # noqa: F401

@@ -1,0 +1,118 @@
+"""In-process transport backend.
+
+Analogue of transport/local/LocalTransport.java: nodes in the same process exchange
+messages through a shared registry — the backbone of the in-process multi-node test
+cluster (the reference tests ALL multi-node behavior this way, SURVEY.md §4.2). Delivery
+is on a worker thread (never inline) so callers observe real asynchrony; payloads were
+already round-tripped through the wire codec by TransportService.
+
+Fault injection: `partition(a, b)` / `heal(a, b)` drop messages between address pairs —
+the hook the discovery/failover tests use to simulate network partitions.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+
+from ..common.errors import NodeNotConnectedError, TransportError
+from .service import TransportChannel
+
+
+class LocalTransportRegistry:
+    """One registry = one simulated network."""
+
+    def __init__(self):
+        self.nodes: dict[str, "LocalTransport"] = {}
+        self.partitions: set[frozenset] = set()
+        self.dropped_count = 0
+        self._lock = threading.Lock()
+
+    def register(self, address: str, transport: "LocalTransport"):
+        with self._lock:
+            self.nodes[address] = transport
+
+    def unregister(self, address: str):
+        with self._lock:
+            self.nodes.pop(address, None)
+
+    def partition(self, a: str, b: str):
+        with self._lock:
+            self.partitions.add(frozenset((a, b)))
+
+    def heal(self, a: str | None = None, b: str | None = None):
+        with self._lock:
+            if a is None:
+                self.partitions.clear()
+            else:
+                self.partitions.discard(frozenset((a, b)))
+
+    def isolate(self, address: str):
+        """Partition one node from every other registered node."""
+        with self._lock:
+            for other in self.nodes:
+                if other != address:
+                    self.partitions.add(frozenset((address, other)))
+
+    def is_blocked(self, a: str, b: str) -> bool:
+        return frozenset((a, b)) in self.partitions
+
+    def addresses(self) -> list[str]:
+        return sorted(self.nodes)
+
+
+DEFAULT_REGISTRY = LocalTransportRegistry()
+
+
+class LocalTransport:
+    def __init__(self, address: str, registry: LocalTransportRegistry | None = None):
+        self.address = address
+        self.registry = registry or DEFAULT_REGISTRY
+        self.service = None
+        self._pool = ThreadPoolExecutor(max_workers=4,
+                                        thread_name_prefix=f"local-transport[{address}]")
+        self._closed = False
+
+    def bind(self, service):
+        self.service = service
+        self.registry.register(self.address, self)
+
+    def send(self, node, action: str, request, fut: Future):
+        address = getattr(node, "transport_address", node)
+        if self.registry.is_blocked(self.address, address):
+            self.registry.dropped_count += 1
+            fut.set_exception(NodeNotConnectedError(f"[{address}] dropped (partition)"))
+            return
+        target = self.registry.nodes.get(address)
+        if target is None or target._closed:
+            fut.set_exception(NodeNotConnectedError(f"no node at [{address}]"))
+            return
+
+        def respond(response, error):
+            # response path also crosses the (simulated) wire
+            if self.registry.is_blocked(self.address, address):
+                self.registry.dropped_count += 1
+                fut.set_exception(NodeNotConnectedError(f"[{address}] response dropped"))
+                return
+            if error is not None:
+                fut.set_exception(error)
+            else:
+                fut.set_result(response)
+
+        channel = TransportChannel(respond)
+
+        def deliver():
+            if target._closed or target.service is None:
+                channel.send_failure(NodeNotConnectedError(f"node [{address}] closed"))
+                return
+            target.service.dispatch(action, request, channel)
+
+        try:
+            target._pool.submit(deliver)
+        except RuntimeError:
+            fut.set_exception(NodeNotConnectedError(f"node [{address}] shut down"))
+
+    def close(self):
+        self._closed = True
+        self.registry.unregister(self.address)
+        self._pool.shutdown(wait=False, cancel_futures=True)

@@ -1,0 +1,124 @@
+"""TransportService: action-string-keyed async RPC.
+
+Analogue of transport/TransportService.java (SURVEY.md §2.2): a handler registry
+(`register_handler(action, fn)`), `send_request(node, action, body)` returning a Future,
+per-request timeouts, and pluggable backends (LocalTransport in-process; NettyTransport's
+role is filled by tcp.py). Payloads are JSON-able dicts; every message round-trips
+through the wire codec even in-process, so serialization bugs surface in unit tests
+exactly like the reference's AssertingLocalTransport (SURVEY.md §4.3).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable
+
+from ..common.errors import (
+    ActionNotFoundError,
+    NodeNotConnectedError,
+    ReceiveTimeoutError,
+    SearchEngineError,
+    TransportError,
+)
+from ..common.logging import get_logger
+from ..common.stream import StreamInput, StreamOutput
+
+
+def fut_result(fut: Future, timeout: float | None = 30.0):
+    """Await a transport future, converting timeout."""
+    try:
+        return fut.result(timeout=timeout)
+    except TimeoutError:
+        raise ReceiveTimeoutError("request timed out") from None
+
+
+class TransportRequestHandler:
+    """Handler signature: fn(request_dict, channel) — respond via channel, or return a
+    dict to auto-respond."""
+
+    def __init__(self, fn: Callable, executor: str = "same"):
+        self.fn = fn
+        self.executor = executor
+
+
+class TransportChannel:
+    def __init__(self, respond: Callable[[dict | None, Exception | None], None]):
+        self._respond = respond
+        self._done = False
+
+    def send_response(self, response: dict | None):
+        if not self._done:
+            self._done = True
+            self._respond(response, None)
+
+    def send_failure(self, error: Exception):
+        if not self._done:
+            self._done = True
+            self._respond(None, error)
+
+
+def _roundtrip(payload: Any) -> Any:
+    """Serialize + deserialize through the wire codec (asserts wire-compatibility)."""
+    out = StreamOutput()
+    out.write_value(payload)
+    return StreamInput(out.bytes()).read_value()
+
+
+class TransportService:
+    def __init__(self, backend, local_node=None, threadpool=None):
+        self.backend = backend
+        self.local_node = local_node
+        self.threadpool = threadpool
+        self.handlers: dict[str, TransportRequestHandler] = {}
+        self._req_ids = itertools.count(1)
+        self.logger = get_logger("transport")
+        self.stats = {"rx_count": 0, "tx_count": 0}
+        backend.bind(self)
+
+    # --- registry -----------------------------------------------------------
+    def register_handler(self, action: str, fn: Callable, executor: str = "same"):
+        self.handlers[action] = TransportRequestHandler(fn, executor)
+
+    # --- sending ------------------------------------------------------------
+    def send_request(self, node, action: str, request: dict,
+                     timeout: float | None = None) -> Future:
+        fut: Future = Future()
+        self.stats["tx_count"] += 1
+        try:
+            self.backend.send(node, action, _roundtrip(request), fut)
+        except SearchEngineError as e:
+            fut.set_exception(e)
+        except Exception as e:  # noqa: BLE001
+            fut.set_exception(TransportError(str(e), cause=e))
+        return fut
+
+    def submit_request(self, node, action: str, request: dict,
+                       timeout: float | None = 30.0) -> dict:
+        """Blocking convenience."""
+        return fut_result(self.send_request(node, action, request), timeout)
+
+    # --- receiving (called by backends) -------------------------------------
+    def dispatch(self, action: str, request: Any, channel: TransportChannel):
+        self.stats["rx_count"] += 1
+        handler = self.handlers.get(action)
+        if handler is None:
+            channel.send_failure(ActionNotFoundError(f"no handler for action [{action}]"))
+            return
+
+        def run():
+            try:
+                result = handler.fn(request, channel)
+                if result is not None:
+                    channel.send_response(result)
+            except Exception as e:  # noqa: BLE001
+                channel.send_failure(e)
+
+        if handler.executor == "same" or self.threadpool is None:
+            run()
+        else:
+            self.threadpool.submit(handler.executor, run)
+
+    def close(self):
+        self.backend.close()

@@ -1,0 +1,325 @@
+"""Multi-node cluster integration: election, state publish, routing, replication,
+peer recovery, failover — the TestCluster-style in-process suite (SURVEY.md §4.2)."""
+
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster.allocation import AllocationService, new_index_routing
+from elasticsearch_tpu.cluster.routing import djb2_hash
+from elasticsearch_tpu.cluster.state import (
+    ClusterState,
+    DiscoveryNode,
+    DiscoveryNodes,
+    IndexMetaData,
+    STARTED,
+    UNASSIGNED,
+)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.transport.local import LocalTransportRegistry
+
+
+def make_cluster(tmp_path, n_nodes=3, settings=None):
+    registry = LocalTransportRegistry()
+    nodes = []
+    for i in range(n_nodes):
+        node = Node(name=f"node_{i}", registry=registry,
+                    data_path=str(tmp_path / f"node_{i}"),
+                    settings=settings)
+        nodes.append(node)
+    for node in nodes:
+        node.start([n.local_node.transport_address for n in nodes])
+    for node in nodes:
+        assert node.wait_for_master(5.0)
+    return registry, nodes
+
+
+def wait_until(fn, timeout=8.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestAllocationPure:
+    """Pure-function allocator tests on synthetic states (no nodes) —
+    the ElasticsearchAllocationTestCase trick."""
+
+    def _state(self, n_nodes=3, shards=2, replicas=1):
+        nodes = DiscoveryNodes(local_id="n0")
+        for i in range(n_nodes):
+            nodes = nodes.with_node(DiscoveryNode(f"n{i}", f"n{i}", f"local://n{i}"))
+        nodes = nodes.with_master("n0")
+        meta = IndexMetaData("idx", settings_map=(
+            ("index.number_of_shards", shards), ("index.number_of_replicas", replicas)))
+        state = ClusterState(nodes=nodes)
+        state = state.next_version(
+            metadata=state.metadata.with_index(meta),
+            routing_table=state.routing_table.with_index(
+                new_index_routing("idx", shards, replicas)))
+        return state
+
+    def test_reroute_assigns_primaries_first(self):
+        svc = AllocationService()
+        state = svc.reroute(self._state())
+        shards = state.routing_table.index("idx").all_shards()
+        # primaries initialize immediately; replicas WAIT for an active primary
+        # (ReplicaAfterPrimaryActiveDecider)
+        assert all(s.state == "INITIALIZING" for s in shards if s.primary)
+        assert all(s.state == UNASSIGNED for s in shards if not s.primary)
+        # primaries started → replicas allocate, never sharing a node with their primary
+        state = svc.apply_started_shards(state, [s for s in shards if s.primary])
+        shards = state.routing_table.index("idx").all_shards()
+        assert all(s.state == "INITIALIZING" for s in shards if not s.primary)
+        by_key = {}
+        for s in shards:
+            by_key.setdefault((s.index, s.shard_id), []).append(s.node_id)
+        for nodes_used in by_key.values():
+            assert len(set(nodes_used)) == len(nodes_used)
+
+    def test_replica_not_allocated_without_nodes(self):
+        svc = AllocationService()
+        state = svc.reroute(self._state(n_nodes=1, shards=1, replicas=1))
+        shards = state.routing_table.index("idx").all_shards()
+        primary = [s for s in shards if s.primary][0]
+        replica = [s for s in shards if not s.primary][0]
+        assert primary.state == "INITIALIZING"
+        assert replica.state == UNASSIGNED  # same-shard decider blocks single node
+
+    def test_failed_primary_promotes_replica(self):
+        svc = AllocationService()
+        state = svc.reroute(self._state(shards=1, replicas=1))
+        # start primaries → replicas allocate → start replicas
+        state = svc.apply_started_shards(
+            state, [s for s in state.routing_table.all_shards() if s.primary])
+        state = svc.apply_started_shards(
+            state, [s for s in state.routing_table.all_shards() if not s.primary])
+        group = state.routing_table.index("idx").shard(0)
+        assert all(s.state == STARTED for s in group.shards)
+        primary = group.primary
+        state = svc.apply_failed_shard(state, primary)
+        group = state.routing_table.index("idx").shard(0)
+        assert group.primary is not None
+        assert group.primary.node_id != primary.node_id
+        assert group.primary.state == STARTED  # promoted replica keeps STARTED
+
+    def test_filter_decider_excludes_node(self):
+        svc = AllocationService(Settings.from_flat(
+            {"cluster.routing.allocation.exclude._name": "n1"}))
+        state = svc.reroute(self._state())
+        for s in state.routing_table.all_shards():
+            assert s.node_id != "n1"
+
+    def test_djb2_matches_java_semantics(self):
+        # spot values computed from the DJB2 definition with 32-bit overflow
+        assert djb2_hash("") == 5381
+        assert abs(djb2_hash("1")) % 5 == abs(((5381 << 5) + 5381 + 49) % 2**32 - 0) % 5
+
+
+class TestClusterFormation:
+    def test_election_and_state_publish(self, tmp_path):
+        registry, nodes = make_cluster(tmp_path, 3)
+        try:
+            masters = {n.cluster_service.state.nodes.master_id for n in nodes}
+            assert len(masters) == 1
+            # lowest node id wins
+            assert masters == {"node_0"}
+            assert all(n.cluster_service.state.nodes.size == 3 for n in nodes)
+            # create an index on a NON-master node → forwarded to master → published
+            client = nodes[2].client()
+            client.create_index("events", {"settings": {"number_of_shards": 3,
+                                                        "number_of_replicas": 1}})
+            assert wait_until(lambda: all(
+                n.cluster_service.state.metadata.has_index("events") for n in nodes))
+            h = client.cluster_health(wait_for_status="green")
+            assert h["status"] == "green"
+            assert h["active_shards"] == 6
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_replication_and_routed_reads(self, tmp_path):
+        registry, nodes = make_cluster(tmp_path, 3)
+        try:
+            client = nodes[0].client()
+            client.create_index("docs", {"settings": {"number_of_shards": 2,
+                                                      "number_of_replicas": 1}})
+            client.cluster_health(wait_for_status="green")
+            for i in range(20):
+                client.index("docs", "doc", {"n": i, "body": f"text number {i}"},
+                             id=str(i))
+            client.refresh("docs")
+            # reads from any node see all docs
+            for node in nodes:
+                c = node.client()
+                assert c.count("docs")["count"] == 20
+                g = c.get("docs", "doc", "7")
+                assert g["found"] and g["_source"]["n"] == 7
+            # search fans out and merges
+            r = client.search("docs", {"query": {"match": {"body": "text"}}, "size": 30})
+            assert r["hits"]["total"] == 20
+            assert r["_shards"]["successful"] == 2
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_update_and_bulk(self, tmp_path):
+        registry, nodes = make_cluster(tmp_path, 2)
+        try:
+            client = nodes[0].client()
+            client.create_index("b", {"settings": {"number_of_shards": 1,
+                                                   "number_of_replicas": 0}})
+            client.cluster_health(wait_for_status="green")
+            r = client.bulk([
+                {"action": {"index": {"_index": "b", "_type": "d", "_id": "1"}},
+                 "source": {"v": 1}},
+                {"action": {"index": {"_index": "b", "_type": "d", "_id": "2"}},
+                 "source": {"v": 2}},
+                {"action": {"delete": {"_index": "b", "_type": "d", "_id": "2"}}},
+            ], refresh=True)
+            assert not r["errors"]
+            assert client.count("b")["count"] == 1
+            client.update("b", "d", "1", {"doc": {"extra": "x"}})
+            g = client.get("b", "d", "1")
+            assert g["_source"] == {"v": 1, "extra": "x"}
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_dynamic_mapping_propagates(self, tmp_path):
+        registry, nodes = make_cluster(tmp_path, 2)
+        try:
+            client = nodes[0].client()
+            client.create_index("dyn", {"settings": {"number_of_shards": 1,
+                                                     "number_of_replicas": 0}})
+            client.cluster_health(wait_for_status="green")
+            client.index("dyn", "doc", {"brand_new_field": 42}, id="1")
+            assert wait_until(lambda: "brand_new_field" in
+                              (nodes[1].cluster_service.state.metadata.index("dyn")
+                               .mapping("doc") or {}).get("properties", {}))
+        finally:
+            for n in nodes:
+                n.close()
+
+
+class TestReplicaRecoveryAndFailover:
+    def test_peer_recovery_copies_data(self, tmp_path):
+        registry, nodes = make_cluster(tmp_path, 2)
+        try:
+            client = nodes[0].client()
+            # replicas=0 first: write data, then add a replica → peer recovery
+            client.create_index("r", {"settings": {"number_of_shards": 1,
+                                                   "number_of_replicas": 0}})
+            client.cluster_health(wait_for_status="green")
+            for i in range(10):
+                client.index("r", "doc", {"i": i}, id=str(i))
+            client.flush("r")
+            client.update_settings("r", {"settings": {"number_of_replicas": 1}})
+            h = client.cluster_health(wait_for_status="green", timeout=10)
+            assert h["status"] == "green", h
+            # find the replica's node and read from it directly with preference
+            state = nodes[0].cluster_service.state
+            group = state.routing_table.index("r").shard(0)
+            replica = group.replicas()[0]
+            rnode = next(n for n in nodes if n.node_id == replica.node_id)
+            shard = rnode.indices.shard_or_none("r", 0)
+            assert shard is not None
+            assert shard.engine.doc_stats()["count"] == 10
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_node_loss_promotes_replica_and_recovers(self, tmp_path):
+        registry, nodes = make_cluster(tmp_path, 3)
+        try:
+            client = nodes[0].client()
+            client.create_index("ha", {"settings": {"number_of_shards": 1,
+                                                    "number_of_replicas": 1}})
+            client.cluster_health(wait_for_status="green")
+            for i in range(12):
+                client.index("ha", "doc", {"i": i}, id=str(i), refresh=True)
+            state = nodes[0].cluster_service.state
+            group = state.routing_table.index("ha").shard(0)
+            primary_node_id = group.primary.node_id
+            # kill the node hosting the primary (not the master: node_0 is master;
+            # if primary IS on master, kill it anyway unless it's node_0)
+            victim = next(n for n in nodes if n.node_id == primary_node_id)
+            if victim.node_id == "node_0":
+                # choose replica's node as victim instead (keep master alive)
+                victim_id = group.replicas()[0].node_id
+                victim = next(n for n in nodes if n.node_id == victim_id)
+            registry.isolate(victim.local_node.transport_address)
+            survivor = next(n for n in nodes if n is not victim and n.node_id != victim.node_id)
+            ok = wait_until(lambda: (
+                survivor.cluster_service.state.nodes.get(victim.node_id) is None
+            ), timeout=15.0)
+            assert ok, "victim was not removed from the cluster"
+            # shard group recovers to green on the remaining nodes
+            c = survivor.client()
+            h = c.cluster_health(wait_for_status="green", timeout=15)
+            assert h["status"] in ("green", "yellow")
+            r = c.search("ha", {"query": {"match_all": {}}, "size": 20})
+            assert r["hits"]["total"] == 12
+        finally:
+            registry.heal()
+            for n in nodes:
+                n.close()
+
+
+class TestAliasesTemplatesGateway:
+    def test_filtered_alias_and_template(self, tmp_path):
+        registry, nodes = make_cluster(tmp_path, 1)
+        try:
+            client = nodes[0].client()
+            client.put_template("logs_tpl", {
+                "template": "logs-*",
+                "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+                "mappings": {"event": {"properties": {"level": {
+                    "type": "string", "index": "not_analyzed"}}}},
+            })
+            client.create_index("logs-2014")
+            client.cluster_health(wait_for_status="green")
+            meta = nodes[0].cluster_service.state.metadata.index("logs-2014")
+            assert meta.number_of_shards == 1
+            assert "level" in meta.mapping("event")["properties"]
+            client.index("logs-2014", "event", {"level": "error", "msg": "boom"}, id="1")
+            client.index("logs-2014", "event", {"level": "info", "msg": "fine"}, id="2")
+            client.update_aliases({"actions": [
+                {"add": {"index": "logs-2014", "alias": "errors",
+                         "filter": {"term": {"level": "error"}}}}]})
+            client.refresh()
+            r = client.search("errors", {"query": {"match_all": {}}})
+            assert r["hits"]["total"] == 1
+            assert r["hits"]["hits"][0]["_source"]["level"] == "error"
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_gateway_restores_metadata_after_full_restart(self, tmp_path):
+        registry, nodes = make_cluster(tmp_path, 1)
+        client = nodes[0].client()
+        client.create_index("persist", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+            "mappings": {"doc": {"properties": {"x": {"type": "long"}}}}})
+        client.cluster_health(wait_for_status="green")
+        client.index("persist", "doc", {"x": 1}, id="1")
+        client.flush("persist")
+        nodes[0].close()
+        # full restart with the same data path
+        registry2 = LocalTransportRegistry()
+        node2 = Node(name="node_0", registry=registry2,
+                     data_path=str(tmp_path / "node_0"))
+        node2.start([node2.local_node.transport_address])
+        try:
+            assert node2.wait_for_master()
+            c2 = node2.client()
+            assert wait_until(lambda: node2.cluster_service.state.metadata.has_index("persist"))
+            h = c2.cluster_health(wait_for_status="green", timeout=10)
+            assert h["status"] == "green"
+            g = c2.get("persist", "doc", "1")
+            assert g["found"] and g["_source"]["x"] == 1
+        finally:
+            node2.close()
