@@ -656,7 +656,20 @@ class ActionModule:
         """Coordinator: group ops per (index, shard) → one A_BULK_SHARD per group
         (ref: TransportShardBulkAction per-shard sub-batches)."""
         t0 = time.monotonic()
+        # auto-create any missing target indices first so EVERY op takes the per-shard
+        # path (a mixed path would miss the shard-level refresh for some docs)
         state = self.cluster_service.state
+        for op in operations:
+            (_op_name, meta) = next(iter(op["action"].items()))
+            index = meta.get("_index")
+            if index and not state.metadata.has_index(index):
+                try:
+                    self.transport.submit_request(self.node.local_node, A_CREATE_INDEX,
+                                                  {"index": index, "body": {}},
+                                                  timeout=30.0)
+                except IndexAlreadyExistsError:
+                    pass
+                state = self.cluster_service.state
         prepared = []
         for i, op in enumerate(operations):
             (op_name, meta) = next(iter(op["action"].items()))
@@ -664,15 +677,6 @@ class ActionModule:
             type_name = meta.get("_type", "_default_")
             doc_id = meta.get("_id") or uuid.uuid4().hex[:20]
             routing = meta.get("_routing") or meta.get("routing")
-            if not state.metadata.has_index(index):
-                self.index_doc(index, type_name, doc_id, op.get("source") or {},
-                               routing=routing,
-                               op_type="create" if op_name == "create" else "index")
-                prepared.append((i, None, {"_index": index, "_type": type_name,
-                                           "_id": doc_id, "_version": 1,
-                                           "status": 201, "op": op_name}))
-                state = self.cluster_service.state
-                continue
             shard_id = self.routing.shard_id(state, index, doc_id, routing)
             prepared.append((i, (index, shard_id),
                              {"op": op_name, "index": index, "type": type_name,
@@ -682,9 +686,8 @@ class ActionModule:
                               "body": op.get("source")}))
         by_shard: dict = {}
         for i, key, item in prepared:
-            if key is not None:
-                by_shard.setdefault(key, []).append((i, item))
-        results: dict[int, dict] = {i: item for i, key, item in prepared if key is None}
+            by_shard.setdefault(key, []).append((i, item))
+        results: dict[int, dict] = {}
         for (index, shard_id), items in by_shard.items():
             group = state.routing_table.index(index).shard(shard_id)
             primary = group.primary

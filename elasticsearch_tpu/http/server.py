@@ -1,0 +1,90 @@
+"""HTTP server: the REST surface over real sockets.
+
+Analogue of http/NettyHttpServerTransport.java (SURVEY.md §2.7): binds the REST
+controller to a TCP port (default 9200 range), keep-alive, JSON in/out. Stdlib
+ThreadingHTTPServer — the request fan-out is the transport layer's job, HTTP is just
+the front door, same as the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qsl, urlparse
+
+from ..common.logging import get_logger
+from ..rest.controller import RestController, RestRequest
+
+
+class HttpServer:
+    def __init__(self, rest_controller: RestController, host: str = "127.0.0.1",
+                 port: int = 9200):
+        self.rest = rest_controller
+        self.logger = get_logger("http")
+        rest = self.rest
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _handle(self, method: str):
+                parsed = urlparse(self.path)
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length).decode() if length else ""
+                body: object = raw
+                ctype = self.headers.get("Content-Type", "")
+                if raw and "json" in ctype:
+                    try:
+                        body = json.loads(raw)
+                    except ValueError:
+                        body = raw
+                elif raw and raw.lstrip().startswith(("{", "[")) and "\n" not in raw.strip():
+                    try:
+                        body = json.loads(raw)
+                    except ValueError:
+                        body = raw
+                request = RestRequest(
+                    method=method, path=parsed.path,
+                    params=dict(parse_qsl(parsed.query)), body=body)
+                response = rest.dispatch(request)
+                payload = response.payload()
+                self.send_response(response.status)
+                self.send_header("Content-Type", response.content_type)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                if method != "HEAD":
+                    self.wfile.write(payload)
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_POST(self):
+                self._handle("POST")
+
+            def do_PUT(self):
+                self._handle("PUT")
+
+            def do_DELETE(self):
+                self._handle("DELETE")
+
+            def do_HEAD(self):
+                self._handle("HEAD")
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_port
+        self.host = host
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True,
+                                        name=f"estpu[http:{self.port}]")
+        self._thread.start()
+        self.logger.info("http listening on %s:%d", self.host, self.port)
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()

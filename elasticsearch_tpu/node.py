@@ -70,6 +70,7 @@ class Node:
                                       self.cluster_service, self.allocation,
                                       self.settings)
         self.discovery.on_joined = None
+        self.http = None
         self._started = False
         self._closed = False
 
@@ -80,14 +81,26 @@ class Node:
         self.discovery.start(addresses)
         self.gateway.maybe_recover()
         self._started = True
+        if self.settings.get_bool("http.enabled", False):
+            self.start_http(self.settings.get_int("http.port", 9200))
         self.logger.info("started (master=%s)",
                          self.cluster_service.state.nodes.master_id)
         return self
+
+    def start_http(self, port: int = 0):
+        """Bind the REST surface (port 0 = ephemeral)."""
+        from .http.server import HttpServer
+        from .rest.controller import build_rest_controller
+
+        self.http = HttpServer(build_rest_controller(self), port=port).start()
+        return self.http
 
     def close(self):
         if self._closed:
             return
         self._closed = True
+        if self.http is not None:
+            self.http.stop()
         self.discovery.leave()
         self.discovery.stop()
         self.gateway.persist_now()

@@ -1,0 +1,1 @@
+from .controller import RestController, RestRequest, build_rest_controller  # noqa: F401

@@ -1,0 +1,565 @@
+"""REST layer: path-template routing + handlers for the API surface.
+
+Analogue of rest/ (89 Rest*Action handler classes + RestController — SURVEY.md §2.7),
+with the reference's `rest-api-spec/api/*.json` as the endpoint contract: methods, path
+templates with {placeholders}, query params, JSON bodies, structured errors with HTTP
+status codes, and the `_cat` plain-text ops APIs.
+
+Handlers call the node Client — REST is a thin adapter exactly as in the reference
+(RestController.dispatchRequest → client.*).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field as dc_field
+from typing import Callable
+
+from ..common.errors import SearchEngineError
+
+
+@dataclass
+class RestRequest:
+    method: str
+    path: str
+    params: dict = dc_field(default_factory=dict)
+    body: dict | list | str | None = None
+    path_params: dict = dc_field(default_factory=dict)
+
+    def param(self, name: str, default=None):
+        return self.path_params.get(name) or self.params.get(name, default)
+
+    def bool_param(self, name: str, default=False) -> bool:
+        v = self.param(name)
+        if v is None:
+            return default
+        return str(v).lower() in ("true", "1", "")
+
+
+@dataclass
+class RestResponse:
+    status: int
+    body: object
+    content_type: str = "application/json"
+
+    def payload(self) -> bytes:
+        if isinstance(self.body, (bytes,)):
+            return self.body
+        if isinstance(self.body, str):
+            return self.body.encode()
+        return json.dumps(self.body).encode()
+
+
+class RestController:
+    """register(method, "/{index}/{type}/_search", handler) + dispatch."""
+
+    def __init__(self):
+        self._routes: dict[str, list[tuple[re.Pattern, list[str], Callable]]] = {}
+
+    def register(self, method: str, template: str, handler: Callable):
+        names = re.findall(r"\{(\w+)\}", template)
+        pattern = re.sub(r"\{(\w+)\}", r"([^/]+)", template.rstrip("/") or "/")
+        compiled = re.compile("^" + pattern + "/?$")
+        for m in method.split(","):
+            self._routes.setdefault(m.strip().upper(), []).append(
+                (compiled, names, handler))
+
+    def dispatch(self, request: RestRequest) -> RestResponse:
+        routes = self._routes.get(request.method, []) + (
+            self._routes.get("GET", []) if request.method == "HEAD" else [])
+        path = request.path.rstrip("/") or "/"
+        best = None
+        for pattern, names, handler in routes:
+            m = pattern.match(path)
+            if m:
+                # prefer routes with fewer wildcards (literal match wins)
+                score = len(names)
+                if best is None or score < best[0]:
+                    best = (score, m, names, handler)
+        if best is None:
+            return RestResponse(400, {"error": f"No handler found for uri [{request.path}] "
+                                               f"and method [{request.method}]"})
+        _, m, names, handler = best
+        request.path_params = dict(zip(names, m.groups()))
+        try:
+            result = handler(request)
+            if isinstance(result, RestResponse):
+                return result
+            return RestResponse(200, result)
+        except SearchEngineError as e:
+            return RestResponse(e.status, {"error": e.to_dict(), "status": e.status})
+        except Exception as e:  # noqa: BLE001
+            return RestResponse(500, {"error": {"type": type(e).__name__,
+                                                "reason": str(e)}, "status": 500})
+
+
+def _parse_body(request: RestRequest) -> dict:
+    if request.body is None or request.body == "":
+        return {}
+    if isinstance(request.body, (dict, list)):
+        return request.body
+    return json.loads(request.body)
+
+
+def build_rest_controller(node) -> RestController:
+    client = node.client()
+    rc = RestController()
+    scroll_registry: dict[str, tuple] = {}
+
+    # --- root / ping --------------------------------------------------------
+    def root(req):
+        from ..version import CURRENT
+
+        return {
+            "status": 200,
+            "name": node.name,
+            "version": {"number": str(CURRENT)},
+            "tagline": "You Know, for Search (TPU-native)",
+        }
+
+    rc.register("GET,HEAD", "/", root)
+
+    # --- document CRUD ------------------------------------------------------
+    def doc_index(req):
+        body = _parse_body(req)
+        r = client.index(
+            req.path_params["index"], req.path_params["type"], body,
+            id=req.path_params.get("id"), routing=req.param("routing"),
+            version=int(req.param("version")) if req.param("version") else None,
+            version_type=req.param("version_type", "internal"),
+            op_type=req.param("op_type", "index"),
+            refresh=req.bool_param("refresh"),
+        )
+        return RestResponse(201 if r.get("created") else 200, r)
+
+    rc.register("PUT,POST", "/{index}/{type}/{id}", doc_index)
+    rc.register("POST", "/{index}/{type}", doc_index)
+
+    def doc_create(req):
+        body = _parse_body(req)
+        r = client.create(req.path_params["index"], req.path_params["type"], body,
+                          id=req.path_params["id"], routing=req.param("routing"))
+        return RestResponse(201, r)
+
+    rc.register("PUT,POST", "/{index}/{type}/{id}/_create", doc_create)
+
+    def doc_get(req):
+        r = client.get(req.path_params["index"], req.path_params["type"],
+                       req.path_params["id"], routing=req.param("routing"),
+                       realtime=req.bool_param("realtime", True),
+                       preference=req.param("preference"))
+        return RestResponse(200 if r["found"] else 404, r)
+
+    rc.register("GET,HEAD", "/{index}/{type}/{id}", doc_get)
+
+    def doc_source(req):
+        r = client.get(req.path_params["index"], req.path_params["type"],
+                       req.path_params["id"])
+        if not r["found"]:
+            return RestResponse(404, {"found": False})
+        return r["_source"]
+
+    rc.register("GET", "/{index}/{type}/{id}/_source", doc_source)
+
+    def doc_delete(req):
+        r = client.delete(req.path_params["index"], req.path_params["type"],
+                          req.path_params["id"], routing=req.param("routing"),
+                          refresh=req.bool_param("refresh"))
+        return RestResponse(200 if r["found"] else 404, r)
+
+    rc.register("DELETE", "/{index}/{type}/{id}", doc_delete)
+
+    def doc_update(req):
+        body = _parse_body(req)
+        return client.update(req.path_params["index"], req.path_params["type"],
+                             req.path_params["id"], body,
+                             routing=req.param("routing"),
+                             retry_on_conflict=int(req.param("retry_on_conflict", 0)))
+
+    rc.register("POST", "/{index}/{type}/{id}/_update", doc_update)
+
+    def mget(req):
+        body = _parse_body(req)
+        docs = body.get("docs", [])
+        for d in docs:
+            d.setdefault("_index", req.path_params.get("index"))
+            d.setdefault("_type", req.path_params.get("type", "_all"))
+        if "ids" in body:
+            docs = [{"_index": req.path_params.get("index"),
+                     "_type": req.path_params.get("type", "_all"), "_id": i}
+                    for i in body["ids"]]
+        return client.mget(docs)
+
+    rc.register("GET,POST", "/_mget", mget)
+    rc.register("GET,POST", "/{index}/_mget", mget)
+    rc.register("GET,POST", "/{index}/{type}/_mget", mget)
+
+    def bulk(req):
+        raw = req.body if isinstance(req.body, str) else ""
+        operations = []
+        if isinstance(req.body, list):  # pre-parsed
+            operations = req.body
+        else:
+            lines = [ln for ln in raw.split("\n") if ln.strip()]
+            i = 0
+            while i < len(lines):
+                action = json.loads(lines[i])
+                (op, meta), = action.items()
+                meta.setdefault("_index", req.path_params.get("index"))
+                meta.setdefault("_type", req.path_params.get("type", "_default_"))
+                entry = {"action": action}
+                i += 1
+                if op != "delete":
+                    entry["source"] = json.loads(lines[i]) if i < len(lines) else {}
+                    i += 1
+                operations.append(entry)
+        return client.bulk(operations, refresh=req.bool_param("refresh"))
+
+    rc.register("POST,PUT", "/_bulk", bulk)
+    rc.register("POST,PUT", "/{index}/_bulk", bulk)
+    rc.register("POST,PUT", "/{index}/{type}/_bulk", bulk)
+
+    # --- search -------------------------------------------------------------
+    def _search_body(req):
+        body = _parse_body(req)
+        if req.param("q"):
+            body = dict(body)
+            body["query"] = {"query_string": {"query": req.param("q")}}
+        for p in ("from", "size"):
+            if req.param(p) is not None:
+                body[p] = int(req.param(p))
+        if req.param("sort"):
+            body["sort"] = [
+                ({s.split(":")[0]: s.split(":")[1]} if ":" in s else s)
+                for s in str(req.param("sort")).split(",")
+            ]
+        return body
+
+    def search(req):
+        body = _search_body(req)
+        index = req.path_params.get("index", "_all")
+        scroll = req.param("scroll")
+        if scroll:
+            return _scrolled_search(index, body, scroll)
+        return client.search(index, body,
+                             search_type=req.param("search_type", "query_then_fetch"),
+                             routing=req.param("routing"),
+                             preference=req.param("preference"))
+
+    def _scrolled_search(index, body, keep_alive):
+        import uuid as _uuid
+
+        r = client.search(index, {**body, "from": 0,
+                                  "size": max(body.get("size", 10), 10) * 10})
+        sid = _uuid.uuid4().hex
+        size = body.get("size", 10)
+        hits = r["hits"]["hits"]
+        scroll_registry[sid] = (hits, size, size)
+        r["_scroll_id"] = sid
+        r["hits"]["hits"] = hits[:size]
+        return r
+
+    def scroll(req):
+        body = _parse_body(req)
+        sid = body.get("scroll_id") or req.param("scroll_id") or (
+            req.body if isinstance(req.body, str) and req.body and
+            not req.body.startswith("{") else None)
+        if sid not in scroll_registry:
+            from ..common.errors import SearchContextMissingError
+
+            raise SearchContextMissingError(0)
+        hits, size, pos = scroll_registry[sid]
+        page = hits[pos: pos + size]
+        scroll_registry[sid] = (hits, size, pos + size)
+        return {"_scroll_id": sid, "hits": {"total": len(hits), "hits": page},
+                "timed_out": False, "_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+    rc.register("GET,POST", "/{index}/_search", search)
+    rc.register("GET,POST", "/{index}/{type}/_search", search)
+    rc.register("GET,POST", "/_search", search)
+    rc.register("GET,POST", "/_search/scroll", scroll)
+
+    def clear_scroll(req):
+        body = _parse_body(req)
+        for sid in body.get("scroll_id", []):
+            scroll_registry.pop(sid, None)
+        return {"succeeded": True}
+
+    rc.register("DELETE", "/_search/scroll", clear_scroll)
+
+    def msearch(req):
+        raw = req.body if isinstance(req.body, str) else ""
+        lines = [ln for ln in raw.split("\n") if ln.strip()]
+        requests = []
+        for i in range(0, len(lines) - 1, 2):
+            requests.append((json.loads(lines[i]), json.loads(lines[i + 1])))
+        return client.msearch(requests)
+
+    rc.register("GET,POST", "/_msearch", msearch)
+    rc.register("GET,POST", "/{index}/_msearch", msearch)
+
+    def count(req):
+        body = _search_body(req)
+        return client.count(req.path_params.get("index", "_all"), body)
+
+    rc.register("GET,POST", "/_count", count)
+    rc.register("GET,POST", "/{index}/_count", count)
+    rc.register("GET,POST", "/{index}/{type}/_count", count)
+
+    def suggest(req):
+        return client.suggest(req.path_params.get("index", "_all"), _parse_body(req))
+
+    rc.register("GET,POST", "/_suggest", suggest)
+    rc.register("GET,POST", "/{index}/_suggest", suggest)
+
+    def explain(req):
+        return client.explain(req.path_params["index"], req.path_params["type"],
+                              req.path_params["id"], _parse_body(req))
+
+    rc.register("GET,POST", "/{index}/{type}/{id}/_explain", explain)
+
+    def validate_query(req):
+        body = _parse_body(req)
+        try:
+            from ..search.queries import parse_query as pq
+
+            pq(body.get("query"))
+            return {"valid": True, "_shards": {"total": 1, "successful": 1, "failed": 0}}
+        except SearchEngineError as e:
+            return {"valid": False, "explanations": [{"error": str(e)}]}
+
+    rc.register("GET,POST", "/{index}/_validate/query", validate_query)
+    rc.register("GET,POST", "/_validate/query", validate_query)
+
+    def delete_by_query(req):
+        return client.delete_by_query(req.path_params["index"], _search_body(req))
+
+    rc.register("DELETE", "/{index}/_query", delete_by_query)
+    rc.register("DELETE", "/{index}/{type}/_query", delete_by_query)
+
+    # --- indices admin ------------------------------------------------------
+    def index_create(req):
+        return client.create_index(req.path_params["index"], _parse_body(req))
+
+    def index_delete(req):
+        return client.delete_index(req.path_params["index"])
+
+    def index_exists(req):
+        return RestResponse(200 if client.exists_index(req.path_params["index"]) else 404,
+                            "")
+
+    rc.register("PUT,POST", "/{index}", index_create)
+    rc.register("DELETE", "/{index}", index_delete)
+    rc.register("HEAD", "/{index}", index_exists)
+    rc.register("POST", "/{index}/_open", lambda r: client.open_index(r.path_params["index"]))
+    rc.register("POST", "/{index}/_close", lambda r: client.close_index(r.path_params["index"]))
+
+    def put_mapping(req):
+        return client.put_mapping(req.path_params["index"], req.path_params["type"],
+                                  _parse_body(req))
+
+    rc.register("PUT,POST", "/{index}/{type}/_mapping", put_mapping)
+    rc.register("PUT,POST", "/{index}/_mapping/{type}", put_mapping)
+    rc.register("GET", "/{index}/_mapping",
+                lambda r: client.get_mapping(r.path_params["index"]))
+    rc.register("GET", "/{index}/{type}/_mapping",
+                lambda r: client.get_mapping(r.path_params["index"], r.path_params["type"]))
+    rc.register("GET", "/_mapping", lambda r: client.get_mapping())
+
+    rc.register("PUT", "/{index}/_settings",
+                lambda r: client.update_settings(r.path_params["index"], _parse_body(r)))
+    rc.register("GET", "/{index}/_settings",
+                lambda r: client.get_settings(r.path_params["index"]))
+    rc.register("GET", "/_settings", lambda r: client.get_settings())
+
+    rc.register("POST", "/_aliases", lambda r: client.update_aliases(_parse_body(r)))
+    rc.register("GET", "/_aliases", lambda r: client.get_aliases())
+    rc.register("GET", "/{index}/_aliases", lambda r: client.get_aliases(r.path_params["index"]))
+
+    def put_alias(req):
+        return client.update_aliases({"actions": [{"add": {
+            "index": req.path_params["index"], "alias": req.path_params["name"],
+            **_parse_body(req)}}]})
+
+    rc.register("PUT", "/{index}/_alias/{name}", put_alias)
+    rc.register("DELETE", "/{index}/_alias/{name}", lambda r: client.update_aliases(
+        {"actions": [{"remove": {"index": r.path_params["index"],
+                                 "alias": r.path_params["name"]}}]}))
+
+    rc.register("PUT,POST", "/_template/{name}",
+                lambda r: client.put_template(r.path_params["name"], _parse_body(r)))
+    rc.register("DELETE", "/_template/{name}",
+                lambda r: client.delete_template(r.path_params["name"]))
+    rc.register("GET", "/_template/{name}",
+                lambda r: client.get_template(r.path_params["name"]))
+    rc.register("GET", "/_template", lambda r: client.get_template())
+
+    for op in ("refresh", "flush", "optimize"):
+        rc.register("POST,GET", f"/_{op}",
+                    (lambda o: lambda r: getattr(client, o)(None))(op))
+        rc.register("POST,GET", "/{index}/_" + op,
+                    (lambda o: lambda r: getattr(client, o)(r.path_params["index"]))(op))
+    rc.register("POST", "/_cache/clear", lambda r: client.clear_cache())
+    rc.register("POST", "/{index}/_cache/clear",
+                lambda r: client.clear_cache(r.path_params["index"]))
+
+    def analyze(req):
+        body = _parse_body(req)
+        text = body.get("text") or req.param("text") or (
+            req.body if isinstance(req.body, str) and not req.body.startswith("{") else "")
+        analyzer_name = body.get("analyzer") or req.param("analyzer") or "standard"
+        from ..analysis import get_analyzer
+
+        a = get_analyzer(analyzer_name)
+        return {"tokens": [
+            {"token": t.term, "start_offset": t.start, "end_offset": t.end,
+             "type": "<ALPHANUM>", "position": t.position + 1}
+            for t in a.analyze(text if isinstance(text, str) else " ".join(text))
+        ]}
+
+    rc.register("GET,POST", "/_analyze", analyze)
+    rc.register("GET,POST", "/{index}/_analyze", analyze)
+
+    rc.register("GET", "/_stats", lambda r: {"indices": client.stats()})
+    rc.register("GET", "/{index}/_stats",
+                lambda r: {"indices": client.stats(r.path_params["index"])})
+    rc.register("GET", "/_segments", lambda r: {"indices": client.stats()})
+
+    # --- cluster admin ------------------------------------------------------
+    rc.register("GET", "/_cluster/health",
+                lambda r: client.cluster_health(
+                    wait_for_status=r.param("wait_for_status"),
+                    timeout=float(str(r.param("timeout", "10")).rstrip("s"))))
+    rc.register("GET", "/_cluster/health/{index}",
+                lambda r: client.cluster_health(index=r.path_params["index"]))
+    rc.register("GET", "/_cluster/state", lambda r: client.cluster_state())
+    rc.register("GET", "/_cluster/pending_tasks", lambda r: client.pending_tasks())
+    rc.register("PUT", "/_cluster/settings",
+                lambda r: client.cluster_update_settings(_parse_body(r)))
+    rc.register("POST", "/_cluster/reroute",
+                lambda r: client.cluster_reroute(_parse_body(r)))
+    rc.register("GET", "/_nodes", lambda r: client.nodes_info())
+    rc.register("GET", "/_nodes/stats", lambda r: client.nodes_stats())
+    rc.register("GET", "/_cluster/nodes/hot_threads", lambda r: _hot_threads())
+    rc.register("GET", "/_nodes/hot_threads", lambda r: _hot_threads())
+
+    def _hot_threads():
+        """ref: monitor/jvm/HotThreads — stacks of the busiest threads."""
+        import sys
+        import traceback
+
+        out = []
+        frames = sys._current_frames()
+        import threading as _th
+
+        names = {t.ident: t.name for t in _th.enumerate()}
+        for tid, frame in list(frames.items())[:10]:
+            stack = "".join(traceback.format_stack(frame, limit=8))
+            out.append(f"::: [{names.get(tid, tid)}]\n{stack}")
+        return RestResponse(200, "\n".join(out), content_type="text/plain")
+
+    # --- _cat APIs (plain text ops views — ref: rest/action/cat/) -----------
+    def cat_health(req):
+        h = client.cluster_health()
+        return RestResponse(200, f"{h['cluster_name']} {h['status']} "
+                                 f"{h['number_of_nodes']} {h['number_of_data_nodes']} "
+                                 f"{h['active_shards']} {h['unassigned_shards']}\n",
+                            content_type="text/plain")
+
+    def cat_nodes(req):
+        state = node.cluster_service.state
+        lines = []
+        for n in state.nodes.nodes:
+            marker = "*" if n.id == state.nodes.master_id else "-"
+            lines.append(f"{n.name} {marker} {n.transport_address} "
+                         f"master_eligible={n.master_eligible} data={n.data}")
+        return RestResponse(200, "\n".join(lines) + "\n", content_type="text/plain")
+
+    def cat_indices(req):
+        state = node.cluster_service.state
+        lines = []
+        for name in state.metadata.index_names():
+            meta = state.metadata.index(name)
+            h = client.cluster_health(index=name)
+            try:
+                cnt = client.count(name)["count"]
+            except SearchEngineError:
+                cnt = "-"
+            lines.append(f"{h['status']} {name} {meta.number_of_shards} "
+                         f"{meta.number_of_replicas} {cnt}")
+        return RestResponse(200, "\n".join(lines) + "\n", content_type="text/plain")
+
+    def cat_shards(req):
+        state = node.cluster_service.state
+        lines = []
+        for s in state.routing_table.all_shards():
+            kind = "p" if s.primary else "r"
+            lines.append(f"{s.index} {s.shard_id} {kind} {s.state} {s.node_id or '-'}")
+        return RestResponse(200, "\n".join(lines) + "\n", content_type="text/plain")
+
+    def cat_master(req):
+        state = node.cluster_service.state
+        m = state.nodes.master
+        return RestResponse(200, f"{m.id} {m.name}\n" if m else "-\n",
+                            content_type="text/plain")
+
+    def cat_allocation(req):
+        state = node.cluster_service.state
+        counts: dict[str, int] = {}
+        for s in state.routing_table.all_shards():
+            if s.node_id:
+                counts[s.node_id] = counts.get(s.node_id, 0) + 1
+        lines = [f"{nid} {cnt}" for nid, cnt in sorted(counts.items())]
+        return RestResponse(200, "\n".join(lines) + "\n", content_type="text/plain")
+
+    def cat_count(req):
+        index = req.path_params.get("index")
+        c = client.count(index or "_all")["count"]
+        return RestResponse(200, f"{c}\n", content_type="text/plain")
+
+    def cat_aliases(req):
+        lines = []
+        for index, spec in client.get_aliases().items():
+            for alias in spec["aliases"]:
+                lines.append(f"{alias} {index}")
+        return RestResponse(200, "\n".join(lines) + "\n", content_type="text/plain")
+
+    def cat_pending_tasks(req):
+        tasks = client.pending_tasks()["tasks"]
+        lines = [f"{t['priority']} {t['time_in_queue_millis']}ms {t['source']}"
+                 for t in tasks]
+        return RestResponse(200, "\n".join(lines) + "\n", content_type="text/plain")
+
+    def cat_recovery(req):
+        lines = []
+        for index, spec in node.indices.stats().items():
+            for sid, st in spec["shards"].items():
+                lines.append(f"{index} {sid} {st['state']} "
+                             f"docs={st['docs']['count']}")
+        return RestResponse(200, "\n".join(lines) + "\n", content_type="text/plain")
+
+    def cat_thread_pool(req):
+        lines = [f"{name} {st['threads']} {st['completed']}"
+                 for name, st in node.threadpool.stats().items()]
+        return RestResponse(200, "\n".join(lines) + "\n", content_type="text/plain")
+
+    rc.register("GET", "/_cat/health", cat_health)
+    rc.register("GET", "/_cat/nodes", cat_nodes)
+    rc.register("GET", "/_cat/indices", cat_indices)
+    rc.register("GET", "/_cat/shards", cat_shards)
+    rc.register("GET", "/_cat/master", cat_master)
+    rc.register("GET", "/_cat/allocation", cat_allocation)
+    rc.register("GET", "/_cat/count", cat_count)
+    rc.register("GET", "/_cat/count/{index}", cat_count)
+    rc.register("GET", "/_cat/aliases", cat_aliases)
+    rc.register("GET", "/_cat/pending_tasks", cat_pending_tasks)
+    rc.register("GET", "/_cat/recovery", cat_recovery)
+    rc.register("GET", "/_cat/thread_pool", cat_thread_pool)
+    rc.register("GET", "/_cat", lambda r: RestResponse(
+        200, "".join(f"/_cat/{n}\n" for n in (
+            "health", "nodes", "indices", "shards", "master", "allocation", "count",
+            "aliases", "pending_tasks", "recovery", "thread_pool")),
+        content_type="text/plain"))
+
+    return rc

@@ -1,0 +1,226 @@
+"""REST + HTTP: the full API surface through real sockets (curl-equivalent)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.transport.local import LocalTransportRegistry
+
+
+@pytest.fixture(scope="module")
+def http_node(tmp_path_factory):
+    registry = LocalTransportRegistry()
+    node = Node(name="rest_node", registry=registry,
+                data_path=str(tmp_path_factory.mktemp("rest_node")))
+    node.start([node.local_node.transport_address])
+    node.wait_for_master()
+    server = node.start_http(port=0)
+    yield node, f"http://127.0.0.1:{server.port}"
+    node.close()
+
+
+def call(base, method, path, body=None, raw_body=None, ok_statuses=(200, 201)):
+    data = None
+    headers = {}
+    if raw_body is not None:
+        data = raw_body.encode()
+        headers["Content-Type"] = "application/x-ndjson"
+    elif body is not None:
+        data = json.dumps(body).encode()
+        headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(base + path, data=data, method=method, headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            status = resp.status
+            payload = resp.read().decode()
+    except urllib.error.HTTPError as e:
+        status = e.code
+        payload = e.read().decode()
+    try:
+        parsed = json.loads(payload) if payload else None
+    except ValueError:
+        parsed = payload
+    return status, parsed
+
+
+class TestRestApi:
+    def test_root(self, http_node):
+        node, base = http_node
+        status, body = call(base, "GET", "/")
+        assert status == 200
+        assert body["version"]["number"].startswith("0.")
+
+    def test_document_crud_lifecycle(self, http_node):
+        node, base = http_node
+        status, body = call(base, "PUT", "/crud", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0}})
+        assert status == 200 and body["acknowledged"]
+        status, body = call(base, "PUT", "/crud/doc/1",
+                            {"title": "hello world", "views": 3})
+        assert status == 201 and body["created"] and body["_version"] == 1
+        status, body = call(base, "GET", "/crud/doc/1")
+        assert status == 200 and body["_source"]["title"] == "hello world"
+        status, body = call(base, "GET", "/crud/doc/1/_source")
+        assert body == {"title": "hello world", "views": 3}
+        status, body = call(base, "PUT", "/crud/doc/1", {"title": "updated"})
+        assert status == 200 and body["_version"] == 2
+        status, body = call(base, "POST", "/crud/doc/1/_update",
+                            {"doc": {"extra": True}})
+        assert status == 200
+        status, body = call(base, "GET", "/crud/doc/1")
+        assert body["_source"] == {"title": "updated", "extra": True}
+        status, body = call(base, "DELETE", "/crud/doc/1")
+        assert status == 200 and body["found"]
+        status, body = call(base, "GET", "/crud/doc/1")
+        assert status == 404 and not body["found"]
+        status, body = call(base, "PUT", "/crud/doc/2/_create", {"a": 1})
+        assert status == 201
+        status, body = call(base, "PUT", "/crud/doc/2/_create", {"a": 2})
+        assert status == 409
+
+    def test_search_with_aggs_and_q(self, http_node):
+        node, base = http_node
+        call(base, "PUT", "/lib", {"settings": {"number_of_shards": 2,
+                                                "number_of_replicas": 0}})
+        for i, (title, cat) in enumerate([
+            ("the art of search", "tech"), ("cooking for two", "food"),
+            ("search engines explained", "tech"), ("garden design", "home"),
+        ]):
+            call(base, "PUT", f"/lib/book/{i}", {"title": title, "category": cat,
+                                                 "pages": (i + 1) * 100})
+        call(base, "POST", "/lib/_refresh")
+        status, body = call(base, "POST", "/lib/_search", {
+            "query": {"match": {"title": "search"}},
+            "aggs": {"cats": {"terms": {"field": "category"}},
+                     "avg_pages": {"avg": {"field": "pages"}}},
+            "highlight": {"fields": {"title": {}}},
+        })
+        assert status == 200
+        assert body["hits"]["total"] == 2
+        assert "<em>search</em>" in body["hits"]["hits"][0]["highlight"]["title"][0]
+        cats = {b["key"]: b["doc_count"] for b in body["aggregations"]["cats"]["buckets"]}
+        assert cats == {"tech": 2}
+        # URI search (?q=)
+        status, body = call(base, "GET", "/lib/_search?q=title:cooking")
+        assert body["hits"]["total"] == 1
+        # count
+        status, body = call(base, "GET", "/lib/_count")
+        assert body["count"] == 4
+
+    def test_bulk_ndjson(self, http_node):
+        node, base = http_node
+        ndjson = "\n".join([
+            json.dumps({"index": {"_index": "bulked", "_type": "d", "_id": "1"}}),
+            json.dumps({"x": 1}),
+            json.dumps({"index": {"_index": "bulked", "_type": "d", "_id": "2"}}),
+            json.dumps({"x": 2}),
+            json.dumps({"delete": {"_index": "bulked", "_type": "d", "_id": "2"}}),
+        ]) + "\n"
+        status, body = call(base, "POST", "/_bulk?refresh=true", raw_body=ndjson)
+        assert status == 200
+        assert not body["errors"]
+        status, body = call(base, "GET", "/_cat/count/bulked")
+        assert str(body).strip() == "1"  # plain-text "1\n" (json.loads parses to int)
+
+    def test_mapping_settings_aliases(self, http_node):
+        node, base = http_node
+        call(base, "PUT", "/meta1", {"settings": {"number_of_shards": 1,
+                                                  "number_of_replicas": 0}})
+        status, body = call(base, "PUT", "/meta1/typ/_mapping", {
+            "typ": {"properties": {"tag": {"type": "string",
+                                           "index": "not_analyzed"}}}})
+        assert status == 200
+        status, body = call(base, "GET", "/meta1/_mapping")
+        assert body["meta1"]["mappings"]["typ"]["properties"]["tag"]["type"] == "string"
+        status, body = call(base, "PUT", "/meta1/_alias/m1")
+        assert status == 200
+        status, body = call(base, "GET", "/_aliases")
+        assert "m1" in body["meta1"]["aliases"]
+        # search through the alias
+        status, _ = call(base, "PUT", "/meta1/typ/1", {"tag": "x"})
+        assert status == 201
+        call(base, "POST", "/meta1/_refresh")
+        status, body = call(base, "GET", "/m1/_search")
+        assert body["hits"]["total"] == 1
+        # raising replicas beyond available nodes: settings apply, and writes are
+        # rejected by the quorum consistency check (reference semantics)
+        status, body = call(base, "PUT", "/meta1/_settings",
+                            {"settings": {"number_of_replicas": 2}})
+        assert status == 200
+        status, body = call(base, "GET", "/meta1/_settings")
+        assert str(body["meta1"]["settings"]["index"]["number_of_replicas"]) == "2"
+        status, body = call(base, "PUT", "/meta1/typ/2", {"tag": "y"})
+        assert status == 503  # quorum (2 of 3) not reachable on one node
+
+    def test_analyze_api(self, http_node):
+        node, base = http_node
+        status, body = call(base, "GET", "/_analyze?text=Quick+Brown+Foxes&analyzer=standard")
+        assert [t["token"] for t in body["tokens"]] == ["quick", "brown", "foxes"]
+
+    def test_cluster_apis(self, http_node):
+        node, base = http_node
+        status, body = call(base, "GET", "/_cluster/health")
+        assert body["status"] in ("green", "yellow")
+        status, body = call(base, "GET", "/_cluster/state")
+        assert body["nodes"]["master_id"] == "rest_node"
+        status, body = call(base, "GET", "/_nodes")
+        assert "rest_node" in body["nodes"]
+        status, body = call(base, "GET", "/_nodes/stats")
+        assert "indices" in body["nodes"]["rest_node"]
+
+    def test_cat_apis(self, http_node):
+        node, base = http_node
+        for path in ("/_cat", "/_cat/health", "/_cat/nodes", "/_cat/indices",
+                     "/_cat/shards", "/_cat/master", "/_cat/allocation",
+                     "/_cat/pending_tasks", "/_cat/thread_pool", "/_cat/recovery"):
+            status, body = call(base, "GET", path)
+            assert status == 200, path
+            assert isinstance(body, str), path
+        status, body = call(base, "GET", "/_cat/master")
+        assert "rest_node" in body
+
+    def test_errors_are_structured(self, http_node):
+        node, base = http_node
+        status, body = call(base, "GET", "/missing_index/_search")
+        assert status == 404
+        assert body["error"]["type"] == "IndexMissingError"
+        status, body = call(base, "POST", "/lib/_search",
+                            {"query": {"bogus_query": {}}})
+        assert status == 400
+        assert "unknown query type" in body["error"]["reason"]
+        status, body = call(base, "GET", "/_no_such_api")
+        assert status in (400, 404)
+
+    def test_validate_and_explain(self, http_node):
+        node, base = http_node
+        status, body = call(base, "POST", "/lib/_validate/query",
+                            {"query": {"match": {"title": "x"}}})
+        assert body["valid"] is True
+        status, body = call(base, "POST", "/lib/_validate/query",
+                            {"query": {"nope": {}}})
+        assert body["valid"] is False
+        status, body = call(base, "GET", "/lib/book/0/_explain",
+                            {"query": {"match": {"title": "search"}}})
+        assert body["matched"] is True
+
+    def test_scroll_via_rest(self, http_node):
+        node, base = http_node
+        call(base, "PUT", "/scr", {"settings": {"number_of_shards": 1,
+                                                "number_of_replicas": 0}})
+        for i in range(25):
+            call(base, "PUT", f"/scr/d/{i}", {"i": i})
+        call(base, "POST", "/scr/_refresh")
+        status, body = call(base, "POST", "/scr/_search?scroll=1m",
+                            {"size": 10, "query": {"match_all": {}}})
+        assert len(body["hits"]["hits"]) == 10
+        sid = body["_scroll_id"]
+        seen = {h["_id"] for h in body["hits"]["hits"]}
+        while True:
+            status, body = call(base, "POST", "/_search/scroll", {"scroll_id": sid})
+            if not body["hits"]["hits"]:
+                break
+            seen.update(h["_id"] for h in body["hits"]["hits"])
+        assert len(seen) == 25
