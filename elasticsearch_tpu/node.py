@@ -66,6 +66,9 @@ class Node:
         self.gateway = LocalGateway(self.data_path, self.cluster_service,
                                     self.settings, node_name=self.name)
         self.actions = ActionModule(self)
+        from .snapshots import SnapshotsService
+
+        self.snapshots = SnapshotsService(self)
         self.discovery = ZenDiscovery(self.local_node, self.transport,
                                       self.cluster_service, self.allocation,
                                       self.settings)
@@ -345,6 +348,34 @@ class Client:
             "transport": self.node.transport.stats,
             "thread_pool": self.node.threadpool.stats(),
         }}}
+
+    # --- snapshots ----------------------------------------------------------
+    def put_repository(self, name, body):
+        return self.node.snapshots.put_repository(name, body)
+
+    def get_repository(self, name=None):
+        return self.node.snapshots.get_repository(name)
+
+    def delete_repository(self, name):
+        return self.node.snapshots.delete_repository(name)
+
+    def verify_repository(self, name):
+        return self.node.snapshots.verify_repository(name)
+
+    def create_snapshot(self, repo, snapshot, body=None):
+        return self.node.snapshots.create_snapshot(repo, snapshot, body)
+
+    def get_snapshots(self, repo, snapshot=None):
+        return self.node.snapshots.get_snapshots(repo, snapshot)
+
+    def snapshot_status(self, repo, snapshot):
+        return self.node.snapshots.snapshot_status(repo, snapshot)
+
+    def delete_snapshot(self, repo, snapshot):
+        return self.node.snapshots.delete_snapshot(repo, snapshot)
+
+    def restore_snapshot(self, repo, snapshot, body=None):
+        return self.node.snapshots.restore_snapshot(repo, snapshot, body)
 
     # --- plumbing -----------------------------------------------------------
     def _local(self, action, body):

@@ -544,6 +544,34 @@ def build_rest_controller(node) -> RestController:
                  for name, st in node.threadpool.stats().items()]
         return RestResponse(200, "\n".join(lines) + "\n", content_type="text/plain")
 
+    # --- snapshot/restore ----------------------------------------------------
+    rc.register("PUT,POST", "/_snapshot/{repo}",
+                lambda r: client.put_repository(r.path_params["repo"], _parse_body(r)))
+    rc.register("GET", "/_snapshot", lambda r: client.get_repository())
+    rc.register("GET", "/_snapshot/{repo}",
+                lambda r: client.get_repository(r.path_params["repo"]))
+    rc.register("DELETE", "/_snapshot/{repo}",
+                lambda r: client.delete_repository(r.path_params["repo"]))
+    rc.register("POST", "/_snapshot/{repo}/_verify",
+                lambda r: client.verify_repository(r.path_params["repo"]))
+    rc.register("PUT", "/_snapshot/{repo}/{snapshot}",
+                lambda r: client.create_snapshot(r.path_params["repo"],
+                                                 r.path_params["snapshot"],
+                                                 _parse_body(r)))
+    rc.register("GET", "/_snapshot/{repo}/{snapshot}",
+                lambda r: client.get_snapshots(r.path_params["repo"],
+                                               r.path_params["snapshot"]))
+    rc.register("GET", "/_snapshot/{repo}/{snapshot}/_status",
+                lambda r: client.snapshot_status(r.path_params["repo"],
+                                                 r.path_params["snapshot"]))
+    rc.register("DELETE", "/_snapshot/{repo}/{snapshot}",
+                lambda r: client.delete_snapshot(r.path_params["repo"],
+                                                 r.path_params["snapshot"]))
+    rc.register("POST", "/_snapshot/{repo}/{snapshot}/_restore",
+                lambda r: client.restore_snapshot(r.path_params["repo"],
+                                                  r.path_params["snapshot"],
+                                                  _parse_body(r)))
+
     rc.register("GET", "/_cat/health", cat_health)
     rc.register("GET", "/_cat/nodes", cat_nodes)
     rc.register("GET", "/_cat/indices", cat_indices)

@@ -1,0 +1,89 @@
+"""Snapshot / restore: incremental fs repository, restore to new + renamed indices."""
+
+import pytest
+
+from elasticsearch_tpu.common.errors import SnapshotError, SnapshotMissingError
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.transport.local import LocalTransportRegistry
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    registry = LocalTransportRegistry()
+    node = Node(name="snap_node", registry=registry, data_path=str(tmp_path / "node"))
+    node.start([node.local_node.transport_address])
+    node.wait_for_master()
+    yield node, node.client(), str(tmp_path / "repo")
+    node.close()
+
+
+class TestSnapshots:
+    def test_snapshot_restore_roundtrip(self, cluster):
+        node, client, repo_path = cluster
+        client.create_index("src", {"settings": {"number_of_shards": 2,
+                                                 "number_of_replicas": 0}})
+        client.cluster_health(wait_for_status="green")
+        for i in range(15):
+            client.index("src", "doc", {"n": i, "text": f"document {i}"}, id=str(i))
+        client.put_repository("backup", {"type": "fs",
+                                         "settings": {"location": repo_path}})
+        assert client.verify_repository("backup")["nodes"]
+        r = client.create_snapshot("backup", "snap1")
+        assert r["snapshot"]["state"] == "SUCCESS"
+        # delete the index, restore it
+        client.delete_index("src")
+        assert not client.exists_index("src")
+        r = client.restore_snapshot("backup", "snap1")
+        assert "src" in r["snapshot"]["indices"]
+        client.cluster_health(wait_for_status="green")
+        client.refresh("src")
+        assert client.count("src")["count"] == 15
+        g = client.get("src", "doc", "7")
+        assert g["found"] and g["_source"]["n"] == 7
+
+    def test_incremental_second_snapshot(self, cluster):
+        node, client, repo_path = cluster
+        client.create_index("inc", {"settings": {"number_of_shards": 1,
+                                                 "number_of_replicas": 0}})
+        client.cluster_health(wait_for_status="green")
+        client.index("inc", "doc", {"v": 1}, id="1")
+        client.put_repository("b2", {"type": "fs", "settings": {"location": repo_path}})
+        client.create_snapshot("b2", "s1")
+        client.index("inc", "doc", {"v": 2}, id="2")
+        r = client.create_snapshot("b2", "s2")
+        assert r["snapshot"]["state"] == "SUCCESS"
+        snaps = client.get_snapshots("b2")
+        assert [s["snapshot"] for s in snaps["snapshots"]] == ["s1", "s2"]
+        # restore older snapshot under a new name
+        r = client.restore_snapshot("b2", "s1", {"rename_pattern": "inc",
+                                                 "rename_replacement": "inc_restored"})
+        assert r["snapshot"]["indices"] == ["inc_restored"]
+        client.refresh("inc_restored")
+        assert client.count("inc_restored")["count"] == 1
+        assert client.count("inc")["count"] == 2  # original untouched
+
+    def test_delete_snapshot_prunes_orphans(self, cluster):
+        node, client, repo_path = cluster
+        client.create_index("p", {"settings": {"number_of_shards": 1,
+                                               "number_of_replicas": 0}})
+        client.cluster_health(wait_for_status="green")
+        client.index("p", "doc", {"x": 1}, id="1")
+        client.put_repository("b3", {"type": "fs", "settings": {"location": repo_path}})
+        client.create_snapshot("b3", "only")
+        client.delete_snapshot("b3", "only")
+        with pytest.raises(SnapshotMissingError):
+            client.get_snapshots("b3", "only")
+        import os
+
+        assert os.listdir(os.path.join(repo_path, "blobs")) == []
+
+    def test_restore_refuses_existing_index(self, cluster):
+        node, client, repo_path = cluster
+        client.create_index("e", {"settings": {"number_of_shards": 1,
+                                               "number_of_replicas": 0}})
+        client.cluster_health(wait_for_status="green")
+        client.index("e", "doc", {"x": 1}, id="1")
+        client.put_repository("b4", {"type": "fs", "settings": {"location": repo_path}})
+        client.create_snapshot("b4", "s")
+        with pytest.raises(SnapshotError):
+            client.restore_snapshot("b4", "s")
