@@ -114,6 +114,8 @@ class ActionModule:
             (A_PUT_TEMPLATE, self._m_put_template),
             (A_DELETE_TEMPLATE, self._m_delete_template),
             (A_CLUSTER_SETTINGS, self._m_cluster_settings),
+            ("indices:admin/warmers/put", self._m_put_warmer),
+            ("indices:admin/warmers/delete", self._m_delete_warmer),
             (A_REROUTE, self._m_reroute),
             (A_MAPPING_UPDATED, self._m_mapping_updated),
             (ACTION_SHARD_STARTED, self._m_shard_started),
@@ -360,6 +362,48 @@ class ActionModule:
         self._submit(f"delete-template[{name}]", update)
         return {"acknowledged": True}
 
+    def _m_put_warmer(self, request, channel):
+        """ref: search/warmer/IndexWarmersMetaData + indices/warmer — registered
+        searches run against new searchers on refresh before exposure."""
+        indices = self.cluster_service.state.metadata.resolve_indices(request["index"])
+        name, body = request["name"], request.get("body")
+
+        def update(state: ClusterState) -> ClusterState:
+            md = state.metadata
+            for index in indices:
+                md = md.with_index(md.require_index(index).with_warmer(name, body))
+            return state.next_version(metadata=md)
+
+        self._submit(f"put-warmer[{name}]", update)
+        return {"acknowledged": True}
+
+    def _m_delete_warmer(self, request, channel):
+        indices = self.cluster_service.state.metadata.resolve_indices(request["index"])
+        name = request["name"]
+
+        def update(state: ClusterState) -> ClusterState:
+            md = state.metadata
+            for index in indices:
+                md = md.with_index(md.require_index(index).with_warmer(name, None))
+            return state.next_version(metadata=md)
+
+        self._submit(f"delete-warmer[{name}]", update)
+        return {"acknowledged": True}
+
+    def _run_warmers(self, index: str, shard_id: int):
+        """After refresh, run registered warm-up searches against the new searcher
+        (populates filter caches + device packing before user traffic)."""
+        meta = self.cluster_service.state.metadata.index(index)
+        if meta is None or not meta.warmers:
+            return
+        for name, body in meta.warmers_dict().items():
+            try:
+                ctx = self._shard_ctx(index, shard_id)
+                execute_query_phase(ctx, parse_search_body(body), shard_id=shard_id)
+            except SearchEngineError as e:
+                self.logger.debug("warmer [%s] failed on [%s][%d]: %s",
+                                  name, index, shard_id, e)
+
     def _m_cluster_settings(self, request, channel):
         body = request["body"]
 
@@ -566,9 +610,21 @@ class ActionModule:
                 f"not enough active copies for [{index}][{shard_id}]: "
                 f"{active} < required {required}")
 
+    def _register_percolator(self, index: str, request: dict, delete: bool = False):
+        if request.get("type") != ".percolator":
+            return
+        svc = getattr(self.node, "percolator", None)
+        if svc is None:
+            return
+        if delete:
+            svc.unregister_query(index, request["id"])
+        else:
+            svc.register_query(index, request["id"], request.get("source") or {})
+
     def _p_index(self, request, channel):
         index, shard_id = request["index"], request["shard"]
         self._check_consistency(index, shard_id, request.get("consistency", "quorum"))
+        self._register_percolator(index, request)
         shard = self.indices.index_service(index).shard(shard_id)
         mapper = shard.engine.mapper_service.mapper_for(request["type"])
         known_before = set(mapper.fields)
@@ -597,6 +653,7 @@ class ActionModule:
                 "_version": version, "created": created}
 
     def _r_index(self, request, channel):
+        self._register_percolator(request["index"], request)
         shard = self.indices.index_service(request["index"]).shard(request["shard"])
         try:
             shard.engine.index(
@@ -612,6 +669,7 @@ class ActionModule:
 
     def _p_delete(self, request, channel):
         index, shard_id = request["index"], request["shard"]
+        self._register_percolator(index, request, delete=True)
         shard = self.indices.index_service(index).shard(shard_id)
         version, found = shard.engine.delete(
             request["type"], request["id"], version=request.get("version"))
@@ -935,7 +993,9 @@ class ActionModule:
             body["query"] = {"filtered": {"query": query, "filter": alias_filter}}
         req = parse_search_body(body)
         ctx = self._shard_ctx(index, shard_id, request.get("dfs"))
+        t_q = time.monotonic()
         result = execute_query_phase(ctx, req, shard_id=shard_id)
+        self._maybe_slowlog(index, shard_id, body, (time.monotonic() - t_q))
         return {
             "total": result.total,
             "docs": [[s, d, sv] for (s, d, sv) in result.docs],
@@ -944,6 +1004,23 @@ class ActionModule:
             "facet_partials": _encode_partials(result.facet_partials),
             "suggest": result.suggest,
         }
+
+    def _maybe_slowlog(self, index: str, shard_id: int, body: dict, took_s: float):
+        """Per-shard query slowlog (ref: index/search/slowlog/
+        ShardSlowLogSearchService.java:41,60-63 — warn/info/debug/trace thresholds from
+        dynamic index settings)."""
+        meta = self.cluster_service.state.metadata.index(index)
+        if meta is None:
+            return
+        settings = meta.settings
+        for level, log in (("warn", self.logger.warning), ("info", self.logger.info),
+                           ("debug", self.logger.debug)):
+            threshold = settings.get_time(
+                f"index.search.slowlog.threshold.query.{level}", None)
+            if threshold is not None and threshold >= 0 and took_s >= threshold:
+                log("slowlog [%s][%d] took[%.1fms] source[%s]",
+                    index, shard_id, took_s * 1000, str(body)[:500])
+                return
 
     def _s_fetch_phase(self, request, channel):
         ctx = self._shard_ctx(request["index"], request["shard"])
@@ -1017,7 +1094,8 @@ class ActionModule:
         shard = self.indices.index_service(request["index"]).shard(request["shard"])
         op = request["op"]
         if op == "refresh":
-            shard.engine.refresh()
+            if shard.engine.refresh():
+                self._run_warmers(request["index"], request["shard"])
             return {"ok": True}
         if op == "flush":
             shard.engine.flush()

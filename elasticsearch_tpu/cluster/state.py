@@ -233,6 +233,7 @@ class IndexMetaData:
     settings_map: tuple = ()
     mappings: tuple = ()  # ((type, mapping_dict_json), ...)
     aliases: tuple = ()  # ((alias, {filter, index_routing, search_routing}), ...)
+    warmers: tuple = ()  # ((name, search_body_json), ...) — ref: IndexWarmersMetaData
     state: str = "open"
     version: int = 1
 
@@ -281,11 +282,25 @@ class IndexMetaData:
     def aliases_dict(self) -> dict:
         return dict(self.aliases)
 
+    def with_warmer(self, name: str, body: dict | None) -> "IndexMetaData":
+        import json
+
+        others = tuple((n, b) for n, b in self.warmers if n != name)
+        if body is not None:
+            others = others + ((name, json.dumps(body)),)
+        return replace(self, warmers=others, version=self.version + 1)
+
+    def warmers_dict(self) -> dict:
+        import json
+
+        return {n: json.loads(b) for n, b in self.warmers}
+
     def to_dict(self) -> dict:
         return {
             "name": self.name, "settings": dict(self.settings_map),
             "mappings": dict(self.mappings), "aliases": {k: dict(v) if isinstance(v, dict) else v
                                                          for k, v in self.aliases},
+            "warmers": dict(self.warmers),
             "state": self.state, "version": self.version,
         }
 
@@ -295,6 +310,7 @@ class IndexMetaData:
             d["name"], tuple(sorted(d.get("settings", {}).items())),
             tuple(d.get("mappings", {}).items()),
             tuple(sorted(d.get("aliases", {}).items())),
+            tuple(sorted(d.get("warmers", {}).items())),
             d.get("state", "open"), d.get("version", 1),
         )
 

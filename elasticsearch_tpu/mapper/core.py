@@ -384,6 +384,12 @@ class DocumentMapper:
                 doc.ttl = int(parse_time(raw_ttl) * 1000) if isinstance(raw_ttl, str) else int(raw_ttl)
         if self.routing_path and routing is None and self.routing_path in source:
             doc.routing = str(source[self.routing_path])
+        if doc.ttl is not None:
+            base_ts = doc.timestamp if doc.timestamp is not None else int(
+                __import__("time").time() * 1000)
+            doc.doc_values_num["_expiry"] = [float(base_ts + doc.ttl)]
+        if doc.timestamp is not None:
+            doc.doc_values_num["_timestamp"] = [float(doc.timestamp)]
         all_terms: list[tuple[str, int]] = []
         self._parse_object(source, "", doc, all_terms, nested_path=None)
         if self.all_enabled and all_terms:

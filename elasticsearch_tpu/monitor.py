@@ -1,0 +1,138 @@
+"""Monitor service: OS / process / fs / runtime metrics.
+
+Analogue of monitor/ (SURVEY.md §2.9): the reference loads native Sigar libraries for
+os/process/network stats with pure-Java fallbacks; here the native source of truth is
+/proc (what Sigar reads underneath) plus resource/os modules — no JVM, so "jvm stats"
+map to the Python runtime + the JAX device: heap → RSS, GC → gc module, plus TPU HBM
+numbers from jax's memory_stats when a device is live.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import resource
+import time
+
+
+def os_stats() -> dict:
+    out: dict = {"timestamp": int(time.time() * 1000)}
+    try:
+        load = os.getloadavg()
+        out["load_average"] = list(load)
+    except OSError:
+        pass
+    try:
+        with open("/proc/meminfo") as fh:
+            mem = {}
+            for line in fh:
+                parts = line.split()
+                if parts[0].rstrip(":") in ("MemTotal", "MemFree", "MemAvailable",
+                                            "SwapTotal", "SwapFree"):
+                    mem[parts[0].rstrip(":")] = int(parts[1]) * 1024
+        out["mem"] = {
+            "total_in_bytes": mem.get("MemTotal", 0),
+            "free_in_bytes": mem.get("MemFree", 0),
+            "available_in_bytes": mem.get("MemAvailable", 0),
+        }
+        out["swap"] = {
+            "total_in_bytes": mem.get("SwapTotal", 0),
+            "free_in_bytes": mem.get("SwapFree", 0),
+        }
+    except OSError:
+        pass
+    out["cpu"] = {"count": os.cpu_count()}
+    return out
+
+
+def process_stats() -> dict:
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    out = {
+        "timestamp": int(time.time() * 1000),
+        "id": os.getpid(),
+        "mem": {"resident_in_bytes": ru.ru_maxrss * 1024},
+        "cpu": {
+            "user_in_millis": int(ru.ru_utime * 1000),
+            "sys_in_millis": int(ru.ru_stime * 1000),
+            "total_in_millis": int((ru.ru_utime + ru.ru_stime) * 1000),
+        },
+    }
+    try:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith("Threads:"):
+                    out["threads"] = int(line.split()[1])
+                elif line.startswith("VmRSS:"):
+                    out["mem"]["resident_in_bytes"] = int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    try:
+        out["open_file_descriptors"] = len(os.listdir("/proc/self/fd"))
+        out["max_file_descriptors"] = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+    except OSError:
+        pass
+    return out
+
+
+def fs_stats(paths: list[str]) -> dict:
+    data = []
+    for p in paths:
+        try:
+            st = os.statvfs(p)
+            data.append({
+                "path": p,
+                "total_in_bytes": st.f_blocks * st.f_frsize,
+                "free_in_bytes": st.f_bfree * st.f_frsize,
+                "available_in_bytes": st.f_bavail * st.f_frsize,
+            })
+        except OSError:
+            continue
+    return {"timestamp": int(time.time() * 1000), "data": data}
+
+
+def runtime_stats() -> dict:
+    """The "jvm stats" analogue: Python runtime + (when live) the TPU device."""
+    import sys
+
+    counts = gc.get_count()
+    out = {
+        "timestamp": int(time.time() * 1000),
+        "runtime": "python",
+        "version": sys.version.split()[0],
+        "gc": {"collections": gc.get_stats()[-1].get("collections", 0)
+               if gc.get_stats() else 0, "pending": sum(counts)},
+        "uptime_in_millis": int(time.monotonic() * 1000),
+    }
+    try:
+        import jax
+
+        devices = jax.devices()
+        dev_stats = []
+        for d in devices:
+            entry = {"platform": d.platform, "device": str(d)}
+            ms = getattr(d, "memory_stats", None)
+            if callable(ms):
+                try:
+                    stats = ms() or {}
+                    entry["hbm_bytes_in_use"] = stats.get("bytes_in_use")
+                    entry["hbm_bytes_limit"] = stats.get("bytes_limit")
+                except Exception:  # noqa: BLE001
+                    pass
+            dev_stats.append(entry)
+        out["devices"] = dev_stats
+    except Exception:  # noqa: BLE001 — no device backend in this process
+        out["devices"] = []
+    return out
+
+
+class MonitorService:
+    def __init__(self, node):
+        self.node = node
+
+    def full_stats(self) -> dict:
+        return {
+            "os": os_stats(),
+            "process": process_stats(),
+            "fs": fs_stats([self.node.data_path]),
+            "runtime": runtime_stats(),
+        }

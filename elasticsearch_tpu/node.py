@@ -66,9 +66,17 @@ class Node:
         self.gateway = LocalGateway(self.data_path, self.cluster_service,
                                     self.settings, node_name=self.name)
         self.actions = ActionModule(self)
+        from .monitor import MonitorService
+        from .percolator import PercolatorService
         from .snapshots import SnapshotsService
 
         self.snapshots = SnapshotsService(self)
+        self.percolator = PercolatorService(self)
+        self.monitor = MonitorService(self)
+        # IndicesTTLService analogue: periodic purge of _ttl-expired docs
+        self._ttl_task = self.threadpool.schedule_with_fixed_delay(
+            self.settings.get_time("indices.ttl.interval", 60.0), self._purge_expired,
+            name="generic")
         self.discovery = ZenDiscovery(self.local_node, self.transport,
                                       self.cluster_service, self.allocation,
                                       self.settings)
@@ -111,6 +119,39 @@ class Node:
         self.cluster_service.close()
         self.transport.close()
         self.threadpool.shutdown()
+
+    def _purge_expired(self):
+        """ref: indices/ttl/IndicesTTLService — delete docs whose _ttl expired."""
+        import time as _time
+
+        now = _time.time() * 1000
+        for index, svc in list(self.indices.indices.items()):
+            for sid, shard in list(svc.shards.items()):
+                if not shard.primary:
+                    continue
+                try:
+                    searcher = shard.engine.acquire_searcher()
+                    uids = []
+                    for seg in searcher.segments:
+                        col = seg.dv_num.get("_expiry")
+                        if col is None:
+                            continue
+                        import numpy as _np
+
+                        off, vals = col
+                        counts = _np.diff(off)
+                        doc_of_val = _np.repeat(_np.arange(seg.doc_count), counts)
+                        expired = doc_of_val[vals < now]
+                        for local in expired:
+                            if seg.live[local] and seg.parent_mask[local]:
+                                uids.append(f"{seg.types[local]}#{seg.ids[local]}")
+                    if uids:
+                        shard.engine.delete_by_uids(uids, query={"expired": True})
+                        shard.engine.refresh()
+                        self.logger.info("ttl purged %d docs from [%s][%d]",
+                                         len(uids), index, sid)
+                except SearchEngineError:
+                    continue
 
     def is_master(self) -> bool:
         s = self.cluster_service.state
@@ -347,7 +388,34 @@ class Client:
             "indices": self.node.indices.stats(),
             "transport": self.node.transport.stats,
             "thread_pool": self.node.threadpool.stats(),
+            **self.node.monitor.full_stats(),
         }}}
+
+    # --- percolate ----------------------------------------------------------
+    def percolate(self, index, body):
+        return self.node.percolator.percolate(index, body)
+
+    def count_percolate(self, index, body):
+        return self.node.percolator.count_percolate(index, body)
+
+    def mpercolate(self, requests):
+        return self.node.percolator.multi_percolate(requests)
+
+    # --- warmers ------------------------------------------------------------
+    def put_warmer(self, index, name, body):
+        return self._local("indices:admin/warmers/put",
+                           {"index": index, "name": name, "body": body})
+
+    def delete_warmer(self, index, name):
+        return self._local("indices:admin/warmers/delete",
+                           {"index": index, "name": name})
+
+    def get_warmer(self, index=None):
+        state = self.node.cluster_service.state
+        return {
+            name: {"warmers": state.metadata.index(name).warmers_dict()}
+            for name in state.metadata.resolve_indices(index or "_all")
+        }
 
     # --- snapshots ----------------------------------------------------------
     def put_repository(self, name, body):

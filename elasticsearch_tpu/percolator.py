@@ -1,0 +1,141 @@
+"""Percolator: reverse search — match a DOCUMENT against registered queries.
+
+Analogue of percolator/PercolatorService.java + index/percolator/ (SURVEY.md §2.9):
+queries are registered as documents under the special `.percolator` type of an index;
+`percolate(doc)` parses the document into an in-memory single-doc segment and evaluates
+every registered query against it, returning the ids of matching queries.
+
+TPU note: percolation evaluates MANY queries against ONE doc — the transpose of the
+scoring kernel's many-docs-one-query layout. The host scorer over a 1-doc segment is the
+right tool; a device batch variant (queries × 1-doc) is a later-round optimization for
+large registries.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .common.errors import PercolateError
+from .mapper import MapperService
+from .index.segment import SegmentBuilder
+from .search.execute import HostScorer, ShardContext
+from .search.queries import Query, parse_query
+
+PERCOLATOR_TYPE = ".percolator"
+
+
+class PercolatorRegistry:
+    """Per-index registry of parsed percolator queries (ref: index/percolator/
+    PercolatorQueriesRegistry — kept in sync with .percolator-type docs)."""
+
+    def __init__(self):
+        self._queries: dict[str, tuple[dict, Query]] = {}
+        self._lock = threading.Lock()
+
+    def register(self, query_id: str, body: dict):
+        if "query" not in body:
+            raise PercolateError("percolator document requires a [query]")
+        q = parse_query(body["query"])
+        with self._lock:
+            self._queries[query_id] = (body, q)
+
+    def unregister(self, query_id: str):
+        with self._lock:
+            self._queries.pop(query_id, None)
+
+    def count(self) -> int:
+        return len(self._queries)
+
+    def percolate(self, doc: dict, mapper_service: MapperService,
+                  type_name: str = "doc", filter_ids=None) -> list[str]:
+        """Build a 1-doc in-memory segment from `doc`, run every registered query."""
+        mapper = mapper_service.mapper_for(type_name)
+        parsed = mapper.parse(doc, doc_id="_percolate")
+        builder = SegmentBuilder(gen=0)
+        builder.add(parsed)
+        seg = builder.freeze()
+
+        class _OneDocSearcher:
+            segments = [seg]
+            bases = [0]
+            max_doc = seg.doc_count
+
+            def doc_freq(self, field, term):
+                return seg.doc_freq(field, term)
+
+            def field_stats(self, field):
+                from .index.segment import FieldStats as FS
+
+                return seg.field_stats.get(field) or FS()
+
+            def live_doc_count(self):
+                return seg.live_count()
+
+            def resolve(self, g):
+                return seg, g
+
+        # late import loop guard
+        from .index.segment import FieldStats  # noqa: F401
+
+        ctx = ShardContext(_OneDocSearcher(), mapper_service)
+        matches = []
+        with self._lock:
+            items = list(self._queries.items())
+        for qid, (_body, query) in items:
+            if filter_ids is not None and qid not in filter_ids:
+                continue
+            scorer = HostScorer(ctx, seg)
+            try:
+                _, match = scorer.eval(query)
+            except Exception:  # noqa: BLE001 — a bad query must not break the rest
+                continue
+            if bool((match & seg.parent_mask).any()):
+                matches.append(qid)
+        return sorted(matches)
+
+
+class PercolatorService:
+    """Node-level: registries per index, fed by the engine write path and exposed via
+    the REST /_percolate APIs."""
+
+    def __init__(self, node):
+        self.node = node
+        self.registries: dict[str, PercolatorRegistry] = {}
+
+    def registry(self, index: str) -> PercolatorRegistry:
+        r = self.registries.get(index)
+        if r is None:
+            r = PercolatorRegistry()
+            self.registries[index] = r
+        return r
+
+    def register_query(self, index: str, query_id: str, body: dict):
+        self.registry(index).register(query_id, body)
+
+    def unregister_query(self, index: str, query_id: str):
+        self.registry(index).unregister(query_id)
+
+    def percolate(self, index: str, body: dict) -> dict:
+        doc = body.get("doc")
+        if doc is None:
+            raise PercolateError("percolate request requires [doc]")
+        svc = self.node.indices.index_service(index)
+        reg = self.registry(index)
+        matches = reg.percolate(doc, svc.mapper_service)
+        return {
+            "total": len(matches),
+            "matches": [{"_index": index, "_id": qid} for qid in matches],
+        }
+
+    def count_percolate(self, index: str, body: dict) -> dict:
+        r = self.percolate(index, body)
+        return {"total": r["total"]}
+
+    def multi_percolate(self, requests: list[tuple[dict, dict]]) -> dict:
+        responses = []
+        for header, body in requests:
+            try:
+                responses.append(self.percolate(header["index"], body))
+            except Exception as e:  # noqa: BLE001
+                responses.append({"error": str(e)})
+        return {"responses": responses}

@@ -544,6 +544,22 @@ def build_rest_controller(node) -> RestController:
                  for name, st in node.threadpool.stats().items()]
         return RestResponse(200, "\n".join(lines) + "\n", content_type="text/plain")
 
+    # --- percolate -----------------------------------------------------------
+    rc.register("GET,POST", "/{index}/{type}/_percolate",
+                lambda r: client.percolate(r.path_params["index"], _parse_body(r)))
+    rc.register("GET,POST", "/{index}/{type}/_percolate/count",
+                lambda r: client.count_percolate(r.path_params["index"], _parse_body(r)))
+
+    # --- warmers -------------------------------------------------------------
+    rc.register("PUT", "/{index}/_warmer/{name}",
+                lambda r: client.put_warmer(r.path_params["index"],
+                                            r.path_params["name"], _parse_body(r)))
+    rc.register("DELETE", "/{index}/_warmer/{name}",
+                lambda r: client.delete_warmer(r.path_params["index"],
+                                               r.path_params["name"]))
+    rc.register("GET", "/{index}/_warmer",
+                lambda r: client.get_warmer(r.path_params["index"]))
+
     # --- snapshot/restore ----------------------------------------------------
     rc.register("PUT,POST", "/_snapshot/{repo}",
                 lambda r: client.put_repository(r.path_params["repo"], _parse_body(r)))

@@ -323,3 +323,81 @@ class TestAliasesTemplatesGateway:
             assert g["found"] and g["_source"]["x"] == 1
         finally:
             node2.close()
+
+
+class TestSidecars:
+    def test_percolator(self, tmp_path):
+        registry, nodes = make_cluster(tmp_path, 1)
+        try:
+            client = nodes[0].client()
+            client.create_index("pq", {"settings": {"number_of_shards": 1,
+                                                    "number_of_replicas": 0}})
+            client.cluster_health(wait_for_status="green")
+            client.index("pq", ".percolator",
+                         {"query": {"match": {"body": "alert"}}}, id="q1")
+            client.index("pq", ".percolator",
+                         {"query": {"range": {"level": {"gte": 3}}}}, id="q2")
+            r = client.percolate("pq", {"doc": {"body": "an alert fired", "level": 1}})
+            assert [m["_id"] for m in r["matches"]] == ["q1"]
+            r = client.percolate("pq", {"doc": {"body": "quiet", "level": 5}})
+            assert [m["_id"] for m in r["matches"]] == ["q2"]
+            r = client.percolate("pq", {"doc": {"body": "alert", "level": 9}})
+            assert [m["_id"] for m in r["matches"]] == ["q1", "q2"]
+            client.delete("pq", ".percolator", "q1")
+            r = client.percolate("pq", {"doc": {"body": "alert", "level": 9}})
+            assert [m["_id"] for m in r["matches"]] == ["q2"]
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_warmers_registered_and_run(self, tmp_path):
+        registry, nodes = make_cluster(tmp_path, 1)
+        try:
+            client = nodes[0].client()
+            client.create_index("w", {"settings": {"number_of_shards": 1,
+                                                   "number_of_replicas": 0}})
+            client.cluster_health(wait_for_status="green")
+            client.put_warmer("w", "warm1", {"query": {"match_all": {}}})
+            assert "warm1" in client.get_warmer("w")["w"]["warmers"]
+            client.index("w", "d", {"a": "x"}, id="1")
+            client.refresh("w")  # runs the warmer (smoke: no exception)
+            client.delete_warmer("w", "warm1")
+            assert client.get_warmer("w")["w"]["warmers"] == {}
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_ttl_purge(self, tmp_path):
+        registry, nodes = make_cluster(tmp_path, 1)
+        try:
+            client = nodes[0].client()
+            client.create_index("ttl", {
+                "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+                "mappings": {"doc": {"_ttl": {"enabled": True},
+                                     "_timestamp": {"enabled": True}}}})
+            client.cluster_health(wait_for_status="green")
+            svc = nodes[0].indices.index_service("ttl")
+            shard = svc.shard(0)
+            # one already-expired doc, one far-future doc
+            shard.engine.index("doc", "old", {"x": 1}, ttl=1, timestamp=1)
+            shard.engine.index("doc", "new", {"x": 2}, ttl="10d")
+            shard.engine.refresh()
+            assert shard.engine.doc_stats()["count"] == 2
+            nodes[0]._purge_expired()
+            assert shard.engine.doc_stats()["count"] == 1
+            assert not shard.engine.get("doc", "old").found
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_monitor_stats(self, tmp_path):
+        registry, nodes = make_cluster(tmp_path, 1)
+        try:
+            stats = nodes[0].client().nodes_stats()["nodes"]["node_0"]
+            assert stats["process"]["mem"]["resident_in_bytes"] > 0
+            assert stats["os"]["mem"]["total_in_bytes"] > 0
+            assert stats["fs"]["data"][0]["total_in_bytes"] > 0
+            assert stats["runtime"]["runtime"] == "python"
+        finally:
+            for n in nodes:
+                n.close()
