@@ -602,6 +602,14 @@ class Analyzer:
         self.filters = filters or []
         self.char_filters = char_filters or []
         self.tokenizer_settings = tokenizer_settings
+        # C fast path applies to the exact standard chain (standard + lowercase, no
+        # char filters) — the bulk-indexing hot path (native/estpu_native.c)
+        self._fast_standard = (
+            tokenizer is standard_tokenizer
+            and self.filters == [lowercase_filter]
+            and not self.char_filters
+            and tokenizer_settings is None
+        )
 
     def analyze(self, text: str) -> list[Token]:
         if text is None:
@@ -614,7 +622,26 @@ class Analyzer:
         return tokens
 
     def terms(self, text: str) -> list[str]:
+        if self._fast_standard and text:
+            native = _native()
+            if native is not None:
+                return native.tokenize_batch([text])[0]
         return [t.term for t in self.analyze(text)]
+
+    def index_tokens(self, text: str) -> list[tuple[str, int]]:
+        """(term, position) pairs — positions are sequential, what the segment builder
+        needs (offsets are only needed at fetch/highlight time, which re-analyzes)."""
+        if self._fast_standard and text:
+            native = _native()
+            if native is not None:
+                return [(t, i) for i, t in enumerate(native.tokenize_batch([text])[0])]
+        return [(t.term, t.position) for t in self.analyze(text)]
+
+
+def _native():
+    from ..native import get_native
+
+    return get_native()
 
 
 CustomAnalyzer = Analyzer

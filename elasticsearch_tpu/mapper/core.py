@@ -473,11 +473,11 @@ class DocumentMapper:
                     v = ft.null_value
                 text = str(v)
                 if ft.analyzed:
-                    toks = mapper.index_analyzer.analyze(text)
-                    for t in toks:
-                        terms.append((t.term, pos_base + t.position))
+                    toks = mapper.index_analyzer.index_tokens(text)
+                    for term, pos in toks:
+                        terms.append((term, pos_base + pos))
                         if ft.include_in_all and self.all_enabled:
-                            all_terms.append((t.term, len(all_terms)))
+                            all_terms.append((term, len(all_terms)))
                     pos_base += len(toks) + 100  # position gap between values (Lucene default)
                 else:
                     terms.append((text, pos_base))

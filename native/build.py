@@ -1,0 +1,48 @@
+"""Build the estpu_native C extension in place (no pip; direct cc invocation).
+
+Usage: python native/build.py   — or imported lazily by elasticsearch_tpu.native.
+Produces native/estpu_native.<abi>.so; callers fall back to pure Python if absent.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def build(verbose: bool = True) -> str | None:
+    src = os.path.join(HERE, "estpu_native.c")
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    out = os.path.join(HERE, f"estpu_native{suffix}")
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    include = sysconfig.get_paths()["include"]
+    cc = os.environ.get("CC", "gcc")
+    cmd = [cc, "-O3", "-fPIC", "-shared", "-std=c11",
+           f"-I{include}", src, "-o", out]
+    try:
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+        return out
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        if verbose:
+            print(f"native build failed: {e}", file=sys.stderr)
+        return None
+
+
+if __name__ == "__main__":
+    path = build()
+    if path:
+        print(path)
+        # smoke test
+        sys.path.insert(0, HERE)
+        import estpu_native  # noqa: E402
+
+        assert estpu_native.tokenize_batch(["Hello World-X"]) == [["hello", "world", "x"]]
+        assert estpu_native.djb2("") == 5381
+        print("smoke ok")
+    else:
+        sys.exit(1)

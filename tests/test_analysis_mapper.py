@@ -168,3 +168,38 @@ class TestMapper:
         assert out["doc"]["properties"]["title"]["type"] == "string"
         assert out["doc"]["properties"]["extra"]["type"] == "long"
         assert svc.field_type("extra").type == "long"
+
+
+class TestNativeTokenizer:
+    """The C fast path (native/estpu_native.c) must be token-identical to the Python
+    standard chain — it silently accelerates the bulk-index hot path."""
+
+    def test_native_matches_python(self):
+        from elasticsearch_tpu.native import get_native
+
+        native = get_native()
+        if native is None:
+            pytest.skip("C toolchain unavailable")
+        texts = [
+            "The Quick-Brown Fox, jumped! Over 2 dogs.",
+            "rock'n'roll and Bob's burgers",
+            "unicode Déjà vu naïve café",
+            "",
+            "trailing space ",
+            "123 456-789",
+        ]
+        a = get_analyzer("standard")
+        for text in texts:
+            fast = native.tokenize_batch([text])[0]
+            slow = [t.term for t in a.analyze(text)]
+            assert fast == slow, text
+
+    def test_native_djb2_matches_python(self):
+        from elasticsearch_tpu.cluster.routing import djb2_hash
+        from elasticsearch_tpu.native import get_native
+
+        native = get_native()
+        if native is None:
+            pytest.skip("C toolchain unavailable")
+        for s in ("", "a", "doc_12345", "routing-key", "ünïcode"):
+            assert native.djb2(s) == djb2_hash(s), s
