@@ -45,7 +45,20 @@ class Node:
             tempfile.mkdtemp(prefix=f"estpu_{self.name}_")
         self.logger = get_logger("node", node=self.name)
         self.registry = registry or DEFAULT_REGISTRY
-        address = f"local://{self.node_id}"
+        # transport.type: "local" (in-process, the test default — LocalTransport.java's
+        # role) or "tcp" (DCN sockets between host processes — NettyTransport's role).
+        if self.settings.get_str("transport.type", "local") == "tcp":
+            from .transport.tcp import TcpTransport
+
+            backend = TcpTransport(
+                host=self.settings.get_str("transport.tcp.host", "127.0.0.1"),
+                port=self.settings.get_int("transport.tcp.port", 0),
+                compress=self.settings.get_bool("transport.tcp.compress", False),
+            )
+            address = backend.address
+        else:
+            backend = None
+            address = f"local://{self.node_id}"
         attrs = tuple(sorted(
             (k[len("node.attr."):], str(v)) for k, v in self.settings.as_dict().items()
             if k.startswith("node.attr.")
@@ -56,8 +69,9 @@ class Node:
             data=self.settings.get_bool("node.data", True),
         )
         self.threadpool = ThreadPool(self.settings)
-        self.transport = TransportService(LocalTransport(address, self.registry),
-                                          self.local_node, self.threadpool)
+        if backend is None:
+            backend = LocalTransport(address, self.registry)
+        self.transport = TransportService(backend, self.local_node, self.threadpool)
         self.cluster_service = ClusterService(self.name)
         self.allocation = AllocationService(self.settings)
         self.operation_routing = OperationRouting()
@@ -88,7 +102,19 @@ class Node:
     # ------------------------------------------------------------------ lifecycle
     def start(self, seeds: list[str] | None = None) -> "Node":
         """ref: InternalNode.start:210-235 — services then discovery then gateway."""
-        addresses = seeds if seeds is not None else self.registry.addresses()
+        if seeds is not None:
+            addresses = seeds
+        else:
+            # TCP nodes seed from unicast hosts (zen/ping/unicast/UnicastZenPing.java);
+            # local nodes see everything on the shared in-process registry.
+            unicast = self.settings.get_list("discovery.zen.ping.unicast.hosts", [])
+            if unicast:
+                addresses = list(unicast)
+            elif isinstance(self.local_node.transport_address, str) and \
+                    self.local_node.transport_address.startswith("local://"):
+                addresses = self.registry.addresses()
+            else:
+                addresses = []
         self.discovery.start(addresses)
         self.gateway.maybe_recover()
         self._started = True

@@ -82,12 +82,41 @@ class TransportService:
         self.handlers[action] = TransportRequestHandler(fn, executor)
 
     # --- sending ------------------------------------------------------------
+    def _is_local(self, node) -> bool:
+        if self.local_node is None:
+            return False
+        address = getattr(node, "transport_address", node)
+        return address == self.local_node.transport_address
+
     def send_request(self, node, action: str, request: dict,
                      timeout: float | None = None) -> Future:
         fut: Future = Future()
         self.stats["tx_count"] += 1
         try:
-            self.backend.send(node, action, _roundtrip(request), fut)
+            # Self-addressed requests short-circuit past the backend (the reference
+            # TransportService does the same for localNode): still codec-roundtripped
+            # for wire-compat assertions, but no socket / simulated-network hop.
+            if self._is_local(node):
+                payload = _roundtrip(request)
+
+                def respond(response, error):
+                    if error is not None:
+                        fut.set_exception(error)
+                    else:
+                        fut.set_result(_roundtrip(response))
+
+                channel = TransportChannel(respond)
+                if self.threadpool is not None:
+                    self.threadpool.submit("generic", self.dispatch, action, payload,
+                                           channel)
+                else:
+                    self.dispatch(action, payload, channel)
+                return fut
+            # Backends that truly serialize (TCP) skip the assert-roundtrip — the
+            # payload already crosses the real codec exactly once on the wire.
+            payload = request if getattr(self.backend, "serializes", False) \
+                else _roundtrip(request)
+            self.backend.send(node, action, payload, fut)
         except SearchEngineError as e:
             fut.set_exception(e)
         except Exception as e:  # noqa: BLE001
