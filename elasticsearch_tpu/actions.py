@@ -84,6 +84,7 @@ A_DELETE_PRIMARY = "indices:data/write/delete[p]"
 A_DELETE_REPLICA = "indices:data/write/delete[r]"
 A_BULK_SHARD = "indices:data/write/bulk[s]"
 A_GET = "indices:data/read/get[s]"
+A_TERMVECTOR = "indices:data/read/termvector[s]"
 A_QUERY_PHASE = "indices:data/read/search[phase/query]"
 A_FETCH_PHASE = "indices:data/read/search[phase/fetch]"
 A_DFS_PHASE = "indices:data/read/search[phase/dfs]"
@@ -129,6 +130,7 @@ class ActionModule:
         t.register_handler(A_DELETE_REPLICA, self._r_delete)
         t.register_handler(A_BULK_SHARD, self._p_bulk_shard)
         t.register_handler(A_GET, self._s_get)
+        t.register_handler(A_TERMVECTOR, self._s_termvector)
         t.register_handler(A_QUERY_PHASE, self._s_query_phase)
         t.register_handler(A_FETCH_PHASE, self._s_fetch_phase)
         t.register_handler(A_DFS_PHASE, self._s_dfs_phase)
@@ -855,6 +857,110 @@ class ActionModule:
             out["_source"] = r.source
         return out
 
+    def term_vector(self, index: str, type_name: str, doc_id: str, routing=None,
+                    fields=None, positions=True, offsets=True,
+                    term_statistics=False, field_statistics=True,
+                    preference=None) -> dict:
+        """Term-vectors API (ref: action/termvector/TransportTermVectorAction —
+        single-shard read pattern). Vectors are re-derived by re-analyzing the stored
+        _source, which is exact for this framework's write-once segments."""
+        state = self.cluster_service.state
+        state.blocks.check("read", index)
+        index = state.metadata.resolve_indices(index)[0]
+        copy = self.routing.get_shard_copy(state, index, doc_id, routing, preference)
+        node = state.nodes.get(copy.node_id)
+        return self.transport.submit_request(node, A_TERMVECTOR, {
+            "index": index, "shard": copy.shard_id, "type": type_name, "id": doc_id,
+            "fields": list(fields) if fields else None,
+            "positions": positions, "offsets": offsets,
+            "term_statistics": term_statistics, "field_statistics": field_statistics,
+        }, timeout=10.0)
+
+    def multi_termvector(self, docs: list[dict]) -> dict:
+        out = []
+        for d in docs:
+            try:
+                out.append(self.term_vector(
+                    d["_index"], d.get("_type", "_all"), d["_id"],
+                    routing=d.get("routing"), fields=d.get("fields"),
+                    term_statistics=d.get("term_statistics", False),
+                    field_statistics=d.get("field_statistics", True)))
+            except SearchEngineError as e:
+                out.append({"_index": d.get("_index"), "_id": d.get("_id"),
+                            "error": e.to_dict()})
+        return {"docs": out}
+
+    def _s_termvector(self, request, channel):
+        index, shard_id = request["index"], request["shard"]
+        shard = self.indices.index_service(index).shard(shard_id)
+        r = shard.engine.get(request["type"], request["id"], realtime=True)
+        out = {"_index": index, "_type": request["type"], "_id": request["id"],
+               "found": r.found}
+        if not r.found:
+            return out
+        out["_version"] = r.version
+        ctx = self._shard_ctx(index, shard_id)
+        flat = _flatten_text_fields(r.source)
+        wanted = request.get("fields")
+        tv = {}
+        for field, texts in sorted(flat.items()):
+            if wanted is not None and field not in wanted:
+                continue
+            ft = ctx.field_type(field)
+            if ft is not None and getattr(ft, "index", "analyzed") == "no":
+                continue
+            terms: dict[str, dict] = {}
+            for text in texts:
+                for tok in ctx.analyze_tokens(field, str(text)):
+                    e = terms.setdefault(tok.term, {"term_freq": 0, "tokens": []})
+                    e["term_freq"] += 1
+                    t = {}
+                    if request.get("positions", True):
+                        t["position"] = tok.position
+                    if request.get("offsets", True):
+                        t["start_offset"] = tok.start
+                        t["end_offset"] = tok.end
+                    if t:
+                        e["tokens"].append(t)
+            if not terms:
+                continue
+            if request.get("term_statistics"):
+                for term, e in terms.items():
+                    e["doc_freq"] = ctx.doc_freq(field, term)
+            entry = {"terms": terms}
+            if request.get("field_statistics", True):
+                fs = ctx.field_stats(field)
+                entry["field_statistics"] = {
+                    "doc_count": fs.doc_count, "sum_ttf": fs.sum_ttf,
+                    "sum_doc_freq": fs.sum_dfs}
+            tv[field] = entry
+        out["term_vectors"] = tv
+        return out
+
+    def more_like_this(self, index: str, type_name: str, doc_id: str,
+                       mlt_fields=None, search_body=None, routing=None,
+                       **mlt_params) -> dict:
+        """MLT API (ref: action/mlt/TransportMoreLikeThisAction): GET the doc, build a
+        more_like_this query from its field text, exclude the doc itself, search."""
+        doc = self.get_doc(index, type_name, doc_id, routing=routing)
+        if not doc.get("found"):
+            raise DocumentMissingError(f"[{index}][{type_name}][{doc_id}] missing")
+        flat = _flatten_text_fields(doc.get("_source") or {})
+        if mlt_fields:
+            flat = {f: v for f, v in flat.items() if f in set(mlt_fields)}
+        like_text = " ".join(str(t) for texts in flat.values() for t in texts)
+        mlt = {"fields": sorted(flat) or ["_all"], "like_text": like_text}
+        for k in ("min_term_freq", "min_doc_freq", "max_query_terms",
+                  "minimum_should_match", "percent_terms_to_match", "boost_terms"):
+            if mlt_params.get(k) is not None:
+                mlt[k] = mlt_params[k]
+        body = dict(search_body or {})
+        body["query"] = {"bool": {
+            "must": [{"more_like_this": mlt}],
+            "must_not": [{"ids": {"type": type_name, "values": [doc_id]}}],
+        }}
+        return self.search(index, body)
+
     def multi_get(self, docs: list[dict]) -> dict:
         out = []
         for d in docs:
@@ -1139,6 +1245,27 @@ class _SourceDoc:
         if v is None:
             return FieldVal([])
         return FieldVal(v if isinstance(v, list) else [v])
+
+
+def _flatten_text_fields(source: dict, prefix: str = "") -> dict[str, list]:
+    """Flatten a _source dict to dotted-path -> list of string values (termvector/mlt
+    operate on text fields only)."""
+    out: dict[str, list] = {}
+    for key, value in (source or {}).items():
+        path = f"{prefix}{key}"
+        if isinstance(value, dict):
+            for k, v in _flatten_text_fields(value, path + ".").items():
+                out.setdefault(k, []).extend(v)
+        elif isinstance(value, list):
+            for item in value:
+                if isinstance(item, dict):
+                    for k, v in _flatten_text_fields(item, path + ".").items():
+                        out.setdefault(k, []).extend(v)
+                elif isinstance(item, str):
+                    out.setdefault(path, []).append(item)
+        elif isinstance(value, str):
+            out.setdefault(path, []).append(value)
+    return out
 
 
 def _deep_merge(dst: dict, src: dict):

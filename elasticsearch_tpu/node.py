@@ -256,6 +256,25 @@ class Client:
         r = self.search(index, {"size": 0, "suggest": body})
         return r.get("suggest", {})
 
+    def termvector(self, index, doc_type, id, routing=None, fields=None,
+                   positions=True, offsets=True, term_statistics=False,
+                   field_statistics=True):
+        return self.actions.term_vector(index, doc_type, id, routing=routing,
+                                        fields=fields, positions=positions,
+                                        offsets=offsets,
+                                        term_statistics=term_statistics,
+                                        field_statistics=field_statistics)
+
+    def mtermvectors(self, docs):
+        return self.actions.multi_termvector(docs)
+
+    def mlt(self, index, doc_type, id, mlt_fields=None, search_body=None,
+            routing=None, **mlt_params):
+        return self.actions.more_like_this(index, doc_type, id,
+                                           mlt_fields=mlt_fields,
+                                           search_body=search_body,
+                                           routing=routing, **mlt_params)
+
     def explain(self, index, doc_type, id, body):
         r = self.search(index, {"query": {"bool": {
             "must": [body.get("query", {"match_all": {}})],

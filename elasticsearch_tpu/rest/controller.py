@@ -319,6 +319,46 @@ def build_rest_controller(node) -> RestController:
 
     rc.register("GET,POST", "/{index}/{type}/{id}/_explain", explain)
 
+    def termvector(req):
+        body = _parse_body(req)
+        fields = req.param("fields")
+        return client.termvector(
+            req.path_params["index"], req.path_params["type"], req.path_params["id"],
+            routing=req.param("routing"),
+            fields=fields.split(",") if fields else body.get("fields"),
+            positions=req.bool_param("positions", True),
+            offsets=req.bool_param("offsets", True),
+            term_statistics=req.bool_param("term_statistics", False),
+            field_statistics=req.bool_param("field_statistics", True))
+
+    rc.register("GET,POST", "/{index}/{type}/{id}/_termvector", termvector)
+    rc.register("GET,POST", "/{index}/{type}/{id}/_termvectors", termvector)
+
+    def mtermvectors(req):
+        body = _parse_body(req)
+        docs = body.get("docs", [])
+        for d in docs:
+            d.setdefault("_index", req.path_params.get("index"))
+            d.setdefault("_type", req.path_params.get("type", "_all"))
+        return client.mtermvectors(docs)
+
+    rc.register("GET,POST", "/_mtermvectors", mtermvectors)
+    rc.register("GET,POST", "/{index}/_mtermvectors", mtermvectors)
+    rc.register("GET,POST", "/{index}/{type}/_mtermvectors", mtermvectors)
+
+    def mlt(req):
+        body = _parse_body(req)
+        fields = req.param("mlt_fields")
+        params = {k: req.param(k) for k in
+                  ("min_term_freq", "min_doc_freq", "max_query_terms")}
+        params = {k: int(v) for k, v in params.items() if v is not None}
+        return client.mlt(
+            req.path_params["index"], req.path_params["type"], req.path_params["id"],
+            mlt_fields=fields.split(",") if fields else None,
+            search_body=body or None, routing=req.param("routing"), **params)
+
+    rc.register("GET,POST", "/{index}/{type}/{id}/_mlt", mlt)
+
     def validate_query(req):
         body = _parse_body(req)
         try:

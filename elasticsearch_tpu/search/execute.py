@@ -63,7 +63,12 @@ from .queries import (
     TermQuery,
     WildcardQuery,
 )
-from .similarity import BM25Similarity, SimilarityService, TFIDFSimilarity
+from .similarity import (
+    BM25Similarity,
+    FreqNormSimilarity,
+    SimilarityService,
+    TFIDFSimilarity,
+)
 
 GROUP_SHOULD, GROUP_MUST, GROUP_MUST_NOT = 0, 1, 2
 MODE_BM25, MODE_TFIDF, MODE_CONST = 0, 1, 2
@@ -194,7 +199,19 @@ def _msm_value(s: str, clause_count: int) -> int:
 
 
 def lower_flat(query: Query, ctx: ShardContext) -> FlatPlan | None:
-    """Lower a query to a flat clause list, or None if it needs the host path."""
+    """Lower a query to a flat clause list, or None if it needs the host path.
+    Fields scored by a freq/norm-generic similarity (DFR/IB/LM*) always take the host
+    path — the device kernel's fused modes are BM25/TF-IDF only."""
+    plan = _lower_flat_inner(query, ctx)
+    if plan is not None:
+        for c in plan.clauses:
+            if not isinstance(ctx.similarity_for(c.field),
+                              (BM25Similarity, TFIDFSimilarity)):
+                return None
+    return plan
+
+
+def _lower_flat_inner(query: Query, ctx: ShardContext) -> FlatPlan | None:
     if isinstance(query, TermQuery):
         ft = ctx.field_type(query.field)
         if ft is not None and ft.is_numeric:
@@ -477,6 +494,16 @@ class HostScorer:
         if isinstance(sim, BM25Similarity):
             w = np.float32(sim.idf(df, ctx.max_doc) * boost * (sim.k1 + 1.0))
             vals = w * freqs / (freqs + cache[nb])
+        elif isinstance(sim, FreqNormSimilarity):
+            # generic freq/doc-len similarities (DFR, IB, LM*) — host-only path
+            from ..common.smallfloat import decode_norm_doclen
+
+            dl = decode_norm_doclen(nb)
+            ttf = sum(int(s.postings(field, term)[1].sum())
+                      for s in ctx.searcher.segments
+                      if s.doc_freq(field, term) > 0)
+            vals = sim.score_freqs(freqs, dl, df, ttf, ctx.field_stats(field),
+                                   ctx.max_doc, boost)
         else:
             idf = TFIDFSimilarity.idf(df, ctx.max_doc)
             w = np.float32(idf * idf * boost) * self.qn

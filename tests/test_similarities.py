@@ -1,0 +1,136 @@
+"""DFR / IB / LM similarity families (ref: index/similarity/DFRSimilarityProvider.java,
+IBSimilarityProvider.java). These score on the host path; ranking sanity + monotonicity
+properties are the contract (tf↑ ⇒ score↑, df↑ ⇒ weight↓)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index import Engine
+from elasticsearch_tpu.mapper import MapperService
+from elasticsearch_tpu.search import ShardContext, parse_query, search_shard
+from elasticsearch_tpu.search.similarity import (
+    DFRSimilarity,
+    IBSimilarity,
+    LMDirichletSimilarity,
+    LMJelinekMercerSimilarity,
+    SimilarityService,
+)
+
+DOCS = [
+    "fox fox fox fox",                       # 0: high tf
+    "fox",                                   # 1: low tf, short doc
+    "fox and dog and cat and bird and bee",  # 2: low tf, long doc
+    "dog dog dog",                           # 3: no fox
+    "common common common fox",              # 4
+    "common word soup without the animal",   # 5
+]
+
+
+def build(tmp_path, sim_type, extra=None):
+    flat = {"index.similarity.default.type": sim_type}
+    flat.update(extra or {})
+    settings = Settings.from_flat(flat)
+    svc = MapperService(settings)
+    e = Engine(str(tmp_path / "s"), svc)
+    for i, text in enumerate(DOCS):
+        e.index("doc", str(i), {"body": text})
+    e.refresh()
+    return e, ShardContext(e.acquire_searcher(), svc,
+                           SimilarityService(settings, mapper_service=svc))
+
+
+# (type, settings, length_normalized) — the third flag gates ordering assertions that
+# only hold when doc length enters the formula (BE+L with normalization "no"
+# legitimately ranks tf=1 above tf=4: Laplace decays faster than BE grows).
+FAMILIES = [
+    ("DFR", {}, True),
+    ("DFR", {"index.similarity.default.basic_model": "in",
+             "index.similarity.default.after_effect": "b",
+             "index.similarity.default.normalization": "h1"}, True),
+    ("DFR", {"index.similarity.default.basic_model": "be",
+             "index.similarity.default.normalization": "no"}, False),
+    ("IB", {}, True),
+    ("IB", {"index.similarity.default.distribution": "spl",
+            "index.similarity.default.lambda": "ttf"}, True),
+    # small mu: with the default 2000 every tiny doc's score clamps to 0 (Lucene
+    # LMDirichlet does the same on toy corpora)
+    ("LMDirichlet", {"index.similarity.default.mu": 10}, True),
+    ("LMJelinekMercer", {}, True),
+]
+
+
+@pytest.mark.parametrize("sim_type,extra,length_norm", FAMILIES)
+class TestFamilies:
+    def test_ranking_sane(self, tmp_path, sim_type, extra, length_norm):
+        e, ctx = build(tmp_path, sim_type, extra)
+        td = search_shard(ctx, parse_query({"match": {"body": "fox"}}), 10)
+        docs = [d for _, d in td.hits]
+        scores = [s for s, _ in td.hits]
+        # only fox docs match; scores non-negative (LM sims clamp negatives to 0,
+        # exactly as Lucene's LMDirichletSimilarity does)
+        assert set(docs) == {0, 1, 2, 4}
+        assert all(s >= 0 for s in scores)
+        assert scores[0] > 0
+        if length_norm:
+            # high-tf short doc first; single occurrence in a short doc beats long doc
+            assert docs[0] == 0
+            assert docs.index(1) < docs.index(2)
+
+    def test_bool_composition(self, tmp_path, sim_type, extra, length_norm):
+        e, ctx = build(tmp_path, sim_type, extra)
+        td = search_shard(ctx, parse_query({"bool": {
+            "must": [{"term": {"body": "fox"}}],
+            "should": [{"term": {"body": "common"}}]}}), 10)
+        docs = [d for _, d in td.hits]
+        assert set(docs) == {0, 1, 2, 4}
+        # doc 4 gets the "common" bonus over doc 2 (both single fox)
+        assert docs.index(4) < docs.index(2)
+
+
+class TestFormulaProperties:
+    def test_tf_monotonic(self):
+        for sim in (DFRSimilarity(), IBSimilarity(), LMDirichletSimilarity(),
+                    LMJelinekMercerSimilarity()):
+            freqs = np.array([1.0, 2.0, 5.0, 10.0], np.float32)
+            dl = np.full(4, 10.0)
+
+            class FS:
+                doc_count, sum_ttf, sum_dfs = 100, 1000, 900
+
+            s = sim.score_freqs(freqs, dl, df=10, ttf=50, field_stats=FS,
+                                max_docs=100, boost=1.0)
+            assert np.all(np.diff(s) > 0), (sim.name, s)
+
+    def test_rare_term_scores_higher(self):
+        for sim in (DFRSimilarity(), IBSimilarity()):
+            freqs = np.array([2.0], np.float32)
+            dl = np.array([10.0])
+
+            class FS:
+                doc_count, sum_ttf, sum_dfs = 1000, 10000, 9000
+
+            rare = sim.score_freqs(freqs, dl, df=2, ttf=4, field_stats=FS,
+                                   max_docs=1000, boost=1.0)
+            common = sim.score_freqs(freqs, dl, df=800, ttf=5000, field_stats=FS,
+                                     max_docs=1000, boost=1.0)
+            assert rare[0] > common[0], sim.name
+
+    def test_boost_scales(self):
+        sim = DFRSimilarity()
+        freqs = np.array([3.0], np.float32)
+        dl = np.array([8.0])
+
+        class FS:
+            doc_count, sum_ttf, sum_dfs = 100, 900, 800
+
+        s1 = sim.score_freqs(freqs, dl, 5, 20, FS, 100, 1.0)
+        s2 = sim.score_freqs(freqs, dl, 5, 20, FS, 100, 2.0)
+        assert np.isclose(s2[0], 2 * s1[0], rtol=1e-5)
+
+    def test_unknown_type_rejected(self):
+        from elasticsearch_tpu.common.errors import IllegalArgumentError
+
+        settings = Settings.from_flat({"index.similarity.default.type": "bogus"})
+        with pytest.raises(IllegalArgumentError):
+            SimilarityService(settings)

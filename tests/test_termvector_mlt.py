@@ -1,0 +1,101 @@
+"""Termvector + more-like-this APIs (ref: action/termvector/, action/mlt/ — §2.6)."""
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.transport.local import LocalTransportRegistry
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    registry = LocalTransportRegistry()
+    n = Node(name="tv_node", registry=registry,
+             data_path=str(tmp_path_factory.mktemp("tv_node")))
+    n.start([n.local_node.transport_address])
+    n.wait_for_master()
+    client = n.client()
+    client.create_index("tv", {"settings": {"index.number_of_shards": 1}})
+    client.index("tv", "doc", {"title": "the quick brown fox fox",
+                               "body": "jumps over the lazy dog",
+                               "n": 3}, id="1")
+    client.index("tv", "doc", {"title": "quick quick red fox"}, id="2")
+    client.index("tv", "doc", {"title": "slow green turtle"}, id="3")
+    client.refresh("tv")
+    yield n, client
+    n.close()
+
+
+class TestTermvector:
+    def test_basic_terms_and_freqs(self, node):
+        _, client = node
+        r = client.termvector("tv", "doc", "1")
+        assert r["found"] and r["_id"] == "1"
+        terms = r["term_vectors"]["title"]["terms"]
+        # ES 1.x standard analyzer keeps stopwords (empty default list)
+        assert terms["fox"]["term_freq"] == 2
+        assert terms["quick"]["term_freq"] == 1
+        assert terms["the"]["term_freq"] == 1
+        # positions and offsets present
+        tok = terms["quick"]["tokens"][0]
+        assert tok["position"] == 1
+        assert "start_offset" in tok and "end_offset" in tok
+
+    def test_field_selection(self, node):
+        _, client = node
+        r = client.termvector("tv", "doc", "1", fields=["body"])
+        assert set(r["term_vectors"]) == {"body"}
+        assert "lazy" in r["term_vectors"]["body"]["terms"]
+
+    def test_term_and_field_statistics(self, node):
+        _, client = node
+        r = client.termvector("tv", "doc", "1", term_statistics=True)
+        terms = r["term_vectors"]["title"]["terms"]
+        assert terms["fox"]["doc_freq"] == 2  # docs 1 and 2
+        fs = r["term_vectors"]["title"]["field_statistics"]
+        assert fs["doc_count"] == 3
+
+    def test_missing_doc(self, node):
+        _, client = node
+        r = client.termvector("tv", "doc", "999")
+        assert r["found"] is False
+
+    def test_mtermvectors(self, node):
+        _, client = node
+        r = client.mtermvectors([{"_index": "tv", "_type": "doc", "_id": "1"},
+                                 {"_index": "tv", "_type": "doc", "_id": "2"}])
+        assert len(r["docs"]) == 2
+        assert all(d["found"] for d in r["docs"])
+
+
+class TestMlt:
+    def test_mlt_finds_similar_excludes_self(self, node):
+        _, client = node
+        r = client.mlt("tv", "doc", "1", min_term_freq=1, min_doc_freq=1)
+        ids = [h["_id"] for h in r["hits"]["hits"]]
+        assert "1" not in ids
+        assert "2" in ids  # shares quick/fox
+        assert "3" not in ids  # nothing in common
+
+    def test_mlt_missing_doc_raises(self, node):
+        from elasticsearch_tpu.common.errors import DocumentMissingError
+
+        _, client = node
+        with pytest.raises(DocumentMissingError):
+            client.mlt("tv", "doc", "999")
+
+
+class TestRestSurface:
+    def test_http_termvector_and_mlt(self, node):
+        import json
+        import urllib.request
+
+        n, _ = node
+        server = n.start_http(0)
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/tv/doc/1/_termvector?term_statistics=true") as resp:
+            r = json.loads(resp.read())
+        assert r["term_vectors"]["title"]["terms"]["fox"]["term_freq"] == 2
+        with urllib.request.urlopen(
+                base + "/tv/doc/1/_mlt?min_term_freq=1&min_doc_freq=1") as resp:
+            r = json.loads(resp.read())
+        assert any(h["_id"] == "2" for h in r["hits"]["hits"])
