@@ -116,6 +116,10 @@ class Engine:
         self._uid_index: dict[str, tuple[int, int]] = {}  # uid -> (gen, local) frozen
         self._pending_deletes: list[tuple] = []  # locations to tombstone at refresh
         self._closed = False
+        self.settings = settings
+        from .merge_policy import TieredMergePolicy
+
+        self.merge_policy = TieredMergePolicy(settings)
         self._searcher: Searcher = Searcher([])
         self.created = time.time()
         self._last_write = 0.0
@@ -404,10 +408,56 @@ class Engine:
             self._searcher = Searcher(list(self._segments))
             self.stats["merge_total"] += 1
 
-    def maybe_merge(self, segments_per_tier: int = 10):
+    def _merge_window(self, start: int, end: int):
+        """Merge self._segments[start:end] into one new-generation segment, preserving
+        list order (contiguous window ⇒ doc order and nested blocks survive). Same
+        commit-before-delete discipline as optimize()."""
+        to_merge = self._segments[start:end]
+        merged = merge_segments(to_merge, self._next_gen)
+        self._next_gen += 1
+        # keep the invariant buffer.gen == _next_gen (the buffer may hold unsearchable
+        # docs mid-merge; re-keying its gen is safe pre-freeze)
+        self._buffer.gen = self._next_gen
+        old_gens = [seg.gen for seg in to_merge]
+        any_persisted = any(g in self._persisted_gens for g in old_gens)
+        new_list = self._segments[:start] + \
+            ([merged] if merged.doc_count else []) + self._segments[end:]
+        self._segments = new_list
+        self._uid_index = {}
+        for seg in self._segments:
+            for local in range(seg.doc_count):
+                if seg.parent_mask[local] and seg.live[local]:
+                    self._uid_index[f"{seg.types[local]}#{seg.ids[local]}"] = (seg.gen, local)
+        if any_persisted:
+            # commit point references old files: persist merged + write a new commit
+            # BEFORE deleting, or a crash makes the last commit unreadable
+            for seg in self._segments:
+                if seg.gen not in self._persisted_gens:
+                    self._segment_files[str(seg.gen)] = self.store.write_segment(seg)
+                    self._persisted_gens.add(seg.gen)
+            self._commit_id += 1
+            self.store.write_commit(
+                self._commit_id,
+                {str(seg.gen): self._segment_files[str(seg.gen)] for seg in self._segments},
+                translog_gen=self.translog.gen,
+            )
+        for g in old_gens:
+            self._persisted_gens.discard(g)
+            self._segment_files.pop(str(g), None)
+            self.store.delete_segment(g)
+        self._searcher = Searcher(list(self._segments))
+        self.stats["merge_total"] += 1
+
+    def maybe_merge(self, max_merges: int = 4):
+        """Run the tiered merge policy to convergence (bounded per call).
+        ref: InternalEngine.maybeMerge:942 + TieredMergePolicy selection."""
         with self._lock:
-            if len(self._segments) > segments_per_tier:
-                self.optimize(max_num_segments=1)
+            self._check_open()
+            for _ in range(max_merges):
+                spec = self.merge_policy.find_merge(self._segments)
+                if spec is None:
+                    return
+                self._merge_window(spec.start, spec.end)
 
     # ------------------------------------------------------------------ recovery
     def recover_from_store(self) -> int:

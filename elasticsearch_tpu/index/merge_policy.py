@@ -1,0 +1,123 @@
+"""Merge policies: which segments to combine, and when.
+
+Analogue of index/merge/policy/ (SURVEY.md §2.3 — TieredMergePolicyProvider.java,
+LogByteSizeMergePolicyProvider.java): keeps segment count bounded so searches touch few
+segments, without rewriting the whole index on every merge (the previous behavior —
+optimize(1) — was O(index) per trigger).
+
+Differences from Lucene's TieredMergePolicy, deliberate for this engine:
+- Merges select a CONTIGUOUS window of the segment list. Segments are ordered by
+  generation; contiguity preserves doc order (stable tie-breaks) and keeps nested
+  block layouts trivially intact. Lucene's LogMergePolicy has the same invariant.
+- Sizes are live-doc-prorated like Lucene (deleted docs don't count toward tier size),
+  so delete-heavy segments become attractive merge candidates.
+
+Settings (index.merge.policy.*): max_merge_at_once (10), segments_per_tier (10),
+max_merged_segment (5gb), floor_segment (2mb), expunge_deletes_allowed (10%% —
+segments above this deleted-fraction merge even when the tier budget is met).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+
+@dataclass
+class MergeSpec:
+    """A single planned merge: segment list indices [start, end) of the engine's
+    segment list."""
+
+    start: int
+    end: int
+
+
+class TieredMergePolicy:
+    def __init__(self, settings=None):
+        g = settings.get if settings is not None else (lambda k, d=None: d)
+
+        def _f(key, default):
+            v = g(key)
+            return float(v) if v is not None else default
+
+        self.max_merge_at_once = int(_f("index.merge.policy.max_merge_at_once", 10))
+        self.segments_per_tier = max(
+            2.0, _f("index.merge.policy.segments_per_tier", 10.0))
+        self.max_merged_segment = int(
+            _f("index.merge.policy.max_merged_segment_bytes", 5 * 1024 ** 3))
+        self.floor_segment = int(
+            _f("index.merge.policy.floor_segment_bytes", 2 * 1024 ** 2))
+        self.expunge_deletes_allowed = _f(
+            "index.merge.policy.expunge_deletes_allowed", 10.0) / 100.0
+
+    # ------------------------------------------------------------------ sizing
+    def _size(self, seg) -> int:
+        """Live-prorated byte size (Lucene TieredMergePolicy.size())."""
+        total = max(seg.estimated_bytes(), 1)
+        docs = max(seg.doc_count, 1)
+        live_frac = seg.live_count() / docs
+        return max(int(total * live_frac), 1)
+
+    def _floored(self, size: int) -> int:
+        return max(size, self.floor_segment)
+
+    def allowed_segment_count(self, sizes: list[int]) -> int:
+        """Tier budget: segments_per_tier per size level, levels scaling by
+        max_merge_at_once (TieredMergePolicy.findMerges' allowedSegCount)."""
+        if not sizes:
+            return 0
+        total = sum(self._floored(s) for s in sizes)
+        level = self._floored(min(sizes))
+        allowed = 0.0
+        remaining = float(total)
+        while True:
+            segs_at_level = remaining / level
+            if segs_at_level < self.segments_per_tier:
+                allowed += math.ceil(segs_at_level)
+                break
+            allowed += self.segments_per_tier
+            remaining -= self.segments_per_tier * level
+            level *= self.max_merge_at_once
+        return max(int(allowed), 1)
+
+    # ------------------------------------------------------------------ planning
+    def find_merge(self, segments: list) -> MergeSpec | None:
+        """Pick the best single merge, or None if the index is within budget.
+        Callers loop: merge → re-plan → merge, until None."""
+        n = len(segments)
+        if n < 2:
+            return None
+        sizes = [self._size(s) for s in segments]
+
+        # expunge-deletes trigger: any window containing a delete-heavy segment
+        # is eligible regardless of budget
+        over_budget = n > self.allowed_segment_count(sizes)
+        delete_heavy = [
+            i for i, s in enumerate(segments)
+            if s.doc_count > 0 and
+            1.0 - s.live_count() / s.doc_count > self.expunge_deletes_allowed
+        ]
+        if not over_budget and not delete_heavy:
+            return None
+
+        best: tuple[float, MergeSpec] | None = None
+        max_w = min(self.max_merge_at_once, n)
+        for width in range(2, max_w + 1):
+            for start in range(0, n - width + 1):
+                window = sizes[start:start + width]
+                total = sum(window)
+                if total > self.max_merged_segment:
+                    continue
+                if not over_budget and not any(
+                        start <= i < start + width for i in delete_heavy):
+                    continue
+                # Lucene's merge score: skew (how unbalanced the merge is — lower is
+                # better) * size^0.05 (prefer cheap merges of small segments)
+                floored = [self._floored(s) for s in window]
+                skew = max(floored) / sum(floored)
+                score = skew * (total ** 0.05)
+                if best is None or score < best[0]:
+                    best = (score, MergeSpec(start, start + width))
+        if best is None:
+            return None
+        return best[1]

@@ -47,6 +47,7 @@ class IndexShard:
     primary: bool
     state: str = CREATED
     recovery_info: dict = dc_field(default_factory=dict)
+    last_scheduled_refresh: float = 0.0
 
 
 class IndexService:
@@ -98,6 +99,30 @@ class IndicesService:
         transport.register_handler(ACTION_RECOVERY_FILES, self._handle_recovery_files)
         transport.register_handler(ACTION_RECOVERY_TRANSLOG, self._handle_recovery_translog)
         cluster_service.add_listener(self.cluster_changed)
+
+    # ------------------------------------------------------------ nrt loop
+    def periodic_refresh(self):
+        """Scheduled NRT refresh per shard (ref: InternalIndexShard.java:176,850-851 —
+        default every 1s, per-index `index.refresh_interval`, -1 disables) followed by
+        a tiered merge-policy check (ConcurrentMergeScheduler's role)."""
+        import time as _time
+
+        now = _time.monotonic()
+        for svc in list(self.indices.values()):
+            interval = svc.settings.get_time("index.refresh_interval", 1.0)
+            if interval is None or interval <= 0:
+                continue
+            for shard in list(svc.shards.values()):
+                if shard.state != SHARD_STARTED:
+                    continue
+                if now - shard.last_scheduled_refresh < interval:
+                    continue
+                shard.last_scheduled_refresh = now
+                try:
+                    shard.engine.refresh()
+                    shard.engine.maybe_merge()
+                except SearchEngineError:
+                    pass
 
     # ------------------------------------------------------------ access
     def index_service(self, name: str) -> IndexService:

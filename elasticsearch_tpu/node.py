@@ -91,6 +91,10 @@ class Node:
         self._ttl_task = self.threadpool.schedule_with_fixed_delay(
             self.settings.get_time("indices.ttl.interval", 60.0), self._purge_expired,
             name="generic")
+        # scheduled NRT refresh + merge-policy driver (per-shard interval honored
+        # inside periodic_refresh; this is just the tick)
+        self._refresh_task = self.threadpool.schedule_with_fixed_delay(
+            0.5, self.indices.periodic_refresh, name="refresh")
         self.discovery = ZenDiscovery(self.local_node, self.transport,
                                       self.cluster_service, self.allocation,
                                       self.settings)

@@ -1,0 +1,141 @@
+"""Tiered merge policy (index/merge_policy.py — ref: index/merge/policy/
+TieredMergePolicyProvider.java) + engine merge integration."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index import Engine
+from elasticsearch_tpu.index.merge_policy import TieredMergePolicy
+from elasticsearch_tpu.mapper import MapperService
+from elasticsearch_tpu.search import ShardContext, parse_query, search_shard
+from elasticsearch_tpu.search.similarity import SimilarityService
+
+
+class FakeSeg:
+    def __init__(self, size, docs=100, live=None):
+        self._size = size
+        self.doc_count = docs
+        self._live = live if live is not None else docs
+
+    def estimated_bytes(self):
+        return self._size
+
+    def live_count(self):
+        return self._live
+
+
+class TestPolicySelection:
+    def test_under_budget_no_merge(self):
+        p = TieredMergePolicy()
+        segs = [FakeSeg(10 * 1024 ** 2) for _ in range(5)]
+        assert p.find_merge(segs) is None
+
+    def test_over_budget_merges_small_segments(self):
+        p = TieredMergePolicy(Settings.from_flat(
+            {"index.merge.policy.segments_per_tier": 4,
+             "index.merge.policy.max_merge_at_once": 4}))
+        # one big + many tiny: the tiny tail should be picked, not the big one
+        segs = [FakeSeg(500 * 1024 ** 2)] + [FakeSeg(1024 ** 2) for _ in range(20)]
+        spec = p.find_merge(segs)
+        assert spec is not None
+        assert spec.start >= 1  # excludes the big segment
+        assert spec.end - spec.start <= 4
+
+    def test_max_merged_segment_respected(self):
+        p = TieredMergePolicy(Settings.from_flat(
+            {"index.merge.policy.max_merged_segment_bytes": 10 * 1024 ** 2,
+             "index.merge.policy.segments_per_tier": 2}))
+        segs = [FakeSeg(8 * 1024 ** 2) for _ in range(6)]
+        spec = p.find_merge(segs)
+        # any window of 2+ segments exceeds 10MB → no legal merge
+        assert spec is None
+
+    def test_delete_heavy_segment_triggers_merge(self):
+        p = TieredMergePolicy()
+        # within budget, but one segment is 60% deleted
+        segs = [FakeSeg(10 * 1024 ** 2, docs=100, live=100) for _ in range(3)]
+        segs[1] = FakeSeg(10 * 1024 ** 2, docs=100, live=40)
+        spec = p.find_merge(segs)
+        assert spec is not None
+        assert spec.start <= 1 < spec.end  # window covers the deleted-heavy segment
+
+    def test_allowed_count_scales_with_tiers(self):
+        p = TieredMergePolicy()
+        small = [1024 ** 2] * 10
+        big = [1024 ** 2] * 5 + [100 * 1024 ** 2] * 5
+        assert p.allowed_segment_count(big) >= p.allowed_segment_count(small)
+
+
+def build_engine(tmp_path, flat=None):
+    settings = Settings.from_flat(flat or {})
+    svc = MapperService(settings)
+    e = Engine(str(tmp_path / "s"), svc, settings=settings)
+    return e, svc
+
+
+class TestEngineMerge:
+    def test_maybe_merge_reduces_segment_count(self, tmp_path):
+        e, svc = build_engine(tmp_path, {
+            "index.merge.policy.segments_per_tier": 3,
+            "index.merge.policy.max_merge_at_once": 5})
+        for i in range(40):
+            e.index("doc", str(i), {"body": f"word{i % 7} common text"})
+            if i % 2 == 1:
+                e.refresh()  # force many tiny segments
+        before = len(e.acquire_searcher().segments)
+        e.maybe_merge(max_merges=20)
+        after = len(e.acquire_searcher().segments)
+        assert after < before
+        # all docs still searchable with correct count
+        ctx = ShardContext(e.acquire_searcher(), svc,
+                           SimilarityService(Settings.EMPTY, mapper_service=svc))
+        td = search_shard(ctx, parse_query({"match": {"body": "common"}}), 50)
+        assert len(td.hits) == 40
+
+    def test_merge_preserves_get_and_versions(self, tmp_path):
+        e, svc = build_engine(tmp_path, {
+            "index.merge.policy.segments_per_tier": 2})
+        for i in range(20):
+            e.index("doc", str(i), {"n": i})
+            e.refresh()
+        e.index("doc", "5", {"n": 500})  # update → version 2
+        e.delete("doc", "7")
+        e.refresh()
+        e.maybe_merge(max_merges=20)
+        r = e.get("doc", "5")
+        assert r.found and r.source["n"] == 500 and r.version == 2
+        assert not e.get("doc", "7").found
+        assert e.get("doc", "3").found
+
+    def test_merge_then_flush_then_restart(self, tmp_path):
+        e, svc = build_engine(tmp_path, {
+            "index.merge.policy.segments_per_tier": 2})
+        for i in range(12):
+            e.index("doc", str(i), {"n": i})
+            e.refresh()
+        e.flush()
+        e.maybe_merge(max_merges=10)  # merges persisted segments → new commit
+        e.index("doc", "100", {"n": 100})  # translog-only doc
+        e.translog.sync()
+        e.close()
+        e2 = Engine(str(tmp_path / "s"), svc, settings=Settings.EMPTY)
+        e2.recover_from_store()
+        e2.refresh()
+        assert e2.get("doc", "100").found
+        assert e2.get("doc", "3").found
+        searcher = e2.acquire_searcher()
+        assert searcher.live_doc_count() == 13
+
+    def test_merge_with_buffered_docs_safe(self, tmp_path):
+        """Docs sitting in the RAM buffer survive a concurrent merge (gen re-key)."""
+        e, svc = build_engine(tmp_path, {
+            "index.merge.policy.segments_per_tier": 2})
+        for i in range(8):
+            e.index("doc", str(i), {"n": i})
+            e.refresh()
+        e.index("doc", "buffered", {"n": 99})  # stays in buffer
+        e.maybe_merge(max_merges=10)
+        e.refresh()
+        assert e.get("doc", "buffered").found
+        assert e.acquire_searcher().live_doc_count() == 9
