@@ -185,6 +185,116 @@ def _mark(text: str, spans: list[tuple[int, int]], pre: str, post: str) -> str:
     return "".join(out)
 
 
+def query_phrases_for_field(query: Query, field: str, ctx) -> list[list[str]]:
+    """Phrase term sequences targeting this field (for phrase-unit highlighting)."""
+    out: list[list[str]] = []
+
+    def walk(q):
+        if isinstance(q, PhraseQuery) and q.field in (field, "_all"):
+            terms = ctx.analyze(field, q.text)
+            if len(terms) > 1:
+                out.append(terms)
+        elif isinstance(q, BoolQuery):
+            for sub in q.must + q.should:
+                walk(sub)
+        elif isinstance(q, FilteredQuery):
+            walk(q.query)
+        elif hasattr(q, "query") and isinstance(getattr(q, "query"), Query):
+            walk(q.query)
+        elif hasattr(q, "queries"):
+            for sub in q.queries:
+                walk(sub)
+
+    walk(query)
+    return out
+
+
+_BOUNDARY_CHARS = set(".,!? \t\n")
+
+
+def fvh_highlight_field(text: str, terms: set[str], phrases: list[list[str]],
+                        ctx, field: str, fragment_size: int = 100,
+                        number_of_fragments: int = 5, pre_tag: str = "<em>",
+                        post_tag: str = "</em>", boundary_max_scan: int = 20) -> list[str]:
+    """Fast-vector-highlighter semantics (ref: search/highlight/ FVH wiring over
+    Lucene's vectorhighlight): phrase matches highlight as ONE unit (not per word),
+    fragments are scored by total match weight (phrases weigh their length), and
+    fragment edges snap to boundary characters within boundary_max_scan."""
+    if not text or (not terms and not phrases):
+        return []
+    analyzer = ctx.mapper_service.search_analyzer_for(field)
+    tokens = analyzer.analyze(text)
+    if not tokens:
+        return []
+    # phrase spans: consecutive-position runs matching the phrase in order
+    spans: list[tuple[int, int, float]] = []  # (start_off, end_off, weight)
+    phrase_positions: set[int] = set()
+    by_pos = {t.position: t for t in tokens}
+    for phrase in phrases:
+        n = len(phrase)
+        for t in tokens:
+            if t.term.lower() != phrase[0]:
+                continue
+            run = [t]
+            for j in range(1, n):
+                nxt = by_pos.get(t.position + j)
+                if nxt is None or nxt.term.lower() != phrase[j]:
+                    run = None
+                    break
+                run.append(nxt)
+            if run:
+                spans.append((run[0].start, run[-1].end, float(n)))
+                phrase_positions.update(x.position for x in run)
+    for t in tokens:
+        if t.term.lower() in terms and t.position not in phrase_positions:
+            spans.append((t.start, t.end, 1.0))
+    if not spans:
+        return []
+    spans.sort()
+    if number_of_fragments == 0:
+        return [_mark(text, [(s, e) for s, e, _ in spans], pre_tag, post_tag)]
+
+    def snap(pos: int, forward: bool) -> int:
+        """Move a fragment edge to the nearest boundary char within the scan window."""
+        if forward:
+            for i in range(pos, min(len(text), pos + boundary_max_scan)):
+                if text[i] in _BOUNDARY_CHARS:
+                    return i + (1 if text[i] != " " else 0)
+            return pos
+        for i in range(pos, max(0, pos - boundary_max_scan), -1):
+            if text[i - 1] in _BOUNDARY_CHARS:
+                return i
+        return pos
+
+    # greedy fragment packing: group spans into windows of fragment_size
+    frags: list[tuple[float, int, int, list[tuple[int, int]]]] = []
+    i = 0
+    while i < len(spans):
+        fs = snap(max(0, spans[i][0] - fragment_size // 4), forward=False)
+        fe_limit = fs + fragment_size
+        window: list[tuple[int, int]] = []
+        weight = 0.0
+        j = i
+        while j < len(spans) and spans[j][1] <= fe_limit:
+            window.append((spans[j][0], spans[j][1]))
+            weight += spans[j][2]
+            j += 1
+        if not window:  # single span longer than the fragment
+            window = [(spans[i][0], spans[i][1])]
+            weight = spans[i][2]
+            j = i + 1
+        fe = snap(min(len(text), max(e for _, e in window) + fragment_size // 4),
+                  forward=True)
+        frags.append((weight, fs, max(fe, max(e for _, e in window)), window))
+        i = j
+    frags.sort(key=lambda f: -f[0])  # highest total match weight first
+    out = []
+    for _w, fs, fe, window in frags[:number_of_fragments]:
+        rel = [(s - fs, e - fs) for s, e in window]
+        out.append(_mark(text[fs:fe], rel, pre_tag, post_tag))
+    return out
+
+
 def build_highlights(query: Query, hl_spec: dict, seg, local: int, ctx) -> dict:
     source = seg.stored[local] or {}
     out = {}
@@ -194,16 +304,27 @@ def build_highlights(query: Query, hl_spec: dict, seg, local: int, ctx) -> dict:
         fopts = fopts or {}
         terms = query_terms_for_field(query, field, ctx)
         vals = extract_field(source, field)
+        hl_type = fopts.get("type", hl_spec.get("type", "plain"))
+        kwargs = dict(
+            fragment_size=int(fopts.get("fragment_size", hl_spec.get("fragment_size", 100))),
+            number_of_fragments=int(fopts.get("number_of_fragments",
+                                              hl_spec.get("number_of_fragments", 5))),
+            pre_tag=(fopts.get("pre_tags") or [global_pre])[0],
+            post_tag=(fopts.get("post_tags") or [global_post])[0],
+        )
         frags: list[str] = []
         for v in vals:
-            frags.extend(highlight_field(
-                str(v), terms, ctx, field,
-                fragment_size=int(fopts.get("fragment_size", hl_spec.get("fragment_size", 100))),
-                number_of_fragments=int(fopts.get("number_of_fragments",
-                                                  hl_spec.get("number_of_fragments", 5))),
-                pre_tag=(fopts.get("pre_tags") or [global_pre])[0],
-                post_tag=(fopts.get("post_tags") or [global_post])[0],
-            ))
+            if hl_type in ("fvh", "fast-vector-highlighter", "postings"):
+                # postings highlighter shares the offsets-based path here — both
+                # highlight from positions+offsets rather than re-scanning
+                phrases = query_phrases_for_field(query, field, ctx)
+                frags.extend(fvh_highlight_field(
+                    str(v), terms, phrases, ctx, field,
+                    boundary_max_scan=int(fopts.get("boundary_max_scan",
+                                                    hl_spec.get("boundary_max_scan", 20))),
+                    **kwargs))
+            else:
+                frags.extend(highlight_field(str(v), terms, ctx, field, **kwargs))
         if frags:
             out[field] = frags
     return out

@@ -122,45 +122,198 @@ def phrase_suggest(ctx, spec: dict, global_text: str | None = None) -> dict:
     }]}
 
 
-class CompletionIndex:
-    """Per-shard completion suggester storage: sorted (input → payload) entries.
-    Fed by `completion`-typed fields at index time (ref: Completion090PostingsFormat)."""
+class _TrieNode:
+    __slots__ = ("children", "max_weight", "outputs")
 
     def __init__(self):
-        self.entries: list[tuple[str, str, float, dict | None]] = []
-        self._sorted = False
+        self.children: dict[str, _TrieNode] = {}
+        self.max_weight = float("-inf")
+        self.outputs: list[tuple[float, str, dict | None]] = []  # terminal entries
+
+
+class CompletionIndex:
+    """Weighted prefix trie with per-node max-weight — the FST analogue of Lucene's
+    Completion090PostingsFormat (ref: search/suggest/completion/): top-k prefix
+    lookup is best-first over max_weight, touching O(k · depth) nodes instead of
+    scanning every completion under the prefix. Optional fuzzy prefix matching via a
+    banded edit-distance walk (the suggester's XFuzzySuggester role)."""
+
+    def __init__(self):
+        self.root = _TrieNode()
+        self.count = 0
 
     def add(self, input_text: str, output: str, weight: float = 1.0, payload=None):
-        self.entries.append((input_text.lower(), output, weight, payload))
-        self._sorted = False
+        w = float(weight)
+        node = self.root
+        node.max_weight = max(node.max_weight, w)
+        for ch in input_text.lower():
+            node = node.children.setdefault(ch, _TrieNode())
+            node.max_weight = max(node.max_weight, w)
+        node.outputs.append((w, output, payload))
+        self.count += 1
 
-    def suggest(self, prefix: str, size: int = 5) -> list[dict]:
-        if not self._sorted:
-            self.entries.sort()
-            self._sorted = True
-        prefix = prefix.lower()
-        import bisect
+    # ------------------------------------------------------------------ lookup
+    def _descend(self, prefix: str) -> _TrieNode | None:
+        node = self.root
+        for ch in prefix:
+            node = node.children.get(ch)
+            if node is None:
+                return None
+        return node
 
-        lo = bisect.bisect_left(self.entries, (prefix,))
-        out = []
-        seen = set()
-        i = lo
-        while i < len(self.entries) and self.entries[i][0].startswith(prefix):
-            out.append(self.entries[i])
-            i += 1
-        out.sort(key=lambda e: (-e[2], e[1]))
-        result = []
-        for _, output, weight, payload in out:
-            if output in seen:
+    def _fuzzy_roots(self, prefix: str, fuzziness: int,
+                     prefix_length: int) -> list[tuple[_TrieNode, str]]:
+        """All trie nodes reachable by consuming `prefix` with ≤ fuzziness edits;
+        the first prefix_length chars must match exactly (ES fuzzy completion
+        defaults: fuzziness 1, prefix_length 1)."""
+        node = self.root
+        exact, rest = prefix[:prefix_length], prefix[prefix_length:]
+        for ch in exact:
+            node = node.children.get(ch)
+            if node is None:
+                return []
+        # banded Levenshtein over the remaining prefix
+        results: dict[int, tuple[_TrieNode, str]] = {}
+        start_row = list(range(len(rest) + 1))
+        stack = [(node, exact, start_row)]
+        while stack:
+            n, path, row = stack.pop()
+            if row[-1] <= fuzziness:
+                key = id(n)
+                if key not in results:
+                    results[key] = (n, path)
+            if min(row) > fuzziness:
                 continue
-            seen.add(output)
-            opt = {"text": output, "score": weight}
-            if payload is not None:
-                opt["payload"] = payload
-            result.append(opt)
-            if len(result) >= size:
-                break
+            for ch, child in n.children.items():
+                new_row = [row[0] + 1]
+                for i in range(1, len(rest) + 1):
+                    cost = 0 if rest[i - 1] == ch else 1
+                    new_row.append(min(new_row[i - 1] + 1, row[i] + 1,
+                                       row[i - 1] + cost))
+                stack.append((child, path + ch, new_row))
+        return list(results.values())
+
+    def suggest(self, prefix: str, size: int = 5,
+                fuzzy: dict | None = None) -> list[dict]:
+        import heapq
+
+        prefix = prefix.lower()
+        if fuzzy:
+            fz = fuzzy.get("fuzziness", 1)
+            if fz in ("AUTO", "auto"):
+                fz = 0 if len(prefix) < 3 else (1 if len(prefix) < 6 else 2)
+            roots = self._fuzzy_roots(prefix, int(fz),
+                                      int(fuzzy.get("prefix_length", 1)))
+        else:
+            node = self._descend(prefix)
+            roots = [(node, prefix)] if node is not None else []
+        if not roots:
+            return []
+        # best-first: heap over (-max_weight) of frontier nodes and found entries
+        seq = 0
+        heap = []
+        for node, _path in roots:
+            heap.append((-node.max_weight, seq := seq + 1, node))
+        heapq.heapify(heap)
+        result: list[dict] = []
+        seen: set[str] = set()
+        candidates: list[tuple[float, str, dict | None]] = []
+        while heap and len(result) < size:
+            neg_w, _, node = heapq.heappop(heap)
+            # flush any found entries at least as good as the rest of the frontier
+            for w, output, payload in sorted(node.outputs, reverse=True,
+                                             key=lambda e: e[0]):
+                heapq.heappush(heap, (-w, seq := seq + 1,
+                                      _Terminal(w, output, payload)))
+            if isinstance(node, _Terminal):
+                if node.output not in seen:
+                    seen.add(node.output)
+                    opt = {"text": node.output, "score": node.weight}
+                    if node.payload is not None:
+                        opt["payload"] = node.payload
+                    result.append(opt)
+                continue
+            for child in node.children.values():
+                heapq.heappush(heap, (-child.max_weight, seq := seq + 1, child))
         return result
+
+
+class _Terminal:
+    """Heap entry for a completed suggestion (weight is exact, not an upper bound)."""
+
+    __slots__ = ("weight", "output", "payload", "children", "outputs", "max_weight")
+
+    def __init__(self, weight: float, output: str, payload):
+        self.weight = weight
+        self.output = output
+        self.payload = payload
+        self.children = {}
+        self.outputs = []
+        self.max_weight = weight
+
+
+def segment_completion_trie(seg, field: str) -> CompletionIndex:
+    """Build (and cache on the write-once segment) the completion trie for one
+    completion-typed field, from stored sources. Entry forms per the reference's
+    CompletionFieldMapper: "text", ["a","b"], or
+    {"input": [...], "output": "...", "weight": N, "payload": {...}}."""
+    cache = getattr(seg, "_completion_tries", None)
+    if cache is None:
+        cache = {}
+        seg._completion_tries = cache
+    trie = cache.get(field)
+    if trie is not None:
+        return trie
+    trie = CompletionIndex()
+    from .fetch import extract_field
+
+    for local in range(seg.doc_count):
+        if not seg.live[local] or seg.stored[local] is None:
+            continue
+        for v in extract_field(seg.stored[local], field):
+            if isinstance(v, dict):
+                inputs = v.get("input", [])
+                inputs = [inputs] if isinstance(inputs, str) else list(inputs)
+                output = v.get("output") or (inputs[0] if inputs else "")
+                weight = float(v.get("weight", 1.0))
+                payload = v.get("payload")
+                for inp in inputs:
+                    trie.add(str(inp), str(output), weight, payload)
+            elif isinstance(v, list):
+                for inp in v:
+                    trie.add(str(inp), str(inp))
+            elif v is not None:
+                trie.add(str(v), str(v))
+    cache[field] = trie
+    return trie
+
+
+def completion_suggest(ctx, name: str, spec: dict,
+                       global_text: str | None = None) -> dict:
+    """Completion across segments: per-segment tries merged by weight."""
+    comp_spec = spec.get("completion") or {}
+    prefix = spec.get("text", spec.get("prefix", global_text or ""))
+    field = comp_spec.get("field", name)
+    size = int(comp_spec.get("size", 5))
+    fuzzy = comp_spec.get("fuzzy")
+    if fuzzy is True:
+        fuzzy = {}
+    options: list[dict] = []
+    # legacy hook: a shard-level index set on the context wins (tests / percolator)
+    shard_index = getattr(ctx, "completion_index", None)
+    if shard_index is not None:
+        options = shard_index.suggest(prefix, size, fuzzy=fuzzy)
+    else:
+        merged: dict[str, dict] = {}
+        for seg in ctx.searcher.segments:
+            for opt in segment_completion_trie(seg, field).suggest(
+                    prefix, size, fuzzy=fuzzy):
+                prev = merged.get(opt["text"])
+                if prev is None or opt["score"] > prev["score"]:
+                    merged[opt["text"]] = opt
+        options = sorted(merged.values(), key=lambda o: (-o["score"], o["text"]))[:size]
+    return {"entries": [{"text": prefix, "offset": 0, "length": len(prefix),
+                         "options": options}]}
 
 
 def run_suggest(ctx, suggest_body: dict) -> dict:
@@ -174,11 +327,7 @@ def run_suggest(ctx, suggest_body: dict) -> dict:
         elif "phrase" in spec:
             r = phrase_suggest(ctx, spec, global_text)
         elif "completion" in spec:
-            comp: CompletionIndex | None = getattr(ctx, "completion_index", None)
-            prefix = spec.get("text", global_text or "")
-            opts = comp.suggest(prefix, int(spec["completion"].get("size", 5))) if comp else []
-            r = {"entries": [{"text": prefix, "offset": 0, "length": len(prefix),
-                              "options": opts}]}
+            r = completion_suggest(ctx, name, spec, global_text)
         else:
             continue
         out[name] = r["entries"]

@@ -1,0 +1,137 @@
+"""Completion suggester (weighted trie, fuzzy) + FVH highlighter."""
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index import Engine
+from elasticsearch_tpu.mapper import MapperService
+from elasticsearch_tpu.search import ShardContext
+from elasticsearch_tpu.search.suggest import CompletionIndex, run_suggest
+
+
+class TestCompletionTrie:
+    def setup_method(self):
+        self.c = CompletionIndex()
+        for t, w in [("nirvana", 10), ("nevermind", 8), ("nine inch nails", 9),
+                     ("nina simone", 7), ("queen", 5), ("nirvana live", 6)]:
+            self.c.add(t, t, w)
+
+    def test_prefix_topk_by_weight(self):
+        opts = self.c.suggest("ni", 3)
+        assert [o["text"] for o in opts] == ["nirvana", "nine inch nails",
+                                            "nina simone"]
+        assert [o["score"] for o in opts] == [10.0, 9.0, 7.0]
+
+    def test_size_limits(self):
+        assert len(self.c.suggest("n", 2)) == 2
+
+    def test_no_match(self):
+        assert self.c.suggest("xyz", 5) == []
+
+    def test_empty_prefix_returns_global_topk(self):
+        opts = self.c.suggest("", 2)
+        assert [o["text"] for o in opts] == ["nirvana", "nine inch nails"]
+
+    def test_fuzzy_one_edit(self):
+        opts = self.c.suggest("nevermnd", 3, fuzzy={"fuzziness": 1})
+        assert [o["text"] for o in opts] == ["nevermind"]
+
+    def test_fuzzy_prefix_length_guard(self):
+        # first char must match exactly with prefix_length=1
+        assert self.c.suggest("xevermind", 3, fuzzy={"fuzziness": 1}) == []
+
+    def test_fuzzy_auto(self):
+        opts = self.c.suggest("nirvana", 3, fuzzy={"fuzziness": "AUTO"})
+        assert opts[0]["text"] == "nirvana"
+
+    def test_dedup_outputs(self):
+        c = CompletionIndex()
+        c.add("foo bar", "foo", 5)
+        c.add("foo baz", "foo", 3)
+        opts = c.suggest("foo", 5)
+        assert len(opts) == 1 and opts[0]["score"] == 5.0
+
+
+@pytest.fixture()
+def engine_ctx(tmp_path):
+    svc = MapperService(Settings.EMPTY)
+    svc.put_mapping("song", {"properties": {
+        "suggest": {"type": "completion"},
+        "title": {"type": "string"},
+        "body": {"type": "string"}}})
+    e = Engine(str(tmp_path / "s"), svc)
+    e.index("song", "1", {"suggest": {"input": ["Nirvana", "Nevermind"],
+                                      "output": "Nirvana - Nevermind",
+                                      "weight": 34, "payload": {"id": 1}},
+                          "title": "Nevermind"})
+    e.index("song", "2", {"suggest": "Nine Inch Nails", "title": "NIN"})
+    e.refresh()
+    e.index("song", "3", {"suggest": {"input": "Nina Simone", "weight": 50}})
+    e.refresh()  # second segment: exercises cross-segment merge
+    yield ShardContext(e.acquire_searcher(), svc)
+    e.close()
+
+
+class TestCompletionField:
+    def test_multi_input_payload(self, engine_ctx):
+        r = run_suggest(engine_ctx, {"s": {"text": "nev",
+                                           "completion": {"field": "suggest"}}})
+        opts = r["s"][0]["options"]
+        assert opts[0]["text"] == "Nirvana - Nevermind"
+        assert opts[0]["payload"] == {"id": 1}
+
+    def test_cross_segment_weight_order(self, engine_ctx):
+        r = run_suggest(engine_ctx, {"s": {"text": "ni",
+                                           "completion": {"field": "suggest"}}})
+        opts = r["s"][0]["options"]
+        assert [o["text"] for o in opts] == ["Nina Simone", "Nirvana - Nevermind",
+                                            "Nine Inch Nails"]
+
+    def test_fuzzy_through_api(self, engine_ctx):
+        r = run_suggest(engine_ctx, {"s": {"text": "nrvana", "completion": {
+            "field": "suggest", "fuzzy": {"fuzziness": 1, "prefix_length": 1}}}})
+        assert r["s"][0]["options"][0]["text"] == "Nirvana - Nevermind"
+
+
+class TestFvhHighlight:
+    def _search(self, tmp_path, body):
+        from elasticsearch_tpu.search.service import execute_query_phase, \
+            execute_fetch_phase, parse_search_body
+
+        svc = MapperService(Settings.EMPTY)
+        e = Engine(str(tmp_path / "h"), svc)
+        e.index("doc", "1", {
+            "body": "The quick brown fox. A lazy dog sleeps here. "
+                    "Quick thinking saves the quick brown fox again."})
+        e.refresh()
+        ctx = ShardContext(e.acquire_searcher(), svc)
+        req = parse_search_body(body)
+        qr = execute_query_phase(ctx, req, shard_id=0)
+        return execute_fetch_phase(ctx, req, qr.docs)
+
+    def test_phrase_highlighted_as_unit(self, tmp_path):
+        hits = self._search(tmp_path, {
+            "query": {"match_phrase": {"body": "quick brown fox"}},
+            "highlight": {"type": "fvh", "fields": {"body": {}}}})
+        frags = hits[0]["highlight"]["body"]
+        joined = " ".join(frags)
+        assert "<em>quick brown fox</em>" in joined.lower().replace(
+            "<em>quick</em> <em>brown</em> <em>fox</em>", "MULTI")
+        assert "MULTI" not in joined
+
+    def test_fragment_scoring_prefers_denser(self, tmp_path):
+        hits = self._search(tmp_path, {
+            "query": {"match": {"body": "quick"}},
+            "highlight": {"type": "fvh",
+                          "fields": {"body": {"fragment_size": 45,
+                                              "number_of_fragments": 1}}}})
+        frag = hits[0]["highlight"]["body"][0]
+        # the densest window has two "quick"s
+        assert frag.lower().count("<em>quick</em>") >= 2
+
+    def test_plain_still_works(self, tmp_path):
+        hits = self._search(tmp_path, {
+            "query": {"match": {"body": "fox"}},
+            "highlight": {"fields": {"body": {}}}})
+        assert any("<em>fox</em>" in f.lower()
+                   for f in hits[0]["highlight"]["body"])
