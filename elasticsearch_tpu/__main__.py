@@ -1,0 +1,76 @@
+"""CLI launcher: `python -m elasticsearch_tpu [options]`.
+
+Analogue of bin/elasticsearch → bootstrap/Bootstrap.java:143 (SURVEY.md §3.1): prepare
+settings (yaml config + -D overrides), build a Node, start transport/discovery/HTTP,
+then block until SIGINT/SIGTERM.
+
+Options mirror the reference launcher's surface:
+  -Dkey=value          setting override (repeatable; e.g. -Dnode.name=n1)
+  --config PATH        elasticsearch.yml-style settings file
+  --data PATH          data directory (path.data)
+  --http-port N        REST port (default 9200; 0 = ephemeral)
+  --transport tcp|local
+  --seeds host:port,…  unicast discovery seeds
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="estpu", description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("-D", action="append", default=[], metavar="key=value",
+                    dest="defines")
+    ap.add_argument("--config", default=None)
+    ap.add_argument("--data", default=None)
+    ap.add_argument("--http-port", type=int, default=9200)
+    ap.add_argument("--transport", choices=("tcp", "local"), default="tcp")
+    ap.add_argument("--seeds", default=None)
+    args = ap.parse_args(argv)
+
+    settings: dict = {}
+    if args.config:
+        import yaml
+
+        with open(args.config) as f:
+            settings.update(yaml.safe_load(f) or {})
+    for d in args.defines:
+        key, _, value = d.partition("=")
+        settings[key] = value
+    settings.setdefault("transport.type", args.transport)
+    settings.setdefault("http.enabled", True)
+    settings.setdefault("http.port", args.http_port)
+    if args.seeds:
+        settings.setdefault("discovery.zen.ping.unicast.hosts",
+                            [s.strip() for s in args.seeds.split(",") if s.strip()])
+
+    from .node import Node
+
+    node = Node(settings=settings, data_path=args.data)
+    seeds = settings.get("discovery.zen.ping.unicast.hosts")
+    node.start(seeds=list(seeds) if seeds else [])
+
+    stop = threading.Event()
+
+    def shutdown(_sig, _frm):
+        stop.set()
+
+    signal.signal(signal.SIGINT, shutdown)
+    signal.signal(signal.SIGTERM, shutdown)
+    addr = node.local_node.transport_address
+    port = node.http.port if node.http else None
+    print(f"[estpu] node [{node.name}] started — transport {addr}, http port {port}",
+          flush=True)
+    stop.wait()
+    print("[estpu] shutting down", flush=True)
+    node.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

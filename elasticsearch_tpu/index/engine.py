@@ -322,6 +322,15 @@ class Engine:
             self.stats["refresh_time_ms"] += (time.monotonic() - t0) * 1000
             return True
 
+    def indexing_buffer_bytes(self) -> int:
+        """Estimated RAM held by the un-refreshed buffer (IndexingMemoryController
+        input — ref: indices/memory/IndexingMemoryController.java:52-85)."""
+        return self._buffer.ram_bytes
+
+    @property
+    def last_write_time(self) -> float:
+        return self._last_write
+
     def acquire_searcher(self) -> Searcher:
         with self._lock:
             self._check_open()

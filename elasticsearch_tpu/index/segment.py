@@ -64,11 +64,21 @@ class SegmentBuilder:
         self._parent_mask: list[bool] = []
         self._nested_paths: list[str | None] = []
         self.doc_count = 0
+        self.ram_bytes = 0
 
     def ram_docs(self) -> int:
         return self.doc_count
 
     def _add_fields(self, doc: ParsedDocument, local: int):
+        # cheap RAM accounting for the IndexingMemoryController (counts postings,
+        # columnar values, and a per-doc overhead — not exact, monotonic is enough)
+        self.ram_bytes += 128
+        for terms in doc.postings.values():
+            self.ram_bytes += 40 * len(terms)
+        for vals in doc.doc_values_num.values():
+            self.ram_bytes += 24 * len(vals)
+        for vals in doc.doc_values_str.values():
+            self.ram_bytes += sum(48 + 2 * len(str(v)) for v in vals)
         for field_name, terms in doc.postings.items():
             # group into freq + positions per term
             per_term: dict[str, list[int]] = {}

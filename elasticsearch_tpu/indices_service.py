@@ -100,6 +100,38 @@ class IndicesService:
         transport.register_handler(ACTION_RECOVERY_TRANSLOG, self._handle_recovery_translog)
         cluster_service.add_listener(self.cluster_changed)
 
+    # ------------------------------------------------------------ memory control
+    def check_indexing_memory(self, budget_bytes: int | None = None,
+                              inactive_after: float = 300.0) -> int:
+        """IndexingMemoryController (ref: indices/memory/IndexingMemoryController.java):
+        a node-wide indexing-buffer budget shared across shards. When the summed
+        un-refreshed buffer estimate exceeds it, the largest buffers are refreshed
+        (frozen to segments) first until under budget; shards idle for
+        `inactive_after` seconds get their buffers flushed out too. Returns the
+        number of shards refreshed."""
+        import time as _time
+
+        budget = budget_bytes if budget_bytes is not None else 64 * 1024 ** 2
+        shards = [s for svc in self.indices.values() for s in svc.shards.values()
+                  if s.state == SHARD_STARTED]
+        now = _time.time()
+        refreshed = 0
+        sized = sorted(((s.engine.indexing_buffer_bytes(), s) for s in shards),
+                       key=lambda t: -t[0])
+        total = sum(b for b, _ in sized)
+        for bytes_, shard in sized:
+            if bytes_ <= 0:
+                continue
+            idle = now - shard.engine.last_write_time > inactive_after
+            if total > budget or idle:
+                try:
+                    shard.engine.refresh()
+                except SearchEngineError:
+                    continue
+                total -= bytes_
+                refreshed += 1
+        return refreshed
+
     # ------------------------------------------------------------ nrt loop
     def periodic_refresh(self):
         """Scheduled NRT refresh per shard (ref: InternalIndexShard.java:176,850-851 —

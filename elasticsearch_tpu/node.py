@@ -45,6 +45,18 @@ class Node:
             tempfile.mkdtemp(prefix=f"estpu_{self.name}_")
         self.logger = get_logger("node", node=self.name)
         self.registry = registry or DEFAULT_REGISTRY
+        # plugin discovery before service assembly (ref: InternalNode.java:150 —
+        # PluginsService first, so plugins can contribute settings defaults)
+        from .plugins import PluginsService
+
+        self.plugins = PluginsService(self.settings, self.data_path or ".")
+        extra = self.plugins.additional_settings()
+        if extra:
+            merged = dict(extra)
+            merged.update(self.settings.as_dict())  # node settings win
+            from .common.settings import Settings as _S
+
+            self.settings = _S.from_flat(merged)
         # transport.type: "local" (in-process, the test default — LocalTransport.java's
         # role) or "tcp" (DCN sockets between host processes — NettyTransport's role).
         if self.settings.get_str("transport.type", "local") == "tcp":
@@ -95,6 +107,12 @@ class Node:
         # inside periodic_refresh; this is just the tick)
         self._refresh_task = self.threadpool.schedule_with_fixed_delay(
             0.5, self.indices.periodic_refresh, name="refresh")
+        # IndexingMemoryController: shared indexing-buffer budget across shards
+        # (ref default 10% of heap → here: % of system RAM, or explicit bytes)
+        self._imc_budget = self._resolve_index_buffer_size()
+        self._imc_task = self.threadpool.schedule_with_fixed_delay(
+            5.0, lambda: self.indices.check_indexing_memory(self._imc_budget),
+            name="management")
         self.discovery = ZenDiscovery(self.local_node, self.transport,
                                       self.cluster_service, self.allocation,
                                       self.settings)
@@ -119,9 +137,11 @@ class Node:
                 addresses = self.registry.addresses()
             else:
                 addresses = []
+        self.plugins.on_node_created(self)
         self.discovery.start(addresses)
         self.gateway.maybe_recover()
         self._started = True
+        self.plugins.on_node_started(self)
         if self.settings.get_bool("http.enabled", False):
             self.start_http(self.settings.get_int("http.port", 9200))
         self.logger.info("started (master=%s)",
@@ -140,6 +160,7 @@ class Node:
         if self._closed:
             return
         self._closed = True
+        self.plugins.on_node_closed(self)
         if self.http is not None:
             self.http.stop()
         self.discovery.leave()
@@ -149,6 +170,20 @@ class Node:
         self.cluster_service.close()
         self.transport.close()
         self.threadpool.shutdown()
+
+    def _resolve_index_buffer_size(self) -> int:
+        """indices.memory.index_buffer_size: "10%" (of system RAM) or bytes value
+        (ref: IndexingMemoryController.java:52 — default 10% of heap)."""
+        raw = self.settings.get("indices.memory.index_buffer_size", "10%")
+        if isinstance(raw, str) and raw.strip().endswith("%"):
+            try:
+                frac = float(raw.strip()[:-1]) / 100.0
+                total = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+                return max(int(total * frac), 16 * 1024 ** 2)
+            except (ValueError, OSError):
+                return 64 * 1024 ** 2
+        v = self.settings.get_bytes("indices.memory.index_buffer_size", None)
+        return v if v else 64 * 1024 ** 2
 
     def _purge_expired(self):
         """ref: indices/ttl/IndicesTTLService — delete docs whose _ttl expired."""
@@ -429,8 +464,13 @@ class Client:
 
     def nodes_info(self):
         state = self.node.cluster_service.state
-        return {"cluster_name": state.cluster_name,
-                "nodes": {n.id: n.to_dict() for n in state.nodes.nodes}}
+        nodes = {}
+        for n in state.nodes.nodes:
+            d = n.to_dict()
+            if n.id == self.node.node_id:
+                d["plugins"] = self.node.plugins.info()
+            nodes[n.id] = d
+        return {"cluster_name": state.cluster_name, "nodes": nodes}
 
     def nodes_stats(self):
         return {"nodes": {self.node.node_id: {

@@ -1,0 +1,132 @@
+"""Plugin system.
+
+Analogue of plugins/PluginsService.java (SURVEY.md §2.7): plugins are discovered in
+`path.plugins` (default `<data>/plugins`) and from the `plugin.types` setting. The
+reference's `es-plugin.properties` naming a Plugin class becomes: a plugin is a python
+file/package whose module defines a `Plugin` subclass (or a `plugin` factory). Plugins
+can contribute settings defaults, lifecycle hooks, and REST routes — the same extension
+points the reference exposes through extra Guice modules/services/REST handlers.
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import os
+import sys
+
+from .common.logging import get_logger
+
+
+class Plugin:
+    """Base class. Override what you need; name/description appear in nodes_info."""
+
+    name = "unnamed-plugin"
+    description = ""
+
+    def additional_settings(self) -> dict:
+        """Defaults merged under the node's settings (lowest precedence)."""
+        return {}
+
+    def on_node_created(self, node) -> None:
+        """Called after services are constructed, before discovery starts."""
+
+    def on_node_started(self, node) -> None:
+        """Called after the node joined the cluster."""
+
+    def on_node_closed(self, node) -> None:
+        """Called during node shutdown."""
+
+    def rest_routes(self, controller, node) -> None:
+        """Register extra REST handlers: controller.register(method, path, fn)."""
+
+
+class PluginsService:
+    """Discovers + holds plugin instances for one node."""
+
+    def __init__(self, settings, data_path: str):
+        self.logger = get_logger("plugins")
+        self.plugins: list[Plugin] = []
+        # 1) explicit classes: plugin.types = ["mypkg.mymod.MyPlugin", ...]
+        for spec in settings.get_list("plugin.types", []):
+            cls = self._load_class(spec)
+            if cls is not None:
+                self._instantiate(cls)
+        # 2) directory scan (ref: PluginsService scans plugins/)
+        plugin_dir = settings.get_str("path.plugins") or os.path.join(data_path, "plugins")
+        if os.path.isdir(plugin_dir):
+            for entry in sorted(os.listdir(plugin_dir)):
+                path = os.path.join(plugin_dir, entry)
+                if entry.endswith(".py"):
+                    self._load_file(entry[:-3], path)
+                elif os.path.isdir(path) and \
+                        os.path.isfile(os.path.join(path, "__init__.py")):
+                    self._load_file(entry, os.path.join(path, "__init__.py"))
+
+    def _load_class(self, spec: str):
+        mod_name, _, cls_name = spec.rpartition(".")
+        try:
+            return getattr(importlib.import_module(mod_name), cls_name)
+        except (ImportError, AttributeError) as e:
+            self.logger.warning("failed to load plugin [%s]: %s", spec, e)
+            return None
+
+    def _load_file(self, name: str, path: str):
+        try:
+            mod_key = f"estpu_plugin_{name}"
+            spec = importlib.util.spec_from_file_location(mod_key, path)
+            module = importlib.util.module_from_spec(spec)
+            sys.modules[mod_key] = module
+            spec.loader.exec_module(module)
+        except Exception as e:  # noqa: BLE001 — a broken plugin must not kill the node
+            self.logger.warning("failed to load plugin file [%s]: %s", path, e)
+            return
+        factory = getattr(module, "plugin", None)
+        if callable(factory) and not isinstance(factory, type):
+            try:
+                self.plugins.append(factory())
+                return
+            except Exception as e:  # noqa: BLE001
+                self.logger.warning("plugin factory [%s] failed: %s", name, e)
+                return
+        for attr in vars(module).values():
+            if isinstance(attr, type) and issubclass(attr, Plugin) and attr is not Plugin:
+                self._instantiate(attr)
+                return
+        self.logger.warning("no Plugin subclass in [%s]", path)
+
+    def _instantiate(self, cls):
+        try:
+            self.plugins.append(cls())
+        except Exception as e:  # noqa: BLE001
+            self.logger.warning("plugin [%s] failed to construct: %s", cls, e)
+
+    # ------------------------------------------------------------------ hooks
+    def additional_settings(self) -> dict:
+        out: dict = {}
+        for p in self.plugins:
+            out.update(p.additional_settings() or {})
+        return out
+
+    def on_node_created(self, node):
+        for p in self.plugins:
+            p.on_node_created(node)
+
+    def on_node_started(self, node):
+        for p in self.plugins:
+            p.on_node_started(node)
+
+    def on_node_closed(self, node):
+        for p in self.plugins:
+            try:
+                p.on_node_closed(node)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def rest_routes(self, controller, node):
+        for p in self.plugins:
+            p.rest_routes(controller, node)
+
+    def info(self) -> list[dict]:
+        return [{"name": p.name, "description": p.description,
+                 "jvm": False, "site": False} for p in self.plugins]

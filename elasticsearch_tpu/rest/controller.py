@@ -646,4 +646,7 @@ def build_rest_controller(node) -> RestController:
             "aliases", "pending_tasks", "recovery", "thread_pool")),
         content_type="text/plain"))
 
+    # plugin-contributed routes (ref: plugins contribute REST handlers)
+    if getattr(node, "plugins", None) is not None:
+        node.plugins.rest_routes(rc, node)
     return rc

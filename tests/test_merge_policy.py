@@ -139,3 +139,36 @@ class TestEngineMerge:
         e.refresh()
         assert e.get("doc", "buffered").found
         assert e.acquire_searcher().live_doc_count() == 9
+
+
+class TestIndexingMemoryController:
+    def test_budget_forces_refresh_of_largest_buffers(self, tmp_path):
+        """check_indexing_memory refreshes big buffers first when over budget
+        (ref: IndexingMemoryController.java:52-85)."""
+        from elasticsearch_tpu.node import Node
+        from elasticsearch_tpu.transport.local import LocalTransportRegistry
+
+        registry = LocalTransportRegistry()
+        node = Node(name="imc_node", registry=registry,
+                    settings={"index.refresh_interval": "-1"},
+                    data_path=str(tmp_path / "n"))
+        try:
+            node.start([node.local_node.transport_address])
+            node.wait_for_master()
+            client = node.client()
+            client.create_index("imc", {"settings": {"index.number_of_shards": 2}})
+            client.cluster_health(wait_for_status="green", timeout=10)
+            for i in range(50):
+                client.index("imc", "doc", {"body": f"some text {i}" * 10}, id=str(i))
+            shards = [s for svc in node.indices.indices.values()
+                      for s in svc.shards.values()]
+            buffered = sum(s.engine.indexing_buffer_bytes() for s in shards)
+            assert buffered > 0
+            # tiny budget → everything must be refreshed out
+            n = node.indices.check_indexing_memory(budget_bytes=1)
+            assert n >= 1
+            assert sum(s.engine.indexing_buffer_bytes() for s in shards) == 0
+            # under budget: no refreshes
+            assert node.indices.check_indexing_memory(budget_bytes=1 << 30) == 0
+        finally:
+            node.close()
