@@ -31,7 +31,9 @@ from .common.errors import (
     IndexMissingError,
     MasterNotDiscoveredError,
     NoShardAvailableError,
+    IndexWarmerMissingError,
     SearchEngineError,
+    TypeMissingError,
     UnavailableShardsError,
     VersionConflictError,
 )
@@ -70,6 +72,7 @@ A_DELETE_INDEX = "indices:admin/delete"
 A_OPEN_INDEX = "indices:admin/open"
 A_CLOSE_INDEX = "indices:admin/close"
 A_PUT_MAPPING = "indices:admin/mapping/put"
+A_DELETE_MAPPING = "indices:admin/mapping/delete"
 A_UPDATE_SETTINGS = "indices:admin/settings/update"
 A_ALIASES = "indices:admin/aliases"
 A_PUT_TEMPLATE = "indices:admin/template/put"
@@ -110,6 +113,7 @@ class ActionModule:
             (A_OPEN_INDEX, self._m_open_index),
             (A_CLOSE_INDEX, self._m_close_index),
             (A_PUT_MAPPING, self._m_put_mapping),
+            (A_DELETE_MAPPING, self._m_delete_mapping),
             (A_UPDATE_SETTINGS, self._m_update_settings),
             (A_ALIASES, self._m_aliases),
             (A_PUT_TEMPLATE, self._m_put_template),
@@ -280,9 +284,17 @@ class ActionModule:
         for k, v in flat.items():
             normalized[k if k.startswith("index.") else f"index.{k}"] = v
 
+        # index.blocks.* settings install/remove the matching cluster blocks
+        # (ref: IndexMetaData block settings → ClusterBlocks)
+        block_keys = {"index.blocks.read_only": ("index_read_only", "write"),
+                      "index.blocks.read": ("index_read", "read"),
+                      "index.blocks.write": ("index_write", "write"),
+                      "index.blocks.metadata": ("index_metadata", "metadata")}
+
         def update(state: ClusterState) -> ClusterState:
             md = state.metadata
             rt = state.routing_table
+            blocks = state.blocks
             for index in indices:
                 meta = md.require_index(index)
                 old_replicas = meta.number_of_replicas
@@ -290,7 +302,14 @@ class ActionModule:
                 md = md.with_index(meta)
                 if meta.number_of_replicas != old_replicas:
                     rt = self._resize_replicas(rt, index, meta.number_of_replicas)
-            new = state.next_version(metadata=md, routing_table=rt)
+                for key, block in block_keys.items():
+                    if key in normalized:
+                        on = str(normalized[key]).lower() in ("true", "1")
+                        if on:
+                            blocks = blocks.with_index_block(index, block)
+                        else:
+                            blocks = blocks.without_index_block(index, block)
+            new = state.next_version(metadata=md, routing_table=rt, blocks=blocks)
             return self.allocation.reroute(new)
 
         self._submit(f"update-settings{indices}", update)
@@ -316,24 +335,92 @@ class ActionModule:
 
     def _m_aliases(self, request, channel):
         actions = request["body"].get("actions", [])
+        # resolve index expressions up-front so missing indices fail before mutation
+        state0 = self.cluster_service.state
+        resolved = []
+        for entry in actions:
+            (op, spec), = entry.items()
+            indices = state0.metadata.resolve_indices(
+                spec.get("index") or spec.get("indices") or "_all")
+            aliases = spec.get("alias") or spec.get("aliases") or []
+            if not isinstance(aliases, list):
+                aliases = [a.strip() for a in str(aliases).split(",")]
+            resolved.append((op, spec, indices, aliases))
+
+        from .common.errors import AliasesMissingError
+        from .common.names import name_matches
+
+        # `remove` with wildcards must match something (ref: AliasesMissingException)
+        for op, spec, indices, alias_exprs in resolved:
+            if op != "remove":
+                continue
+            found = any(
+                name_matches(a, expr)
+                for index in indices
+                for a, _ in state0.metadata.require_index(index).aliases
+                for expr in alias_exprs)
+            if not found:
+                raise AliasesMissingError(alias_exprs)
 
         def update(state: ClusterState) -> ClusterState:
             md = state.metadata
-            for entry in actions:
-                (op, spec), = entry.items()
-                index = spec["index"]
-                alias = spec["alias"]
-                meta = md.require_index(index)
-                aliases = dict(meta.aliases)
-                if op == "add":
-                    aliases[alias] = {k: v for k, v in spec.items()
-                                      if k in ("filter", "index_routing", "search_routing", "routing")}
-                elif op == "remove":
-                    aliases.pop(alias, None)
-                md = md.with_index(meta.with_aliases(aliases))
+            for op, spec, indices, alias_exprs in resolved:
+                for index in indices:
+                    meta = md.require_index(index)
+                    aliases = dict(meta.aliases)
+                    if op == "add":
+                        for alias in alias_exprs:
+                            aliases[alias] = {
+                                k: v for k, v in spec.items()
+                                if k in ("filter", "index_routing",
+                                         "search_routing", "routing")}
+                    elif op == "remove":
+                        for expr in alias_exprs:
+                            for a in [a for a in aliases
+                                      if name_matches(a, expr)]:
+                                aliases.pop(a)
+                    md = md.with_index(meta.with_aliases(aliases))
             return state.next_version(metadata=md)
 
         self._submit("aliases", update)
+        return {"acknowledged": True}
+
+    def _m_delete_mapping(self, request, channel):
+        """ref: action/admin/indices/mapping/delete — drop the type's mapping and its
+        documents from every resolved index."""
+        state0 = self.cluster_service.state
+        indices = state0.metadata.resolve_indices(request["index"])
+        type_expr = request["type"]
+        from .common.names import name_matches
+
+        matched = {
+            index: [t for t, _ in state0.metadata.require_index(index).mappings
+                    if name_matches(t, type_expr)]
+            for index in indices}
+        if not any(matched.values()):
+            raise TypeMissingError(f"type[[{type_expr}]] missing")
+
+        def update(state: ClusterState) -> ClusterState:
+            md = state.metadata
+            for index, types in matched.items():
+                meta = md.require_index(index)
+                for t in types:
+                    meta = meta.without_mapping(t)
+                md = md.with_index(meta)
+            return state.next_version(metadata=md)
+
+        self._submit(f"delete-mapping[{indices}/{type_expr}]", update)
+        # purge documents of the removed types locally (primary-owned shards)
+        for index, types in matched.items():
+            for t in types:
+                try:
+                    self.delete_by_query(index, {"query": {
+                        "filtered": {"query": {"match_all": {}},
+                                     "filter": {"type": {"value": t}}}}})
+                except SearchEngineError as e:
+                    self.logger.warning(
+                        "delete-mapping [%s/%s]: mapping removed but doc purge "
+                        "failed: %s", index, t, e)
         return {"acknowledged": True}
 
     def _m_put_template(self, request, channel):
@@ -380,16 +467,28 @@ class ActionModule:
         return {"acknowledged": True}
 
     def _m_delete_warmer(self, request, channel):
-        indices = self.cluster_service.state.metadata.resolve_indices(request["index"])
-        name = request["name"]
+        state0 = self.cluster_service.state
+        indices = state0.metadata.resolve_indices(request["index"])
+        name_expr = request["name"] or "_all"
+        from .common.names import name_matches
+
+        matched = {
+            index: [w for w, _ in state0.metadata.require_index(index).warmers
+                    if name_matches(w, name_expr)]
+            for index in indices}
+        if not any(matched.values()):
+            raise IndexWarmerMissingError(name_expr)
 
         def update(state: ClusterState) -> ClusterState:
             md = state.metadata
-            for index in indices:
-                md = md.with_index(md.require_index(index).with_warmer(name, None))
+            for index, names in matched.items():
+                meta = md.require_index(index)
+                for w in names:
+                    meta = meta.with_warmer(w, None)
+                md = md.with_index(meta)
             return state.next_version(metadata=md)
 
-        self._submit(f"delete-warmer[{name}]", update)
+        self._submit(f"delete-warmer[{name_expr}]", update)
         return {"acknowledged": True}
 
     def _run_warmers(self, index: str, shard_id: int):
@@ -505,10 +604,26 @@ class ActionModule:
             raise IndexMissingError(index)
         return index
 
+    def _required_routing_check(self, index: str, type_name: str, doc_id: str,
+                                routing) -> None:
+        """ref: MetaData.resolveIndexRouting — `_routing.required` (and `_parent`
+        mappings, whose parent value routes the doc) reject ops without routing."""
+        if routing is not None:
+            return
+        meta = self.cluster_service.state.metadata.index(index)
+        if meta is None:
+            return
+        mapping = meta.mapping(type_name) if type_name and type_name != "_all" else None
+        if mapping and (mapping.get("_routing", {}).get("required")
+                        or "_parent" in mapping):
+            from .common.errors import RoutingMissingError
+
+            raise RoutingMissingError(index, type_name, doc_id)
+
     def index_doc(self, index: str, type_name: str, doc_id: str | None, source: dict,
                   routing=None, version=None, version_type="internal",
                   op_type="index", refresh=False, consistency="quorum",
-                  auto_create=True) -> dict:
+                  auto_create=True, parent=None, timestamp=None, ttl=None) -> dict:
         state = self.cluster_service.state
         if not state.metadata.has_index(index) and auto_create:
             try:
@@ -525,50 +640,130 @@ class ActionModule:
         index = self._resolve_index_write(index)
         if doc_id is None:
             doc_id = uuid.uuid4().hex[:20]
+        effective_routing = routing if routing is not None else parent
+        self._required_routing_check(index, type_name, doc_id, effective_routing)
         req = {"index": index, "type": type_name, "id": doc_id, "source": source,
-               "routing": routing, "version": version, "version_type": version_type,
+               "routing": routing, "parent": parent, "timestamp": timestamp,
+               "ttl": ttl, "version": version, "version_type": version_type,
                "op_type": op_type, "refresh": refresh, "consistency": consistency}
-        return self._route_to_primary(index, doc_id, routing, A_INDEX_PRIMARY, req)
+        return self._route_to_primary(index, doc_id, effective_routing,
+                                      A_INDEX_PRIMARY, req)
 
     def delete_doc(self, index: str, type_name: str, doc_id: str, routing=None,
-                   version=None, refresh=False) -> dict:
+                   version=None, refresh=False, parent=None) -> dict:
         index = self._resolve_index_write(index)
+        effective_routing = routing if routing is not None else parent
+        self._required_routing_check(index, type_name, doc_id, effective_routing)
         req = {"index": index, "type": type_name, "id": doc_id, "routing": routing,
                "version": version, "refresh": refresh}
-        return self._route_to_primary(index, doc_id, routing, A_DELETE_PRIMARY, req)
+        return self._route_to_primary(index, doc_id, effective_routing,
+                                      A_DELETE_PRIMARY, req)
 
     def update_doc(self, index: str, type_name: str, doc_id: str, body: dict,
-                   routing=None, retry_on_conflict: int = 0) -> dict:
+                   routing=None, retry_on_conflict: int = 0, parent=None,
+                   refresh=False, fields=None, ttl=None, timestamp=None,
+                   version=None, version_type="internal") -> dict:
         """Get-modify-reindex on the coordinator with CAS retry
-        (ref: TransportUpdateAction.java:212-270)."""
+        (ref: TransportUpdateAction.java:212-270; auto-creates the index like the
+        index action does)."""
+        if not self.cluster_service.state.metadata.has_index(index):
+            try:
+                self.cluster_service.state.metadata.resolve_indices(index)
+            except IndexMissingError:
+                try:
+                    self.transport.submit_request(
+                        self.node.local_node, A_CREATE_INDEX,
+                        {"index": index, "body": {}}, timeout=30.0)
+                except IndexAlreadyExistsError:
+                    pass
         index = self._resolve_index_write(index)
+        effective_routing = routing if routing is not None else parent
+        self._required_routing_check(index, type_name, doc_id, effective_routing)
+        if isinstance(fields, str):
+            fields = [f.strip() for f in fields.split(",")]
         attempts = retry_on_conflict + 1
         last_error = None
         for _ in range(attempts):
             try:
-                current = self.get_doc(index, type_name, doc_id, routing=routing)
+                current = self.get_doc(index, type_name, doc_id,
+                                       routing=effective_routing)
+                noop = False
                 if not current["found"]:
+                    if version is not None:
+                        raise DocumentMissingError(
+                            f"[{index}][{type_name}][{doc_id}] missing")
                     if "upsert" in body:
-                        return self.index_doc(index, type_name, doc_id, body["upsert"],
-                                              routing=routing, op_type="create")
-                    raise DocumentMissingError(f"[{index}][{type_name}][{doc_id}] missing")
-                source = dict(current["_source"])
-                if "script" in body:
-                    from .script import compile_script
+                        source = body["upsert"]
+                    elif body.get("doc_as_upsert") and "doc" in body:
+                        source = body["doc"]
+                    else:
+                        raise DocumentMissingError(
+                            f"[{index}][{type_name}][{doc_id}] missing")
+                    r = self.index_doc(index, type_name, doc_id, source,
+                                       routing=routing, parent=parent,
+                                       op_type="create", refresh=refresh,
+                                       ttl=ttl, timestamp=timestamp)
+                else:
+                    source = dict(current["_source"])
+                    op = "index"
+                    if "script" in body:
+                        from .script import compile_update_script
 
-                    class _Ctx:
-                        pass
-
-                    cs = compile_script(body["script"], body.get("params", {}))
-                    # scripts mutate `ctx.source` — expression-only language, so we
-                    # expose merge semantics: result dict replaces source
-                    result = cs(_SourceDoc(source), _score=0.0, ctx={"_source": source})
-                    if isinstance(result, dict):
-                        source = result
-                elif "doc" in body:
-                    _deep_merge(source, body["doc"])
-                return self.index_doc(index, type_name, doc_id, source, routing=routing,
-                                      version=current["_version"])
+                        us = compile_update_script(body["script"],
+                                                   body.get("params", {}),
+                                                   lang=body.get("lang"))
+                        ctx = {"_source": source, "op": "index",
+                               "_index": index, "_type": type_name, "_id": doc_id,
+                               "_version": current.get("_version"),
+                               "_routing": current.get("_routing"),
+                               "_parent": current.get("_parent"),
+                               "_ttl": ttl, "_timestamp": timestamp}
+                        us.run(ctx)
+                        source = ctx.get("_source", source)
+                        op = ctx.get("op", "index")
+                        if ctx.get("_ttl") is not None:
+                            ttl = ctx["_ttl"]
+                        if ctx.get("_timestamp") is not None:
+                            timestamp = ctx["_timestamp"]
+                    elif "doc" in body:
+                        _deep_merge(source, body["doc"])
+                    if op == "delete":
+                        r = self.delete_doc(index, type_name, doc_id, routing=routing,
+                                            parent=parent, refresh=refresh)
+                        r.pop("found", None)
+                    elif op == "none":
+                        noop = True
+                        r = {"_index": index, "_type": type_name, "_id": doc_id,
+                             "_version": current["_version"]}
+                    else:
+                        r = self.index_doc(index, type_name, doc_id, source,
+                                           routing=routing, parent=parent,
+                                           version=version if version is not None
+                                           else current["_version"],
+                                           version_type=version_type,
+                                           refresh=refresh, ttl=ttl,
+                                           timestamp=timestamp)
+                out = {"_index": index, "_type": type_name, "_id": doc_id,
+                       "_version": r.get("_version", current.get("_version", 1))}
+                if fields:
+                    # build the get section from the state in hand — no extra
+                    # round-trip, and consistent with the _version we report
+                    pseudo = {"found": True, "_source": source,
+                              "_version": out["_version"]}
+                    if effective_routing is not None:
+                        pseudo["_routing"] = str(effective_routing)
+                    if parent is not None:
+                        pseudo["_parent"] = str(parent)
+                    fdict, src = _extract_fields(pseudo, fields)
+                    get_section = {"found": True}
+                    if src is not None:
+                        get_section["_source"] = src
+                    if fdict:
+                        get_section["fields"] = fdict
+                    out["get"] = get_section
+                if noop:
+                    out["noop"] = True
+                return out
             except VersionConflictError as e:
                 last_error = e
         raise last_error
@@ -635,6 +830,8 @@ class ActionModule:
             routing=request.get("routing"), version=request.get("version"),
             version_type=request.get("version_type", "internal"),
             op_type=request.get("op_type", "index"),
+            parent=request.get("parent"), timestamp=request.get("timestamp"),
+            ttl=request.get("ttl"),
         )
         if set(mapper.fields) - known_before:
             # dynamic mapping grew: propagate to master → cluster state
@@ -836,11 +1033,13 @@ class ActionModule:
 
     # ================= single-shard reads =================
     def get_doc(self, index: str, type_name: str, doc_id: str, routing=None,
-                realtime=True, preference=None) -> dict:
+                realtime=True, preference=None, parent=None) -> dict:
         state = self.cluster_service.state
         state.blocks.check("read", index)
         index = state.metadata.resolve_indices(index)[0]
-        copy = self.routing.get_shard_copy(state, index, doc_id, routing, preference)
+        effective_routing = routing if routing is not None else parent
+        copy = self.routing.get_shard_copy(state, index, doc_id, effective_routing,
+                                           preference)
         node = state.nodes.get(copy.node_id)
         return self.transport.submit_request(node, A_GET, {
             "index": index, "shard": copy.shard_id, "type": type_name, "id": doc_id,
@@ -848,13 +1047,35 @@ class ActionModule:
 
     def _s_get(self, request, channel):
         shard = self.indices.index_service(request["index"]).shard(request["shard"])
-        r = shard.engine.get(request["type"], request["id"],
-                             realtime=request.get("realtime", True))
-        out = {"_index": request["index"], "_type": request["type"],
+        type_name = request["type"] or "_all"
+        if type_name == "_all":
+            # resolve the uid across types (ref: _all type get)
+            r = None
+            for t in list(shard.engine.mapper_service.types()) or []:
+                r = shard.engine.get(t, request["id"],
+                                     realtime=request.get("realtime", True))
+                if r.found:
+                    type_name = t
+                    break
+            if r is None or not r.found:
+                return {"_index": request["index"], "_type": request["type"],
+                        "_id": request["id"], "found": False}
+        else:
+            r = shard.engine.get(type_name, request["id"],
+                                 realtime=request.get("realtime", True))
+        out = {"_index": request["index"], "_type": type_name,
                "_id": request["id"], "found": r.found}
         if r.found:
             out["_version"] = r.version
             out["_source"] = r.source
+            if r.routing is not None:
+                out["_routing"] = str(r.routing)
+            if r.parent is not None:
+                out["_parent"] = str(r.parent)
+            if r.timestamp is not None:
+                out["_timestamp"] = int(r.timestamp)
+            if r.ttl is not None:
+                out["_ttl"] = int(r.ttl)
         return out
 
     def term_vector(self, index: str, type_name: str, doc_id: str, routing=None,
@@ -962,14 +1183,65 @@ class ActionModule:
         return self.search(index, body)
 
     def multi_get(self, docs: list[dict]) -> dict:
+        """ref: TransportMultiGetAction — request-level validation, then per-doc
+        gets; a missing index yields found:false for that doc, not an error."""
+        from .common.errors import ActionRequestValidationError
+
+        if not docs:
+            raise ActionRequestValidationError("Validation Failed: no documents to get")
+        for i, d in enumerate(docs):
+            if not d.get("_id"):
+                raise ActionRequestValidationError(
+                    f"Validation Failed: {i + 1}: id is missing")
+            if not d.get("_index"):
+                raise ActionRequestValidationError(
+                    f"Validation Failed: {i + 1}: index is missing")
         out = []
         for d in docs:
+            type_name = d.get("_type") or "_all"
             try:
-                out.append(self.get_doc(d["_index"], d.get("_type", "_all"), d["_id"],
-                                        routing=d.get("routing")))
+                r = self.get_doc(d["_index"], type_name, str(d["_id"]),
+                                 routing=d.get("routing") or d.get("_routing"),
+                                 parent=d.get("parent") or d.get("_parent"))
+                if d.get("_type") and r.get("_type") != d["_type"]:
+                    # requested type doesn't hold this id
+                    r = {"_index": d["_index"], "_type": d["_type"],
+                         "_id": str(d["_id"]), "found": False}
+                fields = d.get("fields") or d.get("_fields")
+                src_spec = d.get("_source")
+                if r.get("found") and (fields or src_spec is not None):
+                    shaped = {k: v for k, v in r.items() if k != "_source"}
+                    src = r.get("_source")
+                    keep_source = True
+                    if fields:
+                        fdict, fsrc = _extract_fields(r, fields)
+                        if fdict:
+                            shaped["fields"] = fdict
+                        keep_source = fsrc is not None
+                    if src_spec is not None:
+                        if src_spec is False or src_spec == "false":
+                            keep_source = False
+                        elif src_spec is True or src_spec == "true":
+                            keep_source = True
+                        elif isinstance(src_spec, (str, list)):
+                            src = filter_source(src, src_spec, None)
+                            keep_source = True
+                        elif isinstance(src_spec, dict):
+                            src = filter_source(
+                                src, src_spec.get("include") or
+                                src_spec.get("includes"),
+                                src_spec.get("exclude") or src_spec.get("excludes"))
+                            keep_source = True
+                    if keep_source and src is not None:
+                        shaped["_source"] = src
+                    r = shaped
+                out.append(r)
+            except IndexMissingError:
+                out.append({"_index": d["_index"], "_type": d.get("_type"),
+                            "_id": str(d["_id"]), "found": False})
             except SearchEngineError as e:
-                out.append({"_index": d.get("_index"), "_id": d.get("_id"),
-                            "error": e.to_dict()})
+                out.append({"_index": d.get("_index"), "_type": d.get("_type"),
+                            "_id": str(d.get("_id")), "error": e.to_dict()})
         return {"docs": out}
 
     # ================= scatter-gather search =================
@@ -1266,6 +1538,90 @@ def _flatten_text_fields(source: dict, prefix: str = "") -> dict[str, list]:
         elif isinstance(value, str):
             out.setdefault(path, []).append(value)
     return out
+
+
+def _extract_fields(get_response: dict, fields) -> tuple[dict, dict | None]:
+    """Build the `fields` section of a get/update response: meta fields as scalars,
+    source leaves as single-element lists (ref: GetResult field rendering)."""
+    if isinstance(fields, str):
+        fields = [f.strip() for f in fields.split(",")]
+    out: dict = {}
+    source_out = None
+    src = get_response.get("_source") or {}
+    for f in fields or []:
+        if f == "_source":
+            source_out = src
+        elif f in ("_routing", "_parent"):
+            v = get_response.get(f)
+            if v is not None:
+                out[f] = str(v)
+        elif f in ("_timestamp", "_ttl"):
+            v = get_response.get(f)
+            if v is not None:
+                out[f] = int(v)
+        else:
+            vals = _source_leaf(src, f)
+            if vals:
+                out[f] = vals
+    return out, source_out
+
+
+def _source_leaf(src: dict, path: str) -> list:
+    cur = src
+    for part in path.split("."):
+        if isinstance(cur, dict) and part in cur:
+            cur = cur[part]
+        else:
+            return []
+    return cur if isinstance(cur, list) else [cur]
+
+
+def filter_source(src: dict, includes, excludes) -> dict:
+    """_source filtering with wildcard paths (ref: common/xcontent XContentMapValues
+    .filter — include/exclude globs over the source tree). An include naming an
+    object node keeps its whole subtree; an include naming a deeper path descends."""
+    import fnmatch
+
+    def norm(spec):
+        if spec is None:
+            return []
+        if isinstance(spec, str):
+            return [s.strip() for s in spec.split(",") if s.strip()]
+        return [str(s) for s in spec]
+
+    includes, excludes = norm(includes), norm(excludes)
+
+    def matches(path, pattern):
+        return fnmatch.fnmatch(path, pattern)
+
+    def is_ancestor(path, pattern):
+        """`path` is a strict ancestor of a path the pattern could match."""
+        pa, pp = path.split("."), pattern.split(".")
+        if len(pa) >= len(pp):
+            return False
+        return all(fnmatch.fnmatch(a, b) for a, b in zip(pa, pp))
+
+    def walk(obj, prefix, included):
+        out = {}
+        for k, v in obj.items():
+            path = f"{prefix}{k}"
+            if excludes and any(matches(path, p) for p in excludes):
+                continue
+            hit = included or not includes or any(matches(path, p)
+                                                 for p in includes)
+            if isinstance(v, dict):
+                if hit:
+                    sub = walk(v, path + ".", included=True)
+                    out[k] = sub
+                elif any(is_ancestor(path, p) for p in includes):
+                    sub = walk(v, path + ".", included=False)
+                    if sub:
+                        out[k] = sub
+            elif hit:
+                out[k] = v
+        return out
+
+    return walk(src, "", included=False)
 
 
 def _deep_merge(dst: dict, src: dict):

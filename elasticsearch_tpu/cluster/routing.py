@@ -36,7 +36,7 @@ class OperationRouting:
     def shard_id(state: ClusterState, index: str, doc_id: str,
                  routing: str | None = None) -> int:
         meta = state.metadata.require_index(index)
-        h = djb2_hash(routing if routing is not None else doc_id)
+        h = djb2_hash(str(routing) if routing is not None else str(doc_id))
         return abs(h) % meta.number_of_shards
 
     def index_shard(self, state: ClusterState, index: str, doc_id: str,

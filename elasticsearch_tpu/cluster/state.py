@@ -269,6 +269,10 @@ class IndexMetaData:
         return replace(self, mappings=others + ((type_name, json.dumps(mapping)),),
                        version=self.version + 1)
 
+    def without_mapping(self, type_name: str) -> "IndexMetaData":
+        others = tuple((t, m) for t, m in self.mappings if t != type_name)
+        return replace(self, mappings=others, version=self.version + 1)
+
     def with_settings(self, settings: dict) -> "IndexMetaData":
         merged = dict(self.settings_map)
         merged.update({k: v for k, v in settings.items()})
@@ -488,6 +492,10 @@ class ClusterBlocks:
 
     def without_index(self, index: str) -> "ClusterBlocks":
         return replace(self, index_blocks=tuple(e for e in self.index_blocks if e[0] != index))
+
+    def without_index_block(self, index: str, block) -> "ClusterBlocks":
+        return replace(self, index_blocks=tuple(
+            e for e in self.index_blocks if e != (index, block)))
 
     def to_dict(self) -> dict:
         return {"global": [list(b) for b in self.global_blocks],

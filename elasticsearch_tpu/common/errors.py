@@ -19,8 +19,14 @@ class SearchEngineError(Exception):
         self.message = message
         self.cause = cause
 
+    def wire_name(self) -> str:
+        """Error type as exposed on the API — the reference publishes *Exception names
+        (e.g. RoutingMissingException) and clients/tests match on them."""
+        name = type(self).__name__
+        return name[:-len("Error")] + "Exception" if name.endswith("Error") else name
+
     def to_dict(self) -> dict:
-        d = {"type": type(self).__name__, "reason": self.message}
+        d = {"type": self.wire_name(), "reason": self.message}
         if self.cause is not None:
             d["caused_by"] = {"type": type(self.cause).__name__, "reason": str(self.cause)}
         return d
@@ -124,13 +130,19 @@ class MasterNotDiscoveredError(SearchEngineError):
 
 
 class ClusterBlockError(SearchEngineError):
-    """Operation rejected by a cluster-level block (ref: cluster/block/ClusterBlockException.java)."""
+    """Operation rejected by a cluster-level block (ref: cluster/block/ClusterBlockException.java).
 
-    status = 503
+    Status follows the reference: retryable blocks (no master / state not recovered)
+    → 503, non-retryable blocks (index closed / read-only) → 403 FORBIDDEN."""
+
+    RETRYABLE = {"no_master", "state_not_recovered"}
 
     def __init__(self, blocks):
         super().__init__(f"blocked by: {[str(b) for b in blocks]}")
         self.blocks = blocks
+        self.status = 503 if all(
+            (b[0] if isinstance(b, tuple) else str(b)) in self.RETRYABLE
+            for b in blocks) else 403
 
 
 class NoShardAvailableError(SearchEngineError):
@@ -185,6 +197,38 @@ class RepositoryMissingError(RepositoryError):
 
 class InvalidAliasNameError(IllegalArgumentError):
     pass
+
+
+class AliasesMissingError(SearchEngineError):
+    status = 404
+
+    def __init__(self, aliases):
+        super().__init__(f"aliases {list(aliases)} missing")
+        self.aliases = list(aliases)
+
+
+class IndexTemplateMissingError(SearchEngineError):
+    status = 404
+
+    def __init__(self, name):
+        super().__init__(f"index_template [{name}] missing")
+
+
+class IndexWarmerMissingError(SearchEngineError):
+    status = 404
+
+    def __init__(self, name):
+        super().__init__(f"index_warmer [{name}] missing")
+
+
+class ActionRequestValidationError(IllegalArgumentError):
+    """Request failed client-side validation (ref: action/ActionRequestValidationException)."""
+
+
+class AlreadyExpiredError(SearchEngineError):
+    """Doc with _ttl already expired at index time (ref: index/AlreadyExpiredException)."""
+
+    status = 400
 
 
 class InvalidIndexNameError(IllegalArgumentError):

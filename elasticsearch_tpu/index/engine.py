@@ -50,6 +50,9 @@ class VersionEntry:
     # retained source for realtime get of unrefreshed docs
     source: dict | None = None
     routing: str | None = None
+    parent: str | None = None
+    timestamp: int | None = None
+    ttl: int | None = None
 
 
 @dataclass
@@ -60,6 +63,9 @@ class GetResult:
     version: int = 0
     source: dict | None = None
     routing: str | None = None
+    parent: str | None = None
+    timestamp: int | None = None
+    ttl: int | None = None  # remaining ms at read time (ref: TTL decrements)
 
 
 class Searcher:
@@ -204,7 +210,8 @@ class Engine:
             local = self._buffer.add(parsed, version=new_version)
             self._version_map[uid] = VersionEntry(
                 version=new_version, deleted=False, location=("buffer", local),
-                source=source, routing=parsed.routing,
+                source=source, routing=parsed.routing, parent=parsed.parent,
+                timestamp=parsed.timestamp, ttl=parsed.ttl,
             )
             self.stats["index_total"] += 1
             self.stats["index_time_ms"] += (time.monotonic() - t0) * 1000
@@ -266,15 +273,35 @@ class Engine:
                     return GetResult(found=False)
                 if realtime and entry.source is not None:
                     return GetResult(True, doc_id, type_name, entry.version,
-                                     entry.source, entry.routing)
+                                     entry.source, entry.routing, entry.parent,
+                                     entry.timestamp,
+                                     self._remaining_ttl(entry.timestamp, entry.ttl))
             loc = self._uid_index.get(uid)
             if loc is None:
                 return GetResult(found=False)
             seg = self._seg_by_gen(loc[0])
             if seg is None or not seg.live[loc[1]]:
                 return GetResult(found=False)
-            return GetResult(True, doc_id, type_name, int(seg.versions[loc[1]]),
-                             seg.stored[loc[1]], seg.routings[loc[1]])
+            local = loc[1]
+            parent_vals = seg.str_values("_parent", local) or []
+            ts_vals = seg.num_values("_timestamp", local) or []
+            exp_vals = seg.num_values("_expiry", local) or []
+            ts = int(ts_vals[0]) if ts_vals else None
+            ttl = None
+            if exp_vals:
+                base = ts if ts is not None else 0
+                ttl = self._remaining_ttl(base, int(exp_vals[0]) - base)
+            return GetResult(True, doc_id, type_name, int(seg.versions[local]),
+                             seg.stored[local], seg.routings[local],
+                             parent_vals[0] if parent_vals else None, ts, ttl)
+
+    @staticmethod
+    def _remaining_ttl(timestamp, ttl):
+        """Stored TTL → remaining-at-read-time (ref: TTLFieldMapper value semantics)."""
+        if ttl is None:
+            return None
+        base = timestamp if timestamp is not None else int(time.time() * 1000)
+        return max(0, (base + ttl) - int(time.time() * 1000))
 
     # ------------------------------------------------------------------ nrt
     def refresh(self) -> bool:

@@ -183,6 +183,12 @@ class IndicesService:
                 svc = self.indices.pop(name)
                 for sid in list(svc.shards):
                     svc.remove_shard(sid)
+                # index deleted from metadata → wipe its on-disk data, else a
+                # recreated index with the same name would recover stale segments
+                # (ref: IndicesClusterStateService deleteIndex vs removeIndex)
+                import shutil
+
+                shutil.rmtree(os.path.join(svc.data_path, name), ignore_errors=True)
                 self.logger.info("removed index [%s]", name)
         # 2. per assigned shard on this node: create + recover
         my_shards: dict[tuple, ShardRouting] = {}

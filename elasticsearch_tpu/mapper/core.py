@@ -384,6 +384,13 @@ class DocumentMapper:
                 doc.ttl = int(parse_time(raw_ttl) * 1000) if isinstance(raw_ttl, str) else int(raw_ttl)
         if self.routing_path and routing is None and self.routing_path in source:
             doc.routing = str(source[self.routing_path])
+        if parent is not None:
+            # child doc: store the parent pointer for join queries and route by it
+            doc.parent = str(parent)
+            doc.doc_values_str["_parent"] = [doc.parent]
+            doc.postings["_parent"] = [(f"{self.parent_type or 'doc'}#{doc.parent}", 0)]
+            if doc.routing is None:
+                doc.routing = doc.parent
         if doc.ttl is not None:
             base_ts = doc.timestamp if doc.timestamp is not None else int(
                 __import__("time").time() * 1000)

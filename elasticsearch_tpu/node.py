@@ -26,6 +26,8 @@ from .cluster.service import ClusterService
 from .cluster.state import BLOCK_STATE_NOT_RECOVERED, DiscoveryNode
 from .common.errors import SearchEngineError
 from .common.logging import get_logger
+from .common.names import is_pattern as _is_pattern
+from .common.names import name_matches as _name_matches
 from .common.settings import Settings, prepare_settings
 from .discovery.zen import ZenDiscovery
 from .gateway import LocalGateway
@@ -244,28 +246,39 @@ class Client:
 
     # --- document APIs ------------------------------------------------------
     def index(self, index, doc_type, body, id=None, routing=None, version=None,
-              version_type="internal", op_type="index", refresh=False):
+              version_type="internal", op_type="index", refresh=False,
+              parent=None, timestamp=None, ttl=None):
         return self.actions.index_doc(index, doc_type, id, body, routing=routing,
                                       version=version, version_type=version_type,
-                                      op_type=op_type, refresh=refresh)
+                                      op_type=op_type, refresh=refresh,
+                                      parent=parent, timestamp=timestamp, ttl=ttl)
 
     def create(self, index, doc_type, body, id=None, **kw):
         return self.index(index, doc_type, body, id=id, op_type="create", **kw)
 
-    def get(self, index, doc_type, id, routing=None, realtime=True, preference=None):
+    def get(self, index, doc_type, id, routing=None, realtime=True, preference=None,
+            parent=None):
         return self.actions.get_doc(index, doc_type, id, routing=routing,
-                                    realtime=realtime, preference=preference)
+                                    realtime=realtime, preference=preference,
+                                    parent=parent)
 
     def mget(self, docs):
         return self.actions.multi_get(docs)
 
-    def delete(self, index, doc_type, id, routing=None, version=None, refresh=False):
+    def delete(self, index, doc_type, id, routing=None, version=None, refresh=False,
+               parent=None):
         return self.actions.delete_doc(index, doc_type, id, routing=routing,
-                                       version=version, refresh=refresh)
+                                       version=version, refresh=refresh,
+                                       parent=parent)
 
-    def update(self, index, doc_type, id, body, routing=None, retry_on_conflict=0):
+    def update(self, index, doc_type, id, body, routing=None, retry_on_conflict=0,
+               parent=None, refresh=False, fields=None, ttl=None, timestamp=None,
+               version=None, version_type="internal"):
         return self.actions.update_doc(index, doc_type, id, body, routing=routing,
-                                       retry_on_conflict=retry_on_conflict)
+                                       retry_on_conflict=retry_on_conflict,
+                                       parent=parent, refresh=refresh, fields=fields,
+                                       ttl=ttl, timestamp=timestamp, version=version,
+                                       version_type=version_type)
 
     def bulk(self, operations, refresh=False):
         return self.actions.bulk(operations, refresh=refresh)
@@ -340,7 +353,11 @@ class Client:
 
     def put_mapping(self, index, doc_type, body):
         return self._local(A("indices:admin/mapping/put"),
-                           {"index": index, "type": doc_type, "body": body})
+                           {"index": index or "_all", "type": doc_type, "body": body})
+
+    def delete_mapping(self, index, doc_type):
+        return self._local(A("indices:admin/mapping/delete"),
+                           {"index": index or "_all", "type": doc_type})
 
     def get_mapping(self, index=None, doc_type=None):
         state = self.node.cluster_service.state
@@ -349,30 +366,96 @@ class Client:
             meta = state.metadata.index(name)
             mappings = meta.mappings_dict()
             if doc_type:
-                mappings = {t: m for t, m in mappings.items() if t == doc_type}
+                mappings = {t: m for t, m in mappings.items()
+                            if _name_matches(t, doc_type)}
+                if not mappings:
+                    continue
+            # an index with no mappings is omitted when listing across indices
+            # (ref: get-mapping omits empty indices)
+            if not mappings and (index is None or _is_pattern(index)):
+                continue
             out[name] = {"mappings": mappings}
+        if doc_type and not out:
+            from .common.errors import TypeMissingError
+
+            raise TypeMissingError(f"type[[{doc_type}]] missing")
         return out
+
+    def get_field_mapping(self, index=None, doc_type=None, field=None,
+                          include_defaults=False):
+        """ref: action/admin/indices/mapping/get/TransportGetFieldMappingsAction —
+        per-index, per-type, per-field slice of the mapping."""
+        state = self.node.cluster_service.state
+        out = {}
+        for name in state.metadata.resolve_indices(index or "_all"):
+            meta = state.metadata.index(name)
+            for t, mapping in meta.mappings_dict().items():
+                if doc_type and not _name_matches(t, doc_type):
+                    continue
+                props = _flatten_properties(mapping.get("properties") or {})
+                for fname, fdef in props.items():
+                    if field and not _name_matches(fname, field):
+                        continue
+                    leaf = fname.rsplit(".", 1)[-1]
+                    fdef = dict(fdef)
+                    if include_defaults:
+                        fdef.setdefault("type", "string")
+                        fdef.setdefault("index", "analyzed")
+                    out.setdefault(name, {"mappings": {}})["mappings"] \
+                        .setdefault(t, {})[fname] = {
+                        "full_name": fname, "mapping": {leaf: fdef}}
+        return out
+
+    def exists_type(self, index, doc_type) -> bool:
+        """True only if every resolved index has the type (ref: TransportTypesExistsAction)."""
+        state = self.node.cluster_service.state
+        try:
+            names = state.metadata.resolve_indices(index or "_all")
+        except SearchEngineError:
+            return False
+        if not names:
+            return False
+        return all(
+            any(_name_matches(t, doc_type)
+                for t in state.metadata.index(n).mappings_dict())
+            for n in names)
 
     def update_settings(self, index, body):
         return self._local(A("indices:admin/settings/update"),
-                           {"index": index, "body": body})
+                           {"index": index or "_all", "body": body})
 
-    def get_settings(self, index=None):
+    def get_settings(self, index=None, name=None):
         state = self.node.cluster_service.state
-        return {
-            name: {"settings": state.metadata.index(name).settings.as_structured()}
-            for name in state.metadata.resolve_indices(index or "_all")
-        }
+        out = {}
+        for idx in state.metadata.resolve_indices(index or "_all"):
+            flat = {k: _settings_str(v)
+                    for k, v in state.metadata.index(idx).settings.as_dict().items()}
+            if name:
+                flat = {k: v for k, v in flat.items() if _name_matches(k, name)}
+            if flat:
+                out[idx] = {"settings": _nest_keys(flat)}
+        return out
 
     def update_aliases(self, body):
         return self._local(A("indices:admin/aliases"), {"body": body})
 
-    def get_aliases(self, index=None):
+    def get_aliases(self, index=None, name=None):
         state = self.node.cluster_service.state
-        return {
-            name: {"aliases": state.metadata.index(name).aliases_dict()}
-            for name in state.metadata.resolve_indices(index or "_all")
-        }
+        out = {}
+        for idx in state.metadata.resolve_indices(index or "_all"):
+            aliases = state.metadata.index(idx).aliases_dict()
+            if name is not None:
+                aliases = {a: s for a, s in aliases.items() if _name_matches(a, name)}
+                if not aliases:
+                    continue
+            out[idx] = {"aliases": aliases}
+        return out
+
+    def exists_alias(self, index=None, name=None) -> bool:
+        try:
+            return bool(self.get_aliases(index, name))
+        except SearchEngineError:
+            return False
 
     def put_template(self, name, body):
         return self._local(A("indices:admin/template/put"), {"name": name, "body": body})
@@ -384,8 +467,12 @@ class Client:
         state = self.node.cluster_service.state
         out = {}
         for n, t in state.metadata.templates:
-            if name is None or n == name:
+            if name is None or _name_matches(n, name):
                 out[n] = t.to_dict()
+        if name is not None and not out and not _is_pattern(name):
+            from .common.errors import IndexTemplateMissingError
+
+            raise IndexTemplateMissingError(name)
         return out
 
     def refresh(self, index=None):
@@ -408,6 +495,33 @@ class Client:
 
     def stats(self, index=None):
         return self.node.indices.stats()
+
+    def indices_status(self, index=None):
+        """Legacy _status API (ref: action/admin/indices/status) — per-shard view."""
+        state = self.node.cluster_service.state
+        names = state.metadata.resolve_indices(index or "_all")
+        stats = self.node.indices.stats()
+        total = ok = 0
+        indices = {}
+        for name in names:
+            table = state.routing_table.index(name)
+            shards = {}
+            if table is not None:
+                for grp in table.shards:
+                    total += len(grp.shards)
+                    ok += sum(1 for s in grp.shards if s.active)
+            st = stats.get(name)
+            indices[name] = {"index": {"primary_size_in_bytes": 0},
+                             "shards": (st or {}).get("shards", shards)}
+        return {"_shards": {"total": total, "successful": ok, "failed": 0},
+                "indices": indices}
+
+    def gateway_snapshot(self, index=None):
+        """Legacy _gateway/snapshot (ref: indices.snapshot_index spec) — force-persist
+        local gateway state + flush, the durability checkpoint."""
+        self.flush(index)
+        self.node.gateway.persist_now()
+        return {"_shards": {"total": 0, "successful": 0, "failed": 0}}
 
     # --- cluster admin ------------------------------------------------------
     def cluster_health(self, index=None, wait_for_status=None, timeout=10.0):
@@ -450,14 +564,54 @@ class Client:
             "unassigned_shards": unassigned,
         }
 
-    def cluster_state(self):
-        return self.node.cluster_service.state.to_dict()
+    def cluster_state(self, metric=None, index=None):
+        """ref: cluster.state spec — optional metric list filters the response parts."""
+        state = self.node.cluster_service.state
+        full = state.to_dict()
+        full["master_node"] = state.nodes.master_id
+        full["cluster_name"] = state.cluster_name
+        # REST view of blocks: only non-empty sections (the YAML suite length-checks it)
+        blocks = {}
+        if state.blocks.global_blocks:
+            blocks["global"] = {b[0]: {"description": b[0], "levels": [b[1]]}
+                                for b in state.blocks.global_blocks}
+        idx_blocks = {}
+        for i, b in state.blocks.index_blocks:
+            idx_blocks.setdefault(i, {})[b[0]] = {"description": b[0], "levels": [b[1]]}
+        if idx_blocks:
+            blocks["indices"] = idx_blocks
+        full["blocks"] = blocks
+        metrics = None
+        if metric and metric not in ("_all",):
+            metrics = set(str(metric).split(","))
+        if metrics is None:
+            return full
+        out = {"cluster_name": state.cluster_name}
+        for m in metrics:
+            if m == "master_node":
+                out["master_node"] = full["master_node"]
+            elif m == "version":
+                out["version"] = full["version"]
+            elif m in full:
+                out[m] = full[m]
+        if index and "metadata" in out:
+            names = set(state.metadata.resolve_indices(index))
+            md = dict(out["metadata"])
+            md["indices"] = {n: v for n, v in md.get("indices", {}).items()
+                             if n in names}
+            out["metadata"] = md
+        return out
 
     def cluster_reroute(self, body=None):
         return self._local(A("cluster:admin/reroute"), {"body": body or {}})
 
     def cluster_update_settings(self, body):
-        return self._local(A("cluster:admin/settings/update"), {"body": body})
+        r = self._local(A("cluster:admin/settings/update"), {"body": body})
+        # echo applied settings with string values, as the reference serializes them
+        for section in ("persistent", "transient"):
+            if isinstance(r, dict) and section in r:
+                r[section] = {k: _settings_str(v) for k, v in r[section].items()}
+        return r
 
     def pending_tasks(self):
         return {"tasks": self.node.cluster_service.pending_tasks()}
@@ -491,20 +645,30 @@ class Client:
         return self.node.percolator.multi_percolate(requests)
 
     # --- warmers ------------------------------------------------------------
-    def put_warmer(self, index, name, body):
+    def put_warmer(self, index, name, body, doc_type=None):
+        if doc_type:
+            body = dict(body or {})
+            body["types"] = [t for t in str(doc_type).split(",") if t]
         return self._local("indices:admin/warmers/put",
-                           {"index": index, "name": name, "body": body})
+                           {"index": index or "_all", "name": name, "body": body})
 
     def delete_warmer(self, index, name):
         return self._local("indices:admin/warmers/delete",
-                           {"index": index, "name": name})
+                           {"index": index or "_all", "name": name})
 
-    def get_warmer(self, index=None):
+    def get_warmer(self, index=None, name=None):
         state = self.node.cluster_service.state
-        return {
-            name: {"warmers": state.metadata.index(name).warmers_dict()}
-            for name in state.metadata.resolve_indices(index or "_all")
-        }
+        out = {}
+        for idx in state.metadata.resolve_indices(index or "_all"):
+            warmers = state.metadata.index(idx).warmers_dict()
+            if name is not None:
+                warmers = {w: s for w, s in warmers.items() if _name_matches(w, name)}
+                if not warmers:
+                    continue
+            if not warmers and (index is None or _is_pattern(index)):
+                continue
+            out[idx] = {"warmers": warmers}
+        return out
 
     # --- snapshots ----------------------------------------------------------
     def put_repository(self, name, body):
@@ -542,6 +706,46 @@ class Client:
 
 def A(name: str) -> str:
     return name
+
+
+
+
+def _settings_str(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
+def _nest_keys(flat: dict) -> dict:
+    """{"index.number_of_shards": "5"} → {"index": {"number_of_shards": "5"}}."""
+    out: dict = {}
+    for k, v in flat.items():
+        parts = k.split(".")
+        cur = out
+        for p in parts[:-1]:
+            nxt = cur.get(p)
+            if not isinstance(nxt, dict):
+                nxt = {}
+                cur[p] = nxt
+            cur = nxt
+        cur[parts[-1]] = v
+    return out
+
+
+def _flatten_properties(props: dict, prefix: str = "") -> dict:
+    """Mapping properties tree → {"a.b": leaf_def} (multi-fields included)."""
+    out = {}
+    for name, fdef in (props or {}).items():
+        full = f"{prefix}{name}"
+        if isinstance(fdef, dict) and isinstance(fdef.get("properties"), dict) and \
+                fdef.get("type", "object") in ("object", "nested"):
+            out.update(_flatten_properties(fdef["properties"], full + "."))
+        else:
+            out[full] = fdef if isinstance(fdef, dict) else {}
+            if isinstance(fdef, dict) and isinstance(fdef.get("fields"), dict):
+                for sub, sdef in fdef["fields"].items():
+                    out[f"{full}.{sub}"] = sdef
+    return out
 
 
 def _status_at_least(status: str, wanted: str) -> bool:

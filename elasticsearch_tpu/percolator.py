@@ -115,27 +115,74 @@ class PercolatorService:
     def unregister_query(self, index: str, query_id: str):
         self.registry(index).unregister(query_id)
 
-    def percolate(self, index: str, body: dict) -> dict:
-        doc = body.get("doc")
-        if doc is None:
-            raise PercolateError("percolate request requires [doc]")
-        svc = self.node.indices.index_service(index)
-        reg = self.registry(index)
-        matches = reg.percolate(doc, svc.mapper_service)
+    def percolate(self, index: str, body: dict | None, doc_type: str = "doc",
+                  doc_id=None, version=None, percolate_index=None,
+                  percolate_type=None) -> dict:
+        """Percolate an inline doc, or an EXISTING doc by id (optionally against a
+        different percolator index — ref: PercolatorService existing-doc path)."""
+        body = body or {}
+        if doc_id is not None:
+            from .common.errors import DocumentMissingError, VersionConflictError
+
+            g = self.node.actions.get_doc(index, doc_type or "_all", str(doc_id))
+            if not g.get("found"):
+                raise DocumentMissingError(
+                    f"[{index}][{doc_type}][{doc_id}] missing")
+            if version is not None and int(version) != int(g.get("_version", -1)):
+                raise VersionConflictError(f"{doc_type}#{doc_id}",
+                                           g.get("_version", -1), int(version))
+            doc = g.get("_source") or {}
+            target = percolate_index or index
+            target_type = percolate_type or doc_type
+        else:
+            doc = body.get("doc")
+            if doc is None:
+                raise PercolateError("percolate request requires [doc]")
+            target = index
+            target_type = doc_type
+        svc = self.node.indices.index_service(target)
+        reg = self.registry(target)
+        matches = reg.percolate(doc, svc.mapper_service, type_name=target_type or "doc")
         return {
             "total": len(matches),
-            "matches": [{"_index": index, "_id": qid} for qid in matches],
+            "_shards": {"total": 1, "successful": 1, "failed": 0},
+            "matches": [{"_index": target, "_id": qid} for qid in matches],
         }
 
-    def count_percolate(self, index: str, body: dict) -> dict:
-        r = self.percolate(index, body)
-        return {"total": r["total"]}
+    def count_percolate(self, index: str, body: dict | None, doc_type: str = "doc",
+                        doc_id=None) -> dict:
+        r = self.percolate(index, body, doc_type=doc_type, doc_id=doc_id)
+        return {"total": r["total"], "_shards": r["_shards"]}
 
-    def multi_percolate(self, requests: list[tuple[dict, dict]]) -> dict:
+    def multi_percolate(self, requests: list[tuple[dict, dict]],
+                        default_index=None, default_type=None) -> dict:
+        """ndjson multi-percolate (ref: TransportMultiPercolateAction): header lines
+        {"percolate": {...}} / {"count": {...}} paired with doc bodies."""
         responses = []
         for header, body in requests:
+            (op, params), = header.items() if header else (("percolate", {}),)
             try:
-                responses.append(self.percolate(header["index"], body))
+                kwargs = dict(
+                    index=params.get("index", default_index),
+                    body=body,
+                    doc_type=params.get("type", default_type) or "doc",
+                    doc_id=params.get("id"),
+                    percolate_index=params.get("percolate_index"),
+                    percolate_type=params.get("percolate_type"),
+                )
+                if op == "count":
+                    kwargs.pop("percolate_index")
+                    kwargs.pop("percolate_type")
+                    responses.append(self.count_percolate(
+                        kwargs["index"], body, doc_type=kwargs["doc_type"],
+                        doc_id=kwargs["doc_id"]))
+                else:
+                    responses.append(self.percolate(**kwargs))
             except Exception as e:  # noqa: BLE001
-                responses.append({"error": str(e)})
+                from .common.errors import SearchEngineError
+
+                if isinstance(e, SearchEngineError):
+                    responses.append({"error": e.to_dict(), "status": e.status})
+                else:
+                    responses.append({"error": str(e)})
         return {"responses": responses}

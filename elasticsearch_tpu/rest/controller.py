@@ -130,6 +130,8 @@ def build_rest_controller(node) -> RestController:
             version_type=req.param("version_type", "internal"),
             op_type=req.param("op_type", "index"),
             refresh=req.bool_param("refresh"),
+            parent=req.param("parent"), timestamp=req.param("timestamp"),
+            ttl=req.param("ttl"),
         )
         return RestResponse(201 if r.get("created") else 200, r)
 
@@ -139,32 +141,80 @@ def build_rest_controller(node) -> RestController:
     def doc_create(req):
         body = _parse_body(req)
         r = client.create(req.path_params["index"], req.path_params["type"], body,
-                          id=req.path_params["id"], routing=req.param("routing"))
+                          id=req.path_params["id"], routing=req.param("routing"),
+                          parent=req.param("parent"),
+                          refresh=req.bool_param("refresh"),
+                          timestamp=req.param("timestamp"), ttl=req.param("ttl"))
         return RestResponse(201, r)
 
     rc.register("PUT,POST", "/{index}/{type}/{id}/_create", doc_create)
 
+    def _render_get(req, r):
+        from ..actions import _extract_fields, filter_source
+
+        if not r["found"]:
+            return RestResponse(404, {"_index": r.get("_index"),
+                                      "_type": r.get("_type"),
+                                      "_id": r.get("_id"), "found": False})
+        out = {k: v for k, v in r.items()
+               if k in ("_index", "_type", "_id", "_version", "found")}
+        fields = req.param("fields")
+        src_param = req.param("_source")
+        includes = req.param("_source_include")
+        excludes = req.param("_source_exclude")
+        want_source = True
+        if fields:
+            fdict, fsrc = _extract_fields(r, fields)
+            if fdict:
+                out["fields"] = fdict
+            want_source = fsrc is not None or src_param not in (None, "false")
+            if src_param is None and fsrc is None:
+                want_source = False
+        if src_param is not None and str(src_param).lower() == "false":
+            want_source = False
+        src = r.get("_source")
+        if want_source and src is not None:
+            if src_param not in (None, "true", "false", True, False) or includes \
+                    or excludes:
+                inc = includes
+                if src_param not in (None, "true", "false", True, False):
+                    inc = src_param
+                src = filter_source(src, inc, excludes)
+            out["_source"] = src
+        return RestResponse(200, out)
+
     def doc_get(req):
         r = client.get(req.path_params["index"], req.path_params["type"],
                        req.path_params["id"], routing=req.param("routing"),
+                       parent=req.param("parent"),
                        realtime=req.bool_param("realtime", True),
                        preference=req.param("preference"))
-        return RestResponse(200 if r["found"] else 404, r)
+        return _render_get(req, r)
 
     rc.register("GET,HEAD", "/{index}/{type}/{id}", doc_get)
 
     def doc_source(req):
         r = client.get(req.path_params["index"], req.path_params["type"],
-                       req.path_params["id"])
+                       req.path_params["id"], routing=req.param("routing"),
+                       parent=req.param("parent"))
         if not r["found"]:
             return RestResponse(404, {"found": False})
-        return r["_source"]
+        from ..actions import filter_source
 
-    rc.register("GET", "/{index}/{type}/{id}/_source", doc_source)
+        src = r["_source"]
+        if req.param("_source_include") or req.param("_source_exclude"):
+            src = filter_source(src, req.param("_source_include"),
+                                req.param("_source_exclude"))
+        return src
+
+    rc.register("GET,HEAD", "/{index}/{type}/{id}/_source", doc_source)
 
     def doc_delete(req):
         r = client.delete(req.path_params["index"], req.path_params["type"],
                           req.path_params["id"], routing=req.param("routing"),
+                          parent=req.param("parent"),
+                          version=int(req.param("version")) if req.param("version")
+                          else None,
                           refresh=req.bool_param("refresh"))
         return RestResponse(200 if r["found"] else 404, r)
 
@@ -175,21 +225,32 @@ def build_rest_controller(node) -> RestController:
         return client.update(req.path_params["index"], req.path_params["type"],
                              req.path_params["id"], body,
                              routing=req.param("routing"),
+                             parent=req.param("parent"),
+                             refresh=req.bool_param("refresh"),
+                             fields=req.param("fields"),
+                             ttl=req.param("ttl"),
+                             timestamp=req.param("timestamp"),
+                             version=int(req.param("version"))
+                             if req.param("version") else None,
+                             version_type=req.param("version_type", "internal"),
                              retry_on_conflict=int(req.param("retry_on_conflict", 0)))
 
     rc.register("POST", "/{index}/{type}/{id}/_update", doc_update)
 
     def mget(req):
         body = _parse_body(req)
-        docs = body.get("docs", [])
-        for d in docs:
-            d.setdefault("_index", req.path_params.get("index"))
-            d.setdefault("_type", req.path_params.get("type", "_all"))
-        if "ids" in body:
-            docs = [{"_index": req.path_params.get("index"),
-                     "_type": req.path_params.get("type", "_all"), "_id": i}
+        default_index = body.get("index") or req.path_params.get("index")
+        default_type = body.get("type") or req.path_params.get("type")
+        docs = body.get("docs")
+        if docs is None and "ids" in body:
+            docs = [{"_index": default_index, "_type": default_type, "_id": i}
                     for i in body["ids"]]
-        return client.mget(docs)
+        for d in docs or []:
+            if not d.get("_index") and default_index:
+                d["_index"] = default_index
+            if not d.get("_type") and default_type:
+                d["_type"] = default_type
+        return client.mget(docs or [])
 
     rc.register("GET,POST", "/_mget", mget)
     rc.register("GET,POST", "/{index}/_mget", mget)
@@ -396,22 +457,55 @@ def build_rest_controller(node) -> RestController:
     rc.register("POST", "/{index}/_close", lambda r: client.close_index(r.path_params["index"]))
 
     def put_mapping(req):
-        return client.put_mapping(req.path_params["index"], req.path_params["type"],
-                                  _parse_body(req))
+        return client.put_mapping(req.path_params.get("index"),
+                                  req.path_params["type"], _parse_body(req))
 
-    rc.register("PUT,POST", "/{index}/{type}/_mapping", put_mapping)
-    rc.register("PUT,POST", "/{index}/_mapping/{type}", put_mapping)
+    def delete_mapping(req):
+        return client.delete_mapping(req.path_params["index"], req.path_params["type"])
+
+    for suffix in ("_mapping", "_mappings"):
+        rc.register("PUT,POST", "/{index}/{type}/" + suffix, put_mapping)
+        rc.register("PUT,POST", "/{index}/" + suffix + "/{type}", put_mapping)
+        rc.register("PUT,POST", "/" + suffix + "/{type}", put_mapping)
+        rc.register("DELETE", "/{index}/{type}/" + suffix, delete_mapping)
+        rc.register("DELETE", "/{index}/" + suffix + "/{type}", delete_mapping)
     rc.register("GET", "/{index}/_mapping",
                 lambda r: client.get_mapping(r.path_params["index"]))
     rc.register("GET", "/{index}/{type}/_mapping",
                 lambda r: client.get_mapping(r.path_params["index"], r.path_params["type"]))
+    rc.register("GET", "/{index}/_mapping/{type}",
+                lambda r: client.get_mapping(r.path_params["index"], r.path_params["type"]))
     rc.register("GET", "/_mapping", lambda r: client.get_mapping())
+
+    def get_field_mapping(req):
+        return client.get_field_mapping(
+            req.path_params.get("index"), req.path_params.get("type"),
+            req.path_params.get("field"),
+            include_defaults=req.bool_param("include_defaults"))
+
+    rc.register("GET", "/_mapping/field/{field}", get_field_mapping)
+    rc.register("GET", "/{index}/_mapping/field/{field}", get_field_mapping)
+    rc.register("GET", "/_mapping/{type}/field/{field}", get_field_mapping)
+    rc.register("GET", "/{index}/_mapping/{type}/field/{field}", get_field_mapping)
+
+    def exists_type(req):
+        ok = client.exists_type(req.path_params["index"], req.path_params["type"])
+        return RestResponse(200 if ok else 404, "")
+
+    rc.register("HEAD", "/{index}/{type}", exists_type)
 
     rc.register("PUT", "/{index}/_settings",
                 lambda r: client.update_settings(r.path_params["index"], _parse_body(r)))
+    rc.register("PUT", "/_settings",
+                lambda r: client.update_settings(None, _parse_body(r)))
     rc.register("GET", "/{index}/_settings",
                 lambda r: client.get_settings(r.path_params["index"]))
+    rc.register("GET", "/{index}/_settings/{name}",
+                lambda r: client.get_settings(r.path_params["index"],
+                                              r.path_params["name"]))
     rc.register("GET", "/_settings", lambda r: client.get_settings())
+    rc.register("GET", "/_settings/{name}",
+                lambda r: client.get_settings(None, r.path_params["name"]))
 
     rc.register("POST", "/_aliases", lambda r: client.update_aliases(_parse_body(r)))
     rc.register("GET", "/_aliases", lambda r: client.get_aliases())
@@ -419,13 +513,32 @@ def build_rest_controller(node) -> RestController:
 
     def put_alias(req):
         return client.update_aliases({"actions": [{"add": {
-            "index": req.path_params["index"], "alias": req.path_params["name"],
-            **_parse_body(req)}}]})
+            "index": req.path_params.get("index", "_all"),
+            "alias": req.path_params["name"], **_parse_body(req)}}]})
 
-    rc.register("PUT", "/{index}/_alias/{name}", put_alias)
-    rc.register("DELETE", "/{index}/_alias/{name}", lambda r: client.update_aliases(
-        {"actions": [{"remove": {"index": r.path_params["index"],
-                                 "alias": r.path_params["name"]}}]}))
+    def get_alias(req):
+        return client.get_aliases(req.path_params.get("index"),
+                                  req.path_params.get("name"))
+
+    def exists_alias(req):
+        ok = client.exists_alias(req.path_params.get("index"),
+                                 req.path_params.get("name"))
+        return RestResponse(200 if ok else 404, "")
+
+    for suffix in ("_alias", "_aliases"):
+        rc.register("PUT,POST", "/{index}/" + suffix + "/{name}", put_alias)
+        rc.register("PUT,POST", "/" + suffix + "/{name}", put_alias)
+        rc.register("DELETE", "/{index}/" + suffix + "/{name}",
+                    lambda r: client.update_aliases({"actions": [{"remove": {
+                        "index": r.path_params["index"],
+                        "alias": r.path_params["name"]}}]}))
+    rc.register("GET", "/_alias", get_alias)
+    rc.register("GET", "/_alias/{name}", get_alias)
+    rc.register("GET", "/{index}/_alias", get_alias)
+    rc.register("GET", "/{index}/_alias/{name}", get_alias)
+    rc.register("HEAD", "/_alias/{name}", exists_alias)
+    rc.register("HEAD", "/{index}/_alias", exists_alias)
+    rc.register("HEAD", "/{index}/_alias/{name}", exists_alias)
 
     rc.register("PUT,POST", "/_template/{name}",
                 lambda r: client.put_template(r.path_params["name"], _parse_body(r)))
@@ -474,6 +587,11 @@ def build_rest_controller(node) -> RestController:
     rc.register("GET", "/_cluster/health/{index}",
                 lambda r: client.cluster_health(index=r.path_params["index"]))
     rc.register("GET", "/_cluster/state", lambda r: client.cluster_state())
+    rc.register("GET", "/_cluster/state/{metric}",
+                lambda r: client.cluster_state(metric=r.path_params["metric"]))
+    rc.register("GET", "/_cluster/state/{metric}/{index}",
+                lambda r: client.cluster_state(metric=r.path_params["metric"],
+                                               index=r.path_params["index"]))
     rc.register("GET", "/_cluster/pending_tasks", lambda r: client.pending_tasks())
     rc.register("PUT", "/_cluster/settings",
                 lambda r: client.cluster_update_settings(_parse_body(r)))
@@ -585,20 +703,75 @@ def build_rest_controller(node) -> RestController:
         return RestResponse(200, "\n".join(lines) + "\n", content_type="text/plain")
 
     # --- percolate -----------------------------------------------------------
-    rc.register("GET,POST", "/{index}/{type}/_percolate",
-                lambda r: client.percolate(r.path_params["index"], _parse_body(r)))
+    def percolate(req):
+        return node.percolator.percolate(
+            req.path_params["index"], _parse_body(req),
+            doc_type=req.path_params["type"], doc_id=req.param("id"),
+            version=req.param("version"),
+            percolate_index=req.param("percolate_index"),
+            percolate_type=req.param("percolate_type"))
+
+    rc.register("GET,POST", "/{index}/{type}/_percolate", percolate)
+    rc.register("GET,POST", "/{index}/{type}/{id}/_percolate",
+                lambda r: node.percolator.percolate(
+                    r.path_params["index"], _parse_body(r),
+                    doc_type=r.path_params["type"], doc_id=r.path_params["id"],
+                    version=r.param("version"),
+                    percolate_index=r.param("percolate_index"),
+                    percolate_type=r.param("percolate_type")))
     rc.register("GET,POST", "/{index}/{type}/_percolate/count",
-                lambda r: client.count_percolate(r.path_params["index"], _parse_body(r)))
+                lambda r: node.percolator.count_percolate(
+                    r.path_params["index"], _parse_body(r),
+                    doc_type=r.path_params["type"]))
+    rc.register("GET,POST", "/{index}/{type}/{id}/_percolate/count",
+                lambda r: node.percolator.count_percolate(
+                    r.path_params["index"], _parse_body(r),
+                    doc_type=r.path_params["type"], doc_id=r.path_params["id"]))
+
+    def mpercolate(req):
+        raw = req.body if isinstance(req.body, str) else ""
+        lines = [ln for ln in raw.split("\n") if ln.strip()]
+        requests = []
+        for i in range(0, len(lines) - 1, 2):
+            requests.append((json.loads(lines[i]), json.loads(lines[i + 1])))
+        return node.percolator.multi_percolate(
+            requests, default_index=req.path_params.get("index"),
+            default_type=req.path_params.get("type"))
+
+    rc.register("GET,POST", "/_mpercolate", mpercolate)
+    rc.register("GET,POST", "/{index}/_mpercolate", mpercolate)
+    rc.register("GET,POST", "/{index}/{type}/_mpercolate", mpercolate)
 
     # --- warmers -------------------------------------------------------------
-    rc.register("PUT", "/{index}/_warmer/{name}",
-                lambda r: client.put_warmer(r.path_params["index"],
-                                            r.path_params["name"], _parse_body(r)))
-    rc.register("DELETE", "/{index}/_warmer/{name}",
-                lambda r: client.delete_warmer(r.path_params["index"],
-                                               r.path_params["name"]))
-    rc.register("GET", "/{index}/_warmer",
-                lambda r: client.get_warmer(r.path_params["index"]))
+    def put_warmer(req):
+        return client.put_warmer(req.path_params.get("index"),
+                                 req.path_params["name"], _parse_body(req),
+                                 doc_type=req.path_params.get("type"))
+
+    def get_warmer(req):
+        return client.get_warmer(req.path_params.get("index"),
+                                 req.path_params.get("name"))
+
+    for suffix in ("_warmer", "_warmers"):
+        rc.register("PUT,POST", "/" + suffix + "/{name}", put_warmer)
+        rc.register("PUT,POST", "/{index}/" + suffix + "/{name}", put_warmer)
+        rc.register("PUT,POST", "/{index}/{type}/" + suffix + "/{name}", put_warmer)
+        rc.register("DELETE", "/{index}/" + suffix + "/{name}",
+                    lambda r: client.delete_warmer(r.path_params["index"],
+                                                   r.path_params["name"]))
+    rc.register("GET", "/_warmer", get_warmer)
+    rc.register("GET", "/_warmer/{name}", get_warmer)
+    rc.register("GET", "/{index}/_warmer", get_warmer)
+    rc.register("GET", "/{index}/_warmer/{name}", get_warmer)
+    rc.register("GET", "/{index}/{type}/_warmer/{name}", get_warmer)
+
+    # --- legacy status + gateway snapshot ------------------------------------
+    rc.register("GET", "/_status", lambda r: client.indices_status())
+    rc.register("GET", "/{index}/_status",
+                lambda r: client.indices_status(r.path_params["index"]))
+    rc.register("POST", "/_gateway/snapshot", lambda r: client.gateway_snapshot())
+    rc.register("POST", "/{index}/_gateway/snapshot",
+                lambda r: client.gateway_snapshot(r.path_params["index"]))
 
     # --- snapshot/restore ----------------------------------------------------
     rc.register("PUT,POST", "/_snapshot/{repo}",

@@ -71,10 +71,116 @@ class CompiledScript:
             raise ScriptError(f"script runtime error: {e}") from None
 
 
+SUPPORTED_LANGS = {None, "mvel", "expression", "native", "python"}
+
+
+def check_lang(lang):
+    """ref: ScriptService — unknown `lang` rejects the request."""
+    if lang not in SUPPORTED_LANGS:
+        raise ScriptError(f"script_lang not supported [{lang}]")
+
+
+class _AttrDict:
+    """Attribute-style access over a plain dict, so mvel-shaped update scripts
+    (`ctx._source.foo = ...`) run unmodified (the reference's default lang is mvel —
+    script/ScriptService.java:77)."""
+
+    __slots__ = ("_d",)
+
+    def __init__(self, d: dict):
+        object.__setattr__(self, "_d", d)
+
+    def __getattr__(self, k):
+        try:
+            v = self._d[k]
+        except KeyError:
+            raise AttributeError(k) from None
+        return _AttrDict(v) if isinstance(v, dict) else v
+
+    def __setattr__(self, k, v):
+        self._d[k] = v
+
+    def __getitem__(self, k):
+        v = self._d[k]
+        return _AttrDict(v) if isinstance(v, dict) else v
+
+    def __setitem__(self, k, v):
+        self._d[k] = v
+
+    def __contains__(self, k):
+        return k in self._d
+
+
+_STMT_NODES = _ALLOWED_NODES + (
+    ast.Module, ast.Assign, ast.AugAssign, ast.Expr, ast.If, ast.Store,
+    ast.List, ast.Dict, ast.Tuple,
+)
+
+
+class UpdateScript:
+    """Statement-mode script over a mutable `ctx` (ref: update scripts mutate
+    ctx._source / ctx.op / ctx._ttl — TransportUpdateAction.java:212-270)."""
+
+    def __init__(self, source: str, params: dict):
+        self.source = source
+        self.params = dict(params or {})
+        try:
+            self.tree = ast.parse(source, mode="exec")
+        except SyntaxError as e:
+            raise ScriptError(f"script compile error: {e}") from None
+        for node in ast.walk(self.tree):
+            if not isinstance(node, _STMT_NODES):
+                raise ScriptError(
+                    f"disallowed construct [{type(node).__name__}] in script "
+                    f"[{self.source}]")
+            if isinstance(node, ast.Attribute):
+                # attribute chains must be rooted at `ctx` (mediated by _AttrDict)
+                # and never reach dunders — blocks `().__class__...` escapes
+                if node.attr.startswith("__"):
+                    raise ScriptError(f"disallowed attribute [{node.attr}]")
+                base = node
+                while isinstance(base, (ast.Attribute, ast.Subscript)):
+                    base = base.value
+                if not (isinstance(base, ast.Name) and base.id == "ctx"):
+                    raise ScriptError(
+                        "attribute access is only allowed on ctx.*")
+            if isinstance(node, ast.Call):
+                if not isinstance(node.func, ast.Name) or \
+                        node.func.id not in _ALLOWED_FUNCS:
+                    raise ScriptError("only whitelisted functions may be called")
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) else \
+                    [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id in (
+                            *_ALLOWED_FUNCS, "ctx"):
+                        raise ScriptError(
+                            f"cannot rebind builtin name [{t.id}]")
+        self._code = compile(self.tree, "<update-script>", "exec")
+
+    def run(self, ctx: dict, **extra):
+        env = {"ctx": _AttrDict(ctx), **_ALLOWED_FUNCS, **self.params, **extra}
+        try:
+            exec(self._code, {"__builtins__": {}}, env)  # noqa: S102 — sandboxed AST
+        except ScriptError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            raise ScriptError(f"script runtime error: {e}") from None
+        return ctx
+
+
+def compile_update_script(source: str, params: dict | None = None,
+                          lang=None) -> UpdateScript:
+    check_lang(lang)
+    return UpdateScript(source, params or {})
+
+
 _cache: dict[tuple, CompiledScript] = {}
 
 
-def compile_script(source: str, params: dict | None = None) -> CompiledScript:
+def compile_script(source: str, params: dict | None = None,
+                   lang=None) -> CompiledScript:
+    check_lang(lang)
     key = (source, tuple(sorted((params or {}).items())))
     try:
         cs = _cache.get(key)

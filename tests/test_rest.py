@@ -186,7 +186,7 @@ class TestRestApi:
         node, base = http_node
         status, body = call(base, "GET", "/missing_index/_search")
         assert status == 404
-        assert body["error"]["type"] == "IndexMissingError"
+        assert body["error"]["type"] == "IndexMissingException"
         status, body = call(base, "POST", "/lib/_search",
                             {"query": {"bogus_query": {}}})
         assert status == 400
