@@ -94,6 +94,32 @@ A_DFS_PHASE = "indices:data/read/search[phase/dfs]"
 A_SHARD_BROADCAST = "indices:admin/broadcast[s]"
 
 
+def _normalize_alias_specs(aliases: dict) -> dict:
+    """Alias metadata stores index_routing/search_routing; a bare `routing` key sets
+    both (ref: cluster/metadata/AliasMetaData + AliasAction semantics)."""
+    out = {}
+    for name, spec in aliases.items():
+        spec = dict(spec or {})
+        spec = {k: v for k, v in spec.items()
+                if k in ("filter", "index_routing", "search_routing", "routing")}
+        if "routing" in spec:
+            r = spec.pop("routing")
+            spec.setdefault("index_routing", r)
+            spec.setdefault("search_routing", r)
+        out[name] = spec
+    return out
+
+
+def _normalize_warmer(body) -> dict:
+    """Warmer metadata is {types, source} (ref: search/warmer/IndexWarmersMetaData);
+    a bare search body becomes the source."""
+    body = dict(body or {})
+    if "source" in body:
+        return {"types": body.get("types") or [], "source": body["source"]}
+    types = body.pop("types", []) or []
+    return {"types": types, "source": body}
+
+
 class ActionModule:
     """Registers every handler on one node + provides coordinator entry points."""
 
@@ -167,7 +193,9 @@ class ActionModule:
         def update(state: ClusterState) -> ClusterState:
             if state.metadata.has_index(index):
                 raise IndexAlreadyExistsError(index)
-            settings = dict(body.get("settings") or {})
+            settings = {(k if k.startswith("index.") else f"index.{k}"): v
+                        for k, v in Settings.from_flat(
+                            body.get("settings") or {}).as_dict().items()}
             mappings = dict(body.get("mappings") or {})
             aliases = dict(body.get("aliases") or {})
             # apply matching templates lowest order first (ref: IndexTemplateMetaData)
@@ -181,18 +209,21 @@ class ActionModule:
                     mappings.setdefault(ttype, _json.loads(m) if isinstance(m, str) else m)
                 for a, spec in tpl.aliases:
                     aliases.setdefault(a, spec)
-            flat = Settings.from_flat(settings).as_dict()
-            flat.setdefault("index.number_of_shards",
-                            int(flat.pop("number_of_shards", 5)))
-            flat.setdefault("index.number_of_replicas",
-                            int(flat.pop("number_of_replicas", 1)))
+            flat = {(k if k.startswith("index.") else f"index.{k}"): v
+                    for k, v in Settings.from_flat(settings).as_dict().items()}
+            flat.setdefault("index.number_of_shards", 5)
+            flat.setdefault("index.number_of_replicas", 1)
+            flat["index.number_of_shards"] = int(flat["index.number_of_shards"])
+            flat["index.number_of_replicas"] = int(flat["index.number_of_replicas"])
             meta = IndexMetaData(
                 name=index, settings_map=tuple(sorted(flat.items())),
             )
             for t, m in mappings.items():
                 meta = meta.with_mapping(t, m)
             if aliases:
-                meta = meta.with_aliases(aliases)
+                meta = meta.with_aliases(_normalize_alias_specs(aliases))
+            for wname, wbody in (body.get("warmers") or {}).items():
+                meta = meta.with_warmer(wname, _normalize_warmer(wbody))
             new = state.next_version(
                 metadata=state.metadata.with_index(meta),
                 routing_table=state.routing_table.with_index(
@@ -209,11 +240,12 @@ class ActionModule:
         indices = self.cluster_service.state.metadata.resolve_indices(request["index"])
 
         def update(state: ClusterState) -> ClusterState:
-            md, rt = state.metadata, state.routing_table
+            md, rt, blocks = state.metadata, state.routing_table, state.blocks
             for index in indices:
                 md = md.without_index(index)
                 rt = rt.without_index(index)
-            return state.next_version(metadata=md, routing_table=rt)
+                blocks = blocks.without_index(index)
+            return state.next_version(metadata=md, routing_table=rt, blocks=blocks)
 
         self._submit(f"delete-index{indices}", update, priority=URGENT)
         return {"acknowledged": True}
@@ -370,10 +402,7 @@ class ActionModule:
                     aliases = dict(meta.aliases)
                     if op == "add":
                         for alias in alias_exprs:
-                            aliases[alias] = {
-                                k: v for k, v in spec.items()
-                                if k in ("filter", "index_routing",
-                                         "search_routing", "routing")}
+                            aliases.update(_normalize_alias_specs({alias: spec}))
                     elif op == "remove":
                         for expr in alias_exprs:
                             for a in [a for a in aliases
@@ -427,12 +456,16 @@ class ActionModule:
         name = request["name"]
         body = request["body"]
 
+        # template settings are stored flat with the index. prefix, like index settings
+        flat_settings = {
+            (k if k.startswith("index.") else f"index.{k}"): v
+            for k, v in Settings.from_flat(body.get("settings", {})).as_dict().items()}
+
         def update(state: ClusterState) -> ClusterState:
             tpl = IndexTemplateMetaData(
                 name=name, template=body.get("template", "*"),
                 order=int(body.get("order", 0)),
-                settings_map=tuple(sorted(
-                    Settings.from_flat(body.get("settings", {})).as_dict().items())),
+                settings_map=tuple(sorted(flat_settings.items())),
                 mappings=tuple((t, __import__("json").dumps(m))
                                for t, m in (body.get("mappings") or {}).items()),
                 aliases=tuple(sorted((body.get("aliases") or {}).items())),
@@ -455,7 +488,7 @@ class ActionModule:
         """ref: search/warmer/IndexWarmersMetaData + indices/warmer — registered
         searches run against new searchers on refresh before exposure."""
         indices = self.cluster_service.state.metadata.resolve_indices(request["index"])
-        name, body = request["name"], request.get("body")
+        name, body = request["name"], _normalize_warmer(request.get("body"))
 
         def update(state: ClusterState) -> ClusterState:
             md = state.metadata
@@ -500,7 +533,8 @@ class ActionModule:
         for name, body in meta.warmers_dict().items():
             try:
                 ctx = self._shard_ctx(index, shard_id)
-                execute_query_phase(ctx, parse_search_body(body), shard_id=shard_id)
+                execute_query_phase(ctx, parse_search_body(body.get("source", body)),
+                                    shard_id=shard_id)
             except SearchEngineError as e:
                 self.logger.debug("warmer [%s] failed on [%s][%d]: %s",
                                   name, index, shard_id, e)
@@ -650,12 +684,13 @@ class ActionModule:
                                       A_INDEX_PRIMARY, req)
 
     def delete_doc(self, index: str, type_name: str, doc_id: str, routing=None,
-                   version=None, refresh=False, parent=None) -> dict:
+                   version=None, version_type="internal", refresh=False,
+                   parent=None) -> dict:
         index = self._resolve_index_write(index)
         effective_routing = routing if routing is not None else parent
         self._required_routing_check(index, type_name, doc_id, effective_routing)
         req = {"index": index, "type": type_name, "id": doc_id, "routing": routing,
-               "version": version, "refresh": refresh}
+               "version": version, "version_type": version_type, "refresh": refresh}
         return self._route_to_primary(index, doc_id, effective_routing,
                                       A_DELETE_PRIMARY, req)
 
@@ -689,9 +724,11 @@ class ActionModule:
                                        routing=effective_routing)
                 noop = False
                 if not current["found"]:
-                    if version is not None:
-                        raise DocumentMissingError(
-                            f"[{index}][{type_name}][{doc_id}] missing")
+                    # internal CAS against a missing doc is a conflict, not a 404
+                    # (ref: update/30_internal_version.yaml)
+                    if version is not None and version_type == "internal":
+                        raise VersionConflictError(
+                            f"{type_name}#{doc_id}", 0, version)
                     if "upsert" in body:
                         source = body["upsert"]
                     elif body.get("doc_as_upsert") and "doc" in body:
@@ -701,8 +738,9 @@ class ActionModule:
                             f"[{index}][{type_name}][{doc_id}] missing")
                     r = self.index_doc(index, type_name, doc_id, source,
                                        routing=routing, parent=parent,
-                                       op_type="create", refresh=refresh,
-                                       ttl=ttl, timestamp=timestamp)
+                                       version=version, version_type=version_type,
+                                       op_type="create" if version is None else "index",
+                                       refresh=refresh, ttl=ttl, timestamp=timestamp)
                 else:
                     source = dict(current["_source"])
                     op = "index"
@@ -871,7 +909,8 @@ class ActionModule:
         self._register_percolator(index, request, delete=True)
         shard = self.indices.index_service(index).shard(shard_id)
         version, found = shard.engine.delete(
-            request["type"], request["id"], version=request.get("version"))
+            request["type"], request["id"], version=request.get("version"),
+            version_type=request.get("version_type", "internal"))
         self._replicate(index, shard_id, A_DELETE_REPLICA, dict(request))
         if request.get("refresh"):
             shard.engine.refresh()
@@ -1033,7 +1072,7 @@ class ActionModule:
 
     # ================= single-shard reads =================
     def get_doc(self, index: str, type_name: str, doc_id: str, routing=None,
-                realtime=True, preference=None, parent=None) -> dict:
+                realtime=True, refresh=False, preference=None, parent=None) -> dict:
         state = self.cluster_service.state
         state.blocks.check("read", index)
         index = state.metadata.resolve_indices(index)[0]
@@ -1043,10 +1082,12 @@ class ActionModule:
         node = state.nodes.get(copy.node_id)
         return self.transport.submit_request(node, A_GET, {
             "index": index, "shard": copy.shard_id, "type": type_name, "id": doc_id,
-            "realtime": realtime}, timeout=10.0)
+            "realtime": realtime, "refresh": refresh}, timeout=10.0)
 
     def _s_get(self, request, channel):
         shard = self.indices.index_service(request["index"]).shard(request["shard"])
+        if request.get("refresh"):
+            shard.engine.refresh()
         type_name = request["type"] or "_all"
         if type_name == "_all":
             # resolve the uid across types (ref: _all type get)
@@ -1104,6 +1145,8 @@ class ActionModule:
                 out.append(self.term_vector(
                     d["_index"], d.get("_type", "_all"), d["_id"],
                     routing=d.get("routing"), fields=d.get("fields"),
+                    positions=d.get("positions", True),
+                    offsets=d.get("offsets", True),
                     term_statistics=d.get("term_statistics", False),
                     field_statistics=d.get("field_statistics", True)))
             except SearchEngineError as e:
@@ -1148,6 +1191,9 @@ class ActionModule:
             if request.get("term_statistics"):
                 for term, e in terms.items():
                     e["doc_freq"] = ctx.doc_freq(field, term)
+                    e["ttf"] = sum(
+                        int(seg.postings(field, term)[1].sum())
+                        for seg in ctx.searcher.segments)
             entry = {"terms": terms}
             if request.get("field_statistics", True):
                 fs = ctx.field_stats(field)
@@ -1202,7 +1248,9 @@ class ActionModule:
             try:
                 r = self.get_doc(d["_index"], type_name, str(d["_id"]),
                                  routing=d.get("routing") or d.get("_routing"),
-                                 parent=d.get("parent") or d.get("_parent"))
+                                 parent=d.get("parent") or d.get("_parent"),
+                                 realtime=d.get("realtime", True),
+                                 refresh=d.get("refresh", False))
                 if d.get("_type") and r.get("_type") != d["_type"]:
                     # requested type doesn't hold this id
                     r = {"_index": d["_index"], "_type": d["_type"],
@@ -1250,6 +1298,8 @@ class ActionModule:
         t0 = time.monotonic()
         state = self.cluster_service.state
         indices = state.metadata.resolve_indices(index_expr)
+        for i in indices:
+            state.blocks.check("read", i)
         # filtered aliases compose into the query (ref: filtered alias handling)
         alias_filters = {i: state.metadata.alias_filter(i, index_expr) for i in indices}
         req = parse_search_body(body)
@@ -1276,27 +1326,28 @@ class ActionModule:
             }
         results: list[ShardQueryResult] = []
         failures = []
-        shard_nodes = {}
-        for copy in shards:
+        # merge identity is a coordinator-assigned ordinal — (index, shard) pairs from
+        # different indices may share a shard id (ref: the per-request shard index in
+        # TransportSearchTypeAction), so results carry the ordinal as shard_id
+        shard_meta: dict[int, tuple] = {}  # ordinal -> (index, real_shard_id, node)
+        for ordinal, copy in enumerate(shards):
             r, used = self._query_with_failover(state, copy, body, alias_filters,
                                                 dfs_stats, failures)
             if r is not None:
+                shard_meta[ordinal] = (copy.index, r.shard_id, used)
+                r.shard_id = ordinal
                 results.append(r)
-                shard_nodes[(r.shard_id, id(r))] = used
         merged = sort_docs(req, results)
         page = merged.hits[req.from_: req.from_ + req.size]
         # fetch phase: winners only, grouped per shard
         by_shard: dict = {}
-        for rank, (score, shard_id, doc, sort_values) in enumerate(page):
-            by_shard.setdefault(shard_id, []).append((rank, score, doc, sort_values))
+        for rank, (score, ordinal, doc, sort_values) in enumerate(page):
+            by_shard.setdefault(ordinal, []).append((rank, score, doc, sort_values))
         fetched: dict[int, dict] = {}
-        for shard_id, entries in by_shard.items():
-            result = next(r for r in results if r.shard_id == shard_id)
-            node = shard_nodes[(result.shard_id, id(result))]
+        for ordinal, entries in by_shard.items():
+            index_name, real_shard, node = shard_meta[ordinal]
             r = self.transport.submit_request(node, A_FETCH_PHASE, {
-                "index": result.index_name if hasattr(result, "index_name") else
-                         getattr(result, "index", None) or self._shard_index(shards, shard_id),
-                "shard": shard_id, "body": body or {},
+                "index": index_name, "shard": real_shard, "body": body or {},
                 "docs": [[score, doc, sort_values] for (_rank, score, doc, sort_values) in entries],
             }, timeout=30.0)
             for (rank, *_), hit in zip(entries, r["hits"]):

@@ -260,7 +260,12 @@ class IndexMetaData:
     def mappings_dict(self) -> dict:
         import json
 
-        return {t: json.loads(m) for t, m in self.mappings}
+        out = {}
+        for t, m in self.mappings:
+            d = json.loads(m)
+            d.setdefault("properties", {})  # always present in the REST view
+            out[t] = d
+        return out
 
     def with_mapping(self, type_name: str, mapping: dict) -> "IndexMetaData":
         import json
@@ -332,7 +337,9 @@ class IndexTemplateMetaData:
 
     def to_dict(self) -> dict:
         return {"name": self.name, "template": self.template, "order": self.order,
-                "settings": dict(self.settings_map), "mappings": dict(self.mappings),
+                "settings": {k: (str(v).lower() if isinstance(v, bool) else str(v))
+                             for k, v in self.settings_map},
+                "mappings": dict(self.mappings),
                 "aliases": dict(self.aliases)}
 
     @classmethod

@@ -31,6 +31,16 @@ class SearchEngineError(Exception):
             d["caused_by"] = {"type": type(self.cause).__name__, "reason": str(self.cause)}
         return d
 
+    def es1_string(self) -> str:
+        """ES 1.x single-string error rendering, `Type[message]` with nested causes —
+        the shape the reference puts in per-item errors (msearch/mpercolate/bulk)."""
+        out = f"{self.wire_name()}[{self.message}]"
+        if self.cause is not None:
+            inner = (self.cause.es1_string() if isinstance(self.cause, SearchEngineError)
+                     else f"{type(self.cause).__name__}[{self.cause}]")
+            out += f"; nested: {inner}"
+        return out
+
 
 class IllegalArgumentError(SearchEngineError):
     status = 400
@@ -56,7 +66,7 @@ class IndexMissingError(SearchEngineError):
     status = 404
 
     def __init__(self, index: str):
-        super().__init__(f"no such index [{index}]")
+        super().__init__(f"[{index}] missing")
         self.index = index
 
 

@@ -188,7 +188,9 @@ class Engine:
             uid = f"{type_name}#{doc_id}"
             current, deleted = self._current_version(uid)
             created = current is None or deleted
-            if op_type == "create" and not created and version is None:
+            if op_type == "create" and not created:
+                # create on an existing doc always conflicts, whatever the version
+                # (ref: create/35_external_version.yaml)
                 raise DocumentAlreadyExistsError(f"[{type_name}][{doc_id}] already exists")
             new_version = self._check_version(uid, version, version_type)
             parsed = mapper.parse(source, doc_id, routing=routing, timestamp=timestamp,
@@ -301,7 +303,9 @@ class Engine:
         if ttl is None:
             return None
         base = timestamp if timestamp is not None else int(time.time() * 1000)
-        return max(0, (base + ttl) - int(time.time() * 1000))
+        # strictly less than the stored ttl: time has passed since indexing even when
+        # the clock's ms value hasn't ticked (in-process indexing is sub-ms)
+        return max(0, (base + ttl) - int(time.time() * 1000) - 1)
 
     # ------------------------------------------------------------------ nrt
     def refresh(self) -> bool:

@@ -136,11 +136,13 @@ class SegmentBuilder:
         offsets = [0]
         docs_parts, freqs_parts, pos_offsets, pos_parts = [], [], [0], []
         tid = 0
+        sum_dfs_by_field: dict[str, int] = {}
         for f in sorted(by_field):
             terms = sorted(by_field[f])
             td: dict[str, int] = {}
             for t in terms:
                 plist = self._postings[(f, t)]
+                sum_dfs_by_field[f] = sum_dfs_by_field.get(f, 0) + len(plist)
                 plist.sort(key=lambda e: e[0])
                 td[t] = tid
                 docs_parts.append(np.fromiter((e[0] for e in plist), dtype=np.int32, count=len(plist)))
@@ -162,7 +164,8 @@ class SegmentBuilder:
                 lengths[local] += ln
             norms[f] = encode_norm(lengths)
             field_stats[f] = FieldStats(
-                doc_count=int((lengths > 0).sum()), sum_ttf=int(lengths.sum())
+                doc_count=int((lengths > 0).sum()), sum_ttf=int(lengths.sum()),
+                sum_dfs=sum_dfs_by_field.get(f, 0),
             )
 
         dv_num: dict[str, tuple[np.ndarray, np.ndarray]] = {}

@@ -90,6 +90,7 @@ class IndicesService:
     def __init__(self, node_id: str, node_name: str, data_path: str, transport,
                  cluster_service):
         self.node_id = node_id
+        self.node = None  # back-reference, set by Node (used for cross-service cleanup)
         self.data_path = data_path
         self.transport = transport
         self.cluster_service = cluster_service
@@ -189,6 +190,9 @@ class IndicesService:
                 import shutil
 
                 shutil.rmtree(os.path.join(svc.data_path, name), ignore_errors=True)
+                # registered percolator queries die with the index
+                if self.node is not None and getattr(self.node, "percolator", None):
+                    self.node.percolator.registries.pop(name, None)
                 self.logger.info("removed index [%s]", name)
         # 2. per assigned shard on this node: create + recover
         my_shards: dict[tuple, ShardRouting] = {}

@@ -367,10 +367,12 @@ class DocumentMapper:
             id=doc_id, type=self.type, uid=f"{self.type}#{doc_id}", source=source,
             routing=routing, parent=parent,
         )
-        if self.timestamp_enabled:
-            if timestamp is not None:
-                doc.timestamp = parse_date(timestamp)
-            elif self.timestamp_path and self.timestamp_path in source:
+        # an explicit timestamp always takes effect (it drives _ttl expiry); the
+        # _timestamp docvalue is only stored when the meta-field is enabled
+        if timestamp is not None:
+            doc.timestamp = parse_date(timestamp)
+        elif self.timestamp_enabled:
+            if self.timestamp_path and self.timestamp_path in source:
                 doc.timestamp = parse_date(source[self.timestamp_path])
             else:
                 import time
@@ -392,10 +394,18 @@ class DocumentMapper:
             if doc.routing is None:
                 doc.routing = doc.parent
         if doc.ttl is not None:
+            import time as _time
+
             base_ts = doc.timestamp if doc.timestamp is not None else int(
-                __import__("time").time() * 1000)
-            doc.doc_values_num["_expiry"] = [float(base_ts + doc.ttl)]
-        if doc.timestamp is not None:
+                _time.time() * 1000)
+            expiry = base_ts + doc.ttl
+            if expiry < int(_time.time() * 1000):
+                from ..common.errors import AlreadyExpiredError
+
+                raise AlreadyExpiredError(
+                    f"already expired [{doc_id}]: expiry [{expiry}] < now")
+            doc.doc_values_num["_expiry"] = [float(expiry)]
+        if doc.timestamp is not None and self.timestamp_enabled:
             doc.doc_values_num["_timestamp"] = [float(doc.timestamp)]
         all_terms: list[tuple[str, int]] = []
         self._parse_object(source, "", doc, all_terms, nested_path=None)
@@ -518,16 +528,28 @@ class DocumentMapper:
     # mapping output / merge -------------------------------------------------
     def to_mapping(self) -> dict:
         props: dict[str, Any] = {}
+        multi = []  # (parent_parts, leaf, ft) — rendered under the parent's "fields"
         for name, ft in sorted(self.fields.items()):
             if ft.type == "object":
                 continue
-            node = props
             parts = name.split(".")
+            parent = self.fields.get(".".join(parts[:-1])) if len(parts) > 1 else None
+            if parent is not None and parent.type != "object":
+                multi.append((parts[:-1], parts[-1], ft))
+                continue
+            node = props
             for p in parts[:-1]:
                 obj_ft = self.fields.get(".".join(parts[: parts.index(p) + 1]))
                 node = node.setdefault(p, {"type": "nested"} if obj_ft and obj_ft.nested else {})
                 node = node.setdefault("properties", {})
             node[parts[-1]] = ft.to_mapping()
+        for parent_parts, leaf, ft in multi:
+            node = props
+            for i, p in enumerate(parent_parts):
+                if i:
+                    node = node.setdefault("properties", {})
+                node = node.setdefault(p, {})
+            node.setdefault("fields", {})[leaf] = ft.to_mapping()
         out: dict[str, Any] = {"properties": props}
         if not self.source_enabled:
             out["_source"] = {"enabled": False}

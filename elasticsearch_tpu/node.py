@@ -100,6 +100,7 @@ class Node:
 
         self.snapshots = SnapshotsService(self)
         self.percolator = PercolatorService(self)
+        self.indices.node = self
         self.monitor = MonitorService(self)
         # IndicesTTLService analogue: periodic purge of _ttl-expired docs
         self._ttl_task = self.threadpool.schedule_with_fixed_delay(
@@ -256,20 +257,20 @@ class Client:
     def create(self, index, doc_type, body, id=None, **kw):
         return self.index(index, doc_type, body, id=id, op_type="create", **kw)
 
-    def get(self, index, doc_type, id, routing=None, realtime=True, preference=None,
-            parent=None):
+    def get(self, index, doc_type, id, routing=None, realtime=True, refresh=False,
+            preference=None, parent=None):
         return self.actions.get_doc(index, doc_type, id, routing=routing,
-                                    realtime=realtime, preference=preference,
-                                    parent=parent)
+                                    realtime=realtime, refresh=refresh,
+                                    preference=preference, parent=parent)
 
     def mget(self, docs):
         return self.actions.multi_get(docs)
 
-    def delete(self, index, doc_type, id, routing=None, version=None, refresh=False,
-               parent=None):
+    def delete(self, index, doc_type, id, routing=None, version=None,
+               version_type="internal", refresh=False, parent=None):
         return self.actions.delete_doc(index, doc_type, id, routing=routing,
-                                       version=version, refresh=refresh,
-                                       parent=parent)
+                                       version=version, version_type=version_type,
+                                       refresh=refresh, parent=parent)
 
     def update(self, index, doc_type, id, body, routing=None, retry_on_conflict=0,
                parent=None, refresh=False, fields=None, ttl=None, timestamp=None,
@@ -298,7 +299,7 @@ class Client:
             try:
                 responses.append(self.search(header.get("index", "_all"), body))
             except SearchEngineError as e:
-                responses.append({"error": e.to_dict(), "status": e.status})
+                responses.append({"error": e.es1_string(), "status": e.status})
         return {"responses": responses}
 
     def count(self, index=None, body=None):
@@ -375,35 +376,67 @@ class Client:
             if not mappings and (index is None or _is_pattern(index)):
                 continue
             out[name] = {"mappings": mappings}
-        if doc_type and not out:
-            from .common.errors import TypeMissingError
-
-            raise TypeMissingError(f"type[[{doc_type}]] missing")
+        # missing type → empty 200 response (ref: indices.get_mapping/20_missing_type)
         return out
 
     def get_field_mapping(self, index=None, doc_type=None, field=None,
                           include_defaults=False):
         """ref: action/admin/indices/mapping/get/TransportGetFieldMappingsAction —
-        per-index, per-type, per-field slice of the mapping."""
+        per-index, per-type, per-field slice of the mapping. Fields resolve by full
+        path first, then by index name (`index_name` attribute, or the leaf name when
+        an enclosing object has `path: just_name`); the response key is the name the
+        field matched by."""
         state = self.node.cluster_service.state
+        from .common.errors import TypeMissingError
+
         out = {}
+        type_seen = False
         for name in state.metadata.resolve_indices(index or "_all"):
             meta = state.metadata.index(name)
             for t, mapping in meta.mappings_dict().items():
                 if doc_type and not _name_matches(t, doc_type):
                     continue
+                type_seen = True
                 props = _flatten_properties(mapping.get("properties") or {})
+                # full path → def, plus the alternate "index name" each leaf answers to
+                index_names: dict[str, str] = {}  # alternate name → full path
                 for fname, fdef in props.items():
-                    if field and not _name_matches(fname, field):
-                        continue
+                    alts = set()
+                    if isinstance(fdef, dict):
+                        if fdef.get("index_name"):
+                            alts.add(fdef["index_name"])
+                        if fdef.get("_just_name"):
+                            alts.add(fname.rsplit(".", 1)[-1])
+                    for alt in alts:
+                        if alt != fname:
+                            index_names.setdefault(alt, fname)
+                wanted: dict[str, str] = {}  # response key → full path
+                exprs = ([field] if not isinstance(field, list) else field) if field \
+                    else ["*"]
+                exprs = [e for expr in exprs for e in str(expr).split(",")]
+                for expr in exprs:
+                    for fname in props:
+                        if _name_matches(fname, expr):
+                            wanted[fname] = fname
+                    # index names match only where no full name claimed the key and
+                    # the field itself wasn't already matched by full name
+                    for alt, fname in index_names.items():
+                        if alt not in wanted and fname not in wanted.values() \
+                                and _name_matches(alt, expr):
+                            wanted[alt] = fname
+                for key, fname in sorted(wanted.items()):
+                    fdef = {k: v for k, v in props[fname].items()
+                            if k != "_just_name"}
                     leaf = fname.rsplit(".", 1)[-1]
-                    fdef = dict(fdef)
                     if include_defaults:
                         fdef.setdefault("type", "string")
                         fdef.setdefault("index", "analyzed")
+                        fdef.setdefault("analyzer", "default")
                     out.setdefault(name, {"mappings": {}})["mappings"] \
-                        .setdefault(t, {})[fname] = {
+                        .setdefault(t, {})[key] = {
                         "full_name": fname, "mapping": {leaf: fdef}}
+        if doc_type and not type_seen:
+            raise TypeMissingError(f"type[[{doc_type}]] missing")
         return out
 
     def exists_type(self, index, doc_type) -> bool:
@@ -440,20 +473,44 @@ class Client:
         return self._local(A("indices:admin/aliases"), {"body": body})
 
     def get_aliases(self, index=None, name=None):
+        """Plural form (/_aliases): explicitly-addressed indices appear even with no
+        matching aliases (ref: RestGetAliasesAction)."""
+        state = self.node.cluster_service.state
+        explicit = set()
+        if index and not _is_pattern(index) and index not in ("_all", "*"):
+            explicit = {p.strip() for p in str(index).split(",")}
+        out = {}
+        for idx in state.metadata.resolve_indices(index or "_all"):
+            aliases = state.metadata.index(idx).aliases_dict()
+            if name is not None:
+                aliases = {a: s for a, s in aliases.items() if _name_matches(a, name)}
+                if not aliases and idx not in explicit:
+                    continue
+            out[idx] = {"aliases": aliases}
+        return out
+
+    def get_alias(self, index=None, name=None):
+        """Singular form (/_alias): 404 when nothing matches
+        (ref: TransportGetAliasesAction + RestGetAliasesAction.notFound)."""
         state = self.node.cluster_service.state
         out = {}
         for idx in state.metadata.resolve_indices(index or "_all"):
             aliases = state.metadata.index(idx).aliases_dict()
             if name is not None:
                 aliases = {a: s for a, s in aliases.items() if _name_matches(a, name)}
-                if not aliases:
-                    continue
-            out[idx] = {"aliases": aliases}
+            if aliases:
+                out[idx] = {"aliases": aliases}
+        # explicitly-addressed indices make an empty result a 200 {} (ref:
+        # indices.delete_alias/10_basic); only an all-indices miss is a 404
+        if not out and name is not None and index is None:
+            from .common.errors import AliasesMissingError
+
+            raise AliasesMissingError([name])
         return out
 
     def exists_alias(self, index=None, name=None) -> bool:
         try:
-            return bool(self.get_aliases(index, name))
+            return bool(self.get_alias(index, name))
         except SearchEngineError:
             return False
 
@@ -564,8 +621,10 @@ class Client:
             "unassigned_shards": unassigned,
         }
 
-    def cluster_state(self, metric=None, index=None):
-        """ref: cluster.state spec — optional metric list filters the response parts."""
+    def cluster_state(self, metric=None, index=None, index_templates=None):
+        """ref: cluster.state spec — optional metric list filters the response parts.
+        `routing_table` metric also carries routing_nodes + allocations, as the
+        reference's ClusterState.toXContent does."""
         state = self.node.cluster_service.state
         full = state.to_dict()
         full["master_node"] = state.nodes.master_id
@@ -581,37 +640,68 @@ class Client:
         if idx_blocks:
             blocks["indices"] = idx_blocks
         full["blocks"] = blocks
+        # REST view of routing: indices-keyed table + node-centric view
+        names = set(state.metadata.resolve_indices(index)) if index else None
+        rt_indices, routing_nodes = {}, {"unassigned": [], "nodes": {}}
+        for tname, t in state.routing_table.indices:
+            if names is not None and tname not in names:
+                continue
+            shards = {}
+            for gid, grp in enumerate(t.shards):
+                shards[str(gid)] = [s.to_dict() for s in grp.shards]
+                for s in grp.shards:
+                    if s.node_id is None:
+                        routing_nodes["unassigned"].append(s.to_dict())
+                    else:
+                        routing_nodes["nodes"].setdefault(s.node_id, []).append(s.to_dict())
+            rt_indices[tname] = {"shards": shards}
+        full["routing_table"] = {"indices": rt_indices}
+        full["routing_nodes"] = routing_nodes
+        full["allocations"] = []
         metrics = None
         if metric and metric not in ("_all",):
             metrics = set(str(metric).split(","))
-        if metrics is None:
-            return full
-        out = {"cluster_name": state.cluster_name}
-        for m in metrics:
-            if m == "master_node":
-                out["master_node"] = full["master_node"]
-            elif m == "version":
-                out["version"] = full["version"]
-            elif m in full:
-                out[m] = full[m]
-        if index and "metadata" in out:
-            names = set(state.metadata.resolve_indices(index))
+        if metrics is not None and "routing_table" in metrics:
+            metrics |= {"routing_nodes", "allocations"}
+        out = full
+        if metrics is not None:
+            out = {"cluster_name": state.cluster_name}
+            for m in metrics:
+                if m == "master_node":
+                    out["master_node"] = full["master_node"]
+                elif m == "version":
+                    out["version"] = full["version"]
+                elif m in full:
+                    out[m] = full[m]
+        if "metadata" in out:
             md = dict(out["metadata"])
-            md["indices"] = {n: v for n, v in md.get("indices", {}).items()
-                             if n in names}
+            if names is not None:
+                md["indices"] = {n: v for n, v in md.get("indices", {}).items()
+                                 if n in names}
+            if index_templates:
+                wanted = [t.strip() for t in str(index_templates).split(",") if t.strip()]
+                md["templates"] = {n: v for n, v in md.get("templates", {}).items()
+                                   if n in wanted}
             out["metadata"] = md
         return out
 
     def cluster_reroute(self, body=None):
         return self._local(A("cluster:admin/reroute"), {"body": body or {}})
 
-    def cluster_update_settings(self, body):
-        r = self._local(A("cluster:admin/settings/update"), {"body": body})
-        # echo applied settings with string values, as the reference serializes them
-        for section in ("persistent", "transient"):
-            if isinstance(r, dict) and section in r:
-                r[section] = {k: _settings_str(v) for k, v in r[section].items()}
+    def cluster_update_settings(self, body, flat=False):
+        self._local(A("cluster:admin/settings/update"), {"body": body})
+        r = self.cluster_get_settings(flat=flat)
+        r["acknowledged"] = True
         return r
+
+    def cluster_get_settings(self, flat=False):
+        md = self.node.cluster_service.state.metadata
+        out = {}
+        for section, stored in (("persistent", md.persistent_settings),
+                                ("transient", md.transient_settings)):
+            flat_map = {k: _settings_str(v) for k, v in stored}
+            out[section] = flat_map if flat else _nest_keys(flat_map)
+        return out
 
     def pending_tasks(self):
         return {"tasks": self.node.cluster_service.pending_tasks()}
@@ -627,7 +717,8 @@ class Client:
         return {"cluster_name": state.cluster_name, "nodes": nodes}
 
     def nodes_stats(self):
-        return {"nodes": {self.node.node_id: {
+        return {"cluster_name": self.node.cluster_service.state.cluster_name,
+                "nodes": {self.node.node_id: {
             "indices": self.node.indices.stats(),
             "transport": self.node.transport.stats,
             "thread_pool": self.node.threadpool.stats(),
@@ -732,19 +823,25 @@ def _nest_keys(flat: dict) -> dict:
     return out
 
 
-def _flatten_properties(props: dict, prefix: str = "") -> dict:
-    """Mapping properties tree → {"a.b": leaf_def} (multi-fields included)."""
+def _flatten_properties(props: dict, prefix: str = "", just_name: bool = False) -> dict:
+    """Mapping properties tree → {"a.b": leaf_def} (multi-fields included). Leaves under
+    an object with `path: just_name` are tagged so they also answer to their bare name
+    (ref: object mapper path semantics used by get-field-mapping)."""
     out = {}
     for name, fdef in (props or {}).items():
         full = f"{prefix}{name}"
         if isinstance(fdef, dict) and isinstance(fdef.get("properties"), dict) and \
                 fdef.get("type", "object") in ("object", "nested"):
-            out.update(_flatten_properties(fdef["properties"], full + "."))
+            sub_just = just_name or fdef.get("path") == "just_name"
+            out.update(_flatten_properties(fdef["properties"], full + ".", sub_just))
         else:
-            out[full] = fdef if isinstance(fdef, dict) else {}
+            leaf = dict(fdef) if isinstance(fdef, dict) else {}
+            if just_name:
+                leaf["_just_name"] = True
+            out[full] = leaf
             if isinstance(fdef, dict) and isinstance(fdef.get("fields"), dict):
                 for sub, sdef in fdef["fields"].items():
-                    out[f"{full}.{sub}"] = sdef
+                    out[f"{full}.{sub}"] = dict(sdef) if isinstance(sdef, dict) else {}
     return out
 
 

@@ -182,7 +182,7 @@ class PercolatorService:
                 from .common.errors import SearchEngineError
 
                 if isinstance(e, SearchEngineError):
-                    responses.append({"error": e.to_dict(), "status": e.status})
+                    responses.append({"error": e.es1_string(), "status": e.status})
                 else:
                     responses.append({"error": str(e)})
         return {"responses": responses}

@@ -99,7 +99,62 @@ def _parse_body(request: RestRequest) -> dict:
         return {}
     if isinstance(request.body, (dict, list)):
         return request.body
-    return json.loads(request.body)
+    try:
+        return json.loads(request.body)
+    except ValueError:
+        return json.loads(_lenient_to_strict_json(request.body))
+
+
+def _lenient_to_strict_json(text: str) -> str:
+    """The reference's JSON parser accepts unquoted field names and single-quoted
+    strings (Jackson ALLOW_UNQUOTED_FIELD_NAMES/ALLOW_SINGLE_QUOTES, enabled by
+    common/xcontent JsonXContent); rewrite such input to strict JSON."""
+    out = []
+    i, n = 0, len(text)
+    bare = re.compile(r"[A-Za-z_$][A-Za-z0-9_$.\-]*")
+    number = re.compile(r"-?\d+(\.\d+)?([eE][+-]?\d+)?")
+    while i < n:
+        c = text[i]
+        if c == "-" or c.isdigit():
+            m = number.match(text, i)
+            if m:
+                out.append(m.group(0))
+                i = m.end()
+                continue
+        if c == '"':  # standard string: copy verbatim incl. escapes
+            j = i + 1
+            while j < n:
+                if text[j] == "\\":
+                    j += 2
+                    continue
+                if text[j] == '"':
+                    break
+                j += 1
+            out.append(text[i:j + 1])
+            i = j + 1
+        elif c == "'":  # single-quoted string → double-quoted
+            j = i + 1
+            buf = []
+            while j < n and text[j] != "'":
+                if text[j] == "\\" and j + 1 < n:
+                    buf.append(text[j:j + 2])
+                    j += 2
+                    continue
+                buf.append(text[j])
+                j += 1
+            out.append(json.dumps("".join(buf)))
+            i = j + 1
+        else:
+            m = bare.match(text, i)
+            if m:
+                tok = m.group(0)
+                out.append(tok if tok in ("true", "false", "null")
+                           else json.dumps(tok))
+                i = m.end()
+            else:
+                out.append(c)
+                i += 1
+    return "".join(out)
 
 
 def build_rest_controller(node) -> RestController:
@@ -114,7 +169,12 @@ def build_rest_controller(node) -> RestController:
         return {
             "status": 200,
             "name": node.name,
-            "version": {"number": str(CURRENT)},
+            "version": {
+                "number": str(CURRENT),
+                "build_snapshot": True,
+                # the device-index core stands in for Lucene (SURVEY.md §2.8)
+                "lucene_version": str(CURRENT),
+            },
             "tagline": "You Know, for Search (TPU-native)",
         }
 
@@ -143,6 +203,9 @@ def build_rest_controller(node) -> RestController:
         r = client.create(req.path_params["index"], req.path_params["type"], body,
                           id=req.path_params["id"], routing=req.param("routing"),
                           parent=req.param("parent"),
+                          version=int(req.param("version")) if req.param("version")
+                          else None,
+                          version_type=req.param("version_type", "internal"),
                           refresh=req.bool_param("refresh"),
                           timestamp=req.param("timestamp"), ttl=req.param("ttl"))
         return RestResponse(201, r)
@@ -188,6 +251,7 @@ def build_rest_controller(node) -> RestController:
                        req.path_params["id"], routing=req.param("routing"),
                        parent=req.param("parent"),
                        realtime=req.bool_param("realtime", True),
+                       refresh=req.bool_param("refresh"),
                        preference=req.param("preference"))
         return _render_get(req, r)
 
@@ -196,7 +260,9 @@ def build_rest_controller(node) -> RestController:
     def doc_source(req):
         r = client.get(req.path_params["index"], req.path_params["type"],
                        req.path_params["id"], routing=req.param("routing"),
-                       parent=req.param("parent"))
+                       parent=req.param("parent"),
+                       realtime=req.bool_param("realtime", True),
+                       refresh=req.bool_param("refresh"))
         if not r["found"]:
             return RestResponse(404, {"found": False})
         from ..actions import filter_source
@@ -215,6 +281,7 @@ def build_rest_controller(node) -> RestController:
                           parent=req.param("parent"),
                           version=int(req.param("version")) if req.param("version")
                           else None,
+                          version_type=req.param("version_type", "internal"),
                           refresh=req.bool_param("refresh"))
         return RestResponse(200 if r["found"] else 404, r)
 
@@ -222,6 +289,11 @@ def build_rest_controller(node) -> RestController:
 
     def doc_update(req):
         body = _parse_body(req)
+        # script/lang/params may arrive as query params (ref: RestUpdateAction)
+        if req.param("script") is not None:
+            body.setdefault("script", req.param("script"))
+        if req.param("lang") is not None:
+            body.setdefault("lang", req.param("lang"))
         return client.update(req.path_params["index"], req.path_params["type"],
                              req.path_params["id"], body,
                              routing=req.param("routing"),
@@ -245,36 +317,72 @@ def build_rest_controller(node) -> RestController:
         if docs is None and "ids" in body:
             docs = [{"_index": default_index, "_type": default_type, "_id": i}
                     for i in body["ids"]]
+        # request-level params are per-doc defaults (ref: RestMultiGetAction)
+        source_param = req.param("_source")
+        if source_param in ("true", "false"):
+            source_param = source_param == "true"
+        elif isinstance(source_param, str):
+            source_param = source_param.split(",")
+        if req.param("_source_include") or req.param("_source_exclude"):
+            source_param = {
+                "include": str(req.param("_source_include")).split(",")
+                if req.param("_source_include") else [],
+                "exclude": str(req.param("_source_exclude")).split(",")
+                if req.param("_source_exclude") else []}
         for d in docs or []:
             if not d.get("_index") and default_index:
                 d["_index"] = default_index
             if not d.get("_type") and default_type:
                 d["_type"] = default_type
+            if req.param("fields") is not None:
+                d.setdefault("fields", str(req.param("fields")).split(","))
+            if source_param is not None:
+                d.setdefault("_source", source_param)
+            if req.param("realtime") is not None:
+                d.setdefault("realtime", req.bool_param("realtime", True))
+            if req.param("refresh") is not None:
+                d.setdefault("refresh", req.bool_param("refresh"))
+            if req.param("routing") is not None:
+                d.setdefault("routing", req.param("routing"))
         return client.mget(docs or [])
 
     rc.register("GET,POST", "/_mget", mget)
     rc.register("GET,POST", "/{index}/_mget", mget)
     rc.register("GET,POST", "/{index}/{type}/_mget", mget)
 
+    _BULK_OPS = ("index", "create", "update", "delete")
+
     def bulk(req):
-        raw = req.body if isinstance(req.body, str) else ""
-        operations = []
-        if isinstance(req.body, list):  # pre-parsed
-            operations = req.body
+        # Normalize every accepted body shape (ndjson string, list of strings,
+        # list of pre-parsed objects) into one stream of parsed JSON objects.
+        stream = []
+        if isinstance(req.body, list):
+            for item in req.body:
+                if isinstance(item, str):
+                    stream.extend(json.loads(ln) for ln in item.split("\n") if ln.strip())
+                else:
+                    stream.append(item)
         else:
-            lines = [ln for ln in raw.split("\n") if ln.strip()]
-            i = 0
-            while i < len(lines):
-                action = json.loads(lines[i])
-                (op, meta), = action.items()
-                meta.setdefault("_index", req.path_params.get("index"))
-                meta.setdefault("_type", req.path_params.get("type", "_default_"))
-                entry = {"action": action}
+            raw = req.body if isinstance(req.body, str) else ""
+            stream = [json.loads(ln) for ln in raw.split("\n") if ln.strip()]
+        operations = []
+        i = 0
+        while i < len(stream):
+            action = stream[i]
+            if not isinstance(action, dict) or len(action) != 1 or next(iter(action)) not in _BULK_OPS:
+                from ..common.errors import IllegalArgumentError
+                raise IllegalArgumentError(
+                    f"Malformed action/metadata line [{i + 1}], expected one of {_BULK_OPS}")
+            (op, meta), = action.items()
+            meta = dict(meta) if isinstance(meta, dict) else {}
+            meta.setdefault("_index", req.path_params.get("index"))
+            meta.setdefault("_type", req.path_params.get("type", "_default_"))
+            entry = {"action": {op: meta}}
+            i += 1
+            if op != "delete":
+                entry["source"] = stream[i] if i < len(stream) else {}
                 i += 1
-                if op != "delete":
-                    entry["source"] = json.loads(lines[i]) if i < len(lines) else {}
-                    i += 1
-                operations.append(entry)
+            operations.append(entry)
         return client.bulk(operations, refresh=req.bool_param("refresh"))
 
     rc.register("POST,PUT", "/_bulk", bulk)
@@ -295,20 +403,37 @@ def build_rest_controller(node) -> RestController:
                 ({s.split(":")[0]: s.split(":")[1]} if ":" in s else s)
                 for s in str(req.param("sort")).split(",")
             ]
+        if req.param("_source") is not None:
+            sp = req.param("_source")
+            if sp in ("true", "false"):
+                body["_source"] = sp == "true"
+            else:
+                body["_source"] = str(sp).split(",")
+        if req.param("_source_include") or req.param("_source_exclude"):
+            # query params override the body directive (ref: RestSearchAction
+            # fetchSource handling)
+            body["_source"] = {
+                "includes": str(req.param("_source_include")).split(",")
+                if req.param("_source_include") else [],
+                "excludes": str(req.param("_source_exclude")).split(",")
+                if req.param("_source_exclude") else []}
+        if req.param("fields") is not None:
+            body["fields"] = str(req.param("fields")).split(",")
         return body
 
     def search(req):
         body = _search_body(req)
         index = req.path_params.get("index", "_all")
+        search_type = req.param("search_type", "query_then_fetch")
         scroll = req.param("scroll")
         if scroll:
-            return _scrolled_search(index, body, scroll)
+            return _scrolled_search(index, body, scroll, scan=search_type == "scan")
         return client.search(index, body,
-                             search_type=req.param("search_type", "query_then_fetch"),
+                             search_type=search_type,
                              routing=req.param("routing"),
                              preference=req.param("preference"))
 
-    def _scrolled_search(index, body, keep_alive):
+    def _scrolled_search(index, body, keep_alive, scan=False):
         import uuid as _uuid
 
         r = client.search(index, {**body, "from": 0,
@@ -316,16 +441,21 @@ def build_rest_controller(node) -> RestController:
         sid = _uuid.uuid4().hex
         size = body.get("size", 10)
         hits = r["hits"]["hits"]
-        scroll_registry[sid] = (hits, size, size)
+        # scan: the initial response carries no hits; pages come from scroll calls
+        # (ref: search/scan/ScanContext.java — doc-order pagination)
+        pos = 0 if scan else size
+        scroll_registry[sid] = (hits, size, pos)
         r["_scroll_id"] = sid
-        r["hits"]["hits"] = hits[:size]
+        r["hits"]["hits"] = [] if scan else hits[:size]
         return r
 
     def scroll(req):
-        body = _parse_body(req)
-        sid = body.get("scroll_id") or req.param("scroll_id") or (
-            req.body if isinstance(req.body, str) and req.body and
-            not req.body.startswith("{") else None)
+        body = _parse_body(req) if not (
+            isinstance(req.body, str) and req.body and not req.body.lstrip().startswith("{")) else {}
+        sid = (req.path_params.get("scroll_id") or body.get("scroll_id")
+               or req.param("scroll_id") or (
+                   req.body.strip() if isinstance(req.body, str) and req.body and
+                   not req.body.lstrip().startswith("{") else None))
         if sid not in scroll_registry:
             from ..common.errors import SearchContextMissingError
 
@@ -340,14 +470,23 @@ def build_rest_controller(node) -> RestController:
     rc.register("GET,POST", "/{index}/{type}/_search", search)
     rc.register("GET,POST", "/_search", search)
     rc.register("GET,POST", "/_search/scroll", scroll)
+    rc.register("GET,POST", "/_search/scroll/{scroll_id}", scroll)
 
     def clear_scroll(req):
-        body = _parse_body(req)
-        for sid in body.get("scroll_id", []):
+        sids = []
+        if req.path_params.get("scroll_id"):
+            sids = req.path_params["scroll_id"].split(",")
+        else:
+            body = _parse_body(req)
+            sids = body.get("scroll_id", [])
+            if isinstance(sids, str):
+                sids = sids.split(",")
+        for sid in sids:
             scroll_registry.pop(sid, None)
         return {"succeeded": True}
 
     rc.register("DELETE", "/_search/scroll", clear_scroll)
+    rc.register("DELETE", "/_search/scroll/{scroll_id}", clear_scroll)
 
     def msearch(req):
         raw = req.body if isinstance(req.body, str) else ""
@@ -375,8 +514,24 @@ def build_rest_controller(node) -> RestController:
     rc.register("GET,POST", "/{index}/_suggest", suggest)
 
     def explain(req):
-        return client.explain(req.path_params["index"], req.path_params["type"],
-                              req.path_params["id"], _parse_body(req))
+        body = _parse_body(req)
+        if req.param("q"):
+            body = {"query": {"query_string": {"query": req.param("q")}}}
+        out = client.explain(req.path_params["index"], req.path_params["type"],
+                             req.path_params["id"], body)
+        # _source/fields params attach a get section (ref: RestExplainAction fetchSource)
+        if (req.param("_source") is not None or req.param("_source_include")
+                or req.param("_source_exclude") or req.param("fields")):
+            g = client.get(req.path_params["index"], req.path_params["type"],
+                           req.path_params["id"], routing=req.param("routing"))
+            if g.get("found"):
+                rendered = _render_get(req, g).body
+                get_sec = {"found": True}
+                for k in ("fields", "_source"):
+                    if k in rendered:
+                        get_sec[k] = rendered[k]
+                out["get"] = get_sec
+        return out
 
     rc.register("GET,POST", "/{index}/{type}/{id}/_explain", explain)
 
@@ -398,9 +553,21 @@ def build_rest_controller(node) -> RestController:
     def mtermvectors(req):
         body = _parse_body(req)
         docs = body.get("docs", [])
+        ids = body.get("ids") or (
+            str(req.param("ids")).split(",") if req.param("ids") else [])
+        docs = docs + [{"_id": i} for i in ids]
         for d in docs:
             d.setdefault("_index", req.path_params.get("index"))
             d.setdefault("_type", req.path_params.get("type", "_all"))
+            # query params are per-doc defaults (ref: RestMultiTermVectorsAction)
+            for flag, dflt in (("term_statistics", False), ("field_statistics", True),
+                               ("positions", True), ("offsets", True)):
+                if req.param(flag) is not None:
+                    d.setdefault(flag, req.bool_param(flag, dflt))
+            if req.param("routing") is not None:
+                d.setdefault("routing", req.param("routing"))
+            if req.param("fields") is not None:
+                d.setdefault("fields", str(req.param("fields")).split(","))
         return client.mtermvectors(docs)
 
     rc.register("GET,POST", "/_mtermvectors", mtermvectors)
@@ -476,6 +643,8 @@ def build_rest_controller(node) -> RestController:
     rc.register("GET", "/{index}/_mapping/{type}",
                 lambda r: client.get_mapping(r.path_params["index"], r.path_params["type"]))
     rc.register("GET", "/_mapping", lambda r: client.get_mapping())
+    rc.register("GET", "/_mapping/{type}",
+                lambda r: client.get_mapping(None, r.path_params["type"]))
 
     def get_field_mapping(req):
         return client.get_field_mapping(
@@ -517,6 +686,10 @@ def build_rest_controller(node) -> RestController:
             "alias": req.path_params["name"], **_parse_body(req)}}]})
 
     def get_alias(req):
+        return client.get_alias(req.path_params.get("index"),
+                                req.path_params.get("name"))
+
+    def get_aliases(req):
         return client.get_aliases(req.path_params.get("index"),
                                   req.path_params.get("name"))
 
@@ -536,6 +709,8 @@ def build_rest_controller(node) -> RestController:
     rc.register("GET", "/_alias/{name}", get_alias)
     rc.register("GET", "/{index}/_alias", get_alias)
     rc.register("GET", "/{index}/_alias/{name}", get_alias)
+    rc.register("GET", "/_aliases/{name}", get_aliases)
+    rc.register("GET", "/{index}/_aliases/{name}", get_aliases)
     rc.register("HEAD", "/_alias/{name}", exists_alias)
     rc.register("HEAD", "/{index}/_alias", exists_alias)
     rc.register("HEAD", "/{index}/_alias/{name}", exists_alias)
@@ -558,13 +733,56 @@ def build_rest_controller(node) -> RestController:
                 lambda r: client.clear_cache(r.path_params["index"]))
 
     def analyze(req):
+        """ref: RestAnalyzeAction — analyzer by name, ad-hoc tokenizer+filters chain,
+        or a mapped field's analyzer when index+field are given."""
         body = _parse_body(req)
         text = body.get("text") or req.param("text") or (
             req.body if isinstance(req.body, str) and not req.body.startswith("{") else "")
-        analyzer_name = body.get("analyzer") or req.param("analyzer") or "standard"
-        from ..analysis import get_analyzer
+        analyzer_name = body.get("analyzer") or req.param("analyzer")
+        field = body.get("field") or req.param("field")
+        tokenizer_name = body.get("tokenizer") or req.param("tokenizer")
+        raw_filters = (body.get("filters") or body.get("token_filters")
+                       or req.param("filters") or req.param("token_filters"))
+        from ..analysis.core import (
+            TOKENIZERS, TOKEN_FILTERS, _PARAMETRIC_FILTERS, Analyzer, get_analyzer)
+        from ..common.errors import IllegalArgumentError
+        from ..common.settings import Settings as _Settings
 
-        a = get_analyzer(analyzer_name)
+        svc = None
+        index = req.path_params.get("index")
+        if index:
+            names = node.cluster_service.state.metadata.resolve_indices(index)
+            svc = node.indices.index_service(names[0])
+        if tokenizer_name:
+            tk = TOKENIZERS.get(tokenizer_name)
+            if tk is None:
+                raise IllegalArgumentError(f"unknown tokenizer [{tokenizer_name}]")
+            names_list = ([f.strip() for f in str(raw_filters).split(",") if f.strip()]
+                          if isinstance(raw_filters, str) else list(raw_filters or []))
+            filters = []
+            for fn in names_list:
+                if fn in TOKEN_FILTERS:
+                    filters.append(TOKEN_FILTERS[fn])
+                elif fn in _PARAMETRIC_FILTERS:
+                    filters.append(_PARAMETRIC_FILTERS[fn](_Settings.EMPTY))
+                else:
+                    raise IllegalArgumentError(f"unknown token filter [{fn}]")
+            a = Analyzer("_custom_", tk, filters)
+        elif field and svc is not None:
+            ms = svc.mapper_service
+            ft = ms.field_type(field)
+            if ft is not None and ft.is_text and ft.index == "not_analyzed":
+                a = get_analyzer("keyword")
+            elif ft is not None and ft.is_text:
+                a = ms.analysis.analyzer(ft.analyzer)
+            else:
+                a = ms.analysis.analyzer("default")
+        elif analyzer_name:
+            a = (svc.mapper_service.analysis.analyzer(analyzer_name) if svc is not None
+                 else get_analyzer(analyzer_name))
+        else:
+            a = (svc.mapper_service.analysis.analyzer("default") if svc is not None
+                 else get_analyzer("standard"))
         return {"tokens": [
             {"token": t.term, "start_offset": t.start, "end_offset": t.end,
              "type": "<ALPHANUM>", "position": t.position + 1}
@@ -586,19 +804,28 @@ def build_rest_controller(node) -> RestController:
                     timeout=float(str(r.param("timeout", "10")).rstrip("s"))))
     rc.register("GET", "/_cluster/health/{index}",
                 lambda r: client.cluster_health(index=r.path_params["index"]))
-    rc.register("GET", "/_cluster/state", lambda r: client.cluster_state())
+    rc.register("GET", "/_cluster/state",
+                lambda r: client.cluster_state(index_templates=r.param("index_templates")))
     rc.register("GET", "/_cluster/state/{metric}",
-                lambda r: client.cluster_state(metric=r.path_params["metric"]))
+                lambda r: client.cluster_state(metric=r.path_params["metric"],
+                                               index_templates=r.param("index_templates")))
     rc.register("GET", "/_cluster/state/{metric}/{index}",
                 lambda r: client.cluster_state(metric=r.path_params["metric"],
-                                               index=r.path_params["index"]))
+                                               index=r.path_params["index"],
+                                               index_templates=r.param("index_templates")))
     rc.register("GET", "/_cluster/pending_tasks", lambda r: client.pending_tasks())
     rc.register("PUT", "/_cluster/settings",
-                lambda r: client.cluster_update_settings(_parse_body(r)))
+                lambda r: client.cluster_update_settings(
+                    _parse_body(r), flat=r.bool_param("flat_settings")))
+    rc.register("GET", "/_cluster/settings",
+                lambda r: client.cluster_get_settings(flat=r.bool_param("flat_settings")))
     rc.register("POST", "/_cluster/reroute",
                 lambda r: client.cluster_reroute(_parse_body(r)))
     rc.register("GET", "/_nodes", lambda r: client.nodes_info())
     rc.register("GET", "/_nodes/stats", lambda r: client.nodes_stats())
+    rc.register("GET", "/_nodes/stats/{metric}", lambda r: client.nodes_stats())
+    rc.register("GET", "/_nodes/{node_id}/stats", lambda r: client.nodes_stats())
+    rc.register("GET", "/_nodes/{node_id}/stats/{metric}", lambda r: client.nodes_stats())
     rc.register("GET", "/_cluster/nodes/hot_threads", lambda r: _hot_threads())
     rc.register("GET", "/_nodes/hot_threads", lambda r: _hot_threads())
 
@@ -618,6 +845,58 @@ def build_rest_controller(node) -> RestController:
         return RestResponse(200, "\n".join(out), content_type="text/plain")
 
     # --- _cat APIs (plain text ops views — ref: rest/action/cat/) -----------
+    # Shared table renderer (ref: rest/action/support/RestTable.java): ?help lists
+    # columns, ?v adds a header row, ?h= selects columns by name or alias.
+    def _cat_table(req, columns, rows):
+        # columns: (name, alias, help_text); rows: dicts keyed by column name
+        if req.bool_param("help"):
+            text = "".join(f"{name} | {alias or name} | {help_}\n"
+                           for name, alias, help_ in columns)
+            return RestResponse(200, text, content_type="text/plain")
+        by_key = {}
+        for c in columns:
+            by_key[c[0]] = c
+            if c[1]:
+                by_key.setdefault(c[1], c)
+        if req.param("h"):
+            selected = [(h, by_key[h]) for h in str(req.param("h")).split(",")
+                        if h in by_key]
+        else:
+            selected = [(c[0], c) for c in columns]
+        table = []
+        if req.bool_param("v"):
+            table.append([disp for disp, _ in selected])
+        for row in rows:
+            table.append([str(row.get(c[0], "")) for _, c in selected])
+        if not table:
+            return RestResponse(200, "", content_type="text/plain")
+        widths = [max(len(r[i]) for r in table) for i in range(len(selected))]
+        # numbers right-align, text left-aligns (ref: RestTable cell alignment)
+        num_col = [all(r[i].replace(".", "", 1).isdigit()
+                       for r in (table[1:] if req.bool_param("v") else table)
+                       if r[i] != "")
+                   for i in range(len(selected))]
+        lines = []
+        for ri, r in enumerate(table):
+            is_header = req.bool_param("v") and ri == 0
+            cells = [cell.ljust(w) if is_header or not num_col[i]
+                     else cell.rjust(w)
+                     for i, (cell, w) in enumerate(zip(r, widths))]
+            lines.append(" ".join(cells) + " ")
+        return RestResponse(200, "".join(ln + "\n" for ln in lines),
+                            content_type="text/plain")
+
+    from ..common.units import format_bytes as _fmt_bytes
+
+    def _node_host_ip():
+        import socket
+
+        try:
+            host = socket.gethostname()
+        except OSError:
+            host = "localhost"
+        return host, "127.0.0.1"
+
     def cat_health(req):
         h = client.cluster_health()
         return RestResponse(200, f"{h['cluster_name']} {h['status']} "
@@ -650,11 +929,44 @@ def build_rest_controller(node) -> RestController:
 
     def cat_shards(req):
         state = node.cluster_service.state
-        lines = []
+        host, ip = _node_host_ip()
+        local_stats = node.indices.stats()
+        index_filter = req.path_params.get("index")
+        wanted_indices = set(state.metadata.resolve_indices(index_filter)) \
+            if index_filter else None
+        rows = []
         for s in state.routing_table.all_shards():
-            kind = "p" if s.primary else "r"
-            lines.append(f"{s.index} {s.shard_id} {kind} {s.state} {s.node_id or '-'}")
-        return RestResponse(200, "\n".join(lines) + "\n", content_type="text/plain")
+            if wanted_indices is not None and s.index not in wanted_indices:
+                continue
+            row = {"index": s.index, "shard": s.shard_id,
+                   "prirep": "p" if s.primary else "r", "state": s.state}
+            if s.node_id is not None:
+                n = state.nodes.get(s.node_id)
+                row["node"] = n.name if n else s.node_id
+                row["ip"] = ip
+                st = (local_stats.get(s.index, {}).get("shards", {})
+                      .get(s.shard_id))
+                if st:
+                    row["docs"] = st["docs"]["count"]
+                    import os as _os
+
+                    path = _os.path.join(node.data_path, "indices", s.index,
+                                         str(s.shard_id))
+                    size = 0
+                    for dp, _, fs in _os.walk(path):
+                        for f in fs:
+                            try:
+                                size += _os.path.getsize(_os.path.join(dp, f))
+                            except OSError:
+                                pass
+                    row["store"] = _fmt_bytes(size)
+            rows.append(row)
+        return _cat_table(req, [
+            ("index", "i", "index name"), ("shard", "s", "shard id"),
+            ("prirep", "p", "primary or replica"), ("state", "st", "shard state"),
+            ("docs", "d", "number of docs"), ("store", "sto", "store size"),
+            ("ip", None, "node ip"), ("node", "n", "node name"),
+        ], rows)
 
     def cat_master(req):
         state = node.cluster_service.state
@@ -663,25 +975,84 @@ def build_rest_controller(node) -> RestController:
                             content_type="text/plain")
 
     def cat_allocation(req):
+        import shutil as _shutil
+
         state = node.cluster_service.state
         counts: dict[str, int] = {}
         for s in state.routing_table.all_shards():
             if s.node_id:
                 counts[s.node_id] = counts.get(s.node_id, 0) + 1
-        lines = [f"{nid} {cnt}" for nid, cnt in sorted(counts.items())]
-        return RestResponse(200, "\n".join(lines) + "\n", content_type="text/plain")
+        node_filter = req.path_params.get("node_id")
+        host, ip = _node_host_ip()
+        rows = []
+        unassigned = sum(1 for s in state.routing_table.all_shards()
+                         if s.node_id is None)
+        for n in state.nodes.nodes:
+            if node_filter and node_filter not in ("_all",):
+                if node_filter == "_master":
+                    if n.id != state.nodes.master_id:
+                        continue
+                elif node_filter not in (n.id, n.name):
+                    continue
+            try:
+                du = _shutil.disk_usage(node.data_path)
+                used, avail, total = du.used, du.free, du.total
+            except OSError:
+                used = avail = total = 0
+            unit = req.param("bytes")  # raw integers in a fixed unit when given
+            div = {"b": 1, "k": 1024, "m": 1024 ** 2, "g": 1024 ** 3,
+                   "t": 1024 ** 4}.get(unit)
+            fmt = (lambda v: str(int(v / div))) if div else _fmt_bytes
+            rows.append({
+                "shards": counts.get(n.id, 0),
+                "disk.used": fmt(used), "disk.avail": fmt(avail),
+                "disk.total": fmt(total),
+                "disk.percent": int(used * 100 / total) if total else 0,
+                "host": host, "ip": ip, "node": n.name,
+            })
+        if unassigned and not node_filter:
+            rows.append({"shards": unassigned, "node": "UNASSIGNED"})
+        return _cat_table(req, [
+            ("shards", None, "number of shards on node"),
+            ("disk.used", "du", "disk used"),
+            ("disk.avail", "da", "disk available"),
+            ("disk.total", "dt", "total disk capacity"),
+            ("disk.percent", "dp", "percent of disk used"),
+            ("host", "h", "host name"), ("ip", None, "ip address"),
+            ("node", "n", "node name"),
+        ], rows)
 
     def cat_count(req):
+        import time as _time
+
         index = req.path_params.get("index")
         c = client.count(index or "_all")["count"]
-        return RestResponse(200, f"{c}\n", content_type="text/plain")
+        now = int(_time.time())
+        return _cat_table(req, [
+            ("epoch", "t", "seconds since 1970-01-01 00:00:00"),
+            ("timestamp", "ts", "time in HH:MM:SS"),
+            ("count", "dc", "the document count"),
+        ], [{"epoch": now,
+             "timestamp": _time.strftime("%H:%M:%S", _time.localtime(now)),
+             "count": c}])
 
     def cat_aliases(req):
-        lines = []
-        for index, spec in client.get_aliases().items():
-            for alias in spec["aliases"]:
-                lines.append(f"{alias} {index}")
-        return RestResponse(200, "\n".join(lines) + "\n", content_type="text/plain")
+        rows = []
+        for index, spec in client.get_aliases(
+                None, req.path_params.get("name")).items():
+            for alias, aspec in spec["aliases"].items():
+                rows.append({
+                    "alias": alias, "index": index,
+                    "filter": "*" if aspec.get("filter") else "-",
+                    "routing.index": aspec.get("index_routing", "-"),
+                    "routing.search": aspec.get("search_routing", "-"),
+                })
+        return _cat_table(req, [
+            ("alias", "a", "alias name"), ("index", "i", "index the alias points to"),
+            ("filter", "f", "whether the alias has a filter"),
+            ("routing.index", "ri", "index routing"),
+            ("routing.search", "rs", "search routing"),
+        ], rows)
 
     def cat_pending_tasks(req):
         tasks = client.pending_tasks()["tasks"]
@@ -697,10 +1068,45 @@ def build_rest_controller(node) -> RestController:
                              f"docs={st['docs']['count']}")
         return RestResponse(200, "\n".join(lines) + "\n", content_type="text/plain")
 
+    _POOL_ALIASES = {
+        "bulk": "b", "flush": "f", "generic": "ge", "get": "g", "index": "i",
+        "management": "ma", "merge": "m", "optimize": "o", "percolate": "p",
+        "refresh": "r", "search": "s", "snapshot": "sn", "suggest": "su",
+        "warmer": "w",
+    }
+
     def cat_thread_pool(req):
-        lines = [f"{name} {st['threads']} {st['completed']}"
-                 for name, st in node.threadpool.stats().items()]
-        return RestResponse(200, "\n".join(lines) + "\n", content_type="text/plain")
+        import os as _os
+
+        host, ip = _node_host_ip()
+        stats = node.threadpool.stats()
+        columns = [
+            ("pid", None, "process id"), ("id", None, "node id"),
+            ("host", "h", "host name"), ("ip", "i", "ip address"),
+            ("port", "po", "bound transport port"),
+        ]
+        pool_cols = []
+        for pool, alias in _POOL_ALIASES.items():
+            pool_cols += [
+                (f"{pool}.active", f"{alias}a", f"number of active {pool} threads"),
+                (f"{pool}.queue", f"{alias}q", f"number of {pool} threads in queue"),
+                (f"{pool}.rejected", f"{alias}r", f"number of rejected {pool} threads"),
+            ]
+        columns += pool_cols
+        node_id = node.node_id if req.bool_param("full_id") else node.node_id[:4]
+        row = {"pid": _os.getpid(), "id": node_id, "host": host, "ip": ip,
+               "port": 9300}
+        for pool in _POOL_ALIASES:
+            st = stats.get(pool, {})
+            row[f"{pool}.active"] = st.get("active", 0)
+            row[f"{pool}.queue"] = st.get("queue", 0)
+            row[f"{pool}.rejected"] = st.get("rejected", 0)
+        # default view: host/ip + bulk, index, search activity (ref: RestThreadPoolAction)
+        default = [columns[2], columns[3]] + [
+            c for c in pool_cols if c[0].split(".")[0] in ("bulk", "index", "search")]
+        if req.param("h") or req.bool_param("help"):
+            return _cat_table(req, columns, [row])
+        return _cat_table(req, default, [row])
 
     # --- percolate -----------------------------------------------------------
     def percolate(req):
@@ -805,11 +1211,14 @@ def build_rest_controller(node) -> RestController:
     rc.register("GET", "/_cat/nodes", cat_nodes)
     rc.register("GET", "/_cat/indices", cat_indices)
     rc.register("GET", "/_cat/shards", cat_shards)
+    rc.register("GET", "/_cat/shards/{index}", cat_shards)
     rc.register("GET", "/_cat/master", cat_master)
     rc.register("GET", "/_cat/allocation", cat_allocation)
+    rc.register("GET", "/_cat/allocation/{node_id}", cat_allocation)
     rc.register("GET", "/_cat/count", cat_count)
     rc.register("GET", "/_cat/count/{index}", cat_count)
     rc.register("GET", "/_cat/aliases", cat_aliases)
+    rc.register("GET", "/_cat/aliases/{name}", cat_aliases)
     rc.register("GET", "/_cat/pending_tasks", cat_pending_tasks)
     rc.register("GET", "/_cat/recovery", cat_recovery)
     rc.register("GET", "/_cat/thread_pool", cat_thread_pool)

@@ -53,8 +53,11 @@ def filter_source(source: dict, includes, excludes) -> dict:
 def _included(path: str, includes, excludes) -> bool:
     if not includes:
         return True
+    # a pattern naming an ancestor keeps the whole subtree; one naming a descendant
+    # keeps walking through this node
     return any(
         fnmatch.fnmatch(path, pat) or pat.startswith(path + ".")
+        or path.startswith(pat + ".")
         for pat in includes
     )
 
@@ -76,8 +79,13 @@ def source_spec(body: dict):
         return True, [spec], []
     if isinstance(spec, list):
         return True, spec, []
-    return True, spec.get("includes") or spec.get("include") or [], \
-        spec.get("excludes") or spec.get("exclude") or []
+    def as_list(v):
+        if v is None:
+            return []
+        return [v] if isinstance(v, str) else list(v)
+
+    return True, as_list(spec.get("includes") or spec.get("include")), \
+        as_list(spec.get("excludes") or spec.get("exclude"))
 
 
 def extract_field(source: dict, path: str) -> list:
@@ -347,6 +355,13 @@ def build_hit(seg, local: int, score: float, body: dict, query: Query, ctx,
     if shard_id is not None:
         hit["_shard"] = shard_id
     enabled, includes, excludes = source_spec(body)
+    fields_directive = body.get("fields") or body.get("stored_fields")
+    if fields_directive and body.get("_source") is None:
+        # a fields list suppresses _source unless it names "_source" itself
+        # (ref: fetch/FieldsParseElement source handling)
+        listed = [fields_directive] if isinstance(fields_directive, str) \
+            else list(fields_directive)
+        enabled = "_source" in listed
     if enabled and seg.stored[local] is not None:
         hit["_source"] = filter_source(seg.stored[local], includes, excludes)
     if body.get("version"):

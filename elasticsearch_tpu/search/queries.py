@@ -549,8 +549,29 @@ def _parse_query_string(spec) -> Query:
     )
 
 
+def _parse_template(spec) -> Query:
+    """Template query (ref: index/query/TemplateQueryParser): mustache-substitute
+    `params` into `query` (an object tree or a JSON string), then parse the result."""
+    import json as _json
+
+    tpl = spec.get("query")
+    params = spec.get("params") or {}
+
+    def subst(s: str) -> str:
+        for k, v in params.items():
+            s = s.replace("{{%s}}" % k, str(v))
+        return s
+
+    if isinstance(tpl, str):
+        rendered = _json.loads(subst(tpl))
+    else:
+        rendered = _json.loads(subst(_json.dumps(tpl)))
+    return parse_query(rendered)
+
+
 _QUERY_PARSERS = {
     "match_all": lambda s: MatchAllQuery(boost=float((s or {}).get("boost", 1.0))),
+    "template": _parse_template,
     "match": _parse_match,
     "match_phrase": _parse_match_phrase,
     "match_phrase_prefix": _parse_match_phrase_prefix,

@@ -1,0 +1,64 @@
+"""Standalone conformance failure reporter — runs the YAML suite once and prints every
+failing section's first error, grouped by file. Dev tool, not a pytest test.
+
+Usage: python tests/conformance_report.py [substring-filter ...]
+"""
+
+import sys
+import tempfile
+
+from tests import restspec
+from tests.test_rest_conformance import make_dispatch, wipe, BLACKLIST
+
+
+def main():
+    filters = sys.argv[1:]
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.transport.local import LocalTransportRegistry
+    from elasticsearch_tpu.rest.controller import build_rest_controller
+
+    registry = LocalTransportRegistry()
+    node = Node(name="conformance", registry=registry,
+                data_path=tempfile.mkdtemp(prefix="conf-"),
+                settings={"index.number_of_shards": 2,
+                          "index.number_of_replicas": 0})
+    node.start([node.local_node.transport_address])
+    node.wait_for_master()
+    controller = build_rest_controller(node)
+    dispatch = make_dispatch(controller)
+    specs = restspec.load_specs()
+
+    suites = restspec.discover_suites()
+    if filters:
+        suites = [s for s in suites if any(f in s for f in filters)]
+    n_pass = n_fail = 0
+    for rel_path in suites:
+        setup, sections = restspec.load_suite(rel_path)
+        failures = []
+        for name, steps in sections:
+            key = f"{rel_path}::{name}"
+            if key in BLACKLIST or rel_path in BLACKLIST:
+                continue
+            wipe(dispatch)
+            runner = restspec.YamlRunner(dispatch=dispatch, specs=specs)
+            try:
+                if setup:
+                    runner.run_steps(setup)
+                runner.run_steps(steps)
+            except restspec.SkippedSection:
+                pass
+            except Exception as e:
+                failures.append(f"  [{name}] {type(e).__name__}: {e}")
+        if failures:
+            n_fail += 1
+            print(f"FAIL {rel_path}")
+            for f in failures:
+                print(f[:500])
+        else:
+            n_pass += 1
+    print(f"\n{n_pass} passed, {n_fail} failed")
+    node.close()
+
+
+if __name__ == "__main__":
+    main()

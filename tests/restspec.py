@@ -108,11 +108,20 @@ def load_specs() -> dict[str, ApiSpec]:
     # `create` has no spec file — the reference runner maps it through the client's
     # create() (index with op_type=create); synthesize the equivalent endpoint.
     if "create" not in specs and "index" in specs:
+        index_params = raw_params = {}
+        try:
+            with open(os.path.join(api_dir, "index.json")) as f:
+                raw_params = json.load(f)["index"]["url"].get("params", {})
+        except (OSError, KeyError):
+            pass
+        index_params = dict(raw_params)
         specs["create"] = ApiSpec("create", {
             "methods": ["PUT", "POST"],
-            "url": {"paths": ["/{index}/{type}/{id}/_create"],
+            # id-less create maps to POST /{index}/{type}?op_type=create, like the
+            # reference client's create()
+            "url": {"paths": ["/{index}/{type}/{id}/_create", "/{index}/{type}"],
                     "parts": {"index": {}, "type": {}, "id": {}},
-                    "params": {}},
+                    "params": index_params},
             "body": {"required": True}})
     return specs
 
@@ -195,6 +204,8 @@ class YamlRunner:
         except ApiCallError as e:
             self._handle_catch(catch, e.status, e.body, "")
             return
+        if api == "create" and not path.endswith("/_create"):
+            query = {**query, "op_type": "create"}
         status, parsed, text = self.dispatch(method, path, query, body)
         self.last_status, self.last_body, self.last_text = status, parsed, text
         if method == "HEAD":
@@ -315,7 +326,10 @@ class YamlRunner:
             key = keys[i]
             key = self._substitute(key) if key.startswith("$") else key
             if isinstance(obj, list):
-                obj = obj[int(key)]
+                idx = int(key)
+                assert idx < len(obj), \
+                    f"path [{path}]: index {idx} out of range (len {len(obj)})"
+                obj = obj[idx]
                 i += 1
             elif isinstance(obj, dict):
                 if key in obj:

@@ -378,10 +378,12 @@ class TestSidecars:
             client.cluster_health(wait_for_status="green")
             svc = nodes[0].indices.index_service("ttl")
             shard = svc.shard(0)
-            # one already-expired doc, one far-future doc
-            shard.engine.index("doc", "old", {"x": 1}, ttl=1, timestamp=1)
+            # one about-to-expire doc, one far-future doc (indexing an already-
+            # expired doc raises AlreadyExpiredError, as the reference does)
+            shard.engine.index("doc", "old", {"x": 1}, ttl=30)
             shard.engine.index("doc", "new", {"x": 2}, ttl="10d")
             shard.engine.refresh()
+            time.sleep(0.05)
             assert shard.engine.doc_stats()["count"] == 2
             nodes[0]._purge_expired()
             assert shard.engine.doc_stats()["count"] == 1

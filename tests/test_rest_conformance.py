@@ -48,7 +48,9 @@ def make_dispatch(controller):
 
     def dispatch(method, path, query, body):
         if isinstance(body, list):
-            body = "".join(json.dumps(line) + "\n" for line in body)
+            body = "".join(
+                (line if isinstance(line, str) else json.dumps(line)) + "\n"
+                for line in body)
         if not path.startswith("/"):
             path = "/" + path
         resp = controller.dispatch(RestRequest(
