@@ -38,6 +38,36 @@ CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
 K1, B = 1.2, 0.75
 
 
+def _ensure_backend():
+    """Probe the configured JAX backend with a deadline; fall back to CPU.
+
+    The container may pin JAX_PLATFORMS to a TPU plugin whose initialization can
+    fail or hang (tunnel down, chip busy). Probe it in a subprocess so a hung init
+    can't take the bench with it; on failure force the CPU platform in-process
+    (env var AND live jax config — jax may already be imported by a sitecustomize
+    hook, see tests/conftest.py).
+    """
+    import subprocess
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return "cpu"
+    timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", 180))
+    probe = "import jax; print(jax.devices()[0].platform)"
+    try:
+        out = subprocess.run([sys.executable, "-c", probe], capture_output=True,
+                             timeout=timeout, text=True)
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.strip().splitlines()[-1]
+        print(f"# backend probe rc={out.returncode}: {out.stderr[-500:]}",
+              file=sys.stderr)
+    except subprocess.TimeoutExpired:
+        print(f"# backend probe timed out after {timeout}s", file=sys.stderr)
+    from elasticsearch_tpu.common.jaxenv import force_cpu_platform
+
+    force_cpu_platform()
+    return "cpu (fallback)"
+
+
 def build_corpus():
     """CSR postings + norms for a zipf corpus (cached)."""
     os.makedirs(CACHE, exist_ok=True)
@@ -107,6 +137,7 @@ def cpu_reference(post_offsets, post_docs, post_freqs, cache_tbl, norm_bytes, df
 
 def main():
     t_setup = time.time()
+    _ensure_backend()
     post_offsets, post_docs, post_freqs, norm_bytes, sum_ttf, df = build_corpus()
     max_doc = N_DOCS
     avgdl = np.float32(sum_ttf / max_doc)
@@ -250,4 +281,10 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 — the driver contract is ONE JSON line, always
+        # (SystemExit passes through: the ORDERING MISMATCH path already printed its line)
+        print(json.dumps({"metric": f"bench error: {type(e).__name__}: {e}"[:300],
+                          "value": 0, "unit": "error", "vs_baseline": 0}))
+        raise

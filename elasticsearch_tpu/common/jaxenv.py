@@ -1,0 +1,35 @@
+"""JAX platform selection for this container.
+
+The image pins JAX_PLATFORMS to a real-TPU plugin and imports jax at interpreter
+startup via a sitecustomize hook, so an environ set alone does not stick — the live
+jax config must be updated too, or jax.devices() blocks initializing the TPU backend
+even when the caller wants a CPU mesh. One helper so the recipe can't drift between
+the test conftest, the driver entry, and the bench fallback.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_cpu_platform(n_devices: int | None = None) -> None:
+    """Pin jax to the CPU backend, optionally with n virtual host devices.
+
+    Safe to call before or after `import jax` (but before first device use). An
+    existing --xla_force_host_platform_device_count flag is replaced, not skipped —
+    a pre-pinned smaller count would otherwise defeat the requested mesh size.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        flag = f"--xla_force_host_platform_device_count={n_devices}"
+        if "xla_force_host_platform_device_count" in flags:
+            flags = re.sub(r"--xla_force_host_platform_device_count=\d+", flag, flags)
+        else:
+            flags = (flags + " " + flag).strip()
+        os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

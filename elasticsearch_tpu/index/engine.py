@@ -285,12 +285,12 @@ class Engine:
             if seg is None or not seg.live[loc[1]]:
                 return GetResult(found=False)
             local = loc[1]
-            parent_vals = seg.str_values("_parent", local) or []
-            ts_vals = seg.num_values("_timestamp", local) or []
-            exp_vals = seg.num_values("_expiry", local) or []
-            ts = int(ts_vals[0]) if ts_vals else None
+            parent_vals = seg.str_values("_parent", local)
+            ts_vals = seg.num_values("_timestamp", local)
+            exp_vals = seg.num_values("_expiry", local)
+            ts = int(ts_vals[0]) if len(ts_vals) else None
             ttl = None
-            if exp_vals:
+            if len(exp_vals):
                 base = ts if ts is not None else 0
                 ttl = self._remaining_ttl(base, int(exp_vals[0]) - base)
             return GetResult(True, doc_id, type_name, int(seg.versions[local]),

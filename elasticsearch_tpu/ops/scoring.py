@@ -172,15 +172,19 @@ def _get_compiled(n_queries: int, k: int, doc_pad: int, simple: bool = False):
 
 
 def _detect_simple(batch: TermBatch) -> bool:
-    """Pure-should batches (no const-score clauses, whose contribution can be 0 yet
-    still match) reduce match to score>0 — see _score_batch_impl(simple=). Cached on
-    the batch so device-resident arrays are not pulled back per call."""
+    """Pure-should all-BM25 batches reduce match to score>0 — see
+    _score_batch_impl(simple=). BM25 is the only mode whose contribution is provably
+    positive for every posting hit ((w·freq)/(freq+cache) with w>0, cache>0): CONST
+    clauses can carry weight 0, and TFIDF clauses score 0 on normless fields (norm
+    byte 0 → cache 0 — the meta-field case: term _id/_uid/_type), yet both still
+    MATCH — the simple path would drop those hits. Cached on the batch so
+    device-resident arrays are not pulled back per call."""
     if batch.simple is None:
         batch.simple = bool(
             np.all(np.asarray(batch.group) == GROUP_SHOULD)
             and np.all(np.asarray(batch.msm) <= 1)
             and np.all(np.asarray(batch.n_must) == 0)
-            and np.all(np.asarray(batch.tfmode) != MODE_CONST)
+            and np.all(np.asarray(batch.tfmode) == MODE_BM25)
             and (batch.coord is None or np.all(np.asarray(batch.coord) == 1.0)))
     return batch.simple
 

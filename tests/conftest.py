@@ -5,19 +5,11 @@ in-JVM TestCluster — SURVEY.md §4.2; we test multi-chip sharding with virtual
 devices). Must be set before jax is imported anywhere.
 """
 
-import os
+from elasticsearch_tpu.common.jaxenv import force_cpu_platform
 
-# Hard-override: the container env pins JAX_PLATFORMS=axon (real TPU via tunnel) and jax
-# is already imported at interpreter startup by the axon sitecustomize hook, so a plain
-# environ set is not enough — update the live jax config too.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax
-
-jax.config.update("jax_platforms", "cpu")
+# Hard-override: the container env pins a real-TPU JAX platform and jax is already
+# imported at interpreter startup by a sitecustomize hook — see jaxenv.py.
+force_cpu_platform(n_devices=8)
 
 import numpy as np
 import pytest
