@@ -24,6 +24,7 @@ import base64
 import threading
 import time
 import uuid
+from concurrent.futures import Future
 
 from .common.errors import (
     DocumentMissingError,
@@ -31,8 +32,10 @@ from .common.errors import (
     IndexMissingError,
     MasterNotDiscoveredError,
     NoShardAvailableError,
+    ReceiveTimeoutError,
     IndexWarmerMissingError,
     SearchEngineError,
+    TransportError,
     TypeMissingError,
     UnavailableShardsError,
     VersionConflictError,
@@ -58,6 +61,7 @@ from .search.controller import (
     sort_docs,
 )
 from .search.execute import ShardContext
+from .transport import fut_result
 from .search.queries import parse_query
 from .search.service import (
     ParsedSearchRequest,
@@ -153,18 +157,21 @@ class ActionModule:
             (ACTION_SHARD_FAILED, self._m_shard_failed),
         ]:
             t.register_handler(action, self._master_wrap(action, fn))
-        # data-path actions
-        t.register_handler(A_INDEX_PRIMARY, self._p_index)
-        t.register_handler(A_INDEX_REPLICA, self._r_index)
-        t.register_handler(A_DELETE_PRIMARY, self._p_delete)
-        t.register_handler(A_DELETE_REPLICA, self._r_delete)
-        t.register_handler(A_BULK_SHARD, self._p_bulk_shard)
-        t.register_handler(A_GET, self._s_get)
-        t.register_handler(A_TERMVECTOR, self._s_termvector)
-        t.register_handler(A_QUERY_PHASE, self._s_query_phase)
-        t.register_handler(A_FETCH_PHASE, self._s_fetch_phase)
-        t.register_handler(A_DFS_PHASE, self._s_dfs_phase)
-        t.register_handler(A_SHARD_BROADCAST, self._s_broadcast)
+        # data-path actions, each on its named pool (ref: every TransportAction names
+        # its ThreadPool executor — search ops on SEARCH, writes on INDEX/BULK, …).
+        # The dispatch trampoline ("generic") then never blocks on handler work, so
+        # concurrent fan-outs can't starve it into a deadlock.
+        t.register_handler(A_INDEX_PRIMARY, self._p_index, executor="index")
+        t.register_handler(A_INDEX_REPLICA, self._r_index, executor="replica")
+        t.register_handler(A_DELETE_PRIMARY, self._p_delete, executor="index")
+        t.register_handler(A_DELETE_REPLICA, self._r_delete, executor="replica")
+        t.register_handler(A_BULK_SHARD, self._p_bulk_shard, executor="bulk")
+        t.register_handler(A_GET, self._s_get, executor="get")
+        t.register_handler(A_TERMVECTOR, self._s_termvector, executor="get")
+        t.register_handler(A_QUERY_PHASE, self._s_query_phase, executor="search")
+        t.register_handler(A_FETCH_PHASE, self._s_fetch_phase, executor="search")
+        t.register_handler(A_DFS_PHASE, self._s_dfs_phase, executor="search")
+        t.register_handler(A_SHARD_BROADCAST, self._s_broadcast, executor="management")
 
     # ================= master-node pattern =================
     def _master_wrap(self, action, fn):
@@ -926,18 +933,22 @@ class ActionModule:
         return {"ok": True}
 
     def _replicate(self, index: str, shard_id: int, action: str, request: dict):
-        """Fan the op to every assigned replica; failures fail the shard upward
+        """Fan the op to every assigned replica concurrently, wait for all acks
+        (sync replication default); failures fail the shard upward
         (ref: :245 fan-out + ShardStateAction on replica error)."""
         state = self.cluster_service.state
         group = state.routing_table.index(index).shard(shard_id)
+        futs = []
         for replica in group.replicas():
             if not replica.assigned:
                 continue
             node = state.nodes.get(replica.node_id)
             if node is None:
                 continue
+            futs.append((replica, self.transport.send_request(node, action, request)))
+        for replica, fut in futs:
             try:
-                self.transport.submit_request(node, action, request, timeout=30.0)
+                fut_result(fut, 30.0)
             except SearchEngineError as e:
                 self.logger.warning("replica [%s][%d] on %s failed: %s — reporting",
                                     index, shard_id, replica.node_id, e)
@@ -984,6 +995,9 @@ class ActionModule:
         for i, key, item in prepared:
             by_shard.setdefault(key, []).append((i, item))
         results: dict[int, dict] = {}
+        # all shard sub-batches in flight at once (ref: TransportBulkAction fans
+        # TransportShardBulkAction per shard asynchronously)
+        bulk_futs = []
         for (index, shard_id), items in by_shard.items():
             group = state.routing_table.index(index).shard(shard_id)
             primary = group.primary
@@ -992,11 +1006,13 @@ class ActionModule:
                 for i, item in items:
                     results[i] = {"error": "primary unavailable", "status": 503, **item}
                 continue
+            bulk_futs.append((items, self.transport.send_request(
+                node, A_BULK_SHARD,
+                {"index": index, "shard": shard_id, "refresh": refresh,
+                 "items": [item for _, item in items]})))
+        for items, fut in bulk_futs:
             try:
-                resp = self.transport.submit_request(
-                    node, A_BULK_SHARD,
-                    {"index": index, "shard": shard_id, "refresh": refresh,
-                     "items": [item for _, item in items]}, timeout=60.0)
+                resp = fut_result(fut, 60.0)
                 for (i, _item), r in zip(items, resp["items"]):
                     results[i] = r
             except SearchEngineError as e:
@@ -1306,12 +1322,15 @@ class ActionModule:
         shards = self.routing.search_shards(state, indices, routing, preference)
         dfs_stats = None
         if search_type in ("dfs_query_then_fetch", "dfs_query_and_fetch"):
-            dfs_results = []
-            for copy in shards:
-                node = state.nodes.get(copy.node_id)
-                r = self.transport.submit_request(node, A_DFS_PHASE, {
+            # concurrent DFS fan-out — the distributed-IDF all-reduce's gather leg
+            # (ref: TransportSearchDfsQueryThenFetchAction async per-shard phase)
+            dfs_futs = [(copy, self.transport.send_request(
+                state.nodes.get(copy.node_id), A_DFS_PHASE, {
                     "index": copy.index, "shard": copy.shard_id, "body": body or {},
-                }, timeout=30.0)
+                })) for copy in shards]
+            dfs_results = []
+            for copy, fut in dfs_futs:
+                r = fut_result(fut, 30.0)
                 dfs_results.append(DfsResult(
                     shard_id=copy.shard_id, max_doc=r["max_doc"],
                     term_df={(f, t): v for f, t, v in r["term_df"]},
@@ -1330,26 +1349,46 @@ class ActionModule:
         # different indices may share a shard id (ref: the per-request shard index in
         # TransportSearchTypeAction), so results carry the ordinal as shard_id
         shard_meta: dict[int, tuple] = {}  # ordinal -> (index, real_shard_id, node)
-        for ordinal, copy in enumerate(shards):
-            r, used = self._query_with_failover(state, copy, body, alias_filters,
-                                                dfs_stats, failures)
+        # concurrent query-phase fan-out: every shard's first phase is dispatched at
+        # once and failover chains advance via future callbacks, so N-shard latency is
+        # max(shard) not sum(shard) and no coordinator thread parks per shard
+        # (ref: TransportSearchTypeAction.java:135-216 async performFirstPhase)
+        query_futs = [self._query_shard_async(state, copy, body, alias_filters,
+                                              dfs_stats) for copy in shards]
+        # shared deadline: chains resolve themselves (every attempt is timer-bounded),
+        # so this is a backstop — without sharing it, k hung shards would stack k
+        # fresh waits instead of running down one clock
+        deadline = time.monotonic() + self.QUERY_ATTEMPT_TIMEOUT * 4
+        for ordinal, (copy, fut) in enumerate(zip(shards, query_futs)):
+            try:
+                r, used, err = fut.result(
+                    timeout=max(0.0, deadline - time.monotonic()))
+            except TimeoutError:
+                r, used, err = None, None, TransportError("query phase timed out")
             if r is not None:
                 shard_meta[ordinal] = (copy.index, r.shard_id, used)
                 r.shard_id = ordinal
                 results.append(r)
+            else:
+                failures.append({"index": copy.index, "shard": copy.shard_id,
+                                 "reason": str(err)})
         merged = sort_docs(req, results)
         page = merged.hits[req.from_: req.from_ + req.size]
-        # fetch phase: winners only, grouped per shard
+        # fetch phase: winners only, grouped per shard, all shards in flight at once
+        # (ref: TransportSearchQueryThenFetchAction.java:93-147)
         by_shard: dict = {}
         for rank, (score, ordinal, doc, sort_values) in enumerate(page):
             by_shard.setdefault(ordinal, []).append((rank, score, doc, sort_values))
         fetched: dict[int, dict] = {}
+        fetch_futs = []
         for ordinal, entries in by_shard.items():
             index_name, real_shard, node = shard_meta[ordinal]
-            r = self.transport.submit_request(node, A_FETCH_PHASE, {
+            fetch_futs.append((entries, self.transport.send_request(node, A_FETCH_PHASE, {
                 "index": index_name, "shard": real_shard, "body": body or {},
                 "docs": [[score, doc, sort_values] for (_rank, score, doc, sort_values) in entries],
-            }, timeout=30.0)
+            })))
+        for entries, fut in fetch_futs:
+            r = fut_result(fut, 30.0)
             for (rank, *_), hit in zip(entries, r["hits"]):
                 fetched[rank] = hit
         hits = [fetched[r] for r in sorted(fetched)]
@@ -1365,40 +1404,87 @@ class ActionModule:
                 return s.index
         return None
 
-    def _query_with_failover(self, state, copy: ShardRouting, body, alias_filters,
-                             dfs_stats, failures):
-        """Per-shard failover to the next active copy (ref: performFirstPhase:292)."""
+    QUERY_ATTEMPT_TIMEOUT = 60.0
+
+    def _query_shard_async(self, state, copy: ShardRouting, body, alias_filters,
+                           dfs_stats) -> Future:
+        """Per-shard query phase with failover to the next active copy, driven
+        entirely by future callbacks — the coordinator parks no thread per shard
+        (ref: performFirstPhase + onFirstPhaseResult failover,
+        TransportSearchTypeAction.java:135-216,292). Each attempt carries its own
+        timeout (a hung node must not stall the chain — the old blocking version
+        failed over on ReceiveTimeoutError and this one must too). Resolves to
+        (ShardQueryResult | None, node | None, error | None)."""
+        done: Future = Future()
         group = state.routing_table.index(copy.index).shard(copy.shard_id)
-        candidates = [copy] + [s for s in group.active_shards() if s.node_id != copy.node_id]
-        last_err = None
-        for candidate in candidates:
+        candidates = [copy] + [s for s in group.active_shards()
+                               if s.node_id != copy.node_id]
+
+        def attempt(i: int, last_err):
+            while i < len(candidates) and state.nodes.get(candidates[i].node_id) is None:
+                i += 1
+            if i >= len(candidates):
+                done.set_result((None, None, last_err))
+                return
+            candidate = candidates[i]
             node = state.nodes.get(candidate.node_id)
-            if node is None:
-                continue
-            try:
-                r = self.transport.submit_request(node, A_QUERY_PHASE, {
-                    "index": candidate.index, "shard": candidate.shard_id,
-                    "body": body or {},
-                    "alias_filter": alias_filters.get(candidate.index),
-                    "dfs": dfs_stats,
-                }, timeout=60.0)
-                result = ShardQueryResult(
-                    total=r["total"],
-                    docs=[tuple(d) for d in r["docs"]],
-                    max_score=r["max_score"] if r["max_score"] is not None else float("nan"),
-                    agg_partials=_decode_partials(r.get("agg_partials")),
-                    facet_partials=_decode_partials(r.get("facet_partials")),
-                    suggest=r.get("suggest"),
-                    shard_id=candidate.shard_id,
-                )
-                result.index_name = candidate.index  # type: ignore[attr-defined]
-                return result, node
-            except SearchEngineError as e:
-                last_err = e
-                continue
-        failures.append({"index": copy.index, "shard": copy.shard_id,
-                         "reason": str(last_err)})
-        return None, None
+            fut = self.transport.send_request(node, A_QUERY_PHASE, {
+                "index": candidate.index, "shard": candidate.shard_id,
+                "body": body or {},
+                "alias_filter": alias_filters.get(candidate.index),
+                "dfs": dfs_stats,
+            })
+            # exactly one of {response callback, attempt timer} consumes the attempt
+            consumed_lock = threading.Lock()
+            consumed = [False]
+
+            def consume() -> bool:
+                with consumed_lock:
+                    if consumed[0]:
+                        return False
+                    consumed[0] = True
+                    return True
+
+            def on_timeout():
+                if consume():
+                    attempt(i + 1, ReceiveTimeoutError(
+                        f"query phase attempt to [{candidate.node_id}] timed out"))
+
+            timer = self.node.threadpool.schedule(
+                self.QUERY_ATTEMPT_TIMEOUT, "generic", on_timeout)
+
+            def on_done(f):
+                if not consume():
+                    return  # timer already failed this attempt over
+                timer.cancel()
+                try:
+                    err = f.exception()
+                    if err is not None:
+                        if isinstance(err, SearchEngineError):
+                            attempt(i + 1, err)  # next replica
+                        else:
+                            done.set_result((None, None, err))
+                        return
+                    r = f.result()
+                    result = ShardQueryResult(
+                        total=r["total"],
+                        docs=[tuple(d) for d in r["docs"]],
+                        max_score=r["max_score"] if r["max_score"] is not None else float("nan"),
+                        agg_partials=_decode_partials(r.get("agg_partials")),
+                        facet_partials=_decode_partials(r.get("facet_partials")),
+                        suggest=r.get("suggest"),
+                        shard_id=candidate.shard_id,
+                    )
+                    result.index_name = candidate.index  # type: ignore[attr-defined]
+                    done.set_result((result, node, None))
+                except Exception as e:  # noqa: BLE001 — a swallowed callback error
+                    # would otherwise surface as a bogus coordinator timeout
+                    done.set_result((None, None, e))
+
+            fut.add_done_callback(on_done)
+
+        attempt(0, None)
+        return done
 
     def _shard_ctx(self, index: str, shard_id: int, dfs: dict | None = None) -> ShardContext:
         svc = self.indices.index_service(index)
@@ -1482,41 +1568,47 @@ class ActionModule:
         replication action — here resolved per shard then replicated)."""
         state = self.cluster_service.state
         indices = state.metadata.resolve_indices(index_expr)
-        total = 0
+        futs = []
         for index in indices:
             table = state.routing_table.index(index)
             for group in table.shards:
-                for copy in [s for s in group.active_shards()]:
+                for copy in group.active_shards():
                     node = state.nodes.get(copy.node_id)
-                    r = self.transport.submit_request(node, A_SHARD_BROADCAST, {
-                        "index": index, "shard": copy.shard_id, "op": "delete_by_query",
-                        "body": body}, timeout=30.0)
-                    if copy.primary:
-                        total += r.get("deleted", 0)
-        return {"_indices": {i: {"deleted": total} for i in indices}}
+                    futs.append((index, copy, self.transport.send_request(
+                        node, A_SHARD_BROADCAST, {
+                            "index": index, "shard": copy.shard_id,
+                            "op": "delete_by_query", "body": body})))
+        deleted = {i: 0 for i in indices}
+        for index, copy, fut in futs:
+            r = fut_result(fut, 30.0)
+            if copy.primary:
+                deleted[index] += r.get("deleted", 0)
+        return {"_indices": {i: {"deleted": n} for i, n in deleted.items()}}
 
     def broadcast(self, index_expr, op: str) -> dict:
         """refresh / flush / optimize across all shard copies."""
         state = self.cluster_service.state
         indices = state.metadata.resolve_indices(index_expr) if index_expr else \
             state.metadata.index_names()
-        total = 0
-        ok = 0
+        futs = []
         for index in indices:
             table = state.routing_table.index(index)
             if table is None:
                 continue
             for group in table.shards:
                 for copy in group.active_shards():
-                    total += 1
                     node = state.nodes.get(copy.node_id)
-                    try:
-                        self.transport.submit_request(node, A_SHARD_BROADCAST, {
-                            "index": index, "shard": copy.shard_id, "op": op,
-                        }, timeout=30.0)
-                        ok += 1
-                    except SearchEngineError:
-                        pass
+                    futs.append(self.transport.send_request(node, A_SHARD_BROADCAST, {
+                        "index": index, "shard": copy.shard_id, "op": op,
+                    }))
+        ok = 0
+        for fut in futs:
+            try:
+                fut_result(fut, 30.0)
+                ok += 1
+            except SearchEngineError:
+                pass
+        total = len(futs)
         return {"_shards": {"total": total, "successful": ok, "failed": total - ok}}
 
     def _s_broadcast(self, request, channel):

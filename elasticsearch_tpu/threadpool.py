@@ -24,6 +24,11 @@ Names = (
     "get",
     "index",
     "bulk",
+    # replica-side write ops get their own pool (deviation from the reference, which
+    # runs them on INDEX but never parks a thread awaiting acks — our primaries block
+    # for sync replication, so sharing a pool would allow a cross-node wait cycle:
+    # A's primaries hold all index workers waiting on B's replicas and vice versa)
+    "replica",
     "search",
     "suggest",
     "percolate",
@@ -41,6 +46,7 @@ _DEFAULT_SIZES = {
     "get": 4,
     "index": 4,
     "bulk": 4,
+    "replica": 4,
     "search": 8,
     "suggest": 2,
     "percolate": 2,
