@@ -255,48 +255,73 @@ class ExtendedStatsAgg(StatsAgg):
 
 
 class CardinalityAgg(Agg):
-    """Exact distinct count via value sets (the reference uses HyperLogLog++ for
-    bounded memory; exact is strictly more accurate at these scales, flagged for a
-    sketch swap when fields exceed the precision_threshold)."""
+    """Distinct count via a HyperLogLog++ sketch — bounded memory (2^p bytes) on
+    arbitrarily-high-cardinality fields, near-exact up to `precision_threshold`
+    (default 3000; the small range is served by linear counting, which is exact
+    while register load stays low). Shard partials are sketches; cross-shard merge
+    is a register max, so distributed counts don't double-count overlap."""
 
     def collect(self, seg, ctx, mask, scores=None):
+        from ..common.sketches import HyperLogLogPlusPlus, precision_from_threshold
+
         field = self.spec.get("field")
-        out: set = set()
+        threshold = int(self.spec.get("precision_threshold", 3000))
+        sketch = HyperLogLogPlusPlus(precision_from_threshold(threshold))
         if field in seg.dv_str:
             _, vals = _str_values(seg, field, mask)
-            out.update(vals)
+            sketch.add_values(vals)
         else:
             _, vals = _field_values(seg, field, mask)
-            out.update(vals.tolist())
-        return out
+            sketch.add_values(vals)
+        return sketch
 
     def merge(self, partials):
-        out: set = set()
+        out = None
         for p in partials:
-            out |= p
+            if out is None:
+                out = p
+            else:
+                out.merge(p)
         return out
 
     def finalize(self, merged):
-        return {"value": len(merged)}
+        return {"value": int(merged.cardinality()) if merged is not None else 0}
 
 
 class PercentilesAgg(_NumericAgg):
+    """Percentiles via a merging t-digest — O(compression) memory regardless of hit
+    count, tails kept sharp by the k1 scale function. Shard partials are digests;
+    the reduce side merges centroids (exact concatenation + re-compression)."""
+
     DEFAULT_PERCENTS = (1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0)
 
+    def _compression(self) -> float:
+        # later-ES accepts both a flat `compression` and `tdigest.compression`
+        td = self.spec.get("tdigest") or {}
+        return float(self.spec.get("compression", td.get("compression", 100.0)))
+
     def collect(self, seg, ctx, mask, scores=None):
-        return self._values(seg, ctx, mask)
+        from ..common.sketches import TDigest
+
+        digest = TDigest(self._compression())
+        digest.add_values(self._values(seg, ctx, mask))
+        return digest
 
     def merge(self, partials):
-        arrs = [p for p in partials if len(p)]
-        return np.concatenate(arrs) if arrs else np.zeros(0)
+        out = None
+        for p in partials:
+            if out is None:
+                out = p
+            else:
+                out.merge(p)
+        return out
 
     def finalize(self, merged):
         percents = self.spec.get("percents", list(self.DEFAULT_PERCENTS))
         values = {}
         for p in percents:
-            values[f"{float(p)}"] = (
-                float(np.percentile(merged, p)) if len(merged) else None
-            )
+            q = merged.quantile(float(p) / 100.0) if merged is not None else None
+            values[f"{float(p)}"] = q
         return {"values": values}
 
 

@@ -1,0 +1,130 @@
+"""HyperLogLog++ / t-digest sketches: accuracy, merging, bounded memory.
+
+The reference snapshot predates the cardinality/percentiles aggs; later Elasticsearch
+backs them with exactly these sketches and knobs (precision_threshold, compression).
+The accuracy bounds asserted here are the standard ones: HLL relative error
+~1.04/sqrt(2^p) (p=14 → ~0.8%), t-digest tail error well under 1% at δ=100.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.sketches import (
+    HyperLogLogPlusPlus,
+    TDigest,
+    hash64_ints,
+    hash64_strs,
+    precision_from_threshold,
+)
+
+
+class TestHLL:
+    def test_small_range_exact(self):
+        h = HyperLogLogPlusPlus(14)
+        h.add_values(np.arange(2000))
+        assert abs(h.cardinality() - 2000) <= 20  # linear counting ≈ exact
+
+    def test_large_range_bounded_error(self):
+        h = HyperLogLogPlusPlus(14)
+        h.add_values(np.arange(1_000_000) * 31 + 7)
+        assert abs(h.cardinality() - 1_000_000) / 1_000_000 < 0.02
+
+    def test_duplicates_do_not_count(self):
+        h = HyperLogLogPlusPlus(14)
+        for _ in range(5):
+            h.add_values(np.arange(10_000))
+        assert abs(h.cardinality() - 10_000) / 10_000 < 0.02
+
+    def test_strings(self):
+        h = HyperLogLogPlusPlus(14)
+        h.add_values([f"user-{i}" for i in range(50_000)])
+        assert abs(h.cardinality() - 50_000) / 50_000 < 0.02
+
+    def test_merge_with_overlap_and_wire(self):
+        parts = [HyperLogLogPlusPlus(12) for _ in range(4)]
+        for i, p in enumerate(parts):
+            p.add_values(np.arange(i * 20_000, (i + 1) * 20_000 + 4_000))
+        merged = parts[0]
+        for p in parts[1:]:
+            merged.merge(pickle.loads(pickle.dumps(p)))  # sketches cross the wire
+        true = 84_000
+        assert abs(merged.cardinality() - true) / true < 0.03
+
+    def test_bounded_memory(self):
+        h = HyperLogLogPlusPlus(14)
+        h.add_values(np.arange(1_000_000))
+        assert h.registers.nbytes == 1 << 14  # 16 KB no matter the cardinality
+
+    def test_precision_mapping(self):
+        assert precision_from_threshold(100) < precision_from_threshold(3000)
+        assert 4 <= precision_from_threshold(1) <= 18
+        assert precision_from_threshold(10_000_000) == 18
+
+    def test_hash_stability(self):
+        a = hash64_ints(np.array([1, 2, 3]))
+        b = hash64_ints(np.array([1, 2, 3]))
+        assert (a == b).all()
+        s1 = hash64_strs(["abc", "abcd", "abc\x00"])
+        assert len(set(s1.tolist())) == 3  # prefix/padding must not collide
+
+    def test_merge_precision_mismatch_rejected(self):
+        with pytest.raises(ValueError):
+            HyperLogLogPlusPlus(10).merge(HyperLogLogPlusPlus(12))
+
+
+class TestTDigest:
+    def test_accuracy_normal(self):
+        rng = np.random.default_rng(3)
+        data = rng.normal(100, 15, 300_000)
+        td = TDigest(100)
+        for chunk in np.array_split(data, 30):
+            td.add_values(chunk)
+        for q in (0.01, 0.5, 0.95, 0.99):
+            assert td.quantile(q) == pytest.approx(np.quantile(data, q), rel=0.01)
+
+    def test_accuracy_heavy_tail(self):
+        rng = np.random.default_rng(4)
+        data = rng.pareto(3, 300_000)
+        td = TDigest(100)
+        td.add_values(data)
+        for q in (0.5, 0.99):
+            assert td.quantile(q) == pytest.approx(np.quantile(data, q), rel=0.02)
+
+    def test_bounded_memory(self):
+        td = TDigest(100)
+        for chunk in np.array_split(np.random.default_rng(5).normal(0, 1, 500_000), 50):
+            td.add_values(chunk)
+        td._compress()
+        assert len(td.means) <= 2 * td.compression
+
+    def test_merge_matches_combined(self):
+        rng = np.random.default_rng(6)
+        data = rng.exponential(2.0, 200_000)
+        parts = [TDigest(100) for _ in range(8)]
+        for i, td in enumerate(parts):
+            td.add_values(data[i::8])
+        merged = parts[0]
+        for td in parts[1:]:
+            merged.merge(pickle.loads(pickle.dumps(td)))
+        assert merged.total == len(data)
+        for q in (0.5, 0.95, 0.99):
+            assert merged.quantile(q) == pytest.approx(np.quantile(data, q), rel=0.02)
+
+    def test_tiny_inputs_exact_interpolation(self):
+        td = TDigest(100)
+        td.add_values(np.array([10.0, 20, 30, 40, 50, 60]))
+        assert td.quantile(0.5) == pytest.approx(35.0)
+        assert td.quantile(0.0) == pytest.approx(10.0)
+        assert td.quantile(1.0) == pytest.approx(60.0)
+        assert TDigest(100).quantile(0.5) is None
+
+    def test_compression_knob(self):
+        rng = np.random.default_rng(8)
+        data = rng.normal(0, 1, 100_000)
+        small, big = TDigest(20), TDigest(400)
+        small.add_values(data)
+        big.add_values(data)
+        small._compress(); big._compress()
+        assert len(small.means) < len(big.means)
