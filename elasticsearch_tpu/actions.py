@@ -1357,14 +1357,21 @@ class ActionModule:
                                               dfs_stats) for copy in shards]
         # shared deadline: chains resolve themselves (every attempt is timer-bounded),
         # so this is a backstop — without sharing it, k hung shards would stack k
-        # fresh waits instead of running down one clock
-        deadline = time.monotonic() + self.QUERY_ATTEMPT_TIMEOUT * 4
+        # fresh waits instead of running down one clock. Scale it to the longest
+        # possible failover chain so a chain with many hung copies can't outlive it.
+        max_chain = max((getattr(f, "max_attempts", 1) for f in query_futs),
+                        default=1)
+        deadline = (time.monotonic()
+                    + self.QUERY_ATTEMPT_TIMEOUT * max(1, max_chain) + 5.0)
         for ordinal, (copy, fut) in enumerate(zip(shards, query_futs)):
             try:
                 r, used, err = fut.result(
                     timeout=max(0.0, deadline - time.monotonic()))
             except TimeoutError:
                 r, used, err = None, None, TransportError("query phase timed out")
+                cancel = getattr(fut, "cancel_chain", None)
+                if cancel is not None:
+                    cancel()  # abandoned chain must not keep scheduling attempts
             if r is not None:
                 shard_meta[ordinal] = (copy.index, r.shard_id, used)
                 r.shard_id = ordinal
@@ -1419,11 +1426,22 @@ class ActionModule:
         group = state.routing_table.index(copy.index).shard(copy.shard_id)
         candidates = [copy] + [s for s in group.active_shards()
                                if s.node_id != copy.node_id]
+        # the coordinator's backstop may abandon this chain; once it does, stop
+        # scheduling further attempts (they'd leak requests + timers)
+        cancelled = threading.Event()
+        done.cancel_chain = cancelled.set  # type: ignore[attr-defined]
+        done.max_attempts = len(candidates)  # type: ignore[attr-defined]
 
         def attempt(i: int, last_err):
+            if cancelled.is_set():
+                return
             while i < len(candidates) and state.nodes.get(candidates[i].node_id) is None:
                 i += 1
             if i >= len(candidates):
+                if last_err is None:
+                    last_err = NoShardAvailableError(
+                        f"no active copy of [{copy.index}][{copy.shard_id}] on a "
+                        f"live node")
                 done.set_result((None, None, last_err))
                 return
             candidate = candidates[i]

@@ -254,6 +254,11 @@ class TDigest:
         self._compress()
         self.means = np.concatenate([self.means, other.means])
         self.weights = np.concatenate([self.weights, other.weights])
+        # concatenating two sorted runs yields an UNSORTED array; quantile()
+        # interpolates assuming sorted means, and _compress() early-returns
+        # without sorting when small — so restore the invariant here
+        order = np.argsort(self.means, kind="stable")
+        self.means, self.weights = self.means[order], self.weights[order]
         self.total += other.total
         self._min = min(self._min, other._min)
         self._max = max(self._max, other._max)

@@ -35,7 +35,15 @@ A_RESTORE_SHARD = "internal:snapshot/shard/restore"
 class FsRepository:
     """ref: repositories/fs/FsRepository.java — a directory of blobs + metadata."""
 
+    type = "fs"
+
     def __init__(self, name: str, location: str, readonly: bool = False):
+        if "://" in location:
+            # regression guard: a URL passed as an fs location used to be
+            # makedirs()'d literally, leaking an `http:` dir at the cwd root
+            raise SnapshotError(
+                f"fs repository location [{location}] is a URL — use a "
+                f"[url] type repository for read-only URL access")
         self.name = name
         self.location = location
         self.readonly = readonly
@@ -67,6 +75,13 @@ class FsRepository:
             raise SnapshotError(f"repository [{self.name}] is readonly")
         with open(self.snapshot_meta_path(snapshot), "w") as fh:
             json.dump(meta, fh)
+        self._write_index()
+
+    def _write_index(self):
+        # snapshots/index.json lets read-only URL repositories (no directory
+        # listing over http) enumerate snapshots
+        with open(os.path.join(self.location, "snapshots", "index.json"), "w") as fh:
+            json.dump(self.list_snapshots(), fh)
 
     def read_snapshot(self, snapshot: str) -> dict:
         p = self.snapshot_meta_path(snapshot)
@@ -78,13 +93,14 @@ class FsRepository:
     def list_snapshots(self) -> list[str]:
         return sorted(
             n[:-5] for n in os.listdir(os.path.join(self.location, "snapshots"))
-            if n.endswith(".json")
+            if n.endswith(".json") and n != "index.json"
         )
 
     def delete_snapshot(self, snapshot: str):
         p = self.snapshot_meta_path(snapshot)
         if os.path.exists(p):
             os.unlink(p)
+        self._write_index()
         # blobs referenced by other snapshots survive; orphan cleanup:
         referenced: set[str] = set()
         for s in self.list_snapshots():
@@ -96,6 +112,95 @@ class FsRepository:
         for blob in os.listdir(blob_dir):
             if blob not in referenced:
                 os.unlink(os.path.join(blob_dir, blob))
+
+
+class UrlRepository:
+    """Read-only repository addressed by URL (ref: repositories/uri/URLRepository.java
+    + common/blobstore/url/URLBlobStore.java — read-only restore source).
+
+    `file://` URLs resolve to a local directory; `http(s)://` URLs are fetched
+    with urllib (restore from a snapshot server). All mutations raise.
+    """
+
+    type = "url"
+    readonly = True
+
+    def __init__(self, name: str, url: str):
+        from urllib.parse import urlparse
+
+        self.name = name
+        self.url = url.rstrip("/")
+        parsed = urlparse(url)
+        if parsed.scheme in ("", "file"):
+            self._local = parsed.path if parsed.scheme == "file" else url
+            if not os.path.isdir(self._local):
+                raise SnapshotError(
+                    f"url repository [{name}]: directory [{self._local}] not found")
+        elif parsed.scheme in ("http", "https"):
+            self._local = None
+        else:
+            raise SnapshotError(
+                f"url repository [{name}]: unsupported scheme [{parsed.scheme}]")
+        self.location = self._local or self.url  # for wire requests / display
+
+    # read side ---------------------------------------------------------------
+    def _fetch(self, relpath: str) -> bytes:
+        if self._local is not None:
+            p = os.path.join(self._local, relpath)
+            if not os.path.exists(p):
+                raise SnapshotMissingError(f"[{self.name}] blob [{relpath}] missing")
+            with open(p, "rb") as fh:
+                return fh.read()
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(f"{self.url}/{relpath}", timeout=30) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise SnapshotMissingError(
+                    f"[{self.name}] blob [{relpath}] missing") from e
+            raise SnapshotError(f"[{self.name}] fetch [{relpath}]: {e}") from e
+        except urllib.error.URLError as e:
+            raise SnapshotError(f"[{self.name}] unreachable: {e}") from e
+
+    def get_file(self, blob_name: str, dst_path: str):
+        with open(dst_path, "wb") as fh:
+            fh.write(self._fetch(f"blobs/{blob_name}"))
+
+    def read_snapshot(self, snapshot: str) -> dict:
+        return json.loads(self._fetch(f"snapshots/{snapshot}.json"))
+
+    def list_snapshots(self) -> list[str]:
+        if self._local is not None:
+            snap_dir = os.path.join(self._local, "snapshots")
+            if not os.path.isdir(snap_dir):
+                return []
+            return sorted(n[:-5] for n in os.listdir(snap_dir)
+                          if n.endswith(".json") and n != "index.json")
+        # http: directory listing isn't part of the protocol — the writer
+        # maintains snapshots/index.json for exactly this
+        try:
+            return sorted(json.loads(self._fetch("snapshots/index.json")))
+        except SnapshotMissingError:
+            return []
+
+    def verify_readable(self):
+        self.list_snapshots()
+
+    # write side: always refused ---------------------------------------------
+    def _ro(self):
+        raise SnapshotError(f"repository [{self.name}] is readonly (url)")
+
+    def put_file(self, *a, **k):
+        self._ro()
+
+    def write_snapshot(self, *a, **k):
+        self._ro()
+
+    def delete_snapshot(self, *a, **k):
+        self._ro()
 
 
 class SnapshotsService:
@@ -120,19 +225,24 @@ class SnapshotsService:
                 raise SnapshotError("fs repository requires settings.location")
             self.repositories[name] = FsRepository(name, location)
         elif rtype == "url":
-            self.repositories[name] = FsRepository(
-                name, settings.get("url", "").replace("file://", ""), readonly=True)
+            url = settings.get("url")
+            if not url:
+                raise SnapshotError("url repository requires settings.url")
+            self.repositories[name] = UrlRepository(name, url)
         else:
             raise SnapshotError(f"unknown repository type [{rtype}]")
         self._save_repos(body, name)
         return {"acknowledged": True}
 
     def get_repository(self, name: str | None = None) -> dict:
+        def spec(r):
+            if r.type == "url":
+                return {"type": "url", "settings": {"url": r.url}}
+            return {"type": "fs", "settings": {"location": r.location}}
+
         if name:
-            repo = self._repo(name)
-            return {name: {"type": "fs", "settings": {"location": repo.location}}}
-        return {n: {"type": "fs", "settings": {"location": r.location}}
-                for n, r in self.repositories.items()}
+            return {name: spec(self._repo(name))}
+        return {n: spec(r) for n, r in self.repositories.items()}
 
     def delete_repository(self, name: str) -> dict:
         if name not in self.repositories:
@@ -143,10 +253,17 @@ class SnapshotsService:
 
     def verify_repository(self, name: str) -> dict:
         repo = self._repo(name)
-        probe = os.path.join(repo.location, ".verify")
-        with open(probe, "w") as fh:
-            fh.write("ok")
-        os.unlink(probe)
+        if getattr(repo, "readonly", False):
+            # read-only repos are verified by a read, not a probe write
+            if isinstance(repo, UrlRepository):
+                repo.verify_readable()
+            else:
+                repo.list_snapshots()
+        else:
+            probe = os.path.join(repo.location, ".verify")
+            with open(probe, "w") as fh:
+                fh.write("ok")
+            os.unlink(probe)
         return {"nodes": {self.node.node_id: {"name": self.node.name}}}
 
     def _repo(self, name: str) -> FsRepository:
@@ -180,6 +297,10 @@ class SnapshotsService:
     # snapshot ----------------------------------------------------------------
     def create_snapshot(self, repo_name: str, snapshot: str, body: dict | None = None) -> dict:
         repo = self._repo(repo_name)
+        if getattr(repo, "readonly", False):
+            # guard BEFORE the shard fan-out — data nodes write blobs directly,
+            # which would bypass the final write_snapshot readonly check
+            raise SnapshotError(f"repository [{repo_name}] is readonly")
         state = self.node.cluster_service.state
         body = body or {}
         indices = state.metadata.resolve_indices(body.get("indices", "_all"))
@@ -289,7 +410,9 @@ class SnapshotsService:
                 node = state.nodes.get(primary.node_id)
                 self.node.transport.submit_request(node, A_RESTORE_SHARD, {
                     "index": target, "shard": int(sid),
-                    "repo_location": repo.location, "files": shard_files,
+                    "repo_type": repo.type,
+                    "repo_location": repo.url if repo.type == "url" else repo.location,
+                    "files": shard_files,
                 }, timeout=120.0)
             restored.append(target)
         return {"snapshot": {"snapshot": snapshot, "indices": restored,
@@ -298,7 +421,10 @@ class SnapshotsService:
     def _handle_restore_shard(self, request, channel):
         svc = self.node.indices.index_service(request["index"])
         shard = svc.shard(request["shard"])
-        repo = FsRepository("_inline", request["repo_location"], readonly=True)
+        if request.get("repo_type") == "url":
+            repo = UrlRepository("_inline", request["repo_location"])
+        else:
+            repo = FsRepository("_inline", request["repo_location"], readonly=True)
         store_dir = shard.engine.store.dir
         translog_dir = shard.engine.translog.dir
         # close the live engine FIRST, then wipe store + translog (a stale translog

@@ -112,6 +112,19 @@ class TestTDigest:
         for q in (0.5, 0.95, 0.99):
             assert merged.quantile(q) == pytest.approx(np.quantile(data, q), rel=0.02)
 
+    def test_merge_small_digests_stays_sorted(self):
+        # regression: merge() concatenates two sorted centroid runs; below the
+        # compression threshold _compress() used to early-return without sorting,
+        # so quantile() interpolated over an unsorted array (q25 > q75)
+        a, b = TDigest(100), TDigest(100)
+        a.add_values(np.array([100.0, 200.0]))
+        b.add_values(np.array([1.0, 2.0]))
+        a.merge(b)
+        assert np.all(np.diff(a.means) >= 0)
+        qs = [a.quantile(q) for q in (0.25, 0.5, 0.75)]
+        assert qs == sorted(qs)
+        assert a.quantile(0.25) < 100.0 < a.quantile(0.9)
+
     def test_tiny_inputs_exact_interpolation(self):
         td = TDigest(100)
         td.add_values(np.array([10.0, 20, 30, 40, 50, 60]))

@@ -87,3 +87,80 @@ class TestSnapshots:
         client.create_snapshot("b4", "s")
         with pytest.raises(SnapshotError):
             client.restore_snapshot("b4", "s")
+
+
+class TestUrlRepository:
+    """ref: repositories/uri/URLRepository.java — read-only restore source.
+
+    Regression anchor: an http:// address used to be joined as a local path,
+    leaking a literal `http:` directory at the process cwd."""
+
+    def test_fs_location_rejects_url(self, cluster):
+        node, client, repo_path = cluster
+        import os
+
+        with pytest.raises(SnapshotError):
+            client.put_repository("bad", {"type": "fs", "settings": {
+                "location": "http://snapshot.test1/repo"}})
+        assert not os.path.exists("http:")
+
+    def test_file_url_restore_and_readonly(self, cluster):
+        node, client, repo_path = cluster
+        client.create_index("u", {"settings": {"number_of_shards": 1,
+                                               "number_of_replicas": 0}})
+        client.cluster_health(wait_for_status="green")
+        client.index("u", "doc", {"x": 41}, id="1")
+        client.put_repository("w", {"type": "fs", "settings": {"location": repo_path}})
+        client.create_snapshot("w", "s1")
+        client.delete_index("u")
+        # re-register the same tree as a read-only url repo and restore from it
+        client.put_repository("ro", {"type": "url",
+                                     "settings": {"url": f"file://{repo_path}"}})
+        assert client.verify_repository("ro")["nodes"]
+        snaps = client.get_snapshots("ro")
+        assert [s["snapshot"] for s in snaps["snapshots"]] == ["s1"]
+        with pytest.raises(SnapshotError):
+            client.create_snapshot("ro", "s2")  # refused before any blob write
+        r = client.restore_snapshot("ro", "s1")
+        assert r["snapshot"]["indices"] == ["u"]
+        client.refresh("u")
+        assert client.get("u", "doc", "1")["_source"]["x"] == 41
+
+    def test_http_url_restore(self, cluster, tmp_path):
+        """Serve the repo tree over a real local http server; restore through it."""
+        import http.server
+        import threading
+
+        node, client, repo_path = cluster
+        client.create_index("h", {"settings": {"number_of_shards": 1,
+                                               "number_of_replicas": 0}})
+        client.cluster_health(wait_for_status="green")
+        client.index("h", "doc", {"x": 7}, id="1")
+        client.put_repository("w2", {"type": "fs", "settings": {"location": repo_path}})
+        client.create_snapshot("w2", "s1")
+        client.delete_index("h")
+
+        handler = type("H", (http.server.SimpleHTTPRequestHandler,), {
+            "directory": repo_path, "log_message": lambda *a: None})
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            client.put_repository("httpro", {"type": "url", "settings": {
+                "url": f"http://127.0.0.1:{port}"}})
+            snaps = client.get_snapshots("httpro")
+            assert [s["snapshot"] for s in snaps["snapshots"]] == ["s1"]
+            r = client.restore_snapshot("httpro", "s1")
+            assert r["snapshot"]["indices"] == ["h"]
+            client.refresh("h")
+            assert client.get("h", "doc", "1")["_source"]["x"] == 7
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_unsupported_scheme_rejected(self, cluster):
+        node, client, repo_path = cluster
+        with pytest.raises(SnapshotError):
+            client.put_repository("bad2", {"type": "url", "settings": {
+                "url": "ftp://example.com/repo"}})
