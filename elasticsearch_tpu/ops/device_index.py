@@ -44,13 +44,24 @@ class PackedSegment:
     gen: int
     doc_count: int  # real docs
     doc_pad: int  # padded D (bucketed)
-    blk_docs: object  # jnp int32 [NBpad, B]
+    blk_docs: object  # jnp int32 [NBpad, B] — dead/non-parent docs masked to doc_pad
     blk_freqs: object  # jnp float32 [NBpad, B]
     term_blk_start: np.ndarray  # host int64 [T+1]
     live_parent: object  # jnp bool [Dpad] — live & parent (searchable docs)
     norm_bytes: dict  # field -> jnp uint8 [Dpad]
     dv_single: dict = dc_field(default_factory=dict)  # field -> jnp float32/float64 [Dpad] single-valued fast path (NaN missing)
     live_version: int = 0
+    # sparse-path state (see ops/scoring.py score_sparse_batch): tfn = the
+    # weight-independent per-posting term-frequency factor, baked at pack time so the
+    # kernel needs NO per-posting norm gathers (the [M·B] random uint8 gather was the
+    # measured throughput ceiling: ~70 ms/batch vs ~5 ms for the row gather)
+    blk_tfn: object = None  # jnp float32 [NBpad, B] or None until first bake
+    tfn_tables: dict = dc_field(default_factory=dict)  # field -> (mode, cache bytes-hash)
+    # host copies for re-bakes (live-mask refresh / similarity-stats drift)
+    host_docs: np.ndarray | None = None  # int32 [NBpad*B] RAW (unmasked) doc ids
+    host_freqs: np.ndarray | None = None  # float32 [NBpad*B]
+    blk_field: np.ndarray | None = None  # int32 [NBpad] field ordinal per block (-1 pad)
+    field_names: list = dc_field(default_factory=list)  # ordinal -> field name
 
     def blocks_for_term(self, tid: int) -> tuple[int, int]:
         return int(self.term_blk_start[tid]), int(self.term_blk_start[tid + 1])
@@ -86,6 +97,17 @@ def pack_segment(seg: FrozenSegment, fields: list[str] | None = None,
         flat_docs[slots] = seg.post_docs
         flat_freqs[slots] = seg.post_freqs
 
+    # block -> owning field ordinal (blocks never span terms, terms never span fields)
+    field_names = list(seg.term_dict.keys())
+    fid_of_tid = np.full(T, -1, dtype=np.int32)
+    for fo, f in enumerate(field_names):
+        tids = np.fromiter(seg.term_dict[f].values(), dtype=np.int64,
+                           count=len(seg.term_dict[f]))
+        fid_of_tid[tids] = fo
+    blk_field = np.full(NBpad, -1, dtype=np.int32)
+    if NB:
+        blk_field[:NB] = np.repeat(fid_of_tid, nblks)
+
     live_parent = np.zeros(Dpad, dtype=bool)
     live_parent[: seg.doc_count] = seg.live & seg.parent_mask
 
@@ -106,17 +128,87 @@ def pack_segment(seg: FrozenSegment, fields: list[str] | None = None,
             col[: seg.doc_count][has] = vals
             dv_single[f] = put(col)
 
+    # dead/non-parent docs are masked to the sentinel IN the uploaded postings, so no
+    # scoring path needs a per-posting live gather; host_docs keeps the raw ids for
+    # re-masking when tombstones change
+    masked_docs = np.where(live_parent[np.minimum(flat_docs, Dpad - 1)]
+                           & (flat_docs < Dpad), flat_docs, Dpad).astype(np.int32)
+
     return PackedSegment(
         gen=seg.gen,
         doc_count=seg.doc_count,
         doc_pad=Dpad,
-        blk_docs=put(flat_docs.reshape(NBpad, BLOCK)),
+        blk_docs=put(masked_docs.reshape(NBpad, BLOCK)),
         blk_freqs=put(flat_freqs.reshape(NBpad, BLOCK)),
         term_blk_start=blk_start,
         live_parent=put(live_parent),
         norm_bytes=norm_bytes,
         dv_single=dv_single,
+        host_docs=flat_docs,
+        host_freqs=flat_freqs,
+        blk_field=blk_field,
+        field_names=field_names,
     )
+
+
+TFN_BM25 = 0  # tfn = f / (f + cache[norm_byte])        — weight multiplies outside
+TFN_TFIDF = 1  # tfn = sqrt(f) * cache[norm_byte]
+
+
+def tfn_values(freqs: np.ndarray, nb: np.ndarray, cache: np.ndarray,
+               mode: int) -> np.ndarray:
+    """The per-posting tfn formula — the single definition shared by ensure_tfn and
+    bench packing, so the bench provably measures the serving bake."""
+    cv = cache[nb]
+    if mode == TFN_BM25:
+        return (freqs / (freqs + cv)).astype(np.float32)
+    return np.sqrt(freqs, dtype=np.float32) * cv
+
+
+def ensure_tfn(seg: FrozenSegment, packed: PackedSegment,
+               tables: dict[str, tuple[int, np.ndarray]]) -> None:
+    """Bake (or re-bake) the per-posting tfn tensor for the given per-field similarity
+    tables ({field: (TFN_* mode, float32[256] cache)}).
+
+    The bake folds the norm-byte lookup into the stored postings, which is what makes
+    the sparse kernel gather-free. It must re-run when a field's cache table changes —
+    for BM25 that is whenever avgdl (sum_ttf/max_doc) moves, i.e. after indexing
+    activity; Lucene recomputes the same table per query (BM25Similarity's norm cache),
+    we recompute per stats-change and reuse across queries. Cost: one numpy pass over
+    the segment's postings + one HBM upload, amortized over every batch until the next
+    stats change."""
+    current = packed.tfn_tables
+    if packed.blk_tfn is not None and all(
+        f in current and current[f][0] == mode and current[f][1] == cache.tobytes()
+        for f, (mode, cache) in tables.items()
+    ):
+        return
+    import jax.numpy as jnp
+
+    merged = dict(current)
+    for f, (mode, cache) in tables.items():
+        merged[f] = (mode, cache.tobytes())
+    NBpad, B = packed.host_docs.shape[0] // BLOCK, BLOCK
+    flat_docs = packed.host_docs
+    flat_freqs = packed.host_freqs
+    flat_tfn = np.zeros(NBpad * B, dtype=np.float32)
+    fid_per_slot = np.repeat(packed.blk_field, B)
+    for fo, fname in enumerate(packed.field_names):
+        entry = merged.get(fname)
+        if entry is None:
+            continue
+        mode, cache_bytes = entry
+        cache = np.frombuffer(cache_bytes, dtype=np.float32)
+        sel = (fid_per_slot == fo) & (flat_docs < seg.doc_count)
+        if not sel.any():
+            continue
+        d = flat_docs[sel]
+        f32 = flat_freqs[sel]
+        norms = seg.norms.get(fname)
+        nb = norms[d] if norms is not None else np.zeros(len(d), np.uint8)
+        flat_tfn[sel] = tfn_values(f32, nb, cache, mode)
+    packed.blk_tfn = jnp.asarray(flat_tfn.reshape(NBpad, B))
+    packed.tfn_tables = merged
 
 
 def packed_for(seg: FrozenSegment) -> PackedSegment:
@@ -133,5 +225,11 @@ def packed_for(seg: FrozenSegment) -> PackedSegment:
         live_parent = np.zeros(packed.doc_pad, dtype=bool)
         live_parent[: seg.doc_count] = seg.live & seg.parent_mask
         packed.live_parent = jnp.asarray(live_parent)
+        # postings carry the live mask inline (sparse path has no per-posting
+        # live gather) — re-mask from the raw host copy
+        masked = np.where(live_parent[np.minimum(packed.host_docs, packed.doc_pad - 1)]
+                          & (packed.host_docs < packed.doc_pad),
+                          packed.host_docs, packed.doc_pad).astype(np.int32)
+        packed.blk_docs = jnp.asarray(masked.reshape(-1, BLOCK))
         cache["live"] = True
     return packed

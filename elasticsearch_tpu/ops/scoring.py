@@ -91,13 +91,14 @@ def _score_batch_impl(blk_docs, blk_freqs, live_parent, norms_stack, caches,
     nb = norms_stack[fidx[:, None], docs_safe]  # [M, B] uint8
     cache_vals = caches[fidx[:, None], nb.astype(jnp.int32)]  # [M, B]
 
-    # float op ORDER matters for bit-parity with the host scorer and Lucene:
-    # BM25  : (weight·freq) / (freq + cache)   [BM25Similarity scorer order]
-    # TFIDF : (sqrt(freq)·weight) · cache      [TFIDFSimilarity ExactSimScorer order]
+    # float op ORDER matters for bit-parity with the host scorer and the sparse
+    # kernel's baked tfn (device_index.ensure_tfn): the tf factor is computed FIRST,
+    # then multiplied by the weight — Lucene's weight·tfNorm order
+    # (BM25Similarity.BM25DocScorer / TFIDFSimilarity.ExactSimScorer)
     mode = tfmode[:, None]
     w = weight[:, None]
-    bm25 = (w * freqs) / (freqs + cache_vals)
-    tfidf = jnp.sqrt(freqs) * w * cache_vals
+    bm25 = w * (freqs / (freqs + cache_vals))
+    tfidf = w * (jnp.sqrt(freqs) * cache_vals)
     contrib = jnp.where(mode == MODE_BM25, bm25, jnp.where(mode == MODE_TFIDF, tfidf, w))
     scoring = (group[:, None] != GROUP_MUST_NOT) & valid
     contrib = jnp.where(scoring, contrib, 0.0)
@@ -247,6 +248,248 @@ def finalize_score_result(scores: np.ndarray, docs: np.ndarray, total: np.ndarra
     max_score = np.where(total > 0, scores[:, 0], np.nan).astype(np.float32)
     return ScoreResult(scores=scores, docs=docs, total_hits=total,
                        max_score=max_score)
+
+
+# ---------------------------------------------------------------------------
+# sparse candidate-centric path (the serving/bench hot path)
+# ---------------------------------------------------------------------------
+#
+# The dense kernel above scatter-adds into a [Q, doc_pad] accumulator — measured on the
+# v5e: ~112 ms/batch for the scatter alone plus ~49 ms for the full-width top_k, and the
+# accumulator is O(Q·doc_count) HBM (24 GB at enwiki scale — impossible). The sparse
+# path is candidate-centric, the device analogue of Lucene's doc-at-a-time merge
+# (search/query/QueryPhase.java:95-137 walks a merged postings enum; we materialize the
+# merged candidate list per query and reduce it in parallel):
+#
+#   1. row-gather each query's postings blocks            [Qb, TB, B]   (~5 ms DMA)
+#   2. contribution = weight · baked tfn                  (no norm gathers — see
+#      device_index.ensure_tfn; the [M·B] random uint8 gather was ~70 ms)
+#   3. sort candidates by doc id per query                [Qb, P] pairs (~6 ms)
+#   4. doubling-pass segment-sum merges duplicate docs (run length ≤ clause count)
+#   5. bool semantics on the summed match counters at run ends
+#   6. top_k over [Qb, P]                                 (~5 ms; P ≪ doc_pad)
+#
+# Work scales with postings touched, not with corpus size: O(Q·P) HBM per batch,
+# corpus-size-independent — the layout that holds 1M+ docs (see ARCHITECTURE.md
+# "HBM budget"). Queries are bucketed by their block count (power-of-two TB buckets,
+# chunked to a slot budget) so executables cache; pathological block counts
+# (TB > tb_max: match-everything terms) fall back to the dense kernel.
+
+
+@dataclass
+class SparseBatch:
+    """One bucket of queries sharing a [Qb, TB] block layout."""
+
+    n_queries: int  # real queries (rows beyond are padding)
+    qids: np.ndarray  # int32 [Qb] — caller's query index per row (-1 padding)
+    qblk: np.ndarray  # int32 [Qb, TB] — block rows (pad: sentinel all-doc_pad row)
+    qw: np.ndarray  # float32 [Qb, TB] — clause weight (0 for must_not/padding)
+    qconst: np.ndarray  # bool [Qb, TB] — constant-score clause (contribution = w)
+    qcnt: np.ndarray  # int32 [Qb, TB] — packed group counter (should/must/must_not bit)
+    n_must: np.ndarray  # int32 [Qb]
+    msm: np.ndarray  # int32 [Qb]
+    coord: np.ndarray  # float32 [Qb, C+1]
+    passes: int  # segment-sum doubling passes = ceil(log2(max clauses per query))
+    simple: bool  # pure-should all-BM25 msm<=1 no-coord (match ≡ score>0)
+
+
+def _sparse_impl(blk_docs, blk_tfn, qblk, qw, qconst, qcnt, n_must, msm, coord,
+                 *, k: int, doc_pad: int, passes: int, simple: bool, use_coord: bool):
+    import jax
+    import jax.numpy as jnp
+
+    Qb, TB = qblk.shape
+    P = TB * BLOCK
+    docs = blk_docs[qblk]  # [Qb, TB, B]
+    tfn = blk_tfn[qblk]
+    valid = docs < doc_pad
+    contrib = qw[:, :, None] * jnp.where(qconst[:, :, None], 1.0, tfn)
+    contrib = jnp.where(valid, contrib, 0.0)
+    docs = docs.reshape(Qb, P)
+    contrib = contrib.reshape(Qb, P)
+
+    def segsum(docs_s, vals_list):
+        # duplicate docs form runs of length <= clause count after the sort;
+        # log2 doubling leaves the full run sum at the run's LAST element
+        for i in range(passes):
+            shift = 1 << i
+            same = jnp.concatenate(
+                [jnp.zeros((Qb, shift), bool),
+                 docs_s[:, shift:] == docs_s[:, :-shift]], axis=1)
+            out = []
+            for v in vals_list:
+                shifted = jnp.concatenate(
+                    [jnp.zeros((Qb, shift), v.dtype), v[:, :-shift]], axis=1)
+                out.append(v + jnp.where(same, shifted, jnp.zeros((), v.dtype)))
+            vals_list = out
+        return vals_list
+
+    if simple:
+        docs_s, c_s = jax.lax.sort((docs, contrib), num_keys=1)
+        (c_s,) = segsum(docs_s, [c_s])
+        is_last = jnp.concatenate(
+            [docs_s[:, :-1] != docs_s[:, 1:], jnp.ones((Qb, 1), bool)], axis=1)
+        match = is_last & (docs_s < doc_pad) & (c_s > 0.0)
+        masked = jnp.where(match, c_s, -jnp.inf)
+        top_scores, idx = jax.lax.top_k(masked, k)
+        top_docs = jnp.take_along_axis(docs_s, idx, axis=1)
+        return top_scores, top_docs, match.sum(axis=1, dtype=jnp.int32)
+
+    cnt = jnp.where(valid, qcnt[:, :, None], 0).reshape(Qb, P)
+    docs_s, c_s, n_s = jax.lax.sort((docs, contrib, cnt), num_keys=1)
+    c_s, n_s = segsum(docs_s, [c_s, n_s])
+    is_last = jnp.concatenate(
+        [docs_s[:, :-1] != docs_s[:, 1:], jnp.ones((Qb, 1), bool)], axis=1)
+    m_should = n_s & 0x3FF
+    m_must = (n_s >> _MUST_SHIFT) & 0x3FF
+    m_not = n_s >> _NOT_SHIFT
+    match = (
+        is_last & (docs_s < doc_pad)
+        & (m_must == n_must[:, None]) & (m_should >= msm[:, None]) & (m_not == 0)
+        & ((m_should + m_must) > 0)
+    )
+    if use_coord:
+        overlap = jnp.minimum(m_should + m_must, coord.shape[1] - 1)
+        coord_fac = jnp.zeros_like(c_s)
+        for j in range(coord.shape[1]):
+            coord_fac = coord_fac + jnp.where(overlap == j, coord[:, j][:, None], 0.0)
+        c_s = c_s * coord_fac
+    masked = jnp.where(match, c_s, -jnp.inf)
+    top_scores, idx = jax.lax.top_k(masked, k)
+    top_docs = jnp.take_along_axis(docs_s, idx, axis=1)
+    return top_scores, top_docs, match.sum(axis=1, dtype=jnp.int32)
+
+
+def _get_sparse_compiled(Qb: int, TB: int, k: int, doc_pad: int, passes: int,
+                         simple: bool, use_coord: bool, coord_w: int):
+    import jax
+
+    key = ("sparse", Qb, TB, k, doc_pad, passes, simple, use_coord, coord_w)
+    fn = _compiled_cache.get(key)
+    if fn is None:
+        def wrapper(*args):
+            return _sparse_impl(*args, k=k, doc_pad=doc_pad, passes=passes,
+                                simple=simple, use_coord=use_coord)
+
+        fn = jax.jit(wrapper)
+        _compiled_cache[key] = fn
+    return fn
+
+
+def score_sparse_batch_async(packed: PackedSegment, sb: SparseBatch, k: int):
+    """Launch one sparse bucket; returns device arrays (scores, docs, totals) without
+    syncing. Requires packed.blk_tfn (device_index.ensure_tfn)."""
+    import jax.numpy as jnp
+
+    Qb, TB = sb.qblk.shape
+    P = TB * BLOCK
+    k_eff = min(k, P)
+    use_coord = not sb.simple and not bool(np.all(sb.coord == 1.0))
+    fn = _get_sparse_compiled(Qb, TB, k_eff, packed.doc_pad, sb.passes, sb.simple,
+                              use_coord, sb.coord.shape[1])
+    return fn(
+        packed.blk_docs, packed.blk_tfn,
+        jnp.asarray(sb.qblk), jnp.asarray(sb.qw), jnp.asarray(sb.qconst),
+        jnp.asarray(sb.qcnt), jnp.asarray(sb.n_must), jnp.asarray(sb.msm),
+        jnp.asarray(sb.coord),
+    )
+
+
+def plan_sparse_buckets(clause_lists: list, n_must: np.ndarray, msm: np.ndarray,
+                        coord: np.ndarray, sentinel_row: int, *, tb_max: int = 512,
+                        slot_budget: int = 32768, simple: bool = False):
+    """Bucket queries by block count and build SparseBatches.
+
+    clause_lists: per query, list of (b0, b1, weight, group, is_const) block ranges.
+    Returns (batches, overflow_qids): overflow queries (TB > tb_max) need the dense
+    fallback; queries with zero blocks appear in no batch (zero hits)."""
+    Q = len(clause_lists)
+    tb_q = np.array([sum(b1 - b0 for (b0, b1, _w, _g, _c) in cl)
+                     for cl in clause_lists], dtype=np.int64)
+    overflow = [qi for qi in range(Q) if tb_q[qi] > tb_max]
+    buckets: dict[int, list[int]] = {}
+    for qi in range(Q):
+        if 0 < tb_q[qi] <= tb_max:
+            tb = 8
+            while tb < tb_q[qi]:
+                tb *= 2
+            buckets.setdefault(tb, []).append(qi)
+
+    batches = []
+    for tb, qis in sorted(buckets.items()):
+        max_q = max(1, slot_budget // tb)
+        for start in range(0, len(qis), max_q):
+            chunk = qis[start: start + max_q]
+            Qb = 8
+            while Qb < len(chunk):
+                Qb *= 2
+            qblk = np.full((Qb, tb), sentinel_row, np.int32)
+            qw = np.zeros((Qb, tb), np.float32)
+            qconst = np.zeros((Qb, tb), bool)
+            qcnt = np.zeros((Qb, tb), np.int32)
+            qids = np.full(Qb, -1, np.int32)
+            bn_must = np.zeros(Qb, np.int32)
+            bmsm = np.zeros(Qb, np.int32)
+            bcoord = np.ones((Qb, coord.shape[1]), np.float32)
+            maxc = 1
+            for row, qi in enumerate(chunk):
+                qids[row] = qi
+                bn_must[row] = n_must[qi]
+                bmsm[row] = msm[qi]
+                bcoord[row] = coord[qi]
+                maxc = max(maxc, len(clause_lists[qi]))
+                off = 0
+                for (b0, b1, w, g, is_const) in clause_lists[qi]:
+                    nb = b1 - b0
+                    if nb <= 0:
+                        continue
+                    qblk[row, off: off + nb] = np.arange(b0, b1, dtype=np.int32)
+                    qw[row, off: off + nb] = 0.0 if g == GROUP_MUST_NOT else w
+                    qconst[row, off: off + nb] = is_const
+                    qcnt[row, off: off + nb] = (
+                        1 if g == GROUP_SHOULD
+                        else (1 << _MUST_SHIFT) if g == GROUP_MUST
+                        else (1 << _NOT_SHIFT))
+                    off += nb
+            passes = max(0, (maxc - 1).bit_length())
+            batches.append(SparseBatch(
+                n_queries=len(chunk), qids=qids, qblk=qblk, qw=qw, qconst=qconst,
+                qcnt=qcnt, n_must=bn_must, msm=bmsm, coord=bcoord, passes=passes,
+                simple=simple))
+    return batches, overflow
+
+
+def score_flat_sparse(packed: PackedSegment, clause_lists: list, n_must: np.ndarray,
+                      msm: np.ndarray, coord: np.ndarray, k: int, *,
+                      simple: bool = False, tb_max: int = 512):
+    """Score a whole flat-query batch through the sparse path: plan buckets, launch all
+    (pipelined), collect into [Q, k] host arrays.
+
+    Returns (scores, docs, totals, overflow_qids); rows for zero-block and overflow
+    queries are empty (caller handles overflow via the dense kernel)."""
+    import jax
+
+    Q = len(clause_lists)
+    sentinel_row = packed.blk_docs.shape[0] - 1
+    batches, overflow = plan_sparse_buckets(
+        clause_lists, n_must, msm, coord, sentinel_row, tb_max=tb_max, simple=simple)
+    scores = np.full((Q, k), -np.inf, np.float32)
+    docs = np.full((Q, k), packed.doc_pad, np.int32)
+    totals = np.zeros(Q, np.int64)
+    results = [(sb, score_sparse_batch_async(packed, sb, k)) for sb in batches]
+    if results:
+        jax.block_until_ready([r for (_sb, r) in results])
+    for sb, (s, d, t) in results:
+        s = np.asarray(s)
+        d = np.asarray(d)
+        t = np.asarray(t)
+        rows = sb.qids >= 0
+        qid = sb.qids[rows]
+        kk = s.shape[1]
+        scores[qid, :kk] = s[rows]
+        docs[qid, :kk] = d[rows]
+        totals[qid] = t[rows]
+    return scores, docs, totals, overflow
 
 
 def build_term_batch(entries: list, n_queries: int, n_must: np.ndarray, msm: np.ndarray,

@@ -331,10 +331,15 @@ def finalize_flat(plan: FlatPlan, ctx: ShardContext):
 
 
 def execute_flat_batch(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list[TopDocs]:
-    """Run a batch of flat plans through the device kernel, one launch per segment,
-    then merge per-segment top-k host-side (score desc, global doc asc — Lucene order)."""
-    from ..ops.device_index import packed_for
-    from ..ops.scoring import build_term_batch, score_term_batch
+    """Run a batch of flat plans through the device kernels, per-segment launches,
+    then merge per-segment top-k host-side (score desc, global doc asc — Lucene order).
+
+    The common case rides the sparse candidate-centric kernel (ops/scoring.py
+    score_flat_sparse — work scales with postings touched, not corpus size); queries
+    whose terms cover too many postings blocks (tb_max) fall back to the dense
+    scatter kernel, which is O(Q·doc_pad) but block-count-insensitive."""
+    from ..ops.device_index import TFN_BM25, TFN_TFIDF, ensure_tfn, packed_for
+    from ..ops.scoring import build_term_batch, score_flat_sparse, score_term_batch
 
     Q = len(plans)
     finals = [finalize_flat(p, ctx) for p in plans]
@@ -348,6 +353,11 @@ def execute_flat_batch(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list
                 all_fields.append(f)
                 cache_rows.append(caches[i])
     caches_stack = np.stack(cache_rows) if cache_rows else np.ones((1, 256), np.float32)
+    tfn_tables = {
+        f: (TFN_BM25 if isinstance(ctx.similarity_for(f), BM25Similarity)
+            else TFN_TFIDF, cache_rows[field_idx[f]])
+        for f in all_fields
+    }
     max_clauses = max(1, max(
         (sum(1 for c in p.clauses if c.group != GROUP_MUST_NOT) for p in plans), default=1))
     coord_tbl = np.ones((Q, max_clauses + 1), dtype=np.float32)
@@ -359,51 +369,91 @@ def execute_flat_batch(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list
             coord_tbl[qi, len(coord):] = coord[-1]
         n_must[qi] = plan.n_must
         msm[qi] = plan.msm
+    # zero-df clauses (w=0, no postings anywhere) can't affect results — don't let
+    # them demote the batch off the simple fast path
+    simple = bool(
+        np.all(n_must == 0) and np.all(msm <= 1) and np.all(coord_tbl == 1.0)
+        and all(g == GROUP_SHOULD and mode == MODE_BM25 and w > 0
+                for (resolved, _f, _c, _coord) in finals
+                for (_f2, _t, w, _fi, g, mode, df) in resolved if df > 0))
 
-    per_query: list[list[tuple[float, int]]] = [[] for _ in range(Q)]
     totals = np.zeros(Q, dtype=np.int64)
+    seg_hits = []  # (scores [Q,k] f32, global_docs [Q,k] int64) per segment
     for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
         packed = packed_for(seg)
-        entries = []
-        for qi, (resolved, _f, _c, _coord) in enumerate(finals):
+        ensure_tfn(seg, packed, tfn_tables)
+        clause_lists = []
+        for (resolved, _f, _c, _coord) in finals:
+            cl = []
             for (f, t, w, _fi, g, mode, df) in resolved:
                 tid = seg.term_id(f, t)
                 if tid is None:
                     continue
                 b0, b1 = packed.blocks_for_term(tid)
-                for b in range(b0, b1):
-                    entries.append((qi, b, w, field_idx[f], g, mode))
-        # queries whose fields lack norms in this segment still need the field rows
-        norm_fields = [f for f in all_fields]
-        missing = [f for f in norm_fields if f not in packed.norm_bytes]
-        if missing:
-            import jax.numpy as jnp
+                cl.append((b0, b1, w, g, mode == MODE_CONST))
+            clause_lists.append(cl)
+        scores, docs, tq, overflow = score_flat_sparse(
+            packed, clause_lists, n_must, msm, coord_tbl, k, simple=simple)
+        if overflow:
+            _dense_fallback(overflow, finals, field_idx, all_fields, caches_stack,
+                            n_must, msm, coord_tbl, packed, seg, k,
+                            scores, docs, tq, build_term_batch, score_term_batch)
+        totals += tq
+        valid = (docs < min(packed.doc_pad, seg.doc_count)) & np.isfinite(scores)
+        gdocs = np.where(valid, docs.astype(np.int64) + base, np.int64(2**62))
+        seg_hits.append((np.where(valid, scores, -np.inf), gdocs))
 
-            for f in missing:
-                packed.norm_bytes[f] = jnp.zeros(packed.doc_pad, dtype=jnp.uint8)
-        if not entries:
-            # still need totals for must_not/pure-miss semantics: no entries → no matches
-            continue
-        batch = build_term_batch(entries, Q, n_must, msm, coord_tbl, norm_fields,
-                                 caches_stack, nb_pad_row=packed.blk_docs.shape[0] - 1)
-        res = score_term_batch(packed, batch, k)
-        totals += res.total_hits
-        for qi in range(Q):
-            for j in range(res.docs.shape[1]):
-                d = int(res.docs[qi, j])
-                if d >= packed.doc_pad or not np.isfinite(res.scores[qi, j]):
-                    break
-                if d < seg.doc_count:
-                    per_query[qi].append((float(res.scores[qi, j]), base + d))
     out = []
+    if not seg_hits:
+        return [TopDocs(total=0, hits=[], max_score=float("nan")) for _ in range(Q)]
+    all_scores = np.concatenate([s for (s, _d) in seg_hits], axis=1)
+    all_docs = np.concatenate([d for (_s, d) in seg_hits], axis=1)
     for qi in range(Q):
-        hits = sorted(per_query[qi], key=lambda h: (-h[0], h[1]))[:k]
+        order = np.lexsort((all_docs[qi], -all_scores[qi]))[:k]
+        hits = [(float(all_scores[qi, j]), int(all_docs[qi, j]))
+                for j in order if np.isfinite(all_scores[qi, j])]
         out.append(TopDocs(
             total=int(totals[qi]),
             hits=hits,
             max_score=hits[0][0] if hits else float("nan"),
         ))
     return out
+
+
+def _dense_fallback(overflow, finals, field_idx, all_fields, caches_stack,
+                    n_must, msm, coord_tbl, packed, seg, k,
+                    scores, docs, tq, build_term_batch, score_term_batch):
+    """Score overflow queries (block count past the sparse planner's tb_max) with the
+    dense scatter kernel; writes results into the sparse output arrays in place."""
+    import jax.numpy as jnp
+
+    for f in all_fields:
+        if f not in packed.norm_bytes:
+            packed.norm_bytes[f] = jnp.zeros(packed.doc_pad, dtype=jnp.uint8)
+    remap = {qi: i for i, qi in enumerate(overflow)}
+    entries = []
+    for qi in overflow:
+        (resolved, _f, _c, _coord) = finals[qi]
+        for (f, t, w, _fi, g, mode, df) in resolved:
+            tid = seg.term_id(f, t)
+            if tid is None:
+                continue
+            b0, b1 = packed.blocks_for_term(tid)
+            for b in range(b0, b1):
+                entries.append((remap[qi], b, w, field_idx[f], g, mode))
+    if not entries:
+        return
+    sub = np.asarray(overflow, dtype=np.int64)
+    batch = build_term_batch(entries, len(overflow), n_must[sub], msm[sub],
+                             coord_tbl[sub], list(all_fields), caches_stack,
+                             nb_pad_row=packed.blk_docs.shape[0] - 1)
+    res = score_term_batch(packed, batch, k)
+    kk = res.scores.shape[1]
+    scores[sub, :kk] = res.scores
+    docs[sub, :kk] = res.docs
+    scores[sub, kk:] = -np.inf
+    docs[sub, kk:] = packed.doc_pad
+    tq[sub] = res.total_hits
 
 
 # ---------------------------------------------------------------------------
@@ -493,7 +543,9 @@ class HostScorer:
         cache = sim.norm_cache(ctx.field_stats(field), ctx.max_doc)
         if isinstance(sim, BM25Similarity):
             w = np.float32(sim.idf(df, ctx.max_doc) * boost * (sim.k1 + 1.0))
-            vals = w * freqs / (freqs + cache[nb])
+            # tf factor first, then weight — bit-parity with the device kernels'
+            # baked tfn (ops/device_index.ensure_tfn)
+            vals = w * (freqs / (freqs + cache[nb]))
         elif isinstance(sim, FreqNormSimilarity):
             # generic freq/doc-len similarities (DFR, IB, LM*) — host-only path
             from ..common.smallfloat import decode_norm_doclen
@@ -507,7 +559,7 @@ class HostScorer:
         else:
             idf = TFIDFSimilarity.idf(df, ctx.max_doc)
             w = np.float32(idf * idf * boost) * self.qn
-            vals = w * np.sqrt(freqs, dtype=np.float32) * cache[nb]
+            vals = w * (np.sqrt(freqs, dtype=np.float32) * cache[nb])
         scores[docs] = vals.astype(np.float32)
         match[docs] = True
         return scores, match
@@ -797,10 +849,10 @@ class HostScorer:
             nb = norms[d] if norms is not None else 0
             if isinstance(sim, BM25Similarity):
                 w = np.float32(idf_sum * boost * (sim.k1 + 1.0))
-                scores[d] = w * np.float32(freq) / (np.float32(freq) + cache[nb])
+                scores[d] = w * (np.float32(freq) / (np.float32(freq) + cache[nb]))
             else:
                 w = np.float32(idf_sum * idf_sum * boost) * self.qn
-                scores[d] = w * np.sqrt(np.float32(freq)) * cache[nb]
+                scores[d] = w * (np.sqrt(np.float32(freq)) * cache[nb])
             match[d] = True
         return scores, match
 

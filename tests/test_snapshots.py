@@ -140,9 +140,18 @@ class TestUrlRepository:
         client.create_snapshot("w2", "s1")
         client.delete_index("h")
 
-        handler = type("H", (http.server.SimpleHTTPRequestHandler,), {
-            "directory": repo_path, "log_message": lambda *a: None})
-        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        served = []
+
+        class H(http.server.SimpleHTTPRequestHandler):
+            def __init__(self, *a, **kw):
+                # SimpleHTTPRequestHandler defaults directory to os.getcwd() when
+                # the kwarg is absent — a class attribute is silently overwritten
+                super().__init__(*a, directory=str(repo_path), **kw)
+
+            def log_message(self, *a):
+                served.append(self.path)
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
         port = httpd.server_address[1]
         t = threading.Thread(target=httpd.serve_forever, daemon=True)
         t.start()
@@ -155,6 +164,9 @@ class TestUrlRepository:
             assert r["snapshot"]["indices"] == ["h"]
             client.refresh("h")
             assert client.get("h", "doc", "1")["_source"]["x"] == 7
+            # the restore must have actually ridden http, not a local-path fallback
+            assert any(p.endswith("index.json") for p in served), served
+            assert len(served) > 1, served
         finally:
             httpd.shutdown()
             httpd.server_close()
