@@ -177,6 +177,7 @@ def main():
         TFN_BM25,
         PackedSegment,
         _pow2_bucket,
+        expand_ranges,
         tfn_values,
     )
     from elasticsearch_tpu.ops.scoring import (
@@ -194,8 +195,7 @@ def main():
     Dpad = _pow2_bucket(max_doc, 128)
     flat_docs = np.full(NBpad * BLOCK, Dpad, dtype=np.int32)
     flat_freqs = np.zeros(NBpad * BLOCK, dtype=np.float32)
-    within = np.arange(len(post_docs), dtype=np.int64) - np.repeat(post_offsets[:-1], counts)
-    slots = np.repeat(blk_start[:-1] * BLOCK, counts) + within
+    slots = expand_ranges(blk_start[:-1] * BLOCK, counts)
     flat_docs[slots] = post_docs
     flat_freqs[slots] = post_freqs
     # pack-time tfn bake via the serving path's shared formula (device_index.tfn_values)

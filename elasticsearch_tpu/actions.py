@@ -135,6 +135,12 @@ class ActionModule:
         self.routing = node.operation_routing
         self.allocation = node.allocation
         self.logger = get_logger("action", node=node.name)
+        # SPMD mesh serving for co-located shards (ICI data plane as the search path;
+        # ref: the scatter-gather in TransportSearchTypeAction.java:117 this bypasses)
+        from .parallel.mesh_serving import MeshServingService
+
+        self.mesh_serving = MeshServingService(node.indices, node.settings,
+                                               node_name=node.name)
         t = self.transport
         # master-node actions
         for action, fn in [
@@ -1320,6 +1326,21 @@ class ActionModule:
         alias_filters = {i: state.metadata.alias_filter(i, index_expr) for i in indices}
         req = parse_search_body(body)
         shards = self.routing.search_shards(state, indices, routing, preference)
+
+        # co-located shards + flat query → one SPMD program over the device mesh
+        # (DFS psum + all_gather top-k on ICI) instead of per-shard RPC scatter-gather;
+        # None = ineligible or failed, fall through to the transport path unchanged
+        mesh_results = self.mesh_serving.try_search(
+            state, self.node.local_node.id, indices, alias_filters, shards, req,
+            use_global_stats=search_type in ("dfs_query_then_fetch",
+                                             "dfs_query_and_fetch"))
+        if mesh_results is not None:
+            node_local = state.nodes.get(self.node.local_node.id)
+            shard_meta = {o: (copy.index, copy.shard_id, node_local)
+                          for o, copy in enumerate(shards)}
+            return self._finish_search(req, body, mesh_results, [], shards,
+                                       shard_meta, t0)
+
         dfs_stats = None
         if search_type in ("dfs_query_then_fetch", "dfs_query_and_fetch"):
             # concurrent DFS fan-out — the distributed-IDF all-reduce's gather leg
@@ -1379,6 +1400,11 @@ class ActionModule:
             else:
                 failures.append({"index": copy.index, "shard": copy.shard_id,
                                  "reason": str(err)})
+        return self._finish_search(req, body, results, failures, shards, shard_meta, t0)
+
+    def _finish_search(self, req, body, results, failures, shards, shard_meta, t0):
+        """Reduce + fetch + response assembly, shared by the transport scatter-gather
+        and the mesh SPMD query phase (both deliver per-ordinal ShardQueryResults)."""
         merged = sort_docs(req, results)
         page = merged.hits[req.from_: req.from_ + req.size]
         # fetch phase: winners only, grouped per shard, all shards in flight at once

@@ -27,6 +27,8 @@ import numpy as np
 from ..common.smallfloat import encode_norm
 from ..mapper.core import ParsedDocument
 
+_LIVE_GEN = 0  # process-wide tombstone generation (see FrozenSegment.live_gen)
+
 
 @dataclass
 class FieldStats:
@@ -237,6 +239,10 @@ class FrozenSegment:
     parent_mask: np.ndarray  # bool[D]
     nested_paths: list[str | None]
     _device_cache: dict = dc_field(default_factory=dict, repr=False, compare=False)
+    # monotonic tombstone generation: any change to `live` bumps it (process-wide
+    # counter so copy-on-write views get distinct generations) — cheap freshness key
+    # for device-side caches of the live mask (e.g. the mesh serving ShardedIndex)
+    live_gen: int = 0
 
     # --- term access --------------------------------------------------------
     def term_id(self, field: str, term: str) -> int | None:
@@ -279,8 +285,11 @@ class FrozenSegment:
     def delete_doc(self, local: int):
         """Tombstone a doc and its nested children block (in place — use with_deletes
         for copy-on-write semantics that preserve already-acquired searchers)."""
+        global _LIVE_GEN
         self.live[local] = False
         self._device_cache.pop("live", None)
+        _LIVE_GEN += 1
+        self.live_gen = _LIVE_GEN
         i = local - 1
         while i >= 0 and not self.parent_mask[i] and self.nested_paths[i] is not None \
                 and self.ids[i] == self.ids[local]:

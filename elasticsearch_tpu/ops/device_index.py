@@ -37,6 +37,18 @@ def _pow2_bucket(n: int, minimum: int = 128) -> int:
     return b
 
 
+def expand_ranges(starts: np.ndarray, counts: np.ndarray) -> np.ndarray:
+    """Flatten half-open ranges [starts[i], starts[i]+counts[i]) into one int64 array
+    — the CSR expansion idiom (repeat + within-range offset) shared by segment
+    packing, the mesh assembler, and the bench."""
+    total = int(counts.sum())
+    excl = np.zeros(len(counts), dtype=np.int64)
+    if len(counts) > 1:
+        np.cumsum(counts[:-1], out=excl[1:])
+    within = np.arange(total, dtype=np.int64) - np.repeat(excl, counts)
+    return np.repeat(np.asarray(starts, dtype=np.int64), counts) + within
+
+
 @dataclass
 class PackedSegment:
     """Device tensors + host lookup tables for one frozen segment."""
@@ -90,10 +102,7 @@ def pack_segment(seg: FrozenSegment, fields: list[str] | None = None,
     flat_freqs = np.zeros(NBpad * BLOCK, dtype=np.float32)
     if len(seg.post_docs):
         # slot of entry j of term t = (blk_start[t]*B) + (j - post_offsets[t])
-        within = np.arange(len(seg.post_docs), dtype=np.int64) - np.repeat(
-            seg.post_offsets[:-1], counts
-        )
-        slots = np.repeat(blk_start[:-1] * BLOCK, counts) + within
+        slots = expand_ranges(blk_start[:-1] * BLOCK, counts)
         flat_docs[slots] = seg.post_docs
         flat_freqs[slots] = seg.post_freqs
 

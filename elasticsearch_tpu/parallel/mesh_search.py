@@ -34,7 +34,7 @@ import numpy as np
 
 from ..common.smallfloat import decode_norm_doclen, NORM_TABLE
 from ..index.engine import Searcher
-from ..ops.device_index import BLOCK, _pow2_bucket
+from ..ops.device_index import BLOCK, _pow2_bucket, expand_ranges
 from ..search.execute import (
     GROUP_MUST_NOT,
     MODE_BM25,
@@ -176,9 +176,7 @@ def build_sharded_index(searchers: list[Searcher], fields: list[str],
         flat_docs = blk_docs[si].reshape(-1)
         flat_freqs = blk_freqs[si].reshape(-1)
         if len(c.post_docs):
-            within = np.arange(len(c.post_docs), dtype=np.int64) - np.repeat(
-                c.post_offsets[:-1], counts)
-            slots = np.repeat(blk_start[:-1] * BLOCK, counts) + within
+            slots = expand_ranges(blk_start[:-1] * BLOCK, counts)
             flat_docs[slots] = c.post_docs
             flat_freqs[slots] = c.post_freqs
         tb = {}
@@ -225,8 +223,12 @@ def build_sharded_index(searchers: list[Searcher], fields: list[str],
 
 
 def _mesh_score_program(k: int, n_queries: int, doc_pad: int, similarity_kind: int,
-                        k1: float, b: float):
-    """Returns the shard_map-able function (static shapes closed over)."""
+                        k1: float, b: float, use_global_stats: bool = True):
+    """Returns the shard_map-able function (static shapes closed over).
+
+    use_global_stats=True is dfs_query_then_fetch (term stats psum'd over the shards
+    axis — the DFS all-reduce); False is plain query_then_fetch (each shard weighs
+    with its local stats, exactly like the reference's per-shard IndexSearcher)."""
     import jax
     import jax.numpy as jnp
 
@@ -237,7 +239,8 @@ def _mesh_score_program(k: int, n_queries: int, doc_pad: int, similarity_kind: i
                 qidx, blk, clause_id, fidx, group, tfmode,  # entries [1, M]
                 df_local, boost, clause_qidx, clause_scoring,  # clauses [1?, C]
                 max_doc_local, sum_ttf_local,  # [1], [1, F]
-                n_must, msm, coord):  # per query [Qd], [Qd], [Qd, C+1]
+                n_must, msm, coord,  # per query [Qd], [Qd], [Qd, C+1]
+                filter_masks=None):  # optional [1, Qd, Dpad] bool (FilteredQuery)
         blk_docs = blk_docs[0]
         blk_freqs = blk_freqs[0]
         norms_l = norms[0]
@@ -246,10 +249,15 @@ def _mesh_score_program(k: int, n_queries: int, doc_pad: int, similarity_kind: i
         fidx, group, tfmode = fidx[0], group[0], tfmode[0]
         df_local = df_local[0]
 
-        # ---- DFS phase: global stats as collectives over the shards axis ----
-        df_g = jax.lax.psum(df_local.astype(jnp.float32), "shards")  # [C]
-        N = jax.lax.psum(max_doc_local[0].astype(jnp.float32), "shards")  # scalar
-        ttf_g = jax.lax.psum(sum_ttf_local[0], "shards")  # [F]
+        if use_global_stats:
+            # ---- DFS phase: global stats as collectives over the shards axis ----
+            df_g = jax.lax.psum(df_local.astype(jnp.float32), "shards")  # [C]
+            N = jax.lax.psum(max_doc_local[0].astype(jnp.float32), "shards")  # scalar
+            ttf_g = jax.lax.psum(sum_ttf_local[0], "shards")  # [F]
+        else:
+            df_g = df_local.astype(jnp.float32)
+            N = max_doc_local[0].astype(jnp.float32)
+            ttf_g = sum_ttf_local[0]
 
         if similarity_kind == 0:  # BM25
             idf = jnp.log(1.0 + (N - df_g + 0.5) / (df_g + 0.5))
@@ -278,11 +286,13 @@ def _mesh_score_program(k: int, n_queries: int, doc_pad: int, similarity_kind: i
         if similarity_kind == 1:
             w = w * qn_per_query[qidx]
         w = w[:, None]
+        # tf factor first, then weight — the rounding order every other scorer uses
+        # (ops/device_index.tfn_values, HostScorer._term_scores)
         if similarity_kind == 0:
             cache_vals = bm25_cache[fidx[:, None], nb]
-            contrib = (w * freqs) / (freqs + cache_vals)
+            contrib = w * (freqs / (freqs + cache_vals))
         else:
-            contrib = jnp.sqrt(freqs) * w * NORM_DECODE[nb]
+            contrib = w * (jnp.sqrt(freqs) * NORM_DECODE[nb])
         scoring = (group[:, None] != GROUP_MUST_NOT) & valid
         contrib = jnp.where(scoring, contrib, 0.0)
 
@@ -306,6 +316,10 @@ def _mesh_score_program(k: int, n_queries: int, doc_pad: int, similarity_kind: i
         m_not = counts >> _NOT_SHIFT
         match = (m_must == n_must[:, None]) & (m_should >= msm[:, None]) & (m_not == 0)
         match = match & ((m_should + m_must) > 0) & live_l[None, :]
+        if filter_masks is not None:
+            # FilteredQuery: the filter gates matching, never scoring (ref:
+            # FilteredQuery's scorer — score comes from the wrapped query alone)
+            match = match & filter_masks[0]
 
         overlap = jnp.minimum(m_should + m_must, coord.shape[1] - 1)
         scores = scores * jnp.take_along_axis(coord, overlap, axis=1)
@@ -321,7 +335,8 @@ def _mesh_score_program(k: int, n_queries: int, doc_pad: int, similarity_kind: i
         )
 
         # ---- reduce phase: global top-k via all_gather (shard-major → Lucene
-        # tie-break order), totals via psum ----
+        # tie-break order); per-shard totals gathered so serving can synthesize
+        # per-shard query results (ShardQueryResult) without a second pass ----
         g_scores = jax.lax.all_gather(local_scores, "shards")  # [S, Qd, k]
         g_ids = jax.lax.all_gather(local_ids, "shards")
         S = g_scores.shape[0]
@@ -329,8 +344,9 @@ def _mesh_score_program(k: int, n_queries: int, doc_pad: int, similarity_kind: i
         g_ids = jnp.transpose(g_ids, (1, 0, 2)).reshape(n_queries, S * k)
         top_scores, pos = jax.lax.top_k(g_scores, k)
         top_ids = jnp.take_along_axis(g_ids, pos, axis=1)
-        totals = jax.lax.psum(match.sum(axis=1).astype(jnp.int32), "shards")
-        return (top_scores[None], top_ids[None], totals[None])
+        shard_totals = jax.lax.all_gather(
+            match.sum(axis=1).astype(jnp.int32), "shards")  # [S, Qd]
+        return (top_scores[None], top_ids[None], shard_totals[None])
 
     return program
 
@@ -340,7 +356,8 @@ class MeshTopDocs:
     scores: np.ndarray  # [Q, k]
     shard: np.ndarray  # [Q, k] (-1 = no hit)
     doc: np.ndarray  # [Q, k] local doc id within shard
-    totals: np.ndarray  # [Q]
+    totals: np.ndarray  # [Q] — global matches (sum over shards)
+    shard_totals: np.ndarray = None  # [S, Q] per-shard matches
 
 
 class MeshSearchExecutor:
@@ -350,11 +367,12 @@ class MeshSearchExecutor:
     (query-batch data parallelism)."""
 
     def __init__(self, index: ShardedIndex, mesh, similarity="BM25",
-                 k1: float = 1.2, b: float = 0.75):
+                 k1: float = 1.2, b: float = 0.75, use_global_stats: bool = True):
         self.index = index
         self.mesh = mesh
         self.similarity_kind = 0 if str(similarity).upper() == "BM25" else 1
         self.k1, self.b = k1, b
+        self.use_global_stats = use_global_stats
         self._compiled: dict = {}
 
     # -- host-side batch assembly -------------------------------------------
@@ -382,28 +400,42 @@ class MeshSearchExecutor:
             group_c[ci] = grp
             for si in range(idx.n_shards):
                 df_local[si, ci] = idx.shard_term_df[si].get((f, t), 0)
-        # entries per shard
-        per_shard_entries: list[list] = [[] for _ in range(idx.n_shards)]
-        for ci, (qi, f, t, bst, grp, mode) in enumerate(clauses):
-            for si in range(idx.n_shards):
-                rng = idx.shard_term_blocks[si].get((f, t))
-                if rng is None:
-                    continue
-                for blk_row in range(rng[0], rng[1]):
-                    per_shard_entries[si].append(
-                        (qi, blk_row, ci, field_pos.get(f, 0), grp, mode))
-        M = _pow2_bucket(max(max((len(e) for e in per_shard_entries), default=1), 1), 16)
+        # entries per shard, vectorized block expansion (clause block-RANGES expand to
+        # per-block rows with repeat/cumsum — no Python loop over blocks)
         S = idx.n_shards
+        per_shard = []
+        for si in range(S):
+            tb = idx.shard_term_blocks[si]
+            rows = [(rng[0], rng[1], qi, ci, field_pos.get(f, 0), grp, mode)
+                    for ci, (qi, f, t, bst, grp, mode) in enumerate(clauses)
+                    if (rng := tb.get((f, t))) is not None]
+            if not rows:
+                per_shard.append(None)
+                continue
+            b0 = np.array([r[0] for r in rows], np.int64)
+            counts = np.array([r[1] for r in rows], np.int64) - b0
+            per_shard.append((
+                np.repeat(np.array([r[2] for r in rows], np.int32), counts),  # qidx
+                expand_ranges(b0, counts).astype(np.int32),  # blk
+                np.repeat(np.array([r[3] for r in rows], np.int32), counts),  # clause
+                np.repeat(np.array([r[4] for r in rows], np.int32), counts),  # fidx
+                np.repeat(np.array([r[5] for r in rows], np.int32), counts),  # group
+                np.repeat(np.array([r[6] for r in rows], np.int32), counts),  # mode
+            ))
+        M = _pow2_bucket(max(max((len(p[0]) for p in per_shard if p is not None),
+                                 default=1), 1), 16)
         qidx = np.zeros((S, M), np.int32)
         blk = np.full((S, M), idx.nb_pad - 1, np.int32)
         clause_id = np.zeros((S, M), np.int32)
         fidx = np.zeros((S, M), np.int32)
         group = np.zeros((S, M), np.int32)
         tfmode = np.zeros((S, M), np.int32)
-        for si, entries in enumerate(per_shard_entries):
-            for i, (qi, b_, ci, fi, g, m) in enumerate(entries):
-                qidx[si, i], blk[si, i], clause_id[si, i] = qi, b_, ci
-                fidx[si, i], group[si, i], tfmode[si, i] = fi, g, m
+        for si, p in enumerate(per_shard):
+            if p is None:
+                continue
+            n = len(p[0])
+            qidx[si, :n], blk[si, :n], clause_id[si, :n] = p[0], p[1], p[2]
+            fidx[si, :n], group[si, :n], tfmode[si, :n] = p[3], p[4], p[5]
         # per-query bool semantics
         Q = len(plans)
         n_scoring_max = max(
@@ -423,7 +455,11 @@ class MeshSearchExecutor:
         return (qidx, blk, clause_id, fidx, group, tfmode, df_local, boost,
                 clause_qidx, clause_scoring, n_must, msm, coord)
 
-    def search(self, plans: list[FlatPlan], k: int) -> MeshTopDocs:
+    def search(self, plans: list[FlatPlan], k: int,
+               filter_masks: np.ndarray | None = None) -> MeshTopDocs:
+        """filter_masks: optional bool [S, Q, doc_pad] — per-shard, per-query
+        FilteredQuery masks (host-evaluated via the filter cache, sharded onto the
+        mesh; they gate matching, not scoring)."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
@@ -438,27 +474,31 @@ class MeshSearchExecutor:
         (qidx, blk, clause_id, fidx, group, tfmode, df_local, boost, clause_qidx,
          clause_scoring, n_must, msm, coord) = self._assemble(plans)
 
-        key = (Q, k, qidx.shape[1], coord.shape[1])
+        has_filter = filter_masks is not None
+        key = (Q, k, qidx.shape[1], coord.shape[1], has_filter)
         fn = self._compiled.get(key)
         if fn is None:
             program = _mesh_score_program(k, Q, idx.doc_pad, self.similarity_kind,
-                                          self.k1, self.b)
+                                          self.k1, self.b, self.use_global_stats)
+            in_specs = [
+                P("shards"), P("shards"), P("shards"), P("shards"),  # index
+                P("shards"), P("shards"), P("shards"), P("shards"), P("shards"), P("shards"),  # entries
+                P("shards"), P(), P(), P(),  # clause tables (df sharded)
+                P("shards"), P("shards"),  # stats
+                P(), P(), P(),  # per-query
+            ]
+            if has_filter:
+                in_specs.append(P("shards"))
             fn = shard_map(
                 program, mesh=self.mesh,
-                in_specs=(
-                    P("shards"), P("shards"), P("shards"), P("shards"),  # index
-                    P("shards"), P("shards"), P("shards"), P("shards"), P("shards"), P("shards"),  # entries
-                    P("shards"), P(), P(), P(),  # clause tables (df sharded)
-                    P("shards"), P("shards"),  # stats
-                    P(), P(), P(),  # per-query
-                ),
+                in_specs=tuple(in_specs),
                 out_specs=(P(), P(), P()),
                 check_vma=False,
             )
             fn = jax.jit(fn)
             self._compiled[key] = fn
         S = idx.n_shards
-        top_scores, top_ids, totals = fn(
+        args = [
             idx.blk_docs, idx.blk_freqs, idx.norms, idx.live,
             jnp.asarray(qidx), jnp.asarray(blk), jnp.asarray(clause_id),
             jnp.asarray(fidx), jnp.asarray(group), jnp.asarray(tfmode),
@@ -466,12 +506,17 @@ class MeshSearchExecutor:
             jnp.asarray(clause_scoring),
             jnp.asarray(idx.max_doc), jnp.asarray(idx.sum_ttf),
             jnp.asarray(n_must), jnp.asarray(msm), jnp.asarray(coord),
-        )
+        ]
+        if has_filter:
+            args.append(jnp.asarray(filter_masks))
+        top_scores, top_ids, shard_totals = fn(*args)
         top_scores = np.asarray(top_scores)[0]
         top_ids = np.asarray(top_ids)[0]
-        totals = np.asarray(totals)[0]
+        shard_totals = np.asarray(shard_totals)[0]  # [S, Q]
         shard = np.where(top_ids >= 0, top_ids // idx.doc_pad, -1)
         doc = np.where(top_ids >= 0, top_ids % idx.doc_pad, -1)
         shard = np.where(np.isfinite(top_scores), shard, -1)
         doc = np.where(shard >= 0, doc, -1)
-        return MeshTopDocs(scores=top_scores, shard=shard, doc=doc, totals=totals)
+        return MeshTopDocs(scores=top_scores, shard=shard, doc=doc,
+                           totals=shard_totals.sum(axis=0).astype(np.int64),
+                           shard_totals=shard_totals)

@@ -1,0 +1,224 @@
+"""Mesh serving: route co-located multi-shard searches through the SPMD program.
+
+In the reference, scatter-gather IS the production search path — the coordinator
+fans query-phase requests to every shard copy and reduces
+(action/search/type/TransportSearchTypeAction.java:117,135-216; the merge at
+search/controller/SearchPhaseController.java:137). Here, when an index's shards all
+live on THIS node and a device mesh can hold one shard per device, the whole
+scatter/score/reduce collapses into ONE jitted SPMD program (mesh_search.py): DFS
+stats ride psum, the reduce rides all_gather + top_k — collectives over ICI instead
+of RPC over DCN. Anything the program can't express (aggregations, sort, rescore,
+filters, non-flat queries, remote shards) falls back to the transport scatter-gather
+unchanged — same results either way, checked by tests/test_mesh_serving.py.
+
+The executor is cached per index and rebuilt when any shard's segment generation or
+live version moves (NRT refresh / merges / deletes)."""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..common.logging import get_logger
+from ..search.execute import lower_flat
+from ..search.filters import segment_mask
+from ..search.queries import FilteredQuery
+from ..search.service import ParsedSearchRequest, ShardQueryResult
+from ..search.similarity import BM25Similarity, TFIDFSimilarity
+from .mesh_search import MeshSearchExecutor, build_sharded_index
+
+
+class MeshServingService:
+    """Decides per search whether the SPMD mesh program can serve it, and does."""
+
+    MIN_SHARDS = 2  # a 1-shard search gains nothing from the mesh
+
+    def __init__(self, indices_service, settings, node_name: str = "node"):
+        self.indices = indices_service
+        self.enabled = bool(settings.get_bool("search.mesh.enabled", True))
+        self.logger = get_logger("search.mesh", node=node_name)
+        self.mesh_queries = 0  # served via the SPMD program (stats/test hook)
+        self.mesh_fallbacks = 0  # eligible-looking but fell back mid-flight
+        self._lock = threading.Lock()
+        self._meshes: dict[int, object] = {}
+        self._executors: dict = {}  # index -> (freshness_key, executor dict)
+
+    # ------------------------------------------------------------------
+    def _mesh_for(self, n_shards: int):
+        import jax
+
+        mesh = self._meshes.get(n_shards)
+        if mesh is None:
+            devices = jax.devices()
+            if len(devices) < n_shards:
+                return None
+            from jax.sharding import Mesh
+
+            mesh = Mesh(np.array(devices[:n_shards]), ("shards",))
+            self._meshes[n_shards] = mesh
+        return mesh
+
+    def _eligible(self, state, local_node_id, indices, alias_filters, shards,
+                  req: ParsedSearchRequest):
+        """Cheap host-side checks, in rough rejection-frequency order."""
+        if not self.enabled or len(indices) != 1:
+            return None
+        index = indices[0]
+        if alias_filters.get(index):
+            return None
+        if (req.aggs or req.facets or req.suggest or req.sort or req.post_filter
+                or req.rescore or req.min_score is not None or req.explain):
+            return None
+        if len(shards) < self.MIN_SHARDS:
+            return None
+        if any(c.node_id != local_node_id for c in shards):
+            return None
+        sids = sorted(c.shard_id for c in shards)
+        if sids != list(range(len(shards))):
+            return None  # routing/preference selected a subset — not whole-index
+        return index
+
+    def try_search(self, state, local_node_id: str, indices, alias_filters,
+                   shards, req: ParsedSearchRequest, use_global_stats: bool):
+        """Returns per-ordinal ShardQueryResults (ordinal = position in `shards`)
+        when the mesh program served the query phase, else None (transport path)."""
+        index = self._eligible(state, local_node_id, indices, alias_filters, shards, req)
+        if index is None:
+            return None
+        self._prune(state)
+        try:
+            results = self._search_mesh(index, shards, req, use_global_stats)
+        except Exception as e:  # noqa: BLE001 — any mesh failure must not fail the search
+            results = None
+            self.logger.warn(f"mesh path failed, falling back to transport: {e}")
+        if results is None:
+            self.mesh_fallbacks += 1  # eligible-looking but fell back mid-flight
+        return results
+
+    def _prune(self, state):
+        """Drop executors (and their device-resident index arrays) for indices that no
+        longer exist — a deleted-then-recreated index must never hit the old cache."""
+        with self._lock:
+            if not self._executors:
+                return
+            live = {n for n, _m in state.metadata.indices}
+            for name in [n for n in self._executors if n not in live]:
+                del self._executors[name]
+
+    # ------------------------------------------------------------------
+    def _search_mesh(self, index: str, shards, req: ParsedSearchRequest,
+                     use_global_stats: bool):
+        svc = self.indices.index_service(index)
+        S = len(shards)
+        searchers = [svc.shard(sid).engine.acquire_searcher() for sid in range(S)]
+
+        from ..search.execute import ShardContext
+
+        ctx0 = ShardContext(searchers[0], svc.mapper_service, svc.similarity_service)
+        query = req.query
+        filt = None
+        if isinstance(query, FilteredQuery):
+            # the filter gates matching only — evaluate host-side per shard (reusing
+            # the per-segment filter cache) and ship masks onto the mesh
+            if getattr(query, "boost", 1.0) != 1.0:
+                return None
+            filt = query.filter
+            query = query.query
+        plan = lower_flat(query, ctx0)
+        if plan is None:
+            return None
+        # one similarity family per program: every queried field must score with the
+        # index default (per-field DFR/IB/etc lowered out already by lower_flat)
+        default_sim = svc.similarity_service.default
+        kind = "BM25" if isinstance(default_sim, BM25Similarity) else "default"
+        for c in plan.clauses:
+            sim = svc.similarity_service.for_field(c.field)
+            if type(sim) is not type(default_sim):
+                return None
+            if isinstance(sim, BM25Similarity) and (
+                    sim.k1 != default_sim.k1 or sim.b != default_sim.b):
+                return None
+        k = max(req.from_ + req.size, 1)
+
+        executor = self._executor_for(index, svc, searchers, kind, default_sim,
+                                      use_global_stats)
+        if executor is None:
+            return None
+        if k > executor.index.doc_pad:
+            return None
+        # queried fields must exist in the packed norm stack (a field with no norms
+        # anywhere would silently score with another field's norms)
+        for c in plan.clauses:
+            if c.field not in executor.index.fields:
+                return None
+
+        filter_masks = None
+        if filt is not None:
+            doc_pad = executor.index.doc_pad
+            filter_masks = np.zeros((S, 1, doc_pad), bool)
+            for si, searcher in enumerate(searchers):
+                ctx_i = ShardContext(searcher, svc.mapper_service,
+                                     svc.similarity_service)
+                for seg, base in zip(searcher.segments, searcher.bases):
+                    filter_masks[si, 0, base: base + seg.doc_count] = \
+                        segment_mask(seg, filt, ctx_i)
+
+        out = executor.search([plan], k, filter_masks=filter_masks)
+        self.mesh_queries += 1
+
+        results = []
+        for ordinal, copy in enumerate(shards):
+            rows = [(float(out.scores[0][j]), int(out.doc[0][j]), None)
+                    for j in range(out.scores.shape[1])
+                    if out.shard[0][j] == copy.shard_id]
+            scores = [s for (s, _d, _sv) in rows]
+            results.append(ShardQueryResult(
+                total=int(out.shard_totals[copy.shard_id, 0]),
+                docs=rows,
+                max_score=max(scores) if scores else float("nan"),
+                shard_id=ordinal,
+            ))
+        return results
+
+    def _executor_for(self, index: str, svc, searchers, kind, default_sim,
+                      use_global_stats: bool):
+        """Build-or-reuse the ShardedIndex + executor; rebuilt when any shard's
+        segments or tombstones moved."""
+        freshness = tuple(
+            (tuple(seg.gen for seg in s.segments),
+             tuple(seg.live_gen for seg in s.segments),
+             s.max_doc)
+            for s in searchers
+        )
+        with self._lock:
+            cached = self._executors.get(index)
+            if cached is not None and cached[0] == freshness and cached[1] is svc:
+                execs = cached[2]
+                if execs is None:
+                    return None  # negative cache: this generation failed to build
+            else:
+                mesh = self._mesh_for(len(searchers))
+                if mesh is None:
+                    return None
+                fields = sorted({f for s in searchers for seg in s.segments
+                                 for f in seg.norms})
+                if not fields:
+                    return None
+                try:
+                    sharded = build_sharded_index(searchers, fields, mesh=mesh)
+                    execs = {}
+                    for gs in (False, True):
+                        execs[gs] = MeshSearchExecutor(
+                            sharded, mesh, similarity=kind,
+                            k1=getattr(default_sim, "k1", 1.2),
+                            b=getattr(default_sim, "b", 0.75),
+                            use_global_stats=gs)
+                except Exception as e:  # noqa: BLE001 — e.g. device OOM on pack
+                    # negative-cache the failure so every search doesn't re-pay a
+                    # doomed multi-second repack under the lock
+                    self._executors[index] = (freshness, svc, None)
+                    self.logger.warn(f"mesh index build failed for [{index}]: {e}")
+                    return None
+                self._executors[index] = (freshness, svc, execs)
+            return execs[use_global_stats]

@@ -1,0 +1,145 @@
+"""Mesh serving: a REST _search over a co-located multi-shard index executes the
+SPMD shard_map program (DFS psum + all_gather top-k over the virtual 8-device CPU
+mesh) and produces results identical to the transport scatter-gather path.
+
+ref: the scatter-gather this replaces is TransportSearchTypeAction.java:117,135-216
+with the reduce at SearchPhaseController.java:137."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.transport.local import LocalTransportRegistry
+
+N_SHARDS = 4
+VOCAB = ("alpha beta gamma delta epsilon zeta eta theta iota kappa lamda mu nu xi "
+         "omicron pi rho sigma tau upsilon phi chi psi omega").split()
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    registry = LocalTransportRegistry()
+    n = Node(name="mesh_node", registry=registry,
+             data_path=str(tmp_path_factory.mktemp("mesh_node")))
+    n.start([n.local_node.transport_address])
+    n.wait_for_master()
+    client = n.client()
+    client.create_index("library", {"settings": {
+        "number_of_shards": N_SHARDS, "number_of_replicas": 0}})
+    client.cluster_health(wait_for_status="green")
+    rng = np.random.default_rng(7)
+    for i in range(120):
+        body = " ".join(rng.choice(VOCAB, size=rng.integers(5, 25)))
+        client.index("library", "doc", {"body": body, "n": int(i)}, id=str(i))
+    client.refresh("library")
+    yield n, client
+    n.close()
+
+
+def _search_both_paths(node_, client, body, search_type="query_then_fetch"):
+    """Run the same search with mesh serving on and off; return (mesh, transport)."""
+    ms = node_.actions.mesh_serving
+    before = ms.mesh_queries
+    mesh = client.search("library", body, search_type=search_type)
+    assert ms.mesh_queries == before + 1, "search did not ride the mesh program"
+    ms.enabled = False
+    try:
+        transport = client.search("library", body, search_type=search_type)
+    finally:
+        ms.enabled = True
+    return mesh, transport
+
+
+def _assert_same_results(mesh, transport):
+    assert mesh["hits"]["total"] == transport["hits"]["total"]
+    m = [(h["_id"], h["_score"]) for h in mesh["hits"]["hits"]]
+    t = [(h["_id"], h["_score"]) for h in transport["hits"]["hits"]]
+    assert [i for i, _ in m] == [i for i, _ in t]
+    assert np.allclose([s for _, s in m], [s for _, s in t], rtol=2e-6)
+
+
+class TestMeshServing:
+    def test_match_rides_mesh_and_agrees(self, node):
+        n, client = node
+        body = {"query": {"match": {"body": "alpha beta"}}, "size": 10}
+        mesh, transport = _search_both_paths(n, client, body)
+        assert mesh["hits"]["total"] > 0
+        _assert_same_results(mesh, transport)
+
+    def test_bool_semantics_on_mesh(self, node):
+        n, client = node
+        body = {"query": {"bool": {
+            "must": [{"term": {"body": "alpha"}}],
+            "should": [{"term": {"body": "beta"}}, {"term": {"body": "gamma"}}],
+            "must_not": [{"term": {"body": "omega"}}]}}, "size": 10}
+        mesh, transport = _search_both_paths(n, client, body)
+        _assert_same_results(mesh, transport)
+
+    def test_dfs_search_type_uses_global_stats(self, node):
+        n, client = node
+        body = {"query": {"match": {"body": "delta epsilon"}}, "size": 10}
+        mesh, transport = _search_both_paths(n, client, body,
+                                             search_type="dfs_query_then_fetch")
+        _assert_same_results(mesh, transport)
+
+    def test_aggs_fall_back_to_transport(self, node):
+        n, client = node
+        ms = n.actions.mesh_serving
+        before = ms.mesh_queries
+        r = client.search("library", {"query": {"match": {"body": "alpha"}},
+                                      "aggs": {"n_avg": {"avg": {"field": "n"}}}})
+        assert ms.mesh_queries == before  # ineligible: aggregations
+        assert "n_avg" in r["aggregations"]
+
+    def test_fetch_phase_hydrates_mesh_hits(self, node):
+        n, client = node
+        mesh, _ = _search_both_paths(
+            n, client, {"query": {"term": {"body": "alpha"}}, "size": 5})
+        for h in mesh["hits"]["hits"]:
+            assert "body" in h["_source"] and h["_index"] == "library"
+
+    def test_deletes_invalidate_mesh_cache(self, node):
+        n, client = node
+        body = {"query": {"term": {"body": "alpha"}}, "size": 30}
+        mesh, _ = _search_both_paths(n, client, body)
+        victims = [h["_id"] for h in mesh["hits"]["hits"]][:2]
+        for vid in victims:
+            client.delete("library", "doc", vid)
+        client.refresh("library")
+        mesh2, transport2 = _search_both_paths(n, client, body)
+        _assert_same_results(mesh2, transport2)
+        ids = [h["_id"] for h in mesh2["hits"]["hits"]]
+        assert not (set(victims) & set(ids))
+
+    def test_filtered_query_rides_mesh(self, node):
+        n, client = node
+        body = {"query": {"filtered": {
+            "query": {"match": {"body": "alpha beta"}},
+            "filter": {"range": {"n": {"gte": 10, "lt": 80}}}}}, "size": 10}
+        mesh, transport = _search_both_paths(n, client, body)
+        _assert_same_results(mesh, transport)
+        assert mesh["hits"]["total"] > 0
+
+    def test_recreated_index_never_serves_stale_cache(self, node):
+        n, client = node
+        for round_ in ("first", "second"):
+            client.create_index("tmpidx", {"settings": {
+                "number_of_shards": 2, "number_of_replicas": 0}})
+            client.cluster_health(wait_for_status="green")
+            for i in range(8):
+                client.index("tmpidx", "doc", {"body": f"{round_} common"}, id=str(i))
+            client.refresh("tmpidx")
+            r = client.search("tmpidx", {"query": {"term": {"body": round_}},
+                                         "size": 5})
+            assert r["hits"]["total"] == 8, round_  # stale cache would return 0
+            client.delete_index("tmpidx")
+
+    def test_new_docs_visible_after_refresh(self, node):
+        n, client = node
+        client.index("library", "doc", {"body": "zzyzx alpha", "n": 999}, id="zz1")
+        client.refresh("library")
+        mesh, transport = _search_both_paths(
+            n, client, {"query": {"term": {"body": "zzyzx"}}, "size": 5})
+        assert mesh["hits"]["total"] == 1
+        assert mesh["hits"]["hits"][0]["_id"] == "zz1"
+        _assert_same_results(mesh, transport)
