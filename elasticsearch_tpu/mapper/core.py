@@ -450,8 +450,10 @@ class DocumentMapper:
                     self._parse_object(v, f"{full}.", sub, sub_all, nested_path=full)
                     doc.nested_docs.append((full, sub))
                 continue
-            if values and all(isinstance(v, dict) for v in values):
-                # array of objects, non-nested: flatten each
+            if values and all(isinstance(v, dict) for v in values) and (
+                    ft is None or ft.type not in ("geo_point", "geo_shape")):
+                # array of objects, non-nested: flatten each (geo types consume
+                # their dict form as a leaf value: {lat,lon} / GeoJSON shape)
                 for v in values:
                     self._parse_object(v, f"{full}.", doc, all_terms, nested_path)
                 continue
@@ -514,9 +516,27 @@ class DocumentMapper:
             if not col:
                 doc.doc_values_num.pop(ft.name, None)
         elif ft.type == "geo_point":
-            lat, lon = _parse_geo_point(values)
-            doc.doc_values_num.setdefault(f"{ft.name}.lat", []).append(lat)
-            doc.doc_values_num.setdefault(f"{ft.name}.lon", []).append(lon)
+            for lat, lon in _parse_geo_points(values):
+                doc.doc_values_num.setdefault(f"{ft.name}.lat", []).append(lat)
+                doc.doc_values_num.setdefault(f"{ft.name}.lon", []).append(lon)
+        elif ft.type == "geo_shape":
+            # shape stored columnar as canonical JSON (the dv_str column persists
+            # with the segment); relations evaluate host-side from the parsed form —
+            # the TPU-framework replacement for the reference's spatial prefix-tree
+            # terms (ref: index/mapper/geo/GeoShapeFieldMapper.java)
+            import json as _json
+
+            from ..common.geo import normalize_shape
+
+            for v in values:
+                if not isinstance(v, dict):
+                    raise MapperParsingError(f"failed to parse geo_shape [{v}]")
+                try:
+                    kind, data = normalize_shape(v)
+                except ValueError as e:
+                    raise MapperParsingError(str(e))
+                doc.doc_values_str.setdefault(ft.name, []).append(
+                    _json.dumps([kind, data], separators=(",", ":")))
         elif ft.type == "binary":
             pass  # stored via _source only
         else:
@@ -588,15 +608,28 @@ class DocumentMapper:
         return conflicts
 
 
-def _parse_geo_point(values: list) -> tuple[float, float]:
-    v = values[0] if len(values) == 1 else values
+def _parse_geo_points(values: list) -> list[tuple[float, float]]:
+    """One or many points: dict {lat,lon} / "lat,lon" / geohash / [lon,lat] —
+    a bare numeric pair is ONE point (GeoJSON), anything else is per-element."""
+    if len(values) == 2 and all(isinstance(x, (int, float)) for x in values):
+        return [(float(values[1]), float(values[0]))]
+    return [_parse_geo_point(v) for v in values]
+
+
+def _parse_geo_point(v) -> tuple[float, float]:
     if isinstance(v, dict):
         return float(v["lat"]), float(v["lon"])
     if isinstance(v, str):
         if "," in v:
             lat, lon = v.split(",")
             return float(lat), float(lon)
-        raise MapperParsingError(f"geohash not supported yet [{v}]")
+        # bare string = geohash (ref: GeoPointFieldMapper geohash support)
+        from ..common.geo import geohash_decode
+
+        try:
+            return geohash_decode(v.strip().lower())
+        except (KeyError, ValueError):
+            raise MapperParsingError(f"failed to parse geohash [{v}]")
     if isinstance(v, list):
         if len(v) == 2 and all(isinstance(x, (int, float)) for x in v):
             return float(v[1]), float(v[0])  # GeoJSON order [lon, lat]

@@ -58,7 +58,12 @@ from .queries import (
     QueryStringQuery,
     RangeQuery,
     RegexpQuery,
+    FieldMaskingSpanQuery,
+    SpanFirstQuery,
+    SpanMultiTermQuery,
     SpanNearQuery,
+    SpanNotQuery,
+    SpanOrQuery,
     SpanTermQuery,
     TermQuery,
     WildcardQuery,
@@ -702,14 +707,9 @@ class HostScorer:
         if isinstance(q, SpanTermQuery):
             return self._term_scores(q.field, q.value, b)
 
-        if isinstance(q, SpanNearQuery):
-            terms = [c.value if isinstance(c, SpanTermQuery) else None for c in q.clauses]
-            fields = {c.field for c in q.clauses if isinstance(c, SpanTermQuery)}
-            if None in terms or len(fields) != 1:
-                raise QueryParsingError("span_near supports span_term clauses on one field")
-            pq = PhraseQuery(next(iter(fields)), " ".join(terms), slop=q.slop)
-            pq._pre_analyzed = terms  # type: ignore[attr-defined]
-            return self._eval_phrase(pq, b, in_order=q.in_order)
+        if isinstance(q, (SpanNearQuery, SpanOrQuery, SpanFirstQuery, SpanNotQuery,
+                          SpanMultiTermQuery, FieldMaskingSpanQuery)):
+            return self._eval_spans(q, b)
 
         if isinstance(q, IndicesQuery):
             # index targeting resolved at the shard level; here run the main query
@@ -758,6 +758,131 @@ class HostScorer:
             coord = matched_count.astype(np.float32) / np.float32(n_scoring)
             scores = scores * coord
         return np.where(match, scores, 0).astype(np.float32), match
+
+    # -- spans ---------------------------------------------------------------
+    # The span family enumerates (start, end) position windows per doc, composed
+    # recursively — the host-plane equivalent of Lucene's Spans enumerations
+    # (ref: SpanOrQueryParser.java:1, SpanFirstQueryParser.java:1,
+    # SpanNotQueryParser.java:1, SpanMultiTermQueryParser.java:1,
+    # FieldMaskingSpanQueryParser.java:1). Scoring mirrors this framework's phrase
+    # convention: freq = number of matching spans (exact for adjacent matches;
+    # documented approximation of Lucene's sloppyFreq weighting otherwise).
+
+    def _span_tree(self, q):
+        """Returns (field, {local_doc: sorted [(start, end)]}, contributing terms)."""
+        seg = self.seg
+        if isinstance(q, SpanTermQuery):
+            docs, _ = seg.postings(q.field, q.value)
+            pos_lists = seg.term_positions(q.field, q.value)
+            spans = {int(d): [(int(p), int(p) + 1) for p in np.sort(pl)]
+                     for d, pl in zip(docs, pos_lists) if len(pl)}
+            return q.field, spans, {(q.field, q.value)}
+        if isinstance(q, SpanMultiTermQuery):
+            inner = q.match
+            if isinstance(inner, (PrefixQuery, WildcardQuery, RegexpQuery)):
+                if isinstance(inner, PrefixQuery):
+                    pred = lambda t: t.startswith(inner.prefix)  # noqa: E731
+                elif isinstance(inner, WildcardQuery):
+                    rex = re.compile(_wildcard_to_regex(inner.pattern))
+                    pred = lambda t: rex.fullmatch(t) is not None  # noqa: E731
+                else:
+                    rex = re.compile(inner.pattern)
+                    pred = lambda t: rex.fullmatch(t) is not None  # noqa: E731
+                terms = [t for t in seg.terms_for_field(inner.field) if pred(t)]
+                field = inner.field
+            elif isinstance(inner, FuzzyQuery):
+                terms = self._fuzzy_terms(inner)
+                field = inner.field
+            else:
+                raise QueryParsingError(
+                    f"span_multi does not support [{type(inner).__name__}]")
+            spans: dict = {}
+            termset = set()
+            for t in terms:
+                _f, s2, t2 = self._span_tree(SpanTermQuery(field, t))
+                termset |= t2
+                for d, sp in s2.items():
+                    spans.setdefault(d, []).extend(sp)
+            return field, {d: sorted(set(sp)) for d, sp in spans.items()}, termset
+        if isinstance(q, FieldMaskingSpanQuery):
+            _f, spans, terms = self._span_tree(q.query)
+            return q.field, spans, terms
+        if isinstance(q, SpanOrQuery):
+            field, spans, termset = None, {}, set()
+            for c in q.clauses:
+                f2, s2, t2 = self._span_tree(c)
+                field = field or f2
+                if f2 != field:
+                    raise QueryParsingError("span_or clauses must share a field")
+                termset |= t2
+                for d, sp in s2.items():
+                    spans.setdefault(d, []).extend(sp)
+            return field, {d: sorted(set(sp)) for d, sp in spans.items()}, termset
+        if isinstance(q, SpanFirstQuery):
+            field, spans, terms = self._span_tree(q.match)
+            out = {d: [s for s in sp if s[1] <= q.end] for d, sp in spans.items()}
+            return field, {d: sp for d, sp in out.items() if sp}, terms
+        if isinstance(q, SpanNotQuery):
+            field, inc, terms = self._span_tree(q.include)
+            f2, exc, _t2 = self._span_tree(q.exclude)
+            if f2 != field:
+                raise QueryParsingError("span_not include/exclude must share a field")
+            out = {}
+            for d, sp in inc.items():
+                ex = exc.get(d)
+                keep = sp if not ex else [
+                    s for s in sp
+                    if not any(e[0] < s[1] and s[0] < e[1] for e in ex)]
+                if keep:
+                    out[d] = keep
+            # Lucene SpanNotQuery extracts only include terms into the weight
+            return field, out, terms
+        if isinstance(q, SpanNearQuery):
+            field, children, termset = None, [], set()
+            for c in q.clauses:
+                f2, s2, t2 = self._span_tree(c)
+                field = field or f2
+                if f2 != field:
+                    raise QueryParsingError("span_near clauses must share a field")
+                children.append(s2)
+                termset |= t2
+            if not children:
+                return field, {}, termset
+            docs = set(children[0])
+            for s2 in children[1:]:
+                docs &= set(s2)
+            spans = {}
+            for d in docs:
+                found = _near_spans([s2[d] for s2 in children], q.slop, q.in_order)
+                if found:
+                    spans[d] = found
+            return field, spans, termset
+        raise QueryParsingError(f"not a span query: {type(q).__name__}")
+
+    def _eval_spans(self, q, boost: float):
+        seg, ctx = self.seg, self.ctx
+        scores = np.zeros(self.D, np.float32)
+        match = np.zeros(self.D, bool)
+        field, spans, termset = self._span_tree(q)
+        if not spans or field is None:
+            return scores, match
+        sim = ctx.similarity_for(field)
+        cache = sim.norm_cache(ctx.field_stats(field), ctx.max_doc)
+        norms = seg.norms.get(field)
+        idf_sum = np.float32(sum(
+            float(sim.idf(ctx.doc_freq(f, t), ctx.max_doc))
+            for (f, t) in sorted(termset) if ctx.doc_freq(f, t) > 0))
+        for d, sp in spans.items():
+            freq = len(sp)
+            nb = norms[d] if norms is not None else 0
+            if isinstance(sim, BM25Similarity):
+                w = np.float32(idf_sum * boost * (sim.k1 + 1.0))
+                scores[d] = w * (np.float32(freq) / (np.float32(freq) + cache[nb]))
+            else:
+                w = np.float32(idf_sum * idf_sum * boost) * self.qn
+                scores[d] = w * (np.sqrt(np.float32(freq)) * cache[nb])
+            match[d] = True
+        return scores, match
 
     # -- multi-term ----------------------------------------------------------
     def _multi_term_mask(self, q) -> np.ndarray:
@@ -927,6 +1052,47 @@ def _positions_by_doc(seg: FrozenSegment, field: str, term: str) -> dict[int, se
         d = int(seg.post_docs[i])
         out[d] = set(seg.positions[seg.pos_offsets[i]: seg.pos_offsets[i + 1]].tolist())
     return out
+
+
+def _near_spans(lists: list[list[tuple[int, int]]], slop: int,
+                in_order: bool) -> list[tuple[int, int]]:
+    """Compose child span lists into near-spans with total gap <= slop.
+
+    Ordered: one span per clause, each starting at or after the previous clause's
+    end (Lucene NearSpansOrdered's non-overlap rule), gap = sum of inter-span
+    distances. Unordered: any one span per clause, gap = covering width minus total
+    child length (overlaps clamp to 0). Enumeration is bounded (the per-doc span
+    count is small); combos past the cap are dropped rather than searched."""
+    out: set[tuple[int, int]] = set()
+    if in_order:
+        budget = [20000]  # recursion guard for pathological position lists
+
+        def rec(i: int, start: int, prev_end: int, gap: int):
+            if budget[0] <= 0:
+                return
+            if i == len(lists):
+                out.add((start, prev_end))
+                return
+            for (s, e) in lists[i]:
+                if i > 0 and s < prev_end:
+                    continue
+                g = gap + (s - prev_end if i > 0 else 0)
+                if g > slop:
+                    continue
+                budget[0] -= 1
+                rec(i + 1, start if i > 0 else s, e, g)
+
+        rec(0, 0, 0, 0)
+    else:
+        import itertools
+
+        for combo in itertools.islice(itertools.product(*lists), 20000):
+            mn = min(s for s, _e in combo)
+            mx = max(e for _s, e in combo)
+            gap = max((mx - mn) - sum(e - s for s, e in combo), 0)
+            if gap <= slop:
+                out.add((mn, mx))
+    return sorted(out)
 
 
 def _phrase_freq(pos_sets: list[set], rel_pos: list[int], slop: int, in_order: bool) -> int:

@@ -327,19 +327,109 @@ class GeoDistanceFilter(Filter):
         return f"geodist:{self.field}:{self.lat}:{self.lon}:{self.distance_m}"
 
     def evaluate(self, seg, ctx):
-        lat_col = seg.dv_num.get(f"{self.field}.lat")
-        lon_col = seg.dv_num.get(f"{self.field}.lon")
-        mask = np.zeros(seg.doc_count, dtype=bool)
-        if lat_col is None or lon_col is None:
-            return mask
-        off, lats = lat_col
-        _, lons = lon_col
-        d = haversine_m(self.lat, self.lon, lats, lons)
-        hit = d <= self.distance_m
-        counts = np.diff(off)
-        doc_of_val = np.repeat(np.arange(seg.doc_count), counts)
-        np.logical_or.at(mask, doc_of_val, hit)
+        return _geo_points_mask(
+            seg, self.field,
+            lambda lats, lons: haversine_m(self.lat, self.lon, lats, lons)
+            <= self.distance_m)
+
+
+def _geo_points_mask(seg, field: str, hit_fn) -> np.ndarray:
+    """Doc mask from the multi-valued point columns: hit_fn(lats, lons) -> bool[V]
+    per value, OR-scattered to docs — shared by every point-based geo filter."""
+    lat_col = seg.dv_num.get(f"{field}.lat")
+    lon_col = seg.dv_num.get(f"{field}.lon")
+    mask = np.zeros(seg.doc_count, dtype=bool)
+    if lat_col is None or lon_col is None:
         return mask
+    off, lats = lat_col
+    _, lons = lon_col
+    hit = hit_fn(lats, lons)
+    counts = np.diff(off)
+    doc_of_val = np.repeat(np.arange(seg.doc_count), counts)
+    np.logical_or.at(mask, doc_of_val, hit)
+    return mask
+
+
+@dataclass
+class GeoShapeFilter(Filter):
+    """Docs whose stored shape relates to the query shape.
+
+    ref: GeoShapeFilter/GeoShapeQueryParser.java:1 — the reference tests prefix-tree
+    cell terms; here the shape column is decoded once per segment (cached) and the
+    relation computed exactly (common/geo.py)."""
+
+    field: str
+    shape: tuple  # normalized (kind, data)
+    relation: str = "intersects"  # intersects | within | disjoint
+
+    def key(self):
+        import json
+
+        return f"geoshape:{self.field}:{self.relation}:" \
+               f"{json.dumps(self.shape, sort_keys=True)}"
+
+    def _doc_shapes(self, seg):
+        """Parsed per-doc shape lists, cached on the segment."""
+        import json
+
+        cache = seg._device_cache.setdefault("geo_shapes", {})
+        parsed = cache.get(self.field)
+        if parsed is None:
+            parsed = [None] * seg.doc_count
+            for d in range(seg.doc_count):
+                vals = seg.str_values(self.field, d)
+                if vals:
+                    parsed[d] = [tuple(json.loads(v)) for v in vals]
+            cache[self.field] = parsed
+        return parsed
+
+    def evaluate(self, seg, ctx):
+        from ..common.geo import shape_within, shapes_intersect
+
+        mask = np.zeros(seg.doc_count, dtype=bool)
+        q = self.shape
+        for d, shapes in enumerate(self._doc_shapes(seg)):
+            if not shapes:
+                continue
+            if self.relation == "within":
+                mask[d] = any(shape_within(s, q) for s in shapes)
+            elif self.relation == "disjoint":
+                mask[d] = not any(shapes_intersect(s, q) for s in shapes)
+            else:
+                mask[d] = any(shapes_intersect(s, q) for s in shapes)
+        return mask
+
+
+@dataclass
+class GeohashCellFilter(Filter):
+    """Docs whose geo_point falls in the given geohash cell (optionally + the 8
+    neighbors). ref: index/query/GeohashCellFilter.java:1 — the reference matches
+    indexed geohash prefix terms; here the cell is a bbox test over the point
+    columns (identical semantics: a point is in the cell iff the cell geohash
+    prefixes the point's geohash)."""
+
+    field: str
+    geohash: str
+    neighbors: bool = False
+
+    def key(self):
+        return f"geohashcell:{self.field}:{self.geohash}:{self.neighbors}"
+
+    def evaluate(self, seg, ctx):
+        from ..common.geo import geohash_bbox, geohash_neighbors
+
+        cells = [self.geohash] + (geohash_neighbors(self.geohash)
+                                  if self.neighbors else [])
+
+        def hit(lats, lons):
+            h = np.zeros(len(lats), dtype=bool)
+            for cell in cells:
+                lat_lo, lat_hi, lon_lo, lon_hi = geohash_bbox(cell)
+                h |= ((lats >= lat_lo) & (lats < lat_hi)
+                      & (lons >= lon_lo) & (lons < lon_hi))
+            return h
+
+        return _geo_points_mask(seg, self.field, hit)
 
 
 @dataclass
@@ -354,22 +444,13 @@ class GeoBoundingBoxFilter(Filter):
         return f"geobb:{self.field}:{self.top}:{self.left}:{self.bottom}:{self.right}"
 
     def evaluate(self, seg, ctx):
-        lat_col = seg.dv_num.get(f"{self.field}.lat")
-        lon_col = seg.dv_num.get(f"{self.field}.lon")
-        mask = np.zeros(seg.doc_count, dtype=bool)
-        if lat_col is None or lon_col is None:
-            return mask
-        off, lats = lat_col
-        _, lons = lon_col
-        hit = (lats <= self.top) & (lats >= self.bottom)
-        if self.left <= self.right:
-            hit &= (lons >= self.left) & (lons <= self.right)
-        else:  # crossing the dateline
-            hit &= (lons >= self.left) | (lons <= self.right)
-        counts = np.diff(off)
-        doc_of_val = np.repeat(np.arange(seg.doc_count), counts)
-        np.logical_or.at(mask, doc_of_val, hit)
-        return mask
+        def hit(lats, lons):
+            h = (lats <= self.top) & (lats >= self.bottom)
+            if self.left <= self.right:
+                return h & (lons >= self.left) & (lons <= self.right)
+            return h & ((lons >= self.left) | (lons <= self.right))  # dateline
+
+        return _geo_points_mask(seg, self.field, hit)
 
 
 @dataclass

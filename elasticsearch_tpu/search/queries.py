@@ -24,6 +24,8 @@ from .filters import (
     Filter,
     GeoBoundingBoxFilter,
     GeoDistanceFilter,
+    GeohashCellFilter,
+    GeoShapeFilter,
     IdsFilter,
     MatchAllFilter,
     MissingFilter,
@@ -285,6 +287,51 @@ class SpanNearQuery(Query):
     clauses: list
     slop: int = 0
     in_order: bool = True
+    boost: float = 1.0
+
+
+@dataclass
+class SpanOrQuery(Query):
+    """ref: SpanOrQueryParser.java:1 — union of clause spans."""
+
+    clauses: list
+    boost: float = 1.0
+
+
+@dataclass
+class SpanFirstQuery(Query):
+    """ref: SpanFirstQueryParser.java:1 — match spans ending within [0, end)."""
+
+    match: Query = None
+    end: int = 0
+    boost: float = 1.0
+
+
+@dataclass
+class SpanNotQuery(Query):
+    """ref: SpanNotQueryParser.java:1 — include spans not overlapping exclude."""
+
+    include: Query = None
+    exclude: Query = None
+    boost: float = 1.0
+
+
+@dataclass
+class SpanMultiTermQuery(Query):
+    """ref: SpanMultiTermQueryParser.java:1 — a multi-term query (prefix/wildcard/
+    fuzzy/regexp) as a span: union of the expanded terms' position spans."""
+
+    match: Query = None
+    boost: float = 1.0
+
+
+@dataclass
+class FieldMaskingSpanQuery(Query):
+    """ref: FieldMaskingSpanQueryParser.java:1 — inner spans reported under another
+    field name, so span_near can compose across fields indexed in lockstep."""
+
+    query: Query = None
+    field: str = ""
     boost: float = 1.0
 
 
@@ -632,6 +679,22 @@ _QUERY_PARSERS = {
                                                        float(o.get("boost", 1.0))))(*_field_spec(s, "value")),
     "span_near": lambda s: SpanNearQuery([parse_query(c) for c in s.get("clauses", [])],
                                          int(s.get("slop", 0)), bool(s.get("in_order", True))),
+    "span_or": lambda s: SpanOrQuery([parse_query(c) for c in s.get("clauses", [])],
+                                     float(s.get("boost", 1.0))),
+    "span_first": lambda s: SpanFirstQuery(parse_query(s.get("match")),
+                                           int(s.get("end", 0)),
+                                           float(s.get("boost", 1.0))),
+    "span_not": lambda s: SpanNotQuery(parse_query(s.get("include")),
+                                       parse_query(s.get("exclude")),
+                                       float(s.get("boost", 1.0))),
+    "span_multi": lambda s: SpanMultiTermQuery(parse_query(s.get("match")),
+                                               float(s.get("boost", 1.0))),
+    "field_masking_span": lambda s: FieldMaskingSpanQuery(
+        parse_query(s.get("query")), str(s.get("field", "")),
+        float(s.get("boost", 1.0))),
+    "geo_shape": lambda s: ConstantScoreQuery(
+        filter=_parse_geo_shape_f({k: v for k, v in s.items() if k != "boost"}),
+        boost=float(s.get("boost", 1.0))),
     "indices": lambda s: IndicesQuery(_as_list(s.get("indices", s.get("index"))),
                                       parse_query(s.get("query")),
                                       parse_query(s["no_match_query"]) if isinstance(
@@ -703,6 +766,50 @@ def _parse_geo_bbox_f(spec) -> Filter:
     return GeoBoundingBoxFilter(fname, float(top), float(left), float(bottom), float(right))
 
 
+def _parse_geo_shape_f(spec) -> Filter:
+    """ref: GeoShapeQueryParser.java:1 — {field: {shape: {...}, relation}}."""
+    from ..common.geo import normalize_shape
+
+    spec = {k: v for k, v in spec.items() if k not in ("_cache", "_name")}
+    (fname, body), = spec.items()
+    shape_spec = body.get("shape")
+    if shape_spec is None:
+        raise QueryParsingError("geo_shape requires [shape]")
+    try:
+        shape = normalize_shape(shape_spec)
+    except ValueError as e:
+        raise QueryParsingError(str(e))
+    relation = str(body.get("relation", "intersects")).lower()
+    if relation not in ("intersects", "within", "disjoint"):
+        raise QueryParsingError(f"unknown geo_shape relation [{relation}]")
+    return GeoShapeFilter(fname, shape, relation)
+
+
+def _parse_geohash_cell_f(spec) -> Filter:
+    """ref: GeohashCellFilter.java:1 — {field: pin, precision, neighbors}."""
+    from ..common.geo import geohash_encode
+
+    spec = {k: v for k, v in spec.items() if k not in ("_cache", "_name")}
+    neighbors = bool(spec.pop("neighbors", False))
+    precision = spec.pop("precision", None)
+    (fname, pin), = spec.items()
+    if isinstance(pin, dict):
+        h = geohash_encode(float(pin["lat"]), float(pin["lon"]),
+                           int(precision or 12))
+    elif isinstance(pin, str) and "," in pin:
+        lat, lon = (float(x) for x in pin.split(","))
+        h = geohash_encode(lat, lon, int(precision or 12))
+    elif isinstance(pin, str):
+        h = pin.strip().lower()
+        if precision is not None:
+            h = h[: int(precision)]
+    else:  # [lon, lat]
+        h = geohash_encode(float(pin[1]), float(pin[0]), int(precision or 12))
+    if not h:
+        raise QueryParsingError("geohash_cell requires a non-empty cell")
+    return GeohashCellFilter(fname, h, neighbors)
+
+
 _FILTER_PARSERS = {
     "term": lambda s: (lambda f, o: TermFilter(f, o.get("value")))(
         *_field_spec({k: v for k, v in s.items() if not k.startswith("_")}, "value")),
@@ -735,6 +842,8 @@ _FILTER_PARSERS = {
                                      else parse_filter(s.get("filter"))),
     "geo_distance": _parse_geo_distance_f,
     "geo_bounding_box": _parse_geo_bbox_f,
+    "geo_shape": _parse_geo_shape_f,
+    "geohash_cell": _parse_geohash_cell_f,
     "script": lambda s: ScriptFilter(s.get("script", ""), s.get("params", {})),
     "limit": lambda s: MatchAllFilter(),  # limit filter is best-effort in the reference too
 }

@@ -1,0 +1,276 @@
+"""Span query family + geo_shape/geohash_cell — the query-DSL long tail.
+
+ref: SpanOrQueryParser.java:1, SpanFirstQueryParser.java:1, SpanNotQueryParser.java:1,
+SpanMultiTermQueryParser.java:1, FieldMaskingSpanQueryParser.java:1,
+GeoShapeQueryParser.java:1, GeohashCellFilter.java:1."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.geo import (
+    geohash_bbox,
+    geohash_decode,
+    geohash_encode,
+    geohash_neighbors,
+    normalize_shape,
+    shape_within,
+    shapes_intersect,
+)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.mapper.core import MapperService
+from elasticsearch_tpu.search import ShardContext, parse_query, search_shard
+from elasticsearch_tpu.search.queries import parse_filter
+from elasticsearch_tpu.search.similarity import SimilarityService
+
+
+@pytest.fixture(scope="module")
+def ctx(tmp_path_factory):
+    path = tmp_path_factory.mktemp("spans_geo")
+    settings = Settings.from_flat({})
+    svc = MapperService(settings)
+    svc.put_mapping("doc", {"properties": {
+        "body": {"type": "string"},
+        "spot": {"type": "geo_point"},
+        "area": {"type": "geo_shape"},
+    }})
+    eng = Engine(str(path), svc)
+    docs = [
+        # 0: quick brown fox jumps
+        {"body": "quick brown fox jumps over the lazy dog"},
+        # 1: fox ... quick (reverse order, far apart)
+        {"body": "fox stole the extremely well hidden quick cheese"},
+        # 2: quick quack (prefix family)
+        {"body": "quick quack quartz"},
+        # 3: brown at position 0
+        {"body": "brown bear brown bread"},
+        {"body": "lazy days of summer", "spot": {"lat": 52.37, "lon": 4.89},
+         "area": {"type": "envelope", "coordinates": [[4.0, 53.0], [5.0, 52.0]]}},
+        {"body": "dog house", "spot": "52.52,13.40",
+         "area": {"type": "polygon", "coordinates":
+                  [[[13.0, 52.0], [14.0, 52.0], [14.0, 53.0], [13.0, 53.0],
+                    [13.0, 52.0]]]}},
+        {"body": "far away", "spot": [-122.42, 37.77],  # GeoJSON [lon, lat]
+         "area": {"type": "point", "coordinates": [-122.42, 37.77]}},
+    ]
+    for i, d in enumerate(docs):
+        eng.index("doc", str(i), d)
+    eng.refresh()
+    c = ShardContext(eng.acquire_searcher(), svc,
+                     SimilarityService(settings, mapper_service=svc))
+    yield c
+    eng.close()
+
+
+def ids(td):
+    return sorted(d for _, d in td.hits)
+
+
+class TestSpanQueries:
+    def test_span_or(self, ctx):
+        td = search_shard(ctx, parse_query({"span_or": {"clauses": [
+            {"span_term": {"body": "fox"}},
+            {"span_term": {"body": "bear"}}]}}), 10, use_device=False)
+        assert ids(td) == [0, 1, 3]
+
+    def test_span_first(self, ctx):
+        # "brown" within the first 1 position → only doc 3 (position 0)
+        td = search_shard(ctx, parse_query({"span_first": {
+            "match": {"span_term": {"body": "brown"}}, "end": 1}}), 10,
+            use_device=False)
+        assert ids(td) == [3]
+        td2 = search_shard(ctx, parse_query({"span_first": {
+            "match": {"span_term": {"body": "brown"}}, "end": 2}}), 10,
+            use_device=False)
+        assert ids(td2) == [0, 3]  # doc 0 has brown at position 1
+
+    def test_span_not(self, ctx):
+        # quick not followed-within-a-span-of brown: doc 0 has "quick brown";
+        # span_not(include=quick, exclude=near(quick, brown, slop 0)) drops doc 0
+        td = search_shard(ctx, parse_query({"span_not": {
+            "include": {"span_term": {"body": "quick"}},
+            "exclude": {"span_near": {"clauses": [
+                {"span_term": {"body": "quick"}},
+                {"span_term": {"body": "brown"}}], "slop": 0,
+                "in_order": True}}}}), 10, use_device=False)
+        assert ids(td) == [1, 2]
+
+    def test_span_near_ordered_slop(self, ctx):
+        q = {"span_near": {"clauses": [
+            {"span_term": {"body": "quick"}},
+            {"span_term": {"body": "fox"}}], "slop": 1, "in_order": True}}
+        td = search_shard(ctx, parse_query(q), 10, use_device=False)
+        assert ids(td) == [0]  # quick [brown] fox = 1 gap; doc 1 is out of order
+
+    def test_span_near_unordered(self, ctx):
+        q = {"span_near": {"clauses": [
+            {"span_term": {"body": "quick"}},
+            {"span_term": {"body": "fox"}}], "slop": 10, "in_order": False}}
+        td = search_shard(ctx, parse_query(q), 10, use_device=False)
+        assert ids(td) == [0, 1]
+
+    def test_span_multi(self, ctx):
+        td = search_shard(ctx, parse_query({"span_multi": {
+            "match": {"prefix": {"body": {"value": "qua"}}}}}), 10,
+            use_device=False)
+        assert ids(td) == [2]
+        # composed inside span_near: quick + qua* adjacent
+        td2 = search_shard(ctx, parse_query({"span_near": {"clauses": [
+            {"span_term": {"body": "quick"}},
+            {"span_multi": {"match": {"prefix": {"body": {"value": "qua"}}}}}],
+            "slop": 0, "in_order": True}}), 10, use_device=False)
+        assert ids(td2) == [2]
+
+    def test_field_masking_span(self, ctx):
+        # masked field reports "body", so it can compose with body spans
+        td = search_shard(ctx, parse_query({"span_near": {"clauses": [
+            {"span_term": {"body": "quick"}},
+            {"field_masking_span": {
+                "query": {"span_term": {"body": "brown"}}, "field": "body"}}],
+            "slop": 0, "in_order": True}}), 10, use_device=False)
+        assert ids(td) == [0]
+
+    def test_span_scores_positive_and_ranked(self, ctx):
+        td = search_shard(ctx, parse_query({"span_or": {"clauses": [
+            {"span_term": {"body": "brown"}}]}}), 10, use_device=False)
+        scores = [s for s, _ in td.hits]
+        assert all(s > 0 for s in scores)
+        assert scores == sorted(scores, reverse=True)
+        # doc 3 has two "brown" occurrences → higher freq → higher score
+        assert td.hits[0][1] == 3
+
+
+class TestGeohash:
+    def test_roundtrip(self):
+        h = geohash_encode(52.37, 4.89, 7)
+        lat, lon = geohash_decode(h)
+        assert abs(lat - 52.37) < 0.01 and abs(lon - 4.89) < 0.01
+
+    def test_known_value(self):
+        # canonical example: u09tvw0 ≈ Paris; check a stable well-known cell
+        assert geohash_encode(57.64911, 10.40744, 11) == "u4pruydqqvj"
+
+    def test_bbox_contains_center(self):
+        h = geohash_encode(37.77, -122.42, 6)
+        lat_lo, lat_hi, lon_lo, lon_hi = geohash_bbox(h)
+        assert lat_lo <= 37.77 <= lat_hi and lon_lo <= -122.42 <= lon_hi
+
+    def test_neighbors(self):
+        n = geohash_neighbors("u4pruy")
+        assert len(n) == 8 and all(len(x) == 6 for x in n) and "u4pruy" not in n
+
+
+class TestGeoFilters:
+    def test_geohash_cell(self, ctx):
+        cell = geohash_encode(52.37, 4.89, 5)
+        td = search_shard(ctx, parse_query({"filtered": {
+            "query": {"match_all": {}},
+            "filter": {"geohash_cell": {"spot": {"lat": 52.37, "lon": 4.89},
+                                        "precision": 5}}}}), 10, use_device=False)
+        assert ids(td) == [4]
+        # berlin pin at coarse precision w/ neighbors still only finds berlin doc
+        td2 = search_shard(ctx, parse_query({"filtered": {
+            "query": {"match_all": {}},
+            "filter": {"geohash_cell": {"spot": "u33", "neighbors": True}}}}),
+            10, use_device=False)
+        assert ids(td2) == [5]
+        assert parse_filter({"geohash_cell": {"spot": cell}}).geohash == cell
+
+    def test_geo_shape_envelope_query(self, ctx):
+        td = search_shard(ctx, parse_query({"geo_shape": {"area": {
+            "shape": {"type": "envelope",
+                      "coordinates": [[4.5, 52.5], [4.9, 52.1]]}}}}), 10,
+            use_device=False)
+        assert ids(td) == [4]
+
+    def test_geo_shape_polygon_vs_point(self, ctx):
+        td = search_shard(ctx, parse_query({"geo_shape": {"area": {
+            "shape": {"type": "polygon", "coordinates":
+                      [[[-123.0, 37.0], [-122.0, 37.0], [-122.0, 38.0],
+                        [-123.0, 38.0], [-123.0, 37.0]]]}}}}), 10,
+            use_device=False)
+        assert ids(td) == [6]
+
+    def test_geo_shape_within_and_disjoint(self, ctx):
+        big = {"type": "envelope", "coordinates": [[3.0, 54.0], [6.0, 51.0]]}
+        td = search_shard(ctx, parse_query({"filtered": {
+            "query": {"match_all": {}},
+            "filter": {"geo_shape": {"area": {"shape": big,
+                                              "relation": "within"}}}}}), 10,
+            use_device=False)
+        assert ids(td) == [4]
+        td2 = search_shard(ctx, parse_query({"filtered": {
+            "query": {"match_all": {}},
+            "filter": {"geo_shape": {"area": {"shape": big,
+                                              "relation": "disjoint"}}}}}), 10,
+            use_device=False)
+        assert ids(td2) == [5, 6]
+
+    def test_geo_point_accepts_geohash_string(self, ctx):
+        # doc 5's spot was given as "lat,lon"; verify geohash input parses too by
+        # querying through a cell computed from an encoded hash
+        h = geohash_encode(37.77, -122.42, 4)
+        td = search_shard(ctx, parse_query({"filtered": {
+            "query": {"match_all": {}},
+            "filter": {"geohash_cell": {"spot": h}}}}), 10, use_device=False)
+        assert ids(td) == [6]
+
+
+class TestReviewRegressions:
+    def test_multi_valued_geo_points(self, tmp_path):
+        from elasticsearch_tpu.common.errors import MapperParsingError
+        from elasticsearch_tpu.common.settings import Settings as _S
+
+        svc = MapperService(_S.from_flat({}))
+        svc.put_mapping("doc", {"properties": {"spot": {"type": "geo_point"}}})
+        dm = svc.mappers["doc"]
+        d = dm.parse({"spot": [{"lat": 1.0, "lon": 2.0}, {"lat": 3.0, "lon": 4.0}]},
+                     "1")
+        assert d.doc_values_num["spot.lat"] == [1.0, 3.0]
+        assert d.doc_values_num["spot.lon"] == [2.0, 4.0]
+        # GeoJSON bare pair stays a single point
+        d2 = dm.parse({"spot": [4.89, 52.37]}, "2")
+        assert d2.doc_values_num["spot.lat"] == [52.37]
+        with pytest.raises(MapperParsingError):
+            dm.parse({"spot": ""}, "3")  # empty geohash must not become (0, 0)
+
+    def test_within_respects_holes(self):
+        donut = normalize_shape({"type": "polygon", "coordinates": [
+            [[0, 0], [10, 0], [10, 10], [0, 10], [0, 0]],
+            [[4, 4], [6, 4], [6, 6], [4, 6], [4, 4]]]})
+        covers_hole = normalize_shape({"type": "envelope",
+                                       "coordinates": [[3, 7], [7, 3]]})
+        clear = normalize_shape({"type": "envelope", "coordinates": [[1, 3], [3, 1]]})
+        assert not shape_within(covers_hole, donut)
+        assert shape_within(clear, donut)
+
+    def test_malformed_binary_body_gets_400(self, ctx):
+        # server-level behavior is covered in test_xcontent; here assert the codec
+        # raises (the http handler converts it to 400, not a dropped connection)
+        from elasticsearch_tpu.common.xcontent import cbor_loads, smile_loads
+        with pytest.raises(Exception):
+            cbor_loads(b"\xa5\x01")
+        with pytest.raises(Exception):
+            smile_loads(b"garbage")
+
+
+class TestShapeGeometry:
+    def test_polygon_hole(self):
+        donut = normalize_shape({"type": "polygon", "coordinates": [
+            [[0, 0], [10, 0], [10, 10], [0, 10], [0, 0]],
+            [[4, 4], [6, 4], [6, 6], [4, 6], [4, 4]],
+        ]})
+        inside_hole = normalize_shape({"type": "point", "coordinates": [5, 5]})
+        in_ring = normalize_shape({"type": "point", "coordinates": [2, 2]})
+        assert not shapes_intersect(donut, inside_hole)
+        assert shapes_intersect(donut, in_ring)
+
+    def test_edge_crossing_polygons(self):
+        a = normalize_shape({"type": "polygon", "coordinates":
+                             [[[0, 0], [4, 0], [4, 4], [0, 4], [0, 0]]]})
+        b = normalize_shape({"type": "polygon", "coordinates":
+                             [[[2, -1], [3, -1], [3, 5], [2, 5], [2, -1]]]})
+        assert shapes_intersect(a, b)
+        assert not shape_within(b, a)
+        assert shape_within(
+            normalize_shape({"type": "envelope", "coordinates": [[1, 3], [3, 1]]}), a)
