@@ -53,6 +53,11 @@ def _ensure_backend():
     import subprocess
 
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # the env var alone doesn't stick once the axon plugin registered itself at
+        # interpreter startup (sitecustomize) — force the live config too
+        from elasticsearch_tpu.common.jaxenv import force_cpu_platform
+
+        force_cpu_platform()
         return "cpu"
     timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", 180))
     probe = "import jax; print(jax.devices()[0].platform)"

@@ -5,10 +5,12 @@ SURVEY.md): sharded + replicated full-text indices, JSON query DSL with Lucene-e
 BM25/TF-IDF scoring, aggregations, two-phase scatter/gather search, NRT indexing with a
 write-ahead log, master-elected cluster state, peer recovery, snapshot/restore, REST API.
 
-TPU-first architecture: postings live as packed device tensors, the query-phase scoring
-loop is batched JAX/Pallas compute with `lax.top_k`, and cross-shard reduces (global
-top-k, distributed IDF stats) are mesh collectives instead of coordinator loops. The host
-side (cluster state, routing, durability, REST) is pure Python + C-extension hot paths.
+TPU-first architecture: postings live as packed device tensors with pack-time-baked tf
+norms, the query-phase scoring loop is a fused candidate-centric XLA program (gather →
+weight → sort-by-doc → segment-sum → `lax.top_k`), and cross-shard reduces (global
+top-k, distributed IDF stats) are `shard_map` mesh collectives that serve co-located
+multi-shard searches directly. The host side (cluster state, routing, durability, REST)
+is pure Python + C-extension hot paths.
 """
 
 from .version import CURRENT as VERSION  # noqa: F401

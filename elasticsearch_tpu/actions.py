@@ -27,6 +27,7 @@ import uuid
 from concurrent.futures import Future
 
 from .common.errors import (
+    ActionNotFoundError,
     DocumentMissingError,
     IndexAlreadyExistsError,
     IndexMissingError,
@@ -178,6 +179,27 @@ class ActionModule:
         t.register_handler(A_FETCH_PHASE, self._s_fetch_phase, executor="search")
         t.register_handler(A_DFS_PHASE, self._s_dfs_phase, executor="search")
         t.register_handler(A_SHARD_BROADCAST, self._s_broadcast, executor="management")
+        # sniffing TransportClient surface (ref: TransportClientNodesService — the
+        # sampler asks for the node list; every API call arrives as a typed proxy)
+        from .client import A_CLIENT_EXEC, A_CLIENT_NODES
+
+        t.register_handler(A_CLIENT_NODES, self._s_client_nodes, executor="management")
+        t.register_handler(A_CLIENT_EXEC, self._s_client_exec, executor="generic")
+
+    # ================= transport-client proxy =================
+    def _s_client_nodes(self, request, channel):
+        state = self.cluster_service.state
+        return {"nodes": [[n.id, n.name, n.transport_address]
+                          for n in state.nodes.nodes]}
+
+    def _s_client_exec(self, request, channel):
+        from .client import CLIENT_PROXY_METHODS
+
+        method = str(request.get("method"))
+        if method not in CLIENT_PROXY_METHODS:
+            raise ActionNotFoundError(f"client method [{method}] is not proxied")
+        fn = getattr(self.node.client(), method)
+        return {"r": fn(**(request.get("kwargs") or {}))}
 
     # ================= master-node pattern =================
     def _master_wrap(self, action, fn):

@@ -1,0 +1,176 @@
+"""Sniffing TransportClient: a cluster client that is NOT a cluster node.
+
+The reference's TransportClient connects to seed addresses, periodically SAMPLES the
+cluster (listed-nodes mode verifies the configured hosts; sniff mode asks any
+reachable node for the full current node list), round-robins requests over the live
+set, and fails over when a node stops answering
+(ref: client/transport/TransportClientNodesService.java:58 — the scheduled
+NodeSampler — and :100, the retry-over-nodes listener).
+
+This one speaks the same framed TCP transport as inter-node traffic
+(transport/tcp.py) and proxies a whitelisted method surface to the receiving node's
+client facade, which coordinates the request exactly as if it had arrived over REST
+(ref: each TransportAction's node-proxy, client/transport/support/
+InternalTransportClient.java)."""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import threading
+
+from .common.errors import (
+    NoNodeAvailableError,
+    NodeNotConnectedError,
+    ReceiveTimeoutError,
+    TransportError,
+)
+from .common.logging import get_logger
+
+A_CLIENT_NODES = "cluster:monitor/client/nodes"
+A_CLIENT_EXEC = "cluster:client/exec"
+
+# reads are safe to replay on another node after a TIMEOUT; writes are not — a
+# timed-out write may already be applied, so replaying it double-applies (the
+# reference's retry listener also only advances on connect-level failures)
+IDEMPOTENT_METHODS = frozenset({
+    "search", "msearch", "count", "suggest", "get", "mget", "termvector",
+    "mtermvectors", "percolate", "mpercolate", "exists", "analyze", "explain",
+    "get_mapping", "get_settings", "cluster_health", "cluster_state",
+    "cluster_stats", "nodes_info", "nodes_stats", "index_stats", "status",
+    "get_snapshots",
+})
+
+# the proxied API surface — one entry per transport-action proxy the reference's
+# TransportClient registers (client/transport/support/InternalTransportClient.java)
+CLIENT_PROXY_METHODS = frozenset({
+    "search", "msearch", "count", "suggest",
+    "index", "get", "mget", "delete", "update", "bulk", "delete_by_query",
+    "termvector", "mtermvectors", "percolate", "mpercolate",
+    "create_index", "delete_index", "open_index", "close_index", "refresh",
+    "flush", "optimize", "put_mapping", "get_mapping", "delete_mapping",
+    "put_template", "delete_template", "update_settings", "get_settings",
+    "aliases", "exists", "analyze", "explain",
+    "cluster_health", "cluster_state", "cluster_stats", "nodes_info",
+    "nodes_stats", "index_stats", "status",
+    "put_repository", "create_snapshot", "get_snapshots", "restore_snapshot",
+    "delete_snapshot",
+})
+
+
+class TransportClient:
+    """Round-robin, self-healing client over the TCP transport.
+
+    seeds: ["host:port", ...] — at least one must answer for the first sample.
+    sniff=True  → discover every data node from cluster state (the reference's
+                  client.transport.sniff); the live set follows cluster membership.
+    sniff=False → listed-nodes mode: only ever talk to the seed addresses.
+    """
+
+    def __init__(self, seeds: list[str], sniff: bool = True,
+                 sniff_interval: float = 5.0, timeout: float = 30.0):
+        from .transport.service import TransportService
+        from .transport.tcp import TcpTransport
+
+        if not seeds:
+            raise ValueError("TransportClient requires at least one seed address")
+        self._svc = TransportService(TcpTransport())
+        self._seeds = list(seeds)
+        self._sniff = sniff
+        self._interval = float(sniff_interval)
+        self._timeout = float(timeout)
+        self._logger = get_logger("client.transport")
+        self._lock = threading.Lock()
+        self._nodes: list[str] = []  # live addresses, round-robin order
+        self._rr = itertools.count()
+        self._closed = threading.Event()
+        self.sample()
+        self._thread = threading.Thread(target=self._sample_loop, daemon=True,
+                                        name="estpu-client-sampler")
+        self._thread.start()
+
+    # -- sampling ----------------------------------------------------------
+    def _sample_loop(self):
+        while not self._closed.wait(self._interval):
+            try:
+                self.sample()
+            except Exception as e:  # noqa: BLE001 — sampler must never die
+                self._logger.warn(f"node sample failed: {e}")
+
+    def sample(self) -> bool:
+        """One sampling round. Sniff mode: first reachable node (current, then
+        seeds) supplies the authoritative node list. Listed mode: probe each seed.
+        Returns True if any node answered."""
+        with self._lock:
+            current = list(self._nodes)
+        if self._sniff:
+            for address in current + [s for s in self._seeds if s not in current]:
+                nodes = self._ask_nodes(address)
+                if nodes is not None:
+                    with self._lock:
+                        self._nodes = nodes
+                    return True
+            with self._lock:
+                self._nodes = []
+            return False
+        live = [s for s in self._seeds if self._ask_nodes(s) is not None]
+        with self._lock:
+            self._nodes = live
+        return bool(live)
+
+    def _ask_nodes(self, address: str) -> list[str] | None:
+        try:
+            r = self._svc.submit_request(address, A_CLIENT_NODES, {}, timeout=5.0)
+            return [a for (_i, _n, a) in r["nodes"]]
+        except (NodeNotConnectedError, TransportError):
+            return None
+
+    def connected_nodes(self) -> list[str]:
+        with self._lock:
+            return list(self._nodes)
+
+    # -- execution ----------------------------------------------------------
+    def _execute(self, method: str, *args, **kwargs):
+        if args:
+            raise TypeError(
+                f"TransportClient.{method} takes keyword arguments only "
+                "(they cross the wire by name)")
+        last_err: Exception | None = None
+        with self._lock:
+            nodes = list(self._nodes) or list(self._seeds)
+        start = next(self._rr)
+        for i in range(len(nodes)):
+            address = nodes[(start + i) % len(nodes)]
+            try:
+                r = self._svc.submit_request(
+                    address, A_CLIENT_EXEC, {"method": method, "kwargs": kwargs},
+                    timeout=self._timeout)
+                return r["r"]
+            except NodeNotConnectedError as e:
+                # connection-level failure → drop the node and try the next copy;
+                # application errors (index missing, conflicts…) propagate as-is
+                last_err = e
+                with self._lock:
+                    if address in self._nodes:
+                        self._nodes.remove(address)
+            except ReceiveTimeoutError as e:
+                # the node may still be APPLYING the request — only idempotent
+                # reads are safe to replay elsewhere; a timed-out write must
+                # surface to the caller, not silently double-apply
+                if method not in IDEMPOTENT_METHODS:
+                    raise
+                last_err = e
+                with self._lock:
+                    if address in self._nodes:
+                        self._nodes.remove(address)
+        raise NoNodeAvailableError(
+            f"none of {nodes} answered [{method}]: {last_err}")
+
+    def __getattr__(self, name: str):
+        if name in CLIENT_PROXY_METHODS:
+            return functools.partial(self._execute, name)
+        raise AttributeError(name)
+
+    def close(self):
+        self._closed.set()
+        self._svc.close()

@@ -139,6 +139,13 @@ class MasterNotDiscoveredError(SearchEngineError):
     status = 503
 
 
+class NoNodeAvailableError(SearchEngineError):
+    """Every connected node refused or timed out (ref: the TransportClient's
+    NoNodeAvailableException, client/transport/TransportClientNodesService.java)."""
+
+    status = 503
+
+
 class ClusterBlockError(SearchEngineError):
     """Operation rejected by a cluster-level block (ref: cluster/block/ClusterBlockException.java).
 

@@ -13,6 +13,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qsl, urlparse
 
+from ..common import xcontent
 from ..common.logging import get_logger
 from ..rest.controller import RestController, RestRequest
 
@@ -30,26 +31,60 @@ class HttpServer:
             def _handle(self, method: str):
                 parsed = urlparse(self.path)
                 length = int(self.headers.get("Content-Length") or 0)
-                raw = self.rfile.read(length).decode() if length else ""
-                body: object = raw
+                raw_bytes = self.rfile.read(length) if length else b""
                 ctype = self.headers.get("Content-Type", "")
-                if raw and "json" in ctype:
-                    try:
-                        body = json.loads(raw)
-                    except ValueError:
+                # content negotiation (ref: XContentFactory.xContent — Content-Type
+                # first, then byte sniffing): SMILE/CBOR/YAML bodies decode to
+                # objects here; JSON keeps the string fallback so ndjson (_bulk,
+                # _msearch) and lenient-JSON bodies reach their handlers raw
+                fmt = xcontent.from_content_type(ctype)
+                if fmt is None and raw_bytes:
+                    sniffed = xcontent.detect(raw_bytes)
+                    if sniffed in (xcontent.SMILE, xcontent.CBOR):
+                        fmt = sniffed
+                body: object = ""
+                if raw_bytes:
+                    if fmt in (xcontent.SMILE, xcontent.CBOR, xcontent.YAML):
+                        try:
+                            body = xcontent.loads(raw_bytes, fmt)
+                        except Exception as e:  # noqa: BLE001 — malformed body → 400
+                            payload = json.dumps({"error": {
+                                "type": "parse_exception",
+                                "reason": f"failed to parse {fmt} body: {e}"},
+                                "status": 400}).encode()
+                            self.send_response(400)
+                            self.send_header("Content-Type", "application/json")
+                            self.send_header("Content-Length", str(len(payload)))
+                            self.end_headers()
+                            self.wfile.write(payload)
+                            return
+                    else:
+                        raw = raw_bytes.decode()
                         body = raw
-                elif raw and raw.lstrip().startswith(("{", "[")) and "\n" not in raw.strip():
-                    try:
-                        body = json.loads(raw)
-                    except ValueError:
-                        body = raw
+                        single_line = "\n" not in raw.strip()
+                        if "json" in ctype or (
+                                raw.lstrip().startswith(("{", "[")) and single_line):
+                            try:
+                                body = json.loads(raw)
+                            except ValueError:
+                                body = raw
                 request = RestRequest(
                     method=method, path=parsed.path,
                     params=dict(parse_qsl(parsed.query)), body=body)
                 response = rest.dispatch(request)
-                payload = response.payload()
+                # response rides the request's format, or an explicit ?format=
+                out_fmt = xcontent.from_content_type(
+                    "application/" + request.params.get("format", "")) or fmt
+                if (out_fmt and out_fmt != xcontent.JSON
+                        and response.content_type == "application/json"
+                        and isinstance(response.body, (dict, list))):
+                    payload = xcontent.dumps(response.body, out_fmt)
+                    content_type = xcontent.CONTENT_TYPES[out_fmt]
+                else:
+                    payload = response.payload()
+                    content_type = response.content_type
                 self.send_response(response.status)
-                self.send_header("Content-Type", response.content_type)
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 if method != "HEAD":

@@ -581,14 +581,17 @@ class Client:
         return {"_shards": {"total": 0, "successful": 0, "failed": 0}}
 
     # --- cluster admin ------------------------------------------------------
-    def cluster_health(self, index=None, wait_for_status=None, timeout=10.0):
+    def cluster_health(self, index=None, wait_for_status=None, wait_for_nodes=None,
+                       timeout=10.0):
         deadline = time.monotonic() + timeout
         while True:
             h = self._health(index)
-            if wait_for_status is None or _status_at_least(h["status"], wait_for_status) \
-                    or time.monotonic() > deadline:
-                h["timed_out"] = wait_for_status is not None and not _status_at_least(
-                    h["status"], wait_for_status)
+            status_ok = wait_for_status is None or _status_at_least(
+                h["status"], wait_for_status)
+            nodes_ok = wait_for_nodes is None or \
+                h["number_of_nodes"] >= int(wait_for_nodes)
+            if (status_ok and nodes_ok) or time.monotonic() > deadline:
+                h["timed_out"] = not (status_ok and nodes_ok)
                 return h
             time.sleep(0.05)
 

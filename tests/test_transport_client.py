@@ -1,0 +1,97 @@
+"""Sniffing TransportClient: discovery via sampling, round-robin, and node-death
+failover — ref: client/transport/TransportClientNodesService.java:58 (NodeSampler)
+and :100 (retry listener)."""
+
+import time
+
+import pytest
+
+from elasticsearch_tpu.client import TransportClient
+from elasticsearch_tpu.common.errors import NoNodeAvailableError
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    n1 = Node(name="tc1", settings={"transport.type": "tcp"},
+              data_path=str(tmp_path / "n1"))
+    n1.start([])
+    n1.wait_for_master()
+    seed = n1.local_node.transport_address
+    n2 = Node(name="tc2", settings={
+        "transport.type": "tcp",
+        "discovery.zen.ping.unicast.hosts": [seed]}, data_path=str(tmp_path / "n2"))
+    n2.start()
+    n1.client().cluster_health(wait_for_nodes=2)
+    yield n1, n2, seed
+    for n in (n1, n2):
+        try:
+            n.close()
+        except Exception:  # noqa: BLE001 — test may have closed one already
+            pass
+
+
+class TestTransportClient:
+    def test_sniff_discovers_all_nodes(self, cluster):
+        n1, n2, seed = cluster
+        client = TransportClient([seed], sniff_interval=0.2)
+        try:
+            assert len(client.connected_nodes()) == 2  # seeded with 1, sniffed 2
+        finally:
+            client.close()
+
+    def test_api_roundtrip_through_proxy(self, cluster):
+        n1, n2, seed = cluster
+        client = TransportClient([seed], sniff_interval=0.2)
+        try:
+            client.create_index(index="books", body={"settings": {
+                "number_of_shards": 2, "number_of_replicas": 1}})
+            client.cluster_health(wait_for_status="green")
+            client.index(index="books", doc_type="doc",
+                         body={"title": "snow crash"}, id="1")
+            client.refresh(index="books")
+            r = client.search(index="books",
+                              body={"query": {"match": {"title": "snow"}}})
+            assert r["hits"]["total"] == 1
+            assert r["hits"]["hits"][0]["_id"] == "1"
+            g = client.get(index="books", doc_type="doc", id="1")
+            assert g["_source"]["title"] == "snow crash"
+        finally:
+            client.close()
+
+    def test_unproxied_method_rejected(self, cluster):
+        n1, n2, seed = cluster
+        client = TransportClient([seed], sniff=False, sniff_interval=5)
+        try:
+            with pytest.raises(AttributeError):
+                client.start_http()
+        finally:
+            client.close()
+
+    def test_failover_when_node_dies(self, cluster):
+        n1, n2, seed = cluster
+        client = TransportClient([seed], sniff_interval=0.2)
+        try:
+            client.create_index(index="ha", body={"settings": {
+                "number_of_shards": 1, "number_of_replicas": 1}})
+            client.cluster_health(wait_for_status="green")
+            client.index(index="ha", doc_type="doc", body={"x": 1}, id="1")
+            client.refresh(index="ha")
+            # kill the seed node — requests must re-route to the survivor
+            n1.close()
+            deadline = time.time() + 20
+            got = None
+            while time.time() < deadline:
+                try:
+                    got = client.count(index="ha")
+                    break
+                except NoNodeAvailableError:
+                    time.sleep(0.3)
+            assert got is not None and got["count"] == 1
+            # the sampler eventually trims the dead node from the live set
+            deadline = time.time() + 10
+            while time.time() < deadline and len(client.connected_nodes()) != 1:
+                time.sleep(0.2)
+            assert len(client.connected_nodes()) == 1
+        finally:
+            client.close()

@@ -1,0 +1,178 @@
+"""XContent formats: CBOR/SMILE/YAML round-trips, RFC 7049 test vectors, format
+auto-detection, and HTTP content negotiation end to end (ref: common/xcontent/)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from elasticsearch_tpu.common import xcontent
+from elasticsearch_tpu.common.xcontent import (
+    CBOR,
+    JSON,
+    SMILE,
+    YAML,
+    cbor_dumps,
+    cbor_loads,
+    detect,
+    smile_dumps,
+    smile_loads,
+)
+
+DOCS = [
+    None, True, False, 0, 1, -1, 15, -16, 16, -17, 23, 24, 255, 256, 65535, 65536,
+    2 ** 31 - 1, -(2 ** 31), 2 ** 40, -(2 ** 40), 1.5, -0.25, 3.141592653589793,
+    "", "a", "hello", "x" * 32, "x" * 33, "x" * 64, "x" * 65, "x" * 500,
+    "héllo wörld", "ünï" * 20, "日本語テキスト" * 30,
+    [], [1, 2, 3], {"a": 1}, {},
+    {"settings": {"number_of_shards": 3}, "mappings": {"doc": {"properties": {
+        "title": {"type": "string"}, "n": {"type": "long"}}}}},
+    {"query": {"bool": {"must": [{"match": {"t": "x"}}], "boost": 1.5}},
+     "size": 10, "ids": [1, 2, 3], "flag": True, "nothing": None},
+    {"long_key_" + "k" * 80: ["v", {"日本": [1.25, None, False]}]},
+]
+
+
+class TestRoundTrips:
+    @pytest.mark.parametrize("fmt", [CBOR, SMILE, YAML, JSON])
+    def test_roundtrip(self, fmt):
+        for doc in DOCS:
+            raw = xcontent.dumps(doc, fmt)
+            back = xcontent.loads(raw, fmt)
+            assert back == doc, (fmt, doc, back)
+
+    def test_bytes_cbor_only(self):
+        assert cbor_loads(cbor_dumps(b"\x00\x01\xff")) == b"\x00\x01\xff"
+
+
+class TestCborVectors:
+    """Appendix A of RFC 7049 — encodings are normative for the definite-length
+    canonical forms this encoder emits."""
+
+    VECTORS = [
+        (0, "00"), (1, "01"), (10, "0a"), (23, "17"), (24, "1818"), (25, "1819"),
+        (100, "1864"), (1000, "1903e8"), (1000000, "1a000f4240"),
+        (-1, "20"), (-10, "29"), (-100, "3863"), (-1000, "3903e7"),
+        (1.1, "fb3ff199999999999a"), (False, "f4"), (True, "f5"), (None, "f6"),
+        ("", "60"), ("a", "6161"), ("IETF", "6449455446"), ("ü", "62c3bc"),
+        ([], "80"), ([1, 2, 3], "83010203"),
+        ({}, "a0"), ({"a": 1, "b": [2, 3]}, "a26161016162820203"),
+    ]
+
+    def test_encode_matches_rfc(self):
+        for obj, hexa in self.VECTORS:
+            assert cbor_dumps(obj).hex() == hexa, obj
+
+    def test_decode_matches_rfc(self):
+        for obj, hexa in self.VECTORS:
+            assert cbor_loads(bytes.fromhex(hexa)) == obj
+
+    def test_decode_foreign_forms(self):
+        # indefinite-length array + string chunks + half floats (decode-only)
+        assert cbor_loads(bytes.fromhex("9f018202039f0405ffff")) == [1, [2, 3], [4, 5]]
+        assert cbor_loads(bytes.fromhex("7f657374726561646d696e67ff")) == "streaming"
+        assert cbor_loads(bytes.fromhex("f90000")) == 0.0
+        assert cbor_loads(bytes.fromhex("f93c00")) == 1.0
+        # self-describe tag is transparent
+        assert cbor_loads(bytes.fromhex("d9d9f783010203")) == [1, 2, 3]
+
+
+class TestSmile:
+    def test_header(self):
+        raw = smile_dumps({"a": 1})
+        assert raw[:3] == b":)\n" and raw[3] == 0x00
+
+    def test_small_ints_one_byte(self):
+        # zigzag range -16..15 fits the 0xC0 token band
+        for n in (-16, -1, 0, 1, 15):
+            assert len(smile_dumps(n)) == 5  # 4 header + 1 token
+
+    def test_detection(self):
+        assert detect(smile_dumps({"a": 1})) == SMILE
+        assert detect(cbor_dumps({"a": 1})) == CBOR
+        assert detect(b'{"a": 1}') == JSON
+        assert detect(b"---\na: 1\n") == YAML
+        assert xcontent.from_content_type("application/smile") == SMILE
+        assert xcontent.from_content_type("application/x-jackson-smile") == SMILE
+        assert xcontent.from_content_type("application/cbor") == CBOR
+        assert xcontent.from_content_type("text/yaml") == YAML
+        assert xcontent.from_content_type("application/json; charset=UTF-8") == JSON
+
+
+@pytest.fixture(scope="module")
+def http_base(tmp_path_factory):
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.transport.local import LocalTransportRegistry
+
+    node = Node(name="xc_node", registry=LocalTransportRegistry(),
+                data_path=str(tmp_path_factory.mktemp("xc")))
+    node.start([node.local_node.transport_address])
+    node.wait_for_master()
+    server = node.start_http(port=0)
+    yield f"http://127.0.0.1:{server.port}"
+    node.close()
+
+
+def _call(base, method, path, data=None, ctype=None, accept_fmt=None):
+    url = base + path + (f"?format={accept_fmt}" if accept_fmt else "")
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": ctype} if ctype else {})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, r.headers.get("Content-Type"), r.read()
+    except urllib.error.HTTPError as e:  # noqa: F821 — urllib.error via urllib.request
+        return e.code, e.headers.get("Content-Type"), e.read()
+
+
+class TestHttpNegotiation:
+    def test_cbor_request_cbor_response(self, http_base):
+        body = cbor_dumps({"settings": {"number_of_shards": 1,
+                                        "number_of_replicas": 0}})
+        s, ct, raw = _call(http_base, "PUT", "/cb", body, "application/cbor")
+        assert s == 200 and ct == "application/cbor"
+        assert cbor_loads(raw)["acknowledged"] is True
+
+    def test_smile_document_roundtrip(self, http_base):
+        doc = smile_dumps({"title": "binary json", "n": 7})
+        s, ct, raw = _call(http_base, "PUT", "/cb/doc/1", doc, "application/smile")
+        assert s in (200, 201) and ct == "application/smile"
+        assert smile_loads(raw)["_id"] == "1"
+        _call(http_base, "POST", "/cb/_refresh")
+        q = smile_dumps({"query": {"match": {"title": "binary"}}})
+        s, ct, raw = _call(http_base, "POST", "/cb/_search", q, "application/smile")
+        assert s == 200
+        r = smile_loads(raw)
+        assert r["hits"]["total"] == 1
+        assert r["hits"]["hits"][0]["_source"]["n"] == 7
+
+    def test_yaml_body_and_format_param(self, http_base):
+        import yaml
+
+        y = b"query:\n  match_all: {}\n"
+        s, ct, raw = _call(http_base, "POST", "/cb/_search", y,
+                           "application/yaml")
+        assert s == 200 and ct == "application/yaml"
+        assert yaml.safe_load(raw)["hits"]["total"] == 1
+        # JSON body, yaml response via ?format=
+        s, ct, raw = _call(http_base, "POST", "/cb/_search",
+                           json.dumps({"query": {"match_all": {}}}).encode(),
+                           "application/json", accept_fmt="yaml")
+        assert ct == "application/yaml"
+        assert yaml.safe_load(raw)["hits"]["total"] == 1
+
+    def test_json_still_default(self, http_base):
+        s, ct, raw = _call(http_base, "GET", "/cb/doc/1")
+        assert s == 200 and ct == "application/json"
+        assert json.loads(raw)["found"] is True
+
+    def test_malformed_binary_body_is_400_not_dropped_connection(self, http_base):
+        s, ct, raw = _call(http_base, "POST", "/cb/_search", b"\xa5\x01\x02",
+                           "application/cbor")
+        assert s == 400
+        assert json.loads(raw)["error"]["type"] == "parse_exception"
+
+    def test_sniffed_binary_without_content_type(self, http_base):
+        body = cbor_dumps({"query": {"match_all": {}}})
+        s, ct, raw = _call(http_base, "POST", "/cb/_search", body)
+        assert s == 200
+        assert cbor_loads(raw)["hits"]["total"] == 1

@@ -1,0 +1,197 @@
+"""Product-path benchmarks: BASELINE.md configs #1 and #2 through the REAL stack.
+
+Unlike bench.py (which packs the device layout directly to time the serving kernel),
+this indexes documents through MapperService analysis + Engine segment building, then
+serves queries through execute_flat_batch — the exact path a REST _search takes on one
+shard. Numbers land in BASELINE.md's measurement table.
+
+  config #1: single-shard `match`, default TF-IDF, top-10, 100k-doc synthetic-enwiki
+  config #2: BM25 via index similarity settings, 1k batched 4-term bool, top-100
+
+CPU reference = the framework's vectorized numpy host scorer (search_shard
+use_device=False), a stronger baseline than Lucene's per-doc scoring loops.
+Correctness gate: device and host must produce identical hit ordering per query.
+
+Run: python tools/bench_product.py          (TPU; falls back to CPU like bench.py)
+     BENCH_PRODUCT_DOCS=20000 python tools/bench_product.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_DOCS = int(os.environ.get("BENCH_PRODUCT_DOCS", 100_000))
+VOCAB = 50_000
+AVG_LEN = 60
+CACHE = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     ".bench_cache")
+
+
+def _words(n):
+    """Pronounceable pseudo-words so the analysis chain does real tokenization."""
+    cons = "bcdfghjklmnprstvwz"
+    vow = "aeiou"
+    out = []
+    i = 0
+    while len(out) < n:
+        w = ""
+        x = i
+        for _ in range(3):
+            w += cons[x % len(cons)] + vow[(x // len(cons)) % len(vow)]
+            x //= len(cons) * len(vow)
+        out.append(w + str(i % 10))
+        i += 1
+    return out
+
+
+def build_index(path, similarity):
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.index.engine import Engine
+    from elasticsearch_tpu.mapper.core import MapperService
+
+    settings = Settings.from_flat({"index.similarity.default.type": similarity})
+    svc = MapperService(settings)
+    eng = Engine(path, svc)
+    meta_path = os.path.join(path, "bench_meta.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        if meta == {"docs": N_DOCS, "vocab": VOCAB, "sim": similarity}:
+            eng.recover_from_store()
+            eng.refresh()
+            return eng, svc, None
+        shutil.rmtree(path)
+        os.makedirs(path)
+        eng = Engine(path, svc)
+
+    rng = np.random.default_rng(1234)
+    vocab = _words(VOCAB)
+    lengths = np.clip(rng.poisson(AVG_LEN, N_DOCS), 5, 400)
+    raw = rng.zipf(1.35, int(lengths.sum())).astype(np.int64) - 1
+    term_of_tok = raw % VOCAB
+    t0 = time.time()
+    pos = 0
+    for i in range(N_DOCS):
+        n = int(lengths[i])
+        body = " ".join(vocab[t] for t in term_of_tok[pos: pos + n])
+        pos += n
+        eng.index("doc", str(i), {"body": body})
+        if (i + 1) % 20_000 == 0:
+            eng.refresh()
+            print(f"# indexed {i+1}/{N_DOCS} ({(i+1)/(time.time()-t0):.0f} docs/s)",
+                  file=sys.stderr)
+    eng.refresh()
+    eng.flush()
+    with open(meta_path, "w") as f:
+        json.dump({"docs": N_DOCS, "vocab": VOCAB, "sim": similarity}, f)
+    ix_rate = N_DOCS / (time.time() - t0)
+    return eng, svc, ix_rate
+
+
+def pick_terms(ctx, rng, n_queries, terms_per_query):
+    """Mid-frequency terms, like bench.py's pool (skip stopword-like heads)."""
+    seg_terms: dict[str, int] = {}
+    for seg in ctx.searcher.segments:
+        for t in seg.term_dict.get("body", ()):
+            seg_terms[t] = seg_terms.get(t, 0) + seg.doc_freq("body", t)
+    ranked = sorted(seg_terms, key=lambda t: -seg_terms[t])
+    pool = ranked[50:5000]
+    return [list(rng.choice(pool, size=terms_per_query, replace=False))
+            for _ in range(n_queries)]
+
+
+def run_config(name, eng, svc, settings_sim, queries, k, batch):
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.search import ShardContext, parse_query
+    from elasticsearch_tpu.search.execute import execute_flat_batch, lower_flat, search_shard
+    from elasticsearch_tpu.search.similarity import SimilarityService
+
+    settings = Settings.from_flat({"index.similarity.default.type": settings_sim})
+    ctx = ShardContext(eng.acquire_searcher(), svc,
+                       SimilarityService(settings, mapper_service=svc))
+    qdicts = [{"match": {"body": " ".join(terms)}} for terms in queries]
+    plans = [lower_flat(parse_query(qd), ctx) for qd in qdicts]
+    assert all(p is not None for p in plans), "bench queries must lower flat"
+
+    # correctness gate: identical ordering device vs host on a sample
+    for qd in qdicts[:8]:
+        dev = search_shard(ctx, parse_query(qd), k, use_device=True)
+        host = search_shard(ctx, parse_query(qd), k, use_device=False)
+        d_ids = [d for _, d in dev.hits]
+        h_ids = [d for _, d in host.hits]
+        if d_ids != h_ids or dev.total != host.total:
+            print(json.dumps({"metric": f"{name} ORDERING MISMATCH", "value": 0,
+                              "unit": "error", "vs_baseline": 0}))
+            sys.exit(1)
+
+    # device timing: batched through the serving planner (one warmup for compiles)
+    execute_flat_batch(plans[:batch], ctx, k)
+    t0 = time.perf_counter()
+    done = 0
+    while done < len(plans):
+        execute_flat_batch(plans[done: done + batch], ctx, k)
+        done += batch
+    device_qps = len(plans) / (time.perf_counter() - t0)
+
+    # host baseline on a subset
+    sub = min(64, len(plans))
+    t0 = time.perf_counter()
+    for qd in qdicts[:sub]:
+        search_shard(ctx, parse_query(qd), k, use_device=False)
+    cpu_qps = sub / (time.perf_counter() - t0)
+    return device_qps, cpu_qps
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench as kernel_bench
+
+    platform = kernel_bench._ensure_backend()
+    global N_DOCS
+    if platform.startswith("cpu"):
+        N_DOCS = min(N_DOCS, 20_000)
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", os.path.join(CACHE, "xla"))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as e:  # noqa: BLE001
+        print(f"# compilation cache unavailable: {e}", file=sys.stderr)
+
+    rng = np.random.default_rng(99)
+    results = []
+    for (cfg, sim, tpq, k, n_q, batch) in (
+        ("config#1 match top-10 TFIDF", "default", 2, 10, 512, 128),
+        ("config#2 bool top-100 BM25", "BM25", 4, 100, 1024, 1024),
+    ):
+        path = os.path.join(CACHE, f"product_idx_{sim}_{N_DOCS}")
+        os.makedirs(path, exist_ok=True)
+        eng, svc, ix_rate = build_index(path, sim)
+        if ix_rate:
+            print(f"# indexed at {ix_rate:.0f} docs/s through Engine+analysis",
+                  file=sys.stderr)
+        queries = pick_terms(
+            __import__("elasticsearch_tpu.search", fromlist=["ShardContext"])
+            .ShardContext(eng.acquire_searcher(), svc), rng, n_q, tpq)
+        dev, cpu = run_config(cfg, eng, svc, sim, queries, k, batch)
+        line = {"metric": f"{cfg} product-path qps ({N_DOCS} docs, {platform})",
+                "value": round(dev, 1), "unit": "queries/sec",
+                "vs_baseline": round(dev / cpu, 2)}
+        results.append(line)
+        print(json.dumps(line))
+        print(f"# {cfg}: device {dev:.0f} qps  host {cpu:.0f} qps", file=sys.stderr)
+        eng.close()
+    return results
+
+
+if __name__ == "__main__":
+    main()
