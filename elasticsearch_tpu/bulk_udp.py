@@ -1,0 +1,172 @@
+"""Bulk-over-UDP: fire-and-forget ndjson ingestion on a datagram socket.
+
+ref: bulk/udp/BulkUdpService.java — disabled by default (bulk.udp.enabled), binds
+the first free port in bulk.udp.port (default 9700-9800), feeds datagram payloads
+into a BulkProcessor that flushes by action count, byte size, or interval. UDP means
+no response and no backpressure; the reference positions it for metrics-style
+loss-tolerant feeds, and so does this."""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from .common.logging import get_logger
+
+
+class BulkProcessor:
+    """Accumulate bulk ndjson lines; flush on count/size/interval
+    (ref: action/bulk/BulkProcessor.java builder knobs used by BulkUdpService)."""
+
+    def __init__(self, client, bulk_actions: int = 1000,
+                 bulk_size_bytes: int = 5 * 1024 * 1024, flush_interval: float = 5.0,
+                 logger=None):
+        self.client = client
+        self.bulk_actions = bulk_actions
+        self.bulk_size_bytes = bulk_size_bytes
+        self.flush_interval = flush_interval
+        self.logger = logger or get_logger("bulk.udp")
+        self._lines: list[str] = []
+        self._bytes = 0
+        self._actions = 0
+        self._lock = threading.Lock()
+        self._last_flush = time.monotonic()
+
+    def add(self, payload: str):
+        flush = False
+        with self._lock:
+            for ln in payload.split("\n"):
+                if not ln.strip():
+                    continue
+                self._lines.append(ln)
+                self._bytes += len(ln)
+                # action lines (odd positions are sources for index ops, but a
+                # conservative per-line count only flushes EARLIER — harmless)
+                self._actions += 1
+            if (self._actions >= self.bulk_actions
+                    or self._bytes >= self.bulk_size_bytes):
+                flush = True
+        if flush:
+            self.flush()
+
+    def maybe_flush_by_time(self):
+        if time.monotonic() - self._last_flush >= self.flush_interval:
+            self.flush()
+
+    def flush(self):
+        with self._lock:
+            lines, self._lines = self._lines, []
+            self._bytes = 0
+            self._actions = 0
+            self._last_flush = time.monotonic()
+        if not lines:
+            return
+        try:
+            import json
+
+            ops = [json.loads(ln) for ln in lines]
+            self.client.bulk_lines(ops)
+        except Exception as e:  # noqa: BLE001 — UDP feed is loss-tolerant by contract
+            self.logger.warning(f"bulk-udp flush of {len(lines)} lines failed: {e}")
+
+
+class BulkUdpService:
+    """ref: bulk/udp/BulkUdpService.java — lifecycle + datagram loop."""
+
+    def __init__(self, node, settings):
+        self.node = node
+        self.enabled = bool(settings.get_bool("bulk.udp.enabled", False))
+        self.host = settings.get("bulk.udp.host", "127.0.0.1")
+        self.port_range = str(settings.get("bulk.udp.port", "9700-9800"))
+        self.recv_buffer = int(settings.get("bulk.udp.receive_buffer_size",
+                                            10 * 1024 * 1024))
+        self.logger = get_logger("bulk.udp", node=node.name)
+        self.processor = BulkProcessor(
+            _BulkClientAdapter(node),
+            bulk_actions=int(settings.get("bulk.udp.bulk_actions", 1000)),
+            bulk_size_bytes=int(settings.get("bulk.udp.bulk_size", 5 * 1024 * 1024)),
+            flush_interval=float(settings.get("bulk.udp.flush_interval", 5.0)),
+            logger=self.logger)
+        self._sock: socket.socket | None = None
+        self._thread: threading.Thread | None = None
+        self._closed = threading.Event()
+        self.port: int | None = None
+
+    def start(self):
+        if not self.enabled:
+            return self
+        lo, _, hi = self.port_range.partition("-")
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, self.recv_buffer)
+        except OSError:
+            pass
+        for port in range(int(lo), int(hi or lo) + 1):
+            try:
+                sock.bind((self.host, port))
+                self.port = port
+                break
+            except OSError:
+                continue
+        if self.port is None:
+            self.logger.warning(f"bulk-udp: no free port in [{self.port_range}]")
+            sock.close()
+            return self
+        sock.settimeout(0.5)
+        self._sock = sock
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"estpu[bulk-udp:{self.port}]")
+        self._thread.start()
+        self.logger.info("bulk-udp listening on %s:%d", self.host, self.port)
+        return self
+
+    def _loop(self):
+        while not self._closed.is_set():
+            try:
+                data, _addr = self._sock.recvfrom(65536)
+            except socket.timeout:
+                self.processor.maybe_flush_by_time()
+                continue
+            except OSError:
+                break
+            try:
+                self.processor.add(data.decode())
+            except Exception as e:  # noqa: BLE001
+                self.logger.warning(f"bulk-udp datagram dropped: {e}")
+            self.processor.maybe_flush_by_time()
+
+    def stop(self):
+        self._closed.set()
+        if self._sock is not None:
+            self._sock.close()
+        self.processor.flush()
+
+
+_BULK_OPS = ("index", "create", "update", "delete")
+
+
+class _BulkClientAdapter:
+    """Pairs parsed ndjson lines into the action API's op entries
+    ({action: {op: meta}, source}) and submits one bulk."""
+
+    def __init__(self, node):
+        self.node = node
+
+    def bulk_lines(self, lines: list[dict]):
+        operations = []
+        i = 0
+        while i < len(lines):
+            action = lines[i]
+            i += 1
+            if not isinstance(action, dict) or len(action) != 1 \
+                    or next(iter(action)) not in _BULK_OPS:
+                continue  # loss-tolerant feed: skip malformed action lines
+            (op, meta), = action.items()
+            entry = {"action": {op: dict(meta) if isinstance(meta, dict) else {}}}
+            if op != "delete":
+                entry["source"] = lines[i] if i < len(lines) else {}
+                i += 1
+            operations.append(entry)
+        if operations:
+            self.node.client().bulk(operations)

@@ -35,25 +35,25 @@ A_CLIENT_EXEC = "cluster:client/exec"
 # reference's retry listener also only advances on connect-level failures)
 IDEMPOTENT_METHODS = frozenset({
     "search", "msearch", "count", "suggest", "get", "mget", "termvector",
-    "mtermvectors", "percolate", "mpercolate", "exists", "analyze", "explain",
-    "get_mapping", "get_settings", "cluster_health", "cluster_state",
-    "cluster_stats", "nodes_info", "nodes_stats", "index_stats", "status",
-    "get_snapshots",
+    "mtermvectors", "mlt", "percolate", "count_percolate", "mpercolate",
+    "exists_index", "exists_type", "exists_alias", "explain",
+    "get_mapping", "get_field_mapping", "get_settings", "get_aliases",
+    "get_alias", "get_template", "get_warmer", "cluster_health",
+    "cluster_state", "cluster_get_settings", "pending_tasks", "nodes_info",
+    "nodes_stats", "stats", "indices_status", "get_snapshots", "get_repository",
+    "snapshot_status",
 })
 
 # the proxied API surface — one entry per transport-action proxy the reference's
-# TransportClient registers (client/transport/support/InternalTransportClient.java)
-CLIENT_PROXY_METHODS = frozenset({
-    "search", "msearch", "count", "suggest",
-    "index", "get", "mget", "delete", "update", "bulk", "delete_by_query",
-    "termvector", "mtermvectors", "percolate", "mpercolate",
+# TransportClient registers (client/transport/support/InternalTransportClient.java);
+# every name here is a real node.Client method (validated by a test)
+CLIENT_PROXY_METHODS = IDEMPOTENT_METHODS | frozenset({
+    "index", "create", "delete", "update", "bulk", "delete_by_query",
     "create_index", "delete_index", "open_index", "close_index", "refresh",
-    "flush", "optimize", "put_mapping", "get_mapping", "delete_mapping",
-    "put_template", "delete_template", "update_settings", "get_settings",
-    "aliases", "exists", "analyze", "explain",
-    "cluster_health", "cluster_state", "cluster_stats", "nodes_info",
-    "nodes_stats", "index_stats", "status",
-    "put_repository", "create_snapshot", "get_snapshots", "restore_snapshot",
+    "flush", "optimize", "clear_cache", "put_mapping", "delete_mapping",
+    "put_template", "delete_template", "update_settings", "update_aliases",
+    "put_warmer", "delete_warmer", "put_repository", "delete_repository",
+    "verify_repository", "create_snapshot", "restore_snapshot",
     "delete_snapshot",
 })
 
@@ -95,7 +95,7 @@ class TransportClient:
             try:
                 self.sample()
             except Exception as e:  # noqa: BLE001 — sampler must never die
-                self._logger.warn(f"node sample failed: {e}")
+                self._logger.warning(f"node sample failed: {e}")
 
     def sample(self) -> bool:
         """One sampling round. Sniff mode: first reachable node (current, then

@@ -57,8 +57,9 @@ def detect(raw: bytes) -> str:
     head = raw.lstrip()[:3]
     if head[:1] in (b"{", b"["):
         return JSON
-    if raw[:1] and (raw[0] >> 5) in (4, 5) and raw[0] not in (0x80 + 11,):
-        # leading array/map major type — binary CBOR bodies from clients
+    if raw[:1] and (raw[0] >> 5) in (4, 5):
+        # leading array/map major type — binary CBOR bodies from clients (no
+        # printable-ASCII collision: 0x80+ is never a JSON/YAML first byte)
         return CBOR
     if head.startswith(b"---"):
         return YAML
@@ -124,10 +125,16 @@ def _cbor_enc(obj, out: bytearray):
     elif obj is False:
         out.append(0xF4)
     elif isinstance(obj, int):
-        if obj >= 0:
+        if 0 <= obj < (1 << 64):
             out += _cbor_head(0, obj)
-        else:
+        elif -(1 << 64) <= obj < 0:
             out += _cbor_head(1, -1 - obj)
+        else:  # RFC 7049 bignum: tag 2 (positive) / 3 (negative) + byte string
+            n = obj if obj >= 0 else -1 - obj
+            b = n.to_bytes((n.bit_length() + 7) // 8 or 1, "big")
+            out += _cbor_head(6, 2 if obj >= 0 else 3)
+            out += _cbor_head(2, len(b))
+            out += b
     elif isinstance(obj, float):
         out.append(0xFB)
         out += struct.pack(">d", obj)
@@ -220,9 +227,14 @@ def _cbor_dec(raw: bytes, i: int):
             v, i = _cbor_dec(raw, i)
             d[k] = v
         return d, i
-    if major == 6:  # tag: skip and decode the tagged value
-        _tag, i = _cbor_arg(raw, i, info)
-        return _cbor_dec(raw, i)
+    if major == 6:
+        tag, i = _cbor_arg(raw, i, info)
+        v, i = _cbor_dec(raw, i)
+        if tag == 2 and isinstance(v, bytes):  # positive bignum
+            return int.from_bytes(v, "big"), i
+        if tag == 3 and isinstance(v, bytes):  # negative bignum
+            return -1 - int.from_bytes(v, "big"), i
+        return v, i  # other tags (incl. self-describe) are transparent
     # major 7
     if info == 20:
         return False, i
@@ -276,7 +288,9 @@ def _smile_read_vint(raw: bytes, i: int) -> tuple[int, int]:
 
 
 def _zigzag(n: int) -> int:
-    return (n << 1) ^ (n >> 63) if n < 0 else n << 1
+    # arbitrary precision (Python ints are unbounded; a fixed 64-bit shift would
+    # silently corrupt values beyond int64)
+    return ((-n - 1) << 1) | 1 if n < 0 else n << 1
 
 
 def _unzigzag(n: int) -> int:
@@ -319,6 +333,8 @@ def _smile_value(obj, out: bytearray):
             out.append(0x24)
             out += _smile_vint(z)
         else:
+            # int64 token; beyond-64-bit values keep the same vint encoding (our
+            # decoder reads it losslessly; spec BigInteger token not emitted)
             out.append(0x25)
             out += _smile_vint(z)
     elif isinstance(obj, float):

@@ -15,7 +15,7 @@ from urllib.parse import parse_qsl, urlparse
 
 from ..common import xcontent
 from ..common.logging import get_logger
-from ..rest.controller import RestController, RestRequest
+from ..rest.controller import RestController, RestRequest, RestResponse
 
 
 class HttpServer:
@@ -43,31 +43,34 @@ class HttpServer:
                     if sniffed in (xcontent.SMILE, xcontent.CBOR):
                         fmt = sniffed
                 body: object = ""
-                if raw_bytes:
-                    if fmt in (xcontent.SMILE, xcontent.CBOR, xcontent.YAML):
-                        try:
+                try:
+                    if raw_bytes:
+                        if fmt in (xcontent.SMILE, xcontent.CBOR, xcontent.YAML):
                             body = xcontent.loads(raw_bytes, fmt)
-                        except Exception as e:  # noqa: BLE001 — malformed body → 400
-                            payload = json.dumps({"error": {
-                                "type": "parse_exception",
-                                "reason": f"failed to parse {fmt} body: {e}"},
-                                "status": 400}).encode()
-                            self.send_response(400)
-                            self.send_header("Content-Type", "application/json")
-                            self.send_header("Content-Length", str(len(payload)))
-                            self.end_headers()
-                            self.wfile.write(payload)
-                            return
-                    else:
-                        raw = raw_bytes.decode()
-                        body = raw
-                        single_line = "\n" not in raw.strip()
-                        if "json" in ctype or (
-                                raw.lstrip().startswith(("{", "[")) and single_line):
-                            try:
-                                body = json.loads(raw)
-                            except ValueError:
-                                body = raw
+                        else:
+                            raw = raw_bytes.decode()
+                            body = raw
+                            single_line = "\n" not in raw.strip()
+                            if "json" in ctype or (
+                                    raw.lstrip().startswith(("{", "["))
+                                    and single_line):
+                                try:
+                                    body = json.loads(raw)
+                                except ValueError:
+                                    body = raw
+                except Exception as e:  # noqa: BLE001 — malformed body → 400,
+                    # never a dropped connection (incl. undecodable bytes that
+                    # the format sniffer didn't classify as binary)
+                    payload = json.dumps({"error": {
+                        "type": "parse_exception",
+                        "reason": f"failed to parse request body: {e}"},
+                        "status": 400}).encode()
+                    self.send_response(400)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
                 request = RestRequest(
                     method=method, path=parsed.path,
                     params=dict(parse_qsl(parsed.query)), body=body)
@@ -75,14 +78,21 @@ class HttpServer:
                 # response rides the request's format, or an explicit ?format=
                 out_fmt = xcontent.from_content_type(
                     "application/" + request.params.get("format", "")) or fmt
-                if (out_fmt and out_fmt != xcontent.JSON
-                        and response.content_type == "application/json"
-                        and isinstance(response.body, (dict, list))):
-                    payload = xcontent.dumps(response.body, out_fmt)
-                    content_type = xcontent.CONTENT_TYPES[out_fmt]
-                else:
+                try:
+                    if (out_fmt and out_fmt != xcontent.JSON
+                            and response.content_type == "application/json"
+                            and isinstance(response.body, (dict, list))):
+                        payload = xcontent.dumps(response.body, out_fmt)
+                        content_type = xcontent.CONTENT_TYPES[out_fmt]
+                    else:
+                        payload = response.payload()
+                        content_type = response.content_type
+                except Exception as e:  # noqa: BLE001 — unencodable response → 500
+                    response = RestResponse(500, {"error": {
+                        "type": "serialization_exception", "reason": str(e)},
+                        "status": 500})
                     payload = response.payload()
-                    content_type = response.content_type
+                    content_type = "application/json"
                 self.send_response(response.status)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(payload)))

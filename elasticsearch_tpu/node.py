@@ -39,7 +39,7 @@ from .transport.service import TransportService
 
 class Node:
     def __init__(self, name: str | None = None, settings=None, registry=None,
-                 data_path: str | None = None):
+                 data_path: str | None = None, tribe_registries=None):
         self.settings = prepare_settings(settings)
         self.name = name or self.settings.get_str("node.name") or f"node_{uuid.uuid4().hex[:6]}"
         self.node_id = self.settings.get_str("node.id") or self.name
@@ -120,6 +120,31 @@ class Node:
                                       self.cluster_service, self.allocation,
                                       self.settings)
         self.discovery.on_joined = None
+        # ResourceWatcherService: hot-reloadable config files; the script
+        # directory (config/scripts) is the flagship consumer
+        # (ref: watcher/ResourceWatcherService.java + ScriptService wiring)
+        from .script import ScriptService
+        from .watcher import FileWatcher, ResourceWatcherService, ScriptDirectoryListener
+
+        self.script_service = ScriptService(self.settings)
+        self.resource_watcher = ResourceWatcherService(self.settings, self.threadpool)
+        scripts_dir = self.settings.get("path.scripts") or (
+            os.path.join(self.data_path, "config", "scripts") if self.data_path else None)
+        if scripts_dir:
+            self.scripts_dir = scripts_dir
+            self.resource_watcher.add(FileWatcher(
+                scripts_dir, ScriptDirectoryListener(self.script_service)))
+        self.resource_watcher.start()
+        # Bulk-over-UDP ingestion (ref: bulk/udp/BulkUdpService.java; off by default)
+        from .bulk_udp import BulkUdpService
+
+        self.bulk_udp = BulkUdpService(self, self.settings)
+        # tribe node: inner member nodes + merged client view
+        # (ref: tribe/TribeService.java; enabled by tribe.<name>.* settings)
+        from .tribe import TribeService
+
+        self.tribe = TribeService(self)
+        self._tribe_registries = tribe_registries or {}
         self.http = None
         self._started = False
         self._closed = False
@@ -143,6 +168,9 @@ class Node:
         self.plugins.on_node_created(self)
         self.discovery.start(addresses)
         self.gateway.maybe_recover()
+        self.bulk_udp.start()
+        if self.tribe.enabled:
+            self.tribe.start(self._tribe_registries)
         self._started = True
         self.plugins.on_node_started(self)
         if self.settings.get_bool("http.enabled", False):
@@ -164,6 +192,9 @@ class Node:
             return
         self._closed = True
         self.plugins.on_node_closed(self)
+        self.tribe.stop()
+        self.bulk_udp.stop()
+        self.resource_watcher.stop()
         if self.http is not None:
             self.http.stop()
         self.discovery.leave()
@@ -226,6 +257,10 @@ class Node:
         return s.nodes.master_id == self.node_id
 
     def client(self) -> "Client":
+        if self.tribe.enabled:
+            from .tribe import TribeClient
+
+            return TribeClient(self.tribe)
         return Client(self)
 
     # test/ops helper

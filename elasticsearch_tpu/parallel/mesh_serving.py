@@ -91,7 +91,7 @@ class MeshServingService:
             results = self._search_mesh(index, shards, req, use_global_stats)
         except Exception as e:  # noqa: BLE001 — any mesh failure must not fail the search
             results = None
-            self.logger.warn(f"mesh path failed, falling back to transport: {e}")
+            self.logger.warning(f"mesh path failed, falling back to transport: {e}")
         if results is None:
             self.mesh_fallbacks += 1  # eligible-looking but fell back mid-flight
         return results
@@ -218,7 +218,7 @@ class MeshServingService:
                     # negative-cache the failure so every search doesn't re-pay a
                     # doomed multi-second repack under the lock
                     self._executors[index] = (freshness, svc, None)
-                    self.logger.warn(f"mesh index build failed for [{index}]: {e}")
+                    self.logger.warning(f"mesh index build failed for [{index}]: {e}")
                     return None
                 self._executors[index] = (freshness, svc, execs)
             return execs[use_global_stats]

@@ -12,7 +12,9 @@ Handlers call the node Client — REST is a thin adapter exactly as in the refer
 from __future__ import annotations
 
 import json
+import os
 import re
+import time
 from dataclasses import dataclass, field as dc_field
 from typing import Callable
 
@@ -828,6 +830,41 @@ def build_rest_controller(node) -> RestController:
     rc.register("GET", "/_nodes/{node_id}/stats/{metric}", lambda r: client.nodes_stats())
     rc.register("GET", "/_cluster/nodes/hot_threads", lambda r: _hot_threads())
     rc.register("GET", "/_nodes/hot_threads", lambda r: _hot_threads())
+
+    # device-side tracing (SURVEY §5.1 TPU mapping: the profiler role hot_threads
+    # plays for host threads, jax.profiler plays for the XLA programs — captures
+    # an XPlane trace of the query-phase kernels viewable in tensorboard/xprof)
+    profiler_state = {"dir": None}
+
+    def _profiler_start(req):
+        import jax
+
+        if profiler_state["dir"] is not None:
+            return RestResponse(400, {"error": "profiler already running",
+                                      "dir": profiler_state["dir"], "status": 400})
+        body = _parse_body(req)
+        trace_dir = body.get("dir") or os.path.join(
+            node.data_path or ".", "profiler",
+            time.strftime("%Y%m%d-%H%M%S"))
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+        profiler_state["dir"] = trace_dir
+        return {"started": True, "dir": trace_dir}
+
+    def _profiler_stop(req):
+        import jax
+
+        if profiler_state["dir"] is None:
+            return RestResponse(400, {"error": "profiler not running", "status": 400})
+        jax.profiler.stop_trace()
+        trace_dir, profiler_state["dir"] = profiler_state["dir"], None
+        files = []
+        for root_, _d, fs in os.walk(trace_dir):
+            files.extend(os.path.join(root_, f) for f in fs)
+        return {"stopped": True, "dir": trace_dir, "files": sorted(files)}
+
+    rc.register("POST", "/_nodes/_local/profiler/start", _profiler_start)
+    rc.register("POST", "/_nodes/_local/profiler/stop", _profiler_stop)
 
     def _hot_threads():
         """ref: monitor/jvm/HotThreads — stacks of the busiest threads."""

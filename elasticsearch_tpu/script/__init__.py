@@ -177,10 +177,25 @@ def compile_update_script(source: str, params: dict | None = None,
 
 _cache: dict[tuple, CompiledScript] = {}
 
+# named scripts (stored via API or loaded from config/scripts by the resource
+# watcher). The registry is process-wide because every compile site resolves names
+# through module-level compile_script; entries are OWNER-scoped (one sub-entry per
+# ScriptService) so one in-process node deleting its file never clobbers another
+# node's same-named script — resolution takes the newest owner's source.
+_named: dict[str, dict[int, str]] = {}
+
+
+def _resolve_named(name: str) -> str | None:
+    owners = _named.get(name)
+    if owners:
+        return next(reversed(owners.values()))
+    return None
+
 
 def compile_script(source: str, params: dict | None = None,
                    lang=None) -> CompiledScript:
     check_lang(lang)
+    source = _resolve_named(source) or source
     key = (source, tuple(sorted((params or {}).items())))
     try:
         cs = _cache.get(key)
@@ -195,14 +210,23 @@ def compile_script(source: str, params: dict | None = None,
 class ScriptService:
     """Named/stored script registry + language dispatch (parity shell: the single
     supported language is the sandboxed expression subset, like the reference's
-    default-language mvel registry)."""
+    default-language mvel registry). File scripts arrive via
+    watcher.ScriptDirectoryListener."""
 
     def __init__(self, settings=None):
-        self._stored: dict[str, str] = {}
+        self._sid = id(self)
 
     def put(self, name: str, source: str):
-        self._stored[name] = source
+        owners = _named.setdefault(name, {})
+        owners.pop(self._sid, None)  # re-put moves this owner to newest
+        owners[self._sid] = source
+
+    def remove(self, name: str):
+        owners = _named.get(name)
+        if owners is not None:
+            owners.pop(self._sid, None)
+            if not owners:
+                _named.pop(name, None)
 
     def compile(self, source_or_name: str, params: dict | None = None) -> CompiledScript:
-        source = self._stored.get(source_or_name, source_or_name)
-        return compile_script(source, params)
+        return compile_script(source_or_name, params)

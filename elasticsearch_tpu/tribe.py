@@ -1,0 +1,284 @@
+"""Tribe node: one node participating in multiple clusters, serving a merged view.
+
+ref: tribe/TribeService.java — the reference starts an inner CLIENT node per
+configured tribe (`tribe.<name>.*` settings become that node's settings, forced to
+node.client=true), listens to each inner cluster's state events, and merges nodes +
+metadata + routing into the local state with first-cluster-wins on index-name
+conflicts; optional tribe.blocks.write / tribe.blocks.metadata blocks.
+
+Here each tribe member is likewise an inner node (node.data=false,
+node.master=false — the allocator only places shards on data nodes, so inner nodes
+hold nothing) joined to its cluster through normal discovery. The serving plane
+differs by design: instead of splicing remote routing tables into the local state
+and fanning out at shard level (which presumes one flat transport across clusters),
+reads are COORDINATED BY the inner member node that owns the index — the same hop
+count, with cross-tribe searches merged at the client layer. Index-name conflicts
+resolve first-configured-wins, matching the reference's on-conflict default."""
+
+from __future__ import annotations
+
+from .common.errors import ClusterBlockError, IndexMissingError, SearchEngineError
+from .common.logging import get_logger
+
+TRIBE_WRITE_BLOCK_MSG = "tribe node, write not allowed"
+TRIBE_METADATA_BLOCK_MSG = "tribe node, metadata not allowed"
+
+_METADATA_METHODS = {
+    "create_index", "delete_index", "open_index", "close_index", "put_mapping",
+    "delete_mapping", "put_template", "delete_template", "update_settings",
+    "update_aliases", "put_warmer", "delete_warmer",
+}
+_WRITE_METHODS = {"index", "delete", "update", "bulk", "delete_by_query"}
+
+
+class TribeService:
+    """Owns the inner member nodes and the index→tribe resolution."""
+
+    def __init__(self, node):
+        self.node = node
+        self.logger = get_logger("tribe", node=node.name)
+        self.members: dict[str, object] = {}  # name -> inner Node (insertion order)
+        self._groups = self._parse_groups(node.settings)
+        self.enabled = bool(self._groups)
+        self.blocks_write = bool(node.settings.get_bool("tribe.blocks.write", False))
+        self.blocks_metadata = bool(
+            node.settings.get_bool("tribe.blocks.metadata", False))
+
+    @staticmethod
+    def _parse_groups(settings) -> dict[str, dict]:
+        groups: dict[str, dict] = {}
+        for key, value in settings.as_dict().items():
+            if not key.startswith("tribe.") or key in (
+                    "tribe.blocks.write", "tribe.blocks.metadata", "tribe.name"):
+                continue
+            _, name, *rest = key.split(".")
+            if rest:
+                groups.setdefault(name, {})[".".join(rest)] = value
+        return groups
+
+    def start(self, registries: dict[str, object] | None = None):
+        """registries: optional {tribe_name: LocalTransportRegistry} for in-process
+        clusters (tests); TCP tribes configure transport via their settings."""
+        from .node import Node
+
+        for name, cfg in self._groups.items():
+            inner_settings = dict(cfg)
+            inner_settings["node.data"] = False
+            inner_settings["node.master"] = False
+            inner_settings["tribe.name"] = name
+            inner = Node(
+                name=f"{self.node.name}/{name}",
+                settings=inner_settings,
+                registry=(registries or {}).get(name),
+                data_path=(f"{self.node.data_path}/tribe_{name}"
+                           if self.node.data_path else None),
+            )
+            inner.start()
+            self.members[name] = inner
+            self.logger.info("tribe [%s] joined cluster [%s]", name,
+                             inner.cluster_service.state.cluster_name)
+        return self
+
+    def stop(self):
+        for name, inner in self.members.items():
+            try:
+                inner.close()
+            except Exception as e:  # noqa: BLE001 — close the rest regardless
+                self.logger.warning(f"failed closing tribe member [{name}]: {e}")
+        self.members.clear()
+
+    # ------------------------------------------------------------- resolution
+    def owner_of(self, index: str):
+        """First-configured tribe whose cluster has the index (the reference's
+        on_conflict=any/drop default keeps the FIRST merged index)."""
+        for name, inner in self.members.items():
+            if inner.cluster_service.state.metadata.has_index(index):
+                return name, inner
+        return None
+
+    def resolve(self, index_expr) -> dict[str, list[str]]:
+        """index expression → {tribe: [concrete indices]}; wildcard/_all spans all
+        tribes, concrete names resolve first-wins."""
+        out: dict[str, list[str]] = {}
+        exprs = index_expr if isinstance(index_expr, list) else [index_expr]
+        wildcardish = any(e in (None, "", "_all") or "*" in str(e) for e in exprs)
+        if wildcardish:
+            for name, inner in self.members.items():
+                try:
+                    idxs = inner.cluster_service.state.metadata.resolve_indices(
+                        index_expr)
+                except SearchEngineError:
+                    continue
+                seen = {i for lst in out.values() for i in lst}
+                fresh = [i for i in idxs if i not in seen]
+                if fresh:
+                    out[name] = fresh
+            return out
+        for e in exprs:
+            owner = self.owner_of(str(e))
+            if owner is None:
+                raise IndexMissingError(f"[{e}] missing")
+            out.setdefault(owner[0], []).append(str(e))
+        return out
+
+
+class TribeClient:
+    """The tribe node's client facade: routes reads to owning members, merges
+    cross-tribe searches, enforces the optional write/metadata blocks."""
+
+    def __init__(self, tribe: TribeService):
+        self.tribe = tribe
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+
+        def call(*args, **kwargs):
+            return self._dispatch(method, args, kwargs)
+
+        return call
+
+    def _dispatch(self, method: str, args, kwargs):
+        t = self.tribe
+        if method in _WRITE_METHODS and t.blocks_write:
+            raise ClusterBlockError(TRIBE_WRITE_BLOCK_MSG)
+        if method in _METADATA_METHODS:
+            if t.blocks_metadata:
+                raise ClusterBlockError(TRIBE_METADATA_BLOCK_MSG)
+            raise ClusterBlockError(
+                "tribe node cannot perform master-level metadata operations "
+                "(ref: no master is elected on a tribe node)")
+        if method in ("search", "count"):
+            return self._fan_read(method, args, kwargs)
+        if method in ("cluster_health", "cluster_state", "nodes_info"):
+            return self._merged_admin(method, args, kwargs)
+        # single-index reads/writes route to the owning member
+        index = kwargs.get("index", args[0] if args else None)
+        if index is None:
+            raise SearchEngineError(f"tribe client cannot route [{method}] "
+                                    "without an index")
+        owner = t.owner_of(str(index))
+        if owner is None:
+            raise IndexMissingError(f"[{index}] missing")
+        return getattr(owner[1].client(), method)(*args, **kwargs)
+
+    def _merged_admin(self, method: str, args, kwargs):
+        t = self.tribe
+        if method == "cluster_health":
+            healths = [m.client().cluster_health(*args, **kwargs)
+                       for m in t.members.values()]
+            worst = "green"
+            for h in healths:
+                if h["status"] == "red":
+                    worst = "red"
+                elif h["status"] == "yellow" and worst == "green":
+                    worst = "yellow"
+            out = {"cluster_name": t.node.name, "status": worst,
+                   "timed_out": any(h.get("timed_out", False) for h in healths)}
+            for k in ("number_of_nodes", "number_of_data_nodes", "active_shards",
+                      "active_primary_shards", "relocating_shards",
+                      "initializing_shards", "unassigned_shards"):
+                out[k] = sum(h.get(k, 0) for h in healths)
+            return out
+        # cluster_state / nodes_info: per-tribe views keyed by tribe name
+        return {name: getattr(m.client(), method)(*args, **kwargs)
+                for name, m in t.members.items()}
+
+    # ------------------------------------------------------------------ reads
+    def _fan_read(self, method: str, args, kwargs):
+        t = self.tribe
+        index_expr = kwargs.pop("index", args[0] if args else "_all")
+        rest = args[1:] if args else ()
+        per_tribe = t.resolve(index_expr)
+        if not per_tribe:
+            if method == "count":
+                return {"count": 0, "_shards": {"total": 0, "successful": 0,
+                                                "failed": 0}}
+            return {"took": 0, "timed_out": False,
+                    "_shards": {"total": 0, "successful": 0, "failed": 0},
+                    "hits": {"total": 0, "max_score": None, "hits": []}}
+        if len(per_tribe) == 1:
+            (name, idxs), = per_tribe.items()
+            return getattr(t.members[name].client(), method)(idxs, *rest, **kwargs)
+        if method == "count":
+            results = [getattr(t.members[name].client(), "count")(idxs, *rest,
+                                                                  **kwargs)
+                       for name, idxs in per_tribe.items()]
+            return {"count": sum(r["count"] for r in results),
+                    "_shards": _sum_shards([r.get("_shards", {}) for r in results])}
+        # cross-tribe search: each member computes the full window (from+size from
+        # 0), the client-level reduce re-pages globally — the same widen-then-slice
+        # the coordinator merge does across shards
+        body = dict(rest[0]) if rest and isinstance(rest[0], dict) else \
+            dict(kwargs.get("body") or {})
+        from_ = int(body.get("from", 0))
+        size = int(body.get("size", 10))
+        body.update({"from": 0, "size": from_ + size})
+        rest2 = (body,) + tuple(rest[1:])
+        kwargs.pop("body", None)
+        results = [getattr(t.members[name].client(), method)(idxs, *rest2, **kwargs)
+                   for name, idxs in per_tribe.items()]
+        return _merge_search(results, from_, size, body.get("sort"))
+
+
+def _sum_shards(shards: list[dict]) -> dict:
+    return {k: sum(s.get(k, 0) for s in shards)
+            for k in ("total", "successful", "failed")}
+
+
+def _sort_directions(sort_spec) -> list[bool]:
+    """Per-column reverse flags from the body's sort clause."""
+    out = []
+    for s in (sort_spec if isinstance(sort_spec, list) else [sort_spec]):
+        if isinstance(s, str):
+            out.append(s == "_score")  # _score sorts descending by default
+        elif isinstance(s, dict):
+            (_f, opts), = s.items()
+            order = opts.get("order") if isinstance(opts, dict) else opts
+            out.append(str(order) == "desc")
+    return out
+
+
+class _SortKey:
+    """Comparable wrapper: respects per-column direction, Nones last."""
+
+    __slots__ = ("vals",)
+
+    def __init__(self, hit_sort, reverse):
+        self.vals = [(v is None, v, r) for v, r in zip(hit_sort, reverse)]
+
+    def __lt__(self, other):
+        for (none_a, a, rev), (none_b, b, _r) in zip(self.vals, other.vals):
+            if none_a or none_b:
+                if none_a != none_b:
+                    return none_b
+                continue
+            if a != b:
+                return (a > b) if rev else (a < b)
+        return False
+
+
+def _merge_search(responses: list[dict], from_: int, size: int,
+                  sort_spec=None) -> dict:
+    """Client-level reduce of per-tribe search responses — the tribe analogue of
+    the coordinator merge: explicit sort columns when the request sorted (each hit
+    carries its "sort" values), else score desc; stable across tribes; global
+    re-page."""
+    hits = [h for r in responses for h in r["hits"]["hits"]]
+    if sort_spec and all("sort" in h for h in hits):
+        reverse = _sort_directions(sort_spec)
+        hits.sort(key=lambda h: _SortKey(h["sort"], reverse))
+    else:
+        hits.sort(key=lambda h: -(h.get("_score") or 0.0))
+    max_scores = [r["hits"].get("max_score") for r in responses
+                  if r["hits"].get("max_score") is not None]
+    return {
+        "took": max(r.get("took", 0) for r in responses),
+        "timed_out": any(r.get("timed_out", False) for r in responses),
+        "_shards": _sum_shards([r.get("_shards", {}) for r in responses]),
+        "hits": {
+            "total": sum(r["hits"]["total"] for r in responses),
+            "max_score": max(max_scores) if max_scores else None,
+            "hits": hits[from_: from_ + size],
+        },
+    }

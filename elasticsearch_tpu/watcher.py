@@ -1,0 +1,147 @@
+"""Resource watcher: periodic file-change notification for hot-reloadable config.
+
+ref: watcher/ResourceWatcherService.java:42 (scheduled poll of registered watchers,
+watcher.enabled / watcher.interval settings) + watcher/FileWatcher.java (mtime-diff
+tree walk firing onFileCreated/Changed/Deleted). The flagship consumer is the script
+service: files in config/scripts become named scripts, reloaded live — exactly the
+reference's ScriptService(...ResourceWatcherService) wiring."""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from .common.logging import get_logger
+
+
+class FileChangesListener:
+    def on_file_created(self, path: str):  # pragma: no cover - interface default
+        pass
+
+    def on_file_changed(self, path: str):  # pragma: no cover
+        pass
+
+    def on_file_deleted(self, path: str):  # pragma: no cover
+        pass
+
+
+class FileWatcher:
+    """Watches one directory tree; diffing (mtime, size) snapshots per check."""
+
+    def __init__(self, path: str, listener: FileChangesListener):
+        self.path = path
+        self.listener = listener
+        self._state: dict[str, tuple[float, int]] = {}
+        self._primed = False
+
+    def _snapshot(self) -> dict[str, tuple[float, int]]:
+        snap: dict[str, tuple[float, int]] = {}
+        if not os.path.isdir(self.path):
+            return snap
+        for root, _dirs, files in os.walk(self.path):
+            for f in files:
+                p = os.path.join(root, f)
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                snap[p] = (st.st_mtime, st.st_size)
+        return snap
+
+    def init(self):
+        """First scan: existing files fire on_file_created (the reference's
+        FileWatcher.init does the same so startup and hot-add share one path)."""
+        self._state = {}
+        self._primed = True
+        self.check()
+
+    def check(self):
+        if not self._primed:
+            self.init()
+            return
+        snap = self._snapshot()
+        for p, sig in snap.items():
+            old = self._state.get(p)
+            if old is None:
+                self.listener.on_file_created(p)
+            elif old != sig:
+                self.listener.on_file_changed(p)
+        for p in self._state:
+            if p not in snap:
+                self.listener.on_file_deleted(p)
+        self._state = snap
+
+
+class ResourceWatcherService:
+    """Polls registered watchers on a fixed interval; disabled via
+    watcher.enabled=false (ref: ResourceWatcherService.java:42)."""
+
+    def __init__(self, settings, threadpool=None):
+        self.enabled = settings.get_bool("watcher.enabled", True)
+        self.interval = float(settings.get("watcher.interval", 60.0))
+        self.logger = get_logger("watcher")
+        self._watchers: list[FileWatcher] = []
+        self._lock = threading.Lock()
+        self._task = None
+        self._threadpool = threadpool
+
+    def add(self, watcher: FileWatcher) -> FileWatcher:
+        watcher.init()
+        with self._lock:
+            self._watchers.append(watcher)
+        return watcher
+
+    def remove(self, watcher: FileWatcher):
+        with self._lock:
+            if watcher in self._watchers:
+                self._watchers.remove(watcher)
+
+    def notify_now(self):
+        """Immediate check of every watcher (tests; REST-triggered reloads)."""
+        with self._lock:
+            watchers = list(self._watchers)
+        for w in watchers:
+            try:
+                w.check()
+            except Exception as e:  # noqa: BLE001 — one bad watcher can't stop the rest
+                self.logger.warning(f"resource watcher [{w.path}] failed: {e}")
+
+    def start(self):
+        if not self.enabled or self._threadpool is None:
+            return self
+        self._task = self._threadpool.schedule_with_fixed_delay(
+            self.interval, self.notify_now, name="generic")
+        return self
+
+    def stop(self):
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+
+class ScriptDirectoryListener(FileChangesListener):
+    """config/scripts/<name>.<ext> → named script <name> (ref: ScriptService's
+    ScriptChangesListener: file scripts compile on sight, reload on change)."""
+
+    def __init__(self, script_service, logger=None):
+        self.scripts = script_service
+        self.logger = logger or get_logger("watcher.scripts")
+
+    @staticmethod
+    def _name(path: str) -> str:
+        return os.path.splitext(os.path.basename(path))[0]
+
+    def on_file_created(self, path: str):
+        try:
+            with open(path) as fh:
+                self.scripts.put(self._name(path), fh.read().strip())
+            self.logger.info("loaded script [%s]", self._name(path))
+        except OSError as e:
+            self.logger.warning(f"failed loading script [{path}]: {e}")
+
+    def on_file_changed(self, path: str):
+        self.on_file_created(path)
+
+    def on_file_deleted(self, path: str):
+        self.scripts.remove(self._name(path))
+        self.logger.info("removed script [%s]", self._name(path))

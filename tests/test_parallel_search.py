@@ -32,7 +32,11 @@ def node(tmp_path):
 
 
 def _slow_query_phase(node, delay=DELAY):
-    """Re-register the query-phase handler with an injected per-shard delay."""
+    """Re-register the query-phase handler with an injected per-shard delay.
+    This test targets the TRANSPORT scatter-gather specifically — disable the mesh
+    serving path, which would otherwise bypass A_QUERY_PHASE entirely (and put its
+    first XLA compile inside the timed region)."""
+    node.actions.mesh_serving.enabled = False
     original = node.transport.handlers[A_QUERY_PHASE].fn
 
     def slow(request, channel):

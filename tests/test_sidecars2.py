@@ -1,0 +1,197 @@
+"""Round-4 sidecars: resource watcher + file scripts, bulk-UDP, jax profiler REST.
+
+ref: watcher/ResourceWatcherService.java:42, bulk/udp/BulkUdpService.java,
+SURVEY §5.1 (device-side tracing)."""
+
+import json
+import os
+import socket
+import time
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.transport.local import LocalTransportRegistry
+from elasticsearch_tpu.watcher import (
+    FileChangesListener,
+    FileWatcher,
+    ResourceWatcherService,
+    ScriptDirectoryListener,
+)
+
+
+class _Recorder(FileChangesListener):
+    def __init__(self):
+        self.events = []
+
+    def on_file_created(self, path):
+        self.events.append(("created", os.path.basename(path)))
+
+    def on_file_changed(self, path):
+        self.events.append(("changed", os.path.basename(path)))
+
+    def on_file_deleted(self, path):
+        self.events.append(("deleted", os.path.basename(path)))
+
+
+class TestFileWatcher:
+    def test_create_change_delete_cycle(self, tmp_path):
+        rec = _Recorder()
+        w = FileWatcher(str(tmp_path), rec)
+        w.init()
+        p = tmp_path / "a.txt"
+        p.write_text("one")
+        w.check()
+        assert ("created", "a.txt") in rec.events
+        time.sleep(0.01)
+        p.write_text("two longer")
+        w.check()
+        assert ("changed", "a.txt") in rec.events
+        p.unlink()
+        w.check()
+        assert ("deleted", "a.txt") in rec.events
+
+    def test_service_polls_registered_watchers(self, tmp_path):
+        from elasticsearch_tpu.common.settings import Settings
+
+        svc = ResourceWatcherService(Settings.from_flat({"watcher.interval": 600}))
+        rec = _Recorder()
+        svc.add(FileWatcher(str(tmp_path), rec))
+        (tmp_path / "x").write_text("x")
+        svc.notify_now()
+        assert ("created", "x") in rec.events
+
+
+class TestFileScripts:
+    def test_scripts_dir_hot_reload(self, tmp_path):
+        node = Node(name="ws1", registry=LocalTransportRegistry(),
+                    data_path=str(tmp_path))
+        try:
+            node.start([node.local_node.transport_address])
+            node.wait_for_master()
+            sdir = tmp_path / "config" / "scripts"
+            sdir.mkdir(parents=True)
+            (sdir / "double_it.expression").write_text("x * 2")
+            node.resource_watcher.notify_now()
+            cs = node.script_service.compile("double_it", {"x": 21})
+            assert cs({}) == 42  # named file script resolved + sandbox-compiled
+            # module-level compile sites (sort/functions/aggs) resolve names too
+            from elasticsearch_tpu.script import compile_script
+
+            assert compile_script("double_it", {"x": 4})({}) == 8
+            # hot change
+            (sdir / "double_it.expression").write_text("x * 3")
+            node.resource_watcher.notify_now()
+            assert node.script_service.compile("double_it", {"x": 10})({}) == 30
+        finally:
+            node.close()
+
+
+class TestScriptRegistryIsolation:
+    def test_one_services_delete_spares_anothers_script(self):
+        from elasticsearch_tpu.script import ScriptService, compile_script
+
+        s1, s2 = ScriptService(), ScriptService()
+        s1.put("shared_calc", "x + 1")
+        s2.put("shared_calc", "x + 1")
+        s1.remove("shared_calc")  # node A's file deleted
+        # node B's registration survives; module-level resolution still works
+        assert compile_script("shared_calc", {"x": 1})({}) == 2
+        s2.remove("shared_calc")
+        # now unresolvable → treated as inline source (and "shared_calc" isn't
+        # a valid expression → compile error)
+        import pytest as _pytest
+
+        from elasticsearch_tpu.script import ScriptError
+
+        with _pytest.raises(ScriptError):
+            compile_script("shared_calc!", {})
+
+
+class TestBulkUdp:
+    def test_datagrams_become_documents(self, tmp_path):
+        node = Node(name="bu1", registry=LocalTransportRegistry(),
+                    settings={"bulk.udp.enabled": True,
+                              "bulk.udp.port": "19700-19720",
+                              "bulk.udp.flush_interval": 0.2},
+                    data_path=str(tmp_path))
+        try:
+            node.start([node.local_node.transport_address])
+            node.wait_for_master()
+            c = node.client()
+            c.create_index("udp", {"settings": {"number_of_shards": 1,
+                                                "number_of_replicas": 0}})
+            c.cluster_health(wait_for_status="green")
+            assert node.bulk_udp.port is not None
+            payload = "\n".join([
+                json.dumps({"index": {"_index": "udp", "_type": "doc", "_id": "1"}}),
+                json.dumps({"n": 1}),
+                json.dumps({"index": {"_index": "udp", "_type": "doc", "_id": "2"}}),
+                json.dumps({"n": 2}),
+            ]) + "\n"
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.sendto(payload.encode(), ("127.0.0.1", node.bulk_udp.port))
+            s.close()
+            deadline = time.time() + 10
+            total = 0
+            while time.time() < deadline:
+                c.refresh("udp")
+                total = c.count("udp")["count"]
+                if total == 2:
+                    break
+                time.sleep(0.2)
+            assert total == 2
+        finally:
+            node.close()
+
+    def test_disabled_by_default(self, tmp_path):
+        node = Node(name="bu2", registry=LocalTransportRegistry(),
+                    data_path=str(tmp_path))
+        try:
+            node.start([node.local_node.transport_address])
+            assert node.bulk_udp.port is None
+        finally:
+            node.close()
+
+
+class TestProfilerRest:
+    def test_start_stop_capture(self, tmp_path):
+        import urllib.request
+
+        node = Node(name="pf1", registry=LocalTransportRegistry(),
+                    data_path=str(tmp_path))
+        try:
+            node.start([node.local_node.transport_address])
+            node.wait_for_master()
+            http = node.start_http(0)
+            base = f"http://127.0.0.1:{http.port}"
+
+            def post(path, body=None):
+                req = urllib.request.Request(
+                    base + path, data=json.dumps(body or {}).encode(),
+                    method="POST", headers={"Content-Type": "application/json"})
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        return r.status, json.loads(r.read().decode())
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read().decode())
+
+            s, r = post("/_nodes/_local/profiler/start")
+            assert s == 200 and r["started"]
+            # run some device work so the trace has content
+            c = node.client()
+            c.create_index("pf", {"settings": {"number_of_shards": 1,
+                                               "number_of_replicas": 0}})
+            c.cluster_health(wait_for_status="green")
+            c.index("pf", "doc", {"t": "trace me"}, id="1")
+            c.refresh("pf")
+            c.search("pf", {"query": {"match": {"t": "trace"}}})
+            s2, r2 = post("/_nodes/_local/profiler/stop")
+            assert s2 == 200 and r2["stopped"]
+            assert any(f.endswith(".pb") or "trace" in f.lower()
+                       for f in r2["files"]), r2["files"]
+            # double stop → 400
+            s3, _r3 = post("/_nodes/_local/profiler/stop")
+            assert s3 == 400
+        finally:
+            node.close()

@@ -31,6 +31,17 @@ def cluster(tmp_path):
             pass
 
 
+def test_whitelist_names_are_real_client_methods():
+    """Every proxied name must exist on node.Client — a phantom entry passes the
+    whitelist then AttributeErrors server-side on every call."""
+    from elasticsearch_tpu.client import CLIENT_PROXY_METHODS, IDEMPOTENT_METHODS
+    from elasticsearch_tpu.node import Client
+
+    missing = [m for m in CLIENT_PROXY_METHODS | IDEMPOTENT_METHODS
+               if not callable(getattr(Client, m, None))]
+    assert not missing, missing
+
+
 class TestTransportClient:
     def test_sniff_discovers_all_nodes(self, cluster):
         n1, n2, seed = cluster

@@ -44,6 +44,18 @@ class TestRoundTrips:
     def test_bytes_cbor_only(self):
         assert cbor_loads(cbor_dumps(b"\x00\x01\xff")) == b"\x00\x01\xff"
 
+    def test_huge_integers(self):
+        # beyond int64: CBOR uses RFC 7049 bignum tags; SMILE an extended vint
+        for n in (2 ** 64, -(2 ** 64), 2 ** 63, -(2 ** 63) - 1, 10 ** 30,
+                  -(10 ** 30)):
+            assert cbor_loads(cbor_dumps(n)) == n, n
+            assert smile_loads(smile_dumps(n)) == n, n
+        assert cbor_dumps(2 ** 64).hex().startswith("c249")  # tag 2 + 9-byte bstr
+
+    def test_detect_eleven_element_cbor_array(self):
+        # regression: 0x8b (array-of-11) was excluded from sniffing
+        assert detect(cbor_dumps([1] * 11)) == CBOR
+
 
 class TestCborVectors:
     """Appendix A of RFC 7049 — encodings are normative for the definite-length
