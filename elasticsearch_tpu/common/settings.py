@@ -290,6 +290,11 @@ _INDEX_NAME_RE = re.compile(r"^[^A-Z\\/*?\"<>| ,#]+$")
 
 
 def validate_index_name(name: str) -> None:
+    # "_river" is the one leading-underscore exemption, exactly like the reference
+    # (MetaDataCreateIndexService.validateIndexName:168 checks
+    # !index.equals(riverIndexName) before rejecting '_'-prefixed names)
+    if name == "_river":
+        return
     if not name or name.startswith(("_", "-", "+")) or not _INDEX_NAME_RE.match(name):
         from .errors import InvalidIndexNameError
 

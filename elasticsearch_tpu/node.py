@@ -139,6 +139,12 @@ class Node:
         from .bulk_udp import BulkUdpService
 
         self.bulk_udp = BulkUdpService(self, self.settings)
+        # rivers: _river-index-driven ingestion singletons
+        # (ref: river/RiversService.java; `dummy` in-tree, plugins add types)
+        from .rivers import RiversService
+
+        self.rivers = RiversService(
+            self, interval=self.settings.get_time("rivers.check_interval", 1.0))
         # tribe node: inner member nodes + merged client view
         # (ref: tribe/TribeService.java; enabled by tribe.<name>.* settings)
         from .tribe import TribeService
@@ -192,6 +198,7 @@ class Node:
             return
         self._closed = True
         self.plugins.on_node_closed(self)
+        self.rivers.stop()
         self.tribe.stop()
         self.bulk_udp.stop()
         self.resource_watcher.stop()

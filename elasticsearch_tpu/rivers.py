@@ -1,0 +1,150 @@
+"""Rivers: pull-based ingestion singletons driven by `_river` index meta docs.
+
+ref: river/RiversService.java — a river is declared by indexing
+`/_river/<name>/_meta` with `{"type": "<river type>"}`; the service notices, routes
+the river to ONE node (river/routing/RiversRouter.java), instantiates the type from
+the registry (plugins contribute types; `dummy` ships in-tree like the reference's
+river/dummy), calls start(), writes a `_status` doc, and closes the river when the
+meta doc disappears or the index is deleted. Deprecated in the reference lineage —
+implemented for parity; bulk/UDP/clients are the forward path.
+
+Divergence: rivers run on the MASTER node (a deterministic cluster singleton)
+instead of the reference's dedicated river cluster-state routing — same
+one-owner guarantee, one less moving part."""
+
+from __future__ import annotations
+
+import threading
+
+from .common.errors import SearchEngineError
+from .common.logging import get_logger
+
+RIVER_INDEX = "_river"
+
+
+class River:
+    """Base river (ref: river/River.java). Subclasses pull data in start()."""
+
+    def __init__(self, name: str, settings: dict, node):
+        self.name = name
+        self.settings = settings
+        self.node = node
+
+    def start(self):  # pragma: no cover - interface default
+        pass
+
+    def close(self):  # pragma: no cover
+        pass
+
+
+class DummyRiver(River):
+    """ref: river/dummy/DummyRiver.java — logs lifecycle, moves no data."""
+
+    def start(self):
+        get_logger("river.dummy", node=self.node.name).info(
+            "dummy river [%s] started", self.name)
+
+    def close(self):
+        get_logger("river.dummy", node=self.node.name).info(
+            "dummy river [%s] closed", self.name)
+
+
+class RiversService:
+    """Polls the `_river` index on the master and reconciles running rivers."""
+
+    def __init__(self, node, interval: float = 1.0):
+        self.node = node
+        self.logger = get_logger("rivers", node=node.name)
+        self.types: dict[str, type] = {"dummy": DummyRiver}
+        # plugins may contribute river types via a `river_types()` hook
+        for plugin in getattr(node.plugins, "plugins", []):
+            hook = getattr(plugin, "river_types", None)
+            if hook:
+                self.types.update(hook())
+        self.running: dict[str, River] = {}
+        self._lock = threading.Lock()
+        self._stopped = False
+        self._task = node.threadpool.schedule_with_fixed_delay(
+            interval, self.reconcile, name="management")
+
+    def reconcile(self):
+        """Declared rivers (meta docs) vs running rivers; master-only."""
+        if self._stopped:
+            return
+        state = self.node.cluster_service.state
+        if state.nodes.master_id != self.node.local_node.id:
+            self._close_all()  # lost mastership → rivers move with it
+            return
+        declared = self._declared(state)
+        if declared is None:
+            return  # transient _river search failure ≠ "no rivers" — don't tear down
+        with self._lock:
+            if self._stopped:
+                return
+            for name, meta in declared.items():
+                if name in self.running:
+                    continue
+                rtype = str(meta.get("type", ""))
+                cls = self.types.get(rtype)
+                if cls is None:
+                    self.logger.warning(
+                        f"river [{name}]: unknown type [{rtype}] "
+                        f"(registered: {sorted(self.types)})")
+                    continue
+                river = cls(name, meta, self.node)
+                try:
+                    river.start()
+                except Exception as e:  # noqa: BLE001 — a bad river can't stop others
+                    self.logger.warning(f"river [{name}] failed to start: {e}")
+                    continue
+                self.running[name] = river
+                self._write_status(name, "started")
+                self.logger.info("river [%s] of type [%s] started", name, rtype)
+            for name in [n for n in self.running if n not in declared]:
+                self._close(name)
+
+    def _declared(self, state) -> dict[str, dict] | None:
+        """None = couldn't determine (leave running rivers alone this tick)."""
+        if state.metadata.index(RIVER_INDEX) is None:
+            return {}
+        try:
+            client = self.node.client()
+            # only the _meta docs (each river also carries a _status doc; an
+            # unfiltered page could silently drop declarations past the cap)
+            r = client.search(RIVER_INDEX, {
+                "query": {"ids": {"values": ["_meta"]}}, "size": 10000})
+            return {hit["_type"]: hit["_source"] for hit in r["hits"]["hits"]}
+        except SearchEngineError:
+            return None
+
+    def _write_status(self, name: str, status: str):
+        try:
+            self.node.client().index(
+                RIVER_INDEX, name,
+                {"node": {"id": self.node.local_node.id,
+                          "name": self.node.name}, "status": status},
+                id="_status", refresh=True)
+        except SearchEngineError as e:
+            self.logger.warning(f"river [{name}] status write failed: {e}")
+
+    def _close(self, name: str):
+        river = self.running.pop(name, None)
+        if river is None:
+            return
+        try:
+            river.close()
+        except Exception as e:  # noqa: BLE001
+            self.logger.warning(f"river [{name}] close failed: {e}")
+        self.logger.info("river [%s] closed", name)
+
+    def _close_all(self):
+        with self._lock:
+            for name in list(self.running):
+                self._close(name)
+
+    def stop(self):
+        with self._lock:
+            self._stopped = True  # an already-queued reconcile must become a no-op
+        if self._task is not None:
+            self._task.cancel()
+        self._close_all()

@@ -1,0 +1,75 @@
+"""Rivers: _river meta docs start/stop registered river types on the master.
+ref: river/RiversService.java + river/dummy/DummyRiver.java."""
+
+import time
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rivers import River
+from elasticsearch_tpu.transport.local import LocalTransportRegistry
+
+
+class CountingRiver(River):
+    started = []
+    closed = []
+
+    def start(self):
+        CountingRiver.started.append(self.name)
+        # a pull-based river ingests through the normal client
+        self.node.client().index("pulled", "doc",
+                                 {"src": self.settings.get("source", "?")}, id="1")
+
+    def close(self):
+        CountingRiver.closed.append(self.name)
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(name="rv1", registry=LocalTransportRegistry(),
+             settings={"rivers.check_interval": 600},  # drive reconcile manually
+             data_path=str(tmp_path))
+    n.start([n.local_node.transport_address])
+    n.wait_for_master()
+    n.rivers.types["counting"] = CountingRiver
+    CountingRiver.started.clear()
+    CountingRiver.closed.clear()
+    yield n
+    n.close()
+
+
+class TestRivers:
+    def test_meta_doc_starts_and_delete_closes(self, node):
+        c = node.client()
+        c.create_index("pulled", {"settings": {"number_of_shards": 1,
+                                               "number_of_replicas": 0}})
+        c.cluster_health(wait_for_status="green")
+        c.index("_river", "myfeed", {"type": "counting", "source": "somewhere"},
+                id="_meta", refresh=True)
+        node.rivers.reconcile()
+        assert CountingRiver.started == ["myfeed"]
+        # the river ran: it pulled a doc through the client
+        c.refresh("pulled")
+        assert c.get("pulled", "doc", "1")["_source"]["src"] == "somewhere"
+        # status doc written (ref: RiversService writes _status)
+        st = c.get("_river", "myfeed", "_status")
+        assert st["found"] and st["_source"]["status"] == "started"
+        # idempotent: reconcile again doesn't double start
+        node.rivers.reconcile()
+        assert CountingRiver.started == ["myfeed"]
+        # deleting the meta doc closes the river
+        c.delete("_river", "myfeed", "_meta", refresh=True)
+        node.rivers.reconcile()
+        assert CountingRiver.closed == ["myfeed"]
+
+    def test_unknown_type_is_skipped(self, node):
+        c = node.client()
+        c.index("_river", "bad", {"type": "no_such_type"}, id="_meta", refresh=True)
+        node.rivers.reconcile()
+        assert "bad" not in node.rivers.running
+
+    def test_dummy_river_in_tree(self, node):
+        c = node.client()
+        c.index("_river", "d1", {"type": "dummy"}, id="_meta", refresh=True)
+        node.rivers.reconcile()
+        assert "d1" in node.rivers.running
