@@ -124,10 +124,35 @@ def evaluate_function(sf, seg, ctx, sub_scores: np.ndarray) -> np.ndarray:
         return np.nan_to_num(out, nan=0.0, posinf=0.0, neginf=0.0).astype(np.float32)
 
     if sf.kind == "script_score":
-        from ..script import compile_script
+        from ..script import ColumnVectorizer, compile_script
         from .filters import DocAccess
 
         fn = compile_script(sf.script, sf.params)
+        # column-lowered fast path: the whole segment in a few numpy ops; docs
+        # outside the vectorizable domain fall back to per-doc eval so semantics
+        # are unchanged — that covers missing referenced fields (per-doc sees
+        # value=None) AND non-finite vectorized results (per-doc raises
+        # ScriptError on the same domain error, e.g. log(0))
+        col_cache: dict[str, np.ndarray] = {}
+
+        def col(f):
+            if f not in col_cache:
+                col_cache[f] = _column_first_value(seg, f)
+            return col_cache[f]
+
+        vec = ColumnVectorizer(fn, col, sub_scores.astype(np.float64))
+        result = vec.vectorize()
+        if result is not None:
+            out = np.broadcast_to(np.asarray(result, dtype=np.float64),
+                                  (D,)).astype(np.float32)
+            ok = seg.parent_mask & np.isfinite(out)
+            for f in vec.used_fields:
+                ok &= ~np.isnan(col(f))
+            out = np.where(ok, out, np.float32(0.0))
+            for local in np.nonzero(seg.parent_mask & ~ok)[0]:
+                out[local] = float(fn(DocAccess(seg, int(local)),
+                                      _score=float(sub_scores[local])))
+            return out
         out = np.zeros(D, dtype=np.float32)
         for local in range(D):
             if seg.parent_mask[local]:

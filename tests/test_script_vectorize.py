@@ -1,0 +1,125 @@
+"""Column-lowered script evaluation: the vectorized fast path must be
+indistinguishable from per-doc eval (SURVEY §7 hard-parts: expression subset that
+lowers to column math)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.mapper.core import MapperService
+from elasticsearch_tpu.script import ColumnVectorizer, compile_script
+from elasticsearch_tpu.search import ShardContext, parse_query, search_shard
+from elasticsearch_tpu.search.similarity import SimilarityService
+
+
+@pytest.fixture(scope="module")
+def ctx(tmp_path_factory):
+    settings = Settings.from_flat({})
+    svc = MapperService(settings)
+    eng = Engine(str(tmp_path_factory.mktemp("vec")), svc)
+    rng = np.random.default_rng(11)
+    for i in range(300):
+        eng.index("doc", str(i), {"t": "scored doc",
+                                  "n": float(rng.integers(1, 100))})
+    # missing-field docs: only empty-guarded scripts can score them (the per-doc
+    # path raises on unguarded None — identical either way); they exercise the
+    # vectorizer's per-doc fallback domain
+    for i in range(300, 310):
+        eng.index("doc", str(i), {"t": "scored doc"})
+    eng.refresh()
+    c = ShardContext(eng.acquire_searcher(), svc,
+                     SimilarityService(settings, mapper_service=svc))
+    yield c
+    eng.close()
+
+
+SCRIPTS = [
+    "_score * 2",
+    "0 if doc['n'].empty else doc['n'].value * 3 + 1",
+    "_score if doc['n'].empty else _score + log(doc['n'].value)",
+    "1 if doc['n'].empty else min(doc['n'].value, 10) + max(_score, 0.5)",
+    "0 if doc['n'].empty else doc['n'].value",
+    "0 if doc['n'].empty else (doc['n'].value * f if doc['n'].value > 50 "
+    "else doc['n'].value / f)",
+    "0 if doc['n'].empty else sqrt(abs(doc['n'].value - 50))",
+]
+
+
+class TestVectorizedScripts:
+    @pytest.mark.parametrize("script", SCRIPTS)
+    def test_vectorized_equals_per_doc(self, ctx, script, monkeypatch):
+        q = {"function_score": {"query": {"match": {"t": "scored"}},
+                                "script_score": {"script": script,
+                                                 "params": {"f": 2.0}}}}
+        fast = search_shard(ctx, parse_query(q), 300, use_device=False)
+        # force the per-doc path and compare bit-for-bit hit lists
+        monkeypatch.setattr(ColumnVectorizer, "vectorize", lambda self: None)
+        slow = search_shard(ctx, parse_query(q), 300, use_device=False)
+        assert fast.total == slow.total
+        assert [(round(s, 5), d) for s, d in fast.hits] == \
+            [(round(s, 5), d) for s, d in slow.hits]
+
+    def test_subset_boundary_falls_back(self, ctx):
+        # doc['n'].values (the list form) is outside the vectorizable subset
+        cs = compile_script("doc['n'].values[0] if not doc['n'].empty else 0")
+        v = ColumnVectorizer(cs, lambda f: np.zeros(4), np.zeros(4))
+        assert v.vectorize() is None
+
+    def test_boolop_returns_values_not_booleans(self, ctx, monkeypatch):
+        # Python and/or return operand VALUES; logical_and-style lowering would
+        # score every doc 1.0
+        script = "(not doc['n'].empty) and log(doc['n'].value + 1)"
+        q = {"function_score": {"query": {"match": {"t": "scored"}},
+                                "script_score": {"script": script},
+                                "boost_mode": "replace"}}
+        fast = search_shard(ctx, parse_query(q), 300, use_device=False)
+        monkeypatch.setattr(ColumnVectorizer, "vectorize", lambda self: None)
+        slow = search_shard(ctx, parse_query(q), 300, use_device=False)
+        assert [(round(s, 5), d) for s, d in fast.hits] == \
+            [(round(s, 5), d) for s, d in slow.hits]
+        assert fast.hits[0][0] > 1.01  # real log values, not collapsed booleans
+
+    def test_params_shadow_score_and_functions(self):
+        # per-doc env order is {doc, _score, **funcs, **params} — params win
+        cs = compile_script("_score * 2", {"_score": 5.0})
+        v = ColumnVectorizer(cs, lambda f: None, np.array([1.0, 2.0]))
+        out = v.vectorize()
+        assert np.allclose(out, [10.0, 10.0])  # param, not the real scores
+        cs2 = compile_script("log(3)", {"log": 2.0})
+        v2 = ColumnVectorizer(cs2, lambda f: None, np.zeros(2))
+        assert v2.vectorize() is None  # per-doc raises (calling a float) — fall back
+
+    def test_domain_errors_keep_per_doc_semantics(self, tmp_path):
+        # log(0): per-doc raises ScriptError; the fast path must not silently
+        # return -inf — it routes the doc to per-doc eval, which raises identically
+        from elasticsearch_tpu.common.errors import ScriptError
+
+        settings = Settings.from_flat({})
+        svc = MapperService(settings)
+        eng = Engine(str(tmp_path / "dom"), svc)
+        eng.index("doc", "1", {"t": "x", "n": 0.0})
+        eng.refresh()
+        c = ShardContext(eng.acquire_searcher(), svc,
+                         SimilarityService(settings, mapper_service=svc))
+        q = {"function_score": {"query": {"match": {"t": "x"}},
+                                "script_score": {"script": "log(doc['n'].value)"}}}
+        with pytest.raises(ScriptError):
+            search_shard(c, parse_query(q), 10, use_device=False)
+        eng.close()
+
+    def test_numpy_arity_mismatch_falls_back_not_crashes(self):
+        # pow(2,3,5) is legal per-doc (builtin 3-arg pow); np.power(2,3,5) would
+        # TypeError — vectorize() must return None, not raise
+        cs = compile_script("pow(2, 3, 5)")
+        v = ColumnVectorizer(cs, lambda f: None, np.zeros(2))
+        assert v.vectorize() is None
+
+    def test_vectorizer_direct(self):
+        cs = compile_script("_score * w + doc['p'].value", {"w": 3.0})
+        cols = {"p": np.array([1.0, 2.0, np.nan, 4.0])}
+        v = ColumnVectorizer(cs, cols.get, np.array([10.0, 20.0, 30.0, 40.0]))
+        out = v.vectorize()
+        assert np.allclose(out[:2], [31.0, 62.0])
+        assert np.isnan(out[2])
+        assert v.used_fields == {"p"}
