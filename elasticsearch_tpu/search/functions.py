@@ -27,6 +27,37 @@ from ..mapper.core import parse_date_math
 from .filters import haversine_m, parse_distance, segment_mask
 
 
+def vectorized_script_eval(fn, seg, scores: np.ndarray):
+    """Column-lowered script evaluation over a whole segment.
+
+    Returns (values float64[D], ok bool[D]) or None when the script is outside the
+    vectorizable subset. `ok` excludes exactly the docs whose per-doc evaluation
+    may diverge or raise — referenced fields missing (per-doc sees value=None) and
+    non-finite vectorized results (per-doc raises ScriptError on the same domain
+    error, e.g. log(0)) — so callers run the per-doc path for ~ok docs and
+    semantics, including errors, are unchanged. Shared by script_score and
+    _script sorts; keep the masking rules HERE so both stay in lockstep."""
+    from ..script import ColumnVectorizer
+
+    col_cache: dict[str, np.ndarray] = {}
+
+    def col(f):
+        if f not in col_cache:
+            col_cache[f] = _column_first_value(seg, f)
+        return col_cache[f]
+
+    vec = ColumnVectorizer(fn, col, scores)
+    result = vec.vectorize()
+    if result is None:
+        return None
+    vals = np.broadcast_to(np.asarray(result, dtype=np.float64),
+                           (seg.doc_count,))
+    ok = seg.parent_mask & np.isfinite(vals)
+    for f in vec.used_fields:
+        ok &= ~np.isnan(col(f))
+    return vals, ok
+
+
 def _column_first_value(seg, field: str) -> np.ndarray:
     """First numeric value per doc (NaN = missing)."""
     col = seg.dv_num.get(field)
@@ -124,31 +155,14 @@ def evaluate_function(sf, seg, ctx, sub_scores: np.ndarray) -> np.ndarray:
         return np.nan_to_num(out, nan=0.0, posinf=0.0, neginf=0.0).astype(np.float32)
 
     if sf.kind == "script_score":
-        from ..script import ColumnVectorizer, compile_script
+        from ..script import compile_script
         from .filters import DocAccess
 
         fn = compile_script(sf.script, sf.params)
-        # column-lowered fast path: the whole segment in a few numpy ops; docs
-        # outside the vectorizable domain fall back to per-doc eval so semantics
-        # are unchanged — that covers missing referenced fields (per-doc sees
-        # value=None) AND non-finite vectorized results (per-doc raises
-        # ScriptError on the same domain error, e.g. log(0))
-        col_cache: dict[str, np.ndarray] = {}
-
-        def col(f):
-            if f not in col_cache:
-                col_cache[f] = _column_first_value(seg, f)
-            return col_cache[f]
-
-        vec = ColumnVectorizer(fn, col, sub_scores.astype(np.float64))
-        result = vec.vectorize()
-        if result is not None:
-            out = np.broadcast_to(np.asarray(result, dtype=np.float64),
-                                  (D,)).astype(np.float32)
-            ok = seg.parent_mask & np.isfinite(out)
-            for f in vec.used_fields:
-                ok &= ~np.isnan(col(f))
-            out = np.where(ok, out, np.float32(0.0))
+        vec = vectorized_script_eval(fn, seg, sub_scores.astype(np.float64))
+        if vec is not None:
+            vals, ok = vec
+            out = np.where(ok, vals, 0.0).astype(np.float32)
             for local in np.nonzero(seg.parent_mask & ~ok)[0]:
                 out[local] = float(fn(DocAccess(seg, int(local)),
                                       _score=float(sub_scores[local])))

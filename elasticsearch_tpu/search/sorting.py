@@ -124,15 +124,28 @@ def sort_key_column(spec: SortSpec, seg, ctx, scores: np.ndarray | None) -> np.n
     if spec.kind == "script":
         from ..script import compile_script
         from .filters import DocAccess
+        from .functions import vectorized_script_eval
 
         fn = compile_script(spec.script or "0", spec.params)
+        # _script sorts expose the document's _score (reference semantics)
+        score_arr = (scores if scores is not None
+                     else np.zeros(D)).astype(np.float64)
         out = np.full(D, np.nan)
-        for local in range(D):
-            if seg.parent_mask[local]:
-                try:
-                    out[local] = float(fn(DocAccess(seg, local)))
-                except Exception:  # noqa: BLE001 — missing fields etc.
-                    pass
+        # column-lowered fast path (shared contract with script_score: identical
+        # or fall back per doc — here, per-doc errors become NaN keys)
+        vec = vectorized_script_eval(fn, seg, score_arr)
+        if vec is not None:
+            vals, ok = vec
+            out[ok] = vals[ok]
+            rest = np.nonzero(seg.parent_mask & ~ok)[0]
+        else:
+            rest = np.nonzero(seg.parent_mask)[0]
+        for local in rest:
+            try:
+                out[local] = float(fn(DocAccess(seg, int(local)),
+                                      _score=float(score_arr[local])))
+            except Exception:  # noqa: BLE001 — missing fields etc. → NaN key
+                pass
         return out
     col = seg.dv_num.get(spec.field)
     if col is not None:

@@ -115,6 +115,38 @@ class TestVectorizedScripts:
         v = ColumnVectorizer(cs, lambda f: None, np.zeros(2))
         assert v.vectorize() is None
 
+    def test_script_sort_vectorized_equals_per_doc(self, ctx, monkeypatch):
+        q = {"query": {"match": {"t": "scored"}},
+             "sort": [{"_script": {"script":
+                                   "0 if doc['n'].empty else doc['n'].value % 17",
+                                   "type": "number", "order": "asc"}}],
+             "size": 300}
+        from elasticsearch_tpu.search.service import (
+            execute_query_phase,
+            parse_search_body,
+        )
+
+        fast = execute_query_phase(ctx, parse_search_body(q))
+        monkeypatch.setattr(ColumnVectorizer, "vectorize", lambda self: None)
+        slow = execute_query_phase(ctx, parse_search_body(q))
+        assert [(d, sv) for (_s, d, sv) in fast.docs] == \
+            [(d, sv) for (_s, d, sv) in slow.docs]
+
+    def test_script_sort_sees_real_score(self, ctx):
+        # reference semantics: _script sorts expose the doc's _score
+        from elasticsearch_tpu.search.service import (
+            execute_query_phase,
+            parse_search_body,
+        )
+
+        q = {"query": {"match": {"t": "scored"}}, "track_scores": True,
+             "sort": [{"_script": {"script": "_score * -1.0", "type": "number",
+                                   "order": "asc"}}], "size": 300}
+        r = execute_query_phase(ctx, parse_search_body(q))
+        keys = [sv[0] for (_s, _d, sv) in r.docs]
+        assert keys == sorted(keys)
+        assert any(k != 0.0 for k in keys)  # real scores, not the old zero default
+
     def test_vectorizer_direct(self):
         cs = compile_script("_score * w + doc['p'].value", {"w": 3.0})
         cols = {"p": np.array([1.0, 2.0, np.nan, 4.0])}
