@@ -1,0 +1,106 @@
+"""Pallas TPU kernels for the sparse scoring path.
+
+The sparse kernel's dominant remaining cost is the postings block gather
+(`blk_docs[qblk]` / `blk_tfn[qblk]` — measured ~5.4 ms of the ~8 ms batch on v5e;
+XLA lowers it as a generic gather far from DMA bandwidth). `gather_scale` replaces
+it with a scalar-prefetch Pallas kernel: the per-(query, slot) block row indices are
+prefetched to SMEM, the BlockSpec index maps select each [1, B] postings block row
+directly (Pallas double-buffers the HBM→VMEM DMAs across grid steps), and the
+weight multiply + const-clause select fuse into the same pass — the gather becomes
+streaming DMA instead of generic gather.
+
+Opt-in, TPU-only: scoring.py uses it when ESTPU_PALLAS=1 AND the backend is a TPU
+(pending on-silicon benchmarking before any default flips). ESTPU_PALLAS=interpret
+forces the kernel in interpret mode on any backend — bitwise-identical semantics,
+which is how the parity suite exercises it on the CPU test mesh; interpret mode is
+orders of magnitude slower, so it never engages implicitly.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .device_index import BLOCK
+
+
+def estpu_pallas_enabled() -> bool:
+    """ESTPU_PALLAS=1 → only on a real TPU backend (interpret-mode Pallas on the
+    serving path would be a silent orders-of-magnitude regression);
+    ESTPU_PALLAS=interpret → force anywhere (tests/dev)."""
+    flag = os.environ.get("ESTPU_PALLAS", "0")
+    if flag == "interpret":
+        return True
+    return flag == "1" and _is_tpu()
+
+
+def _is_tpu() -> bool:
+    import jax
+
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001 — backend probe failure → interpret mode
+        return False
+
+
+def _gather_scale_kernel(qblk_ref, qw_ref, qconst_ref,  # scalar prefetch (SMEM)
+                         docs_blk_ref, tfn_blk_ref,  # [1, B] selected block row
+                         docs_out_ref, contrib_out_ref):  # [1, 1, B]
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    q = pl.program_id(0)
+    t = pl.program_id(1)
+    w = qw_ref[q, t]
+    is_const = qconst_ref[q, t]
+    docs_out_ref[...] = docs_blk_ref[...].reshape(docs_out_ref.shape)
+    tfn = tfn_blk_ref[...].reshape(contrib_out_ref.shape)
+    # CONST clauses contribute w per match; scoring clauses w·tfn
+    contrib_out_ref[...] = jnp.where(is_const != 0, w, w * tfn)
+
+
+def _gather_scale_call(qblk, qw, qconst, blk_docs, blk_tfn, *, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    Qb, TB = qblk.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,  # qblk, qw, qconst
+        grid=(Qb, TB),
+        in_specs=[
+            # the prefetched qblk drives WHICH postings block row each grid cell
+            # streams in — this is the gather
+            pl.BlockSpec((1, BLOCK), lambda q, t, qblk, qw, qc: (qblk[q, t], 0)),
+            pl.BlockSpec((1, BLOCK), lambda q, t, qblk, qw, qc: (qblk[q, t], 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, BLOCK), lambda q, t, *_: (q, t, 0)),
+            pl.BlockSpec((1, 1, BLOCK), lambda q, t, *_: (q, t, 0)),
+        ],
+    )
+    return pl.pallas_call(
+        _gather_scale_kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((Qb, TB, BLOCK), jnp.int32),
+            jax.ShapeDtypeStruct((Qb, TB, BLOCK), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qblk, qw, qconst, blk_docs, blk_tfn)
+
+
+def gather_scale(qblk, qw, qconst, blk_docs, blk_tfn):
+    """[Qb, TB] block rows + weights → (docs [Qb, TB, B] int32,
+    contrib [Qb, TB, B] f32 = w·tfn, or w for const clauses).
+
+    Equivalent to `blk_docs[qblk]`, `qw[:, :, None] * where(qconst, 1, blk_tfn[qblk])`
+    — asserted against that exact formulation by tests/test_pallas_kernels.py."""
+    import jax.numpy as jnp
+
+    return _gather_scale_call(
+        jnp.asarray(qblk, jnp.int32), jnp.asarray(qw, jnp.float32),
+        jnp.asarray(qconst).astype(jnp.int32),
+        blk_docs, blk_tfn, interpret=not _is_tpu())

@@ -294,16 +294,25 @@ class SparseBatch:
 
 
 def _sparse_impl(blk_docs, blk_tfn, qblk, qw, qconst, qcnt, n_must, msm, coord,
-                 *, k: int, doc_pad: int, passes: int, simple: bool, use_coord: bool):
+                 *, k: int, doc_pad: int, passes: int, simple: bool,
+                 use_coord: bool, use_pallas: bool = False):
     import jax
     import jax.numpy as jnp
 
     Qb, TB = qblk.shape
     P = TB * BLOCK
-    docs = blk_docs[qblk]  # [Qb, TB, B]
-    tfn = blk_tfn[qblk]
-    valid = docs < doc_pad
-    contrib = qw[:, :, None] * jnp.where(qconst[:, :, None], 1.0, tfn)
+    if use_pallas:
+        # scalar-prefetch DMA gather fused with the weight multiply
+        # (ops/pallas_kernels.py; parity-tested against the XLA formulation)
+        from .pallas_kernels import gather_scale
+
+        docs, contrib = gather_scale(qblk, qw, qconst, blk_docs, blk_tfn)
+        valid = docs < doc_pad
+    else:
+        docs = blk_docs[qblk]  # [Qb, TB, B]
+        tfn = blk_tfn[qblk]
+        valid = docs < doc_pad
+        contrib = qw[:, :, None] * jnp.where(qconst[:, :, None], 1.0, tfn)
     contrib = jnp.where(valid, contrib, 0.0)
     docs = docs.reshape(Qb, P)
     contrib = contrib.reshape(Qb, P)
@@ -364,12 +373,17 @@ def _get_sparse_compiled(Qb: int, TB: int, k: int, doc_pad: int, passes: int,
                          simple: bool, use_coord: bool, coord_w: int):
     import jax
 
-    key = ("sparse", Qb, TB, k, doc_pad, passes, simple, use_coord, coord_w)
+    from .pallas_kernels import estpu_pallas_enabled
+
+    use_pallas = estpu_pallas_enabled()
+    key = ("sparse", Qb, TB, k, doc_pad, passes, simple, use_coord, coord_w,
+           use_pallas)
     fn = _compiled_cache.get(key)
     if fn is None:
         def wrapper(*args):
             return _sparse_impl(*args, k=k, doc_pad=doc_pad, passes=passes,
-                                simple=simple, use_coord=use_coord)
+                                simple=simple, use_coord=use_coord,
+                                use_pallas=use_pallas)
 
         fn = jax.jit(wrapper)
         _compiled_cache[key] = fn
