@@ -100,7 +100,10 @@ def gather_scale(qblk, qw, qconst, blk_docs, blk_tfn):
     — asserted against that exact formulation by tests/test_pallas_kernels.py."""
     import jax.numpy as jnp
 
+    # ESTPU_PALLAS=interpret forces interpretation EVERYWHERE (incl. on TPU —
+    # that's the escape hatch for comparing interpreted vs compiled output)
+    interpret = (os.environ.get("ESTPU_PALLAS") == "interpret") or not _is_tpu()
     return _gather_scale_call(
         jnp.asarray(qblk, jnp.int32), jnp.asarray(qw, jnp.float32),
         jnp.asarray(qconst).astype(jnp.int32),
-        blk_docs, blk_tfn, interpret=not _is_tpu())
+        blk_docs, blk_tfn, interpret=interpret)

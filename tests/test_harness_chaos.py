@@ -1,0 +1,112 @@
+"""Chaos tests on the TestCluster harness: master kill + failover, replica
+promotion under node death, store fault injection during recovery.
+
+ref: the reference's recovery/discovery/cluster suites run on TestCluster with
+stopRandomNode; MockFSDirectoryService injects random IO errors."""
+
+import time
+
+import pytest
+
+from tests.harness import FaultyStore, SearcherLeakTracker, TestCluster
+
+
+class TestClusterHarness:
+    def test_master_kill_reelection_and_data_survival(self, tmp_path):
+        with TestCluster(n_nodes=3, data_root=tmp_path, seed=7) as cluster:
+            c = cluster.client()
+            c.create_index("ha", {"settings": {"number_of_shards": 2,
+                                               "number_of_replicas": 1}})
+            cluster.ensure_green("ha")
+            for i in range(20):
+                c.index("ha", "doc", {"n": i}, id=str(i))
+            c.refresh("ha")
+            old_master = cluster.master_name()
+            cluster.kill_node(old_master)
+            # a new master must emerge and all data must survive via replicas
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                m = cluster.master_name()
+                if m is not None and m != old_master:
+                    break
+                time.sleep(0.2)
+            assert cluster.master_name() not in (None, old_master)
+            deadline = time.time() + 30
+            count = 0
+            while time.time() < deadline:
+                try:
+                    cluster.client().refresh("ha")
+                    count = cluster.client().count("ha")["count"]
+                    if count == 20:
+                        break
+                except Exception:  # noqa: BLE001 — cluster still settling
+                    pass
+                time.sleep(0.3)
+            assert count == 20
+
+    def test_node_join_rebalances_and_serves(self, tmp_path):
+        with TestCluster(n_nodes=2, data_root=tmp_path, seed=3) as cluster:
+            c = cluster.client()
+            c.create_index("grow", {"settings": {"number_of_shards": 3,
+                                                 "number_of_replicas": 1}})
+            cluster.ensure_green("grow")
+            for i in range(12):
+                c.index("grow", "doc", {"n": i}, id=str(i))
+            c.refresh("grow")
+            cluster.add_node()
+            cluster.client().cluster_health(wait_for_nodes=3)
+            assert cluster.client().count("grow")["count"] == 12
+
+
+class TestFaultInjection:
+    def test_store_read_faults_surface_not_corrupt(self, tmp_path):
+        """Injected read IOErrors must raise cleanly (checksummed store), never
+        return corrupt segments."""
+        from elasticsearch_tpu.common.settings import Settings
+        from elasticsearch_tpu.index.engine import Engine
+        from elasticsearch_tpu.mapper.core import MapperService
+
+        svc = MapperService(Settings.from_flat({}))
+        eng = Engine(str(tmp_path / "f"), svc)
+        for i in range(30):
+            eng.index("doc", str(i), {"n": i})
+        eng.refresh()
+        eng.flush()
+        eng.close()
+
+        eng2 = Engine(str(tmp_path / "f"), svc)
+        faulty = FaultyStore(eng2.store, fail_rate=1.0)
+        eng2.store = faulty
+        with pytest.raises(IOError):
+            eng2.recover_from_store()
+        assert faulty.failures > 0
+        # with faults off, the same store recovers fully
+        faulty.fail_rate = 0.0
+        eng3 = Engine(str(tmp_path / "f"), svc)
+        eng3.recover_from_store()
+        eng3.refresh()
+        assert eng3.acquire_searcher().max_doc == 30
+        eng3.close()
+
+    def test_searcher_acquisitions_bounded_per_search(self, tmp_path):
+        from elasticsearch_tpu.common.settings import Settings
+        from elasticsearch_tpu.index.engine import Engine
+        from elasticsearch_tpu.mapper.core import MapperService
+        from elasticsearch_tpu.search import ShardContext, parse_query, search_shard
+        from elasticsearch_tpu.search.similarity import SimilarityService
+
+        settings = Settings.from_flat({})
+        svc = MapperService(settings)
+        eng = Engine(str(tmp_path / "lk"), svc)
+        for i in range(10):
+            eng.index("doc", str(i), {"t": "leak check"})
+        eng.refresh()
+        with SearcherLeakTracker(eng) as tracker:
+            ctx = ShardContext(eng.acquire_searcher(), svc,
+                               SimilarityService(settings, mapper_service=svc))
+            for _ in range(5):
+                search_shard(ctx, parse_query({"match": {"t": "leak"}}), 5,
+                             use_device=False)
+            # a search must not re-acquire per hit/segment — one per context
+            assert tracker.acquired <= 2, tracker.acquired
+        eng.close()
