@@ -59,17 +59,22 @@ def _ensure_backend():
 
         force_cpu_platform()
         return "cpu"
-    timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", 180))
+    timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", 240))
+    retries = int(os.environ.get("BENCH_PROBE_RETRIES", 2))
     probe = "import jax; print(jax.devices()[0].platform)"
-    try:
-        out = subprocess.run([sys.executable, "-c", probe], capture_output=True,
-                             timeout=timeout, text=True)
-        if out.returncode == 0 and out.stdout.strip():
-            return out.stdout.strip().splitlines()[-1]
-        print(f"# backend probe rc={out.returncode}: {out.stderr[-500:]}",
-              file=sys.stderr)
-    except subprocess.TimeoutExpired:
-        print(f"# backend probe timed out after {timeout}s", file=sys.stderr)
+    for attempt in range(retries):
+        try:
+            out = subprocess.run([sys.executable, "-c", probe], capture_output=True,
+                                 timeout=timeout, text=True)
+            if out.returncode == 0 and out.stdout.strip():
+                return out.stdout.strip().splitlines()[-1]
+            print(f"# backend probe rc={out.returncode}: {out.stderr[-500:]}",
+                  file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            # a wedged tunnel sometimes recovers between attempts — retry before
+            # settling for the CPU fallback (the number the driver records)
+            print(f"# backend probe attempt {attempt + 1}/{retries} timed out "
+                  f"after {timeout}s", file=sys.stderr)
     from elasticsearch_tpu.common.jaxenv import force_cpu_platform
 
     force_cpu_platform()

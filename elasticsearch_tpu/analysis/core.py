@@ -292,6 +292,108 @@ def make_truncate_filter(settings: Settings):
     return apply
 
 
+_FRENCH_ARTICLES = ("l", "m", "t", "qu", "n", "s", "j")
+
+
+def make_elision_filter(settings: Settings):
+    """Strip elided articles (l'avion → avion).
+    ref: index/analysis/ElisionTokenFilterFactory.java — articles configurable,
+    French defaults."""
+    articles = frozenset(a.lower() for a in
+                         (settings.get_list("articles") or _FRENCH_ARTICLES))
+
+    def apply(tokens: list[Token], _settings=None) -> list[Token]:
+        for t in tokens:
+            for sep in ("'", "’"):
+                i = t.term.find(sep)
+                if i > 0 and t.term[:i].lower() in articles:
+                    t.term = t.term[i + 1:]
+                    break
+        return [t for t in tokens if t.term]
+
+    return apply
+
+
+def make_common_grams_filter(settings: Settings):
+    """Bigram tokens over common words, at the same positions as the unigrams
+    (ref: index/analysis/CommonGramsTokenFilterFactory.java; query_mode drops the
+    unigrams the bigrams cover)."""
+    ignore_case = settings.get_bool("ignore_case", False)
+    query_mode = settings.get_bool("query_mode", False)
+    words = settings.get_list("common_words") or ()
+    common = frozenset(w.lower() for w in words) if ignore_case else frozenset(words)
+
+    def is_common(term: str) -> bool:
+        return (term.lower() if ignore_case else term) in common
+
+    def apply(tokens: list[Token], _settings=None) -> list[Token]:
+        n = len(tokens)
+        flags = [is_common(t.term) for t in tokens]
+        # bigram between i and i+1 whenever either side is common
+        has_gram = [i + 1 < n and (flags[i] or flags[i + 1]) for i in range(n)]
+        out: list[Token] = []
+        for i, t in enumerate(tokens):
+            # query_mode (CommonGramsQueryFilter): drop a unigram when a bigram
+            # STARTS at it (the bigram carries it forward); the final token after
+            # the last bigram stays
+            if not (query_mode and has_gram[i]):
+                out.append(t)
+            if has_gram[i]:
+                nxt = tokens[i + 1]
+                out.append(Token(f"{t.term}_{nxt.term}", t.position, t.start,
+                                 nxt.end))
+        return out
+
+    return apply
+
+
+def make_stemmer_override_filter(settings: Settings):
+    """Exact-match stemming overrides applied BEFORE stemmers; matched terms are
+    keyword-marked so stemmers leave them alone
+    (ref: index/analysis/StemmerOverrideTokenFilterFactory.java, rules "a => b")."""
+    rules = {}
+    for rule in settings.get_list("rules") or ():
+        src, _, dst = str(rule).partition("=>")
+        if dst:
+            rules[src.strip()] = dst.strip()
+
+    def apply(tokens: list[Token], _settings=None) -> list[Token]:
+        for t in tokens:
+            dst = rules.get(t.term)
+            if dst is not None:
+                t.term = "\x00" + dst  # keyword-mark; stemmers unmark
+        return tokens
+
+    return apply
+
+
+def make_pattern_capture_filter(settings: Settings):
+    """Emit each regex capture group as a token at the original position
+    (ref: index/analysis/PatternCaptureGroupTokenFilterFactory.java)."""
+    patterns = [re.compile(p) for p in settings.get_list("patterns") or ()]
+    preserve = settings.get_bool("preserve_original", True)
+
+    def apply(tokens: list[Token], _settings=None) -> list[Token]:
+        out: list[Token] = []
+        for t in tokens:
+            emitted = set()
+            if preserve:
+                out.append(t)
+                emitted.add(t.term)
+            for pat in patterns:
+                for m in pat.finditer(t.term):
+                    groups = m.groups() or (m.group(0),)
+                    for g in groups:
+                        if g and g not in emitted:
+                            emitted.add(g)
+                            out.append(Token(g, t.position, t.start, t.end))
+            if not preserve and not emitted:
+                out.append(t)  # no groups matched: keep the original
+        return out
+
+    return apply
+
+
 def unique_filter(tokens: list[Token], settings: Settings | None = None) -> list[Token]:
     seen = set()
     out = []
@@ -574,6 +676,10 @@ _PARAMETRIC_FILTERS: dict[str, Callable[[Settings], Callable]] = {
     "edgeNGram": lambda s: make_ngram_filter(s, edge=True),
     "synonym": make_synonym_filter,
     "keyword_marker": make_keyword_marker_filter,
+    "elision": make_elision_filter,
+    "common_grams": make_common_grams_filter,
+    "stemmer_override": make_stemmer_override_filter,
+    "pattern_capture": make_pattern_capture_filter,
 }
 
 CHAR_FILTERS: dict[str, Callable] = {
@@ -619,6 +725,12 @@ class Analyzer:
         tokens = self.tokenizer(text, self.tokenizer_settings)
         for f in self.filters:
             tokens = f(tokens)
+        # keyword marks (\x00 prefix from keyword_marker/stemmer_override) protect
+        # terms from stemmers mid-chain; whatever survives to the end must be
+        # stripped or the control byte would be INDEXED into the term
+        for t in tokens:
+            if t.term.startswith("\x00"):
+                t.term = t.term[1:]
         return tokens
 
     def terms(self, text: str) -> list[str]:

@@ -54,6 +54,68 @@ class TestAnalysis:
         assert svc.analyzer("ng").terms("hello") == ["he", "hel", "hell"]
         assert "quick brown" in svc.analyzer("sh").terms("Quick Brown Fox")
 
+    def test_elision_filter(self):
+        svc = AnalysisService(Settings.from_flat({
+            "index.analysis.analyzer.fr.tokenizer": "standard",
+            "index.analysis.analyzer.fr.filter": ["lowercase", "el"],
+            "index.analysis.filter.el.type": "elision",
+            "index.analysis.filter.el.articles": ["l", "d"],
+        }))
+        assert svc.analyzer("fr").terms("L'avion d'essai") == ["avion", "essai"]
+
+    def test_common_grams_filter(self):
+        svc = AnalysisService(Settings.from_flat({
+            "index.analysis.analyzer.cg.tokenizer": "standard",
+            "index.analysis.analyzer.cg.filter": ["lowercase", "cg"],
+            "index.analysis.filter.cg.type": "common_grams",
+            "index.analysis.filter.cg.common_words": ["the", "of"],
+        }))
+        terms = svc.analyzer("cg").terms("king of spain")
+        assert "king_of" in terms and "of_spain" in terms
+        assert "king" in terms and "spain" in terms  # unigrams preserved
+
+    def test_stemmer_override_filter(self):
+        svc = AnalysisService(Settings.from_flat({
+            "index.analysis.analyzer.so.tokenizer": "standard",
+            "index.analysis.analyzer.so.filter": ["lowercase", "so", "porter_stem"],
+            "index.analysis.filter.so.type": "stemmer_override",
+            "index.analysis.filter.so.rules": ["running => sprint"],
+            # no stemmer after the override: the keyword mark must never be indexed
+            "index.analysis.analyzer.so2.tokenizer": "standard",
+            "index.analysis.analyzer.so2.filter": ["lowercase", "so"],
+        }))
+        # overridden term bypasses the stemmer; others still stem
+        assert svc.analyzer("so").terms("running jumping") == ["sprint", "jump"]
+        assert svc.analyzer("so2").terms("running") == ["sprint"]
+
+    def test_common_grams_case_and_query_mode(self):
+        svc = AnalysisService(Settings.from_flat({
+            # case-sensitive by default: configured words match as-given
+            "index.analysis.analyzer.cs.tokenizer": "whitespace",
+            "index.analysis.analyzer.cs.filter": ["cs"],
+            "index.analysis.filter.cs.type": "common_grams",
+            "index.analysis.filter.cs.common_words": ["The"],
+            # query_mode: bigram-covered unigrams drop (CommonGramsQueryFilter)
+            "index.analysis.analyzer.qm.tokenizer": "whitespace",
+            "index.analysis.analyzer.qm.filter": ["lowercase", "qm"],
+            "index.analysis.filter.qm.type": "common_grams",
+            "index.analysis.filter.qm.common_words": ["of"],
+            "index.analysis.filter.qm.query_mode": True,
+        }))
+        assert "The_cat" in svc.analyzer("cs").terms("The cat")
+        assert svc.analyzer("qm").terms("king of spain") == \
+            ["king_of", "of_spain", "spain"]
+
+    def test_pattern_capture_filter(self):
+        svc = AnalysisService(Settings.from_flat({
+            "index.analysis.analyzer.pc.tokenizer": "whitespace",
+            "index.analysis.analyzer.pc.filter": ["lowercase", "pc"],
+            "index.analysis.filter.pc.type": "pattern_capture",
+            "index.analysis.filter.pc.patterns": ["(\\w+)@(\\w+)"],
+        }))
+        terms = svc.analyzer("pc").terms("user@example")
+        assert set(terms) == {"user@example", "user", "example"}
+
     def test_synonym_filter(self):
         svc = AnalysisService(Settings.from_flat({
             "index.analysis.analyzer.syn.tokenizer": "standard",
