@@ -83,6 +83,37 @@ def _score_batch_impl(blk_docs, blk_freqs, live_parent, norms_stack, caches,
     import jax.numpy as jnp
 
     Q = n_queries
+    scores, flat_idx, valid = _dense_accumulate(
+        blk_docs, blk_freqs, norms_stack, caches, qidx, blk, weight, fidx, group,
+        tfmode, Q=Q, doc_pad=doc_pad)
+
+    if simple:
+        match = (scores > 0.0) & live_parent[None, :doc_pad]
+        neg_inf = jnp.float32(-jnp.inf)
+        masked = jnp.where(match, scores, neg_inf)
+        top_scores, top_docs = jax.lax.top_k(masked, k)
+        total = match.sum(axis=1, dtype=jnp.int32)
+        # sentinel substitution + max_score are [Q, k]-tiny — done host-side in
+        # score_term_batch (appending them here measurably slowed the whole program
+        # on the axon backend)
+        return top_scores, top_docs, total
+
+    scores, match = _dense_semantics(scores, flat_idx, valid, group, live_parent,
+                                     n_must, msm, coord, Q=Q, doc_pad=doc_pad)
+    neg_inf = jnp.float32(-jnp.inf)
+    masked = jnp.where(match, scores, neg_inf)
+    top_scores, top_docs = jax.lax.top_k(masked, k)
+    total = match.sum(axis=1, dtype=jnp.int32)
+    return top_scores, top_docs, total
+
+
+def _dense_accumulate(blk_docs, blk_freqs, norms_stack, caches,
+                      qidx, blk, weight, fidx, group, tfmode, *, Q: int, doc_pad: int):
+    """Steps 1-3 of the dense kernel: gather postings blocks, per-posting
+    contributions, scatter-add into the [Q, doc_pad] accumulator. Returns
+    (scores, flat_idx, valid) for the semantics pass."""
+    import jax.numpy as jnp
+
     docs = blk_docs[blk]  # [M, B] int32; padded rows → doc_pad sentinel
     freqs = blk_freqs[blk]  # [M, B]
     valid = docs < doc_pad
@@ -109,17 +140,15 @@ def _score_batch_impl(blk_docs, blk_freqs, live_parent, norms_stack, caches,
     scores = jnp.zeros(Q * (doc_pad + 1), jnp.float32).at[flat_idx.reshape(-1)].add(
         contrib.reshape(-1), mode="drop"
     ).reshape(Q, doc_pad + 1)[:, :doc_pad]
+    return scores, flat_idx, valid
 
-    if simple:
-        match = (scores > 0.0) & live_parent[None, :doc_pad]
-        neg_inf = jnp.float32(-jnp.inf)
-        masked = jnp.where(match, scores, neg_inf)
-        top_scores, top_docs = jax.lax.top_k(masked, k)
-        total = match.sum(axis=1, dtype=jnp.int32)
-        # sentinel substitution + max_score are [Q, k]-tiny — done host-side in
-        # score_term_batch (appending them here measurably slowed the whole program
-        # on the axon backend)
-        return top_scores, top_docs, total
+
+def _dense_semantics(scores, flat_idx, valid, group, live_parent, n_must, msm, coord,
+                     *, Q: int, doc_pad: int):
+    """Bool-query semantics + coord over the dense accumulator: returns the
+    coord-scaled scores and the match mask (shared by the plain dense kernel and
+    the function_score variants below)."""
+    import jax.numpy as jnp
 
     counters = (
         jnp.where(group == GROUP_SHOULD, 1, 0)
@@ -145,13 +174,7 @@ def _score_batch_impl(blk_docs, blk_freqs, live_parent, norms_stack, caches,
     coord_fac = jnp.zeros_like(scores)
     for j in range(coord.shape[1]):
         coord_fac = coord_fac + jnp.where(overlap == j, coord[:, j][:, None], 0.0)
-    scores = scores * coord_fac
-
-    neg_inf = jnp.float32(-jnp.inf)
-    masked = jnp.where(match, scores, neg_inf)
-    top_scores, top_docs = jax.lax.top_k(masked, k)
-    total = match.sum(axis=1, dtype=jnp.int32)
-    return top_scores, top_docs, total
+    return scores * coord_fac, match
 
 
 _compiled_cache: dict = {}
@@ -170,6 +193,203 @@ def _get_compiled(n_queries: int, k: int, doc_pad: int, simple: bool = False):
         fn = jax.jit(wrapper)
         _compiled_cache[key] = fn
     return fn
+
+
+# ---------------------------------------------------------------------------
+# function_score variants of the dense kernel
+# ---------------------------------------------------------------------------
+#
+# The reference rescores inside the Lucene query (FunctionScoreQuery wraps the sub
+# scorer — common/lucene/search/function/FunctionScoreQuery.java); here the
+# function value is fused into the same device program that scores the sub query:
+#   "rows"   — every function is doc-only (decay/field_value_factor/boost_factor/
+#              random/script-without-_score): the score_mode-combined value is one
+#              host-computed f32 row per segment (functions.combined_doc_rows),
+#              and the kernel applies max_boost/boost_mode/outer-boost/min_score.
+#   "script" — a single script_score that READS _score: the sandboxed AST is
+#              traced into the kernel (script.jax_vectorizer_cls) with _score
+#              bound to the dense sub-score array and doc columns as device rows.
+# Tail math is float32 in the same op order as functions.apply_functions, so host
+# and device scores are bit-identical for the rows case.
+
+
+def _bmode_combine(sub, comb, applied, bmode: str):
+    """boost_mode combine, float32, op-order-identical to apply_functions.
+    applied=None means every doc has a function applied (no filter)."""
+    import jax.numpy as jnp
+
+    if bmode == "multiply":
+        return sub * comb
+    if bmode == "replace":
+        return comb if applied is None else jnp.where(applied, comb, sub)
+    if bmode == "sum":
+        return sub + comb
+    if bmode == "avg":
+        return (sub + comb) / jnp.float32(2.0)
+    if bmode == "max":
+        return jnp.maximum(sub, comb)
+    if bmode == "min":
+        return jnp.minimum(sub, comb)
+    raise ValueError(f"unknown boost_mode [{bmode}]")
+
+
+def _fs_rows_impl(blk_docs, blk_freqs, live_parent, norms_stack, caches,
+                  qidx, blk, weight, fidx, group, tfmode, n_must, msm, coord,
+                  g_row, applies_row, max_boost, fboost, min_score,
+                  *, n_queries: int, k: int, doc_pad: int, bmode: str,
+                  use_min_score: bool, no_functions: bool):
+    import jax
+    import jax.numpy as jnp
+
+    Q = n_queries
+    scores, flat_idx, valid = _dense_accumulate(
+        blk_docs, blk_freqs, norms_stack, caches, qidx, blk, weight, fidx, group,
+        tfmode, Q=Q, doc_pad=doc_pad)
+    scores, match = _dense_semantics(scores, flat_idx, valid, group, live_parent,
+                                     n_must, msm, coord, Q=Q, doc_pad=doc_pad)
+    if no_functions:
+        out = scores * fboost
+    else:
+        applied = applies_row[None, :]
+        comb = jnp.where(applied, g_row[None, :], jnp.float32(1.0))
+        comb = jnp.minimum(comb, max_boost)
+        out = _bmode_combine(scores, comb, applied, bmode) * fboost
+    if use_min_score:
+        match = match & (out >= min_score)
+    masked = jnp.where(match, out, jnp.float32(-jnp.inf))
+    top_scores, top_docs = jax.lax.top_k(masked, k)
+    return top_scores, top_docs, match.sum(axis=1, dtype=jnp.int32)
+
+
+def _fs_script_impl(blk_docs, blk_freqs, live_parent, norms_stack, caches,
+                    qidx, blk, weight, fidx, group, tfmode, n_must, msm, coord,
+                    col_rows, fmask_row, bad_row, parent_row,
+                    weight_s, max_boost, fboost, min_score,
+                    *, n_queries: int, k: int, doc_pad: int, script,
+                    used_fields: tuple, bmode: str, use_min_score: bool,
+                    has_filter: bool, has_weight: bool):
+    import jax
+    import jax.numpy as jnp
+
+    from ..script import jax_vectorizer_cls
+
+    Q = n_queries
+    scores, flat_idx, valid = _dense_accumulate(
+        blk_docs, blk_freqs, norms_stack, caches, qidx, blk, weight, fidx, group,
+        tfmode, Q=Q, doc_pad=doc_pad)
+    scores, match = _dense_semantics(scores, flat_idx, valid, group, live_parent,
+                                     n_must, msm, coord, Q=Q, doc_pad=doc_pad)
+
+    cols = dict(zip(used_fields, col_rows))
+    vec = jax_vectorizer_cls()(script, lambda f: cols[f], scores)
+    val = jnp.broadcast_to(jnp.asarray(vec.vectorize(), jnp.float32), scores.shape)
+    if has_weight:
+        val = val * weight_s
+    applied = fmask_row[None, :] if has_filter else None
+    comb = val if applied is None else jnp.where(applied, val, jnp.float32(1.0))
+    comb = jnp.minimum(comb, max_boost)
+    out = _bmode_combine(scores, comb, applied, bmode) * fboost
+    if use_min_score:
+        match = match & (out >= min_score)
+    # host error semantics (functions.vectorized_script_eval): any parent doc whose
+    # used columns are missing or whose script value is non-finite would take the
+    # per-doc path (which may raise ScriptError) — flag the query so the caller
+    # reruns it on the host
+    bad = (bad_row[None, :] | (parent_row[None, :] & ~jnp.isfinite(val))).any(axis=1)
+    masked = jnp.where(match, out, jnp.float32(-jnp.inf))
+    top_scores, top_docs = jax.lax.top_k(masked, k)
+    return top_scores, top_docs, match.sum(axis=1, dtype=jnp.int32), bad
+
+
+def _get_fs_compiled(kind: str, n_queries: int, k: int, doc_pad: int, **statics):
+    import jax
+
+    if kind == "rows":
+        key = ("fs_rows", n_queries, k, doc_pad, tuple(sorted(statics.items())))
+        impl = _fs_rows_impl
+    else:
+        script = statics.pop("script")
+        key = ("fs_script", n_queries, k, doc_pad, script.source,
+               repr(sorted(script.params.items())),
+               tuple(sorted((k2, v) for k2, v in statics.items())))
+        impl = functools.partial(_fs_script_impl, script=script)
+    fn = _compiled_cache.get(key)
+    if fn is None:
+        def wrapper(*args):
+            return impl(*args, n_queries=n_queries, k=k, doc_pad=doc_pad, **statics)
+
+        fn = jax.jit(wrapper)
+        _compiled_cache[key] = fn
+    return fn
+
+
+def _stack_args(packed: PackedSegment, batch: TermBatch):
+    """Kernel ABI: the stacked norm-byte and cache tables every dense launch takes
+    (single construction site — the fallback shapes are load-bearing)."""
+    import jax.numpy as jnp
+
+    norms_stack = (
+        jnp.stack([packed.norm_bytes[f] for f in batch.norm_fields])
+        if batch.norm_fields
+        else jnp.zeros((1, packed.doc_pad), jnp.uint8)
+    )
+    caches = jnp.asarray(
+        batch.caches if batch.caches is not None else np.ones((1, 256), np.float32)
+    )
+    return norms_stack, caches
+
+
+def score_fs_rows_batch(packed: PackedSegment, batch: TermBatch, k: int,
+                        g_row, applies_row, max_boost: float, fboost: float,
+                        min_score, bmode: str, no_functions: bool):
+    """Dense launch with host-combined function rows; returns (scores, docs, total)
+    numpy [Q, k]/[Q]."""
+    import jax.numpy as jnp
+
+    norms_stack, caches = _stack_args(packed, batch)
+    fn = _get_fs_compiled(
+        "rows", batch.n_queries, min(k, packed.doc_pad), packed.doc_pad,
+        bmode=bmode, use_min_score=min_score is not None, no_functions=no_functions)
+    top_scores, top_docs, total = fn(
+        packed.blk_docs, packed.blk_freqs, packed.live_parent, norms_stack, caches,
+        jnp.asarray(batch.qidx), jnp.asarray(batch.blk), jnp.asarray(batch.weight),
+        jnp.asarray(batch.fidx), jnp.asarray(batch.group), jnp.asarray(batch.tfmode),
+        jnp.asarray(batch.n_must), jnp.asarray(batch.msm), jnp.asarray(batch.coord),
+        jnp.asarray(g_row, jnp.float32), jnp.asarray(applies_row, bool),
+        jnp.float32(max_boost), jnp.float32(fboost),
+        jnp.float32(min_score if min_score is not None else 0.0),
+    )
+    return np.asarray(top_scores), np.asarray(top_docs), np.asarray(total)
+
+
+def score_fs_script_batch(packed: PackedSegment, batch: TermBatch, k: int,
+                          script, used_fields: tuple, col_rows, fmask_row,
+                          bad_row, parent_row, weight, max_boost: float,
+                          fboost: float, min_score, bmode: str, has_filter: bool):
+    """Dense launch with the script traced into the kernel; returns
+    (scores, docs, total, bad) numpy."""
+    import jax.numpy as jnp
+
+    norms_stack, caches = _stack_args(packed, batch)
+    fn = _get_fs_compiled(
+        "script", batch.n_queries, min(k, packed.doc_pad), packed.doc_pad,
+        script=script, used_fields=used_fields, bmode=bmode,
+        use_min_score=min_score is not None, has_filter=has_filter,
+        has_weight=weight is not None)
+    top_scores, top_docs, total, bad = fn(
+        packed.blk_docs, packed.blk_freqs, packed.live_parent, norms_stack, caches,
+        jnp.asarray(batch.qidx), jnp.asarray(batch.blk), jnp.asarray(batch.weight),
+        jnp.asarray(batch.fidx), jnp.asarray(batch.group), jnp.asarray(batch.tfmode),
+        jnp.asarray(batch.n_must), jnp.asarray(batch.msm), jnp.asarray(batch.coord),
+        tuple(jnp.asarray(c, jnp.float32) for c in col_rows),
+        jnp.asarray(fmask_row, bool), jnp.asarray(bad_row, bool),
+        jnp.asarray(parent_row, bool),
+        jnp.float32(weight if weight is not None else 1.0),
+        jnp.float32(max_boost), jnp.float32(fboost),
+        jnp.float32(min_score if min_score is not None else 0.0),
+    )
+    return (np.asarray(top_scores), np.asarray(top_docs), np.asarray(total),
+            np.asarray(bad))
 
 
 def _detect_simple(batch: TermBatch) -> bool:
@@ -196,14 +416,7 @@ def score_term_batch_async(packed: PackedSegment, batch: TermBatch, k: int):
     import jax.numpy as jnp
 
     Q = batch.n_queries
-    norms_stack = (
-        jnp.stack([packed.norm_bytes[f] for f in batch.norm_fields])
-        if batch.norm_fields
-        else jnp.zeros((1, packed.doc_pad), jnp.uint8)
-    )
-    caches = jnp.asarray(
-        batch.caches if batch.caches is not None else np.ones((1, 256), np.float32)
-    )
+    norms_stack, caches = _stack_args(packed, batch)
     fn = _get_compiled(Q, min(k, packed.doc_pad), packed.doc_pad,
                        _detect_simple(batch))
     return fn(
@@ -220,14 +433,7 @@ def score_term_batch(packed: PackedSegment, batch: TermBatch, k: int) -> ScoreRe
     import jax.numpy as jnp
 
     Q = batch.n_queries
-    norms_stack = (
-        jnp.stack([packed.norm_bytes[f] for f in batch.norm_fields])
-        if batch.norm_fields
-        else jnp.zeros((1, packed.doc_pad), jnp.uint8)
-    )
-    caches = jnp.asarray(
-        batch.caches if batch.caches is not None else np.ones((1, 256), np.float32)
-    )
+    norms_stack, caches = _stack_args(packed, batch)
     fn = _get_compiled(Q, min(k, packed.doc_pad), packed.doc_pad,
                        _detect_simple(batch))
     top_scores, top_docs, total = fn(

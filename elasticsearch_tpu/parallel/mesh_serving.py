@@ -126,7 +126,10 @@ class MeshServingService:
             filt = query.filter
             query = query.query
         plan = lower_flat(query, ctx0)
-        if plan is None:
+        if plan is None or plan.fs is not None:
+            # function_score plans carry a device tail the mesh program doesn't
+            # express — transport path (which itself serves them on-device via
+            # execute_flat_batch's fs kernels)
             return None
         # one similarity family per program: every queried field must score with the
         # index default (per-field DFR/IB/etc lowered out already by lower_flat)

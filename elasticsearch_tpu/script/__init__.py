@@ -99,6 +99,12 @@ class ColumnVectorizer:
         ast.Eq: np.equal, ast.NotEq: np.not_equal, ast.Lt: np.less,
         ast.LtE: np.less_equal, ast.Gt: np.greater, ast.GtE: np.greater_equal,
     }
+    # array primitives as class attrs so JaxVectorizer can swap in jnp and reuse
+    # the exact same AST walk (one lowering, two backends)
+    _where = staticmethod(np.where)
+    _isnan = staticmethod(np.isnan)
+    _negative = staticmethod(np.negative)
+    _logical_not = staticmethod(np.logical_not)
 
     def __init__(self, script: "CompiledScript", columns, scores):
         """columns: field name -> float64[D] (NaN = missing); scores: float[D]."""
@@ -139,11 +145,11 @@ class ColumnVectorizer:
         if isinstance(node, ast.UnaryOp):
             v = self._visit(node.operand)
             if isinstance(node.op, ast.USub):
-                return np.negative(v)
+                return self._negative(v)
             if isinstance(node.op, ast.UAdd):
                 return v
             if isinstance(node.op, ast.Not):
-                return np.logical_not(v)
+                return self._logical_not(v)
             raise _NotVectorizable
         if isinstance(node, ast.Compare) and len(node.ops) == 1 \
                 and type(node.ops[0]) in self._CMPOPS:
@@ -155,12 +161,12 @@ class ColumnVectorizer:
             out = vals[0]
             for v in vals[1:]:
                 truthy = out != 0
-                out = np.where(truthy, v, out) if isinstance(node.op, ast.And) \
-                    else np.where(truthy, out, v)
+                out = self._where(truthy, v, out) if isinstance(node.op, ast.And) \
+                    else self._where(truthy, out, v)
             return out
         if isinstance(node, ast.IfExp):
-            return np.where(self._visit(node.test), self._visit(node.body),
-                            self._visit(node.orelse))
+            return self._where(self._visit(node.test), self._visit(node.body),
+                               self._visit(node.orelse))
         if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
                 and node.func.id in self._FUNCS and not node.keywords \
                 and node.func.id not in self.script.params:  # params shadow funcs
@@ -181,12 +187,88 @@ class ColumnVectorizer:
                 if col is None:
                     raise _NotVectorizable
                 self.used_fields.add(str(sub.slice.value))
-                return np.isnan(col) if node.attr == "empty" else col
+                return self._isnan(col) if node.attr == "empty" else col
         raise _NotVectorizable
 
 
 class _NotVectorizable(Exception):
     pass
+
+
+_jax_vectorizer_cls = None
+
+
+def jax_vectorizer_cls():
+    """The jnp twin of ColumnVectorizer — same AST walk, jax.numpy primitives.
+
+    Used under `jit` tracing: the walk runs once at trace time and emits the
+    script as fused XLA ops with `_score` bound to the dense device score array
+    and doc columns bound to device-resident rows. This is SURVEY §7's "compiled
+    expression subset that lowers to XLA" (the device tier; ColumnVectorizer is
+    the host tier)."""
+    global _jax_vectorizer_cls
+    if _jax_vectorizer_cls is None:
+        import jax.numpy as jnp
+
+        class JaxVectorizer(ColumnVectorizer):
+            _FUNCS = {
+                "abs": jnp.abs, "sqrt": jnp.sqrt, "log": jnp.log,
+                "log10": jnp.log10, "exp": jnp.exp, "floor": jnp.floor,
+                "ceil": jnp.ceil, "sin": jnp.sin, "cos": jnp.cos,
+                "tan": jnp.tan, "round": jnp.round, "pow": jnp.power,
+                "min": jnp.minimum, "max": jnp.maximum,
+            }
+            _BINOPS = {
+                ast.Add: jnp.add, ast.Sub: jnp.subtract, ast.Mult: jnp.multiply,
+                ast.Div: jnp.divide, ast.FloorDiv: jnp.floor_divide,
+                ast.Mod: jnp.mod, ast.Pow: jnp.power,
+            }
+            _CMPOPS = {
+                ast.Eq: jnp.equal, ast.NotEq: jnp.not_equal, ast.Lt: jnp.less,
+                ast.LtE: jnp.less_equal, ast.Gt: jnp.greater,
+                ast.GtE: jnp.greater_equal,
+            }
+            _where = staticmethod(jnp.where)
+            _isnan = staticmethod(jnp.isnan)
+            _negative = staticmethod(jnp.negative)
+            _logical_not = staticmethod(jnp.logical_not)
+
+            def vectorize(self):
+                # no errstate / no exception swallowing: under jit tracing a
+                # failure must propagate so the caller can fall back BEFORE
+                # compiling a wrong program
+                return self._visit(self.script.tree.body)
+
+        _jax_vectorizer_cls = JaxVectorizer
+    return _jax_vectorizer_cls
+
+
+def script_uses_score(script: "CompiledScript") -> bool:
+    """True if the script reads `_score` (params shadow it, mirroring the eval
+    env construction in CompiledScript.__call__)."""
+    if "_score" in script.params:
+        return False
+    return any(isinstance(n, ast.Name) and n.id == "_score"
+               for n in ast.walk(script.tree))
+
+
+def script_vector_info(script: "CompiledScript") -> tuple[bool, tuple]:
+    """(vectorizable, used_fields) — probed once with dummy 2-element columns and
+    cached on the CompiledScript (compile_script caches those, so classification
+    at lower time and execution share one probe). The subsets of ColumnVectorizer
+    and JaxVectorizer are identical by construction (same walk, parallel op
+    tables)."""
+    info = getattr(script, "_vector_info", None)
+    if info is None:
+        probe = ColumnVectorizer(script, lambda f: np.zeros(2), np.zeros(2))
+        ok = probe.vectorize() is not None
+        info = (ok, tuple(sorted(probe.used_fields)))
+        script._vector_info = info
+    return info
+
+
+def script_vectorizable(script: "CompiledScript") -> bool:
+    return script_vector_info(script)[0]
 
 
 SUPPORTED_LANGS = {None, "mvel", "expression", "native", "python"}

@@ -157,6 +157,14 @@ class FlatPlan:
     coord_enabled: bool
     boost: float
     query_norm: float = 1.0
+    # function_score plans: the wrapping FunctionScoreQuery (kernel applies the
+    # function tail), the original query (host rerun on script-badness fallback),
+    # and the outer boost — which participates in the TF-IDF queryNorm pre-pass
+    # (execute._weight_prepass walks through FunctionScoreQuery with the outer
+    # boost folded in) but NOT in the sub-query clause weights
+    fs: object = None  # FunctionScoreQuery | None (also the host-fallback query)
+    fs_kind: str | None = None  # "rows" | "script" (classified at lower time)
+    norm_boost: float = 1.0
 
 
 # ---------------------------------------------------------------------------
@@ -269,6 +277,48 @@ def _lower_flat_inner(query: Query, ctx: ShardContext) -> FlatPlan | None:
         coord = not query.disable_coord and n_scoring > 1
         return FlatPlan(clauses, msm=msm, n_must=n_must, coord_enabled=coord,
                         boost=query.boost)
+    if isinstance(query, FunctionScoreQuery):
+        # device function_score: sub query must lower flat, and the functions must
+        # classify as "rows" or "script" (see _classify_fs); the function tail is
+        # fused into the dense kernel (ops/scoring._fs_rows_impl/_fs_script_impl,
+        # ref: common/lucene/search/function/FunctionScoreQuery.java)
+        if query.query is None:
+            return None
+        sub = _lower_flat_inner(query.query, ctx)
+        if sub is None or sub.fs is not None:
+            return None
+        kind = _classify_fs(query)
+        if kind is None:
+            return None
+        return FlatPlan(sub.clauses, msm=sub.msm, n_must=sub.n_must,
+                        coord_enabled=sub.coord_enabled, boost=sub.boost,
+                        fs=query, fs_kind=kind, norm_boost=query.boost)
+    return None
+
+
+def _classify_fs(q: FunctionScoreQuery):
+    """Device eligibility for a function_score spec:
+      "rows"   — no function reads _score: values fold to host-combined f32 rows
+      "script" — exactly one function, a _score-reading script_score inside the
+                 vectorizable AST subset: traced into the kernel
+      None     — host path."""
+    from ..common.errors import ScriptError
+    from ..script import compile_script, script_uses_score, script_vectorizable
+
+    score_readers = 0
+    for sf in q.functions:
+        if sf.kind == "script_score":
+            try:
+                cs = compile_script(sf.script, sf.params)
+            except ScriptError:
+                return None
+            if script_uses_score(cs):
+                score_readers += 1
+    if score_readers == 0:
+        return "rows"
+    if score_readers == 1 and len(q.functions) == 1 and script_vectorizable(
+            compile_script(q.functions[0].script, q.functions[0].params)):
+        return "script"
     return None
 
 
@@ -315,7 +365,7 @@ def finalize_flat(plan: FlatPlan, ctx: ShardContext):
             w = np.float32(idf * idf * c.boost * plan.boost)  # queryNorm folded later
             mode = MODE_TFIDF
         if c.group != GROUP_MUST_NOT:
-            ssw += float((idf * c.boost * plan.boost) ** 2)
+            ssw += float((idf * c.boost * plan.boost * plan.norm_boost) ** 2)
         resolved.append((c.field, c.term, float(w), fi, c.group, mode, df))
     qn = 1.0
     if isinstance(ctx.default_similarity, TFIDFSimilarity) and ssw > 0:
@@ -336,6 +386,65 @@ def finalize_flat(plan: FlatPlan, ctx: ShardContext):
 
 
 def execute_flat_batch(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list[TopDocs]:
+    """Run a batch of flat plans through the device kernels. Plain plans ride the
+    sparse candidate-centric path; function_score plans are grouped by spec and
+    ride the dense kernel with the function tail fused in (_execute_flat_fs)."""
+    if all(p.fs is None for p in plans):
+        return _execute_flat_plain(plans, ctx, k)
+    out: list[TopDocs | None] = [None] * len(plans)
+    plain_idx = [i for i, p in enumerate(plans) if p.fs is None]
+    if plain_idx:
+        for i, td in zip(plain_idx,
+                         _execute_flat_plain([plans[i] for i in plain_idx], ctx, k)):
+            out[i] = td
+    groups: dict = {}
+    for i, p in enumerate(plans):
+        if p.fs is not None:
+            groups.setdefault(_fs_group_key(p.fs), []).append(i)
+    for idxs in groups.values():
+        for i, td in zip(idxs, _execute_flat_fs([plans[i] for i in idxs], ctx, k)):
+            out[i] = td
+    return out  # type: ignore[return-value]
+
+
+def _fs_group_key(fsq) -> tuple:
+    """Queries whose function_score spec is VALUE-identical share kernel launches
+    (the spec's scalars are baked per launch). Dataclass reprs are content reprs."""
+    return (repr(fsq.functions), fsq.score_mode, fsq.boost_mode, fsq.max_boost,
+            fsq.min_score, fsq.boost)
+
+
+def _assemble_batch(plans: list[FlatPlan], finals: list):
+    """Field/cache tables + per-query bool-semantics arrays for a batch of
+    finalized plans — single construction site for both the plain and the
+    function_score batch paths (the coord padding rule is kernel ABI)."""
+    Q = len(plans)
+    all_fields: list[str] = []
+    field_idx: dict[str, int] = {}
+    cache_rows: list[np.ndarray] = []
+    for (_resolved, fields, caches, _coord) in finals:
+        for i, f in enumerate(fields):
+            if f not in field_idx:
+                field_idx[f] = len(all_fields)
+                all_fields.append(f)
+                cache_rows.append(caches[i])
+    caches_stack = np.stack(cache_rows) if cache_rows else np.ones((1, 256), np.float32)
+    max_clauses = max(1, max(
+        (sum(1 for c in p.clauses if c.group != GROUP_MUST_NOT) for p in plans),
+        default=1))
+    coord_tbl = np.ones((Q, max_clauses + 1), dtype=np.float32)
+    n_must = np.zeros(Q, np.int32)
+    msm = np.zeros(Q, np.int32)
+    for qi, (plan, (_resolved, _fields, _caches, coord)) in enumerate(zip(plans, finals)):
+        coord_tbl[qi, : len(coord)] = coord
+        if len(coord) <= max_clauses:
+            coord_tbl[qi, len(coord):] = coord[-1]
+        n_must[qi] = plan.n_must
+        msm[qi] = plan.msm
+    return all_fields, field_idx, cache_rows, caches_stack, coord_tbl, n_must, msm
+
+
+def _execute_flat_plain(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list[TopDocs]:
     """Run a batch of flat plans through the device kernels, per-segment launches,
     then merge per-segment top-k host-side (score desc, global doc asc — Lucene order).
 
@@ -348,32 +457,13 @@ def execute_flat_batch(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list
 
     Q = len(plans)
     finals = [finalize_flat(p, ctx) for p in plans]
-    all_fields: list[str] = []
-    field_idx: dict[str, int] = {}
-    cache_rows: list[np.ndarray] = []
-    for (resolved, fields, caches, _coord) in finals:
-        for i, f in enumerate(fields):
-            if f not in field_idx:
-                field_idx[f] = len(all_fields)
-                all_fields.append(f)
-                cache_rows.append(caches[i])
-    caches_stack = np.stack(cache_rows) if cache_rows else np.ones((1, 256), np.float32)
+    (all_fields, field_idx, cache_rows, caches_stack,
+     coord_tbl, n_must, msm) = _assemble_batch(plans, finals)
     tfn_tables = {
         f: (TFN_BM25 if isinstance(ctx.similarity_for(f), BM25Similarity)
             else TFN_TFIDF, cache_rows[field_idx[f]])
         for f in all_fields
     }
-    max_clauses = max(1, max(
-        (sum(1 for c in p.clauses if c.group != GROUP_MUST_NOT) for p in plans), default=1))
-    coord_tbl = np.ones((Q, max_clauses + 1), dtype=np.float32)
-    n_must = np.zeros(Q, np.int32)
-    msm = np.zeros(Q, np.int32)
-    for qi, (plan, (resolved, fields, caches, coord)) in enumerate(zip(plans, finals)):
-        coord_tbl[qi, : len(coord)] = coord
-        if len(coord) <= max_clauses:
-            coord_tbl[qi, len(coord):] = coord[-1]
-        n_must[qi] = plan.n_must
-        msm[qi] = plan.msm
     # zero-df clauses (w=0, no postings anywhere) can't affect results — don't let
     # them demote the batch off the simple fast path
     simple = bool(
@@ -408,11 +498,17 @@ def execute_flat_batch(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list
         gdocs = np.where(valid, docs.astype(np.int64) + base, np.int64(2**62))
         seg_hits.append((np.where(valid, scores, -np.inf), gdocs))
 
-    out = []
+    return _merge_seg_hits(seg_hits, totals, Q, k)
+
+
+def _merge_seg_hits(seg_hits, totals, Q: int, k: int) -> list[TopDocs]:
+    """Cross-segment top-k merge: score desc, global doc asc — the Lucene
+    tie-break order (single site; shared by the plain and function_score paths)."""
     if not seg_hits:
         return [TopDocs(total=0, hits=[], max_score=float("nan")) for _ in range(Q)]
     all_scores = np.concatenate([s for (s, _d) in seg_hits], axis=1)
     all_docs = np.concatenate([d for (_s, d) in seg_hits], axis=1)
+    out = []
     for qi in range(Q):
         order = np.lexsort((all_docs[qi], -all_scores[qi]))[:k]
         hits = [(float(all_scores[qi, j]), int(all_docs[qi, j]))
@@ -425,27 +521,38 @@ def execute_flat_batch(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list
     return out
 
 
-def _dense_fallback(overflow, finals, field_idx, all_fields, caches_stack,
-                    n_must, msm, coord_tbl, packed, seg, k,
-                    scores, docs, tq, build_term_batch, score_term_batch):
-    """Score overflow queries (block count past the sparse planner's tb_max) with the
-    dense scatter kernel; writes results into the sparse output arrays in place."""
+def _ensure_norm_rows(packed, all_fields):
+    """The dense kernel's norms_stack gathers a row per queried field — zero-fill
+    rows for fields this segment never indexed."""
     import jax.numpy as jnp
 
     for f in all_fields:
         if f not in packed.norm_bytes:
             packed.norm_bytes[f] = jnp.zeros(packed.doc_pad, dtype=jnp.uint8)
-    remap = {qi: i for i, qi in enumerate(overflow)}
+
+
+def _dense_entries(finals, seg, packed, field_idx) -> list:
+    """(qidx, block_row, weight, fidx, group, mode) triples for the dense kernel,
+    qidx = position in `finals`."""
     entries = []
-    for qi in overflow:
-        (resolved, _f, _c, _coord) = finals[qi]
+    for qi, (resolved, _f, _c, _coord) in enumerate(finals):
         for (f, t, w, _fi, g, mode, df) in resolved:
             tid = seg.term_id(f, t)
             if tid is None:
                 continue
             b0, b1 = packed.blocks_for_term(tid)
             for b in range(b0, b1):
-                entries.append((remap[qi], b, w, field_idx[f], g, mode))
+                entries.append((qi, b, w, field_idx[f], g, mode))
+    return entries
+
+
+def _dense_fallback(overflow, finals, field_idx, all_fields, caches_stack,
+                    n_must, msm, coord_tbl, packed, seg, k,
+                    scores, docs, tq, build_term_batch, score_term_batch):
+    """Score overflow queries (block count past the sparse planner's tb_max) with the
+    dense scatter kernel; writes results into the sparse output arrays in place."""
+    _ensure_norm_rows(packed, all_fields)
+    entries = _dense_entries([finals[qi] for qi in overflow], seg, packed, field_idx)
     if not entries:
         return
     sub = np.asarray(overflow, dtype=np.int64)
@@ -459,6 +566,113 @@ def _dense_fallback(overflow, finals, field_idx, all_fields, caches_stack,
     scores[sub, kk:] = -np.inf
     docs[sub, kk:] = packed.doc_pad
     tq[sub] = res.total_hits
+
+
+_FS_CHUNK = 256  # dense accumulator is O(Q·doc_pad) — bound the launch width
+
+
+def _execute_flat_fs(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list[TopDocs]:
+    """Execute a group of function_score plans sharing ONE spec (see _fs_group_key)
+    through the dense kernel with the function tail fused in.
+
+    "rows": the spec's doc-only function values are host-combined once per segment
+    (functions.combined_doc_rows — float32, bit-identical to the host tail) and
+    shipped as a row. "script": the single _score-reading script is traced into
+    the kernel; queries flagged bad (missing columns / non-finite values on parent
+    docs) rerun on the host so error semantics are preserved."""
+    from ..common.errors import ScriptError
+    from ..ops.device_index import packed_for
+    from ..ops.scoring import (build_term_batch, score_fs_rows_batch,
+                               score_fs_script_batch)
+    from ..script import compile_script, script_vector_info
+    from .functions import _column_first_value, combined_doc_rows
+    from .filters import segment_mask
+
+    if len(plans) > _FS_CHUNK:
+        out: list[TopDocs] = []
+        for start in range(0, len(plans), _FS_CHUNK):
+            out.extend(_execute_flat_fs(plans[start: start + _FS_CHUNK], ctx, k))
+        return out
+
+    fsq = plans[0].fs
+    kind = plans[0].fs_kind  # classified once at lower time
+    Q = len(plans)
+    finals = [finalize_flat(p, ctx) for p in plans]
+    (all_fields, field_idx, _cache_rows, caches_stack,
+     coord_tbl, n_must, msm) = _assemble_batch(plans, finals)
+
+    script = used_fields = sf = None
+    if kind == "script":
+        sf = fsq.functions[0]
+        script = compile_script(sf.script, sf.params)
+        used_fields = script_vector_info(script)[1]
+
+    host_idx: set[int] = set()
+    totals = np.zeros(Q, dtype=np.int64)
+    seg_hits = []
+    try:
+        for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
+            packed = packed_for(seg)
+            _ensure_norm_rows(packed, all_fields)
+            entries = _dense_entries(finals, seg, packed, field_idx)
+            batch = build_term_batch(entries, Q, n_must, msm, coord_tbl,
+                                     list(all_fields), caches_stack,
+                                     nb_pad_row=packed.blk_docs.shape[0] - 1)
+            D, doc_pad = seg.doc_count, packed.doc_pad
+            if kind == "rows":
+                if fsq.functions:
+                    g_seg, applies_seg = combined_doc_rows(
+                        fsq, np.zeros(D, np.float32), seg, ctx)
+                else:
+                    g_seg = np.ones(D, np.float32)
+                    applies_seg = np.zeros(D, bool)
+                g_row = np.ones(doc_pad, np.float32)
+                g_row[:D] = g_seg
+                applies_row = np.zeros(doc_pad, bool)
+                applies_row[:D] = applies_seg
+                scores, docs, tq = score_fs_rows_batch(
+                    packed, batch, k, g_row, applies_row, fsq.max_boost, fsq.boost,
+                    fsq.min_score, fsq.boost_mode,
+                    no_functions=not fsq.functions)
+            else:
+                col_rows = []
+                colmiss = np.zeros(D, bool)
+                for f in used_fields:
+                    col = _column_first_value(seg, f)
+                    colmiss |= np.isnan(col)
+                    row = np.full(doc_pad, np.nan, np.float32)
+                    row[:D] = col.astype(np.float32)
+                    col_rows.append(row)
+                parent_row = np.zeros(doc_pad, bool)
+                parent_row[:D] = seg.parent_mask
+                bad_row = np.zeros(doc_pad, bool)
+                bad_row[:D] = seg.parent_mask & colmiss
+                if sf.filter is not None:
+                    fmask_row = np.zeros(doc_pad, bool)
+                    fmask_row[:D] = segment_mask(seg, sf.filter, ctx)
+                else:
+                    fmask_row = np.zeros(doc_pad, bool)
+                scores, docs, tq, bad = score_fs_script_batch(
+                    packed, batch, k, script, used_fields, col_rows, fmask_row,
+                    bad_row, parent_row, sf.weight, fsq.max_boost, fsq.boost,
+                    fsq.min_score, fsq.boost_mode, has_filter=sf.filter is not None)
+                host_idx.update(int(qi) for qi in np.nonzero(bad)[0])
+            totals += tq
+            valid = (docs < min(doc_pad, D)) & np.isfinite(scores)
+            gdocs = np.where(valid, docs.astype(np.int64) + base, np.int64(2**62))
+            seg_hits.append((np.where(valid, scores, -np.inf), gdocs))
+    except ScriptError:
+        # a host-side per-doc evaluation raised while building rows — the host
+        # path is authoritative for error semantics; rerun the whole group there
+        host_idx = set(range(Q))
+        seg_hits = []
+
+    merged = _merge_seg_hits(seg_hits, totals, Q, k)
+    return [
+        _host_search(ctx, plans[qi].fs, k) if (qi in host_idx or not seg_hits)
+        else merged[qi]
+        for qi in range(Q)
+    ]
 
 
 # ---------------------------------------------------------------------------

@@ -202,15 +202,19 @@ def evaluate_function(sf, seg, ctx, sub_scores: np.ndarray) -> np.ndarray:
     raise QueryParsingError(f"unknown score function [{sf.kind}]")
 
 
-def apply_functions(q, sub_scores: np.ndarray, match: np.ndarray, seg, ctx) -> np.ndarray:
-    """Combine function values with the subquery score (score_mode × boost_mode)."""
+def combined_doc_rows(q, sub_scores: np.ndarray, seg, ctx):
+    """score_mode-combined function values + applies mask: (float32[D], bool[D]).
+
+    The per-doc part of function_score — everything up to (but excluding) the
+    no-function default, max_boost cap and boost_mode. Shared by the host tail
+    (apply_functions) and the device factor-row builder
+    (execute._execute_flat_fs): all math is float32 so the two paths are
+    bit-identical."""
     D = seg.doc_count
-    if not q.functions:
-        return sub_scores.astype(np.float32)
     vals: list[np.ndarray] = []
     masks: list[np.ndarray] = []
     for sf in q.functions:
-        v = evaluate_function(sf, seg, ctx, sub_scores)
+        v = evaluate_function(sf, seg, ctx, sub_scores).astype(np.float32)
         if sf.weight is not None:
             v = v * np.float32(sf.weight)
         fmask = segment_mask(seg, sf.filter, ctx) if sf.filter is not None else None
@@ -219,21 +223,24 @@ def apply_functions(q, sub_scores: np.ndarray, match: np.ndarray, seg, ctx) -> n
     stacked = np.stack(vals)
     mstack = np.stack(masks)
     any_applies = mstack.any(axis=0)
+    one = np.float32(1.0)
     if q.score_mode == "multiply":
-        combined = np.where(mstack, stacked, 1.0).prod(axis=0)
+        combined = np.where(mstack, stacked, one).prod(axis=0, dtype=np.float32)
     elif q.score_mode == "sum":
-        combined = np.where(mstack, stacked, 0.0).sum(axis=0)
+        combined = np.where(mstack, stacked, np.float32(0.0)).sum(
+            axis=0, dtype=np.float32)
     elif q.score_mode == "avg":
         cnt = mstack.sum(axis=0)
-        combined = np.where(cnt > 0, np.where(mstack, stacked, 0.0).sum(axis=0) / np.maximum(cnt, 1), 1.0)
+        s = np.where(mstack, stacked, np.float32(0.0)).sum(axis=0, dtype=np.float32)
+        combined = np.where(cnt > 0, s / np.maximum(cnt, 1).astype(np.float32), one)
     elif q.score_mode == "max":
-        combined = np.where(mstack, stacked, -np.inf).max(axis=0)
-        combined = np.where(np.isfinite(combined), combined, 1.0)
+        combined = np.where(mstack, stacked, np.float32(-np.inf)).max(axis=0)
+        combined = np.where(np.isfinite(combined), combined, one)
     elif q.score_mode == "min":
-        combined = np.where(mstack, stacked, np.inf).min(axis=0)
-        combined = np.where(np.isfinite(combined), combined, 1.0)
+        combined = np.where(mstack, stacked, np.float32(np.inf)).min(axis=0)
+        combined = np.where(np.isfinite(combined), combined, one)
     elif q.score_mode == "first":
-        combined = np.ones(D, dtype=np.float64)
+        combined = np.ones(D, dtype=np.float32)
         chosen = np.zeros(D, dtype=bool)
         for v, m in zip(vals, masks):
             take = m & ~chosen
@@ -241,9 +248,20 @@ def apply_functions(q, sub_scores: np.ndarray, match: np.ndarray, seg, ctx) -> n
             chosen |= m
     else:
         raise QueryParsingError(f"unknown score_mode [{q.score_mode}]")
-    combined = np.where(any_applies, combined, 1.0)
+    return combined.astype(np.float32), any_applies
+
+
+def apply_functions(q, sub_scores: np.ndarray, match: np.ndarray, seg, ctx) -> np.ndarray:
+    """Combine function values with the subquery score (score_mode × boost_mode).
+    Float32 throughout — in bit-lockstep with the device kernel's fs tail
+    (ops/scoring._fs_tail)."""
+    if not q.functions:
+        return sub_scores.astype(np.float32)
+    combined, any_applies = combined_doc_rows(q, sub_scores, seg, ctx)
+    sub_scores = sub_scores.astype(np.float32)
+    combined = np.where(any_applies, combined, np.float32(1.0))
     if math.isfinite(q.max_boost):
-        combined = np.minimum(combined, q.max_boost)
+        combined = np.minimum(combined, np.float32(q.max_boost))
     bm = q.boost_mode
     if bm == "multiply":
         out = sub_scores * combined
@@ -252,7 +270,7 @@ def apply_functions(q, sub_scores: np.ndarray, match: np.ndarray, seg, ctx) -> n
     elif bm == "sum":
         out = sub_scores + combined
     elif bm == "avg":
-        out = (sub_scores + combined) / 2.0
+        out = (sub_scores + combined) / np.float32(2.0)
     elif bm == "max":
         out = np.maximum(sub_scores, combined)
     elif bm == "min":

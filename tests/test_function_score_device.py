@@ -1,0 +1,301 @@
+"""Device-path function_score: differential tests vs the host scorer.
+
+Every case runs the SAME query through search_shard(use_device=True) — which lowers
+function_score onto the dense device kernel (ops/scoring._fs_rows_impl /
+_fs_script_impl) — and through the host path, asserting identical totals, hit
+ordering and scores. The rows case is bit-identical by construction (float32
+lockstep, functions.combined_doc_rows shared); the script case is compared at 5
+decimals (f32 device vs f64-then-cast host evaluation).
+
+ref: index/query/functionscore/FunctionScoreQueryParser.java,
+common/lucene/search/function/FunctionScoreQuery.java; SURVEY §7 hard-parts
+("compiled expression subset that lowers to XLA").
+"""
+
+from __future__ import annotations
+
+import tempfile
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import ScriptError
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.mapper.core import MapperService
+from elasticsearch_tpu.search import ShardContext, parse_query
+from elasticsearch_tpu.search.execute import lower_flat, search_shard
+from elasticsearch_tpu.search.similarity import SimilarityService
+
+WORDS = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta", "theta"]
+
+
+def _build(similarity: str):
+    tmp = tempfile.mkdtemp()
+    settings = Settings.from_flat({"index.similarity.default.type": similarity})
+    svc = MapperService(settings)
+    eng = Engine(tmp, svc)
+    rng = np.random.default_rng(42)
+    for i in range(400):
+        doc = {
+            "body": " ".join(rng.choice(WORDS, size=6)),
+            "pop": int(rng.integers(1, 200)),
+            "price": float(np.round(rng.uniform(1, 60), 2)),
+            "ts": f"2014-01-{int(rng.integers(1, 28)):02d}",
+        }
+        if i % 7 == 0:
+            del doc["pop"]  # missing column
+        if i % 11 == 0:
+            doc["zero"] = 0
+        eng.index("doc", str(i), doc)
+        if i == 199:
+            eng.refresh()  # force a second segment
+    # tombstones interact with live/parent masks in both kernels
+    for i in (3, 77, 140, 301):
+        eng.delete("doc", str(i))
+    eng.refresh()
+    ctx = ShardContext(eng.acquire_searcher(), svc,
+                       SimilarityService(settings, mapper_service=svc))
+    return eng, ctx
+
+
+@pytest.fixture(scope="module")
+def bm25():
+    eng, ctx = _build("BM25")
+    yield ctx
+    eng.close()
+
+
+@pytest.fixture(scope="module")
+def tfidf():
+    eng, ctx = _build("default")
+    yield ctx
+    eng.close()
+
+
+def _parity(ctx, qd, k=10, expect_device=True, places=None):
+    q = parse_query(qd)
+    plan = lower_flat(q, ctx)
+    if expect_device:
+        assert plan is not None and plan.fs is not None, f"not device-lowered: {qd}"
+    dev = search_shard(ctx, q, k, use_device=True)
+    host = search_shard(ctx, q, k, use_device=False)
+    assert dev.total == host.total
+    if places is None:  # rows case: float32 lockstep → exact
+        assert dev.hits == host.hits
+    else:
+        assert [d for _s, d in dev.hits] == [d for _s, d in host.hits]
+        for (ds, _), (hs, _) in zip(dev.hits, host.hits):
+            assert ds == pytest.approx(hs, rel=10 ** -places)
+    return dev
+
+
+# ---------------------------------------------------------------------------
+# rows case: doc-only functions (bit-identical to host)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", ["gauss", "exp", "linear"])
+def test_decay_numeric(bm25, kind):
+    _parity(bm25, {"function_score": {
+        "query": {"match": {"body": "alpha beta"}},
+        "functions": [{kind: {"price": {"origin": 25, "scale": 10,
+                                        "offset": 2, "decay": 0.4}}}]}})
+
+
+def test_decay_date(bm25):
+    _parity(bm25, {"function_score": {
+        "query": {"match": {"body": "gamma"}},
+        "functions": [{"gauss": {"ts": {"origin": "2014-01-15", "scale": "7d"}}}]}})
+
+
+@pytest.mark.parametrize("mod", ["none", "log1p", "log2p", "ln1p", "ln2p",
+                                 "square", "sqrt", "reciprocal"])
+def test_field_value_factor_modifiers(bm25, mod):
+    _parity(bm25, {"function_score": {
+        "query": {"match": {"body": "delta"}},
+        "field_value_factor": {"field": "pop", "factor": 1.3, "modifier": mod,
+                               "missing": 2}}})
+
+
+def test_boost_factor_with_filter(bm25):
+    _parity(bm25, {"function_score": {
+        "query": {"match": {"body": "alpha beta gamma"}},
+        "functions": [
+            {"filter": {"range": {"pop": {"gte": 100}}}, "boost_factor": 3},
+            {"filter": {"term": {"body": "zeta"}}, "boost_factor": 0.5},
+        ]}})
+
+
+def test_random_score_seeded(bm25):
+    _parity(bm25, {"function_score": {
+        "query": {"match": {"body": "epsilon"}},
+        "functions": [{"random_score": {"seed": 1234}}]}})
+
+
+@pytest.mark.parametrize("sm", ["multiply", "sum", "avg", "max", "min", "first"])
+def test_score_modes(bm25, sm):
+    _parity(bm25, {"function_score": {
+        "query": {"match": {"body": "alpha"}},
+        "functions": [
+            {"filter": {"range": {"price": {"lte": 30}}},
+             "gauss": {"price": {"origin": 10, "scale": 15}}},
+            {"filter": {"range": {"pop": {"gte": 50}}}, "boost_factor": 2,
+             "weight": 1.5},
+        ],
+        "score_mode": sm}})
+
+
+@pytest.mark.parametrize("bm", ["multiply", "replace", "sum", "avg", "max", "min"])
+def test_boost_modes(bm25, bm):
+    _parity(bm25, {"function_score": {
+        "query": {"match": {"body": "beta gamma"}},
+        "functions": [{"filter": {"range": {"pop": {"gte": 80}}},
+                       "field_value_factor": {"field": "pop", "modifier": "ln2p"}}],
+        "boost_mode": bm}})
+
+
+def test_max_boost_and_outer_boost(bm25):
+    _parity(bm25, {"function_score": {
+        "query": {"match": {"body": "alpha delta"}},
+        "functions": [{"field_value_factor": {"field": "pop", "missing": 1}}],
+        "max_boost": 5.0, "boost": 2.5}})
+
+
+def test_min_score_gates_total(bm25):
+    q = {"function_score": {
+        "query": {"match": {"body": "alpha"}},
+        "functions": [{"gauss": {"price": {"origin": 25, "scale": 8}}}],
+        "min_score": 0.8}}
+    dev = _parity(bm25, q)
+    loose = search_shard(bm25, parse_query(
+        {"function_score": q["function_score"]["query"] and {
+            "query": q["function_score"]["query"],
+            "functions": q["function_score"]["functions"]}}), 10)
+    assert dev.total < loose.total  # min_score really trims matches
+
+
+def test_empty_functions_min_score_only(bm25):
+    _parity(bm25, {"function_score": {
+        "query": {"match": {"body": "alpha beta"}},
+        "min_score": 0.3, "boost_mode": "sum"}})
+
+
+def test_weight_only_function(bm25):
+    _parity(bm25, {"function_score": {
+        "query": {"match": {"body": "zeta"}},
+        "functions": [{"weight": 4.0, "filter": {"range": {"pop": {"gte": 20}}}}]}})
+
+
+def test_doc_only_script_rides_rows(bm25):
+    # script_score that never reads _score folds into the host-combined row
+    _parity(bm25, {"function_score": {
+        "query": {"match": {"body": "eta"}},
+        "script_score": {"script": "log(2 + doc['price'].value)"}}})
+
+
+def test_tfidf_coord_querynorm_interplay(tfidf):
+    # outer boost participates in queryNorm (prepass) but not sub scores
+    _parity(tfidf, {"function_score": {
+        "query": {"bool": {"should": [{"term": {"body": "alpha"}},
+                                      {"term": {"body": "beta"}},
+                                      {"term": {"body": "gamma"}}]}},
+        "functions": [{"gauss": {"price": {"origin": 20, "scale": 12}}}],
+        "boost": 1.7}})
+
+
+# ---------------------------------------------------------------------------
+# script case: _score-reading scripts traced into the kernel
+# ---------------------------------------------------------------------------
+
+
+def test_script_score_basic(bm25):
+    _parity(bm25, {"function_score": {
+        "query": {"match": {"body": "gamma delta"}},
+        "script_score": {"script": "_score * log(2 + doc['price'].value)"}}},
+        places=5)
+
+
+def test_script_score_params_and_weight(bm25):
+    _parity(bm25, {"function_score": {
+        "query": {"match": {"body": "alpha"}},
+        "functions": [{"script_score": {
+            "script": "_score * factor + doc['price'].value / divisor",
+            "params": {"factor": 2.5, "divisor": 10}}, "weight": 1.25}],
+        "boost_mode": "replace"}}, places=5)
+
+
+def test_script_score_with_filter(bm25):
+    _parity(bm25, {"function_score": {
+        "query": {"match": {"body": "beta epsilon"}},
+        "functions": [{"filter": {"range": {"price": {"lte": 40}}},
+                       "script_score": {"script": "_score + sqrt(doc['price'].value)"}}],
+        "boost_mode": "sum", "max_boost": 20.0, "min_score": 0.2}}, places=5)
+
+
+def test_script_missing_column_falls_back_to_host(bm25):
+    # `pop` is missing on some docs: host evaluates those per-doc (None →
+    # ScriptError). The device kernel must flag the query bad and rerun on the
+    # host so both paths raise identically.
+    qd = {"function_score": {
+        "query": {"match": {"body": "alpha"}},
+        "script_score": {"script": "_score * doc['pop'].value"}}}
+    q = parse_query(qd)
+    assert lower_flat(q, bm25) is not None  # device-eligible until data says no
+    with pytest.raises(ScriptError):
+        search_shard(bm25, q, 10, use_device=False)
+    with pytest.raises(ScriptError):
+        search_shard(bm25, q, 10, use_device=True)
+
+
+def test_script_empty_guard_falls_back_and_agrees(bm25):
+    # guards missing values via .empty: host serves it (per-doc for the missing
+    # rows), device flags bad → host rerun → identical results, no error
+    _parity(bm25, {"function_score": {
+        "query": {"match": {"body": "alpha"}},
+        "script_score": {
+            "script": "_score if doc['pop'].empty else _score * log(1 + doc['pop'].value)"}}},
+        places=5)
+
+
+def test_script_nonfinite_raises_on_both_paths(bm25):
+    qd = {"function_score": {
+        "query": {"match_all": {}},
+        "script_score": {"script": "log(doc['zero'].value)"}}}
+    # match_all sub query doesn't lower flat — host path both ways, still raises
+    with pytest.raises(ScriptError):
+        search_shard(bm25, parse_query(qd), 10, use_device=False)
+    qd2 = {"function_score": {
+        "query": {"match": {"body": "alpha beta gamma delta"}},
+        "script_score": {"script": "_score / doc['zero'].value"}}}
+    with pytest.raises(ScriptError):
+        search_shard(bm25, parse_query(qd2), 10, use_device=True)
+
+
+def test_multi_function_with_score_script_stays_host(bm25):
+    # two functions where one reads _score → not device-expressible → plan None
+    q = parse_query({"function_score": {
+        "query": {"match": {"body": "alpha"}},
+        "functions": [
+            {"script_score": {"script": "_score * 2"}},
+            {"boost_factor": 3},
+        ]}})
+    assert lower_flat(q, bm25) is None
+    dev = search_shard(bm25, q, 10, use_device=True)
+    host = search_shard(bm25, q, 10, use_device=False)
+    assert dev.hits == host.hits and dev.total == host.total
+
+
+def test_service_level_device_serving(bm25):
+    # the serving path (execute_query_phase) routes fs plans through the kernels
+    from elasticsearch_tpu.search.service import execute_query_phase, parse_search_body
+
+    req = parse_search_body({
+        "query": {"function_score": {
+            "query": {"match": {"body": "alpha beta"}},
+            "functions": [{"gauss": {"price": {"origin": 25, "scale": 10}}}]}},
+        "size": 10})
+    dev = execute_query_phase(bm25, req, use_device=True)
+    host = execute_query_phase(bm25, req, use_device=False)
+    assert dev.total == host.total
+    assert [(s, d) for s, d, _ in dev.docs] == [(s, d) for s, d, _ in host.docs]
