@@ -334,9 +334,14 @@ def make_common_grams_filter(settings: Settings):
         out: list[Token] = []
         for i, t in enumerate(tokens):
             # query_mode (CommonGramsQueryFilter): drop a unigram when a bigram
-            # STARTS at it (the bigram carries it forward); the final token after
-            # the last bigram stays
-            if not (query_mode and has_gram[i]):
+            # STARTS at it (the gram replaces its look-behind buffer), and drop
+            # the FINAL unigram when a bigram ends at it (the filter's
+            # end-of-stream `GRAM_TYPE.equals(previousType)` check). A middle
+            # unigram that only ENDS a bigram survives: "the quick brown" →
+            # [the_quick, quick, brown]
+            drop = query_mode and (
+                has_gram[i] or (i == n - 1 and i > 0 and has_gram[i - 1]))
+            if not drop:
                 out.append(t)
             if has_gram[i]:
                 nxt = tokens[i + 1]

@@ -103,8 +103,14 @@ class TestAnalysis:
             "index.analysis.filter.qm.query_mode": True,
         }))
         assert "The_cat" in svc.analyzer("cs").terms("The cat")
-        assert svc.analyzer("qm").terms("king of spain") == \
-            ["king_of", "of_spain", "spain"]
+        # CommonGramsQueryFilter: the final unigram drops when a bigram ends at
+        # it, but a MIDDLE unigram that only ends a bigram survives
+        assert svc.analyzer("qm").terms("king of spain") == ["king_of", "of_spain"]
+        assert svc.analyzer("qm").terms("king of") == ["king_of"]
+        assert svc.analyzer("qm").terms("of spain") == ["of_spain"]
+        assert svc.analyzer("qm").terms("of") == ["of"]
+        assert svc.analyzer("qm").terms("of quick brown") == \
+            ["of_quick", "quick", "brown"]
 
     def test_pattern_capture_filter(self):
         svc = AnalysisService(Settings.from_flat({
