@@ -69,6 +69,12 @@ class PackedSegment:
     # measured throughput ceiling: ~70 ms/batch vs ~5 ms for the row gather)
     blk_tfn: object = None  # jnp float32 [NBpad, B] or None until first bake
     tfn_tables: dict = dc_field(default_factory=dict)  # field -> (mode, cache bytes-hash)
+    # device metric-agg state: per-doc (count, sum, min, max, sumsq) rows per
+    # numeric field, exact for MULTI-valued columns because the per-doc folds
+    # happen host-side at build time (ops/scoring.score_agg_batch reduces them
+    # under the match mask — SURVEY §5.7 "shard-level parallel reduce")
+    agg_rows: dict = dc_field(default_factory=dict)  # field -> jnp f32 [5, Dpad]
+    agg_stacks: dict = dc_field(default_factory=dict)  # fields-tuple -> [F, 5, Dpad]
     # host copies for re-bakes (live-mask refresh / similarity-stats drift)
     host_docs: np.ndarray | None = None  # int32 [NBpad*B] RAW (unmasked) doc ids
     host_freqs: np.ndarray | None = None  # float32 [NBpad*B]
@@ -158,6 +164,75 @@ def pack_segment(seg: FrozenSegment, fields: list[str] | None = None,
         blk_field=blk_field,
         field_names=field_names,
     )
+
+
+def agg_doc_rows(seg: FrozenSegment, field: str) -> np.ndarray:
+    """Per-doc metric folds of one numeric column: float32 [5, doc_count] rows
+    (count, sum, min, max, sumsq). Multi-valued docs fold exactly (cumsum
+    difference / reduceat over the CSR); docs with no value carry count 0 and
+    ±inf min/max so the kernel's masked reductions ignore them. Values are
+    float32 on device — double-typed columns round to 7 significant digits
+    (float/integer columns are exact)."""
+    D = seg.doc_count
+    rows = np.zeros((5, D), dtype=np.float32)
+    rows[2] = np.inf
+    rows[3] = -np.inf
+    col = seg.dv_num.get(field)
+    if col is None:
+        return rows
+    off, vals = col
+    counts = np.diff(off)
+    c = np.zeros(len(vals) + 1)
+    np.cumsum(vals, out=c[1:])
+    sums = c[off[1:]] - c[off[:-1]]
+    c2 = np.zeros(len(vals) + 1)
+    np.cumsum(np.asarray(vals, dtype=np.float64) ** 2, out=c2[1:])
+    sumsq = c2[off[1:]] - c2[off[:-1]]
+    has = counts > 0
+    if len(vals):
+        # reduceat yields garbage for empty segments (off[i] == off[i+1]) — those
+        # entries are masked by `has`; indices are clipped so the final empty doc
+        # can't index past the values array
+        idx = np.minimum(off[:-1], len(vals) - 1)
+        rows[2][has] = np.minimum.reduceat(vals, idx)[has]
+        rows[3][has] = np.maximum.reduceat(vals, idx)[has]
+    rows[0] = counts
+    rows[1] = sums
+    rows[4] = sumsq
+    return rows
+
+
+def _pad_agg_rows(rows: np.ndarray, doc_pad: int, base: int = 0,
+                  out: np.ndarray | None = None) -> np.ndarray:
+    """Place [5, D] rows at `base` inside a [5, doc_pad] canvas (empty slots:
+    count 0, ±inf min/max)."""
+    if out is None:
+        out = np.zeros((5, doc_pad), dtype=np.float32)
+        out[2] = np.inf
+        out[3] = -np.inf
+    out[:, base: base + rows.shape[1]] = rows
+    return out
+
+
+def ensure_agg_rows(seg: FrozenSegment, packed: PackedSegment, fields: list[str]):
+    """Device-resident [F, 5, Dpad] stack for `fields` — rows cached per field,
+    the stacked array per fields-tuple (FIFO-bounded) so the agg hot path never
+    re-copies on repeat queries."""
+    import jax.numpy as jnp
+
+    key = tuple(fields)
+    stack = packed.agg_stacks.get(key)
+    if stack is not None:
+        return stack
+    for f in fields:
+        if f not in packed.agg_rows:
+            packed.agg_rows[f] = jnp.asarray(
+                _pad_agg_rows(agg_doc_rows(seg, f), packed.doc_pad))
+    stack = jnp.stack([packed.agg_rows[f] for f in fields])
+    while len(packed.agg_stacks) >= 8:
+        packed.agg_stacks.pop(next(iter(packed.agg_stacks)))
+    packed.agg_stacks[key] = stack
+    return stack
 
 
 TFN_BM25 = 0  # tfn = f / (f + cache[norm_byte])        — weight multiplies outside

@@ -392,6 +392,93 @@ def score_fs_script_batch(packed: PackedSegment, batch: TermBatch, k: int,
             np.asarray(bad))
 
 
+# ---------------------------------------------------------------------------
+# dense kernel + fused metric-aggregation stats
+# ---------------------------------------------------------------------------
+#
+# The reference collects metric aggs in a second per-doc pass over the matched
+# docs (search/aggregations/AggregationPhase + per-agg collectors); here the agg
+# reduction fuses into the SAME device program that scored the query: the match
+# mask multiplies per-doc (count, sum, sumsq) rows via a [Q, Dpad] @ [Dpad, 3F]
+# matmul (MXU work), and min/max ride masked reductions. Rows come from
+# device_index.agg_doc_rows — exact for multi-valued fields.
+
+
+def agg_stat_reduction(match, agg_rows):
+    """Masked metric stats under a match mask — the ONE implementation both trace
+    contexts call (single-shard _dense_aggstats_impl and the mesh SPMD program).
+
+    match: bool [Q, Dpad]; agg_rows: f32 [F, 5, Dpad] per-doc folds
+    (device_index.agg_doc_rows). Returns (counts int32 [Q, F], stats f32
+    [Q, F, 4] = (sum, min, max, sumsq)). Counts ride an exact int32 reduction —
+    an f32 accumulator would silently round past 2^24 matched values; sums and
+    sumsq share one [Q, Dpad] @ [Dpad, 2F] matmul (MXU work)."""
+    import jax.numpy as jnp
+
+    F = agg_rows.shape[0]
+    mf = match.astype(jnp.float32)
+    lin = jnp.concatenate([agg_rows[:, 1], agg_rows[:, 4]], axis=0)  # [2F, Dpad]
+    sums2 = mf @ lin.T  # [Q, 2F]
+    cnt_rows = agg_rows[:, 0].astype(jnp.int32)  # [F, Dpad]
+    counts = jnp.sum(jnp.where(match[:, None, :], cnt_rows[None], 0),
+                     axis=2, dtype=jnp.int32)  # [Q, F]
+    has = match[:, None, :] & (agg_rows[None, :, 0, :] > 0)  # [Q, F, Dpad]
+    mins = jnp.where(has, agg_rows[None, :, 2, :], jnp.inf).min(axis=2)
+    maxs = jnp.where(has, agg_rows[None, :, 3, :], -jnp.inf).max(axis=2)
+    stats = jnp.stack([sums2[:, :F], mins, maxs, sums2[:, F:]], axis=2)
+    return counts, stats
+
+
+def _dense_aggstats_impl(blk_docs, blk_freqs, live_parent, norms_stack, caches,
+                         qidx, blk, weight, fidx, group, tfmode, n_must, msm, coord,
+                         agg_rows,  # [F, 5, Dpad] f32: count/sum/min/max/sumsq
+                         *, n_queries: int, k: int, doc_pad: int):
+    import jax
+    import jax.numpy as jnp
+
+    Q = n_queries
+    scores, flat_idx, valid = _dense_accumulate(
+        blk_docs, blk_freqs, norms_stack, caches, qidx, blk, weight, fidx, group,
+        tfmode, Q=Q, doc_pad=doc_pad)
+    scores, match = _dense_semantics(scores, flat_idx, valid, group, live_parent,
+                                     n_must, msm, coord, Q=Q, doc_pad=doc_pad)
+    masked = jnp.where(match, scores, jnp.float32(-jnp.inf))
+    top_scores, top_docs = jax.lax.top_k(masked, k)
+    total = match.sum(axis=1, dtype=jnp.int32)
+    counts, stats = agg_stat_reduction(match, agg_rows)
+    return top_scores, top_docs, total, counts, stats
+
+
+def score_agg_batch(packed: PackedSegment, batch: TermBatch, k: int,
+                    agg_row_stack):
+    """Dense launch returning (scores, docs, total, counts [Q, F] int,
+    stats [Q, F, 4]) numpy. stats rows: (sum, min(+inf if none), max(-inf),
+    sumsq) over matched docs per agg field."""
+    import jax
+    import jax.numpy as jnp
+
+    norms_stack, caches = _stack_args(packed, batch)
+    key = ("aggstats", batch.n_queries, min(k, packed.doc_pad), packed.doc_pad)
+    fn = _compiled_cache.get(key)
+    if fn is None:
+        def wrapper(*args):
+            return _dense_aggstats_impl(
+                *args, n_queries=batch.n_queries, k=min(k, packed.doc_pad),
+                doc_pad=packed.doc_pad)
+
+        fn = jax.jit(wrapper)
+        _compiled_cache[key] = fn
+    top_scores, top_docs, total, counts, stats = fn(
+        packed.blk_docs, packed.blk_freqs, packed.live_parent, norms_stack, caches,
+        jnp.asarray(batch.qidx), jnp.asarray(batch.blk), jnp.asarray(batch.weight),
+        jnp.asarray(batch.fidx), jnp.asarray(batch.group), jnp.asarray(batch.tfmode),
+        jnp.asarray(batch.n_must), jnp.asarray(batch.msm), jnp.asarray(batch.coord),
+        agg_row_stack,
+    )
+    return (np.asarray(top_scores), np.asarray(top_docs), np.asarray(total),
+            np.asarray(counts), np.asarray(stats))
+
+
 def _detect_simple(batch: TermBatch) -> bool:
     """Pure-should all-BM25 batches reduce match to score>0 — see
     _score_batch_impl(simple=). BM25 is the only mode whose contribution is provably

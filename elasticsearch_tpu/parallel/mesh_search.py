@@ -138,6 +138,12 @@ class ShardedIndex:
     max_doc: np.ndarray  # [S] int32 (host; also fed to psum)
     sum_ttf: np.ndarray  # [S, F] f32
     mesh: object = None
+    # fused-agg state (built lazily by mesh_serving, lives and dies with this
+    # packed generation): per-FIELD host rows so overlapping field sets never
+    # recompute, plus a bounded cache of per-tuple device stacks
+    agg_field_rows: dict = dc_field(default_factory=dict)  # field -> np [S, 5, Dpad]
+    agg_stacks: dict = dc_field(default_factory=dict)  # fields-tuple -> device
+    searchers: list = dc_field(default_factory=list)  # for lazy agg-row builds
 
     def global_max_doc(self) -> int:
         return int(self.max_doc.sum())
@@ -214,7 +220,49 @@ def build_sharded_index(searchers: list[Searcher], fields: list[str],
         max_doc=max_doc,
         sum_ttf=sum_ttf,
         mesh=mesh,
+        searchers=list(searchers),
     )
+
+
+_AGG_STACK_CACHE_MAX = 8  # distinct fields-tuples kept on device per generation
+
+
+def ensure_mesh_agg_stack(index: ShardedIndex, fields: tuple):
+    """Device [S, F, 5, Dpad] per-doc metric folds for `fields`, sharded along
+    "shards". Per-field host rows are computed once per packed generation;
+    per-tuple device stacks are FIFO-bounded so rotating agg field sets can't
+    grow device memory unboundedly."""
+    import jax
+    import jax.numpy as jnp
+
+    stack = index.agg_stacks.get(fields)
+    if stack is not None:
+        return stack
+    from ..ops.device_index import _pad_agg_rows, agg_doc_rows
+
+    S = index.n_shards
+    for f in fields:
+        if f in index.agg_field_rows:
+            continue
+        host_f = np.zeros((S, 5, index.doc_pad), dtype=np.float32)
+        host_f[:, 2] = np.inf
+        host_f[:, 3] = -np.inf
+        for si, searcher in enumerate(index.searchers):
+            for seg, base in zip(searcher.segments, searcher.bases):
+                _pad_agg_rows(agg_doc_rows(seg, f), index.doc_pad, base,
+                              out=host_f[si])
+        index.agg_field_rows[f] = host_f
+    host = np.stack([index.agg_field_rows[f] for f in fields], axis=1)
+    if index.mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        stack = jax.device_put(host, NamedSharding(index.mesh, P("shards")))
+    else:
+        stack = jnp.asarray(host)
+    while len(index.agg_stacks) >= _AGG_STACK_CACHE_MAX:
+        index.agg_stacks.pop(next(iter(index.agg_stacks)))
+    index.agg_stacks[fields] = stack
+    return stack
 
 
 # ---------------------------------------------------------------------------
@@ -223,12 +271,17 @@ def build_sharded_index(searchers: list[Searcher], fields: list[str],
 
 
 def _mesh_score_program(k: int, n_queries: int, doc_pad: int, similarity_kind: int,
-                        k1: float, b: float, use_global_stats: bool = True):
+                        k1: float, b: float, use_global_stats: bool = True,
+                        use_filter: bool = False, use_aggs: bool = False):
     """Returns the shard_map-able function (static shapes closed over).
 
     use_global_stats=True is dfs_query_then_fetch (term stats psum'd over the shards
     axis — the DFS all-reduce); False is plain query_then_fetch (each shard weighs
-    with its local stats, exactly like the reference's per-shard IndexSearcher)."""
+    with its local stats, exactly like the reference's per-shard IndexSearcher).
+    use_filter adds per-shard FilteredQuery masks; use_aggs adds fused metric-agg
+    stats (device_index.agg_doc_rows folds reduced under the match mask, gathered
+    per shard — the SPMD embodiment of the reference's per-shard agg collect +
+    coordinator reduce)."""
     import jax
     import jax.numpy as jnp
 
@@ -240,7 +293,11 @@ def _mesh_score_program(k: int, n_queries: int, doc_pad: int, similarity_kind: i
                 df_local, boost, clause_qidx, clause_scoring,  # clauses [1?, C]
                 max_doc_local, sum_ttf_local,  # [1], [1, F]
                 n_must, msm, coord,  # per query [Qd], [Qd], [Qd, C+1]
-                filter_masks=None):  # optional [1, Qd, Dpad] bool (FilteredQuery)
+                *extra):  # [filter_masks [1, Qd, Dpad] bool][agg_rows [1, F, 5, Dpad]]
+        ei = 0
+        filter_masks = extra[ei] if use_filter else None
+        ei += 1 if use_filter else 0
+        agg_rows = extra[ei] if use_aggs else None
         blk_docs = blk_docs[0]
         blk_freqs = blk_freqs[0]
         norms_l = norms[0]
@@ -321,6 +378,17 @@ def _mesh_score_program(k: int, n_queries: int, doc_pad: int, similarity_kind: i
             # FilteredQuery's scorer — score comes from the wrapped query alone)
             match = match & filter_masks[0]
 
+        if agg_rows is not None:
+            # fused metric aggs under the match mask (ops/scoring.agg_stat_reduction
+            # — the SAME reduction the single-shard dense kernel runs); per-shard
+            # partials gathered so serving synthesizes transport-identical
+            # ShardQueryResult.agg_partials
+            from ..ops.scoring import agg_stat_reduction
+
+            local_counts, local_stats = agg_stat_reduction(match, agg_rows[0])
+            agg_counts = jax.lax.all_gather(local_counts, "shards")  # [S, Qd, F]
+            agg_stats = jax.lax.all_gather(local_stats, "shards")  # [S, Qd, F, 4]
+
         overlap = jnp.minimum(m_should + m_must, coord.shape[1] - 1)
         scores = scores * jnp.take_along_axis(coord, overlap, axis=1)
 
@@ -346,6 +414,9 @@ def _mesh_score_program(k: int, n_queries: int, doc_pad: int, similarity_kind: i
         top_ids = jnp.take_along_axis(g_ids, pos, axis=1)
         shard_totals = jax.lax.all_gather(
             match.sum(axis=1).astype(jnp.int32), "shards")  # [S, Qd]
+        if agg_rows is not None:
+            return (top_scores[None], top_ids[None], shard_totals[None],
+                    agg_counts[None], agg_stats[None])
         return (top_scores[None], top_ids[None], shard_totals[None])
 
     return program
@@ -358,6 +429,8 @@ class MeshTopDocs:
     doc: np.ndarray  # [Q, k] local doc id within shard
     totals: np.ndarray  # [Q] — global matches (sum over shards)
     shard_totals: np.ndarray = None  # [S, Q] per-shard matches
+    agg_counts: np.ndarray = None  # [S, Q, F] int per-shard matched value counts
+    agg_stats: np.ndarray = None  # [S, Q, F, 4] per-shard (sum, min, max, sumsq)
 
 
 class MeshSearchExecutor:
@@ -456,10 +529,13 @@ class MeshSearchExecutor:
                 clause_qidx, clause_scoring, n_must, msm, coord)
 
     def search(self, plans: list[FlatPlan], k: int,
-               filter_masks: np.ndarray | None = None) -> MeshTopDocs:
+               filter_masks: np.ndarray | None = None,
+               agg_rows=None) -> MeshTopDocs:
         """filter_masks: optional bool [S, Q, doc_pad] — per-shard, per-query
         FilteredQuery masks (host-evaluated via the filter cache, sharded onto the
-        mesh; they gate matching, not scoring)."""
+        mesh; they gate matching, not scoring). agg_rows: optional [S, F, 5, Dpad]
+        f32 per-doc metric folds (device_index.agg_doc_rows) — fused agg stats
+        come back per shard in MeshTopDocs.agg_stats."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
@@ -475,11 +551,14 @@ class MeshSearchExecutor:
          clause_scoring, n_must, msm, coord) = self._assemble(plans)
 
         has_filter = filter_masks is not None
-        key = (Q, k, qidx.shape[1], coord.shape[1], has_filter)
+        has_aggs = agg_rows is not None
+        key = (Q, k, qidx.shape[1], coord.shape[1], has_filter, has_aggs)
         fn = self._compiled.get(key)
         if fn is None:
             program = _mesh_score_program(k, Q, idx.doc_pad, self.similarity_kind,
-                                          self.k1, self.b, self.use_global_stats)
+                                          self.k1, self.b, self.use_global_stats,
+                                          use_filter=has_filter,
+                                          use_aggs=has_aggs)
             in_specs = [
                 P("shards"), P("shards"), P("shards"), P("shards"),  # index
                 P("shards"), P("shards"), P("shards"), P("shards"), P("shards"), P("shards"),  # entries
@@ -489,10 +568,13 @@ class MeshSearchExecutor:
             ]
             if has_filter:
                 in_specs.append(P("shards"))
+            if has_aggs:
+                in_specs.append(P("shards"))
+            out_specs = (P(), P(), P(), P(), P()) if has_aggs else (P(), P(), P())
             fn = shard_map(
                 program, mesh=self.mesh,
                 in_specs=tuple(in_specs),
-                out_specs=(P(), P(), P()),
+                out_specs=out_specs,
                 check_vma=False,
             )
             fn = jax.jit(fn)
@@ -509,7 +591,16 @@ class MeshSearchExecutor:
         ]
         if has_filter:
             args.append(jnp.asarray(filter_masks))
-        top_scores, top_ids, shard_totals = fn(*args)
+        if has_aggs:
+            args.append(agg_rows if not isinstance(agg_rows, np.ndarray)
+                        else jnp.asarray(agg_rows))
+        agg_counts = agg_stats = None
+        if has_aggs:
+            top_scores, top_ids, shard_totals, agg_counts, agg_stats = fn(*args)
+            agg_counts = np.asarray(agg_counts)[0]  # [S, Q, F]
+            agg_stats = np.asarray(agg_stats)[0]  # [S, Q, F, 4]
+        else:
+            top_scores, top_ids, shard_totals = fn(*args)
         top_scores = np.asarray(top_scores)[0]
         top_ids = np.asarray(top_ids)[0]
         shard_totals = np.asarray(shard_totals)[0]  # [S, Q]
@@ -519,4 +610,5 @@ class MeshSearchExecutor:
         doc = np.where(shard >= 0, doc, -1)
         return MeshTopDocs(scores=top_scores, shard=shard, doc=doc,
                            totals=shard_totals.sum(axis=0).astype(np.int64),
-                           shard_totals=shard_totals)
+                           shard_totals=shard_totals, agg_counts=agg_counts,
+                           agg_stats=agg_stats)

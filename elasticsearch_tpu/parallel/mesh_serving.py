@@ -67,7 +67,10 @@ class MeshServingService:
         index = indices[0]
         if alias_filters.get(index):
             return None
-        if (req.aggs or req.facets or req.suggest or req.sort or req.post_filter
+        # req.aggs does NOT reject: metric aggs ride the SPMD program (fused
+        # stats + all_gather); per-agg eligibility is checked in _search_mesh
+        # where the shard context exists
+        if (req.facets or req.suggest or req.sort or req.post_filter
                 or req.rescore or req.min_score is not None or req.explain):
             return None
         if len(shards) < self.MIN_SHARDS:
@@ -131,6 +134,13 @@ class MeshServingService:
             # express — transport path (which itself serves them on-device via
             # execute_flat_batch's fs kernels)
             return None
+        agg_fields = None
+        if req.aggs:
+            from ..search.aggregations import device_agg_fields
+
+            agg_fields = device_agg_fields(req.aggs, ctx0)
+            if agg_fields is None:
+                return None
         # one similarity family per program: every queried field must score with the
         # index default (per-field DFR/IB/etc lowered out already by lower_flat)
         default_sim = svc.similarity_service.default
@@ -167,7 +177,16 @@ class MeshServingService:
                     filter_masks[si, 0, base: base + seg.doc_count] = \
                         segment_mask(seg, filt, ctx_i)
 
-        out = executor.search([plan], k, filter_masks=filter_masks)
+        agg_rows = None
+        fields = None
+        if agg_fields is not None:
+            from .mesh_search import ensure_mesh_agg_stack
+
+            fields = tuple(sorted(set(agg_fields.values())))
+            agg_rows = ensure_mesh_agg_stack(executor.index, fields)
+
+        out = executor.search([plan], k, filter_masks=filter_masks,
+                              agg_rows=agg_rows)
         self.mesh_queries += 1
 
         results = []
@@ -176,10 +195,23 @@ class MeshServingService:
                     for j in range(out.scores.shape[1])
                     if out.shard[0][j] == copy.shard_id]
             scores = [s for (s, _d, _sv) in rows]
+            agg_partials = []
+            if agg_fields is not None and out.agg_stats is not None:
+                from ..search.aggregations import device_partial
+
+                fpos = {f: i for i, f in enumerate(fields)}
+                counts = out.agg_counts[copy.shard_id, 0]  # [F]
+                stats = out.agg_stats[copy.shard_id, 0]  # [F, 4]
+                agg_partials = [{
+                    name: device_partial(agg, counts[fpos[agg_fields[name]]],
+                                         stats[fpos[agg_fields[name]]])
+                    for name, agg in req.aggs.items()
+                }]
             results.append(ShardQueryResult(
                 total=int(out.shard_totals[copy.shard_id, 0]),
                 docs=rows,
                 max_score=max(scores) if scores else float("nan"),
+                agg_partials=agg_partials,
                 shard_id=ordinal,
             ))
         return results

@@ -254,6 +254,67 @@ class ExtendedStatsAgg(StatsAgg):
         return out
 
 
+# ---------------------------------------------------------------------------
+# device metric-agg bridge (ops/scoring.score_agg_batch)
+# ---------------------------------------------------------------------------
+
+_DEVICE_METRIC_CLASSES = (SumAgg, AvgAgg, MinAgg, MaxAgg, ValueCountAgg, StatsAgg)
+
+
+def device_agg_field(agg: Agg, ctx) -> str | None:
+    """The numeric column this agg can reduce on-device, else None (host path).
+    extended_stats stays host-side: its variance finalization subtracts nearly
+    equal sums, which float32 kernel accumulation would amplify."""
+    if type(agg) is ExtendedStatsAgg or not isinstance(agg, _DEVICE_METRIC_CLASSES):
+        return None
+    if agg.subs:
+        return None
+    field = agg.spec.get("field")
+    if not field or agg.spec.get("script"):
+        return None
+    ft = ctx.field_type(field)
+    if ft is None or not getattr(ft, "is_numeric", False):
+        return None
+    return field
+
+
+def device_agg_fields(aggs: dict, ctx) -> dict | None:
+    """name -> numeric column for EVERY agg in the request, or None when any agg
+    needs the host path — the single eligibility gate shared by the single-shard
+    serving branch (service._try_device_aggs) and the mesh path (mesh_serving)."""
+    out = {}
+    for name, agg in aggs.items():
+        f = device_agg_field(agg, ctx)
+        if f is None:
+            return None
+        out[name] = f
+    return out
+
+
+def device_partial(agg: Agg, count, st):
+    """One kernel result (count int, st = (sum, min, max, sumsq) f32) → the SAME
+    partial shape Agg.collect produces, so merge/finalize stay shared between
+    paths. Counts arrive from an exact int32 device reduction."""
+    count = int(count)
+    total = float(st[0])
+    mn = float(st[1]) if count and np.isfinite(st[1]) else None
+    mx = float(st[2]) if count and np.isfinite(st[2]) else None
+    if isinstance(agg, AvgAgg):
+        return (total, count)
+    if isinstance(agg, SumAgg):
+        return total
+    if isinstance(agg, MinAgg):
+        return mn
+    if isinstance(agg, MaxAgg):
+        return mx
+    if isinstance(agg, ValueCountAgg):
+        return count
+    if isinstance(agg, StatsAgg):
+        return (count, total, mn, mx, float(st[3])) if count \
+            else (0, 0.0, None, None, 0.0)
+    raise QueryParsingError(f"not a device agg [{type(agg).__name__}]")
+
+
 class CardinalityAgg(Agg):
     """Distinct count via a HyperLogLog++ sketch — bounded memory (2^p bytes) on
     arbitrarily-high-cardinality fields, near-exact up to `precision_threshold`

@@ -675,6 +675,39 @@ def _execute_flat_fs(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list[T
     ]
 
 
+def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
+                      fields: list[str]):
+    """Single-plan dense execution with metric-agg stats fused into the kernel:
+    returns (TopDocs, per-segment (counts int [F], stats float32 [F, 4])) with
+    F = len(fields), stats = (sum, min, max, sumsq) over matched docs. Serving
+    uses this when every aggregation is a device-eligible metric
+    (service.execute_query_phase → aggregations.device_agg_fields)."""
+    from ..ops.device_index import ensure_agg_rows, packed_for
+    from ..ops.scoring import build_term_batch, score_agg_batch
+
+    finals = [finalize_flat(plan, ctx)]
+    (all_fields, field_idx, _cache_rows, caches_stack,
+     coord_tbl, n_must, msm) = _assemble_batch([plan], finals)
+    totals = np.zeros(1, dtype=np.int64)
+    seg_hits = []
+    seg_stats = []
+    for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
+        packed = packed_for(seg)
+        _ensure_norm_rows(packed, all_fields)
+        stack = ensure_agg_rows(seg, packed, fields)
+        entries = _dense_entries(finals, seg, packed, field_idx)
+        batch = build_term_batch(entries, 1, n_must, msm, coord_tbl,
+                                 list(all_fields), caches_stack,
+                                 nb_pad_row=packed.blk_docs.shape[0] - 1)
+        scores, docs, tq, counts, stats = score_agg_batch(packed, batch, k, stack)
+        totals += tq
+        valid = (docs < min(packed.doc_pad, seg.doc_count)) & np.isfinite(scores)
+        gdocs = np.where(valid, docs.astype(np.int64) + base, np.int64(2**62))
+        seg_hits.append((np.where(valid, scores, -np.inf), gdocs))
+        seg_stats.append((counts[0], stats[0]))
+    return _merge_seg_hits(seg_hits, totals, 1, k)[0], seg_stats
+
+
 # ---------------------------------------------------------------------------
 # host scorer (general path)
 # ---------------------------------------------------------------------------

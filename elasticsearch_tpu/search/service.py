@@ -123,6 +123,16 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
                                 max_score=td.max_score, suggest=suggest_out,
                                 shard_id=shard_id)
 
+    # device metric-agg path: when the ONLY mask consumer is a set of
+    # device-eligible metric aggs, the agg reduction fuses into the scoring
+    # kernel (execute.execute_flat_aggs) instead of materializing host masks
+    if (use_device and req.aggs and not req.facets and not req.sort
+            and req.post_filter is None and not req.rescore
+            and req.min_score is None and not req.explain):
+        device = _try_device_aggs(ctx, req, k, suggest_out, shard_id)
+        if device is not None:
+            return device
+
     # general path: dense per-segment masks drive sort/aggs/rescore
     seg_results = match_masks(ctx, req.query)
     seg_masks_for_aggs = []
@@ -205,6 +215,35 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
     return ShardQueryResult(
         total=total, docs=docs, max_score=max_score, agg_partials=agg_partials,
         facet_partials=facet_partials, suggest=suggest_out, shard_id=shard_id,
+    )
+
+
+def _try_device_aggs(ctx: ShardContext, req: ParsedSearchRequest, k: int,
+                     suggest_out, shard_id: int) -> "ShardQueryResult | None":
+    """Serve query + metric aggs in one fused device program per segment; None
+    when any agg (or the query) needs the host path."""
+    from .aggregations import device_agg_fields, device_partial
+    from .execute import execute_flat_aggs
+
+    agg_fields = device_agg_fields(req.aggs, ctx)
+    if agg_fields is None:
+        return None
+    plan = lower_flat(req.query, ctx)
+    if plan is None or plan.fs is not None:
+        return None
+    fields = sorted(set(agg_fields.values()))
+    fpos = {f: i for i, f in enumerate(fields)}
+    td, seg_stats = execute_flat_aggs(plan, ctx, max(k, 1), fields)
+    agg_partials = [
+        {name: device_partial(agg, counts[fpos[agg_fields[name]]],
+                              stats[fpos[agg_fields[name]]])
+         for name, agg in req.aggs.items()}
+        for (counts, stats) in seg_stats
+    ]
+    return ShardQueryResult(
+        total=td.total, docs=[(s, d, None) for s, d in td.hits],
+        max_score=td.max_score, agg_partials=agg_partials, suggest=suggest_out,
+        shard_id=shard_id,
     )
 
 

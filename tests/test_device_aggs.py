@@ -1,0 +1,145 @@
+"""Device metric aggregations: differential tests vs the host collectors.
+
+Eligible requests (metric aggs on numeric columns, no other mask consumers) are
+served by ONE fused device program per segment — scoring + top-k + masked stat
+reductions (ops/scoring.score_agg_batch over device_index.agg_doc_rows) — instead
+of host-side mask materialization. Results must match the host collectors within
+float32 kernel accumulation (double-typed columns round to 7 significant digits;
+int/float columns are exact).
+
+ref: search/aggregations/AggregationPhase.java + metrics collectors; SURVEY §5.7
+"shard-level parallel reduce of aggregations".
+"""
+
+from __future__ import annotations
+
+import tempfile
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.mapper.core import MapperService
+from elasticsearch_tpu.search import ShardContext
+from elasticsearch_tpu.search.aggregations import reduce_aggs
+from elasticsearch_tpu.search.service import (
+    _try_device_aggs,
+    execute_query_phase,
+    parse_search_body,
+)
+from elasticsearch_tpu.search.similarity import SimilarityService
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    tmp = tempfile.mkdtemp()
+    settings = Settings.from_flat({"index.similarity.default.type": "BM25"})
+    svc = MapperService(settings)
+    eng = Engine(tmp, svc)
+    rng = np.random.default_rng(17)
+    words = ["alpha", "beta", "gamma", "delta", "epsilon"]
+    for i in range(400):
+        d = {"body": " ".join(rng.choice(words, size=5)),
+             "price": float(np.round(rng.uniform(1, 99), 2)),
+             "label": words[i % 5]}
+        if i % 3 == 0:
+            d["tags_n"] = [int(x) for x in rng.integers(1, 10, size=3)]
+        if i % 7 != 0:
+            d["pop"] = int(rng.integers(1, 100))
+        eng.index("doc", str(i), d)
+        if i == 199:
+            eng.refresh()  # second segment
+    for i in (4, 44, 250):
+        eng.delete("doc", str(i))
+    eng.refresh()
+    out = ShardContext(eng.acquire_searcher(), svc,
+                       SimilarityService(settings, mapper_service=svc))
+    yield out
+    eng.close()
+
+
+def _both(ctx, body):
+    req = parse_search_body(body)
+    dev = execute_query_phase(ctx, req, use_device=True)
+    host = execute_query_phase(ctx, req, use_device=False)
+    assert dev.total == host.total
+    assert [(round(s, 5), d) for s, d, _ in dev.docs] == \
+        [(round(s, 5), d) for s, d, _ in host.docs]
+    dr = reduce_aggs(req.aggs, dev.agg_partials)
+    hr = reduce_aggs(req.aggs, host.agg_partials)
+    for name in dr:
+        df, hf = dr[name], hr[name]
+        for k2 in df:
+            if df[k2] is None or hf[k2] is None:
+                assert df[k2] is None and hf[k2] is None, (name, k2, df, hf)
+            else:
+                assert df[k2] == pytest.approx(hf[k2], rel=1e-5), (name, k2)
+    return req
+
+
+def test_all_metric_types_parity(ctx):
+    req = _both(ctx, {
+        "query": {"match": {"body": "alpha beta"}}, "size": 5,
+        "aggs": {"p_avg": {"avg": {"field": "price"}},
+                 "p_sum": {"sum": {"field": "price"}},
+                 "p_stats": {"stats": {"field": "price"}},
+                 "pop_min": {"min": {"field": "pop"}},
+                 "pop_max": {"max": {"field": "pop"}},
+                 "p_count": {"value_count": {"field": "price"}}}})
+    # and the device path really served it
+    assert _try_device_aggs(ctx, req, 5, None, 0) is not None
+
+
+def test_multivalued_column_exact(ctx):
+    # per-doc folds happen host-side, so multi-valued sums/counts are exact
+    req = _both(ctx, {
+        "query": {"match": {"body": "gamma"}}, "size": 3,
+        "aggs": {"t_sum": {"sum": {"field": "tags_n"}},
+                 "t_cnt": {"value_count": {"field": "tags_n"}},
+                 "t_min": {"min": {"field": "tags_n"}},
+                 "t_max": {"max": {"field": "tags_n"}}}})
+    assert _try_device_aggs(ctx, req, 3, None, 0) is not None
+
+
+def test_missing_column_docs(ctx):
+    # `pop` is absent on every 7th doc: masked counts skip them on both paths
+    _both(ctx, {
+        "query": {"match": {"body": "delta epsilon"}}, "size": 3,
+        "aggs": {"s": {"stats": {"field": "pop"}}}})
+
+
+def test_no_matches_yields_empty_stats(ctx):
+    req = _both(ctx, {
+        "query": {"match": {"body": "zzzznope"}}, "size": 3,
+        "aggs": {"s": {"stats": {"field": "price"}},
+                 "m": {"min": {"field": "price"}}}})
+    r = reduce_aggs(req.aggs, execute_query_phase(ctx, req).agg_partials)
+    assert r["s"]["count"] == 0 and r["s"]["min"] is None
+    assert r["m"]["value"] is None
+
+
+@pytest.mark.parametrize("aggs", [
+    {"x": {"extended_stats": {"field": "price"}}},  # variance: host-only
+    {"x": {"avg": {"script": "doc['price'].value * 2"}}},  # script agg
+    {"x": {"terms": {"field": "label"}}},  # bucket agg
+    {"x": {"value_count": {"field": "label"}}},  # string column
+    {"x": {"cardinality": {"field": "pop"}}},  # sketch agg
+])
+def test_ineligible_aggs_fall_back(ctx, aggs):
+    body = {"query": {"match": {"body": "alpha"}}, "size": 3, "aggs": aggs}
+    req = parse_search_body(body)
+    assert _try_device_aggs(ctx, req, 3, None, 0) is None
+    # and the host path still serves them correctly end to end
+    res = execute_query_phase(ctx, req, use_device=True)
+    assert reduce_aggs(req.aggs, res.agg_partials)["x"] is not None
+
+
+def test_unlowerable_query_falls_back(ctx):
+    req = parse_search_body({
+        "query": {"match_all": {}},
+        "aggs": {"a": {"avg": {"field": "price"}}}})
+    assert _try_device_aggs(ctx, req, 3, None, 0) is None
+    # host path agrees with itself (sanity that fallback serves)
+    res = execute_query_phase(ctx, req, use_device=True)
+    assert reduce_aggs(req.aggs, res.agg_partials)["a"]["value"] is not None

@@ -82,14 +82,39 @@ class TestMeshServing:
                                              search_type="dfs_query_then_fetch")
         _assert_same_results(mesh, transport)
 
-    def test_aggs_fall_back_to_transport(self, node):
+    def test_metric_aggs_ride_mesh_and_match_transport(self, node):
+        # metric aggs fuse into the SPMD program (stats + all_gather); results
+        # must match the transport path within f32 kernel accumulation
         n, client = node
         ms = n.actions.mesh_serving
         before = ms.mesh_queries
-        r = client.search("library", {"query": {"match": {"body": "alpha"}},
-                                      "aggs": {"n_avg": {"avg": {"field": "n"}}}})
-        assert ms.mesh_queries == before  # ineligible: aggregations
-        assert "n_avg" in r["aggregations"]
+        body = {"query": {"match": {"body": "alpha"}},
+                "aggs": {"n_avg": {"avg": {"field": "n"}},
+                         "n_stats": {"stats": {"field": "n"}}}}
+        r = client.search("library", body)
+        assert ms.mesh_queries == before + 1  # served by the mesh program
+        ms.enabled = False
+        try:
+            r2 = client.search("library", body)
+        finally:
+            ms.enabled = True
+        for name in ("n_avg", "n_stats"):
+            a, b = r["aggregations"][name], r2["aggregations"][name]
+            for k2 in a:
+                if isinstance(a[k2], float):
+                    assert abs(a[k2] - b[k2]) <= 1e-5 * max(abs(b[k2]), 1)
+                else:
+                    assert a[k2] == b[k2]
+
+    def test_non_metric_aggs_fall_back_to_transport(self, node):
+        n, client = node
+        ms = n.actions.mesh_serving
+        before = ms.mesh_queries
+        r = client.search("library", {
+            "query": {"match": {"body": "alpha"}},
+            "aggs": {"by_body": {"terms": {"field": "body"}}}})
+        assert ms.mesh_queries == before  # ineligible: bucket agg
+        assert "by_body" in r["aggregations"]
 
     def test_fetch_phase_hydrates_mesh_hits(self, node):
         n, client = node
