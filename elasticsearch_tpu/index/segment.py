@@ -49,10 +49,23 @@ class FieldStats:
 
 class SegmentBuilder:
     """Accumulates parsed documents, freezes into a FrozenSegment.
-    The analogue of Lucene's in-RAM IndexWriter buffer (DWPT)."""
+    The analogue of Lucene's in-RAM IndexWriter buffer (DWPT).
+
+    Postings accumulation is the bulk-index hot loop (the reference's is inside
+    native Lucene); when the C extension is available it runs in
+    estpu_native.PostingsBuilder — C hash-table slots with append-time doc
+    grouping, freezing straight to the FrozenSegment CSR layout. The Python dict
+    path below is the always-available fallback and the behavioral reference."""
 
     def __init__(self, gen: int):
         self.gen = gen
+        from ..native import get_native
+
+        native = get_native()
+        self._pb = (native.PostingsBuilder()
+                    if native is not None and hasattr(native, "PostingsBuilder")
+                    else None)
+        self._pb_fids: dict[str, int] = {}
         # term postings: (field, term) -> list of (local_doc, freq, positions)
         self._postings: dict[tuple[str, str], list] = {}
         self._field_lengths: dict[str, list[tuple[int, int]]] = {}
@@ -81,15 +94,25 @@ class SegmentBuilder:
             self.ram_bytes += 24 * len(vals)
         for vals in doc.doc_values_str.values():
             self.ram_bytes += sum(48 + 2 * len(str(v)) for v in vals)
-        for field_name, terms in doc.postings.items():
-            # group into freq + positions per term
-            per_term: dict[str, list[int]] = {}
-            for term, pos in terms:
-                per_term.setdefault(term, []).append(pos)
-            for term, positions in per_term.items():
-                self._postings.setdefault((field_name, term), []).append(
-                    (local, len(positions), positions)
-                )
+        if self._pb is not None:
+            for field_name, terms in doc.postings.items():
+                if not terms:
+                    # a field whose every value analyzed to zero tokens must not
+                    # register (the Python path keys off actual (field, term)
+                    # entries — a phantom empty term_dict entry would differ)
+                    continue
+                fid = self._pb_fids.setdefault(field_name, len(self._pb_fids))
+                self._pb.add(fid, local, terms)
+        else:
+            for field_name, terms in doc.postings.items():
+                # group into freq + positions per term
+                per_term: dict[str, list[int]] = {}
+                for term, pos in terms:
+                    per_term.setdefault(term, []).append(pos)
+                for term, positions in per_term.items():
+                    self._postings.setdefault((field_name, term), []).append(
+                        (local, len(positions), positions)
+                    )
         for field_name, length in doc.field_lengths.items():
             self._field_lengths.setdefault(field_name, []).append((local, length))
         for field_name, vals in doc.doc_values_num.items():
@@ -127,18 +150,49 @@ class SegmentBuilder:
         self._nested_paths.append(None)
         return local
 
-    def freeze(self) -> "FrozenSegment":
-        D = self.doc_count
-        # term dictionary: per field, terms sorted (Lucene term dict is sorted; sorted
-        # ordinals make range/prefix queries on keyword fields array slices)
+    def _freeze_postings(self):
+        """(term_dict, post_offsets, post_docs, post_freqs, pos_offsets,
+        positions, sum_dfs_by_field) — from the C accumulator when present, else
+        the Python dict path. Both produce the identical CSR layout (fields
+        sorted by name, terms sorted per field — UTF-8 byte order equals
+        Python's code-point sort — docs ascending per term)."""
+        if self._pb is not None:
+            names = sorted(self._pb_fids)
+            name_rank = {n: r for r, n in enumerate(names)}
+            fid_rank = [0] * len(self._pb_fids)
+            for n, fid in self._pb_fids.items():
+                fid_rank[fid] = name_rank[n]
+            (terms_lists, off_b, docs_b, freqs_b, poff_b, pos_b) = \
+                self._pb.freeze(fid_rank)
+            term_dict: dict[str, dict[str, int]] = {}
+            tid = 0
+            for name in names:
+                terms = terms_lists[name_rank[name]]
+                term_dict[name] = {t: tid + i for i, t in enumerate(terms)}
+                tid += len(terms)
+            post_offsets = np.frombuffer(off_b, dtype=np.int64)
+            counts = np.diff(post_offsets)
+            sum_dfs_by_field = {}
+            lo = 0
+            for name in names:
+                hi = lo + len(term_dict[name])
+                sum_dfs_by_field[name] = int(counts[lo:hi].sum())
+                lo = hi
+            return (term_dict, post_offsets,
+                    np.frombuffer(docs_b, dtype=np.int32),
+                    np.frombuffer(freqs_b, dtype=np.float32),
+                    np.frombuffer(poff_b, dtype=np.int64),
+                    np.frombuffer(pos_b, dtype=np.int32),
+                    sum_dfs_by_field)
+
         by_field: dict[str, list[str]] = {}
         for f, t in self._postings:
             by_field.setdefault(f, []).append(t)
-        term_dict: dict[str, dict[str, int]] = {}
+        term_dict = {}
         offsets = [0]
         docs_parts, freqs_parts, pos_offsets, pos_parts = [], [], [0], []
         tid = 0
-        sum_dfs_by_field: dict[str, int] = {}
+        sum_dfs_by_field = {}
         for f in sorted(by_field):
             terms = sorted(by_field[f])
             td: dict[str, int] = {}
@@ -157,6 +211,16 @@ class SegmentBuilder:
             term_dict[f] = td
         post_docs = np.concatenate(docs_parts) if docs_parts else np.zeros(0, np.int32)
         post_freqs = np.concatenate(freqs_parts) if freqs_parts else np.zeros(0, np.float32)
+        return (term_dict, np.asarray(offsets, dtype=np.int64), post_docs,
+                post_freqs, np.asarray(pos_offsets, dtype=np.int64),
+                np.asarray(pos_parts, dtype=np.int32), sum_dfs_by_field)
+
+    def freeze(self) -> "FrozenSegment":
+        D = self.doc_count
+        # term dictionary: per field, terms sorted (Lucene term dict is sorted; sorted
+        # ordinals make range/prefix queries on keyword fields array slices)
+        (term_dict, post_offsets, post_docs, post_freqs, pos_offsets, positions,
+         sum_dfs_by_field) = self._freeze_postings()
 
         norms: dict[str, np.ndarray] = {}
         field_stats: dict[str, FieldStats] = {}
@@ -196,11 +260,11 @@ class SegmentBuilder:
             gen=self.gen,
             doc_count=D,
             term_dict=term_dict,
-            post_offsets=np.asarray(offsets, dtype=np.int64),
+            post_offsets=post_offsets,
             post_docs=post_docs,
             post_freqs=post_freqs,
-            pos_offsets=np.asarray(pos_offsets, dtype=np.int64),
-            positions=np.asarray(pos_parts, dtype=np.int32),
+            pos_offsets=pos_offsets,
+            positions=positions,
             norms=norms,
             field_stats=field_stats,
             dv_num=dv_num,

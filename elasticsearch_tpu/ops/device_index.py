@@ -73,8 +73,8 @@ class PackedSegment:
     # numeric field, exact for MULTI-valued columns because the per-doc folds
     # happen host-side at build time (ops/scoring.score_agg_batch reduces them
     # under the match mask — SURVEY §5.7 "shard-level parallel reduce")
-    agg_rows: dict = dc_field(default_factory=dict)  # field -> jnp f32 [5, Dpad]
-    agg_stacks: dict = dc_field(default_factory=dict)  # fields-tuple -> [F, 5, Dpad]
+    agg_rows: dict = dc_field(default_factory=dict)  # field -> HOST f32 [5, Dpad] | None (not f32-exact)
+    agg_stacks: dict = dc_field(default_factory=dict)  # fields-tuple -> device [F, 5, Dpad], FIFO-bounded
     # host copies for re-bakes (live-mask refresh / similarity-stats drift)
     host_docs: np.ndarray | None = None  # int32 [NBpad*B] RAW (unmasked) doc ids
     host_freqs: np.ndarray | None = None  # float32 [NBpad*B]
@@ -166,13 +166,18 @@ def pack_segment(seg: FrozenSegment, fields: list[str] | None = None,
     )
 
 
-def agg_doc_rows(seg: FrozenSegment, field: str) -> np.ndarray:
+def agg_doc_rows(seg: FrozenSegment, field: str) -> np.ndarray | None:
     """Per-doc metric folds of one numeric column: float32 [5, doc_count] rows
-    (count, sum, min, max, sumsq). Multi-valued docs fold exactly (cumsum
-    difference / reduceat over the CSR); docs with no value carry count 0 and
-    ±inf min/max so the kernel's masked reductions ignore them. Values are
-    float32 on device — double-typed columns round to 7 significant digits
-    (float/integer columns are exact)."""
+    (count, sum, min, max, sumsq), or None when the column is INTEGER-valued but
+    not exactly float32-representable (longs/dates past 2^24: integers are
+    semantically exact — epoch millis shifted by f32 rounding would be a wrong
+    answer, so those columns stay on the exact host collectors). Fractional
+    columns are inherently approximate reals and take the f32 kernel (~1e-7
+    relative rounding, same as an ES `float`-typed field).
+
+    Multi-valued docs fold exactly (cumsum difference / reduceat over the CSR);
+    docs with no value carry count 0 and ±inf min/max so the kernel's masked
+    reductions ignore them."""
     D = seg.doc_count
     rows = np.zeros((5, D), dtype=np.float32)
     rows[2] = np.inf
@@ -181,6 +186,10 @@ def agg_doc_rows(seg: FrozenSegment, field: str) -> np.ndarray:
     if col is None:
         return rows
     off, vals = col
+    if len(vals) and not np.array_equal(
+            vals.astype(np.float32).astype(np.float64), vals) \
+            and np.all(vals == np.floor(vals)):
+        return None
     counts = np.diff(off)
     c = np.zeros(len(vals) + 1)
     np.cumsum(vals, out=c[1:])
@@ -190,12 +199,12 @@ def agg_doc_rows(seg: FrozenSegment, field: str) -> np.ndarray:
     sumsq = c2[off[1:]] - c2[off[:-1]]
     has = counts > 0
     if len(vals):
-        # reduceat yields garbage for empty segments (off[i] == off[i+1]) — those
-        # entries are masked by `has`; indices are clipped so the final empty doc
-        # can't index past the values array
-        idx = np.minimum(off[:-1], len(vals) - 1)
-        rows[2][has] = np.minimum.reduceat(vals, idx)[has]
-        rows[3][has] = np.maximum.reduceat(vals, idx)[has]
+        # reduceat over the value-holding docs' true start offsets: consecutive
+        # starts delimit exactly each such doc's value run (clipping off[:-1]
+        # would TRUNCATE the previous doc's run when trailing docs are empty)
+        starts = off[:-1][has]
+        rows[2][has] = np.minimum.reduceat(vals, starts)
+        rows[3][has] = np.maximum.reduceat(vals, starts)
     rows[0] = counts
     rows[1] = sums
     rows[4] = sumsq
@@ -215,9 +224,10 @@ def _pad_agg_rows(rows: np.ndarray, doc_pad: int, base: int = 0,
 
 
 def ensure_agg_rows(seg: FrozenSegment, packed: PackedSegment, fields: list[str]):
-    """Device-resident [F, 5, Dpad] stack for `fields` — rows cached per field,
-    the stacked array per fields-tuple (FIFO-bounded) so the agg hot path never
-    re-copies on repeat queries."""
+    """Device-resident [F, 5, Dpad] stack for `fields`, or None when any column
+    is not f32-exact (callers fall back to the host collectors). Per-field rows
+    cache HOST-side; only the per-tuple device stacks (FIFO-bounded) hold device
+    memory — mirroring ensure_mesh_agg_stack."""
     import jax.numpy as jnp
 
     key = tuple(fields)
@@ -226,9 +236,12 @@ def ensure_agg_rows(seg: FrozenSegment, packed: PackedSegment, fields: list[str]
         return stack
     for f in fields:
         if f not in packed.agg_rows:
-            packed.agg_rows[f] = jnp.asarray(
-                _pad_agg_rows(agg_doc_rows(seg, f), packed.doc_pad))
-    stack = jnp.stack([packed.agg_rows[f] for f in fields])
+            rows = agg_doc_rows(seg, f)
+            packed.agg_rows[f] = (None if rows is None
+                                  else _pad_agg_rows(rows, packed.doc_pad))
+    if any(packed.agg_rows[f] is None for f in fields):
+        return None
+    stack = jnp.asarray(np.stack([packed.agg_rows[f] for f in fields]))
     while len(packed.agg_stacks) >= 8:
         packed.agg_stacks.pop(next(iter(packed.agg_stacks)))
     packed.agg_stacks[key] = stack

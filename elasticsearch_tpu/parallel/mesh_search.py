@@ -229,9 +229,10 @@ _AGG_STACK_CACHE_MAX = 8  # distinct fields-tuples kept on device per generation
 
 def ensure_mesh_agg_stack(index: ShardedIndex, fields: tuple):
     """Device [S, F, 5, Dpad] per-doc metric folds for `fields`, sharded along
-    "shards". Per-field host rows are computed once per packed generation;
-    per-tuple device stacks are FIFO-bounded so rotating agg field sets can't
-    grow device memory unboundedly."""
+    "shards" — or None when any column is not f32-exact (serving falls back to
+    the transport/host path). Per-field host rows are computed once per packed
+    generation; per-tuple device stacks are FIFO-bounded so rotating agg field
+    sets can't grow device memory unboundedly."""
     import jax
     import jax.numpy as jnp
 
@@ -249,9 +250,16 @@ def ensure_mesh_agg_stack(index: ShardedIndex, fields: tuple):
         host_f[:, 3] = -np.inf
         for si, searcher in enumerate(index.searchers):
             for seg, base in zip(searcher.segments, searcher.bases):
-                _pad_agg_rows(agg_doc_rows(seg, f), index.doc_pad, base,
-                              out=host_f[si])
+                rows = agg_doc_rows(seg, f)
+                if rows is None:
+                    host_f = None
+                    break
+                _pad_agg_rows(rows, index.doc_pad, base, out=host_f[si])
+            if host_f is None:
+                break
         index.agg_field_rows[f] = host_f
+    if any(index.agg_field_rows[f] is None for f in fields):
+        return None
     host = np.stack([index.agg_field_rows[f] for f in fields], axis=1)
     if index.mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P

@@ -184,6 +184,8 @@ class MeshServingService:
 
             fields = tuple(sorted(set(agg_fields.values())))
             agg_rows = ensure_mesh_agg_stack(executor.index, fields)
+            if agg_rows is None:
+                return None  # column not f32-exact → transport/host path
 
         out = executor.search([plan], k, filter_masks=filter_masks,
                               agg_rows=agg_rows)

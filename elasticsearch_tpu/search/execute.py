@@ -695,6 +695,8 @@ def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
         packed = packed_for(seg)
         _ensure_norm_rows(packed, all_fields)
         stack = ensure_agg_rows(seg, packed, fields)
+        if stack is None:
+            return None, None  # column not f32-exact → host collectors
         entries = _dense_entries(finals, seg, packed, field_idx)
         batch = build_term_batch(entries, 1, n_must, msm, coord_tbl,
                                  list(all_fields), caches_stack,

@@ -234,6 +234,8 @@ def _try_device_aggs(ctx: ShardContext, req: ParsedSearchRequest, k: int,
     fields = sorted(set(agg_fields.values()))
     fpos = {f: i for i, f in enumerate(fields)}
     td, seg_stats = execute_flat_aggs(plan, ctx, max(k, 1), fields)
+    if td is None:
+        return None  # a column wasn't f32-exact — host path
     agg_partials = [
         {name: device_partial(agg, counts[fpos[agg_fields[name]]],
                               stats[fpos[agg_fields[name]]])

@@ -133,6 +133,276 @@ static PyObject *py_djb2(PyObject *self, PyObject *arg) {
     return PyLong_FromLong((long)signed_h);
 }
 
+/* ----------------------------------------------------------------------------
+ * PostingsBuilder — the segment builder's accumulation hot loop in C.
+ *
+ * The Python SegmentBuilder spends most of bulk indexing in per-token dict/list
+ * churn (_add_fields) and per-term freeze loops; this object keeps postings in
+ * C arrays: a (field, term) hash table of slots, each slot holding parallel
+ * (doc, freq) arrays plus a concatenated positions buffer. Docs arrive in
+ * increasing local order, so per-term doc lists are ALREADY sorted at freeze —
+ * no sorting beyond the term dictionary. freeze() emits the exact CSR layout
+ * FrozenSegment uses (term-major, UTF-8 byte order per field == Python's
+ * code-point sorted()), returned as bytes for zero-conversion numpy views.
+ */
+
+typedef struct {
+    char *term;
+    int32_t term_len;
+    int32_t fid;
+    int32_t *docs;   /* per-entry local doc ids (ascending by construction) */
+    int32_t *lens;   /* per-entry position counts (== freq) */
+    int32_t *pos;    /* concatenated positions, entry-major, token order */
+    int32_t ndocs, cap_docs;
+    int64_t npos, cap_pos;
+} Slot;
+
+typedef struct {
+    PyObject_HEAD
+    Slot *slots;
+    int32_t nslots, cap_slots;
+    int32_t *table;     /* open addressing: slot index + 1, 0 = empty */
+    int64_t table_cap;  /* power of two */
+    int64_t total_entries, total_pos;
+} PBObject;
+
+static uint64_t pb_hash(const char *s, Py_ssize_t n, int32_t fid) {
+    uint64_t h = 1469598103934665603ULL ^ (uint64_t)(uint32_t)fid * 0x9E3779B1ULL;
+    Py_ssize_t i;
+    for (i = 0; i < n; i++) { h ^= (unsigned char)s[i]; h *= 1099511628211ULL; }
+    return h;
+}
+
+static int pb_table_grow(PBObject *pb) {
+    int64_t ncap = pb->table_cap ? pb->table_cap * 2 : 1024;
+    int32_t *nt = (int32_t *)calloc((size_t)ncap, sizeof(int32_t));
+    if (!nt) { PyErr_NoMemory(); return -1; }
+    int32_t i;
+    for (i = 0; i < pb->nslots; i++) {
+        Slot *sl = &pb->slots[i];
+        uint64_t h = pb_hash(sl->term, sl->term_len, sl->fid);
+        int64_t j = (int64_t)(h & (uint64_t)(ncap - 1));
+        while (nt[j]) j = (j + 1) & (ncap - 1);
+        nt[j] = i + 1;
+    }
+    free(pb->table);
+    pb->table = nt;
+    pb->table_cap = ncap;
+    return 0;
+}
+
+static Slot *pb_slot_for(PBObject *pb, const char *term, Py_ssize_t tlen, int32_t fid) {
+    if (pb->table_cap == 0 || (int64_t)pb->nslots * 2 >= pb->table_cap)
+        if (pb_table_grow(pb) < 0) return NULL;
+    uint64_t h = pb_hash(term, tlen, fid);
+    int64_t j = (int64_t)(h & (uint64_t)(pb->table_cap - 1));
+    while (pb->table[j]) {
+        Slot *sl = &pb->slots[pb->table[j] - 1];
+        if (sl->fid == fid && sl->term_len == (int32_t)tlen &&
+            memcmp(sl->term, term, (size_t)tlen) == 0)
+            return sl;
+        j = (j + 1) & (pb->table_cap - 1);
+    }
+    if (pb->nslots == pb->cap_slots) {
+        int32_t ncap = pb->cap_slots ? pb->cap_slots * 2 : 256;
+        Slot *ns = (Slot *)realloc(pb->slots, (size_t)ncap * sizeof(Slot));
+        if (!ns) { PyErr_NoMemory(); return NULL; }
+        pb->slots = ns;
+        pb->cap_slots = ncap;
+    }
+    Slot *sl = &pb->slots[pb->nslots];
+    memset(sl, 0, sizeof(Slot));
+    sl->term = (char *)malloc((size_t)tlen ? (size_t)tlen : 1);
+    if (!sl->term) { PyErr_NoMemory(); return NULL; }
+    memcpy(sl->term, term, (size_t)tlen);
+    sl->term_len = (int32_t)tlen;
+    sl->fid = fid;
+    pb->table[j] = ++pb->nslots;
+    return sl;
+}
+
+/* add(fid, local, terms): terms = [(term_str, position_int), ...] in token order */
+static PyObject *pb_add(PBObject *pb, PyObject *args) {
+    int fid, local;
+    PyObject *terms;
+    if (!PyArg_ParseTuple(args, "iiO", &fid, &local, &terms)) return NULL;
+    PyObject *seq = PySequence_Fast(terms, "terms must be a sequence");
+    if (!seq) return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq), i;
+    for (i = 0; i < n; i++) {
+        PyObject *pair = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) != 2) {
+            PyErr_SetString(PyExc_TypeError, "terms entries must be (term, pos)");
+            Py_DECREF(seq); return NULL;
+        }
+        PyObject *t = PyTuple_GET_ITEM(pair, 0);
+        long pos = PyLong_AsLong(PyTuple_GET_ITEM(pair, 1));
+        if (pos == -1 && PyErr_Occurred()) { Py_DECREF(seq); return NULL; }
+        Py_ssize_t tlen = 0;
+        const char *ts = PyUnicode_AsUTF8AndSize(t, &tlen);
+        if (!ts) { Py_DECREF(seq); return NULL; }
+        Slot *sl = pb_slot_for(pb, ts, tlen, (int32_t)fid);
+        if (!sl) { Py_DECREF(seq); return NULL; }
+        if (sl->ndocs && sl->docs[sl->ndocs - 1] == (int32_t)local) {
+            sl->lens[sl->ndocs - 1]++;
+        } else {
+            if (sl->ndocs == sl->cap_docs) {
+                int32_t ncap = sl->cap_docs ? sl->cap_docs * 2 : 4;
+                int32_t *nd = (int32_t *)realloc(sl->docs, (size_t)ncap * 4);
+                if (!nd) { PyErr_NoMemory(); Py_DECREF(seq); return NULL; }
+                sl->docs = nd;
+                int32_t *nl = (int32_t *)realloc(sl->lens, (size_t)ncap * 4);
+                if (!nl) { PyErr_NoMemory(); Py_DECREF(seq); return NULL; }
+                sl->lens = nl;
+                sl->cap_docs = ncap; /* only after BOTH grew */
+            }
+            sl->docs[sl->ndocs] = (int32_t)local;
+            sl->lens[sl->ndocs] = 1;
+            sl->ndocs++;
+            pb->total_entries++;
+        }
+        if (sl->npos == sl->cap_pos) {
+            int64_t ncap = sl->cap_pos ? sl->cap_pos * 2 : 8;
+            int32_t *np_ = (int32_t *)realloc(sl->pos, (size_t)ncap * 4);
+            if (!np_) { PyErr_NoMemory(); Py_DECREF(seq); return NULL; }
+            sl->pos = np_; sl->cap_pos = ncap;
+        }
+        sl->pos[sl->npos++] = (int32_t)pos;
+        pb->total_pos++;
+    }
+    Py_DECREF(seq);
+    Py_RETURN_NONE;
+}
+
+static int pb_cmp_slots(const void *a, const void *b) {
+    const Slot *x = *(const Slot *const *)a, *y = *(const Slot *const *)b;
+    if (x->fid != y->fid) return x->fid < y->fid ? -1 : 1; /* fid pre-ranked */
+    int32_t m = x->term_len < y->term_len ? x->term_len : y->term_len;
+    int c = memcmp(x->term, y->term, (size_t)m);
+    if (c) return c;
+    return x->term_len - y->term_len;
+}
+
+/* freeze(fid_rank): fid_rank[fid] = output position of the field (fields sorted
+ * by NAME on the Python side). Returns (terms_per_rank, post_offsets_i64,
+ * post_docs_i32, post_freqs_f32, pos_offsets_i64, positions_i32) with the
+ * buffer outputs as bytes. */
+static PyObject *pb_freeze(PBObject *pb, PyObject *arg) {
+    PyObject *rank_seq = PySequence_Fast(arg, "fid_rank must be a sequence");
+    if (!rank_seq) return NULL;
+    Py_ssize_t nfields = PySequence_Fast_GET_SIZE(rank_seq);
+    int32_t *rank = (int32_t *)malloc(((size_t)nfields ? (size_t)nfields : 1) * 4);
+    if (!rank) { Py_DECREF(rank_seq); return PyErr_NoMemory(); }
+    Py_ssize_t i;
+    for (i = 0; i < nfields; i++) {
+        long r = PyLong_AsLong(PySequence_Fast_GET_ITEM(rank_seq, i));
+        if (r == -1 && PyErr_Occurred()) { free(rank); Py_DECREF(rank_seq); return NULL; }
+        rank[i] = (int32_t)r;
+    }
+    Py_DECREF(rank_seq);
+
+    Slot **order = (Slot **)malloc(((size_t)pb->nslots ? (size_t)pb->nslots : 1)
+                                   * sizeof(Slot *));
+    if (!order) { free(rank); return PyErr_NoMemory(); }
+    int32_t s;
+    /* temporarily rewrite fid to its rank so one qsort orders (field, term) */
+    for (s = 0; s < pb->nslots; s++) {
+        Slot *sl = &pb->slots[s];
+        sl->fid = (sl->fid < (int32_t)nfields) ? rank[sl->fid] : sl->fid;
+        order[s] = sl;
+    }
+    qsort(order, (size_t)pb->nslots, sizeof(Slot *), pb_cmp_slots);
+
+    int64_t T = pb->nslots, P = pb->total_entries, PP = pb->total_pos;
+    PyObject *off_b = PyBytes_FromStringAndSize(NULL, (T + 1) * 8);
+    PyObject *docs_b = PyBytes_FromStringAndSize(NULL, P * 4);
+    PyObject *freqs_b = PyBytes_FromStringAndSize(NULL, P * 4);
+    PyObject *poff_b = PyBytes_FromStringAndSize(NULL, (P + 1) * 8);
+    PyObject *pos_b = PyBytes_FromStringAndSize(NULL, PP * 4);
+    PyObject *terms_out = PyList_New(nfields);
+    if (!off_b || !docs_b || !freqs_b || !poff_b || !pos_b || !terms_out) goto fail;
+    for (i = 0; i < nfields; i++) {
+        PyObject *lst = PyList_New(0);
+        if (!lst) goto fail;
+        PyList_SET_ITEM(terms_out, i, lst);
+    }
+    {
+        int64_t *off = (int64_t *)PyBytes_AS_STRING(off_b);
+        int32_t *docs = (int32_t *)PyBytes_AS_STRING(docs_b);
+        float *freqs = (float *)PyBytes_AS_STRING(freqs_b);
+        int64_t *poff = (int64_t *)PyBytes_AS_STRING(poff_b);
+        int32_t *posout = (int32_t *)PyBytes_AS_STRING(pos_b);
+        int64_t doc_at = 0, pos_at = 0;
+        off[0] = 0; poff[0] = 0;
+        for (s = 0; s < pb->nslots; s++) {
+            Slot *sl = order[s];
+            PyObject *tstr = PyUnicode_DecodeUTF8(sl->term, sl->term_len, "replace");
+            if (!tstr) goto fail;
+            if (sl->fid >= 0 && sl->fid < (int32_t)nfields) {
+                if (PyList_Append(PyList_GET_ITEM(terms_out, sl->fid), tstr) < 0) {
+                    Py_DECREF(tstr); goto fail;
+                }
+            }
+            Py_DECREF(tstr);
+            memcpy(docs + doc_at, sl->docs, (size_t)sl->ndocs * 4);
+            int32_t e;
+            int64_t sp = 0;
+            for (e = 0; e < sl->ndocs; e++) {
+                freqs[doc_at + e] = (float)sl->lens[e];
+                sp += sl->lens[e];
+                poff[doc_at + e + 1] = pos_at + sp;
+            }
+            memcpy(posout + pos_at, sl->pos, (size_t)sl->npos * 4);
+            pos_at += sl->npos;
+            doc_at += sl->ndocs;
+            off[s + 1] = doc_at;
+        }
+    }
+    free(order); free(rank);
+    PyObject *out = Py_BuildValue("(OOOOOO)", terms_out, off_b, docs_b, freqs_b,
+                                  poff_b, pos_b);
+    Py_DECREF(terms_out); Py_DECREF(off_b); Py_DECREF(docs_b);
+    Py_DECREF(freqs_b); Py_DECREF(poff_b); Py_DECREF(pos_b);
+    return out;
+fail:
+    free(order); free(rank);
+    Py_XDECREF(off_b); Py_XDECREF(docs_b); Py_XDECREF(freqs_b);
+    Py_XDECREF(poff_b); Py_XDECREF(pos_b); Py_XDECREF(terms_out);
+    return NULL;
+}
+
+static void pb_dealloc(PBObject *pb) {
+    int32_t i;
+    for (i = 0; i < pb->nslots; i++) {
+        free(pb->slots[i].term);
+        free(pb->slots[i].docs);
+        free(pb->slots[i].lens);
+        free(pb->slots[i].pos);
+    }
+    free(pb->slots);
+    free(pb->table);
+    Py_TYPE(pb)->tp_free((PyObject *)pb);
+}
+
+static PyMethodDef pb_methods[] = {
+    {"add", (PyCFunction)pb_add, METH_VARARGS,
+     "add(fid, local_doc, [(term, pos), ...]) in token order"},
+    {"freeze", (PyCFunction)pb_freeze, METH_O,
+     "freeze(fid_rank) -> (terms_per_rank, off, docs, freqs, pos_off, positions)"},
+    {NULL, NULL, 0, NULL},
+};
+
+static PyTypeObject PBType = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "estpu_native.PostingsBuilder",
+    .tp_basicsize = sizeof(PBObject),
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_new = PyType_GenericNew,
+    .tp_dealloc = (destructor)pb_dealloc,
+    .tp_methods = pb_methods,
+    .tp_doc = "C postings accumulator for SegmentBuilder",
+};
+
 static PyMethodDef Methods[] = {
     {"tokenize_batch", (PyCFunction)py_tokenize_batch, METH_VARARGS | METH_KEYWORDS,
      "tokenize_batch(texts, lowercase=True) -> list[list[str]]"},
@@ -146,5 +416,12 @@ static struct PyModuleDef moduledef = {
 };
 
 PyMODINIT_FUNC PyInit_estpu_native(void) {
-    return PyModule_Create(&moduledef);
+    PyObject *m = PyModule_Create(&moduledef);
+    if (!m) return NULL;
+    if (PyType_Ready(&PBType) < 0) { Py_DECREF(m); return NULL; }
+    Py_INCREF(&PBType);
+    if (PyModule_AddObject(m, "PostingsBuilder", (PyObject *)&PBType) < 0) {
+        Py_DECREF(&PBType); Py_DECREF(m); return NULL;
+    }
+    return m;
 }

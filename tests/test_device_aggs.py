@@ -135,6 +135,54 @@ def test_ineligible_aggs_fall_back(ctx, aggs):
     assert reduce_aggs(req.aggs, res.agg_partials)["x"] is not None
 
 
+def test_trailing_valueless_docs_dont_truncate_minmax():
+    # regression: reduceat index clipping truncated the PREVIOUS doc's value run
+    # when trailing docs lacked the field — max([1, 9]) came back as 1
+    import tempfile
+
+    from elasticsearch_tpu.ops.device_index import agg_doc_rows
+
+    svc = MapperService(Settings.from_flat({}))
+    eng = Engine(tempfile.mkdtemp(), svc)
+    eng.index("doc", "0", {"body": "alpha", "v": [1, 9]})
+    eng.index("doc", "1", {"body": "alpha"})  # no v — trailing value-less doc
+    eng.refresh()
+    seg = eng.acquire_searcher().segments[0]
+    rows = agg_doc_rows(seg, "v")
+    assert rows[3][0] == 9.0 and rows[2][0] == 1.0
+    ctx2 = ShardContext(eng.acquire_searcher(), svc,
+                        SimilarityService(Settings.from_flat({}), mapper_service=svc))
+    _ = ctx2
+    req = parse_search_body({"query": {"match": {"body": "alpha"}},
+                             "aggs": {"m": {"max": {"field": "v"}}}})
+    res = execute_query_phase(ctx2, req, use_device=True)
+    assert reduce_aggs(req.aggs, res.agg_partials)["m"]["value"] == 9.0
+    eng.close()
+
+
+def test_f32_inexact_column_falls_back_to_host():
+    # values past 2^24 (longs/dates) are not float32-exact: the device path must
+    # refuse and the host collectors serve the exact numbers
+    import tempfile
+
+    from elasticsearch_tpu.search.service import _try_device_aggs as try_dev
+
+    svc = MapperService(Settings.from_flat({}))
+    eng = Engine(tempfile.mkdtemp(), svc)
+    big = 1_700_000_000_123  # epoch-millis-sized long
+    for i in range(5):
+        eng.index("doc", str(i), {"body": "alpha", "ts_l": big + i})
+    eng.refresh()
+    c = ShardContext(eng.acquire_searcher(), svc,
+                     SimilarityService(Settings.from_flat({}), mapper_service=svc))
+    req = parse_search_body({"query": {"match": {"body": "alpha"}},
+                             "aggs": {"m": {"max": {"field": "ts_l"}}}})
+    assert try_dev(c, req, 3, None, 0) is None  # refused at row build
+    res = execute_query_phase(c, req, use_device=True)
+    assert reduce_aggs(req.aggs, res.agg_partials)["m"]["value"] == big + 4  # exact
+    eng.close()
+
+
 def test_unlowerable_query_falls_back(ctx):
     req = parse_search_body({
         "query": {"match_all": {}},

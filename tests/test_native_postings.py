@@ -1,0 +1,105 @@
+"""The C postings accumulator must produce BYTE-identical frozen segments to the
+Python dict path — same term dictionary (field-name order, per-field term sort),
+same CSR arrays, same stats. ref: the reference's equivalent hot loop lives in
+native Lucene (SURVEY §2.8)."""
+
+from __future__ import annotations
+
+import tempfile
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index import segment as segmod
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.mapper.core import MapperService
+from elasticsearch_tpu.native import get_native
+
+
+def _build(docs, force_python: bool):
+    svc = MapperService(Settings.from_flat({}))
+    eng = Engine(tempfile.mkdtemp(), svc)
+    orig = segmod.SegmentBuilder.__init__
+    if force_python:
+        def patched(self, gen):
+            orig(self, gen)
+            self._pb = None
+        segmod.SegmentBuilder.__init__ = patched
+    try:
+        for i, d in enumerate(docs):
+            eng.index("doc", str(i), d)
+        eng.refresh()
+    finally:
+        segmod.SegmentBuilder.__init__ = orig
+    return eng
+
+
+def _assert_identical(a, b):
+    assert a.term_dict == b.term_dict
+    for name in ("post_offsets", "post_docs", "post_freqs", "pos_offsets",
+                 "positions"):
+        assert np.array_equal(getattr(a, name), getattr(b, name)), name
+    assert a.field_stats == b.field_stats
+    for f in b.norms:
+        assert np.array_equal(a.norms[f], b.norms[f]), f
+
+
+@pytest.mark.skipif(get_native() is None
+                    or not hasattr(get_native(), "PostingsBuilder"),
+                    reason="native extension unavailable")
+def test_native_and_python_builders_agree():
+    rng = np.random.default_rng(7)
+    vocab = [f"w{i}" for i in range(800)] + ["café", "zürich", "Ωmega", "a'postrophe"]
+    docs = []
+    for i in range(300):
+        d = {"body": " ".join(rng.choice(vocab, size=int(rng.integers(1, 40)))),
+             "title": " ".join(rng.choice(vocab, size=3)),
+             "tag": f"t{i % 9}", "n": int(i)}
+        if i % 11 == 0:
+            d["body"] = ""  # empty text
+        d["always_empty"] = ""  # field that NEVER produces a token on any doc —
+        # must not appear in term_dict on either path
+        if i % 13 == 0:
+            d["multi"] = ["alpha beta", "beta gamma"]  # position gaps between values
+        if i % 17 == 0:
+            d["nested_kids"] = [{"k": "x y"}, {"k": "y z"}]
+        docs.append(d)
+    e1 = _build(docs, force_python=False)
+    e2 = _build(docs, force_python=True)
+    s1 = e1.acquire_searcher().segments
+    s2 = e2.acquire_searcher().segments
+    assert len(s1) == len(s2)
+    for a, b in zip(s1, s2):
+        _assert_identical(a, b)
+    e1.close()
+    e2.close()
+
+
+@pytest.mark.skipif(get_native() is None
+                    or not hasattr(get_native(), "PostingsBuilder"),
+                    reason="native extension unavailable")
+def test_native_builder_survives_merge_roundtrip():
+    # merge_segments rebuilds through a SegmentBuilder — the C path must
+    # reproduce positions (phrase queries) and dv columns across the round trip
+    svc = MapperService(Settings.from_flat({}))
+    eng = Engine(tempfile.mkdtemp(), svc)
+    for i in range(60):
+        eng.index("doc", str(i), {"body": f"quick brown fox {i % 5} jumps"})
+        if i in (19, 39):
+            eng.refresh()
+    eng.refresh()
+    eng.optimize(max_num_segments=1)
+    eng.refresh()
+    searcher = eng.acquire_searcher()
+    assert len(searcher.segments) == 1
+    from elasticsearch_tpu.search import ShardContext, parse_query
+    from elasticsearch_tpu.search.execute import search_shard
+    from elasticsearch_tpu.search.similarity import SimilarityService
+
+    ctx = ShardContext(searcher, svc,
+                       SimilarityService(Settings.from_flat({}), mapper_service=svc))
+    td = search_shard(ctx, parse_query({"match_phrase": {"body": "quick brown fox"}}),
+                      100, use_device=False)
+    assert td.total == 60
+    eng.close()
