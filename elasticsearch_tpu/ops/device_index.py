@@ -75,6 +75,7 @@ class PackedSegment:
     # under the match mask — SURVEY §5.7 "shard-level parallel reduce")
     agg_rows: dict = dc_field(default_factory=dict)  # field -> HOST f32 [5, Dpad] | None (not f32-exact)
     agg_stacks: dict = dc_field(default_factory=dict)  # fields-tuple -> device [F, 5, Dpad], FIFO-bounded
+    bucket_cols: dict = dc_field(default_factory=dict)  # bucket-agg cache key -> device (pair_doc, pair_bucket, zeros[NB])
     # host copies for re-bakes (live-mask refresh / similarity-stats drift)
     host_docs: np.ndarray | None = None  # int32 [NBpad*B] RAW (unmasked) doc ids
     host_freqs: np.ndarray | None = None  # float32 [NBpad*B]
@@ -241,7 +242,8 @@ def ensure_agg_rows(seg: FrozenSegment, packed: PackedSegment, fields: list[str]
                                   else _pad_agg_rows(rows, packed.doc_pad))
     if any(packed.agg_rows[f] is None for f in fields):
         return None
-    stack = jnp.asarray(np.stack([packed.agg_rows[f] for f in fields]))
+    stack = jnp.asarray(np.stack([packed.agg_rows[f] for f in fields])
+                        if fields else np.zeros((0, 5, packed.doc_pad), np.float32))
     while len(packed.agg_stacks) >= 8:
         packed.agg_stacks.pop(next(iter(packed.agg_stacks)))
     packed.agg_stacks[key] = stack

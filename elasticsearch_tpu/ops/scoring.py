@@ -431,7 +431,8 @@ def agg_stat_reduction(match, agg_rows):
 
 def _dense_aggstats_impl(blk_docs, blk_freqs, live_parent, norms_stack, caches,
                          qidx, blk, weight, fidx, group, tfmode, n_must, msm, coord,
-                         agg_rows,  # [F, 5, Dpad] f32: count/sum/min/max/sumsq
+                         agg_rows,  # [F, 5, Dpad] f32 (F may be 0)
+                         bucket_pairs,  # tuple of (pair_doc [NP], pair_bucket [NP], nb-sized zeros)
                          *, n_queries: int, k: int, doc_pad: int):
     import jax
     import jax.numpy as jnp
@@ -446,19 +447,29 @@ def _dense_aggstats_impl(blk_docs, blk_freqs, live_parent, norms_stack, caches,
     top_scores, top_docs = jax.lax.top_k(masked, k)
     total = match.sum(axis=1, dtype=jnp.int32)
     counts, stats = agg_stat_reduction(match, agg_rows)
-    return top_scores, top_docs, total, counts, stats
+    # bucket aggs: per deduplicated (doc, bucket) pair, scatter the match bit —
+    # doc counts are exact int32; keys live host-side
+    bucket_counts = tuple(
+        jnp.broadcast_to(zeros_nb, (Q,) + zeros_nb.shape).astype(jnp.int32)
+        .at[:, pbucket].add(match[:, pdoc].astype(jnp.int32))
+        for (pdoc, pbucket, zeros_nb) in bucket_pairs
+    )
+    return top_scores, top_docs, total, counts, stats, bucket_counts
 
 
 def score_agg_batch(packed: PackedSegment, batch: TermBatch, k: int,
-                    agg_row_stack):
+                    agg_row_stack, bucket_pairs=()):
     """Dense launch returning (scores, docs, total, counts [Q, F] int,
-    stats [Q, F, 4]) numpy. stats rows: (sum, min(+inf if none), max(-inf),
-    sumsq) over matched docs per agg field."""
+    stats [Q, F, 4], bucket_counts tuple of [Q, NB]) numpy. stats rows:
+    (sum, min(+inf if none), max(-inf), sumsq) over matched docs per agg field;
+    bucket_pairs: per bucket agg, (pair_doc, pair_bucket, zeros[NB]) device
+    arrays."""
     import jax
     import jax.numpy as jnp
 
     norms_stack, caches = _stack_args(packed, batch)
-    key = ("aggstats", batch.n_queries, min(k, packed.doc_pad), packed.doc_pad)
+    key = ("aggstats", batch.n_queries, min(k, packed.doc_pad), packed.doc_pad,
+           len(bucket_pairs))
     fn = _compiled_cache.get(key)
     if fn is None:
         def wrapper(*args):
@@ -468,15 +479,16 @@ def score_agg_batch(packed: PackedSegment, batch: TermBatch, k: int,
 
         fn = jax.jit(wrapper)
         _compiled_cache[key] = fn
-    top_scores, top_docs, total, counts, stats = fn(
+    top_scores, top_docs, total, counts, stats, bucket_counts = fn(
         packed.blk_docs, packed.blk_freqs, packed.live_parent, norms_stack, caches,
         jnp.asarray(batch.qidx), jnp.asarray(batch.blk), jnp.asarray(batch.weight),
         jnp.asarray(batch.fidx), jnp.asarray(batch.group), jnp.asarray(batch.tfmode),
         jnp.asarray(batch.n_must), jnp.asarray(batch.msm), jnp.asarray(batch.coord),
-        agg_row_stack,
+        agg_row_stack, tuple(bucket_pairs),
     )
     return (np.asarray(top_scores), np.asarray(top_docs), np.asarray(total),
-            np.asarray(counts), np.asarray(stats))
+            np.asarray(counts), np.asarray(stats),
+            tuple(np.asarray(c) for c in bucket_counts))
 
 
 def _detect_simple(batch: TermBatch) -> bool:

@@ -315,6 +315,86 @@ def device_partial(agg: Agg, count, st):
     raise QueryParsingError(f"not a device agg [{type(agg).__name__}]")
 
 
+def device_bucket_eligible(agg: Agg) -> bool:
+    """Bucket aggs the device path serves: terms / histogram / date_histogram on
+    a plain field with no sub-aggs. Bucket KEYS are computed host-side per
+    segment (exact — calendar bucketing included); only the per-bucket doc
+    counts ride the kernel (exact int32 scatter-add under the match mask)."""
+    if agg.subs or not agg.spec.get("field") or agg.spec.get("script"):
+        return False
+    return type(agg) in (TermsAgg, HistogramAgg, DateHistogramAgg)
+
+
+_BUCKET_CACHE_MAX = 8  # distinct bucket-agg shapes cached per segment
+
+
+def bucket_cache_key(agg: Agg) -> tuple:
+    """The ONE cache-key constructor for a bucket agg's per-segment columns —
+    shared by the host cache here and the device-array cache on PackedSegment
+    (execute.execute_flat_aggs) so the two can never drift. Every spec param
+    that changes the (pairs, keys) layout MUST appear here."""
+    return ("bucket_cols", type(agg).__name__, agg.spec.get("field"),
+            repr(agg.spec.get("interval")))
+
+
+def _bucket_cache_put(cache: dict, ckey: tuple, value):
+    """FIFO-bound the bucket entries (user-controlled intervals must not grow
+    memory unboundedly); non-bucket entries in the same dict are untouched."""
+    bucket_keys = [k for k in cache
+                   if isinstance(k, tuple) and k and k[0] == "bucket_cols"]
+    while len(bucket_keys) >= _BUCKET_CACHE_MAX:
+        cache.pop(bucket_keys.pop(0), None)
+    cache[ckey] = value
+    return value
+
+
+def bucket_cols_for(agg: Agg, seg) -> tuple:
+    """(pair_doc int32 [NP], pair_bucket int32 [NP], keys list) for one bucket
+    agg on one segment — deduplicated (doc, bucket) pairs, so the scatter counts
+    DOCS exactly like the host's bucket masks (a doc with duplicate values
+    counts once). Cached on the segment (host arrays; device copies cache on the
+    PackedSegment)."""
+    field = agg.spec.get("field")
+    ckey = bucket_cache_key(agg)
+    cached = seg._device_cache.get(ckey)
+    if cached is not None:
+        return cached
+    empty = (np.zeros(0, np.int32), np.zeros(0, np.int32), [])
+    if isinstance(agg, TermsAgg) and field in seg.dv_str:
+        uniq, off, ords = seg.dv_str[field]
+        if not len(uniq):
+            return _bucket_cache_put(seg._device_cache, ckey, empty)
+        counts = np.diff(off)
+        doc_of_val = np.repeat(np.arange(seg.doc_count, dtype=np.int64), counts)
+        pairs = np.unique(doc_of_val * len(uniq) + ords)
+        out = ((pairs // len(uniq)).astype(np.int32),
+               (pairs % len(uniq)).astype(np.int32), list(uniq))
+    else:
+        col = seg.dv_num.get(field)
+        if col is None or not len(col[1]):
+            return _bucket_cache_put(seg._device_cache, ckey, empty)
+        off, vals = col
+        counts = np.diff(off)
+        doc_of_val = np.repeat(np.arange(seg.doc_count, dtype=np.int64), counts)
+        if isinstance(agg, HistogramAgg):  # incl. DateHistogramAgg
+            kv = agg._key_for(vals)
+            uniq_k, inv = np.unique(kv, return_inverse=True)
+            keys = [float(k) for k in uniq_k]
+        else:
+            uniq_k, inv = np.unique(vals, return_inverse=True)
+            keys = [int(v) if float(v).is_integer() else float(v) for v in uniq_k]
+        pairs = np.unique(doc_of_val * len(uniq_k) + inv)
+        out = ((pairs // len(uniq_k)).astype(np.int32),
+               (pairs % len(uniq_k)).astype(np.int32), keys)
+    return _bucket_cache_put(seg._device_cache, ckey, out)
+
+
+def device_bucket_partial(agg: Agg, keys: list, counts: np.ndarray) -> list:
+    """Kernel counts → the SAME partial shape _BucketAgg.collect produces."""
+    return [{"key": k, "doc_count": int(c), "subs": {}}
+            for k, c in zip(keys, counts) if c > 0]
+
+
 class CardinalityAgg(Agg):
     """Distinct count via a HyperLogLog++ sketch — bounded memory (2^p bytes) on
     arbitrarily-high-cardinality fields, near-exact up to `precision_threshold`

@@ -676,14 +676,20 @@ def _execute_flat_fs(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list[T
 
 
 def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
-                      fields: list[str]):
-    """Single-plan dense execution with metric-agg stats fused into the kernel:
-    returns (TopDocs, per-segment (counts int [F], stats float32 [F, 4])) with
-    F = len(fields), stats = (sum, min, max, sumsq) over matched docs. Serving
-    uses this when every aggregation is a device-eligible metric
-    (service.execute_query_phase → aggregations.device_agg_fields)."""
+                      fields: list[str], bucket_aggs: list = ()):
+    """Single-plan dense execution with aggregations fused into the kernel:
+    returns (TopDocs, per-segment (counts int [F], stats float32 [F, 4],
+    bucket list of (keys, counts))) with F = len(fields), stats =
+    (sum, min, max, sumsq) over matched docs. bucket_aggs: Agg objects whose
+    (doc, bucket) pairs ride the kernel's scatter (aggregations.bucket_cols_for).
+    Serving uses this when every aggregation is device-eligible
+    (service.execute_query_phase → aggregations.device_agg_fields /
+    device_bucket_eligible)."""
+    import jax.numpy as jnp
+
     from ..ops.device_index import ensure_agg_rows, packed_for
     from ..ops.scoring import build_term_batch, score_agg_batch
+    from .aggregations import bucket_cache_key, bucket_cols_for
 
     finals = [finalize_flat(plan, ctx)]
     (all_fields, field_idx, _cache_rows, caches_stack,
@@ -697,16 +703,32 @@ def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
         stack = ensure_agg_rows(seg, packed, fields)
         if stack is None:
             return None, None  # column not f32-exact → host collectors
+        pair_args = []
+        seg_keys = []
+        for agg in bucket_aggs:
+            pdoc, pbucket, keys = bucket_cols_for(agg, seg)
+            ck = bucket_cache_key(agg)  # same constructor as the host cache
+            dev = packed.bucket_cols.get(ck)
+            if dev is None:
+                dev = (jnp.asarray(pdoc), jnp.asarray(pbucket),
+                       jnp.zeros(len(keys), jnp.int32))
+                while len(packed.bucket_cols) >= 8:
+                    packed.bucket_cols.pop(next(iter(packed.bucket_cols)))
+                packed.bucket_cols[ck] = dev
+            pair_args.append(dev)
+            seg_keys.append(keys)
         entries = _dense_entries(finals, seg, packed, field_idx)
         batch = build_term_batch(entries, 1, n_must, msm, coord_tbl,
                                  list(all_fields), caches_stack,
                                  nb_pad_row=packed.blk_docs.shape[0] - 1)
-        scores, docs, tq, counts, stats = score_agg_batch(packed, batch, k, stack)
+        scores, docs, tq, counts, stats, bcounts = score_agg_batch(
+            packed, batch, k, stack, tuple(pair_args))
         totals += tq
         valid = (docs < min(packed.doc_pad, seg.doc_count)) & np.isfinite(scores)
         gdocs = np.where(valid, docs.astype(np.int64) + base, np.int64(2**62))
         seg_hits.append((np.where(valid, scores, -np.inf), gdocs))
-        seg_stats.append((counts[0], stats[0]))
+        seg_stats.append((counts[0], stats[0],
+                          [(keys, bc[0]) for keys, bc in zip(seg_keys, bcounts)]))
     return _merge_seg_hits(seg_hits, totals, 1, k)[0], seg_stats
 
 

@@ -220,30 +220,47 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
 
 def _try_device_aggs(ctx: ShardContext, req: ParsedSearchRequest, k: int,
                      suggest_out, shard_id: int) -> "ShardQueryResult | None":
-    """Serve query + metric aggs in one fused device program per segment; None
-    when any agg (or the query) needs the host path."""
-    from .aggregations import device_agg_fields, device_partial
+    """Serve query + aggregations in one fused device program per segment; None
+    when any agg (or the query) needs the host path. Metric aggs reduce to
+    masked stats, bucket aggs (terms/histogram/date_histogram) to exact
+    scatter-add doc counts over host-computed keys."""
+    from .aggregations import (device_agg_field, device_bucket_eligible,
+                               device_bucket_partial, device_partial)
     from .execute import execute_flat_aggs
 
-    agg_fields = device_agg_fields(req.aggs, ctx)
-    if agg_fields is None:
-        return None
+    metric_fields = {}
+    bucket_names = []
+    for name, agg in req.aggs.items():
+        f = device_agg_field(agg, ctx)
+        if f is not None:
+            metric_fields[name] = f
+        elif device_bucket_eligible(agg):
+            bucket_names.append(name)
+        else:
+            return None
     plan = lower_flat(req.query, ctx)
     if plan is None or plan.fs is not None:
         return None
-    fields = sorted(set(agg_fields.values()))
+    fields = sorted(set(metric_fields.values()))
     fpos = {f: i for i, f in enumerate(fields)}
-    td, seg_stats = execute_flat_aggs(plan, ctx, max(k, 1), fields)
+    bucket_aggs = [req.aggs[n] for n in bucket_names]
+    # kernel k is at least 1 so max_score stays observable; hits trim to the
+    # requested size below (size=0 agg-only requests return no docs, like the
+    # host mask path)
+    td, seg_stats = execute_flat_aggs(plan, ctx, max(k, 1), fields, bucket_aggs)
     if td is None:
         return None  # a column wasn't f32-exact — host path
+    bpos = {n: i for i, n in enumerate(bucket_names)}
     agg_partials = [
-        {name: device_partial(agg, counts[fpos[agg_fields[name]]],
-                              stats[fpos[agg_fields[name]]])
+        {name: (device_partial(agg, counts[fpos[metric_fields[name]]],
+                               stats[fpos[metric_fields[name]]])
+                if name in metric_fields
+                else device_bucket_partial(agg, *buckets[bpos[name]]))
          for name, agg in req.aggs.items()}
-        for (counts, stats) in seg_stats
+        for (counts, stats, buckets) in seg_stats
     ]
     return ShardQueryResult(
-        total=td.total, docs=[(s, d, None) for s, d in td.hits],
+        total=td.total, docs=[(s, d, None) for s, d in td.hits[:max(k, 0)]],
         max_score=td.max_score, agg_partials=agg_partials, suggest=suggest_out,
         shard_id=shard_id,
     )

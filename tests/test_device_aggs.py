@@ -59,6 +59,23 @@ def ctx():
     eng.close()
 
 
+def _agg_equal(a, b, path=""):
+    if isinstance(a, dict) and isinstance(b, dict):
+        assert set(a) == set(b), (path, a, b)
+        for k2 in a:
+            _agg_equal(a[k2], b[k2], f"{path}.{k2}")
+    elif isinstance(a, list) and isinstance(b, list):
+        assert len(a) == len(b), (path, a, b)
+        for i, (x, y) in enumerate(zip(a, b)):
+            _agg_equal(x, y, f"{path}[{i}]")
+    elif a is None or b is None:
+        assert a is None and b is None, (path, a, b)
+    elif isinstance(a, float) or isinstance(b, float):
+        assert a == pytest.approx(b, rel=1e-5), (path, a, b)
+    else:
+        assert a == b, (path, a, b)
+
+
 def _both(ctx, body):
     req = parse_search_body(body)
     dev = execute_query_phase(ctx, req, use_device=True)
@@ -69,12 +86,7 @@ def _both(ctx, body):
     dr = reduce_aggs(req.aggs, dev.agg_partials)
     hr = reduce_aggs(req.aggs, host.agg_partials)
     for name in dr:
-        df, hf = dr[name], hr[name]
-        for k2 in df:
-            if df[k2] is None or hf[k2] is None:
-                assert df[k2] is None and hf[k2] is None, (name, k2, df, hf)
-            else:
-                assert df[k2] == pytest.approx(hf[k2], rel=1e-5), (name, k2)
+        _agg_equal(dr[name], hr[name], name)
     return req
 
 
@@ -122,9 +134,11 @@ def test_no_matches_yields_empty_stats(ctx):
 @pytest.mark.parametrize("aggs", [
     {"x": {"extended_stats": {"field": "price"}}},  # variance: host-only
     {"x": {"avg": {"script": "doc['price'].value * 2"}}},  # script agg
-    {"x": {"terms": {"field": "label"}}},  # bucket agg
+    {"x": {"terms": {"field": "label"},
+           "aggs": {"s": {"sum": {"field": "pop"}}}}},  # bucket with sub-aggs
     {"x": {"value_count": {"field": "label"}}},  # string column
     {"x": {"cardinality": {"field": "pop"}}},  # sketch agg
+    {"x": {"range": {"field": "pop", "ranges": [{"to": 50}]}}},  # range agg
 ])
 def test_ineligible_aggs_fall_back(ctx, aggs):
     body = {"query": {"match": {"body": "alpha"}}, "size": 3, "aggs": aggs}
@@ -133,6 +147,52 @@ def test_ineligible_aggs_fall_back(ctx, aggs):
     # and the host path still serves them correctly end to end
     res = execute_query_phase(ctx, req, use_device=True)
     assert reduce_aggs(req.aggs, res.agg_partials)["x"] is not None
+
+
+def test_terms_agg_parity(ctx):
+    # terms on a string column AND on a numeric column, plus multi-valued docs
+    # (duplicate values in one doc must count the doc ONCE)
+    req = _both(ctx, {
+        "query": {"match": {"body": "alpha"}}, "size": 0,
+        "aggs": {"by_label": {"terms": {"field": "label", "size": 20}},
+                 "by_pop": {"terms": {"field": "pop", "size": 50}},
+                 "by_tag": {"terms": {"field": "tags_n", "size": 20}}}})
+    assert _try_device_aggs(ctx, req, 1, None, 0) is not None
+
+
+def test_histogram_parity(ctx):
+    req = _both(ctx, {
+        "query": {"match": {"body": "beta gamma"}}, "size": 0,
+        "aggs": {"h": {"histogram": {"field": "price", "interval": 10}},
+                 "hm": {"histogram": {"field": "tags_n", "interval": 2}}}})
+    assert _try_device_aggs(ctx, req, 1, None, 0) is not None
+
+
+def test_mixed_metric_and_bucket_aggs(ctx):
+    req = _both(ctx, {
+        "query": {"match": {"body": "delta"}}, "size": 3,
+        "aggs": {"by_label": {"terms": {"field": "label"}},
+                 "p_avg": {"avg": {"field": "price"}},
+                 "h": {"histogram": {"field": "price", "interval": 25}}}})
+    assert _try_device_aggs(ctx, req, 3, None, 0) is not None
+
+
+def test_date_histogram_parity():
+    import tempfile
+
+    svc = MapperService(Settings.from_flat({}))
+    eng = Engine(tempfile.mkdtemp(), svc)
+    for i in range(90):
+        eng.index("doc", str(i), {"body": "alpha",
+                                  "ts": f"2014-{(i % 3) + 1:02d}-{(i % 27) + 1:02d}"})
+    eng.refresh()
+    c = ShardContext(eng.acquire_searcher(), svc,
+                     SimilarityService(Settings.from_flat({}), mapper_service=svc))
+    req = _both(c, {"query": {"match": {"body": "alpha"}}, "size": 0,
+                    "aggs": {"d": {"date_histogram": {"field": "ts",
+                                                      "interval": "month"}}}})
+    assert _try_device_aggs(c, req, 1, None, 0) is not None
+    eng.close()
 
 
 def test_trailing_valueless_docs_dont_truncate_minmax():
