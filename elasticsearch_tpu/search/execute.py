@@ -710,11 +710,12 @@ def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
             ck = bucket_cache_key(agg)  # same constructor as the host cache
             dev = packed.bucket_cols.get(ck)
             if dev is None:
-                dev = (jnp.asarray(pdoc), jnp.asarray(pbucket),
-                       jnp.zeros(len(keys), jnp.int32))
-                while len(packed.bucket_cols) >= 8:
-                    packed.bucket_cols.pop(next(iter(packed.bucket_cols)))
-                packed.bucket_cols[ck] = dev
+                from .aggregations import _bucket_cache_put
+
+                dev = _bucket_cache_put(
+                    packed.bucket_cols, ck,
+                    (jnp.asarray(pdoc), jnp.asarray(pbucket),
+                     jnp.zeros(len(keys), jnp.int32)))
             pair_args.append(dev)
             seg_keys.append(keys)
         entries = _dense_entries(finals, seg, packed, field_idx)

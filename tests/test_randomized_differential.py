@@ -1,0 +1,212 @@
+"""Seeded randomized differential testing: device serving vs host scorer.
+
+The reference tests everything under carrotsearch randomizedtesting — every run
+seeded and reproducible (SURVEY §4.1, TESTING.asciidoc:65). This suite applies
+that strategy to the framework's core invariant: the DEVICE serving path (sparse
+kernel, dense fs kernels, fused aggs) must agree with the HOST scorer on any
+query the planner lowers.
+
+Set ESTPU_TEST_SEED to reproduce a failure; the active seed prints on failure.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.mapper.core import MapperService
+from elasticsearch_tpu.search import ShardContext, parse_query
+from elasticsearch_tpu.search.aggregations import reduce_aggs
+from elasticsearch_tpu.search.execute import search_shard
+from elasticsearch_tpu.search.service import execute_query_phase, parse_search_body
+from elasticsearch_tpu.search.similarity import SimilarityService
+
+SEED = int(os.environ.get("ESTPU_TEST_SEED", np.random.SeedSequence().entropy % (2**31)))
+N_QUERIES = int(os.environ.get("ESTPU_FUZZ_QUERIES", 120))
+
+WORDS = [f"w{i}" for i in range(120)] + ["the", "of", "and"]
+
+
+def _corpus(rng, similarity):
+    tmp = tempfile.mkdtemp()
+    settings = Settings.from_flat({"index.similarity.default.type": similarity})
+    svc = MapperService(settings)
+    eng = Engine(tmp, svc)
+    # doc count pinned inside one pow2 bucket (doc_pad 256) so the fuzz loop
+    # reuses compiled kernels instead of paying XLA per corpus shape
+    n_docs = int(rng.integers(180, 250))
+    refresh_at = set(rng.integers(1, n_docs, size=int(rng.integers(0, 3))).tolist())
+    for i in range(n_docs):
+        d = {"body": " ".join(rng.choice(WORDS, size=int(rng.integers(1, 25)))),
+             "price": float(np.round(rng.uniform(0.5, 99), 2)),
+             "label": f"L{int(rng.integers(0, 9))}"}
+        if rng.random() < 0.7:
+            d["pop"] = int(rng.integers(1, 500))
+        if rng.random() < 0.3:
+            d["tags"] = [int(x) for x in rng.integers(1, 12,
+                                                      size=int(rng.integers(1, 4)))]
+        eng.index("doc", str(i), d)
+        if i in refresh_at:
+            eng.refresh()
+    for local in rng.integers(0, n_docs, size=int(rng.integers(0, 12))):
+        eng.delete("doc", str(int(local)))
+    eng.refresh()
+    ctx = ShardContext(eng.acquire_searcher(), svc,
+                       SimilarityService(settings, mapper_service=svc))
+    return eng, ctx
+
+
+def _rand_term(rng):
+    return {"term": {"body": str(rng.choice(WORDS))}}
+
+
+def _rand_query(rng):
+    r = rng.random()
+    if r < 0.25:
+        q = {"match": {"body": " ".join(rng.choice(WORDS,
+                                                   size=int(rng.integers(1, 5))))}}
+        if rng.random() < 0.3:
+            q["match"]["body"] = {"query": q["match"]["body"], "operator": "and"}
+        elif rng.random() < 0.3:
+            q["match"]["body"] = {
+                "query": q["match"]["body"],
+                "minimum_should_match": int(rng.integers(1, 4))}
+        return q
+    if r < 0.35:
+        return _rand_term(rng)
+    if r < 0.7:
+        nb = {"should": [_rand_term(rng) for _ in range(int(rng.integers(0, 4)))],
+              "must": [_rand_term(rng) for _ in range(int(rng.integers(0, 3)))],
+              "must_not": [_rand_term(rng) for _ in range(int(rng.integers(0, 2)))]}
+        nb = {k: v for k, v in nb.items() if v}
+        if not nb.get("should") and not nb.get("must"):
+            nb["should"] = [_rand_term(rng)]
+        if nb.get("should") and rng.random() < 0.4:
+            nb["minimum_should_match"] = int(rng.integers(1, len(nb["should"]) + 2))
+        if rng.random() < 0.3:
+            nb["boost"] = float(np.float32(rng.uniform(0.2, 3)))
+        return {"bool": nb}
+    # function_score over a random sub query
+    sub = _rand_query(rng) if rng.random() < 0.5 else _rand_term(rng)
+    fs: dict = {"query": sub}
+    kind = rng.random()
+    if kind < 0.3:
+        fs["functions"] = [{_g: {"price": {"origin": float(rng.uniform(10, 60)),
+                                           "scale": float(rng.uniform(5, 30))}}}
+                           for _g in [str(rng.choice(["gauss", "exp", "linear"]))]]
+    elif kind < 0.55:
+        fs["field_value_factor"] = {
+            "field": "pop", "missing": 1,
+            "modifier": str(rng.choice(["none", "log1p", "sqrt", "ln2p"]))}
+    elif kind < 0.75:
+        fs["functions"] = [
+            {"filter": {"range": {"pop": {"gte": int(rng.integers(0, 300))}}},
+             "boost_factor": float(np.float32(rng.uniform(0.5, 4)))},
+            {"weight": float(np.float32(rng.uniform(0.5, 2)))},
+        ]
+        fs["score_mode"] = str(rng.choice(["multiply", "sum", "avg", "max",
+                                           "min", "first"]))
+    else:
+        fs["script_score"] = {"script": "_score * log(2 + doc['price'].value)"}
+    fs["boost_mode"] = str(rng.choice(["multiply", "replace", "sum", "avg",
+                                       "max", "min"]))
+    if rng.random() < 0.2:
+        fs["max_boost"] = float(np.float32(rng.uniform(1, 5)))
+    if rng.random() < 0.15:
+        fs["boost"] = float(np.float32(rng.uniform(0.5, 2)))
+    return {"function_score": fs}
+
+
+def _tie_tolerant_equal(dev, host, rel=1e-5):
+    """Identical ordering, except adjacent swaps among near-equal scores (the
+    in-kernel f32 script evaluation vs host f64-then-cast)."""
+    if [d for _, d in dev.hits] == [d for _, d in host.hits]:
+        return all(ds == pytest.approx(hs, rel=rel)
+                   for (ds, _), (hs, _) in zip(dev.hits, host.hits))
+    if sorted(d for _, d in dev.hits) != sorted(d for _, d in host.hits):
+        return False
+    pos = {d: i for i, d in enumerate(d for _, d in host.hits)}
+    hs_by = {d: s for s, d in host.hits}
+    return all(abs(pos[d] - i) <= 1
+               and s == pytest.approx(hs_by[d], rel=rel)
+               for i, (s, d) in enumerate(dev.hits))
+
+
+@pytest.mark.parametrize("similarity", ["BM25", "default"])
+def test_randomized_query_parity(similarity):
+    rng = np.random.default_rng(SEED)
+    eng, ctx = _corpus(rng, similarity)
+    try:
+        from elasticsearch_tpu.common.errors import ScriptError
+
+        for qi in range(N_QUERIES):
+            qd = _rand_query(rng)
+            k = int(rng.choice([3, 10, 25]))  # few k shapes → few compiles
+            try:
+                host = search_shard(ctx, parse_query(qd), k, use_device=False)
+            except ScriptError:
+                with pytest.raises(ScriptError):
+                    search_shard(ctx, parse_query(qd), k, use_device=True)
+                continue
+            dev = search_shard(ctx, parse_query(qd), k, use_device=True)
+            assert dev.total == host.total, \
+                f"seed={SEED} query#{qi} {qd}: totals {dev.total} vs {host.total}"
+            assert _tie_tolerant_equal(dev, host), \
+                f"seed={SEED} query#{qi} {qd}:\n dev {dev.hits[:5]}\n host {host.hits[:5]}"
+    finally:
+        eng.close()
+
+
+def test_randomized_agg_parity():
+    rng = np.random.default_rng(SEED + 1)
+    eng, ctx = _corpus(rng, "BM25")
+    try:
+        for qi in range(max(N_QUERIES // 4, 10)):
+            aggs = {}
+            for ai in range(int(rng.integers(1, 4))):
+                kind = rng.random()
+                field = str(rng.choice(["price", "pop", "tags"]))
+                if kind < 0.4:
+                    aggs[f"a{ai}"] = {str(rng.choice(
+                        ["avg", "sum", "min", "max", "stats", "value_count"])):
+                        {"field": field}}
+                elif kind < 0.7:
+                    aggs[f"a{ai}"] = {"terms": {"field": str(rng.choice(
+                        ["label", "pop", "tags"])), "size": 50}}
+                else:
+                    aggs[f"a{ai}"] = {"histogram": {
+                        "field": field,
+                        "interval": float(rng.choice([2, 5, 10, 25]))}}
+            body = {"query": _rand_query(rng), "size": int(rng.integers(0, 10)),
+                    "aggs": aggs}
+            req = parse_search_body(body)
+            dev = execute_query_phase(ctx, req, use_device=True)
+            host = execute_query_phase(ctx, req, use_device=False)
+            assert dev.total == host.total, f"seed={SEED} agg#{qi} {body}"
+            dr = reduce_aggs(req.aggs, dev.agg_partials)
+            hr = reduce_aggs(req.aggs, host.agg_partials)
+            _deep_approx(dr, hr, f"seed={SEED} agg#{qi} {body}")
+    finally:
+        eng.close()
+
+
+def _deep_approx(a, b, ctx_msg, path=""):
+    if isinstance(a, dict) and isinstance(b, dict):
+        assert set(a) == set(b), (ctx_msg, path)
+        for k in a:
+            _deep_approx(a[k], b[k], ctx_msg, f"{path}.{k}")
+    elif isinstance(a, list) and isinstance(b, list):
+        assert len(a) == len(b), (ctx_msg, path, a, b)
+        for i, (x, y) in enumerate(zip(a, b)):
+            _deep_approx(x, y, ctx_msg, f"{path}[{i}]")
+    elif a is None or b is None:
+        assert a is None and b is None, (ctx_msg, path, a, b)
+    elif isinstance(a, float) or isinstance(b, float):
+        assert a == pytest.approx(b, rel=1e-5, abs=1e-9), (ctx_msg, path, a, b)
+    else:
+        assert a == b, (ctx_msg, path, a, b)
