@@ -404,6 +404,17 @@ def score_fs_script_batch(packed: PackedSegment, batch: TermBatch, k: int,
 # device_index.agg_doc_rows — exact for multi-valued fields.
 
 
+def score_filtered_batch(packed: PackedSegment, batch: TermBatch, k: int, fmask):
+    """Dense launch with match-gating filter masks (the device form of the
+    reference's FilteredQuery — the filter gates matching, never scoring,
+    XFilteredQuery). Rides score_agg_batch with an empty agg stack (F=0): one
+    kernel family to keep in sync. Returns numpy (scores, docs, total)."""
+    empty = np.zeros((0, 5, packed.doc_pad), np.float32)
+    scores, docs, total, _counts, _stats, _buckets = score_agg_batch(
+        packed, batch, k, empty, (), fmask=fmask)
+    return scores, docs, total
+
+
 def agg_stat_reduction(match, agg_rows):
     """Masked metric stats under a match mask — the ONE implementation both trace
     contexts call (single-shard _dense_aggstats_impl and the mesh SPMD program).
@@ -433,6 +444,7 @@ def _dense_aggstats_impl(blk_docs, blk_freqs, live_parent, norms_stack, caches,
                          qidx, blk, weight, fidx, group, tfmode, n_must, msm, coord,
                          agg_rows,  # [F, 5, Dpad] f32 (F may be 0)
                          bucket_pairs,  # tuple of (pair_doc [NP], pair_bucket [NP], nb-sized zeros)
+                         fmask,  # bool [Q, Dpad] — FilteredQuery masks (all-true when none)
                          *, n_queries: int, k: int, doc_pad: int):
     import jax
     import jax.numpy as jnp
@@ -443,6 +455,7 @@ def _dense_aggstats_impl(blk_docs, blk_freqs, live_parent, norms_stack, caches,
         tfmode, Q=Q, doc_pad=doc_pad)
     scores, match = _dense_semantics(scores, flat_idx, valid, group, live_parent,
                                      n_must, msm, coord, Q=Q, doc_pad=doc_pad)
+    match = match & fmask
     masked = jnp.where(match, scores, jnp.float32(-jnp.inf))
     top_scores, top_docs = jax.lax.top_k(masked, k)
     total = match.sum(axis=1, dtype=jnp.int32)
@@ -458,12 +471,12 @@ def _dense_aggstats_impl(blk_docs, blk_freqs, live_parent, norms_stack, caches,
 
 
 def score_agg_batch(packed: PackedSegment, batch: TermBatch, k: int,
-                    agg_row_stack, bucket_pairs=()):
+                    agg_row_stack, bucket_pairs=(), fmask=None):
     """Dense launch returning (scores, docs, total, counts [Q, F] int,
     stats [Q, F, 4], bucket_counts tuple of [Q, NB]) numpy. stats rows:
     (sum, min(+inf if none), max(-inf), sumsq) over matched docs per agg field;
     bucket_pairs: per bucket agg, (pair_doc, pair_bucket, zeros[NB]) device
-    arrays."""
+    arrays; fmask: optional bool [Q, Dpad] FilteredQuery match gates."""
     import jax
     import jax.numpy as jnp
 
@@ -479,12 +492,16 @@ def score_agg_batch(packed: PackedSegment, batch: TermBatch, k: int,
 
         fn = jax.jit(wrapper)
         _compiled_cache[key] = fn
+    if fmask is None:
+        # broadcastable no-op mask: [1, 1] & [Q, Dpad] — avoids allocating and
+        # transferring a full all-true mask on the unfiltered aggs hot path
+        fmask = np.ones((1, 1), dtype=bool)
     top_scores, top_docs, total, counts, stats, bucket_counts = fn(
         packed.blk_docs, packed.blk_freqs, packed.live_parent, norms_stack, caches,
         jnp.asarray(batch.qidx), jnp.asarray(batch.blk), jnp.asarray(batch.weight),
         jnp.asarray(batch.fidx), jnp.asarray(batch.group), jnp.asarray(batch.tfmode),
         jnp.asarray(batch.n_must), jnp.asarray(batch.msm), jnp.asarray(batch.coord),
-        agg_row_stack, tuple(bucket_pairs),
+        agg_row_stack, tuple(bucket_pairs), jnp.asarray(fmask),
     )
     return (np.asarray(top_scores), np.asarray(top_docs), np.asarray(total),
             np.asarray(counts), np.asarray(stats),

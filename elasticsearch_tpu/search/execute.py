@@ -165,6 +165,10 @@ class FlatPlan:
     fs: object = None  # FunctionScoreQuery | None (also the host-fallback query)
     fs_kind: str | None = None  # "rows" | "script" (classified at lower time)
     norm_boost: float = 1.0
+    # FilteredQuery: the filter gates MATCHING only (host: match &= mask, scores
+    # untouched for matched docs — HostScorer FilteredQuery branch); evaluated
+    # host-side per segment via the filter cache and shipped as a mask row
+    filt: object = None  # Filter | None
 
 
 # ---------------------------------------------------------------------------
@@ -285,7 +289,7 @@ def _lower_flat_inner(query: Query, ctx: ShardContext) -> FlatPlan | None:
         if query.query is None:
             return None
         sub = _lower_flat_inner(query.query, ctx)
-        if sub is None or sub.fs is not None:
+        if sub is None or sub.fs is not None or sub.filt is not None:
             return None
         kind = _classify_fs(query)
         if kind is None:
@@ -293,6 +297,16 @@ def _lower_flat_inner(query: Query, ctx: ShardContext) -> FlatPlan | None:
         return FlatPlan(sub.clauses, msm=sub.msm, n_must=sub.n_must,
                         coord_enabled=sub.coord_enabled, boost=sub.boost,
                         fs=query, fs_kind=kind, norm_boost=query.boost)
+    if isinstance(query, FilteredQuery):
+        # the reference's canonical query+filter idiom (ES 1.x `filtered`):
+        # boost folds into the sub clauses (host: eval(q.query, b)), the filter
+        # becomes a match-gating mask row in the dense kernel
+        sub = _lower_flat_inner(query.query, ctx)
+        if sub is None or sub.fs is not None or sub.filt is not None:
+            return None
+        return FlatPlan(sub.clauses, msm=sub.msm, n_must=sub.n_must,
+                        coord_enabled=sub.coord_enabled,
+                        boost=sub.boost * query.boost, filt=query.filter)
     return None
 
 
@@ -388,14 +402,21 @@ def finalize_flat(plan: FlatPlan, ctx: ShardContext):
 def execute_flat_batch(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list[TopDocs]:
     """Run a batch of flat plans through the device kernels. Plain plans ride the
     sparse candidate-centric path; function_score plans are grouped by spec and
-    ride the dense kernel with the function tail fused in (_execute_flat_fs)."""
-    if all(p.fs is None for p in plans):
+    ride the dense kernel with the function tail fused in (_execute_flat_fs);
+    filtered plans ride the dense kernel with per-query mask rows
+    (_execute_flat_filtered)."""
+    if all(p.fs is None and p.filt is None for p in plans):
         return _execute_flat_plain(plans, ctx, k)
     out: list[TopDocs | None] = [None] * len(plans)
-    plain_idx = [i for i, p in enumerate(plans) if p.fs is None]
+    plain_idx = [i for i, p in enumerate(plans) if p.fs is None and p.filt is None]
     if plain_idx:
         for i, td in zip(plain_idx,
                          _execute_flat_plain([plans[i] for i in plain_idx], ctx, k)):
+            out[i] = td
+    filt_idx = [i for i, p in enumerate(plans) if p.filt is not None]
+    if filt_idx:
+        for i, td in zip(filt_idx,
+                         _execute_flat_filtered([plans[i] for i in filt_idx], ctx, k)):
             out[i] = td
     groups: dict = {}
     for i, p in enumerate(plans):
@@ -675,6 +696,47 @@ def _execute_flat_fs(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list[T
     ]
 
 
+def _execute_flat_filtered(plans: list[FlatPlan], ctx: ShardContext,
+                           k: int) -> list[TopDocs]:
+    """Filtered plans: per-query filter masks (host-evaluated via the per-segment
+    filter cache — the same masks the host scorer uses) gate matching inside the
+    dense kernel. Scores/weights are untouched, so sub-query scoring parity is
+    inherited from the plain path."""
+    from ..ops.device_index import packed_for
+    from ..ops.scoring import build_term_batch, score_filtered_batch
+    from .filters import segment_mask
+
+    if len(plans) > _FS_CHUNK:
+        out: list[TopDocs] = []
+        for start in range(0, len(plans), _FS_CHUNK):
+            out.extend(_execute_flat_filtered(plans[start: start + _FS_CHUNK],
+                                              ctx, k))
+        return out
+
+    Q = len(plans)
+    finals = [finalize_flat(p, ctx) for p in plans]
+    (all_fields, field_idx, _cache_rows, caches_stack,
+     coord_tbl, n_must, msm) = _assemble_batch(plans, finals)
+    totals = np.zeros(Q, dtype=np.int64)
+    seg_hits = []
+    for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
+        packed = packed_for(seg)
+        _ensure_norm_rows(packed, all_fields)
+        fmask = np.zeros((Q, packed.doc_pad), dtype=bool)
+        for qi, plan in enumerate(plans):
+            fmask[qi, : seg.doc_count] = segment_mask(seg, plan.filt, ctx)
+        entries = _dense_entries(finals, seg, packed, field_idx)
+        batch = build_term_batch(entries, Q, n_must, msm, coord_tbl,
+                                 list(all_fields), caches_stack,
+                                 nb_pad_row=packed.blk_docs.shape[0] - 1)
+        scores, docs, tq = score_filtered_batch(packed, batch, k, fmask)
+        totals += tq
+        valid = (docs < min(packed.doc_pad, seg.doc_count)) & np.isfinite(scores)
+        gdocs = np.where(valid, docs.astype(np.int64) + base, np.int64(2**62))
+        seg_hits.append((np.where(valid, scores, -np.inf), gdocs))
+    return _merge_seg_hits(seg_hits, totals, Q, k)
+
+
 def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
                       fields: list[str], bucket_aggs: list = ()):
     """Single-plan dense execution with aggregations fused into the kernel:
@@ -722,8 +784,14 @@ def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
         batch = build_term_batch(entries, 1, n_must, msm, coord_tbl,
                                  list(all_fields), caches_stack,
                                  nb_pad_row=packed.blk_docs.shape[0] - 1)
+        fmask = None
+        if plan.filt is not None:
+            from .filters import segment_mask
+
+            fmask = np.zeros((1, packed.doc_pad), dtype=bool)
+            fmask[0, : seg.doc_count] = segment_mask(seg, plan.filt, ctx)
         scores, docs, tq, counts, stats, bcounts = score_agg_batch(
-            packed, batch, k, stack, tuple(pair_args))
+            packed, batch, k, stack, tuple(pair_args), fmask=fmask)
         totals += tq
         valid = (docs < min(packed.doc_pad, seg.doc_count)) & np.isfinite(scores)
         gdocs = np.where(valid, docs.astype(np.int64) + base, np.int64(2**62))

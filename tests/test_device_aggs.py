@@ -177,6 +177,31 @@ def test_mixed_metric_and_bucket_aggs(ctx):
     assert _try_device_aggs(ctx, req, 3, None, 0) is not None
 
 
+def test_filtered_query_with_aggs(ctx):
+    # the classic analytics shape: query + filter + aggs, fused in one launch
+    req = _both(ctx, {
+        "query": {"filtered": {"query": {"match": {"body": "alpha"}},
+                               "filter": {"range": {"pop": {"gte": 50}}}}},
+        "size": 3,
+        "aggs": {"p_avg": {"avg": {"field": "price"}},
+                 "by_label": {"terms": {"field": "label"}}}})
+    assert _try_device_aggs(ctx, req, 3, None, 0) is not None
+
+
+def test_filtered_query_device_topk(ctx):
+    from elasticsearch_tpu.search.execute import lower_flat, search_shard
+    from elasticsearch_tpu.search import parse_query
+
+    qd = {"filtered": {"query": {"match": {"body": "beta gamma"}},
+                       "filter": {"term": {"label": "L3"}}, "boost": 1.5}}
+    q = parse_query(qd)
+    plan = lower_flat(q, ctx)
+    assert plan is not None and plan.filt is not None
+    dev = search_shard(ctx, q, 10, use_device=True)
+    host = search_shard(ctx, q, 10, use_device=False)
+    assert dev.total == host.total and dev.hits == host.hits
+
+
 def test_date_histogram_parity():
     import tempfile
 

@@ -79,6 +79,21 @@ def _rand_query(rng):
         return q
     if r < 0.35:
         return _rand_term(rng)
+    if r < 0.45:
+        # the ES 1.x `filtered` idiom: query + match-gating filter
+        inner = _rand_query(rng) if rng.random() < 0.3 else _rand_term(rng)
+        f = rng.random()
+        if f < 0.4:
+            filt = {"range": {"pop": {"gte": int(rng.integers(0, 400))}}}
+        elif f < 0.7:
+            filt = {"term": {"label": f"L{int(rng.integers(0, 9))}"}}
+        else:
+            filt = {"bool": {"must": [{"exists": {"field": "pop"}}],
+                             "must_not": [{"term": {"label": "L0"}}]}}
+        fq: dict = {"query": inner, "filter": filt}
+        if rng.random() < 0.2:
+            fq["boost"] = float(np.float32(rng.uniform(0.5, 2)))
+        return {"filtered": fq}
     if r < 0.7:
         nb = {"should": [_rand_term(rng) for _ in range(int(rng.integers(0, 4)))],
               "must": [_rand_term(rng) for _ in range(int(rng.integers(0, 3)))],
