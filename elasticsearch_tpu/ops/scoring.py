@@ -415,6 +415,74 @@ def score_filtered_batch(packed: PackedSegment, batch: TermBatch, k: int, fmask)
     return scores, docs, total
 
 
+def _dense_sort_impl(blk_docs, blk_freqs, live_parent, norms_stack, caches,
+                     qidx, blk, weight, fidx, group, tfmode, n_must, msm, coord,
+                     fmask, key_row,  # f32 [Dpad] ascending-semantics sort keys
+                     *, n_queries: int, k: int, doc_pad: int, descending: bool):
+    """Dense kernel + field-sort top-k: the device form of the reference's
+    sorted TopFieldCollector (QueryPhase sorted search). Keys come pre-folded
+    per doc (sorting.device_sort_key_row — mode + missing policy baked in);
+    ties break by doc id ascending via top_k's lower-index preference, matching
+    the host lexsort."""
+    import jax
+    import jax.numpy as jnp
+
+    Q = n_queries
+    scores, flat_idx, valid = _dense_accumulate(
+        blk_docs, blk_freqs, norms_stack, caches, qidx, blk, weight, fidx, group,
+        tfmode, Q=Q, doc_pad=doc_pad)
+    scores, match = _dense_semantics(scores, flat_idx, valid, group, live_parent,
+                                     n_must, msm, coord, Q=Q, doc_pad=doc_pad)
+    match = match & fmask
+    key = jnp.broadcast_to(key_row[None, :], match.shape)
+    pad = jnp.float32(-jnp.inf) if descending else jnp.float32(jnp.inf)
+    sortable = jnp.where(match, key, pad)
+    if descending:
+        top_keys, top_docs = jax.lax.top_k(sortable, k)
+    else:
+        neg, top_docs = jax.lax.top_k(-sortable, k)
+        top_keys = -neg
+    top_scores = jnp.take_along_axis(scores, top_docs, axis=1)
+    # max_score spans ALL matches (the host mask path computes it that way for
+    # sorted searches), not just the k winners
+    qmax = jnp.max(jnp.where(match, scores, jnp.float32(-jnp.inf)), axis=1)
+    return (top_keys, top_docs, top_scores, qmax,
+            match.sum(axis=1, dtype=jnp.int32))
+
+
+def score_sorted_batch(packed: PackedSegment, batch: TermBatch, k: int,
+                       key_row, descending: bool, fmask=None):
+    """Field-sorted dense launch; returns numpy (keys, docs, scores, qmax,
+    total). Matched docs occupy the first min(total, k) slots per query
+    (padding ranks strictly after ±FLT_MAX missing keys)."""
+    import jax
+    import jax.numpy as jnp
+
+    norms_stack, caches = _stack_args(packed, batch)
+    key = ("sorted", batch.n_queries, min(k, packed.doc_pad), packed.doc_pad,
+           descending)
+    fn = _compiled_cache.get(key)
+    if fn is None:
+        def wrapper(*args):
+            return _dense_sort_impl(
+                *args, n_queries=batch.n_queries, k=min(k, packed.doc_pad),
+                doc_pad=packed.doc_pad, descending=descending)
+
+        fn = jax.jit(wrapper)
+        _compiled_cache[key] = fn
+    if fmask is None:
+        fmask = np.ones((1, 1), dtype=bool)
+    top_keys, top_docs, top_scores, qmax, total = fn(
+        packed.blk_docs, packed.blk_freqs, packed.live_parent, norms_stack, caches,
+        jnp.asarray(batch.qidx), jnp.asarray(batch.blk), jnp.asarray(batch.weight),
+        jnp.asarray(batch.fidx), jnp.asarray(batch.group), jnp.asarray(batch.tfmode),
+        jnp.asarray(batch.n_must), jnp.asarray(batch.msm), jnp.asarray(batch.coord),
+        jnp.asarray(fmask), key_row,
+    )
+    return (np.asarray(top_keys), np.asarray(top_docs), np.asarray(top_scores),
+            np.asarray(qmax), np.asarray(total))
+
+
 def agg_stat_reduction(match, agg_rows):
     """Masked metric stats under a match mask — the ONE implementation both trace
     contexts call (single-shard _dense_aggstats_impl and the mesh SPMD program).

@@ -737,6 +737,59 @@ def _execute_flat_filtered(plans: list[FlatPlan], ctx: ShardContext,
     return _merge_seg_hits(seg_hits, totals, Q, k)
 
 
+def execute_flat_sorted(plan: FlatPlan, ctx: ShardContext, k: int, spec):
+    """Single-plan field-sorted dense execution: returns
+    (total, max_score, ordered entries [(key, gdoc, seg_idx, local, score)])
+    or None when any segment's column refuses device keys
+    (sorting.device_sort_key_row). Ordering: (key asc/desc, global doc asc) —
+    the host lexsort order."""
+    import jax.numpy as jnp
+
+    from ..ops.device_index import packed_for
+    from ..ops.scoring import build_term_batch, score_sorted_batch
+    from .filters import segment_mask
+    from .sorting import device_sort_key_row
+
+    finals = [finalize_flat(plan, ctx)]
+    (all_fields, field_idx, _cache_rows, caches_stack,
+     coord_tbl, n_must, msm) = _assemble_batch([plan], finals)
+    # validate EVERY segment's eligibility before the first launch — a
+    # late-segment refusal must not waste completed kernel work
+    packeds = [packed_for(seg) for seg in ctx.searcher.segments]
+    key_rows = [device_sort_key_row(spec, seg, p.doc_pad)
+                for seg, p in zip(ctx.searcher.segments, packeds)]
+    if any(r is None for r in key_rows):
+        return None
+    total = 0
+    max_score = float("nan")
+    cand = []  # (key, gdoc, seg_idx, local, score)
+    for si, (seg, base, packed, key_row) in enumerate(zip(
+            ctx.searcher.segments, ctx.searcher.bases, packeds, key_rows)):
+        _ensure_norm_rows(packed, all_fields)
+        fmask = None
+        if plan.filt is not None:
+            fmask = np.zeros((1, packed.doc_pad), dtype=bool)
+            fmask[0, : seg.doc_count] = segment_mask(seg, plan.filt, ctx)
+        entries = _dense_entries(finals, seg, packed, field_idx)
+        batch = build_term_batch(entries, 1, n_must, msm, coord_tbl,
+                                 list(all_fields), caches_stack,
+                                 nb_pad_row=packed.blk_docs.shape[0] - 1)
+        keys, docs, scores, qmax, tq = score_sorted_batch(
+            packed, batch, max(k, 1), jnp.asarray(key_row), spec.reverse,
+            fmask=fmask)
+        seg_total = int(tq[0])
+        total += seg_total
+        if seg_total:
+            m = float(qmax[0])
+            max_score = m if max_score != max_score else max(max_score, m)
+        for j in range(min(seg_total, keys.shape[1])):
+            local = int(docs[0, j])
+            cand.append((float(keys[0, j]), base + local, si, local,
+                         float(scores[0, j])))
+    cand.sort(key=lambda e: (-e[0] if spec.reverse else e[0], e[1]))
+    return total, max_score, cand[: max(k, 0)]
+
+
 def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
                       fields: list[str], bucket_aggs: list = ()):
     """Single-plan dense execution with aggregations fused into the kernel:

@@ -133,6 +133,15 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
         if device is not None:
             return device
 
+    # device field-sort path: single numeric field sort, top-k over pre-folded
+    # key rows inside the kernel (execute.execute_flat_sorted)
+    if (use_device and req.sort and len(req.sort) == 1 and not req.aggs
+            and not req.facets and req.post_filter is None and not req.rescore
+            and req.min_score is None and not req.explain):
+        device = _try_device_sort(ctx, req, k, suggest_out, shard_id)
+        if device is not None:
+            return device
+
     # general path: dense per-segment masks drive sort/aggs/rescore
     seg_results = match_masks(ctx, req.query)
     seg_masks_for_aggs = []
@@ -182,17 +191,9 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
     docs = []
     # per-segment grouped sort-value extraction for response "sort" arrays
     if req.sort:
-        by_seg: dict[int, list[int]] = {}
-        for rank, (_, _s, g, si, local) in enumerate(top):
-            by_seg.setdefault(si, []).append(rank)
-        sort_vals_by_rank: dict[int, list] = {}
-        for si, ranks in by_seg.items():
-            seg = ctx.searcher.segments[si]
-            locals_ = np.asarray([top[r][4] for r in ranks])
-            scores_dense = seg_results[si][0]
-            vals = sort_values_for_docs(req.sort, seg, ctx, locals_, scores_dense)
-            for r, v in zip(ranks, vals):
-                sort_vals_by_rank[r] = v
+        sort_vals_by_rank = _sort_values_by_rank(
+            req.sort, ctx, [(si, local) for (_, _s, _g, si, local) in top],
+            scores_by_seg={si: r[0] for si, r in enumerate(seg_results)})
         for rank, (_, s, g, si, local) in enumerate(top):
             score = s if req.track_scores or _score_in_sort(req.sort) else float("nan")
             docs.append((score, g, sort_vals_by_rank[rank]))
@@ -264,6 +265,56 @@ def _try_device_aggs(ctx: ShardContext, req: ParsedSearchRequest, k: int,
         max_score=td.max_score, agg_partials=agg_partials, suggest=suggest_out,
         shard_id=shard_id,
     )
+
+
+def _try_device_sort(ctx: ShardContext, req: ParsedSearchRequest, k: int,
+                     suggest_out, shard_id: int) -> "ShardQueryResult | None":
+    """Field-sorted top-k in the fused kernel; None when the spec/columns/query
+    need the host path. Sort VALUES in the response come from the host extractor
+    (exact f64 / None-for-missing), only the ORDERING rides the device."""
+    from .execute import execute_flat_sorted, lower_flat
+    from .sorting import sort_values_for_docs
+
+    spec = req.sort[0]
+    if spec.kind != "field":
+        return None
+    plan = lower_flat(req.query, ctx)
+    if plan is None or plan.fs is not None:
+        return None
+    res = execute_flat_sorted(plan, ctx, max(k, 1), spec)
+    if res is None:
+        return None
+    total, max_score, entries = res
+    values_by_rank = _sort_values_by_rank(
+        req.sort, ctx, [(si, local) for (_key, _g, si, local, _s) in entries])
+    docs = [
+        (s if req.track_scores else float("nan"), g, values_by_rank[rank])
+        for rank, (_key, g, _si, _local, s) in enumerate(entries)
+    ][: max(k, 0)]
+    return ShardQueryResult(
+        total=total, docs=docs, max_score=max_score, suggest=suggest_out,
+        shard_id=shard_id,
+    )
+
+
+def _sort_values_by_rank(specs: list, ctx: ShardContext, seg_locals: list,
+                         scores_by_seg: dict | None = None) -> dict:
+    """rank -> sort-value list, extracted per segment so column reads vectorize
+    — the ONE site for response "sort" arrays (host mask path AND device sort
+    path). seg_locals: (seg_idx, local) per rank; scores_by_seg supplies dense
+    score arrays for _score-kind specs (host path only)."""
+    by_seg: dict[int, list[int]] = {}
+    for rank, (si, _local) in enumerate(seg_locals):
+        by_seg.setdefault(si, []).append(rank)
+    out: dict[int, list] = {}
+    for si, ranks in by_seg.items():
+        seg = ctx.searcher.segments[si]
+        locals_ = np.asarray([seg_locals[r][1] for r in ranks])
+        scores = scores_by_seg.get(si) if scores_by_seg else None
+        vals = sort_values_for_docs(specs, seg, ctx, locals_, scores)
+        for r, v in zip(ranks, vals):
+            out[r] = v
+    return out
 
 
 def _score_in_sort(sort: list) -> bool:

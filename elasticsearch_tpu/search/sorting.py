@@ -168,6 +168,63 @@ def sort_key_column(spec: SortSpec, seg, ctx, scores: np.ndarray | None) -> np.n
     return np.full(D, np.nan)
 
 
+_F32_MAX = float(np.finfo(np.float32).max)
+
+
+def device_sort_key_row(spec: SortSpec, seg, doc_pad: int) -> np.ndarray | None:
+    """float32 [doc_pad] ascending-semantics key row for the device sort kernel,
+    or None when the spec/column needs the host path.
+
+    Sort order is deterministic user-visible state, so only columns whose values
+    are EXACTLY float32-representable ride the kernel (fractional f64 rounding
+    could swap strict orderings); avg/sum modes divide/accumulate in f64 on the
+    host and stay there. Missing docs take ±FLT_MAX (not ±inf) so the kernel can
+    rank them after real keys but before its ±inf padding; custom numeric
+    missing fills must be f32-exact too."""
+    if spec.kind != "field" or spec.mode in ("avg", "sum"):
+        return None
+    if spec.field in seg.dv_str and spec.field not in seg.dv_num:
+        return None
+    mode = spec.mode or ("min" if spec.order == "asc" else "max")
+    # the exactness check + per-doc fold are pure functions of the immutable
+    # (segment column, mode) — cache them so hot sorted queries don't re-scan
+    # the column (missing/order handling below is per-spec and cheap)
+    ckey = ("sort_keys", spec.field, mode)
+    keys = seg._device_cache.get(ckey)
+    if keys is None:
+        col = seg.dv_num.get(spec.field)
+        if col is None:
+            keys = np.full(seg.doc_count, np.nan)
+        else:
+            off, vals = col
+            if len(vals) and (
+                    not np.array_equal(
+                        vals.astype(np.float32).astype(np.float64), vals)
+                    or np.abs(vals).max() >= _F32_MAX / 2):
+                keys = "inexact"
+            else:
+                keys = _reduce_multi(off, vals, seg.doc_count, mode)
+        seg._device_cache[ckey] = keys
+    if isinstance(keys, str):
+        return None
+    if spec.missing == "_last":
+        fill = _F32_MAX if not spec.reverse else -_F32_MAX
+    elif spec.missing == "_first":
+        fill = -_F32_MAX if not spec.reverse else _F32_MAX
+    else:
+        try:
+            fill = float(spec.missing)
+        except (TypeError, ValueError):
+            fill = _F32_MAX
+        if float(np.float32(fill)) != fill:
+            return None
+    keys = np.where(np.isnan(keys), fill, keys)
+    row = np.full(doc_pad, _F32_MAX if not spec.reverse else -_F32_MAX,
+                  dtype=np.float32)
+    row[: seg.doc_count] = keys.astype(np.float32)
+    return row
+
+
 def apply_missing(keys: np.ndarray, spec: SortSpec) -> np.ndarray:
     missing = spec.missing
     if missing == "_last":

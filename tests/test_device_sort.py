@@ -1,0 +1,122 @@
+"""Device field sort: differential tests vs the host mask path.
+
+Single numeric field sorts ride the fused kernel (top-k over pre-folded key
+rows — ops/scoring._dense_sort_impl); only exactly-f32-representable columns
+are eligible, so ordering is bit-identical to the host lexsort. Everything
+else (multi-key, _score/geo/script sorts, avg/sum modes, fractional columns)
+falls back to the host path.
+"""
+
+from __future__ import annotations
+
+import math
+import tempfile
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.mapper.core import MapperService
+from elasticsearch_tpu.search import ShardContext
+from elasticsearch_tpu.search.service import (
+    _try_device_sort,
+    execute_query_phase,
+    parse_search_body,
+)
+from elasticsearch_tpu.search.similarity import SimilarityService
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    tmp = tempfile.mkdtemp()
+    svc = MapperService(Settings.from_flat({}))
+    eng = Engine(tmp, svc)
+    rng = np.random.default_rng(31)
+    words = ["alpha", "beta", "gamma", "delta"]
+    for i in range(300):
+        d = {"body": " ".join(rng.choice(words, size=5)),
+             "rank": int(rng.integers(0, 5000)),
+             "price_frac": float(np.round(rng.uniform(1, 99), 2))}
+        if i % 6 == 0:
+            del d["rank"]  # missing values
+        if i % 5 == 0:
+            d["multi"] = [int(x) for x in rng.integers(0, 100, size=3)]
+        eng.index("doc", str(i), d)
+        if i == 149:
+            eng.refresh()
+    for i in (7, 70, 200):
+        eng.delete("doc", str(i))
+    eng.refresh()
+    out = ShardContext(eng.acquire_searcher(), svc,
+                       SimilarityService(Settings.from_flat({}), mapper_service=svc))
+    yield out
+    eng.close()
+
+
+def _both(ctx, body, expect_device=True):
+    req = parse_search_body(body)
+    if expect_device:
+        assert _try_device_sort(ctx, req, req.from_ + req.size, None, 0) is not None
+    dev = execute_query_phase(ctx, req, use_device=True)
+    host = execute_query_phase(ctx, req, use_device=False)
+    assert dev.total == host.total
+    assert len(dev.docs) == len(host.docs)
+    for (ds, dg, dv), (hs, hg, hv) in zip(dev.docs, host.docs):
+        assert dg == hg, (body, dev.docs[:5], host.docs[:5])
+        assert dv == hv
+        if not (math.isnan(ds) and math.isnan(hs)):
+            assert ds == pytest.approx(hs, rel=1e-6)
+    if not (math.isnan(dev.max_score) and math.isnan(host.max_score)):
+        assert dev.max_score == pytest.approx(host.max_score, rel=1e-6)
+    return req
+
+
+@pytest.mark.parametrize("order", ["asc", "desc"])
+def test_basic_field_sort(ctx, order):
+    _both(ctx, {"query": {"match": {"body": "alpha beta"}},
+                "sort": [{"rank": order}], "size": 25})
+
+
+@pytest.mark.parametrize("missing", ["_last", "_first", 42])
+def test_missing_policies(ctx, missing):
+    _both(ctx, {"query": {"match": {"body": "gamma"}},
+                "sort": [{"rank": {"order": "asc", "missing": missing}}],
+                "size": 30})
+
+
+@pytest.mark.parametrize("mode,order", [("min", "desc"), ("max", "asc")])
+def test_multivalued_modes(ctx, mode, order):
+    _both(ctx, {"query": {"match": {"body": "delta"}},
+                "sort": [{"multi": {"order": order, "mode": mode}}], "size": 20})
+
+
+def test_filtered_query_with_sort(ctx):
+    _both(ctx, {"query": {"filtered": {"query": {"match": {"body": "alpha"}},
+                                       "filter": {"range": {"rank": {"lte": 2500}}}}},
+                "sort": [{"rank": "desc"}], "size": 15})
+
+
+def test_track_scores(ctx):
+    _both(ctx, {"query": {"match": {"body": "beta"}},
+                "sort": [{"rank": "asc"}], "size": 10, "track_scores": True})
+
+
+@pytest.mark.parametrize("body", [
+    # fractional column: not f32-exact → host (ordering must still agree)
+    {"query": {"match": {"body": "alpha"}}, "sort": [{"price_frac": "asc"}],
+     "size": 10},
+    # multi-key → host
+    {"query": {"match": {"body": "alpha"}},
+     "sort": [{"rank": "asc"}, {"price_frac": "desc"}], "size": 10},
+    # avg mode → host
+    {"query": {"match": {"body": "alpha"}},
+     "sort": [{"multi": {"order": "asc", "mode": "avg"}}], "size": 10},
+    # _score sort → host
+    {"query": {"match": {"body": "alpha"}}, "sort": ["_score"], "size": 10},
+])
+def test_host_fallbacks_agree(ctx, body):
+    req = parse_search_body(body)
+    if len(req.sort) == 1:
+        assert _try_device_sort(ctx, req, 10, None, 0) is None
+    _both(ctx, body, expect_device=False)

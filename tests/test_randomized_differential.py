@@ -177,6 +177,35 @@ def test_randomized_query_parity(similarity):
         eng.close()
 
 
+def test_randomized_sort_parity():
+    rng = np.random.default_rng(SEED + 2)
+    eng, ctx = _corpus(rng, "BM25")
+    try:
+        import math
+
+        for qi in range(max(N_QUERIES // 4, 10)):
+            spec: dict = {"order": str(rng.choice(["asc", "desc"]))}
+            if rng.random() < 0.4:
+                spec["missing"] = str(rng.choice(["_last", "_first"])) \
+                    if rng.random() < 0.7 else int(rng.integers(0, 600))
+            field = str(rng.choice(["pop", "tags"]))
+            if field == "tags" and rng.random() < 0.6:
+                spec["mode"] = str(rng.choice(["min", "max"]))
+            body = {"query": _rand_query(rng), "sort": [{field: spec}],
+                    "size": int(rng.integers(1, 20))}
+            req = parse_search_body(body)
+            dev = execute_query_phase(ctx, req, use_device=True)
+            host = execute_query_phase(ctx, req, use_device=False)
+            assert dev.total == host.total, f"seed={SEED} sort#{qi} {body}"
+            assert [(g, v) for _s, g, v in dev.docs] == \
+                [(g, v) for _s, g, v in host.docs], \
+                f"seed={SEED} sort#{qi} {body}:\n{dev.docs[:5]}\n{host.docs[:5]}"
+            if not (math.isnan(dev.max_score) and math.isnan(host.max_score)):
+                assert dev.max_score == pytest.approx(host.max_score, rel=1e-5)
+    finally:
+        eng.close()
+
+
 def test_randomized_agg_parity():
     rng = np.random.default_rng(SEED + 1)
     eng, ctx = _corpus(rng, "BM25")
