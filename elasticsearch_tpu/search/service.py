@@ -134,8 +134,9 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
             return device
 
     # device field-sort path: single numeric field sort, top-k over pre-folded
-    # key rows inside the kernel (execute.execute_flat_sorted)
-    if (use_device and req.sort and len(req.sort) == 1 and not req.aggs
+    # key rows inside the kernel (execute.execute_flat_sorted); combines with
+    # device-eligible aggs (agg launch supplies partials, sort launch ordering)
+    if (use_device and req.sort and len(req.sort) == 1
             and not req.facets and req.post_filter is None and not req.rescore
             and req.min_score is None and not req.explain):
         device = _try_device_sort(ctx, req, k, suggest_out, shard_id)
@@ -271,13 +272,19 @@ def _try_device_sort(ctx: ShardContext, req: ParsedSearchRequest, k: int,
                      suggest_out, shard_id: int) -> "ShardQueryResult | None":
     """Field-sorted top-k in the fused kernel; None when the spec/columns/query
     need the host path. Sort VALUES in the response come from the host extractor
-    (exact f64 / None-for-missing), only the ORDERING rides the device."""
+    (exact f64 / None-for-missing), only the ORDERING rides the device. Requests
+    that ALSO carry device-eligible aggs get a second fused launch for the
+    partials (same match set — both kernels share the dense core)."""
     from .execute import execute_flat_sorted, lower_flat
-    from .sorting import sort_values_for_docs
 
     spec = req.sort[0]
     if spec.kind != "field":
         return None
+    agg_result = None
+    if req.aggs:
+        agg_result = _try_device_aggs(ctx, req, 0, None, shard_id)
+        if agg_result is None:
+            return None  # any host-only agg sends the whole request host-side
     plan = lower_flat(req.query, ctx)
     if plan is None or plan.fs is not None:
         return None
@@ -292,8 +299,9 @@ def _try_device_sort(ctx: ShardContext, req: ParsedSearchRequest, k: int,
         for rank, (_key, g, _si, _local, s) in enumerate(entries)
     ][: max(k, 0)]
     return ShardQueryResult(
-        total=total, docs=docs, max_score=max_score, suggest=suggest_out,
-        shard_id=shard_id,
+        total=total, docs=docs, max_score=max_score,
+        agg_partials=agg_result.agg_partials if agg_result is not None else [],
+        suggest=suggest_out, shard_id=shard_id,
     )
 
 

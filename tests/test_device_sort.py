@@ -97,6 +97,31 @@ def test_filtered_query_with_sort(ctx):
                 "sort": [{"rank": "desc"}], "size": 15})
 
 
+def test_sort_with_aggs_combined(ctx):
+    # sort + aggs both device-eligible: ordering from the sort launch, partials
+    # from the agg launch (same match set)
+    from elasticsearch_tpu.search.aggregations import reduce_aggs
+
+    body = {"query": {"match": {"body": "alpha"}},
+            "sort": [{"rank": "asc"}], "size": 10,
+            "aggs": {"m": {"max": {"field": "rank"}},
+                     "by_label": {"terms": {"field": "multi"}}}}
+    req = _both(ctx, body)
+    dev = execute_query_phase(ctx, req, use_device=True)
+    host = execute_query_phase(ctx, req, use_device=False)
+    dr = reduce_aggs(req.aggs, dev.agg_partials)
+    hr = reduce_aggs(req.aggs, host.agg_partials)
+    assert dr == hr
+
+
+def test_sort_with_host_only_agg_falls_back(ctx):
+    # an ineligible agg sends the whole request host-side, still correct
+    _both(ctx, {"query": {"match": {"body": "alpha"}},
+                "sort": [{"rank": "asc"}], "size": 5,
+                "aggs": {"c": {"cardinality": {"field": "rank"}}}},
+          expect_device=False)
+
+
 def test_track_scores(ctx):
     _both(ctx, {"query": {"match": {"body": "beta"}},
                 "sort": [{"rank": "asc"}], "size": 10, "track_scores": True})
