@@ -762,11 +762,21 @@ class Client:
         return {"cluster_name": state.cluster_name, "nodes": nodes}
 
     def nodes_stats(self):
+        from .search.service import SERVING_COUNTERS
+
+        ms = getattr(self.node.actions, "mesh_serving", None)
+        serving = dict(SERVING_COUNTERS)
+        if ms is not None:
+            serving["mesh_spmd"] = ms.mesh_queries
+            serving["mesh_fallbacks"] = ms.mesh_fallbacks
         return {"cluster_name": self.node.cluster_service.state.cluster_name,
                 "nodes": {self.node.node_id: {
             "indices": self.node.indices.stats(),
             "transport": self.node.transport.stats,
             "thread_pool": self.node.threadpool.stats(),
+            # which executor served each query phase (device kernel variants vs
+            # host scorer; process-wide rollup)
+            "search_serving": serving,
             **self.node.monitor.full_stats(),
         }}}
 

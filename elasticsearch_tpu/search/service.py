@@ -103,6 +103,23 @@ class ShardQueryResult:
     shard_id: int = 0
 
 
+# process-wide serving-path counters (which executor served the query phase —
+# surfaced via nodes stats "search_serving"; in-process test clusters share the
+# process, so treat these as process rollups, like the script registry)
+SERVING_COUNTERS = {
+    "device_sparse": 0,  # flat top-k via the sparse candidate kernel
+    "device_filtered": 0,  # filtered dense kernel
+    "device_function_score": 0,  # fs rows/script kernels
+    "device_aggs": 0,  # fused agg launch (metric/bucket)
+    "device_sort": 0,  # field-sort kernel (incl. sort+aggs composition)
+    "host": 0,  # host scorer / mask path
+}
+
+
+def _count(path: str):
+    SERVING_COUNTERS[path] += 1
+
+
 def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
                         use_device: bool = True, shard_id: int = 0) -> ShardQueryResult:
     k = req.from_ + req.size
@@ -113,11 +130,15 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
     if not needs_masks:
         plan = lower_flat(req.query, ctx) if use_device else None
         if plan is not None:
+            _count("device_function_score" if plan.fs is not None
+                   else "device_filtered" if plan.filt is not None
+                   else "device_sparse")
             td = execute_flat_batch([plan], ctx, max(k, 1))[0]
             return ShardQueryResult(
                 total=td.total, docs=[(s, d, None) for s, d in td.hits],
                 max_score=td.max_score, suggest=suggest_out, shard_id=shard_id,
             )
+        _count("host")
         td = _host_topk(ctx, req, k)
         return ShardQueryResult(total=td.total, docs=[(s, d, None) for s, d in td.hits],
                                 max_score=td.max_score, suggest=suggest_out,
@@ -131,6 +152,7 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
             and req.min_score is None and not req.explain):
         device = _try_device_aggs(ctx, req, k, suggest_out, shard_id)
         if device is not None:
+            _count("device_aggs")
             return device
 
     # device field-sort path: single numeric field sort, top-k over pre-folded
@@ -141,9 +163,11 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
             and req.min_score is None and not req.explain):
         device = _try_device_sort(ctx, req, k, suggest_out, shard_id)
         if device is not None:
+            _count("device_sort")
             return device
 
     # general path: dense per-segment masks drive sort/aggs/rescore
+    _count("host")
     seg_results = match_masks(ctx, req.query)
     seg_masks_for_aggs = []
     all_entries = []  # (sortkeys..., score, global_doc, seg_idx, local)

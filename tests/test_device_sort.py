@@ -145,3 +145,27 @@ def test_host_fallbacks_agree(ctx, body):
     if len(req.sort) == 1:
         assert _try_device_sort(ctx, req, 10, None, 0) is None
     _both(ctx, body, expect_device=False)
+
+
+def test_serving_counters_track_paths(ctx):
+    from elasticsearch_tpu.search.service import SERVING_COUNTERS
+
+    cases = [
+        ({"query": {"match": {"body": "alpha"}}, "size": 3}, "device_sparse"),
+        ({"query": {"filtered": {"query": {"match": {"body": "alpha"}},
+                                 "filter": {"range": {"rank": {"gte": 1}}}}},
+          "size": 3}, "device_filtered"),
+        ({"query": {"function_score": {"query": {"match": {"body": "alpha"}},
+                                       "boost_factor": 2}}, "size": 3},
+         "device_function_score"),
+        ({"query": {"match": {"body": "alpha"}}, "size": 0,
+          "aggs": {"m": {"max": {"field": "rank"}}}}, "device_aggs"),
+        ({"query": {"match": {"body": "alpha"}}, "sort": [{"rank": "asc"}],
+          "size": 3}, "device_sort"),
+        ({"query": {"match": {"body": "alpha"}}, "sort": ["_score", {"rank": "asc"}],
+          "size": 3}, "host"),
+    ]
+    for body, path in cases:
+        before = SERVING_COUNTERS[path]
+        execute_query_phase(ctx, parse_search_body(body), use_device=True)
+        assert SERVING_COUNTERS[path] == before + 1, (path, body)
