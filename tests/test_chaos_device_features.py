@@ -1,0 +1,89 @@
+"""Chaos testing of the device-served search features on a real multi-node
+cluster: function_score, fused aggregations and field sorts must return
+identical answers before and after a node kill + replica promotion, and the
+device serving paths must actually be the ones answering.
+
+ref: the reference's failover suites run real searches against TestCluster
+across node kills (src/test/java/org/elasticsearch/recovery/, discovery/);
+here the searches additionally pin the TPU-native serving kernels.
+"""
+
+from __future__ import annotations
+
+import math
+
+import pytest
+
+from tests.harness import TestCluster
+
+
+def _index_docs(client, n=90):
+    for i in range(n):
+        client.index("shop", "item", {
+            "body": ("red shiny " if i % 2 else "blue matte ") + f"thing{i % 7}",
+            "price": float(i % 50 + 1), "pop": i % 30 + 1,
+        }, id=str(i))
+    client.refresh("shop")
+
+
+def _searches():
+    return [
+        {"query": {"function_score": {
+            "query": {"match": {"body": "red shiny"}},
+            "script_score": {"script": "_score * log(2 + doc['pop'].value)"}}},
+         "size": 10},
+        {"query": {"filtered": {"query": {"match": {"body": "blue"}},
+                                "filter": {"range": {"price": {"gte": 20}}}}},
+         "size": 0,
+         "aggs": {"p": {"stats": {"field": "price"}},
+                  "by_pop": {"terms": {"field": "pop", "size": 40}}}},
+        {"query": {"match": {"body": "thing3"}},
+         "sort": [{"price": "desc"}], "size": 10},
+    ]
+
+
+def _snapshot(client, bodies):
+    out = []
+    for b in bodies:
+        r = client.search("shop", b)
+        hits = [(h["_id"], round(h.get("_score") or 0.0, 5),
+                 tuple(h.get("sort", []))) for h in r["hits"]["hits"]]
+        aggs = r.get("aggregations")
+        out.append((r["hits"]["total"], hits, aggs))
+    return out
+
+
+def _approx_equal(a, b):
+    if isinstance(a, dict) and isinstance(b, dict):
+        return set(a) == set(b) and all(_approx_equal(a[k], b[k]) for k in a)
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return len(a) == len(b) and all(_approx_equal(x, y) for x, y in zip(a, b))
+    if isinstance(a, float) and isinstance(b, float):
+        return (math.isnan(a) and math.isnan(b)) or a == pytest.approx(b, rel=1e-5)
+    return a == b
+
+
+def test_device_features_survive_failover(tmp_path):
+    from elasticsearch_tpu.search.service import SERVING_COUNTERS
+
+    with TestCluster(n_nodes=3, data_root=tmp_path, seed=11) as cluster:
+        client = cluster.client()
+        client.create_index("shop", {"settings": {
+            "number_of_shards": 3, "number_of_replicas": 1}})
+        cluster.ensure_green("shop")
+        _index_docs(client)
+
+        bodies = _searches()
+        before_counts = {k: SERVING_COUNTERS[k] for k in
+                         ("device_function_score", "device_aggs", "device_sort")}
+        baseline = _snapshot(client, bodies)
+        # every search was served by its device path on every queried shard
+        for key in before_counts:
+            assert SERVING_COUNTERS[key] > before_counts[key], key
+
+        victim = cluster.kill_random_node(exclude_master=True)
+        cluster.ensure_green("shop")
+
+        after = _snapshot(client, bodies)
+        for b, x, y in zip(bodies, baseline, after):
+            assert _approx_equal(x, y), (victim, b, x, y)
