@@ -174,6 +174,61 @@ def run_config(name, eng, svc, settings_sim, queries, k, batch, wrap=None,
     return device_qps, cpu_qps
 
 
+def run_fused_paths(eng, svc, queries, platform):
+    """Supplementary rows: the fused request-feature kernels (aggs / sort)
+    through execute_query_phase, device vs the host mask path — per-query
+    serving (Q=1), the latency shape these paths exist for."""
+    import time
+
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.search import ShardContext
+    from elasticsearch_tpu.search.aggregations import reduce_aggs
+    from elasticsearch_tpu.search.service import execute_query_phase, parse_search_body
+    from elasticsearch_tpu.search.similarity import SimilarityService
+
+    settings = Settings.from_flat({"index.similarity.default.type": "BM25"})
+    ctx = ShardContext(eng.acquire_searcher(), svc,
+                       SimilarityService(settings, mapper_service=svc))
+    shapes = {
+        "aggs (stats+terms)": lambda terms: {
+            "query": {"match": {"body": " ".join(terms)}}, "size": 0,
+            "aggs": {"s": {"stats": {"field": "pop"}},
+                     "t": {"terms": {"field": "pop", "size": 50}}}},
+        "sort (field asc)": lambda terms: {
+            "query": {"match": {"body": " ".join(terms)}},
+            "sort": [{"pop": "asc"}], "size": 10},
+    }
+    out = []
+    for name, mk in shapes.items():
+        reqs = [parse_search_body(mk(t)) for t in queries[:256]]
+        # correctness gate on a sample: totals + docs + reduced aggs must agree
+        for req in reqs[:5]:
+            dev = execute_query_phase(ctx, req, use_device=True)
+            host = execute_query_phase(ctx, req, use_device=False)
+            assert dev.total == host.total
+            assert [d for _s, d, _v in dev.docs] == [d for _s, d, _v in host.docs]
+            if req.aggs:
+                assert set(reduce_aggs(req.aggs, dev.agg_partials)) == \
+                    set(reduce_aggs(req.aggs, host.agg_partials))
+        execute_query_phase(ctx, reqs[0], use_device=True)  # warm compile
+        t0 = time.perf_counter()
+        for req in reqs:
+            execute_query_phase(ctx, req, use_device=True)
+        dev_qps = len(reqs) / (time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        for req in reqs[:64]:
+            execute_query_phase(ctx, req, use_device=False)
+        host_qps = 64 / (time.perf_counter() - t0)
+        line = {"metric": f"fused {name} per-query qps ({platform})",
+                "value": round(dev_qps, 1), "unit": "queries/sec",
+                "vs_baseline": round(dev_qps / host_qps, 2)}
+        out.append(line)
+        print(json.dumps(line))
+        print(f"# fused {name}: device {dev_qps:.0f} qps  host {host_qps:.0f} qps",
+              file=sys.stderr)
+    return out
+
+
 def main():
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     import bench as kernel_bench
@@ -223,6 +278,8 @@ def main():
         results.append(line)
         print(json.dumps(line))
         print(f"# {cfg}: device {dev:.0f} qps  host {cpu:.0f} qps", file=sys.stderr)
+        if cfg.startswith("config#2"):
+            results.extend(run_fused_paths(eng, svc, queries, platform))
         eng.close()
     return results
 
