@@ -316,12 +316,20 @@ def device_partial(agg: Agg, count, st):
 
 
 def device_bucket_eligible(agg: Agg) -> bool:
-    """Bucket aggs the device path serves: terms / histogram / date_histogram on
-    a plain field with no sub-aggs. Bucket KEYS are computed host-side per
-    segment (exact — calendar bucketing included); only the per-bucket doc
-    counts ride the kernel (exact int32 scatter-add under the match mask)."""
+    """Bucket aggs the device path serves: terms / histogram / date_histogram /
+    range / date_range / ip_range on a plain field with no sub-aggs. Bucket KEYS
+    are computed host-side per segment (exact — calendar bucketing and range
+    bound conversion included); only the per-bucket doc counts ride the kernel
+    (exact int32 scatter-add under the match mask)."""
     if agg.subs or not agg.spec.get("field") or agg.spec.get("script"):
         return False
+    if type(agg) in (RangeAgg, DateRangeAgg, IpRangeAgg):
+        # relative date-math bounds ("now-1h") re-resolve per query on the host;
+        # the device pair cache is per segment generation, so only absolute
+        # bounds are safe to cache
+        return not any("now" in str(b)
+                       for r in agg.spec.get("ranges", [])
+                       for b in (r.get("from"), r.get("to")) if b is not None)
     return type(agg) in (TermsAgg, HistogramAgg, DateHistogramAgg)
 
 
@@ -334,7 +342,7 @@ def bucket_cache_key(agg: Agg) -> tuple:
     (execute.execute_flat_aggs) so the two can never drift. Every spec param
     that changes the (pairs, keys) layout MUST appear here."""
     return ("bucket_cols", type(agg).__name__, agg.spec.get("field"),
-            repr(agg.spec.get("interval")))
+            repr(agg.spec.get("interval")), repr(agg.spec.get("ranges")))
 
 
 def _bucket_cache_put(cache: dict, ckey: tuple, value):
@@ -360,6 +368,28 @@ def bucket_cols_for(agg: Agg, seg) -> tuple:
     if cached is not None:
         return cached
     empty = (np.zeros(0, np.int32), np.zeros(0, np.int32), [])
+    if isinstance(agg, RangeAgg):
+        # range buckets: a value can fall in several (overlapping) ranges —
+        # one (doc, range) pair per membership, deduplicated per doc; every
+        # range emits a bucket even at zero docs (host collect does too)
+        ranges = agg.spec.get("ranges", [])
+        keys = [r.get("key") or f"{r.get('from', '*')}-{r.get('to', '*')}"
+                for r in ranges]
+        col = seg.dv_num.get(field)
+        if col is None or not len(col[1]) or not ranges:
+            out = (empty[0], empty[1], keys)
+            return _bucket_cache_put(seg._device_cache, ckey, out)
+        off, vals = col
+        counts = np.diff(off)
+        doc_of_val = np.repeat(np.arange(seg.doc_count, dtype=np.int64), counts)
+        pair_parts = [
+            doc_of_val[agg._selector(vals, r)[0]] * len(ranges) + ri
+            for ri, r in enumerate(ranges)
+        ]
+        pairs = np.unique(np.concatenate(pair_parts))
+        out = ((pairs // len(ranges)).astype(np.int32),
+               (pairs % len(ranges)).astype(np.int32), keys)
+        return _bucket_cache_put(seg._device_cache, ckey, out)
     if isinstance(agg, TermsAgg) and field in seg.dv_str:
         uniq, off, ords = seg.dv_str[field]
         if not len(uniq):
@@ -390,7 +420,16 @@ def bucket_cols_for(agg: Agg, seg) -> tuple:
 
 
 def device_bucket_partial(agg: Agg, keys: list, counts: np.ndarray) -> list:
-    """Kernel counts → the SAME partial shape _BucketAgg.collect produces."""
+    """Kernel counts → the SAME partial shape _BucketAgg.collect produces.
+    Range aggs keep zero-count buckets (the host emits every range) and carry
+    their converted bounds."""
+    if isinstance(agg, RangeAgg):
+        out = []
+        for (k, c, r) in zip(keys, counts, agg.spec.get("ranges", [])):
+            out.append({"key": k, "doc_count": int(c), "subs": {},
+                        "from": agg._convert(r.get("from")),
+                        "to": agg._convert(r.get("to"))})
+        return out
     return [{"key": k, "doc_count": int(c), "subs": {}}
             for k, c in zip(keys, counts) if c > 0]
 
@@ -609,18 +648,24 @@ class RangeAgg(_BucketAgg):
             return float(parse_date_math(v))
         return float(v)
 
+    def _selector(self, vals: np.ndarray, r: dict):
+        """(membership bool over vals, from, to) — the ONE half-open range
+        predicate, shared with the device pair builder (bucket_cols_for)."""
+        frm = self._convert(r.get("from"))
+        to = self._convert(r.get("to"))
+        sel = np.ones(len(vals), dtype=bool)
+        if frm is not None:
+            sel &= vals >= frm
+        if to is not None:
+            sel &= vals < to
+        return sel, frm, to
+
     def collect(self, seg, ctx, mask, scores=None):
         field = self.spec.get("field")
         docs, vals = _field_values(seg, field, mask)
         buckets = []
         for r in self.spec.get("ranges", []):
-            frm = self._convert(r.get("from"))
-            to = self._convert(r.get("to"))
-            sel = np.ones(len(vals), dtype=bool)
-            if frm is not None:
-                sel &= vals >= frm
-            if to is not None:
-                sel &= vals < to
+            sel, frm, to = self._selector(vals, r)
             bmask = np.zeros(seg.doc_count, dtype=bool)
             bmask[docs[sel]] = True
             bmask &= mask

@@ -138,7 +138,7 @@ def test_no_matches_yields_empty_stats(ctx):
            "aggs": {"s": {"sum": {"field": "pop"}}}}},  # bucket with sub-aggs
     {"x": {"value_count": {"field": "label"}}},  # string column
     {"x": {"cardinality": {"field": "pop"}}},  # sketch agg
-    {"x": {"range": {"field": "pop", "ranges": [{"to": 50}]}}},  # range agg
+    {"x": {"percentiles": {"field": "pop"}}},  # sketch agg
 ])
 def test_ineligible_aggs_fall_back(ctx, aggs):
     body = {"query": {"match": {"body": "alpha"}}, "size": 3, "aggs": aggs}
@@ -165,6 +165,18 @@ def test_histogram_parity(ctx):
         "query": {"match": {"body": "beta gamma"}}, "size": 0,
         "aggs": {"h": {"histogram": {"field": "price", "interval": 10}},
                  "hm": {"histogram": {"field": "tags_n", "interval": 2}}}})
+    assert _try_device_aggs(ctx, req, 1, None, 0) is not None
+
+
+def test_range_agg_parity(ctx):
+    # overlapping + unbounded + keyed + empty ranges; zero-count buckets survive
+    req = _both(ctx, {
+        "query": {"match": {"body": "alpha"}}, "size": 0,
+        "aggs": {"r": {"range": {"field": "price", "ranges": [
+            {"to": 30}, {"from": 20, "to": 60}, {"from": 50},
+            {"key": "none", "from": 4000, "to": 5000}]}},
+                 "rm": {"range": {"field": "tags_n", "ranges": [
+                     {"from": 1, "to": 5}, {"from": 5}]}}}})
     assert _try_device_aggs(ctx, req, 1, None, 0) is not None
 
 
@@ -276,3 +288,16 @@ def test_unlowerable_query_falls_back(ctx):
     # host path agrees with itself (sanity that fallback serves)
     res = execute_query_phase(ctx, req, use_device=True)
     assert reduce_aggs(req.aggs, res.agg_partials)["a"]["value"] is not None
+
+
+def test_date_math_range_bounds_stay_host(ctx):
+    # "now"-relative bounds re-resolve per query on the host; the device pair
+    # cache is per segment generation, so such specs must refuse the device
+    from elasticsearch_tpu.search.aggregations import device_bucket_eligible, parse_aggs
+
+    aggs = parse_aggs({"r": {"date_range": {"field": "pop", "ranges": [
+        {"from": "now-1h"}]}}})
+    assert not device_bucket_eligible(aggs["r"])
+    aggs2 = parse_aggs({"r": {"range": {"field": "pop", "ranges": [
+        {"from": 10, "to": 20}]}}})
+    assert device_bucket_eligible(aggs2["r"])

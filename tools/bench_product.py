@@ -202,14 +202,24 @@ def run_fused_paths(eng, svc, queries, platform):
     for name, mk in shapes.items():
         reqs = [parse_search_body(mk(t)) for t in queries[:256]]
         # correctness gate on a sample: totals + docs + reduced aggs must agree
+        def deep_close(a, b):
+            if isinstance(a, dict) and isinstance(b, dict):
+                return set(a) == set(b) and all(deep_close(a[x], b[x]) for x in a)
+            if isinstance(a, list) and isinstance(b, list):
+                return len(a) == len(b) and all(
+                    deep_close(x, y) for x, y in zip(a, b))
+            if isinstance(a, float) and isinstance(b, float):
+                return a == b or abs(a - b) <= 1e-5 * max(abs(b), 1.0)
+            return a == b
+
         for req in reqs[:5]:
             dev = execute_query_phase(ctx, req, use_device=True)
             host = execute_query_phase(ctx, req, use_device=False)
             assert dev.total == host.total
             assert [d for _s, d, _v in dev.docs] == [d for _s, d, _v in host.docs]
             if req.aggs:
-                assert set(reduce_aggs(req.aggs, dev.agg_partials)) == \
-                    set(reduce_aggs(req.aggs, host.agg_partials))
+                assert deep_close(reduce_aggs(req.aggs, dev.agg_partials),
+                                  reduce_aggs(req.aggs, host.agg_partials))
         execute_query_phase(ctx, reqs[0], use_device=True)  # warm compile
         t0 = time.perf_counter()
         for req in reqs:
