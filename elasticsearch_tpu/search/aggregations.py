@@ -316,17 +316,22 @@ def device_partial(agg: Agg, count, st):
 
 
 def device_bucket_eligible(agg: Agg) -> bool:
-    """Bucket aggs the device path serves: terms / histogram / date_histogram /
-    range / date_range / ip_range on a plain field with no sub-aggs. Bucket KEYS
-    are computed host-side per segment (exact — calendar bucketing and range
-    bound conversion included); only the per-bucket doc counts ride the kernel
-    (exact int32 scatter-add under the match mask)."""
-    if agg.subs or not agg.spec.get("field") or agg.spec.get("script"):
+    """Bucket aggs the device path serves, all with no sub-aggs: terms /
+    histogram / date_histogram / range family on a plain field, plus the
+    mask-shaped buckets (filter / filters / missing — their masks are
+    host-evaluated per segment like FilteredQuery). Bucket KEYS are computed
+    host-side (exact — calendar bucketing and range bound conversion included);
+    only the per-bucket doc counts ride the kernel (exact int32 scatter-add
+    under the match mask). Specs containing relative date math ("now…") refuse:
+    they re-resolve per query on the host while the device pair cache lives per
+    segment generation."""
+    if agg.subs:
+        return False
+    if type(agg) in (FilterAgg, FiltersAgg, MissingAgg):
+        return "now" not in repr(agg.spec)
+    if not agg.spec.get("field") or agg.spec.get("script"):
         return False
     if type(agg) in (RangeAgg, DateRangeAgg, IpRangeAgg):
-        # relative date-math bounds ("now-1h") re-resolve per query on the host;
-        # the device pair cache is per segment generation, so only absolute
-        # bounds are safe to cache
         return not any("now" in str(b)
                        for r in agg.spec.get("ranges", [])
                        for b in (r.get("from"), r.get("to")) if b is not None)
@@ -341,8 +346,8 @@ def bucket_cache_key(agg: Agg) -> tuple:
     shared by the host cache here and the device-array cache on PackedSegment
     (execute.execute_flat_aggs) so the two can never drift. Every spec param
     that changes the (pairs, keys) layout MUST appear here."""
-    return ("bucket_cols", type(agg).__name__, agg.spec.get("field"),
-            repr(agg.spec.get("interval")), repr(agg.spec.get("ranges")))
+    return ("bucket_cols", type(agg).__name__,
+            repr(sorted(agg.spec.items(), key=lambda kv: kv[0])))
 
 
 def _bucket_cache_put(cache: dict, ckey: tuple, value):
@@ -356,7 +361,7 @@ def _bucket_cache_put(cache: dict, ckey: tuple, value):
     return value
 
 
-def bucket_cols_for(agg: Agg, seg) -> tuple:
+def bucket_cols_for(agg: Agg, seg, ctx=None) -> tuple:
     """(pair_doc int32 [NP], pair_bucket int32 [NP], keys list) for one bucket
     agg on one segment — deduplicated (doc, bucket) pairs, so the scatter counts
     DOCS exactly like the host's bucket masks (a doc with duplicate values
@@ -368,6 +373,29 @@ def bucket_cols_for(agg: Agg, seg) -> tuple:
     if cached is not None:
         return cached
     empty = (np.zeros(0, np.int32), np.zeros(0, np.int32), [])
+    if isinstance(agg, (FilterAgg, FiltersAgg, MissingAgg)):
+        # mask-shaped buckets: host-evaluated per segment via the filter cache
+        # (same masks the host collectors use), one pair per matching doc
+        from .filters import MissingFilter
+
+        if isinstance(agg, MissingAgg):
+            masks = [("missing", segment_mask(seg, MissingFilter(field), ctx))]
+        elif isinstance(agg, FilterAgg):
+            masks = [("filter", segment_mask(seg, parse_filter(agg.spec), ctx))]
+        else:
+            fspecs = agg.spec.get("filters", {})
+            items = fspecs.items() if isinstance(fspecs, dict) else \
+                enumerate(fspecs)
+            masks = [(key, segment_mask(seg, parse_filter(fs), ctx))
+                     for key, fs in items]
+        keys = [k for k, _m in masks]
+        pair_parts = [np.nonzero(m)[0] * max(len(masks), 1) + mi
+                      for mi, (_k, m) in enumerate(masks)]
+        pairs = (np.concatenate(pair_parts).astype(np.int64)
+                 if pair_parts else np.zeros(0, np.int64))
+        out = ((pairs // max(len(masks), 1)).astype(np.int32),
+               (pairs % max(len(masks), 1)).astype(np.int32), keys)
+        return _bucket_cache_put(seg._device_cache, ckey, out)
     if isinstance(agg, RangeAgg):
         # range buckets: a value can fall in several (overlapping) ranges —
         # one (doc, range) pair per membership, deduplicated per doc; every
@@ -421,8 +449,8 @@ def bucket_cols_for(agg: Agg, seg) -> tuple:
 
 def device_bucket_partial(agg: Agg, keys: list, counts: np.ndarray) -> list:
     """Kernel counts → the SAME partial shape _BucketAgg.collect produces.
-    Range aggs keep zero-count buckets (the host emits every range) and carry
-    their converted bounds."""
+    Range and mask-shaped aggs keep zero-count buckets (the host emits every
+    range/filter); ranges carry their converted bounds."""
     if isinstance(agg, RangeAgg):
         out = []
         for (k, c, r) in zip(keys, counts, agg.spec.get("ranges", [])):
@@ -430,6 +458,9 @@ def device_bucket_partial(agg: Agg, keys: list, counts: np.ndarray) -> list:
                         "from": agg._convert(r.get("from")),
                         "to": agg._convert(r.get("to"))})
         return out
+    if isinstance(agg, (FilterAgg, FiltersAgg, MissingAgg)):
+        return [{"key": k, "doc_count": int(c), "subs": {}}
+                for k, c in zip(keys, counts)]
     return [{"key": k, "doc_count": int(c), "subs": {}}
             for k, c in zip(keys, counts) if c > 0]
 

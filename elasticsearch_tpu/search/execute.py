@@ -821,7 +821,7 @@ def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
         pair_args = []
         seg_keys = []
         for agg in bucket_aggs:
-            pdoc, pbucket, keys = bucket_cols_for(agg, seg)
+            pdoc, pbucket, keys = bucket_cols_for(agg, seg, ctx)
             ck = bucket_cache_key(agg)  # same constructor as the host cache
             dev = packed.bucket_cols.get(ck)
             if dev is None:

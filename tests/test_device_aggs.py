@@ -301,3 +301,22 @@ def test_date_math_range_bounds_stay_host(ctx):
     aggs2 = parse_aggs({"r": {"range": {"field": "pop", "ranges": [
         {"from": 10, "to": 20}]}}})
     assert device_bucket_eligible(aggs2["r"])
+
+
+def test_mask_shaped_bucket_aggs_parity(ctx):
+    # filter / filters / missing ride the device scatter with host-built masks
+    req = _both(ctx, {
+        "query": {"match": {"body": "alpha"}}, "size": 0,
+        "aggs": {"f": {"filter": {"range": {"pop": {"gte": 50}}}},
+                 "fs": {"filters": {"filters": {
+                     "cheap": {"range": {"price": {"lte": 30}}},
+                     "tagged": {"exists": {"field": "tags_n"}}}}},
+                 "no_pop": {"missing": {"field": "pop"}}}})
+    assert _try_device_aggs(ctx, req, 1, None, 0) is not None
+
+
+def test_mask_bucket_with_date_math_stays_host(ctx):
+    from elasticsearch_tpu.search.aggregations import device_bucket_eligible, parse_aggs
+
+    aggs = parse_aggs({"f": {"filter": {"range": {"pop": {"gte": "now-1h"}}}}})
+    assert not device_bucket_eligible(aggs["f"])
