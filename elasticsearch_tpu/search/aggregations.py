@@ -335,7 +335,8 @@ def device_bucket_eligible(agg: Agg) -> bool:
         return not any("now" in str(b)
                        for r in agg.spec.get("ranges", [])
                        for b in (r.get("from"), r.get("to")) if b is not None)
-    return type(agg) in (TermsAgg, HistogramAgg, DateHistogramAgg)
+    return type(agg) in (TermsAgg, HistogramAgg, DateHistogramAgg,
+                         GeoDistanceAgg, GeohashGridAgg)
 
 
 _BUCKET_CACHE_MAX = 8  # distinct bucket-agg shapes cached per segment
@@ -346,8 +347,14 @@ def bucket_cache_key(agg: Agg) -> tuple:
     shared by the host cache here and the device-array cache on PackedSegment
     (execute.execute_flat_aggs) so the two can never drift. Every spec param
     that changes the (pairs, keys) layout MUST appear here."""
+    # finalize-only params don't change the (pairs, keys) layout — excluding
+    # them keeps e.g. size:10 / size:50 variants of one terms agg on one cache
+    # entry instead of fragmenting the FIFO
+    layout_irrelevant = ("size", "shard_size", "order", "min_doc_count",
+                         "extended_bounds")
     return ("bucket_cols", type(agg).__name__,
-            repr(sorted(agg.spec.items(), key=lambda kv: kv[0])))
+            repr(sorted(((k, v) for k, v in agg.spec.items()
+                         if k not in layout_irrelevant), key=lambda kv: kv[0])))
 
 
 def _bucket_cache_put(cache: dict, ckey: tuple, value):
@@ -395,6 +402,44 @@ def bucket_cols_for(agg: Agg, seg, ctx=None) -> tuple:
                  if pair_parts else np.zeros(0, np.int64))
         out = ((pairs // max(len(masks), 1)).astype(np.int32),
                (pairs % max(len(masks), 1)).astype(np.int32), keys)
+        return _bucket_cache_put(seg._device_cache, ckey, out)
+    if isinstance(agg, (GeoDistanceAgg, GeohashGridAgg)):
+        # geo buckets: distances/cells computed host-side per value (static
+        # origin/precision per spec — covered by the cache key), then the same
+        # deduplicated pair machinery
+        field2 = agg.spec.get("field")
+        lat_col = seg.dv_num.get(f"{field2}.lat")
+        lon_col = seg.dv_num.get(f"{field2}.lon")
+        if lat_col is None or lon_col is None or not len(lat_col[1]):
+            out = (empty[0], empty[1],
+                   [r.get("key") or f"{r.get('from', '*')}-{r.get('to', '*')}"
+                    for r in agg.spec.get("ranges", [])]
+                   if isinstance(agg, GeoDistanceAgg) else [])
+            return _bucket_cache_put(seg._device_cache, ckey, out)
+        off, lats = lat_col
+        _, lons = lon_col
+        counts = np.diff(off)
+        doc_of_val = np.repeat(np.arange(seg.doc_count, dtype=np.int64), counts)
+        if isinstance(agg, GeohashGridAgg):
+            cells = agg._cells(lats, lons)
+            uniq_c = sorted(set(cells))
+            cpos = {c: i for i, c in enumerate(uniq_c)}
+            inv = np.asarray([cpos[c] for c in cells], dtype=np.int64)
+            pairs = np.unique(doc_of_val * len(uniq_c) + inv)
+            out = ((pairs // len(uniq_c)).astype(np.int32),
+                   (pairs % len(uniq_c)).astype(np.int32), uniq_c)
+            return _bucket_cache_put(seg._device_cache, ckey, out)
+        d = agg._distances(lats, lons)
+        ranges = agg.spec.get("ranges", [])
+        keys = [agg._range_key(r) for r in ranges]
+        pair_parts = [
+            doc_of_val[agg._range_sel(d, r)] * max(len(ranges), 1) + ri
+            for ri, r in enumerate(ranges)
+        ]
+        pairs = (np.unique(np.concatenate(pair_parts)) if pair_parts
+                 else np.zeros(0, np.int64))
+        out = ((pairs // max(len(ranges), 1)).astype(np.int32),
+               (pairs % max(len(ranges), 1)).astype(np.int32), keys)
         return _bucket_cache_put(seg._device_cache, ckey, out)
     if isinstance(agg, RangeAgg):
         # range buckets: a value can fall in several (overlapping) ranges —
@@ -458,7 +503,7 @@ def device_bucket_partial(agg: Agg, keys: list, counts: np.ndarray) -> list:
                         "from": agg._convert(r.get("from")),
                         "to": agg._convert(r.get("to"))})
         return out
-    if isinstance(agg, (FilterAgg, FiltersAgg, MissingAgg)):
+    if isinstance(agg, (FilterAgg, FiltersAgg, MissingAgg, GeoDistanceAgg)):
         return [{"key": k, "doc_count": int(c), "subs": {}}
                 for k, c in zip(keys, counts)]
     return [{"key": k, "doc_count": int(c), "subs": {}}
@@ -934,9 +979,11 @@ class NestedAgg(_BucketAgg):
 
 
 class GeoDistanceAgg(_BucketAgg):
-    def collect(self, seg, ctx, mask, scores=None):
-        field = self.spec.get("field")
-        origin = self.spec.get("origin") or self.spec.get("point") or self.spec.get("center")
+    def _distances(self, lats: np.ndarray, lons: np.ndarray) -> np.ndarray:
+        """Per-value distance from the spec origin in spec units — the ONE
+        origin-parse + haversine, shared with the device pair builder."""
+        origin = self.spec.get("origin") or self.spec.get("point") \
+            or self.spec.get("center")
         if isinstance(origin, dict):
             lat0, lon0 = float(origin["lat"]), float(origin["lon"])
         elif isinstance(origin, str):
@@ -944,22 +991,36 @@ class GeoDistanceAgg(_BucketAgg):
         else:
             lon0, lat0 = float(origin[0]), float(origin[1])
         unit = parse_distance("1" + self.spec.get("unit", "m"))
+        return haversine_m(lat0, lon0, lats, lons) / unit
+
+    @staticmethod
+    def _range_sel(d: np.ndarray, r: dict) -> np.ndarray:
+        sel = np.ones(len(d), dtype=bool)
+        if r.get("from") is not None:
+            sel &= d >= float(r["from"])
+        if r.get("to") is not None:
+            sel &= d < float(r["to"])
+        return sel
+
+    @staticmethod
+    def _range_key(r: dict) -> str:
+        frm, to = r.get("from"), r.get("to")
+        return r.get("key") or \
+            f"{frm if frm is not None else '*'}-{to if to is not None else '*'}"
+
+    def collect(self, seg, ctx, mask, scores=None):
+        field = self.spec.get("field")
         docs_lat, lats = _field_values(seg, f"{field}.lat", mask)
         _, lons = _field_values(seg, f"{field}.lon", mask)
-        d = haversine_m(lat0, lon0, lats, lons) / unit
+        d = self._distances(lats, lons)
         buckets = []
         for r in self.spec.get("ranges", []):
-            frm, to = r.get("from"), r.get("to")
-            sel = np.ones(len(d), dtype=bool)
-            if frm is not None:
-                sel &= d >= float(frm)
-            if to is not None:
-                sel &= d < float(to)
+            sel = self._range_sel(d, r)
             bmask = np.zeros(seg.doc_count, dtype=bool)
             bmask[docs_lat[sel]] = True
             bmask &= mask
-            key = r.get("key") or f"{frm if frm is not None else '*'}-{to if to is not None else '*'}"
-            buckets.append(self._bucket_partial(seg, ctx, key, bmask, scores))
+            buckets.append(self._bucket_partial(seg, ctx, self._range_key(r),
+                                                bmask, scores))
         return buckets
 
     def merge(self, partials):
@@ -967,6 +1028,47 @@ class GeoDistanceAgg(_BucketAgg):
 
     def finalize(self, merged):
         return {"buckets": [self._finalize_bucket(e) for e in merged.values()]}
+
+
+class GeohashGridAgg(_BucketAgg):
+    """Buckets per geohash cell at `precision`, doc-deduplicated counts, ordered
+    by count desc then cell asc (ref:
+    search/aggregations/bucket/geogrid/GeoHashGridParser.java)."""
+
+    def _cells(self, lats: np.ndarray, lons: np.ndarray) -> list:
+        from ..common.geo import geohash_encode
+
+        precision = int(self.spec.get("precision", 5))
+        return [geohash_encode(float(la), float(lo), precision)
+                for la, lo in zip(lats, lons)]
+
+    def collect(self, seg, ctx, mask, scores=None):
+        field = self.spec.get("field")
+        docs, lats = _field_values(seg, f"{field}.lat", mask)
+        _, lons = _field_values(seg, f"{field}.lon", mask)
+        by_cell: dict[str, set] = {}
+        for d, cell in zip(docs, self._cells(lats, lons)):
+            by_cell.setdefault(cell, set()).add(int(d))
+        buckets = []
+        for cell, ds in by_cell.items():
+            if not self.subs:
+                # docs are already mask-filtered; the per-cell mask is only
+                # needed to drive sub-agg collection
+                buckets.append({"key": cell, "doc_count": len(ds), "subs": {}})
+                continue
+            bmask = np.zeros(seg.doc_count, dtype=bool)
+            bmask[list(ds)] = True
+            buckets.append(self._bucket_partial(seg, ctx, cell, bmask, scores))
+        return buckets
+
+    def merge(self, partials):
+        return self._merge_buckets(partials)
+
+    def finalize(self, merged):
+        entries = sorted(merged.values(),
+                         key=lambda e: (-e["doc_count"], e["key"]))
+        size = int(self.spec.get("size", 10000) or 10000)
+        return {"buckets": [self._finalize_bucket(e) for e in entries[:size]]}
 
 
 class SignificantTermsAgg(TermsAgg):
@@ -1045,6 +1147,7 @@ _AGG_REGISTRY: dict[str, type] = {
     "missing": MissingAgg,
     "nested": NestedAgg,
     "geo_distance": GeoDistanceAgg,
+    "geohash_grid": GeohashGridAgg,
 }
 
 

@@ -320,3 +320,31 @@ def test_mask_bucket_with_date_math_stays_host(ctx):
 
     aggs = parse_aggs({"f": {"filter": {"range": {"pop": {"gte": "now-1h"}}}}})
     assert not device_bucket_eligible(aggs["f"])
+
+
+def test_geo_bucket_aggs_parity():
+    import tempfile
+
+    svc = MapperService(Settings.from_flat({}))
+    svc.put_mapping("doc", {"properties": {"loc": {"type": "geo_point"}}})
+    eng = Engine(tempfile.mkdtemp(), svc)
+    rng = np.random.default_rng(9)
+    for i in range(150):
+        eng.index("doc", str(i), {
+            "body": "alpha" if i % 2 else "alpha beta",
+            "loc": {"lat": float(rng.uniform(40, 60)),
+                    "lon": float(rng.uniform(-5, 25))}})
+    eng.refresh()
+    c = ShardContext(eng.acquire_searcher(), svc,
+                     SimilarityService(Settings.from_flat({}), mapper_service=svc))
+    req = _both(c, {
+        "query": {"match": {"body": "alpha"}}, "size": 0,
+        "aggs": {"d": {"geo_distance": {"field": "loc",
+                                        "origin": {"lat": 50, "lon": 10},
+                                        "unit": "km",
+                                        "ranges": [{"to": 300},
+                                                   {"from": 300, "to": 900},
+                                                   {"from": 900}]}},
+                 "g": {"geohash_grid": {"field": "loc", "precision": 2}}}})
+    assert _try_device_aggs(c, req, 1, None, 0) is not None
+    eng.close()
