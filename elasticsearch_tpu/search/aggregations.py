@@ -335,8 +335,8 @@ def device_bucket_eligible(agg: Agg) -> bool:
         return not any("now" in str(b)
                        for r in agg.spec.get("ranges", [])
                        for b in (r.get("from"), r.get("to")) if b is not None)
-    return type(agg) in (TermsAgg, HistogramAgg, DateHistogramAgg,
-                         GeoDistanceAgg, GeohashGridAgg)
+    return type(agg) in (TermsAgg, SignificantTermsAgg, HistogramAgg,
+                         DateHistogramAgg, GeoDistanceAgg, GeohashGridAgg)
 
 
 _BUCKET_CACHE_MAX = 8  # distinct bucket-agg shapes cached per segment
@@ -492,10 +492,35 @@ def bucket_cols_for(agg: Agg, seg, ctx=None) -> tuple:
     return _bucket_cache_put(seg._device_cache, ckey, out)
 
 
-def device_bucket_partial(agg: Agg, keys: list, counts: np.ndarray) -> list:
+def _sig_bg_counts(seg, field: str) -> dict:
+    """Per-term BACKGROUND doc counts (live parent docs, deduplicated) for
+    significant_terms — depends on tombstones, so cached per live generation."""
+    ck = ("sig_bg", field)
+    cached = seg._device_cache.get(ck)
+    if cached is not None and cached[0] == seg.live_gen:
+        return cached[1]
+    col = seg.dv_str.get(field)
+    out: dict = {}
+    if col is not None and len(col[0]):
+        uniq, off, ords = col
+        bg = seg.live & seg.parent_mask
+        counts = np.diff(off)
+        doc_of_val = np.repeat(np.arange(seg.doc_count, dtype=np.int64), counts)
+        sel = bg[doc_of_val]
+        pairs = np.unique(doc_of_val[sel] * len(uniq) + ords[sel])
+        ord_counts = np.bincount((pairs % len(uniq)).astype(np.int64),
+                                 minlength=len(uniq))
+        out = {uniq[i]: int(ord_counts[i]) for i in range(len(uniq))}
+    seg._device_cache[ck] = (seg.live_gen, out)
+    return out
+
+
+def device_bucket_partial(agg: Agg, keys: list, counts: np.ndarray,
+                          seg=None) -> list:
     """Kernel counts → the SAME partial shape _BucketAgg.collect produces.
     Range and mask-shaped aggs keep zero-count buckets (the host emits every
-    range/filter); ranges carry their converted bounds."""
+    range/filter); ranges carry their converted bounds; significant_terms
+    attaches per-term background counts."""
     if isinstance(agg, RangeAgg):
         out = []
         for (k, c, r) in zip(keys, counts, agg.spec.get("ranges", [])):
@@ -506,6 +531,14 @@ def device_bucket_partial(agg: Agg, keys: list, counts: np.ndarray) -> list:
     if isinstance(agg, (FilterAgg, FiltersAgg, MissingAgg, GeoDistanceAgg)):
         return [{"key": k, "doc_count": int(c), "subs": {}}
                 for k, c in zip(keys, counts)]
+    if isinstance(agg, SignificantTermsAgg):
+        field = agg.spec.get("field")
+        bg = _sig_bg_counts(seg, field) if seg is not None and \
+            field in seg.dv_str else {}
+        # numeric columns / unknown keys: host falls back to bg == doc_count
+        return [{"key": k, "doc_count": int(c), "subs": {},
+                 "bg_count": int(bg.get(k, c))}
+                for k, c in zip(keys, counts) if c > 0]
     return [{"key": k, "doc_count": int(c), "subs": {}}
             for k, c in zip(keys, counts) if c > 0]
 

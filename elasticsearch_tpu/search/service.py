@@ -281,9 +281,10 @@ def _try_device_aggs(ctx: ShardContext, req: ParsedSearchRequest, k: int,
         {name: (device_partial(agg, counts[fpos[metric_fields[name]]],
                                stats[fpos[metric_fields[name]]])
                 if name in metric_fields
-                else device_bucket_partial(agg, *buckets[bpos[name]]))
+                else device_bucket_partial(agg, *buckets[bpos[name]], seg=seg))
          for name, agg in req.aggs.items()}
-        for (counts, stats, buckets) in seg_stats
+        for (counts, stats, buckets), seg in zip(seg_stats,
+                                                 ctx.searcher.segments)
     ]
     return ShardQueryResult(
         total=td.total, docs=[(s, d, None) for s, d in td.hits[:max(k, 0)]],

@@ -348,3 +348,14 @@ def test_geo_bucket_aggs_parity():
                  "g": {"geohash_grid": {"field": "loc", "precision": 2}}}})
     assert _try_device_aggs(c, req, 1, None, 0) is not None
     eng.close()
+
+
+def test_significant_terms_parity(ctx):
+    req = _both(ctx, {
+        "query": {"match": {"body": "alpha beta"}}, "size": 0,
+        "aggs": {"sig": {"significant_terms": {"field": "label", "size": 10}}}})
+    assert _try_device_aggs(ctx, req, 1, None, 0) is not None
+    # bg_count present in the reduced output
+    r = reduce_aggs(req.aggs, execute_query_phase(ctx, req).agg_partials)
+    assert all("bg_count" in b and b["bg_count"] >= b["doc_count"] >= 1
+               for b in r["sig"]["buckets"])
