@@ -508,10 +508,42 @@ def agg_stat_reduction(match, agg_rows):
     return counts, stats
 
 
+def _bucket_scatter(match, pdoc, pbucket, nb: int, sub_stack):
+    """One bucket agg's reductions: exact int32 doc counts per bucket, plus —
+    when the agg carries metric sub-aggs (sub_stack [Fs, 5, Dpad]) — per-bucket
+    masked stats of the per-doc folds, scattered along the SAME (doc, bucket)
+    pairs so a doc contributes once per bucket it belongs to (exactly the host's
+    per-bucket mask collection)."""
+    import jax.numpy as jnp
+
+    Q = match.shape[0]
+    hit = match[:, pdoc]  # [Q, NP] bool
+    counts = jnp.zeros((Q, nb), jnp.int32).at[:, pbucket].add(
+        hit.astype(jnp.int32))
+    if sub_stack is None:
+        return counts, None, None
+    Fs = sub_stack.shape[0]
+    m = hit[:, None, :]  # [Q, 1, NP]
+    cnt_g = sub_stack[:, 0][:, pdoc].astype(jnp.int32)  # [Fs, NP]
+    sub_cnt = jnp.zeros((Q, Fs, nb), jnp.int32).at[:, :, pbucket].add(
+        jnp.where(m, cnt_g[None], 0))
+    has_vals = m & (cnt_g[None] > 0)  # min/max must ignore value-less docs
+    parts = []
+    for row, fill, op in ((1, 0.0, "add"), (2, jnp.inf, "min"),
+                          (3, -jnp.inf, "max"), (4, 0.0, "add")):
+        g = sub_stack[:, row][:, pdoc]  # [Fs, NP]
+        gate = m if op == "add" else has_vals
+        contrib = jnp.where(gate, g[None], jnp.float32(fill))
+        base = jnp.full((Q, Fs, nb), jnp.float32(fill))
+        parts.append(getattr(base.at[:, :, pbucket], op)(contrib))
+    sub_stats = jnp.stack([parts[0], parts[1], parts[2], parts[3]], axis=3)
+    return counts, sub_cnt, sub_stats  # [Q,Fs,nb], [Q,Fs,nb,4]=(sum,min,max,sumsq)
+
+
 def _dense_aggstats_impl(blk_docs, blk_freqs, live_parent, norms_stack, caches,
                          qidx, blk, weight, fidx, group, tfmode, n_must, msm, coord,
                          agg_rows,  # [F, 5, Dpad] f32 (F may be 0)
-                         bucket_pairs,  # tuple of (pair_doc [NP], pair_bucket [NP], nb-sized zeros)
+                         bucket_pairs,  # tuple of (pair_doc, pair_bucket, nb zeros, sub_stack|None)
                          fmask,  # bool [Q, Dpad] — FilteredQuery masks (all-true when none)
                          *, n_queries: int, k: int, doc_pad: int):
     import jax
@@ -528,12 +560,9 @@ def _dense_aggstats_impl(blk_docs, blk_freqs, live_parent, norms_stack, caches,
     top_scores, top_docs = jax.lax.top_k(masked, k)
     total = match.sum(axis=1, dtype=jnp.int32)
     counts, stats = agg_stat_reduction(match, agg_rows)
-    # bucket aggs: per deduplicated (doc, bucket) pair, scatter the match bit —
-    # doc counts are exact int32; keys live host-side
     bucket_counts = tuple(
-        jnp.broadcast_to(zeros_nb, (Q,) + zeros_nb.shape).astype(jnp.int32)
-        .at[:, pbucket].add(match[:, pdoc].astype(jnp.int32))
-        for (pdoc, pbucket, zeros_nb) in bucket_pairs
+        _bucket_scatter(match, pdoc, pbucket, zeros_nb.shape[0], sub_stack)
+        for (pdoc, pbucket, zeros_nb, sub_stack) in bucket_pairs
     )
     return top_scores, top_docs, total, counts, stats, bucket_counts
 
@@ -541,10 +570,12 @@ def _dense_aggstats_impl(blk_docs, blk_freqs, live_parent, norms_stack, caches,
 def score_agg_batch(packed: PackedSegment, batch: TermBatch, k: int,
                     agg_row_stack, bucket_pairs=(), fmask=None):
     """Dense launch returning (scores, docs, total, counts [Q, F] int,
-    stats [Q, F, 4], bucket_counts tuple of [Q, NB]) numpy. stats rows:
-    (sum, min(+inf if none), max(-inf), sumsq) over matched docs per agg field;
-    bucket_pairs: per bucket agg, (pair_doc, pair_bucket, zeros[NB]) device
-    arrays; fmask: optional bool [Q, Dpad] FilteredQuery match gates."""
+    stats [Q, F, 4], bucket results) numpy. stats rows: (sum, min(+inf if none),
+    max(-inf), sumsq) over matched docs per agg field; bucket_pairs: per bucket
+    agg, (pair_doc, pair_bucket, zeros[NB], sub_stack [Fs,5,Dpad]|None) device
+    arrays — each bucket result is (doc counts [Q,NB], sub value-counts
+    [Q,Fs,NB]|None, sub stats [Q,Fs,NB,4]|None); fmask: optional bool [Q, Dpad]
+    FilteredQuery match gates."""
     import jax
     import jax.numpy as jnp
 
@@ -573,7 +604,10 @@ def score_agg_batch(packed: PackedSegment, batch: TermBatch, k: int,
     )
     return (np.asarray(top_scores), np.asarray(top_docs), np.asarray(total),
             np.asarray(counts), np.asarray(stats),
-            tuple(np.asarray(c) for c in bucket_counts))
+            tuple((np.asarray(c),
+                   None if sc is None else np.asarray(sc),
+                   None if ss is None else np.asarray(ss))
+                  for (c, sc, ss) in bucket_counts))
 
 
 def _detect_simple(batch: TermBatch) -> bool:

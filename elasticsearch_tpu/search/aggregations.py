@@ -278,6 +278,18 @@ def device_agg_field(agg: Agg, ctx) -> str | None:
     return field
 
 
+def device_bucket_subs(agg: Agg, ctx) -> dict | None:
+    """name -> numeric column for every metric sub-agg of a bucket agg, or None
+    when any sub can't ride the kernel (deeper nesting, scripts, bucket subs)."""
+    out = {}
+    for name, sub in agg.subs.items():
+        f = device_agg_field(sub, ctx)
+        if f is None:
+            return None
+        out[name] = f
+    return out
+
+
 def device_agg_fields(aggs: dict, ctx) -> dict | None:
     """name -> numeric column for EVERY agg in the request, or None when any agg
     needs the host path — the single eligibility gate shared by the single-shard
@@ -316,17 +328,18 @@ def device_partial(agg: Agg, count, st):
 
 
 def device_bucket_eligible(agg: Agg) -> bool:
-    """Bucket aggs the device path serves, all with no sub-aggs: terms /
-    histogram / date_histogram / range family on a plain field, plus the
-    mask-shaped buckets (filter / filters / missing — their masks are
+    """Bucket aggs the device path serves: terms / significant_terms /
+    histogram / date_histogram / range family / geo buckets on a plain field,
+    plus the mask-shaped buckets (filter / filters / missing — their masks are
     host-evaluated per segment like FilteredQuery). Bucket KEYS are computed
     host-side (exact — calendar bucketing and range bound conversion included);
     only the per-bucket doc counts ride the kernel (exact int32 scatter-add
     under the match mask). Specs containing relative date math ("now…") refuse:
     they re-resolve per query on the host while the device pair cache lives per
-    segment generation."""
-    if agg.subs:
-        return False
+    segment generation.
+
+    Metric SUB-aggs are separately eligible (device_bucket_subs): their per-doc
+    folds scatter along the same (doc, bucket) pairs — callers must check."""
     if type(agg) in (FilterAgg, FiltersAgg, MissingAgg):
         return "now" not in repr(agg.spec)
     if not agg.spec.get("field") or agg.spec.get("script"):
@@ -516,31 +529,52 @@ def _sig_bg_counts(seg, field: str) -> dict:
 
 
 def device_bucket_partial(agg: Agg, keys: list, counts: np.ndarray,
-                          seg=None) -> list:
+                          seg=None, sub_data=None) -> list:
     """Kernel counts → the SAME partial shape _BucketAgg.collect produces.
     Range and mask-shaped aggs keep zero-count buckets (the host emits every
     range/filter); ranges carry their converted bounds; significant_terms
-    attaches per-term background counts."""
+    attaches per-term background counts. sub_data = (sub_aggs, field_of,
+    field_order, sub_cnt [Fs, NB] int, sub_stats [Fs, NB, 4]) when metric
+    sub-aggs rode the kernel — their partials assemble in the host shapes via
+    device_partial, so merge/finalize nest unchanged."""
+    sub_rows = None
+    if sub_data is not None:
+        sub_aggs, field_of, order, scnt, sstats = sub_data
+        fpos = {f: i for i, f in enumerate(order)}
+        sub_rows = [(n, s, fpos[field_of[n]]) for n, s in sub_aggs.items()]
+
+    def mk(bi: int, key, c) -> dict:
+        subs = {}
+        if sub_rows is not None:
+            subs = {n: device_partial(s, scnt[fi, bi], sstats[fi, bi])
+                    for n, s, fi in sub_rows}
+        return {"key": key, "doc_count": int(c), "subs": subs}
+
     if isinstance(agg, RangeAgg):
         out = []
-        for (k, c, r) in zip(keys, counts, agg.spec.get("ranges", [])):
-            out.append({"key": k, "doc_count": int(c), "subs": {},
-                        "from": agg._convert(r.get("from")),
-                        "to": agg._convert(r.get("to"))})
+        for bi, (k, c, r) in enumerate(zip(keys, counts,
+                                           agg.spec.get("ranges", []))):
+            b = mk(bi, k, c)
+            b["from"] = agg._convert(r.get("from"))
+            b["to"] = agg._convert(r.get("to"))
+            out.append(b)
         return out
     if isinstance(agg, (FilterAgg, FiltersAgg, MissingAgg, GeoDistanceAgg)):
-        return [{"key": k, "doc_count": int(c), "subs": {}}
-                for k, c in zip(keys, counts)]
+        return [mk(bi, k, c) for bi, (k, c) in enumerate(zip(keys, counts))]
     if isinstance(agg, SignificantTermsAgg):
         field = agg.spec.get("field")
         bg = _sig_bg_counts(seg, field) if seg is not None and \
             field in seg.dv_str else {}
-        # numeric columns / unknown keys: host falls back to bg == doc_count
-        return [{"key": k, "doc_count": int(c), "subs": {},
-                 "bg_count": int(bg.get(k, c))}
-                for k, c in zip(keys, counts) if c > 0]
-    return [{"key": k, "doc_count": int(c), "subs": {}}
-            for k, c in zip(keys, counts) if c > 0]
+        out = []
+        for bi, (k, c) in enumerate(zip(keys, counts)):
+            if c > 0:
+                b = mk(bi, k, c)
+                # numeric columns / unknown keys: host falls back to bg == fg
+                b["bg_count"] = int(bg.get(k, c))
+                out.append(b)
+        return out
+    return [mk(bi, k, c)
+            for bi, (k, c) in enumerate(zip(keys, counts)) if c > 0]
 
 
 class CardinalityAgg(Agg):

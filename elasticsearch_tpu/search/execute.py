@@ -794,12 +794,13 @@ def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
                       fields: list[str], bucket_aggs: list = ()):
     """Single-plan dense execution with aggregations fused into the kernel:
     returns (TopDocs, per-segment (counts int [F], stats float32 [F, 4],
-    bucket list of (keys, counts))) with F = len(fields), stats =
-    (sum, min, max, sumsq) over matched docs. bucket_aggs: Agg objects whose
-    (doc, bucket) pairs ride the kernel's scatter (aggregations.bucket_cols_for).
-    Serving uses this when every aggregation is device-eligible
-    (service.execute_query_phase → aggregations.device_agg_fields /
-    device_bucket_eligible)."""
+    bucket list of (keys, counts, sub_cnt|None, sub_stats|None))) with
+    F = len(fields), stats = (sum, min, max, sumsq) over matched docs.
+    bucket_aggs: (Agg, sub_field_order|None) pairs whose (doc, bucket) pairs
+    ride the kernel's scatter (aggregations.bucket_cols_for); metric sub-agg
+    folds scatter along the same pairs. Serving uses this when every
+    aggregation is device-eligible (service.execute_query_phase →
+    aggregations.device_agg_fields / device_bucket_eligible)."""
     import jax.numpy as jnp
 
     from ..ops.device_index import ensure_agg_rows, packed_for
@@ -820,7 +821,7 @@ def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
             return None, None  # column not f32-exact → host collectors
         pair_args = []
         seg_keys = []
-        for agg in bucket_aggs:
+        for agg, sub_order in bucket_aggs:
             pdoc, pbucket, keys = bucket_cols_for(agg, seg, ctx)
             ck = bucket_cache_key(agg)  # same constructor as the host cache
             dev = packed.bucket_cols.get(ck)
@@ -831,7 +832,12 @@ def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
                     packed.bucket_cols, ck,
                     (jnp.asarray(pdoc), jnp.asarray(pbucket),
                      jnp.zeros(len(keys), jnp.int32)))
-            pair_args.append(dev)
+            sub_stack = None
+            if sub_order:
+                sub_stack = ensure_agg_rows(seg, packed, sub_order)
+                if sub_stack is None:
+                    return None, None  # sub column not f32-exact → host
+            pair_args.append((dev[0], dev[1], dev[2], sub_stack))
             seg_keys.append(keys)
         entries = _dense_entries(finals, seg, packed, field_idx)
         batch = build_term_batch(entries, 1, n_must, msm, coord_tbl,
@@ -849,8 +855,12 @@ def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
         valid = (docs < min(packed.doc_pad, seg.doc_count)) & np.isfinite(scores)
         gdocs = np.where(valid, docs.astype(np.int64) + base, np.int64(2**62))
         seg_hits.append((np.where(valid, scores, -np.inf), gdocs))
-        seg_stats.append((counts[0], stats[0],
-                          [(keys, bc[0]) for keys, bc in zip(seg_keys, bcounts)]))
+        seg_stats.append((counts[0], stats[0], [
+            (keys, bc[0],
+             None if sc is None else sc[0],
+             None if ss is None else ss[0])
+            for keys, (bc, sc, ss) in zip(seg_keys, bcounts)
+        ]))
     return _merge_seg_hits(seg_hits, totals, 1, k)[0], seg_stats
 
 

@@ -251,17 +251,25 @@ def _try_device_aggs(ctx: ShardContext, req: ParsedSearchRequest, k: int,
     masked stats, bucket aggs (terms/histogram/date_histogram) to exact
     scatter-add doc counts over host-computed keys."""
     from .aggregations import (device_agg_field, device_bucket_eligible,
-                               device_bucket_partial, device_partial)
+                               device_bucket_partial, device_bucket_subs,
+                               device_partial)
     from .execute import execute_flat_aggs
 
     metric_fields = {}
     bucket_names = []
+    bucket_subs: dict[str, dict] = {}
     for name, agg in req.aggs.items():
         f = device_agg_field(agg, ctx)
         if f is not None:
             metric_fields[name] = f
         elif device_bucket_eligible(agg):
+            subs = device_bucket_subs(agg, ctx) if agg.subs else {}
+            if subs is None:
+                return None  # a sub-agg can't ride the kernel
             bucket_names.append(name)
+            # the ONE field-order used for both the kernel stack layout and
+            # partial-assembly row lookup
+            bucket_subs[name] = (subs, sorted(set(subs.values())))
         else:
             return None
     plan = lower_flat(req.query, ctx)
@@ -269,7 +277,9 @@ def _try_device_aggs(ctx: ShardContext, req: ParsedSearchRequest, k: int,
         return None
     fields = sorted(set(metric_fields.values()))
     fpos = {f: i for i, f in enumerate(fields)}
-    bucket_aggs = [req.aggs[n] for n in bucket_names]
+    bucket_aggs = [
+        (req.aggs[n], bucket_subs[n][1] or None) for n in bucket_names
+    ]
     # kernel k is at least 1 so max_score stays observable; hits trim to the
     # requested size below (size=0 agg-only requests return no docs, like the
     # host mask path)
@@ -277,11 +287,21 @@ def _try_device_aggs(ctx: ShardContext, req: ParsedSearchRequest, k: int,
     if td is None:
         return None  # a column wasn't f32-exact — host path
     bpos = {n: i for i, n in enumerate(bucket_names)}
+
+    def bucket_partial(name, agg, buckets, seg):
+        keys, bcounts, sub_cnt, sub_stats = buckets[bpos[name]]
+        sub_data = None
+        field_of, order = bucket_subs[name]
+        if field_of:
+            sub_data = (agg.subs, field_of, order, sub_cnt, sub_stats)
+        return device_bucket_partial(agg, keys, bcounts, seg=seg,
+                                     sub_data=sub_data)
+
     agg_partials = [
         {name: (device_partial(agg, counts[fpos[metric_fields[name]]],
                                stats[fpos[metric_fields[name]]])
                 if name in metric_fields
-                else device_bucket_partial(agg, *buckets[bpos[name]], seg=seg))
+                else bucket_partial(name, agg, buckets, seg))
          for name, agg in req.aggs.items()}
         for (counts, stats, buckets), seg in zip(seg_stats,
                                                  ctx.searcher.segments)

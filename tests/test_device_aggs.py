@@ -135,7 +135,9 @@ def test_no_matches_yields_empty_stats(ctx):
     {"x": {"extended_stats": {"field": "price"}}},  # variance: host-only
     {"x": {"avg": {"script": "doc['price'].value * 2"}}},  # script agg
     {"x": {"terms": {"field": "label"},
-           "aggs": {"s": {"sum": {"field": "pop"}}}}},  # bucket with sub-aggs
+           "aggs": {"s": {"cardinality": {"field": "pop"}}}}},  # sketch sub-agg
+    {"x": {"terms": {"field": "label"},
+           "aggs": {"s": {"terms": {"field": "pop"}}}}},  # bucket sub-agg
     {"x": {"value_count": {"field": "label"}}},  # string column
     {"x": {"cardinality": {"field": "pop"}}},  # sketch agg
     {"x": {"percentiles": {"field": "pop"}}},  # sketch agg
@@ -359,3 +361,40 @@ def test_significant_terms_parity(ctx):
     r = reduce_aggs(req.aggs, execute_query_phase(ctx, req).agg_partials)
     assert all("bg_count" in b and b["bg_count"] >= b["doc_count"] >= 1
                for b in r["sig"]["buckets"])
+
+
+def test_metric_sub_aggs_under_buckets_parity(ctx):
+    # the canonical analytics tree: buckets with metric sub-aggs, all in-kernel
+    req = _both(ctx, {
+        "query": {"match": {"body": "alpha beta"}}, "size": 0,
+        "aggs": {
+            "by_label": {"terms": {"field": "label", "size": 20},
+                         "aggs": {"p_avg": {"avg": {"field": "price"}},
+                                  "p_stats": {"stats": {"field": "price"}},
+                                  "pop_max": {"max": {"field": "pop"}}}},
+            "by_range": {"range": {"field": "price",
+                                   "ranges": [{"to": 40}, {"from": 40}]},
+                         "aggs": {"t_sum": {"sum": {"field": "tags_n"}}}},
+            "no_pop": {"missing": {"field": "pop"},
+                       "aggs": {"p_min": {"min": {"field": "price"}}}},
+        }})
+    assert _try_device_aggs(ctx, req, 1, None, 0) is not None
+
+
+def test_sub_agg_empty_buckets_parity(ctx):
+    # zero-count range buckets must carry the same empty sub partials as host
+    _both(ctx, {
+        "query": {"match": {"body": "gamma"}}, "size": 0,
+        "aggs": {"r": {"range": {"field": "price",
+                                 "ranges": [{"from": 5000, "to": 6000}]},
+                       "aggs": {"a": {"avg": {"field": "pop"}},
+                                "m": {"min": {"field": "pop"}}}}}})
+
+
+def test_sub_agg_multivalued_exact(ctx):
+    # multi-valued sub-agg sums within buckets stay exact (per-doc host folds)
+    _both(ctx, {
+        "query": {"match": {"body": "delta"}}, "size": 0,
+        "aggs": {"by_label": {"terms": {"field": "label"},
+                              "aggs": {"t": {"sum": {"field": "tags_n"}},
+                                       "tc": {"value_count": {"field": "tags_n"}}}}}})
