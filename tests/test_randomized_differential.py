@@ -215,17 +215,34 @@ def test_randomized_agg_parity():
             for ai in range(int(rng.integers(1, 4))):
                 kind = rng.random()
                 field = str(rng.choice(["price", "pop", "tags"]))
-                if kind < 0.4:
+                if kind < 0.3:
                     aggs[f"a{ai}"] = {str(rng.choice(
                         ["avg", "sum", "min", "max", "stats", "value_count"])):
                         {"field": field}}
-                elif kind < 0.7:
+                elif kind < 0.5:
                     aggs[f"a{ai}"] = {"terms": {"field": str(rng.choice(
                         ["label", "pop", "tags"])), "size": 50}}
-                else:
+                elif kind < 0.65:
                     aggs[f"a{ai}"] = {"histogram": {
                         "field": field,
                         "interval": float(rng.choice([2, 5, 10, 25]))}}
+                elif kind < 0.8:
+                    lo = int(rng.integers(0, 200))
+                    aggs[f"a{ai}"] = {str(rng.choice(["range", "missing"])): (
+                        {"field": field, "ranges": [
+                            {"to": lo}, {"from": lo, "to": lo + 150},
+                            {"from": lo + 150}]}
+                        if rng.random() < 0.7 else {"field": field})}
+                    if "ranges" not in list(aggs[f"a{ai}"].values())[0] \
+                            and "range" in aggs[f"a{ai}"]:
+                        aggs[f"a{ai}"] = {"missing": {"field": field}}
+                else:
+                    # bucket + metric sub-agg tree
+                    sub = {f"s{ai}": {str(rng.choice(
+                        ["avg", "sum", "min", "max", "stats"])):
+                        {"field": str(rng.choice(["price", "pop", "tags"]))}}}
+                    aggs[f"a{ai}"] = {"terms": {"field": str(rng.choice(
+                        ["label", "pop"])), "size": 50}, "aggs": sub}
             body = {"query": _rand_query(rng), "size": int(rng.integers(0, 10)),
                     "aggs": aggs}
             req = parse_search_body(body)
