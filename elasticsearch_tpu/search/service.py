@@ -155,6 +155,17 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
             _count("device_aggs")
             return device
 
+    # device post_filter path: aggs (if any) reduce over the FULL match set while
+    # hits gate on the post filter — two composed launches sharing the dense core
+    # (the reference's faceting idiom: post_filter never affects aggregations)
+    if (use_device and req.post_filter is not None and not req.sort
+            and not req.facets and not req.rescore and req.min_score is None
+            and not req.explain):
+        device = _try_device_post_filter(ctx, req, k, suggest_out, shard_id)
+        if device is not None:
+            _count("device_filtered")
+            return device
+
     # device field-sort path: single numeric field sort, top-k over pre-folded
     # key rows inside the kernel (execute.execute_flat_sorted); combines with
     # device-eligible aggs (agg launch supplies partials, sort launch ordering)
@@ -310,6 +321,36 @@ def _try_device_aggs(ctx: ShardContext, req: ParsedSearchRequest, k: int,
         total=td.total, docs=[(s, d, None) for s, d in td.hits[:max(k, 0)]],
         max_score=td.max_score, agg_partials=agg_partials, suggest=suggest_out,
         shard_id=shard_id,
+    )
+
+
+def _try_device_post_filter(ctx: ShardContext, req: ParsedSearchRequest, k: int,
+                            suggest_out, shard_id: int) -> "ShardQueryResult | None":
+    """post_filter requests: the hit launch gates on (query filter AND post
+    filter); the agg launch (when aggs exist and are device-eligible) sees only
+    the query's own match set — exactly the host mask path's split."""
+    import dataclasses
+
+    from .execute import lower_flat
+    from .filters import BoolFilter
+
+    plan = lower_flat(req.query, ctx)
+    if plan is None or plan.fs is not None:
+        return None
+    agg_result = None
+    if req.aggs:
+        agg_result = _try_device_aggs(ctx, req, 0, None, shard_id)
+        if agg_result is None:
+            return None
+    hit_filter = req.post_filter if plan.filt is None else \
+        BoolFilter(must=[plan.filt, req.post_filter])
+    hit_plan = dataclasses.replace(plan, filt=hit_filter)
+    td = execute_flat_batch([hit_plan], ctx, max(k, 1))[0]
+    return ShardQueryResult(
+        total=td.total, docs=[(s, d, None) for s, d in td.hits[: max(k, 0)]],
+        max_score=td.max_score,
+        agg_partials=agg_result.agg_partials if agg_result is not None else [],
+        suggest=suggest_out, shard_id=shard_id,
     )
 
 

@@ -398,3 +398,28 @@ def test_sub_agg_multivalued_exact(ctx):
         "aggs": {"by_label": {"terms": {"field": "label"},
                               "aggs": {"t": {"sum": {"field": "tags_n"}},
                                        "tc": {"value_count": {"field": "tags_n"}}}}}})
+
+
+def test_post_filter_device_parity(ctx):
+    # hits post-filtered, aggs over the FULL match set — the faceting idiom
+    req = _both(ctx, {
+        "query": {"match": {"body": "alpha"}}, "size": 10,
+        "post_filter": {"range": {"pop": {"gte": 50}}},
+        "aggs": {"by_label": {"terms": {"field": "label"}},
+                 "p_avg": {"avg": {"field": "price"}}}})
+    # total reflects the post filter; aggs don't
+    full = execute_query_phase(ctx, parse_search_body(
+        {"query": {"match": {"body": "alpha"}}, "size": 0}))
+    res = execute_query_phase(ctx, req)
+    assert res.total < full.total
+    dr = reduce_aggs(req.aggs, res.agg_partials)
+    assert sum(b["doc_count"] for b in dr["by_label"]["buckets"]) == full.total
+
+
+def test_post_filter_with_filtered_query(ctx):
+    _both(ctx, {
+        "query": {"filtered": {"query": {"match": {"body": "beta"}},
+                               "filter": {"range": {"price": {"lte": 70}}}}},
+        "size": 8,
+        "post_filter": {"term": {"label": "gamma"}},
+        "aggs": {"s": {"stats": {"field": "pop"}}}})
