@@ -155,6 +155,23 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
             _count("device_aggs")
             return device
 
+    # device min_score path: the function_score rows kernel with no functions IS
+    # a score threshold gate — synthesize an empty fs wrapper around the query
+    if (use_device and req.min_score is not None and not req.aggs
+            and not req.facets and not req.sort and req.post_filter is None
+            and not req.rescore and not req.explain):
+        from .queries import FunctionScoreQuery
+
+        wrapped = FunctionScoreQuery(query=req.query, min_score=req.min_score)
+        plan = lower_flat(wrapped, ctx)
+        if plan is not None:
+            _count("device_filtered")
+            td = execute_flat_batch([plan], ctx, max(k, 1))[0]
+            return ShardQueryResult(
+                total=td.total, docs=[(s, d, None) for s, d in td.hits[: max(k, 0)]],
+                max_score=td.max_score, suggest=suggest_out, shard_id=shard_id,
+            )
+
     # device post_filter path: aggs (if any) reduce over the FULL match set while
     # hits gate on the post filter — two composed launches sharing the dense core
     # (the reference's faceting idiom: post_filter never affects aggregations)

@@ -423,3 +423,17 @@ def test_post_filter_with_filtered_query(ctx):
         "size": 8,
         "post_filter": {"term": {"label": "gamma"}},
         "aggs": {"s": {"stats": {"field": "pop"}}}})
+
+
+def test_min_score_device_parity(ctx):
+    body = {"query": {"match": {"body": "alpha beta"}}, "size": 10,
+            "min_score": 0.8}
+    req = parse_search_body(body)
+    dev = execute_query_phase(ctx, req, use_device=True)
+    host = execute_query_phase(ctx, req, use_device=False)
+    assert dev.total == host.total and dev.total > 0
+    assert [(round(s, 5), d) for s, d, _ in dev.docs] == \
+        [(round(s, 5), d) for s, d, _ in host.docs]
+    loose = execute_query_phase(ctx, parse_search_body(
+        {"query": {"match": {"body": "alpha beta"}}, "size": 0}))
+    assert dev.total < loose.total  # the threshold really trims
