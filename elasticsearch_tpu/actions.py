@@ -21,6 +21,7 @@ implementing one of the reference's interaction patterns (action/support/):
 from __future__ import annotations
 
 import base64
+import contextlib
 import threading
 import time
 import uuid
@@ -95,6 +96,7 @@ A_GET = "indices:data/read/get[s]"
 A_TERMVECTOR = "indices:data/read/termvector[s]"
 A_QUERY_PHASE = "indices:data/read/search[phase/query]"
 A_FETCH_PHASE = "indices:data/read/search[phase/fetch]"
+A_FREE_CONTEXT = "indices:data/read/search[free-context]"
 A_DFS_PHASE = "indices:data/read/search[phase/dfs]"
 A_SHARD_BROADCAST = "indices:admin/broadcast[s]"
 
@@ -142,6 +144,14 @@ class ActionModule:
 
         self.mesh_serving = MeshServingService(node.indices, node.settings,
                                                node_name=node.name)
+        self.mesh_serving.pin_context = self._pin_context
+        # point-in-time contexts pinned between the query and fetch phases (the
+        # reference's SearchService active-contexts map: a merge/refresh between
+        # phases must not move local doc ids under the fetch — SearchContext
+        # holds the query-time searcher; ref SearchService.java:177,315)
+        self._pinned: dict[int, tuple] = {}  # cid -> (expiry, index, shard, ctx)
+        self._pinned_lock = threading.Lock()
+        self._pinned_next = [1]
         t = self.transport
         # master-node actions
         for action, fn in [
@@ -177,6 +187,7 @@ class ActionModule:
         t.register_handler(A_TERMVECTOR, self._s_termvector, executor="get")
         t.register_handler(A_QUERY_PHASE, self._s_query_phase, executor="search")
         t.register_handler(A_FETCH_PHASE, self._s_fetch_phase, executor="search")
+        t.register_handler(A_FREE_CONTEXT, self._s_free_context, executor="search")
         t.register_handler(A_DFS_PHASE, self._s_dfs_phase, executor="search")
         t.register_handler(A_SHARD_BROADCAST, self._s_broadcast, executor="management")
         # sniffing TransportClient surface (ref: TransportClientNodesService — the
@@ -1358,22 +1369,31 @@ class ActionModule:
                                              "dfs_query_and_fetch"))
         if mesh_results is not None:
             node_local = state.nodes.get(self.node.local_node.id)
-            shard_meta = {o: (copy.index, copy.shard_id, node_local)
+            shard_meta = {o: (copy.index, copy.shard_id, node_local,
+                              mesh_results[o].context_id)
                           for o, copy in enumerate(shards)}
             return self._finish_search(req, body, mesh_results, [], shards,
                                        shard_meta, t0)
 
         dfs_stats = None
+        dfs_failed: set[int] = set()  # ordinals excluded from the query phase
         if search_type in ("dfs_query_then_fetch", "dfs_query_and_fetch"):
             # concurrent DFS fan-out — the distributed-IDF all-reduce's gather leg
-            # (ref: TransportSearchDfsQueryThenFetchAction async per-shard phase)
+            # (ref: TransportSearchDfsQueryThenFetchAction async per-shard phase).
+            # Each shard fails over across its copies like the query phase; a
+            # shard with no serving copy becomes a recorded shard FAILURE and is
+            # excluded from the query phase — querying it against stats that
+            # omit it would silently skew every shard's IDF
             dfs_futs = [(copy, self.transport.send_request(
                 state.nodes.get(copy.node_id), A_DFS_PHASE, {
                     "index": copy.index, "shard": copy.shard_id, "body": body or {},
                 })) for copy in shards]
             dfs_results = []
-            for copy, fut in dfs_futs:
-                r = fut_result(fut, 30.0)
+            for ordinal, (copy, fut) in enumerate(dfs_futs):
+                r = self._dfs_shard_result(state, copy, body, fut)
+                if r is None:
+                    dfs_failed.add(ordinal)
+                    continue
                 dfs_results.append(DfsResult(
                     shard_id=copy.shard_id, max_doc=r["max_doc"],
                     term_df={(f, t): v for f, t, v in r["term_df"]},
@@ -1391,22 +1411,28 @@ class ActionModule:
         # merge identity is a coordinator-assigned ordinal — (index, shard) pairs from
         # different indices may share a shard id (ref: the per-request shard index in
         # TransportSearchTypeAction), so results carry the ordinal as shard_id
-        shard_meta: dict[int, tuple] = {}  # ordinal -> (index, real_shard_id, node)
+        shard_meta: dict[int, tuple] = {}  # ordinal -> (index, real_shard_id, node, ctx_id)
         # concurrent query-phase fan-out: every shard's first phase is dispatched at
         # once and failover chains advance via future callbacks, so N-shard latency is
         # max(shard) not sum(shard) and no coordinator thread parks per shard
         # (ref: TransportSearchTypeAction.java:135-216 async performFirstPhase)
-        query_futs = [self._query_shard_async(state, copy, body, alias_filters,
-                                              dfs_stats) for copy in shards]
+        query_futs = [
+            None if ordinal in dfs_failed else
+            self._query_shard_async(state, copy, body, alias_filters, dfs_stats)
+            for ordinal, copy in enumerate(shards)]
         # shared deadline: chains resolve themselves (every attempt is timer-bounded),
         # so this is a backstop — without sharing it, k hung shards would stack k
         # fresh waits instead of running down one clock. Scale it to the longest
         # possible failover chain so a chain with many hung copies can't outlive it.
-        max_chain = max((getattr(f, "max_attempts", 1) for f in query_futs),
-                        default=1)
+        max_chain = max((getattr(f, "max_attempts", 1) for f in query_futs
+                         if f is not None), default=1)
         deadline = (time.monotonic()
                     + self.QUERY_ATTEMPT_TIMEOUT * max(1, max_chain) + 5.0)
         for ordinal, (copy, fut) in enumerate(zip(shards, query_futs)):
+            if fut is None:
+                failures.append({"index": copy.index, "shard": copy.shard_id,
+                                 "reason": "dfs phase failed on every copy"})
+                continue
             try:
                 r, used, err = fut.result(
                     timeout=max(0.0, deadline - time.monotonic()))
@@ -1416,7 +1442,7 @@ class ActionModule:
                 if cancel is not None:
                     cancel()  # abandoned chain must not keep scheduling attempts
             if r is not None:
-                shard_meta[ordinal] = (copy.index, r.shard_id, used)
+                shard_meta[ordinal] = (copy.index, r.shard_id, used, r.context_id)
                 r.shard_id = ordinal
                 results.append(r)
             else:
@@ -1437,15 +1463,24 @@ class ActionModule:
         fetched: dict[int, dict] = {}
         fetch_futs = []
         for ordinal, entries in by_shard.items():
-            index_name, real_shard, node = shard_meta[ordinal]
+            index_name, real_shard, node, ctx_id = shard_meta[ordinal]
             fetch_futs.append((entries, self.transport.send_request(node, A_FETCH_PHASE, {
                 "index": index_name, "shard": real_shard, "body": body or {},
+                "ctx": ctx_id,
                 "docs": [[score, doc, sort_values] for (_rank, score, doc, sort_values) in entries],
             })))
         for entries, fut in fetch_futs:
             r = fut_result(fut, 30.0)
             for (rank, *_), hit in zip(entries, r["hits"]):
                 fetched[rank] = hit
+        # release pinned contexts of shards that contributed no fetched hits
+        # (fire-and-forget, like the reference's free-context after the merge)
+        for ordinal, meta in shard_meta.items():
+            index_name, real_shard, node, ctx_id = meta
+            if ctx_id is not None and ordinal not in by_shard:
+                with contextlib.suppress(Exception):
+                    self.transport.send_request(node, A_FREE_CONTEXT, {
+                        "index": index_name, "shard": real_shard, "ctx": ctx_id})
         hits = [fetched[r] for r in sorted(fetched)]
         return merge_responses(req, merged, results, hits,
                                took_ms=int((time.monotonic() - t0) * 1000),
@@ -1460,6 +1495,29 @@ class ActionModule:
         return None
 
     QUERY_ATTEMPT_TIMEOUT = 60.0
+
+    def _dfs_shard_result(self, state, copy: ShardRouting, body, first_fut):
+        """DFS phase for one shard group with failover across its copies (the
+        first attempt is already in flight for fan-out concurrency; failover
+        attempts are sequential — rare). Returns the stats dict, or None when no
+        copy on a live node serves it."""
+        group = state.routing_table.index(copy.index).shard(copy.shard_id)
+        candidates = [copy] + [s for s in group.active_shards()
+                               if s.node_id != copy.node_id]
+        fut = first_fut
+        for cand in candidates:
+            if fut is None:
+                node = state.nodes.get(cand.node_id)
+                if node is None:
+                    continue
+                fut = self.transport.send_request(node, A_DFS_PHASE, {
+                    "index": cand.index, "shard": cand.shard_id,
+                    "body": body or {}})
+            try:
+                return fut_result(fut, 30.0)
+            except SearchEngineError:  # TransportError subclasses it
+                fut = None  # next copy
+        return None
 
     def _query_shard_async(self, state, copy: ShardRouting, body, alias_filters,
                            dfs_stats) -> Future:
@@ -1526,10 +1584,12 @@ class ActionModule:
                 try:
                     err = f.exception()
                     if err is not None:
-                        if isinstance(err, SearchEngineError):
-                            attempt(i + 1, err)  # next replica
-                        else:
-                            done.set_result((None, None, err))
+                        # ANY per-attempt failure fails over to the next copy —
+                        # including transport errors to a node that died after
+                        # this state was read (ref: onFirstPhaseResult treats
+                        # every shard exception as failover, :292); terminal
+                        # only when the chain runs out of candidates
+                        attempt(i + 1, err)
                         return
                     r = f.result()
                     result = ShardQueryResult(
@@ -1539,6 +1599,7 @@ class ActionModule:
                         agg_partials=_decode_partials(r.get("agg_partials")),
                         facet_partials=_decode_partials(r.get("facet_partials")),
                         suggest=r.get("suggest"),
+                        context_id=r.get("ctx_id"),
                         shard_id=candidate.shard_id,
                     )
                     result.index_name = candidate.index  # type: ignore[attr-defined]
@@ -1551,6 +1612,35 @@ class ActionModule:
 
         attempt(0, None)
         return done
+
+    _PIN_KEEP_S = 60.0
+
+    def _pin_context(self, index: str, shard_id: int, ctx: ShardContext) -> int:
+        """Pin a query-phase ShardContext for the fetch phase; reaped lazily."""
+        now = time.monotonic()
+        with self._pinned_lock:
+            for k in [k for k, v in self._pinned.items() if v[0] < now]:
+                del self._pinned[k]
+            cid = self._pinned_next[0]
+            self._pinned_next[0] += 1
+            self._pinned[cid] = (now + self._PIN_KEEP_S, index, shard_id, ctx)
+        return cid
+
+    def _take_pinned(self, cid, index: str, shard_id: int) -> ShardContext | None:
+        now = time.monotonic()
+        with self._pinned_lock:
+            for k in [k for k, v in self._pinned.items() if v[0] < now]:
+                del self._pinned[k]
+            v = self._pinned.pop(cid, None) if cid is not None else None
+        if v is not None and v[1] == index and v[2] == shard_id:
+            return v[3]
+        return None
+
+    def _s_free_context(self, request, channel):
+        """ES's free-context: the coordinator releases pinned searchers of shards
+        that contributed no fetched hits (the fetch itself pops the winners)."""
+        self._take_pinned(request.get("ctx"), request["index"], request["shard"])
+        return {}
 
     def _shard_ctx(self, index: str, shard_id: int, dfs: dict | None = None) -> ShardContext:
         svc = self.indices.index_service(index)
@@ -1584,6 +1674,9 @@ class ActionModule:
             "agg_partials": _encode_partials(result.agg_partials),
             "facet_partials": _encode_partials(result.facet_partials),
             "suggest": result.suggest,
+            # fetch must read the SAME point-in-time searcher these doc ids
+            # come from (a merge between phases moves local ids)
+            "ctx_id": self._pin_context(index, shard_id, ctx),
         }
 
     def _maybe_slowlog(self, index: str, shard_id: int, body: dict, took_s: float):
@@ -1604,7 +1697,11 @@ class ActionModule:
                 return
 
     def _s_fetch_phase(self, request, channel):
-        ctx = self._shard_ctx(request["index"], request["shard"])
+        # the pinned query-time context when available (expired/restarted nodes
+        # fall back to a fresh searcher — best effort, like a lost scroll)
+        ctx = self._take_pinned(request.get("ctx"), request["index"],
+                                request["shard"]) \
+            or self._shard_ctx(request["index"], request["shard"])
         req = parse_search_body(request.get("body") or {})
         docs = [(s, d, sv) for s, d, sv in request["docs"]]
         hits = execute_fetch_phase(ctx, req, docs, index_name=request["index"],

@@ -209,13 +209,22 @@ class MeshServingService:
                                          stats[fpos[agg_fields[name]]])
                     for name, agg in req.aggs.items()
                 }]
-            results.append(ShardQueryResult(
+            result = ShardQueryResult(
                 total=int(out.shard_totals[copy.shard_id, 0]),
                 docs=rows,
                 max_score=max(scores) if scores else float("nan"),
                 agg_partials=agg_partials,
                 shard_id=ordinal,
-            ))
+            )
+            # pin the query-time searcher for the fetch phase (a merge between
+            # phases must not move local doc ids under the fetch)
+            pin = getattr(self, "pin_context", None)
+            if pin is not None:
+                result.context_id = pin(
+                    copy.index, copy.shard_id,
+                    ShardContext(searchers[copy.shard_id], svc.mapper_service,
+                                 svc.similarity_service))
+            results.append(result)
         return results
 
     def _executor_for(self, index: str, svc, searchers, kind, default_sim,

