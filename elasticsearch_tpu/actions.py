@@ -1461,16 +1461,30 @@ class ActionModule:
         for rank, (score, ordinal, doc, sort_values) in enumerate(page):
             by_shard.setdefault(ordinal, []).append((rank, score, doc, sort_values))
         fetched: dict[int, dict] = {}
+        fetch_failed = 0
         fetch_futs = []
         for ordinal, entries in by_shard.items():
             index_name, real_shard, node, ctx_id = shard_meta[ordinal]
-            fetch_futs.append((entries, self.transport.send_request(node, A_FETCH_PHASE, {
-                "index": index_name, "shard": real_shard, "body": body or {},
-                "ctx": ctx_id,
-                "docs": [[score, doc, sort_values] for (_rank, score, doc, sort_values) in entries],
-            })))
-        for entries, fut in fetch_futs:
-            r = fut_result(fut, 30.0)
+            fetch_futs.append(((ordinal, entries), self.transport.send_request(
+                node, A_FETCH_PHASE, {
+                    "index": index_name, "shard": real_shard, "body": body or {},
+                    "ctx": ctx_id,
+                    "docs": [[score, doc, sort_values]
+                             for (_rank, score, doc, sort_values) in entries],
+                })))
+        for (ordinal, entries), fut in fetch_futs:
+            try:
+                r = fut_result(fut, 30.0)
+            except Exception as e:  # noqa: BLE001 — ANY per-shard fetch failure
+                # (remote errors arrive typed over TCP but raw over the local
+                # transport): a shard lost between phases drops ITS hits and
+                # records a failure; the rest of the page still returns (ref:
+                # fetch-phase onFailure collects ShardFetchFailures)
+                index_name, real_shard, _node, _cid = shard_meta[ordinal]
+                failures.append({"index": index_name, "shard": real_shard,
+                                 "reason": f"fetch phase failed: {e}"})
+                fetch_failed += 1
+                continue
             for (rank, *_), hit in zip(entries, r["hits"]):
                 fetched[rank] = hit
         # release pinned contexts of shards that contributed no fetched hits
@@ -1485,7 +1499,8 @@ class ActionModule:
         return merge_responses(req, merged, results, hits,
                                took_ms=int((time.monotonic() - t0) * 1000),
                                total_shards=len(shards),
-                               successful=len(results), failures=failures)
+                               successful=len(results) - fetch_failed,
+                               failures=failures)
 
     @staticmethod
     def _shard_index(shards, shard_id):

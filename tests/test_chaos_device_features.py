@@ -87,3 +87,38 @@ def test_device_features_survive_failover(tmp_path):
         after = _snapshot(client, bodies)
         for b, x, y in zip(bodies, baseline, after):
             assert _approx_equal(x, y), (victim, b, x, y)
+
+
+def test_fetch_failure_drops_shard_not_search(tmp_path):
+    # a shard lost between query and fetch: its hits drop, the rest return,
+    # and a failure is recorded (ref: ShardFetchFailure semantics)
+    with TestCluster(n_nodes=1, data_root=tmp_path, seed=3) as cluster:
+        client = cluster.client()
+        client.create_index("f", {"settings": {
+            "number_of_shards": 2, "number_of_replicas": 0}})
+        cluster.ensure_green("f")
+        for i in range(40):
+            client.index("f", "d", {"body": "common words here"}, id=str(i))
+        client.refresh("f")
+        import elasticsearch_tpu.actions as actions_mod
+
+        orig = actions_mod.execute_fetch_phase
+        state = {"failed": False}
+
+        def flaky(ctx, req, docs, index_name="index", shard_id=0):
+            if shard_id == 1 and not state["failed"]:
+                state["failed"] = True
+                raise RuntimeError("node lost between phases")
+            return orig(ctx, req, docs, index_name=index_name,
+                        shard_id=shard_id)
+
+        actions_mod.execute_fetch_phase = flaky
+        try:
+            r = client.search("f", {"query": {"match": {"body": "common"}},
+                                    "size": 40})
+        finally:
+            actions_mod.execute_fetch_phase = orig
+        assert state["failed"]
+        assert r["_shards"]["failed"] >= 1
+        assert 0 < len(r["hits"]["hits"]) < 40  # shard 0's hits survived
+        assert r["hits"]["total"] == 40
