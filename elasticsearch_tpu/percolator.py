@@ -28,6 +28,8 @@ class PercolatorRegistry:
     """Per-index registry of parsed percolator queries (ref: index/percolator/
     PercolatorQueriesRegistry — kept in sync with .percolator-type docs)."""
 
+    DEVICE_BATCH_MIN = 64  # below this the host loop beats device dispatch
+
     def __init__(self):
         self._queries: dict[str, tuple[dict, Query]] = {}
         self._lock = threading.Lock()
@@ -81,9 +83,40 @@ class PercolatorRegistry:
         matches = []
         with self._lock:
             items = list(self._queries.items())
-        for qid, (_body, query) in items:
-            if filter_ids is not None and qid not in filter_ids:
-                continue
+        if filter_ids is not None:
+            items = [(qid, v) for qid, v in items if qid in filter_ids]
+
+        # reverse search as ONE batched kernel launch: registered queries that
+        # lower flat score against the 1-doc segment together — the percolation
+        # cost the reference pays per query (PercolatorService's per-query
+        # memory-index search) amortizes into a single device program. Small
+        # registries stay on the host loop (dispatch would dominate).
+        host_items = items
+        if len(items) >= self.DEVICE_BATCH_MIN:
+            from .search.execute import execute_flat_batch, lower_flat
+
+            flat_plans, flat_qids, rest = [], [], []
+            for qid, (_body, query) in items:
+                try:
+                    plan = lower_flat(query, ctx)
+                except Exception:  # noqa: BLE001 — lowering trouble → host path
+                    plan = None
+                if plan is not None:
+                    flat_plans.append(plan)
+                    flat_qids.append(qid)
+                else:
+                    rest.append((qid, (_body, query)))
+            if flat_plans:
+                try:
+                    tds = execute_flat_batch(flat_plans, ctx, 1)
+                    matches.extend(qid for qid, td in zip(flat_qids, tds)
+                                   if td.total > 0)
+                    host_items = rest
+                except Exception:  # noqa: BLE001 — any batch failure falls back
+                    matches = []
+                    host_items = items
+
+        for qid, (_body, query) in host_items:
             scorer = HostScorer(ctx, seg)
             try:
                 _, match = scorer.eval(query)

@@ -437,3 +437,41 @@ def test_min_score_device_parity(ctx):
     loose = execute_query_phase(ctx, parse_search_body(
         {"query": {"match": {"body": "alpha beta"}}, "size": 0}))
     assert dev.total < loose.total  # the threshold really trims
+
+
+def test_batched_device_percolation_parity():
+    # many registered queries percolate as ONE kernel batch; results must match
+    # the pure host loop exactly
+    import tempfile
+
+    from elasticsearch_tpu.mapper.core import MapperService
+    from elasticsearch_tpu.percolator import PercolatorRegistry
+
+    svc = MapperService(Settings.from_flat({}))
+    reg = PercolatorRegistry()
+    rng = np.random.default_rng(13)
+    words = ["alpha", "beta", "gamma", "delta", "epsilon"]
+    for i in range(200):
+        kind = i % 4
+        if kind == 0:
+            q = {"match": {"body": str(rng.choice(words))}}
+        elif kind == 1:
+            q = {"bool": {"must": [{"term": {"body": str(rng.choice(words))}}],
+                          "must_not": [{"term": {"body": str(rng.choice(words))}}]}}
+        elif kind == 2:
+            q = {"term": {"body": str(rng.choice(words))}}
+        else:  # not flat-lowerable → host within the same percolation
+            q = {"match_phrase": {"body": f"{rng.choice(words)} {rng.choice(words)}"}}
+        reg.register(f"q{i}", {"query": q})
+    assert reg.count() >= reg.DEVICE_BATCH_MIN
+
+    doc = {"body": "alpha beta gamma"}
+    batched = reg.percolate(doc, svc)
+    # force the pure host loop by lowering the gate
+    orig = PercolatorRegistry.DEVICE_BATCH_MIN
+    PercolatorRegistry.DEVICE_BATCH_MIN = 10**9
+    try:
+        host = reg.percolate(doc, svc)
+    finally:
+        PercolatorRegistry.DEVICE_BATCH_MIN = orig
+    assert batched == host and len(batched) > 0
