@@ -94,6 +94,7 @@ class PercolatorRegistry:
         host_items = items
         if len(items) >= self.DEVICE_BATCH_MIN:
             from .search.execute import execute_flat_batch, lower_flat
+            from .search.service import SERVING_COUNTERS
 
             flat_plans, flat_qids, rest = [], [], []
             for qid, (_body, query) in items:
@@ -106,15 +107,19 @@ class PercolatorRegistry:
                     flat_qids.append(qid)
                 else:
                     rest.append((qid, (_body, query)))
-            if flat_plans:
+            # the gate's rationale is batch size: only launch when the FLAT
+            # count amortizes dispatch (a mostly-non-flat registry stays host)
+            if len(flat_plans) >= self.DEVICE_BATCH_MIN:
                 try:
                     tds = execute_flat_batch(flat_plans, ctx, 1)
                     matches.extend(qid for qid, td in zip(flat_qids, tds)
                                    if td.total > 0)
                     host_items = rest
+                    SERVING_COUNTERS["device_percolate"] += 1
                 except Exception:  # noqa: BLE001 — any batch failure falls back
                     matches = []
                     host_items = items
+                    SERVING_COUNTERS["device_percolate_fallbacks"] += 1
 
         for qid, (_body, query) in host_items:
             scorer = HostScorer(ctx, seg)

@@ -112,6 +112,8 @@ SERVING_COUNTERS = {
     "device_function_score": 0,  # fs rows/script kernels
     "device_aggs": 0,  # fused agg launch (metric/bucket)
     "device_sort": 0,  # field-sort kernel (incl. sort+aggs composition)
+    "device_percolate": 0,  # batched percolation launches
+    "device_percolate_fallbacks": 0,  # batch failed → host loop
     "host": 0,  # host scorer / mask path
 }
 

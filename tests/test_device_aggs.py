@@ -442,10 +442,9 @@ def test_min_score_device_parity(ctx):
 def test_batched_device_percolation_parity():
     # many registered queries percolate as ONE kernel batch; results must match
     # the pure host loop exactly
-    import tempfile
-
     from elasticsearch_tpu.mapper.core import MapperService
     from elasticsearch_tpu.percolator import PercolatorRegistry
+    from elasticsearch_tpu.search.service import SERVING_COUNTERS
 
     svc = MapperService(Settings.from_flat({}))
     reg = PercolatorRegistry()
@@ -466,7 +465,12 @@ def test_batched_device_percolation_parity():
     assert reg.count() >= reg.DEVICE_BATCH_MIN
 
     doc = {"body": "alpha beta gamma"}
+    before = SERVING_COUNTERS["device_percolate"]
     batched = reg.percolate(doc, svc)
+    # the device batch really ran (the wholesale fallback would otherwise make
+    # this test compare host against host)
+    assert SERVING_COUNTERS["device_percolate"] == before + 1
+    assert SERVING_COUNTERS["device_percolate_fallbacks"] == 0
     # force the pure host loop by lowering the gate
     orig = PercolatorRegistry.DEVICE_BATCH_MIN
     PercolatorRegistry.DEVICE_BATCH_MIN = 10**9
