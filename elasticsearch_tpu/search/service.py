@@ -114,12 +114,30 @@ SERVING_COUNTERS = {
     "device_sort": 0,  # field-sort kernel (incl. sort+aggs composition)
     "device_percolate": 0,  # batched percolation launches
     "device_percolate_fallbacks": 0,  # batch failed → host loop
+    "device_errors": 0,  # device launch failed → host fallback (see _device_failed)
     "host": 0,  # host scorer / mask path
 }
+
+_device_error_logged: set = set()
 
 
 def _count(path: str):
     SERVING_COUNTERS[path] += 1
+
+
+def _device_failed(e: BaseException):
+    """A device launch failed (broken backend, OOM, plugin init): the search
+    must still answer — count it, log each distinct error once, serve host.
+    Mirrors mesh_serving's any-mesh-failure-must-not-fail-the-search rule."""
+    from ..common.logging import get_logger
+
+    SERVING_COUNTERS["device_errors"] += 1
+    key = type(e).__name__
+    if key not in _device_error_logged:
+        _device_error_logged.add(key)
+        get_logger("search.device").warning(
+            f"device serving failed ({key}: {e}); falling back to the host "
+            f"scorer (logged once per error type)")
 
 
 def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
@@ -132,14 +150,19 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
     if not needs_masks:
         plan = lower_flat(req.query, ctx) if use_device else None
         if plan is not None:
-            _count("device_function_score" if plan.fs is not None
-                   else "device_filtered" if plan.filt is not None
-                   else "device_sparse")
-            td = execute_flat_batch([plan], ctx, max(k, 1))[0]
-            return ShardQueryResult(
-                total=td.total, docs=[(s, d, None) for s, d in td.hits],
-                max_score=td.max_score, suggest=suggest_out, shard_id=shard_id,
-            )
+            try:
+                td = execute_flat_batch([plan], ctx, max(k, 1))[0]
+            except Exception as e:  # noqa: BLE001 — device trouble must not
+                _device_failed(e)   # fail the search; the host scorer answers
+            else:
+                _count("device_function_score" if plan.fs is not None
+                       else "device_filtered" if plan.filt is not None
+                       else "device_sparse")
+                return ShardQueryResult(
+                    total=td.total, docs=[(s, d, None) for s, d in td.hits],
+                    max_score=td.max_score, suggest=suggest_out,
+                    shard_id=shard_id,
+                )
         _count("host")
         td = _host_topk(ctx, req, k)
         return ShardQueryResult(total=td.total, docs=[(s, d, None) for s, d in td.hits],
@@ -152,7 +175,11 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
     if (use_device and req.aggs and not req.facets and not req.sort
             and req.post_filter is None and not req.rescore
             and req.min_score is None and not req.explain):
-        device = _try_device_aggs(ctx, req, k, suggest_out, shard_id)
+        try:
+            device = _try_device_aggs(ctx, req, k, suggest_out, shard_id)
+        except Exception as e:  # noqa: BLE001
+            _device_failed(e)
+            device = None
         if device is not None:
             _count("device_aggs")
             return device
@@ -167,12 +194,18 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
         wrapped = FunctionScoreQuery(query=req.query, min_score=req.min_score)
         plan = lower_flat(wrapped, ctx)
         if plan is not None:
-            _count("device_filtered")
-            td = execute_flat_batch([plan], ctx, max(k, 1))[0]
-            return ShardQueryResult(
-                total=td.total, docs=[(s, d, None) for s, d in td.hits[: max(k, 0)]],
-                max_score=td.max_score, suggest=suggest_out, shard_id=shard_id,
-            )
+            try:
+                td = execute_flat_batch([plan], ctx, max(k, 1))[0]
+            except Exception as e:  # noqa: BLE001
+                _device_failed(e)
+            else:
+                _count("device_filtered")
+                return ShardQueryResult(
+                    total=td.total,
+                    docs=[(s, d, None) for s, d in td.hits[: max(k, 0)]],
+                    max_score=td.max_score, suggest=suggest_out,
+                    shard_id=shard_id,
+                )
 
     # device post_filter path: aggs (if any) reduce over the FULL match set while
     # hits gate on the post filter — two composed launches sharing the dense core
@@ -180,7 +213,11 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
     if (use_device and req.post_filter is not None and not req.sort
             and not req.facets and not req.rescore and req.min_score is None
             and not req.explain):
-        device = _try_device_post_filter(ctx, req, k, suggest_out, shard_id)
+        try:
+            device = _try_device_post_filter(ctx, req, k, suggest_out, shard_id)
+        except Exception as e:  # noqa: BLE001
+            _device_failed(e)
+            device = None
         if device is not None:
             _count("device_filtered")
             return device
@@ -191,7 +228,11 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
     if (use_device and req.sort and len(req.sort) == 1
             and not req.facets and req.post_filter is None and not req.rescore
             and req.min_score is None and not req.explain):
-        device = _try_device_sort(ctx, req, k, suggest_out, shard_id)
+        try:
+            device = _try_device_sort(ctx, req, k, suggest_out, shard_id)
+        except Exception as e:  # noqa: BLE001
+            _device_failed(e)
+            device = None
         if device is not None:
             _count("device_sort")
             return device

@@ -479,3 +479,30 @@ def test_batched_device_percolation_parity():
     finally:
         PercolatorRegistry.DEVICE_BATCH_MIN = orig
     assert batched == host and len(batched) > 0
+
+
+def test_device_failure_falls_back_to_host(ctx, monkeypatch):
+    # a broken device backend (dead TPU tunnel, OOM, plugin init) must degrade
+    # to the host scorer, visibly (device_errors counter), never fail searches
+    import elasticsearch_tpu.search.service as svc_mod
+    from elasticsearch_tpu.search.service import SERVING_COUNTERS
+
+    def boom(*a, **k):
+        raise RuntimeError("device backend unavailable")
+
+    monkeypatch.setattr(svc_mod, "execute_flat_batch", boom)
+    monkeypatch.setattr(svc_mod, "_try_device_aggs", boom)
+    monkeypatch.setattr(svc_mod, "_try_device_sort", boom)
+    before = SERVING_COUNTERS["device_errors"]
+    for body in (
+        {"query": {"match": {"body": "alpha"}}, "size": 5},
+        {"query": {"match": {"body": "alpha"}}, "size": 0,
+         "aggs": {"m": {"max": {"field": "pop"}}}},
+        {"query": {"match": {"body": "alpha"}}, "sort": [{"pop": "asc"}],
+         "size": 5},
+    ):
+        req = parse_search_body(body)
+        res = execute_query_phase(ctx, req, use_device=True)
+        host = execute_query_phase(ctx, req, use_device=False)
+        assert res.total == host.total
+    assert SERVING_COUNTERS["device_errors"] >= before + 3
