@@ -16,7 +16,11 @@ from dataclasses import dataclass, field as dc_field
 
 import numpy as np
 
-from ..common.errors import QueryParsingError, SearchContextMissingError
+from ..common.errors import (
+    QueryParsingError,
+    SearchContextMissingError,
+    SearchEngineError,
+)
 from .aggregations import facet_response, parse_aggs, parse_facets, reduce_aggs
 from .execute import (
     HostScorer,
@@ -152,6 +156,8 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
         if plan is not None:
             try:
                 td = execute_flat_batch([plan], ctx, max(k, 1))[0]
+            except SearchEngineError:
+                raise  # domain errors (scripts, parsing) are the answer itself
             except Exception as e:  # noqa: BLE001 — device trouble must not
                 _device_failed(e)   # fail the search; the host scorer answers
             else:
@@ -177,6 +183,8 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
             and req.min_score is None and not req.explain):
         try:
             device = _try_device_aggs(ctx, req, k, suggest_out, shard_id)
+        except SearchEngineError:
+            raise  # domain errors (scripts, parsing) are the answer itself
         except Exception as e:  # noqa: BLE001
             _device_failed(e)
             device = None
@@ -196,6 +204,8 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
         if plan is not None:
             try:
                 td = execute_flat_batch([plan], ctx, max(k, 1))[0]
+            except SearchEngineError:
+                raise  # domain errors are the answer itself
             except Exception as e:  # noqa: BLE001
                 _device_failed(e)
             else:
@@ -215,6 +225,8 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
             and not req.explain):
         try:
             device = _try_device_post_filter(ctx, req, k, suggest_out, shard_id)
+        except SearchEngineError:
+            raise  # domain errors (scripts, parsing) are the answer itself
         except Exception as e:  # noqa: BLE001
             _device_failed(e)
             device = None
@@ -230,6 +242,8 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
             and req.min_score is None and not req.explain):
         try:
             device = _try_device_sort(ctx, req, k, suggest_out, shard_id)
+        except SearchEngineError:
+            raise  # domain errors (scripts, parsing) are the answer itself
         except Exception as e:  # noqa: BLE001
             _device_failed(e)
             device = None
