@@ -68,6 +68,10 @@ class BackendProbe:
 
     def __init__(self):
         self.timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 60))
+        # the last attempt gets a long deadline: a healthy-but-cold backend can
+        # legitimately take >60s to init, and killing it repeatedly would turn a
+        # slow TPU into a CPU fallback — the exact regression this class prevents
+        self.final_timeout = float(os.environ.get("BENCH_PROBE_FINAL_TIMEOUT", 180))
         self.retries = int(os.environ.get("BENCH_PROBE_RETRIES", 4))
         self.backoff = float(os.environ.get("BENCH_PROBE_BACKOFF", 15))
         self.attempt = 0
@@ -85,7 +89,9 @@ class BackendProbe:
         self.proc = subprocess.Popen(
             [sys.executable, "-c", _PROBE_SRC],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
-        self.deadline = time.time() + self.timeout
+        per_attempt = (self.final_timeout if self.attempt >= self.retries
+                       else self.timeout)
+        self.deadline = time.time() + per_attempt
 
     def _fail(self, why: str):
         print(f"# backend probe attempt {self.attempt}/{self.retries}: {why}",
@@ -109,7 +115,7 @@ class BackendProbe:
             if time.time() >= self.deadline:
                 self.proc.kill()
                 self.proc.communicate()
-                self._fail(f"timed out after {self.timeout:.0f}s")
+                self._fail("timed out")
             return None
         out, err = self.proc.communicate()
         if rc == 0 and out.strip():
@@ -179,7 +185,9 @@ def build_layout(n_docs, vocab, post_offsets, post_docs, post_freqs, norm_bytes,
     from elasticsearch_tpu.ops.device_index import (
         BLOCK, TFN_BM25, _pow2_bucket, expand_ranges, tfn_values)
 
-    path = os.path.join(CACHE, f"layout_{n_docs}_{vocab}_b{BLOCK}.npz")
+    # v1 tags the baked-tfn formula (TFN_BM25 + K1/B + smallfloat decode); bump
+    # it when the scoring math changes or the cached flat_tfn would go stale
+    path = os.path.join(CACHE, f"layout_v1_{n_docs}_{vocab}_b{BLOCK}.npz")
     if os.path.exists(path):
         d = np.load(path)
         return (d["flat_docs"], d["flat_freqs"], d["flat_tfn"], d["blk_start"],
@@ -348,8 +356,6 @@ def run_config(n_docs, vocab, batch, n_batches, k, cpu_n=64, gate_n=8):
     print(f"# {len(batches)} bucket launches/batch: "
           + ", ".join(f"[{sb.qblk.shape[0]}x{sb.qblk.shape[1]}]" for sb in batches),
           file=sys.stderr)
-    import jax
-
     jax.block_until_ready([r for (_sb, r) in run_batches(batches, k)])  # warmup
     # p50 latency: one synchronous round-trip (includes host transfer)
     t0 = time.perf_counter()
@@ -400,8 +406,11 @@ def main():
     global N_DOCS, VOCAB, BATCH, N_BATCHES
     t_start = time.time()
     probe = BackendProbe()
-    # overlap the probe's first attempt(s) with the headline corpus build
-    build_corpus(N_DOCS, VOCAB)
+    if probe.poll() is None:
+        # overlap the probe's first attempt(s) with the headline corpus build —
+        # skipped when the platform is already decided (JAX_PLATFORMS=cpu), where
+        # the full-size corpus would be built only to be discarded by scale-down
+        build_corpus(N_DOCS, VOCAB)
     platform = probe.wait()
     print(f"# backend: {platform} (probe {time.time()-t_start:.1f}s, "
           f"{probe.attempt} attempt(s))", file=sys.stderr)
@@ -439,6 +448,10 @@ def main():
 
     # ---- scale row: enwiki-class corpus on one chip (TPU only) --------------
     if result["platform"] == "tpu" and os.environ.get("BENCH_SCALE", "1") != "0":
+        stale = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_SCALE.json")
+        if os.path.exists(stale):  # never leave a prior run's row misattributed
+            os.remove(stale)
         try:
             scale = run_config(SCALE_DOCS, SCALE_VOCAB, BATCH, max(N_BATCHES // 4, 2),
                                K, cpu_n=16)
