@@ -57,6 +57,15 @@ class OperationRouting:
                       routing: str | None = None,
                       preference: str | None = None) -> list[ShardRouting]:
         """One active copy of every relevant shard group (ref: searchShards:103-146)."""
+        # "_shards:0,2" restricts the searched shard groups; an optional ";"
+        # suffix carries a secondary copy-selection preference
+        # (ref: Preference.SHARDS handling in PlainOperationRouting)
+        only_shards = None
+        if preference and preference.startswith("_shards:"):
+            rest = preference[len("_shards:"):]
+            spec, _, preference = rest.partition(";")
+            preference = preference or None
+            only_shards = {int(s) for s in spec.split(",") if s.strip()}
         out = []
         for index in indices:
             table = state.routing_table.index(index)
@@ -69,6 +78,8 @@ class OperationRouting:
             else:
                 shard_ids = range(len(table.shards))
             for sid in shard_ids:
+                if only_shards is not None and sid not in only_shards:
+                    continue
                 group = table.shard(sid)
                 out.append(self._select(group, state, preference))
         return out

@@ -280,7 +280,11 @@ def ensure_mesh_agg_stack(index: ShardedIndex, fields: tuple):
 
 def _mesh_score_program(k: int, n_queries: int, doc_pad: int, similarity_kind: int,
                         k1: float, b: float, use_global_stats: bool = True,
-                        use_filter: bool = False, use_aggs: bool = False):
+                        use_filter: bool = False, use_aggs: bool = False,
+                        use_post: bool = False, use_min_score: bool = False,
+                        use_sort: bool = False, sort_desc: bool = False,
+                        use_active: bool = False, use_stack: bool = False,
+                        bucket_specs: tuple = ()):
     """Returns the shard_map-able function (static shapes closed over).
 
     use_global_stats=True is dfs_query_then_fetch (term stats psum'd over the shards
@@ -289,7 +293,25 @@ def _mesh_score_program(k: int, n_queries: int, doc_pad: int, similarity_kind: i
     use_filter adds per-shard FilteredQuery masks; use_aggs adds fused metric-agg
     stats (device_index.agg_doc_rows folds reduced under the match mask, gathered
     per shard — the SPMD embodiment of the reference's per-shard agg collect +
-    coordinator reduce)."""
+    coordinator reduce).
+
+    Round-5 feature parity with the single-shard device path
+    (service.execute_query_phase's device branches):
+      use_post       — post_filter masks gate HITS and totals, never aggs
+                       (ref: DefaultSearchContext.parsedPostFilter semantics)
+      use_min_score  — score threshold applied to match BEFORE aggs (host
+                       mask path order, service.py execute_query_phase)
+      use_sort       — single-field sort: per-shard top-k over pre-folded key
+                       rows, global merge by (key, shard, doc) — the SPMD form
+                       of execute.execute_flat_sorted + the coordinator merge
+      use_active     — shard-subset serving (routing/preference selected a
+                       subset): inactive shards mask out of match entirely
+      use_stack      — the agg_rows stack input is present (metric aggs and/or
+                       bucket metric sub-aggs need per-doc folds)
+      bucket_specs   — static per bucket agg: (n_buckets, sub_row_idx|None);
+                       counts scatter exactly like ops.scoring._bucket_scatter
+                       and ride all_gather back per shard
+    """
     import jax
     import jax.numpy as jnp
 
@@ -301,11 +323,27 @@ def _mesh_score_program(k: int, n_queries: int, doc_pad: int, similarity_kind: i
                 df_local, boost, clause_qidx, clause_scoring,  # clauses [1?, C]
                 max_doc_local, sum_ttf_local,  # [1], [1, F]
                 n_must, msm, coord,  # per query [Qd], [Qd], [Qd, C+1]
-                *extra):  # [filter_masks [1, Qd, Dpad] bool][agg_rows [1, F, 5, Dpad]]
+                *extra):  # optional inputs gated by the use_* flags, in order:
+        # filter_masks [1, Qd, Dpad] | agg_rows [1, F, 5, Dpad] |
+        # post_masks [1, Qd, Dpad] | min_score scalar | sort_keys [1, Dpad] |
+        # active [1] bool | per bucket agg: pdoc [1, P], pbucket [1, P]
         ei = 0
         filter_masks = extra[ei] if use_filter else None
         ei += 1 if use_filter else 0
-        agg_rows = extra[ei] if use_aggs else None
+        agg_rows = extra[ei] if use_stack else None
+        ei += 1 if use_stack else 0
+        post_masks = extra[ei] if use_post else None
+        ei += 1 if use_post else 0
+        min_score = extra[ei] if use_min_score else None
+        ei += 1 if use_min_score else 0
+        sort_keys = extra[ei] if use_sort else None
+        ei += 1 if use_sort else 0
+        active = extra[ei] if use_active else None
+        ei += 1 if use_active else 0
+        bucket_pairs = []
+        for _nb, _sub in bucket_specs:
+            bucket_pairs.append((extra[ei], extra[ei + 1]))
+            ei += 2
         blk_docs = blk_docs[0]
         blk_freqs = blk_freqs[0]
         norms_l = norms[0]
@@ -386,7 +424,21 @@ def _mesh_score_program(k: int, n_queries: int, doc_pad: int, similarity_kind: i
             # FilteredQuery's scorer — score comes from the wrapped query alone)
             match = match & filter_masks[0]
 
-        if agg_rows is not None:
+        # coord multiplies BEFORE min_score: the threshold sees the final score
+        # (the fs-kernel semantics the single-shard min_score path uses)
+        overlap = jnp.minimum(m_should + m_must, coord.shape[1] - 1)
+        scores = scores * jnp.take_along_axis(coord, overlap, axis=1)
+
+        if min_score is not None:
+            # min_score prunes match itself — totals AND aggs see the pruned
+            # set (host mask path order: service.execute_query_phase)
+            match = match & (scores >= min_score)
+        if active is not None:
+            # shard-subset serving: an unselected shard contributes nothing —
+            # no hits, no totals, no agg partials
+            match = match & active[0]
+
+        if use_aggs and agg_rows is not None:
             # fused metric aggs under the match mask (ops/scoring.agg_stat_reduction
             # — the SAME reduction the single-shard dense kernel runs); per-shard
             # partials gathered so serving synthesizes transport-identical
@@ -397,35 +449,78 @@ def _mesh_score_program(k: int, n_queries: int, doc_pad: int, similarity_kind: i
             agg_counts = jax.lax.all_gather(local_counts, "shards")  # [S, Qd, F]
             agg_stats = jax.lax.all_gather(local_stats, "shards")  # [S, Qd, F, 4]
 
-        overlap = jnp.minimum(m_should + m_must, coord.shape[1] - 1)
-        scores = scores * jnp.take_along_axis(coord, overlap, axis=1)
+        bucket_outs = []
+        if bucket_specs:
+            # bucket aggs reduce over the PRE-post_filter match (the reference's
+            # faceting idiom), per-shard results gathered so serving assembles
+            # shard-level partials with each shard's own key list
+            from ..ops.scoring import _bucket_scatter
+
+            for (nb, sub_idx), (pdoc, pbucket) in zip(bucket_specs, bucket_pairs):
+                sub_stack = (agg_rows[0][np.asarray(sub_idx)]
+                             if sub_idx else None)
+                cnts, sub_cnt, sub_stats = _bucket_scatter(
+                    match, pdoc[0], pbucket[0], nb, sub_stack)
+                out = [jax.lax.all_gather(cnts, "shards")]  # [S, Qd, nb]
+                if sub_idx:
+                    out.append(jax.lax.all_gather(sub_cnt, "shards"))
+                    out.append(jax.lax.all_gather(sub_stats, "shards"))
+                bucket_outs.append(out)
+
+        # post_filter gates hits and totals only — aggs above saw full match
+        hits_match = match & post_masks[0] if post_masks is not None else match
 
         neg_inf = jnp.float32(-jnp.inf)
-        masked = jnp.where(match, scores, neg_inf)
-        local_scores, local_docs = jax.lax.top_k(masked, k)  # [Qd, k]
+        masked_scores = jnp.where(hits_match, scores, neg_inf)
+        # per-shard max_score spans ALL post-filtered matches (host parity for
+        # sorted searches, where winners' scores aren't the shard max)
+        qmax = jax.lax.all_gather(jnp.max(masked_scores, axis=1), "shards")  # [S, Qd]
         shard_idx = jax.lax.axis_index("shards")
-        local_ids = jnp.where(
-            jnp.isfinite(local_scores),
-            shard_idx * doc_pad + local_docs,
-            jnp.int32(-1),
-        )
+
+        if use_sort:
+            sign = jnp.float32(1.0 if sort_desc else -1.0)
+            sortable = jnp.where(hits_match, sort_keys[0][None, :] * sign, neg_inf)
+            local_keys, local_docs = jax.lax.top_k(sortable, k)  # [Qd, k]
+            local_scores = jnp.take_along_axis(masked_scores, local_docs, axis=1)
+            finite = jnp.isfinite(local_keys)
+        else:
+            local_scores, local_docs = jax.lax.top_k(masked_scores, k)  # [Qd, k]
+            local_keys = None
+            finite = jnp.isfinite(local_scores)
+        local_ids = jnp.where(finite, shard_idx * doc_pad + local_docs,
+                              jnp.int32(-1))
 
         # ---- reduce phase: global top-k via all_gather (shard-major → Lucene
         # tie-break order); per-shard totals gathered so serving can synthesize
         # per-shard query results (ShardQueryResult) without a second pass ----
-        g_scores = jax.lax.all_gather(local_scores, "shards")  # [S, Qd, k]
-        g_ids = jax.lax.all_gather(local_ids, "shards")
-        S = g_scores.shape[0]
-        g_scores = jnp.transpose(g_scores, (1, 0, 2)).reshape(n_queries, S * k)
-        g_ids = jnp.transpose(g_ids, (1, 0, 2)).reshape(n_queries, S * k)
-        top_scores, pos = jax.lax.top_k(g_scores, k)
+        def gather_major(x):  # [Qd, k] per shard → [Qd, S*k] shard-major
+            g = jax.lax.all_gather(x, "shards")  # [S, Qd, k]
+            return jnp.transpose(g, (1, 0, 2)).reshape(n_queries, -1)
+
+        g_scores = gather_major(local_scores)
+        g_ids = gather_major(local_ids)
+        if use_sort:
+            g_keys = gather_major(local_keys)
+            top_sortable, pos = jax.lax.top_k(g_keys, k)
+            top_keys = top_sortable * (jnp.float32(1.0) if sort_desc
+                                       else jnp.float32(-1.0))
+            top_scores = jnp.take_along_axis(g_scores, pos, axis=1)
+        else:
+            top_scores, pos = jax.lax.top_k(g_scores, k)
+            top_keys = None
         top_ids = jnp.take_along_axis(g_ids, pos, axis=1)
         shard_totals = jax.lax.all_gather(
-            match.sum(axis=1).astype(jnp.int32), "shards")  # [S, Qd]
-        if agg_rows is not None:
-            return (top_scores[None], top_ids[None], shard_totals[None],
-                    agg_counts[None], agg_stats[None])
-        return (top_scores[None], top_ids[None], shard_totals[None])
+            hits_match.sum(axis=1).astype(jnp.int32), "shards")  # [S, Qd]
+
+        outs = [top_scores[None], top_ids[None], shard_totals[None], qmax[None]]
+        if use_sort:
+            outs.append(top_keys[None])
+        if use_aggs and agg_rows is not None:
+            outs.append(agg_counts[None])
+            outs.append(agg_stats[None])
+        for out in bucket_outs:
+            outs.extend(o[None] for o in out)
+        return tuple(outs)
 
     return program
 
@@ -439,6 +534,11 @@ class MeshTopDocs:
     shard_totals: np.ndarray = None  # [S, Q] per-shard matches
     agg_counts: np.ndarray = None  # [S, Q, F] int per-shard matched value counts
     agg_stats: np.ndarray = None  # [S, Q, F, 4] per-shard (sum, min, max, sumsq)
+    qmax: np.ndarray = None  # [S, Q] per-shard max score over matches (-inf none)
+    sort_keys: np.ndarray = None  # [Q, k] winning sort keys (sorted searches)
+    # per bucket agg: (counts [S, Q, NB], sub_cnt [S, Q, Fs, NB]|None,
+    #                  sub_stats [S, Q, Fs, NB, 4]|None)
+    bucket_results: list = None
 
 
 class MeshSearchExecutor:
@@ -538,12 +638,25 @@ class MeshSearchExecutor:
 
     def search(self, plans: list[FlatPlan], k: int,
                filter_masks: np.ndarray | None = None,
-               agg_rows=None) -> MeshTopDocs:
+               agg_rows=None, use_metric_aggs: bool | None = None,
+               post_masks: np.ndarray | None = None,
+               min_score: float | None = None,
+               sort_keys: np.ndarray | None = None, sort_desc: bool = False,
+               active: np.ndarray | None = None,
+               bucket_pairs: list | None = None) -> MeshTopDocs:
         """filter_masks: optional bool [S, Q, doc_pad] — per-shard, per-query
         FilteredQuery masks (host-evaluated via the filter cache, sharded onto the
         mesh; they gate matching, not scoring). agg_rows: optional [S, F, 5, Dpad]
         f32 per-doc metric folds (device_index.agg_doc_rows) — fused agg stats
-        come back per shard in MeshTopDocs.agg_stats."""
+        come back per shard in MeshTopDocs.agg_stats; the stack may carry extra
+        rows used only by bucket metric sub-aggs (use_metric_aggs=False then
+        skips the top-level stat outputs). post_masks: bool [S, Q, doc_pad]
+        post_filter masks (hits/totals only). min_score: score threshold
+        pre-aggs. sort_keys: f32 [S, doc_pad] single-field sort key rows
+        (sorting.device_sort_key_row per segment, shard-rebased); sort_desc
+        mirrors SortSpec.reverse. active: bool [S] shard-subset mask.
+        bucket_pairs: per bucket agg (pdoc [S, P], pbucket [S, P], nb,
+        sub_row_idx tuple|None) — results in MeshTopDocs.bucket_results."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
@@ -558,15 +671,35 @@ class MeshSearchExecutor:
         (qidx, blk, clause_id, fidx, group, tfmode, df_local, boost, clause_qidx,
          clause_scoring, n_must, msm, coord) = self._assemble(plans)
 
+        bucket_pairs = bucket_pairs or []
         has_filter = filter_masks is not None
-        has_aggs = agg_rows is not None
-        key = (Q, k, qidx.shape[1], coord.shape[1], has_filter, has_aggs)
+        has_stack = agg_rows is not None
+        # metric-agg outputs require the stack: normalizing here keeps the
+        # program's emission guard (use_aggs AND stack) and the host-side
+        # output popping in lockstep for every caller
+        has_aggs = has_stack and (True if use_metric_aggs is None
+                                  else use_metric_aggs)
+        has_post = post_masks is not None
+        has_min = min_score is not None
+        has_sort = sort_keys is not None
+        has_active = active is not None
+        bucket_specs = tuple((int(nb), tuple(sub) if sub else None)
+                             for (_pd, _pb, nb, sub) in bucket_pairs)
+        key = (Q, k, qidx.shape[1], coord.shape[1], has_filter, has_stack,
+               has_aggs, has_post, has_min, has_sort, sort_desc, has_active,
+               bucket_specs)
         fn = self._compiled.get(key)
         if fn is None:
             program = _mesh_score_program(k, Q, idx.doc_pad, self.similarity_kind,
                                           self.k1, self.b, self.use_global_stats,
                                           use_filter=has_filter,
-                                          use_aggs=has_aggs)
+                                          use_aggs=has_aggs,
+                                          use_post=has_post,
+                                          use_min_score=has_min,
+                                          use_sort=has_sort, sort_desc=sort_desc,
+                                          use_active=has_active,
+                                          use_stack=has_stack,
+                                          bucket_specs=bucket_specs)
             in_specs = [
                 P("shards"), P("shards"), P("shards"), P("shards"),  # index
                 P("shards"), P("shards"), P("shards"), P("shards"), P("shards"), P("shards"),  # entries
@@ -576,18 +709,28 @@ class MeshSearchExecutor:
             ]
             if has_filter:
                 in_specs.append(P("shards"))
-            if has_aggs:
+            if has_stack:
                 in_specs.append(P("shards"))
-            out_specs = (P(), P(), P(), P(), P()) if has_aggs else (P(), P(), P())
+            if has_post:
+                in_specs.append(P("shards"))
+            if has_min:
+                in_specs.append(P())
+            if has_sort:
+                in_specs.append(P("shards"))
+            if has_active:
+                in_specs.append(P("shards"))
+            for _spec in bucket_specs:
+                in_specs.extend([P("shards"), P("shards")])
+            n_out = 4 + (1 if has_sort else 0) + (2 if has_aggs else 0) \
+                + sum(3 if sub else 1 for (_nb, sub) in bucket_specs)
             fn = shard_map(
                 program, mesh=self.mesh,
                 in_specs=tuple(in_specs),
-                out_specs=out_specs,
+                out_specs=tuple(P() for _ in range(n_out)),
                 check_vma=False,
             )
             fn = jax.jit(fn)
             self._compiled[key] = fn
-        S = idx.n_shards
         args = [
             idx.blk_docs, idx.blk_freqs, idx.norms, idx.live,
             jnp.asarray(qidx), jnp.asarray(blk), jnp.asarray(clause_id),
@@ -599,24 +742,45 @@ class MeshSearchExecutor:
         ]
         if has_filter:
             args.append(jnp.asarray(filter_masks))
-        if has_aggs:
+        if has_stack:
             args.append(agg_rows if not isinstance(agg_rows, np.ndarray)
                         else jnp.asarray(agg_rows))
+        if has_post:
+            args.append(jnp.asarray(post_masks))
+        if has_min:
+            args.append(jnp.float32(min_score))
+        if has_sort:
+            args.append(jnp.asarray(sort_keys))
+        if has_active:
+            args.append(jnp.asarray(active))
+        for (pd, pb, _nb, _sub) in bucket_pairs:
+            args.append(jnp.asarray(pd))
+            args.append(jnp.asarray(pb))
+
+        outs = list(fn(*args))
+        top_scores = np.asarray(outs.pop(0))[0]
+        top_ids = np.asarray(outs.pop(0))[0]
+        shard_totals = np.asarray(outs.pop(0))[0]  # [S, Q]
+        qmax = np.asarray(outs.pop(0))[0]  # [S, Q]
+        out_sort_keys = np.asarray(outs.pop(0))[0] if has_sort else None
         agg_counts = agg_stats = None
         if has_aggs:
-            top_scores, top_ids, shard_totals, agg_counts, agg_stats = fn(*args)
-            agg_counts = np.asarray(agg_counts)[0]  # [S, Q, F]
-            agg_stats = np.asarray(agg_stats)[0]  # [S, Q, F, 4]
-        else:
-            top_scores, top_ids, shard_totals = fn(*args)
-        top_scores = np.asarray(top_scores)[0]
-        top_ids = np.asarray(top_ids)[0]
-        shard_totals = np.asarray(shard_totals)[0]  # [S, Q]
-        shard = np.where(top_ids >= 0, top_ids // idx.doc_pad, -1)
-        doc = np.where(top_ids >= 0, top_ids % idx.doc_pad, -1)
-        shard = np.where(np.isfinite(top_scores), shard, -1)
-        doc = np.where(shard >= 0, doc, -1)
+            agg_counts = np.asarray(outs.pop(0))[0]  # [S, Q, F]
+            agg_stats = np.asarray(outs.pop(0))[0]  # [S, Q, F, 4]
+        bucket_results = []
+        for (_nb, sub) in bucket_specs:
+            cnts = np.asarray(outs.pop(0))[0]  # [S, Q, NB]
+            sc = ss = None
+            if sub:
+                sc = np.asarray(outs.pop(0))[0]  # [S, Q, Fs, NB]
+                ss = np.asarray(outs.pop(0))[0]  # [S, Q, Fs, NB, 4]
+            bucket_results.append((cnts, sc, ss))
+        valid_rank = np.isfinite(out_sort_keys if has_sort else top_scores)
+        shard = np.where((top_ids >= 0) & valid_rank, top_ids // idx.doc_pad, -1)
+        doc = np.where(shard >= 0, top_ids % idx.doc_pad, -1)
         return MeshTopDocs(scores=top_scores, shard=shard, doc=doc,
                            totals=shard_totals.sum(axis=0).astype(np.int64),
                            shard_totals=shard_totals, agg_counts=agg_counts,
-                           agg_stats=agg_stats)
+                           agg_stats=agg_stats, qmax=qmax,
+                           sort_keys=out_sort_keys,
+                           bucket_results=bucket_results)

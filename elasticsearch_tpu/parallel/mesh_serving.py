@@ -61,37 +61,48 @@ class MeshServingService:
 
     def _eligible(self, state, local_node_id, indices, alias_filters, shards,
                   req: ParsedSearchRequest):
-        """Cheap host-side checks, in rough rejection-frequency order."""
+        """Cheap host-side checks, in rough rejection-frequency order.
+
+        Round-5 widening: sort (single field spec), post_filter, min_score and
+        bucket aggs all ride the program now (per-agg/per-column eligibility is
+        checked in _search_mesh where the shard context exists), and a
+        routing/preference-selected shard SUBSET is served via an active-shard
+        mask as long as the whole index is locally present."""
         if not self.enabled or len(indices) != 1:
             return None
         index = indices[0]
         if alias_filters.get(index):
             return None
-        # req.aggs does NOT reject: metric aggs ride the SPMD program (fused
-        # stats + all_gather); per-agg eligibility is checked in _search_mesh
-        # where the shard context exists
-        if (req.facets or req.suggest or req.sort or req.post_filter
-                or req.rescore or req.min_score is not None or req.explain):
+        if req.facets or req.suggest or req.rescore or req.explain:
+            return None
+        if req.sort and (len(req.sort) != 1 or req.sort[0].kind != "field"):
             return None
         if len(shards) < self.MIN_SHARDS:
             return None
         if any(c.node_id != local_node_id for c in shards):
             return None
+        meta = state.metadata.index(index)
+        if meta is None:
+            return None
+        n_total = meta.number_of_shards
         sids = sorted(c.shard_id for c in shards)
-        if sids != list(range(len(shards))):
-            return None  # routing/preference selected a subset — not whole-index
-        return index
+        if len(set(sids)) != len(sids) or sids[-1] >= n_total:
+            return None
+        return index, n_total
 
     def try_search(self, state, local_node_id: str, indices, alias_filters,
                    shards, req: ParsedSearchRequest, use_global_stats: bool):
         """Returns per-ordinal ShardQueryResults (ordinal = position in `shards`)
         when the mesh program served the query phase, else None (transport path)."""
-        index = self._eligible(state, local_node_id, indices, alias_filters, shards, req)
-        if index is None:
+        eligible = self._eligible(state, local_node_id, indices, alias_filters,
+                                  shards, req)
+        if eligible is None:
             return None
+        index, n_total = eligible
         self._prune(state)
         try:
-            results = self._search_mesh(index, shards, req, use_global_stats)
+            results = self._search_mesh(index, n_total, shards, req,
+                                        use_global_stats)
         except Exception as e:  # noqa: BLE001 — any mesh failure must not fail the search
             results = None
             self.logger.warning(f"mesh path failed, falling back to transport: {e}")
@@ -110,15 +121,24 @@ class MeshServingService:
                 del self._executors[name]
 
     # ------------------------------------------------------------------
-    def _search_mesh(self, index: str, shards, req: ParsedSearchRequest,
-                     use_global_stats: bool):
+    def _search_mesh(self, index: str, n_total: int, shards,
+                     req: ParsedSearchRequest, use_global_stats: bool):
+        from ..common.errors import IndexShardMissingError
+
         svc = self.indices.index_service(index)
-        S = len(shards)
-        searchers = [svc.shard(sid).engine.acquire_searcher() for sid in range(S)]
+        S = n_total
+        try:
+            searchers = [svc.shard(sid).engine.acquire_searcher()
+                         for sid in range(S)]
+        except IndexShardMissingError:
+            return None  # subset selected but index not fully local
 
         from ..search.execute import ShardContext
 
-        ctx0 = ShardContext(searchers[0], svc.mapper_service, svc.similarity_service)
+        ctxs = [ShardContext(s, svc.mapper_service, svc.similarity_service,
+                             index_name=index)
+                for s in searchers]
+        ctx0 = ctxs[0]
         query = req.query
         filt = None
         if isinstance(query, FilteredQuery):
@@ -134,13 +154,36 @@ class MeshServingService:
             # program doesn't express — transport path (which itself serves them
             # on-device via execute_flat_batch's fs/filtered kernels)
             return None
-        agg_fields = None
-        if req.aggs:
-            from ..search.aggregations import device_agg_fields
 
-            agg_fields = device_agg_fields(req.aggs, ctx0)
-            if agg_fields is None:
-                return None
+        # ---- aggregation eligibility: metric aggs fuse as masked stats, bucket
+        # aggs as per-shard scatter counts (+ metric sub-agg folds); anything
+        # else declines to the transport path ----
+        metric_fields: dict = {}
+        bucket_names: list = []
+        bucket_subs: dict = {}
+        if req.aggs:
+            from ..search.aggregations import (SignificantTermsAgg,
+                                               device_agg_field,
+                                               device_bucket_eligible,
+                                               device_bucket_subs)
+
+            for name, agg in req.aggs.items():
+                f = device_agg_field(agg, ctx0)
+                if f is not None:
+                    metric_fields[name] = f
+                    continue
+                if isinstance(agg, SignificantTermsAgg):
+                    # per-SEGMENT background counts don't survive the mesh's
+                    # shard-level partial merge — transport path serves these
+                    return None
+                if device_bucket_eligible(agg):
+                    subs = device_bucket_subs(agg, ctx0) if agg.subs else {}
+                    if subs is None:
+                        return None
+                    bucket_names.append(name)
+                    bucket_subs[name] = (subs, sorted(set(subs.values())))
+                else:
+                    return None
         # one similarity family per program: every queried field must score with the
         # index default (per-field DFR/IB/etc lowered out already by lower_flat)
         default_sim = svc.similarity_service.default
@@ -158,7 +201,8 @@ class MeshServingService:
                                       use_global_stats)
         if executor is None:
             return None
-        if k > executor.index.doc_pad:
+        doc_pad = executor.index.doc_pad
+        if k > doc_pad:
             return None
         # queried fields must exist in the packed norm stack (a field with no norms
         # anywhere would silently score with another field's norms)
@@ -166,53 +210,92 @@ class MeshServingService:
             if c.field not in executor.index.fields:
                 return None
 
-        filter_masks = None
-        if filt is not None:
-            doc_pad = executor.index.doc_pad
-            filter_masks = np.zeros((S, 1, doc_pad), bool)
+        def shard_masks(f):
+            masks = np.zeros((S, 1, doc_pad), bool)
             for si, searcher in enumerate(searchers):
-                ctx_i = ShardContext(searcher, svc.mapper_service,
-                                     svc.similarity_service)
                 for seg, base in zip(searcher.segments, searcher.bases):
-                    filter_masks[si, 0, base: base + seg.doc_count] = \
-                        segment_mask(seg, filt, ctx_i)
+                    masks[si, 0, base: base + seg.doc_count] = \
+                        segment_mask(seg, f, ctxs[si])
+            return masks
 
+        filter_masks = shard_masks(filt) if filt is not None else None
+        post_masks = (shard_masks(req.post_filter)
+                      if req.post_filter is not None else None)
+
+        # ---- single-field sort: per-shard key rows (host-exact fold, f32-exact
+        # gate per segment — sorting.device_sort_key_row) ----
+        sort_spec = req.sort[0] if req.sort else None
+        sort_keys = None
+        if sort_spec is not None:
+            from ..search.sorting import device_sort_key_row
+
+            fill = np.finfo(np.float32).max * (-1.0 if sort_spec.reverse else 1.0)
+            sort_keys = np.full((S, doc_pad), fill, np.float32)
+            for si, searcher in enumerate(searchers):
+                for seg, base in zip(searcher.segments, searcher.bases):
+                    row = device_sort_key_row(sort_spec, seg, seg.doc_count)
+                    if row is None:
+                        return None  # column/spec needs the host path
+                    sort_keys[si, base: base + seg.doc_count] = row
+
+        # ---- ONE per-doc fold stack for metric aggs and bucket sub-aggs ----
+        all_stack_fields = tuple(sorted(
+            set(metric_fields.values())
+            | {f for (_subs, order) in bucket_subs.values() for f in order}))
         agg_rows = None
-        fields = None
-        if agg_fields is not None:
+        if all_stack_fields:
             from .mesh_search import ensure_mesh_agg_stack
 
-            fields = tuple(sorted(set(agg_fields.values())))
-            agg_rows = ensure_mesh_agg_stack(executor.index, fields)
+            agg_rows = ensure_mesh_agg_stack(executor.index, all_stack_fields)
             if agg_rows is None:
                 return None  # column not f32-exact → transport/host path
+        fpos = {f: i for i, f in enumerate(all_stack_fields)}
 
-        out = executor.search([plan], k, filter_masks=filter_masks,
-                              agg_rows=agg_rows)
+        bucket_pairs, bucket_keys_per = self._bucket_pairs(
+            req, bucket_names, bucket_subs, fpos, searchers, ctxs, S)
+        if bucket_names and bucket_pairs is None:
+            return None
+
+        active = None
+        selected = sorted(c.shard_id for c in shards)
+        if selected != list(range(S)):
+            active = np.zeros(S, bool)
+            active[selected] = True
+
+        out = executor.search(
+            [plan], k, filter_masks=filter_masks, agg_rows=agg_rows,
+            use_metric_aggs=bool(metric_fields), post_masks=post_masks,
+            min_score=(float(req.min_score)
+                       if req.min_score is not None else None),
+            sort_keys=sort_keys,
+            sort_desc=bool(sort_spec.reverse) if sort_spec is not None else False,
+            active=active, bucket_pairs=bucket_pairs or None)
         self.mesh_queries += 1
 
+        track = bool(req.track_scores) if req.sort else True
         results = []
         for ordinal, copy in enumerate(shards):
-            rows = [(float(out.scores[0][j]), int(out.doc[0][j]), None)
-                    for j in range(out.scores.shape[1])
-                    if out.shard[0][j] == copy.shard_id]
-            scores = [s for (s, _d, _sv) in rows]
-            agg_partials = []
-            if agg_fields is not None and out.agg_stats is not None:
-                from ..search.aggregations import device_partial
-
-                fpos = {f: i for i, f in enumerate(fields)}
-                counts = out.agg_counts[copy.shard_id, 0]  # [F]
-                stats = out.agg_stats[copy.shard_id, 0]  # [F, 4]
-                agg_partials = [{
-                    name: device_partial(agg, counts[fpos[agg_fields[name]]],
-                                         stats[fpos[agg_fields[name]]])
-                    for name, agg in req.aggs.items()
-                }]
+            sid = copy.shard_id
+            sel = [j for j in range(out.scores.shape[1])
+                   if out.shard[0][j] == sid]
+            if req.sort:
+                locals_ = [int(out.doc[0][j]) for j in sel]
+                sort_vals = self._sort_values(req.sort, ctxs[sid],
+                                              searchers[sid], locals_)
+                rows = [(float(out.scores[0][j]) if track else float("nan"),
+                         int(out.doc[0][j]), sort_vals[i])
+                        for i, j in enumerate(sel)]
+            else:
+                rows = [(float(out.scores[0][j]), int(out.doc[0][j]), None)
+                        for j in sel]
+            qm = out.qmax[sid, 0]
+            agg_partials = self._shard_agg_partials(
+                req, metric_fields, bucket_names, bucket_subs, fpos,
+                bucket_keys_per, out, sid, searchers[sid])
             result = ShardQueryResult(
-                total=int(out.shard_totals[copy.shard_id, 0]),
+                total=int(out.shard_totals[sid, 0]),
                 docs=rows,
-                max_score=max(scores) if scores else float("nan"),
+                max_score=float(qm) if np.isfinite(qm) else float("nan"),
                 agg_partials=agg_partials,
                 shard_id=ordinal,
             )
@@ -220,12 +303,141 @@ class MeshServingService:
             # phases must not move local doc ids under the fetch)
             pin = getattr(self, "pin_context", None)
             if pin is not None:
-                result.context_id = pin(
-                    copy.index, copy.shard_id,
-                    ShardContext(searchers[copy.shard_id], svc.mapper_service,
-                                 svc.similarity_service))
+                result.context_id = pin(copy.index, sid, ctxs[sid])
             results.append(result)
         return results
+
+    # ------------------------------------------------------------------
+    _POSITIONAL_BUCKETS = None  # class-level lazy import cache
+
+    @classmethod
+    def _positional(cls, agg) -> bool:
+        """Positionally-keyed bucket aggs: the key LIST comes from the spec and
+        is identical in every segment (ranges/filters/missing/geo_distance), so
+        bucket ordinals align across segments without a key union."""
+        if cls._POSITIONAL_BUCKETS is None:
+            from ..search.aggregations import (FilterAgg, FiltersAgg,
+                                               GeoDistanceAgg, MissingAgg,
+                                               RangeAgg)
+
+            cls._POSITIONAL_BUCKETS = (RangeAgg, FilterAgg, FiltersAgg,
+                                       MissingAgg, GeoDistanceAgg)
+        return isinstance(agg, cls._POSITIONAL_BUCKETS)
+
+    def _bucket_pairs(self, req, bucket_names, bucket_subs, fpos, searchers,
+                      ctxs, S):
+        """Per bucket agg: shard-level (doc, bucket) pair arrays padded to
+        common shapes, plus each shard's key list. Segments concatenate into
+        the shard's doc space (bases rebase pair docs); value-keyed aggs union
+        their segment key lists per shard, positional aggs share the spec's.
+        Returns (bucket_pairs, keys_per_name) or (None, None) on any shape the
+        partial assembly can't express."""
+        if not bucket_names:
+            return [], {}
+        from ..search.aggregations import bucket_cols_for
+
+        bucket_pairs = []
+        bucket_keys_per: dict = {}
+        for name in bucket_names:
+            agg = req.aggs[name]
+            positional = self._positional(agg)
+            per_shard = []
+            shard_keys = []
+            for si in range(S):
+                seg_cols = [
+                    (bucket_cols_for(agg, seg, ctxs[si]), base)
+                    for seg, base in zip(searchers[si].segments,
+                                         searchers[si].bases)
+                ]
+                pd_parts, pb_parts = [], []
+                if positional:
+                    keys = next((c[2] for c, _b in seg_cols if c[2]), [])
+                    for (pd, pb, seg_keys), base in seg_cols:
+                        if seg_keys and len(seg_keys) != len(keys):
+                            return None, None  # spec-derived keys must align
+                        pd_parts.append(pd.astype(np.int64) + base)
+                        pb_parts.append(pb)
+                else:
+                    union = sorted({k2 for c, _b in seg_cols for k2 in c[2]})
+                    pos = {k2: i for i, k2 in enumerate(union)}
+                    keys = list(union)
+                    for (pd, pb, seg_keys), base in seg_cols:
+                        if not len(pd):
+                            continue
+                        remap = np.asarray([pos[k2] for k2 in seg_keys],
+                                           dtype=np.int32)
+                        pd_parts.append(pd.astype(np.int64) + base)
+                        pb_parts.append(remap[pb])
+                pd_all = (np.concatenate(pd_parts).astype(np.int32)
+                          if pd_parts else np.zeros(0, np.int32))
+                pb_all = (np.concatenate(pb_parts).astype(np.int32)
+                          if pb_parts else np.zeros(0, np.int32))
+                per_shard.append((pd_all, pb_all))
+                shard_keys.append(keys)
+            NB = max((len(ks) for ks in shard_keys), default=0) or 1
+            P = max((len(pd) for pd, _ in per_shard), default=0) or 1
+            # pad pairs with (doc 0, bucket NB): the OOB bucket scatter drops
+            # under jit, so padding contributes nothing
+            pdoc = np.zeros((S, P), np.int32)
+            pbucket = np.full((S, P), NB, np.int32)
+            for si, (pd, pb) in enumerate(per_shard):
+                pdoc[si, : len(pd)] = pd
+                pbucket[si, : len(pb)] = pb
+            sub_order = bucket_subs[name][1]
+            sub_idx = (tuple(fpos[f] for f in sub_order)
+                       if sub_order else None)
+            bucket_pairs.append((pdoc, pbucket, NB, sub_idx))
+            bucket_keys_per[name] = shard_keys
+        return bucket_pairs, bucket_keys_per
+
+    def _shard_agg_partials(self, req, metric_fields, bucket_names, bucket_subs,
+                            fpos, bucket_keys_per, out, sid, searcher):
+        """One shard-level partial dict (the transport path emits one per
+        SEGMENT; merge is associative so one-per-shard reduces identically).
+        Shards with no segments emit none — mirroring the transport path's
+        empty per-segment list."""
+        if not (metric_fields or bucket_names) or not searcher.segments:
+            return []
+        from ..search.aggregations import device_bucket_partial, device_partial
+
+        partial = {}
+        for name, agg in req.aggs.items():
+            if name in metric_fields:
+                fi = fpos[metric_fields[name]]
+                partial[name] = device_partial(
+                    agg, out.agg_counts[sid, 0][fi], out.agg_stats[sid, 0][fi])
+            else:
+                bi = bucket_names.index(name)
+                cnts, scnt, sstats = out.bucket_results[bi]
+                keys = bucket_keys_per[name][sid]
+                sub_aggs_map, order = bucket_subs[name]
+                sub_data = None
+                if sub_aggs_map:
+                    sub_data = (agg.subs, sub_aggs_map, order,
+                                scnt[sid, 0], sstats[sid, 0])
+                partial[name] = device_bucket_partial(
+                    agg, keys, cnts[sid, 0][: len(keys)], seg=None,
+                    sub_data=sub_data)
+        return [partial]
+
+    def _sort_values(self, specs, ctx, searcher, locals_):
+        """Host-exact sort VALUES for the response "sort" arrays, extracted per
+        segment (the one extraction idiom — service._sort_values_by_rank)."""
+        from ..search.sorting import sort_values_for_docs
+
+        bases = np.asarray(searcher.bases)
+        out: list = [None] * len(locals_)
+        by_seg: dict = {}
+        for i, g in enumerate(locals_):
+            si = int(np.searchsorted(bases, g, side="right") - 1)
+            by_seg.setdefault(si, []).append((i, g - int(bases[si])))
+        for si, items in by_seg.items():
+            seg = searcher.segments[si]
+            vals = sort_values_for_docs(
+                specs, seg, ctx, np.asarray([l for _i, l in items]), None)
+            for (i, _l), v in zip(items, vals):
+                out[i] = v
+        return out
 
     def _executor_for(self, index: str, svc, searchers, kind, default_sim,
                       use_global_stats: bool):

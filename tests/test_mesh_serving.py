@@ -106,15 +106,17 @@ class TestMeshServing:
                 else:
                     assert a[k2] == b[k2]
 
-    def test_non_metric_aggs_fall_back_to_transport(self, node):
+    def test_ineligible_aggs_fall_back_to_transport(self, node):
+        # cardinality's HLL sketch can't ride the SPMD scatter; the whole
+        # request declines to the transport path (which still answers)
         n, client = node
         ms = n.actions.mesh_serving
         before = ms.mesh_queries
         r = client.search("library", {
             "query": {"match": {"body": "alpha"}},
-            "aggs": {"by_body": {"terms": {"field": "body"}}}})
-        assert ms.mesh_queries == before  # ineligible: bucket agg
-        assert "by_body" in r["aggregations"]
+            "aggs": {"uniq": {"cardinality": {"field": "body"}}}})
+        assert ms.mesh_queries == before  # ineligible: sketch agg
+        assert "uniq" in r["aggregations"]
 
     def test_fetch_phase_hydrates_mesh_hits(self, node):
         n, client = node
@@ -168,3 +170,174 @@ class TestMeshServing:
         assert mesh["hits"]["total"] == 1
         assert mesh["hits"]["hits"][0]["_id"] == "zz1"
         _assert_same_results(mesh, transport)
+
+
+class TestMeshServingRound5:
+    """Round-5 mesh parity: sort, post_filter, min_score, bucket aggs and
+    shard-subset serving all ride the SPMD program and match the transport
+    path (ref: the per-feature logic these mirror lives in
+    service.execute_query_phase's device branches)."""
+
+    def test_field_sort_rides_mesh(self, node):
+        n, client = node
+        for order in ("asc", "desc"):
+            body = {"query": {"match": {"body": "alpha"}},
+                    "sort": [{"n": {"order": order}}], "size": 10}
+            mesh, transport = _search_both_paths(n, client, body)
+            assert mesh["hits"]["total"] == transport["hits"]["total"]
+            m = [(h["_id"], h["sort"]) for h in mesh["hits"]["hits"]]
+            t = [(h["_id"], h["sort"]) for h in transport["hits"]["hits"]]
+            assert m == t, order
+            assert len(m) > 0
+
+    def test_sort_with_track_scores(self, node):
+        n, client = node
+        body = {"query": {"match": {"body": "alpha"}},
+                "sort": [{"n": "desc"}], "track_scores": True, "size": 8}
+        mesh, transport = _search_both_paths(n, client, body)
+        m = [(h["_id"], h["sort"]) for h in mesh["hits"]["hits"]]
+        t = [(h["_id"], h["sort"]) for h in transport["hits"]["hits"]]
+        assert m == t
+        ms = [h["_score"] for h in mesh["hits"]["hits"]]
+        ts = [h["_score"] for h in transport["hits"]["hits"]]
+        assert np.allclose(ms, ts, rtol=2e-6)
+
+    def test_post_filter_rides_mesh(self, node):
+        # post_filter gates hits/totals but not aggregations
+        n, client = node
+        body = {"query": {"match": {"body": "alpha"}},
+                "post_filter": {"range": {"n": {"lt": 40}}},
+                "aggs": {"n_avg": {"avg": {"field": "n"}}}, "size": 10}
+        mesh, transport = _search_both_paths(n, client, body)
+        _assert_same_results(mesh, transport)
+        assert abs(mesh["aggregations"]["n_avg"]["value"]
+                   - transport["aggregations"]["n_avg"]["value"]) < 1e-4
+
+    def test_min_score_rides_mesh(self, node):
+        n, client = node
+        probe = client.search("library", {"query": {"match": {"body": "alpha"}},
+                                          "size": 5})
+        # midpoint between two hit scores: robust to per-kernel f32 ulp drift
+        # (an exact hit score would flip inclusion between execution paths)
+        threshold = (probe["hits"]["hits"][2]["_score"]
+                     + probe["hits"]["hits"][3]["_score"]) / 2.0
+        body = {"query": {"match": {"body": "alpha"}},
+                "min_score": threshold, "size": 10}
+        mesh, transport = _search_both_paths(n, client, body)
+        _assert_same_results(mesh, transport)
+        assert mesh["hits"]["total"] < probe["hits"]["total"]
+
+    def test_terms_agg_rides_mesh(self, node):
+        n, client = node
+        body = {"query": {"match": {"body": "alpha"}},
+                "aggs": {"by_body": {"terms": {"field": "body", "size": 8}}},
+                "size": 5}
+        mesh, transport = _search_both_paths(n, client, body)
+        _assert_same_results(mesh, transport)
+        m = [(b["key"], b["doc_count"])
+             for b in mesh["aggregations"]["by_body"]["buckets"]]
+        t = [(b["key"], b["doc_count"])
+             for b in transport["aggregations"]["by_body"]["buckets"]]
+        assert m == t
+
+    def test_histogram_with_metric_subagg_rides_mesh(self, node):
+        n, client = node
+        body = {"query": {"match": {"body": "alpha"}},
+                "aggs": {"by_n": {"histogram": {"field": "n", "interval": 25},
+                                  "aggs": {"navg": {"avg": {"field": "n"}}}}},
+                "size": 0}
+        mesh, transport = _search_both_paths(n, client, body)
+        m = mesh["aggregations"]["by_n"]["buckets"]
+        t = transport["aggregations"]["by_n"]["buckets"]
+        assert [(b["key"], b["doc_count"]) for b in m] == \
+            [(b["key"], b["doc_count"]) for b in t]
+        for bm, bt in zip(m, t):
+            assert abs(bm["navg"]["value"] - bt["navg"]["value"]) < 1e-4
+
+    def test_range_agg_rides_mesh(self, node):
+        # positional buckets: every range emits (zero-count included)
+        n, client = node
+        body = {"query": {"match": {"body": "alpha"}},
+                "aggs": {"rng": {"range": {"field": "n", "ranges": [
+                    {"to": 40}, {"from": 40, "to": 90},
+                    {"from": 90}, {"from": 5000}]}}}, "size": 0}
+        mesh, transport = _search_both_paths(n, client, body)
+        m = mesh["aggregations"]["rng"]["buckets"]
+        t = transport["aggregations"]["rng"]["buckets"]
+        assert [(b.get("key"), b["doc_count"]) for b in m] == \
+            [(b.get("key"), b["doc_count"]) for b in t]
+        assert m[-1]["doc_count"] == 0  # zero-count range still emitted
+
+    def test_filters_agg_rides_mesh(self, node):
+        n, client = node
+        body = {"query": {"match": {"body": "alpha"}},
+                "aggs": {"f": {"filters": {"filters": {
+                    "low": {"range": {"n": {"lt": 60}}},
+                    "high": {"range": {"n": {"gte": 60}}}}}}}, "size": 0}
+        mesh, transport = _search_both_paths(n, client, body)
+        m = {k: b["doc_count"]
+             for k, b in mesh["aggregations"]["f"]["buckets"].items()}
+        t = {k: b["doc_count"]
+             for k, b in transport["aggregations"]["f"]["buckets"].items()}
+        assert m == t and set(m) == {"low", "high"}
+
+    def test_significant_terms_declines_mesh(self, node):
+        # per-segment background counts don't survive the shard-level merge
+        n, client = node
+        ms = n.actions.mesh_serving
+        before = ms.mesh_queries
+        r = client.search("library", {
+            "query": {"match": {"body": "alpha"}},
+            "aggs": {"sig": {"significant_terms": {"field": "body"}}}})
+        assert ms.mesh_queries == before
+        assert "sig" in r["aggregations"]
+
+    def test_shard_subset_preference_rides_mesh(self, node):
+        # routing/preference selecting a subset serves via the active mask
+        n, client = node
+        ms = n.actions.mesh_serving
+        body = {"query": {"match": {"body": "alpha beta"}}, "size": 10}
+        full = client.search("library", body)
+        before = ms.mesh_queries
+        subset = client.search("library", body, preference="_shards:0,2")
+        assert ms.mesh_queries == before + 1
+        ms.enabled = False
+        try:
+            subset_t = client.search("library", body, preference="_shards:0,2")
+        finally:
+            ms.enabled = True
+        assert subset["hits"]["total"] == subset_t["hits"]["total"]
+        assert [h["_id"] for h in subset["hits"]["hits"]] == \
+            [h["_id"] for h in subset_t["hits"]["hits"]]
+        assert subset["hits"]["total"] < full["hits"]["total"]
+
+    def test_sort_asc_missing_last(self, node):
+        # (k > doc_pad declines the mesh, so keep the result window small and
+        # the query selective enough that the missing-value doc is in-window)
+        n, client = node
+        client.index("library", "doc", {"body": "zzyzx nofield"}, id="nm1")
+        client.refresh("library")
+        try:
+            body = {"query": {"term": {"body": "zzyzx"}},
+                    "sort": [{"n": {"order": "asc", "missing": "_last"}}],
+                    "size": 10}
+            mesh, transport = _search_both_paths(n, client, body)
+            m = [(h["_id"], h["sort"]) for h in mesh["hits"]["hits"]]
+            t = [(h["_id"], h["sort"]) for h in transport["hits"]["hits"]]
+            assert m == t
+            assert m[-1][0] == "nm1"  # missing ranks last
+            assert len(m) >= 2
+        finally:
+            client.delete("library", "doc", "nm1")
+            client.refresh("library")
+
+    def test_sort_plus_post_filter_plus_min_score_composes(self, node):
+        n, client = node
+        body = {"query": {"match": {"body": "alpha"}},
+                "post_filter": {"range": {"n": {"gte": 10}}},
+                "min_score": 0.01,
+                "sort": [{"n": "desc"}], "size": 10}
+        mesh, transport = _search_both_paths(n, client, body)
+        assert mesh["hits"]["total"] == transport["hits"]["total"]
+        assert [h["_id"] for h in mesh["hits"]["hits"]] == \
+            [h["_id"] for h in transport["hits"]["hits"]]
