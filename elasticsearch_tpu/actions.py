@@ -55,6 +55,7 @@ from .cluster.state import (
 )
 from .index.translog import CREATE, DELETE, INDEX, TranslogOp
 from .indices_service import ACTION_SHARD_FAILED, ACTION_SHARD_STARTED
+from .search.queries import resolve_terms_lookups
 from .search.controller import (
     aggregate_dfs,
     collect_dfs,
@@ -1357,6 +1358,9 @@ class ActionModule:
             state.blocks.check("read", i)
         # filtered aliases compose into the query (ref: filtered alias handling)
         alias_filters = {i: state.metadata.alias_filter(i, index_expr) for i in indices}
+        # terms LOOKUPS resolve here, once, against the get path — every shard
+        # then sees identical literal values (ref: TermsFilterParser lookup)
+        body = resolve_terms_lookups(body, self._lookup_get)
         req = parse_search_body(body)
         shards = self.routing.search_shards(state, indices, routing, preference)
 
@@ -1668,7 +1672,8 @@ class ActionModule:
                 "field_stats": {f: _fs_from(l) for f, l in dfs["field_stats"].items()},
             }
         return ShardContext(shard.engine.acquire_searcher(), svc.mapper_service,
-                            svc.similarity_service, global_stats)
+                            svc.similarity_service, global_stats,
+                            index_name=index)
 
     def _s_query_phase(self, request, channel):
         index, shard_id = request["index"], request["shard"]
@@ -1741,9 +1746,16 @@ class ActionModule:
         r = self.search(index_expr, {**(body or {}), "size": 0})
         return {"count": r["hits"]["total"], "_shards": r["_shards"]}
 
+    def _lookup_get(self, index, type_name, doc_id, routing=None):
+        # a missing lookup DOCUMENT resolves to no terms (reference behavior);
+        # a missing lookup INDEX (typo) must fail the request, not silently
+        # return zero hits — get_doc's IndexMissingError propagates
+        return self.get_doc(index, type_name or "_all", doc_id, routing=routing)
+
     def delete_by_query(self, index_expr, body) -> dict:
         """Broadcast: resolve matching uids per shard, tombstone (ref: delete_by_query
         replication action — here resolved per shard then replicated)."""
+        body = resolve_terms_lookups(body, self._lookup_get)
         state = self.cluster_service.state
         indices = state.metadata.resolve_indices(index_expr)
         futs = []

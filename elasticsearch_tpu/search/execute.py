@@ -42,6 +42,7 @@ from .queries import (
     DisMaxQuery,
     FilteredQuery,
     FunctionScoreQuery,
+    FuzzyLikeThisQuery,
     FuzzyQuery,
     HasChildQuery,
     HasParentQuery,
@@ -56,6 +57,7 @@ from .queries import (
     PrefixQuery,
     Query,
     QueryStringQuery,
+    SimpleQueryStringQuery,
     RangeQuery,
     RegexpQuery,
     FieldMaskingSpanQuery,
@@ -83,7 +85,7 @@ class ShardContext:
     """Shard-level stats + mapping access shared by planner and scorers."""
 
     def __init__(self, searcher: Searcher, mapper_service, similarity_service=None,
-                 global_stats: dict | None = None):
+                 global_stats: dict | None = None, index_name: str | None = None):
         self.searcher = searcher
         self.mapper_service = mapper_service
         self.similarity_service = similarity_service or SimilarityService(
@@ -92,6 +94,9 @@ class ShardContext:
         # DFS-phase override: {"df": {(field, term): df}, "max_doc": N,
         #                      "field_stats": {field: FieldStats}}
         self.global_stats = global_stats or {}
+        # which index this shard belongs to (indices query/filter targeting);
+        # None = unknown → indices-targeted constructs assume a match
+        self.index_name = index_name
 
     @property
     def max_doc(self) -> int:
@@ -1115,8 +1120,25 @@ class HostScorer:
             return self._eval_spans(q, b)
 
         if isinstance(q, IndicesQuery):
-            # index targeting resolved at the shard level; here run the main query
-            return self.eval(q.query, b)
+            # ref: IndicesQueryParser — the query applies on the named indices,
+            # no_match_query (default all, "none" = nothing) elsewhere
+            import fnmatch
+
+            name = getattr(self.ctx, "index_name", None)
+            if name is None or any(fnmatch.fnmatch(name, p)
+                                   for p in (q.indices or [])):
+                return self.eval(q.query, b * q.boost)
+            if q.no_match_none:
+                return (np.zeros(self.D, np.float32), np.zeros(self.D, bool))
+            if q.no_match_query is None:
+                return self.eval(MatchAllQuery(), b * q.boost)
+            return self.eval(q.no_match_query, b * q.boost)
+
+        if isinstance(q, SimpleQueryStringQuery):
+            return self.eval(parse_simple_query_string(q), b)
+
+        if isinstance(q, FuzzyLikeThisQuery):
+            return self.eval(self._rewrite_flt(q), b)
 
         raise QueryParsingError(f"unsupported query type {type(q).__name__}")
 
@@ -1428,6 +1450,28 @@ class HostScorer:
                 shoulds.append(TermQuery(field, t))
         return BoolQuery(should=shoulds, minimum_should_match=q.minimum_should_match)
 
+    def _rewrite_flt(self, q: FuzzyLikeThisQuery) -> Query:
+        """ref: FuzzyLikeThisQueryParser.java:1 — like_text analyzed per field,
+        each term OR-expanded to its fuzzy neighborhood. Legacy float
+        fuzziness < 1 is a min-similarity: edits = min(2, ⌊(1-sim)·len⌋) — the
+        classic Lucene FuzzyQuery conversion."""
+        ctx = self.ctx
+        fields = q.fields or ["_all"]
+        shoulds: list = []
+        budget = max(int(q.max_query_terms), 1)
+        for field in fields:
+            terms = list(dict.fromkeys(ctx.analyze(field, q.like_text)))[:budget]
+            for t in terms:
+                fz = q.fuzziness
+                try:
+                    f_val = float(fz)
+                    if 0 < f_val < 1:
+                        fz = min(2, int((1.0 - f_val) * len(t)))
+                except (TypeError, ValueError):
+                    pass
+                shoulds.append(FuzzyQuery(field, t, fz, q.prefix_length))
+        return BoolQuery(should=shoulds, minimum_should_match=1, boost=q.boost)
+
     # -- function score ------------------------------------------------------
     def _eval_function_score(self, q: FunctionScoreQuery, boost: float):
         from .functions import apply_functions
@@ -1576,6 +1620,75 @@ _QS_TOKEN = re.compile(
     r"\s*(?:(\()|(\))|(AND\b|&&)|(OR\b|\|\|)|(NOT\b|!)|([+-])?"
     r"(?:(\w[\w.]*):)?(?:\"([^\"]*)\"|([^\s()]+)))"
 )
+
+
+_SQS_TOKEN = re.compile(
+    r'\s*(?:(\|)|(\+)|(-)|"([^"]*)"(?:~(\d+))?|([^\s|+\-][^\s|+]*))'
+)
+
+
+def parse_simple_query_string(q: "SimpleQueryStringQuery") -> Query:
+    """The degraded-gracefully syntax (ref: SimpleQueryStringParser.java:1 /
+    Lucene SimpleQueryParser): whitespace-separated terms joined by the default
+    operator, `+` forces AND, `|` forces OR, leading `-` negates, `"..."` is a
+    phrase (optional ~slop), a trailing `*` is a prefix. Invalid syntax never
+    errors — stray operators degrade to plain text handling."""
+    fields = q.fields or ["_all"]
+
+    def node_for(phrase, slop, word):
+        subs: list = []
+        for f in fields:
+            fname, _, fboost = f.partition("^")
+            boost = float(fboost) if fboost else 1.0
+            if phrase is not None:
+                subs.append(PhraseQuery(fname, phrase, slop=int(slop or 0),
+                                        boost=boost))
+            elif word.endswith("*") and len(word) > 1:
+                subs.append(PrefixQuery(fname, word[:-1].lower(), boost))
+            else:
+                subs.append(MatchQuery(fname, word, boost=boost))
+        if len(subs) == 1:
+            return subs[0]
+        return BoolQuery(should=subs, minimum_should_match=1,
+                         disable_coord=True)
+
+    must, should, must_not = [], [], []
+    pending = None  # explicit connective seen since the last term
+    negate = False
+    for m in _SQS_TOKEN.finditer(q.query):
+        bar, plus, minus, phrase, slop, word = m.groups()
+        if bar:
+            # "a | b": explicit OR releases its LEFT operand from must (the
+            # default_operator=and case) — Lucene's SimpleQueryParser OR wins
+            if must:
+                should.append(must.pop())
+            pending = "or"
+            continue
+        if plus:
+            pending = "and"
+            continue
+        if minus:
+            negate = True
+            continue
+        node = node_for(phrase, slop, word)
+        if negate:
+            must_not.append(node)
+        elif pending == "and" or (pending is None
+                                  and q.default_operator == "and"):
+            if pending == "and" and should:
+                must.append(should.pop())  # "a + b": AND binds its left operand
+            must.append(node)
+        else:
+            should.append(node)
+        pending = None
+        negate = False
+    if not must and not should and not must_not:
+        return MatchAllQuery()
+    if len(should) == 1 and not must and not must_not:
+        out = should[0]
+        out.boost = out.boost * q.boost
+        return out
+    return BoolQuery(must=must, should=should, must_not=must_not, boost=q.boost)
 
 
 def parse_query_string(q: QueryStringQuery, ctx: ShardContext) -> Query:

@@ -32,9 +32,18 @@ class Filter:
     def evaluate(self, seg: FrozenSegment, ctx) -> np.ndarray:
         raise NotImplementedError
 
+    def cacheable(self) -> bool:
+        """False for masks that depend on state OUTSIDE the segment (e.g. the
+        parent/child join spans the whole shard): the per-segment filter cache
+        would serve stale results after other segments change. Composites
+        propagate from their children."""
+        return True
+
 
 def segment_mask(seg: FrozenSegment, f: Filter, ctx) -> np.ndarray:
     """Cached evaluation (the filter cache). ctx carries the mapper service."""
+    if not f.cacheable():
+        return f.evaluate(seg, ctx)
     cache = seg._device_cache.setdefault("filters", {})
     k = f.key()
     m = cache.get(k)
@@ -275,6 +284,10 @@ class BoolFilter(Filter):
             mask &= ~segment_mask(seg, f, ctx)
         return mask
 
+    def cacheable(self):
+        return all(f.cacheable()
+                   for f in (*self.must, *self.should, *self.must_not))
+
 
 @dataclass
 class NotFilter(Filter):
@@ -285,6 +298,9 @@ class NotFilter(Filter):
 
     def evaluate(self, seg, ctx):
         return ~segment_mask(seg, self.inner, ctx)
+
+    def cacheable(self):
+        return self.inner.cacheable()
 
 
 @dataclass
@@ -490,6 +506,151 @@ class RegexpFilter(Filter):
 
 
 EARTH_RADIUS_M = 6371008.7714
+
+
+@dataclass
+class HasChildFilter(Filter):
+    """Parent docs with a matching child — the non-scoring filter form
+    (ref: index/query/HasChildFilterParser.java:1). Wraps the query-form's
+    cross-segment join (execute._shard_join) because parent/child links span
+    segments; the per-segment mask slices out of that shard-level join."""
+
+    query: Any  # HasChildQuery or HasParentQuery with score_mode "none"
+
+    def key(self):
+        q = self.query
+        inner_key = repr(q.query)
+        return f"haschildf:{type(q).__name__}:{getattr(q, 'child_type', getattr(q, 'parent_type', None))}:{inner_key}"
+
+    def cacheable(self):
+        # the join spans the whole shard: a per-segment cached mask would go
+        # stale when a child lands in (or leaves) ANOTHER segment
+        return False
+
+    def evaluate(self, seg, ctx):
+        from .execute import _shard_join
+
+        # one join per (searcher, filter): the searcher's segment set is
+        # immutable for its lifetime, so caching there is both correct and
+        # avoids recomputing the shard-wide join once per segment
+        cache = getattr(ctx.searcher, "_join_cache", None)
+        if cache is None:
+            cache = ctx.searcher._join_cache = {}
+        join = cache.get(self.key())
+        if join is None:
+            join = cache[self.key()] = _shard_join(ctx, self.query)
+        for si, s in enumerate(ctx.searcher.segments):
+            if s is seg:
+                return join[si][1]
+        return np.zeros(seg.doc_count, dtype=bool)
+
+
+@dataclass
+class GeoPolygonFilter(Filter):
+    """Docs with a point inside the polygon (ray casting over the value columns).
+
+    ref: index/query/GeoPolygonFilterParser.java:1 + GeoPolygonFilter.java —
+    the reference walks polygon edges per point (pointInPolygon); here the
+    crossing test vectorizes over every stored point at once."""
+
+    field: str
+    points: tuple  # ((lat, lon), ...) — closed or open ring, either works
+
+    def key(self):
+        return f"geopoly:{self.field}:{self.points}"
+
+    def evaluate(self, seg, ctx):
+        pts = [p for p in self.points]
+        if len(pts) > 1 and pts[0] == pts[-1]:
+            pts = pts[:-1]  # drop the explicit closing point
+        lat_v = np.asarray([p[0] for p in pts])
+        lon_v = np.asarray([p[1] for p in pts])
+
+        def inside(lats, lons):
+            hit = np.zeros(len(lats), dtype=bool)
+            n = len(lat_v)
+            for i in range(n):
+                j = (i - 1) % n
+                crosses = ((lat_v[i] > lats) != (lat_v[j] > lats)) & (
+                    lons < (lon_v[j] - lon_v[i]) * (lats - lat_v[i])
+                    / (lat_v[j] - lat_v[i] + 1e-300) + lon_v[i])
+                hit ^= crosses
+            return hit
+
+        return _geo_points_mask(seg, self.field, inside)
+
+
+@dataclass
+class GeoDistanceRangeFilter(Filter):
+    """Docs whose point distance from the origin falls in [from, to).
+
+    ref: index/query/GeoDistanceRangeFilterParser.java:1 — the ring/doughnut
+    variant of geo_distance; bounds honor include_lower/include_upper."""
+
+    field: str
+    lat: float
+    lon: float
+    from_m: float | None = None
+    to_m: float | None = None
+    include_lower: bool = True
+    include_upper: bool = True
+
+    def key(self):
+        return (f"geodistrange:{self.field}:{self.lat}:{self.lon}:"
+                f"{self.from_m}:{self.to_m}:{self.include_lower}:{self.include_upper}")
+
+    def evaluate(self, seg, ctx):
+        def hit(lats, lons):
+            d = haversine_m(self.lat, self.lon, lats, lons)
+            ok = np.ones(len(d), dtype=bool)
+            if self.from_m is not None:
+                ok &= (d >= self.from_m) if self.include_lower else (d > self.from_m)
+            if self.to_m is not None:
+                ok &= (d <= self.to_m) if self.include_upper else (d < self.to_m)
+            return ok
+
+        return _geo_points_mask(seg, self.field, hit)
+
+
+@dataclass
+class IndicesFilter(Filter):
+    """Filter that applies only when searching the named indices; other indices
+    see no_match_filter (default all — ref: IndicesFilterParser.java:1).
+    Needs the shard's index name: ShardContext.index_name (None = assume match,
+    the single-index embedded case)."""
+
+    indices: tuple
+    filter: Any = None
+    no_match_filter: Any = None  # None = match_all
+    no_match_none: bool = False
+
+    def key(self):
+        inner_key = getattr(self.filter, "key", lambda: repr(self.filter))()
+        nm_key = (getattr(self.no_match_filter, "key",
+                          lambda: repr(self.no_match_filter))()
+                  if self.no_match_filter is not None else "all")
+        return f"indices:{self.indices}:{inner_key}:{nm_key}:{self.no_match_none}"
+
+    def cacheable(self):
+        return (self.filter is None or self.filter.cacheable()) and (
+            self.no_match_filter is None or self.no_match_filter.cacheable())
+
+    def _matches_index(self, ctx) -> bool:
+        name = getattr(ctx, "index_name", None)
+        if name is None:
+            return True
+        import fnmatch
+
+        return any(fnmatch.fnmatch(name, pat) for pat in self.indices)
+
+    def evaluate(self, seg, ctx):
+        if self._matches_index(ctx):
+            return segment_mask(seg, self.filter, ctx)
+        if self.no_match_none:
+            return np.zeros(seg.doc_count, dtype=bool)
+        if self.no_match_filter is None:
+            return np.ones(seg.doc_count, dtype=bool)
+        return segment_mask(seg, self.no_match_filter, ctx)
 
 
 def haversine_m(lat1, lon1, lat2, lon2):

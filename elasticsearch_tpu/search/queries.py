@@ -24,9 +24,13 @@ from .filters import (
     Filter,
     GeoBoundingBoxFilter,
     GeoDistanceFilter,
+    GeoDistanceRangeFilter,
     GeohashCellFilter,
+    GeoPolygonFilter,
     GeoShapeFilter,
+    HasChildFilter,
     IdsFilter,
+    IndicesFilter,
     MatchAllFilter,
     MissingFilter,
     NestedFilter,
@@ -339,7 +343,37 @@ class FieldMaskingSpanQuery(Query):
 class IndicesQuery(Query):
     indices: list
     query: Query = None
-    no_match_query: Query | None = None
+    no_match_query: Query | None = None  # None = match_all (the reference default)
+    boost: float = 1.0
+    no_match_none: bool = False  # "no_match_query": "none"
+
+
+@dataclass
+class SimpleQueryStringQuery(Query):
+    """ref: index/query/SimpleQueryStringParser.java:1 — the degraded-gracefully
+    query syntax (+ | - "phrase" prefix*); resolved against the analyzer at
+    execution time like QueryStringQuery (execute.parse_simple_query_string)."""
+
+    query: str = ""
+    fields: list = dc_field(default_factory=list)  # empty = _all
+    default_operator: str = "or"
+    analyzer: str | None = None
+    boost: float = 1.0
+
+
+@dataclass
+class FuzzyLikeThisQuery(Query):
+    """ref: index/query/FuzzyLikeThisQueryParser.java:1 (+ the _field variant) —
+    like_text analyzed, each term expanded to its fuzzy index-term neighborhood,
+    OR-combined. Rewritten in HostScorer._rewrite_flt."""
+
+    fields: list = dc_field(default_factory=list)  # empty = _all
+    like_text: str = ""
+    fuzziness: Any = 0.5  # min_similarity legacy float or edit distance
+    prefix_length: int = 0
+    max_query_terms: int = 25
+    ignore_tf: bool = False
+    analyzer: str | None = None
     boost: float = 1.0
 
 
@@ -596,6 +630,75 @@ def _parse_query_string(spec) -> Query:
     )
 
 
+def _parse_simple_query_string(spec) -> Query:
+    if isinstance(spec, str):
+        spec = {"query": spec}
+    return SimpleQueryStringQuery(
+        query=str(spec.get("query", "")),
+        fields=list(spec.get("fields", [])),
+        default_operator=str(spec.get("default_operator", "or")).lower(),
+        analyzer=spec.get("analyzer"),
+        boost=float(spec.get("boost", 1.0)),
+    )
+
+
+def _parse_flt(spec) -> Query:
+    return FuzzyLikeThisQuery(
+        fields=list(spec.get("fields", [])),
+        like_text=str(spec.get("like_text", "")),
+        fuzziness=spec.get("fuzziness", spec.get("min_similarity", 0.5)),
+        prefix_length=int(spec.get("prefix_length", 0)),
+        max_query_terms=int(spec.get("max_query_terms", 25)),
+        ignore_tf=bool(spec.get("ignore_tf", False)),
+        analyzer=spec.get("analyzer"),
+        boost=float(spec.get("boost", 1.0)),
+    )
+
+
+def _parse_flt_field(spec) -> Query:
+    """{field: {like_text: ...}} — ref: FuzzyLikeThisFieldQueryParser.java:1."""
+    (fname, opts), = spec.items()
+    return _parse_flt({**(opts if isinstance(opts, dict) else {"like_text": opts}),
+                       "fields": [fname]})
+
+
+def _parse_mlt_field(spec) -> Query:
+    """{field: {like_text: ...}} — ref: MoreLikeThisFieldQueryParser.java:1."""
+    (fname, opts), = spec.items()
+    if not isinstance(opts, dict):
+        opts = {"like_text": opts}
+    return _QUERY_PARSERS["more_like_this"]({**opts, "fields": [fname]})
+
+
+def _unwrap_wrapper(spec) -> Any:
+    """ref: WrapperQueryParser.java:1 — {"query": <base64 JSON or raw JSON str>}."""
+    import base64
+    import json as _json
+
+    raw = spec.get("query") if isinstance(spec, dict) else spec
+    if isinstance(raw, (dict, list)):
+        return raw
+    s = str(raw)
+    try:
+        s = base64.b64decode(s, validate=True).decode("utf-8")
+    except Exception:  # noqa: BLE001 — not base64: treat as raw JSON
+        pass
+    try:
+        return _json.loads(s)
+    except ValueError as e:
+        raise QueryParsingError(f"wrapper: malformed embedded query: {e}")
+
+
+def _parse_indices_common(spec, parse_inner, none_obj):
+    """Shared indices query/filter shape (ref: IndicesQueryParser/
+    IndicesFilterParser): no_match accepts "all" (default), "none", or a spec."""
+    inner = parse_inner(spec.get("query") if "query" in spec else spec.get("filter"))
+    nm = spec.get("no_match_query", spec.get("no_match_filter"))
+    no_match_none = isinstance(nm, str) and nm.lower() == "none"
+    no_match = parse_inner(nm) if isinstance(nm, dict) else None
+    return inner, no_match, no_match_none, _as_list(spec.get("indices", spec.get("index")))
+
+
 def _parse_template(spec) -> Query:
     """Template query (ref: index/query/TemplateQueryParser): mustache-substitute
     `params` into `query` (an object tree or a JSON string), then parse the result."""
@@ -695,13 +798,20 @@ _QUERY_PARSERS = {
     "geo_shape": lambda s: ConstantScoreQuery(
         filter=_parse_geo_shape_f({k: v for k, v in s.items() if k != "boost"}),
         boost=float(s.get("boost", 1.0))),
-    "indices": lambda s: IndicesQuery(_as_list(s.get("indices", s.get("index"))),
-                                      parse_query(s.get("query")),
-                                      parse_query(s["no_match_query"]) if isinstance(
-                                          s.get("no_match_query"), dict) else None),
+    "indices": lambda s: (lambda inner, nm, nmn, idx: IndicesQuery(
+        idx, inner, nm, float(s.get("boost", 1.0)), no_match_none=nmn))(
+        *_parse_indices_common(s, parse_query, None)),
     "type": lambda s: ConstantScoreQuery(filter=TypeFilter(s.get("value"))),
     "top_children": lambda s: HasChildQuery(s.get("type"), parse_query(s.get("query")),
                                             s.get("score", "max"), float(s.get("boost", 1.0))),
+    "simple_query_string": _parse_simple_query_string,
+    "fuzzy_like_this": _parse_flt,
+    "flt": _parse_flt,
+    "fuzzy_like_this_field": _parse_flt_field,
+    "flt_field": _parse_flt_field,
+    "more_like_this_field": _parse_mlt_field,
+    "mlt_field": _parse_mlt_field,
+    "wrapper": lambda s: parse_query(_unwrap_wrapper(s)),
 }
 
 
@@ -711,11 +821,91 @@ def _as_list(v):
     return v if isinstance(v, list) else [v]
 
 
+_LOOKUP_META = ("index", "type", "id", "path", "routing", "cache")
+
+
+def resolve_terms_lookups(body, get_fn):
+    """Rewrite terms-LOOKUP specs in a raw request body into plain value lists
+    by fetching the referenced document (ref: TermsFilterParser.java:1 — the
+    lookup resolves against the get path; IndicesTermsFilterCache.java:1 caches
+    per node; here the coordinating node resolves once per request, so every
+    shard sees identical values even mid-reindex).
+
+    get_fn(index, type, id, routing) -> get-response dict (or None). A missing
+    document resolves to NO terms (the reference's behavior). Returns the
+    original object when nothing needed rewriting."""
+    def walk(obj):
+        if isinstance(obj, list):
+            new = [walk(v) for v in obj]
+            return new if any(a is not b for a, b in zip(new, obj)) else obj
+        if not isinstance(obj, dict):
+            return obj
+        out = {}
+        changed = False
+        for k, v in obj.items():
+            if k in ("terms", "in") and isinstance(v, dict):
+                fields = {fk: fv for fk, fv in v.items()
+                          if not fk.startswith("_") and fk not in
+                          ("execution", "minimum_should_match",
+                           "minimum_match", "boost", "disable_coord")}
+                if len(fields) == 1:
+                    (fk, fv), = fields.items()
+                    if isinstance(fv, dict) and "id" in fv and "path" in fv:
+                        values = _fetch_lookup_terms(fv, get_fn)
+                        out[k] = {**{ok: ov for ok, ov in v.items() if ok != fk},
+                                  fk: values}
+                        changed = True
+                        continue
+            nv = walk(v)
+            changed = changed or (nv is not v)
+            out[k] = nv
+        return out if changed else obj
+
+    return walk(body)
+
+
+def _fetch_lookup_terms(spec: dict, get_fn) -> list:
+    index = spec.get("index")
+    if not index:
+        raise QueryParsingError("terms lookup requires [index]")
+    doc = get_fn(index, spec.get("type"), str(spec["id"]), spec.get("routing"))
+    src = (doc or {}).get("_source")
+    if not doc or not doc.get("found") or src is None:
+        return []
+    values: list = []
+
+    def extract(node, parts):
+        if not parts:
+            if isinstance(node, list):
+                values.extend(node)
+            elif node is not None:
+                values.append(node)
+            return
+        head, rest = parts[0], parts[1:]
+        if isinstance(node, list):
+            for item in node:
+                extract(item, parts)
+        elif isinstance(node, dict) and head in node:
+            extract(node[head], rest)
+
+    extract(src, str(spec.get("path", "")).split("."))
+    return values
+
+
 def _parse_terms_f(spec) -> Filter:
     spec = {k: v for k, v in spec.items() if k not in ("execution", "_cache", "_cache_key", "_name")}
     if len(spec) != 1:
         raise QueryParsingError("terms filter requires exactly one field")
     fname, values = next(iter(spec.items()))
+    if isinstance(values, dict):
+        # terms LOOKUP (values live in another document — ref:
+        # TermsFilterParser.java:1 + IndicesTermsFilterCache.java:1): the
+        # coordinating node resolves it against the get path BEFORE shard
+        # fan-out (actions.resolve_terms_lookups); reaching this parser
+        # unresolved means there was no coordinator (embedded/percolator use)
+        raise QueryParsingError(
+            f"terms lookup on [{fname}] must be resolved by the coordinating "
+            f"node (index/type/id/path get) before shard execution")
     return TermsFilter(fname, list(values))
 
 
@@ -732,6 +922,71 @@ def _parse_range_f(spec) -> Filter:
     if "include_upper" in opts and not opts["include_upper"] and "lte" in kw:
         kw["lt"] = kw.pop("lte")
     return RangeFilter(field=fname, **kw)
+
+
+def _parse_geo_point(point):
+    """The reference's three point spellings: {lat, lon} | "lat,lon" | [lon, lat]."""
+    if isinstance(point, dict):
+        return float(point["lat"]), float(point["lon"])
+    if isinstance(point, str):
+        lat, lon = (float(x) for x in point.split(","))
+        return lat, lon
+    return float(point[1]), float(point[0])  # geojson order
+
+
+def _parse_geo_polygon_f(spec) -> Filter:
+    """ref: GeoPolygonFilterParser.java:1 — {field: {points: [...]}}."""
+    spec = {k: v for k, v in spec.items() if k not in ("_cache", "_cache_key", "_name")}
+    (fname, body), = spec.items()
+    pts = tuple(_parse_geo_point(p) for p in body.get("points", []))
+    if len({p for p in pts}) < 3:
+        raise QueryParsingError("geo_polygon requires at least 3 distinct points")
+    return GeoPolygonFilter(fname, pts)
+
+
+def _parse_geo_distance_range_f(spec) -> Filter:
+    """ref: GeoDistanceRangeFilterParser.java:1 — geo_distance with
+    from/to/gt/gte/lt/lte distance bounds around the origin point."""
+    spec = {k: v for k, v in spec.items()
+            if k not in ("_cache", "_cache_key", "_name", "distance_type",
+                         "optimize_bbox", "unit")}
+    from_m = to_m = None
+    include_lower = include_upper = True
+    for k in ("from", "gte", "gt"):
+        if k in spec:
+            from_m = parse_distance(spec.pop(k))
+            include_lower = k != "gt"
+    for k in ("to", "lte", "lt"):
+        if k in spec:
+            to_m = parse_distance(spec.pop(k))
+            include_upper = k != "lt"
+    if "include_lower" in spec:
+        include_lower = bool(spec.pop("include_lower"))
+    if "include_upper" in spec:
+        include_upper = bool(spec.pop("include_upper"))
+    (fname, point), = spec.items()
+    lat, lon = _parse_geo_point(point)
+    return GeoDistanceRangeFilter(fname, lat, lon, from_m, to_m,
+                                  include_lower, include_upper)
+
+
+def _parse_has_child_f(spec) -> Filter:
+    """ref: HasChildFilterParser.java:1 — parent docs with a matching child;
+    never scores (score_mode none). The cross-segment join lives in
+    filters.HasChildFilter (a QueryWrapperFilter would evaluate segment-local
+    and match nothing)."""
+    inner = (parse_query(spec["query"]) if "query" in spec
+             else ConstantScoreQuery(filter=parse_filter(spec.get("filter"))))
+    return HasChildFilter(
+        HasChildQuery(spec.get("type", spec.get("child_type")), inner, "none"))
+
+
+def _parse_has_parent_f(spec) -> Filter:
+    """ref: HasParentFilterParser.java:1."""
+    inner = (parse_query(spec["query"]) if "query" in spec
+             else ConstantScoreQuery(filter=parse_filter(spec.get("filter"))))
+    return HasChildFilter(
+        HasParentQuery(spec.get("parent_type", spec.get("type")), inner, "none"))
 
 
 def _parse_geo_distance_f(spec) -> Filter:
@@ -846,4 +1101,12 @@ _FILTER_PARSERS = {
     "geohash_cell": _parse_geohash_cell_f,
     "script": lambda s: ScriptFilter(s.get("script", ""), s.get("params", {})),
     "limit": lambda s: MatchAllFilter(),  # limit filter is best-effort in the reference too
+    "geo_polygon": _parse_geo_polygon_f,
+    "geo_distance_range": _parse_geo_distance_range_f,
+    "has_child": _parse_has_child_f,
+    "has_parent": _parse_has_parent_f,
+    "indices": lambda s: (lambda inner, nm, nmn, idx: IndicesFilter(
+        tuple(idx), inner, nm, no_match_none=nmn))(
+        *_parse_indices_common(s, parse_filter, None)),
+    "wrapper": lambda s: parse_filter(_unwrap_wrapper(s)),
 }

@@ -1,0 +1,403 @@
+"""Query/filter DSL long tail — round-5 closures.
+
+ref: HasChildFilterParser.java:1, HasParentFilterParser.java:1,
+TermsFilterParser.java:1 (+ IndicesTermsFilterCache.java:1),
+GeoPolygonFilterParser.java:1, GeoDistanceRangeFilterParser.java:1,
+IndicesFilterParser.java:1, WrapperQueryParser.java:1,
+SimpleQueryStringParser.java:1, FuzzyLikeThisQueryParser.java:1,
+FuzzyLikeThisFieldQueryParser.java:1, MoreLikeThisFieldQueryParser.java:1.
+
+Each construct gets a differential check against independently-computed
+expectations on the host scorer; terms-lookup goes through the real get path
+on a node."""
+
+import base64
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.mapper.core import MapperService
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.search import ShardContext, parse_query, search_shard
+from elasticsearch_tpu.search.execute import QueryParsingError
+from elasticsearch_tpu.search.filters import segment_mask
+from elasticsearch_tpu.search.queries import parse_filter, resolve_terms_lookups
+from elasticsearch_tpu.search.similarity import SimilarityService
+from elasticsearch_tpu.transport.local import LocalTransportRegistry
+
+
+@pytest.fixture(scope="module")
+def ctx(tmp_path_factory):
+    path = tmp_path_factory.mktemp("dsl_tail")
+    settings = Settings.from_flat({})
+    svc = MapperService(settings)
+    svc.put_mapping("doc", {"properties": {
+        "body": {"type": "string"},
+        "tag": {"type": "string", "index": "not_analyzed"},
+        "n": {"type": "integer"},
+        "loc": {"type": "geo_point"},
+    }})
+    eng = Engine(str(path), svc)
+    docs = [
+        {"body": "quick brown fox", "tag": "a", "n": 1,
+         "loc": {"lat": 52.37, "lon": 4.89}},     # Amsterdam
+        {"body": "lazy brown dog", "tag": "b", "n": 2,
+         "loc": {"lat": 52.52, "lon": 13.40}},    # Berlin
+        {"body": "quick red wolf", "tag": "a", "n": 3,
+         "loc": {"lat": 48.85, "lon": 2.35}},     # Paris
+        {"body": "slow green turtle", "tag": "c", "n": 4,
+         "loc": {"lat": 37.77, "lon": -122.42}},  # SF
+        {"body": "quick quince quest", "tag": "b", "n": 5},  # no loc
+    ]
+    for i, d in enumerate(docs):
+        eng.index("doc", str(i), d)
+    eng.refresh()
+    c = ShardContext(eng.acquire_searcher(), svc,
+                     SimilarityService(settings, mapper_service=svc),
+                     index_name="dsl_tail")
+    yield c
+    eng.close()
+
+
+def _ids(ctx, td):
+    out = []
+    for _s, g in td.hits:
+        seg, local = ctx.searcher.resolve(g)
+        out.append(seg.ids[local])
+    return out
+
+
+def _mask_ids(ctx, f):
+    ids = []
+    for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
+        m = segment_mask(seg, f, ctx)
+        ids.extend(seg.ids[i] for i in m.nonzero()[0])
+    return sorted(ids)
+
+
+class TestSimpleQueryString:
+    def test_default_or(self, ctx):
+        q = parse_query({"simple_query_string": {
+            "query": "fox turtle", "fields": ["body"]}})
+        td = search_shard(ctx, q, 10, use_device=False)
+        assert sorted(_ids(ctx, td)) == ["0", "3"]
+
+    def test_plus_forces_and(self, ctx):
+        q = parse_query({"simple_query_string": {
+            "query": "quick + brown", "fields": ["body"]}})
+        td = search_shard(ctx, q, 10, use_device=False)
+        assert sorted(_ids(ctx, td)) == ["0"]
+
+    def test_negation(self, ctx):
+        q = parse_query({"simple_query_string": {
+            "query": "quick -red", "fields": ["body"]}})
+        td = search_shard(ctx, q, 10, use_device=False)
+        assert sorted(_ids(ctx, td)) == ["0", "4"]
+
+    def test_phrase_and_prefix(self, ctx):
+        q = parse_query({"simple_query_string": {
+            "query": '"brown fox"', "fields": ["body"]}})
+        td = search_shard(ctx, q, 10, use_device=False)
+        assert _ids(ctx, td) == ["0"]
+        q2 = parse_query({"simple_query_string": {
+            "query": "quin*", "fields": ["body"]}})
+        td2 = search_shard(ctx, q2, 10, use_device=False)
+        assert _ids(ctx, td2) == ["4"]
+
+    def test_default_operator_and(self, ctx):
+        q = parse_query({"simple_query_string": {
+            "query": "quick brown", "fields": ["body"],
+            "default_operator": "and"}})
+        td = search_shard(ctx, q, 10, use_device=False)
+        assert sorted(_ids(ctx, td)) == ["0"]
+
+    def test_stray_operators_degrade_gracefully(self, ctx):
+        q = parse_query({"simple_query_string": {
+            "query": "+ | - fox", "fields": ["body"]}})
+        td = search_shard(ctx, q, 10, use_device=False)  # must not raise
+        assert td.total >= 0
+
+    def test_explicit_or_overrides_default_and(self, ctx):
+        # "fox | turtle" with default AND must still be an OR (Lucene's
+        # SimpleQueryParser: the explicit | releases its left operand)
+        q = parse_query({"simple_query_string": {
+            "query": "fox | turtle", "fields": ["body"],
+            "default_operator": "and"}})
+        td = search_shard(ctx, q, 10, use_device=False)
+        assert sorted(_ids(ctx, td)) == ["0", "3"]
+
+
+class TestFuzzyLikeThis:
+    def test_flt_matches_fuzzy_neighborhood(self, ctx):
+        # "quik"~"quick" (1 edit), "brown"~"brown" (1 edit)
+        q = parse_query({"fuzzy_like_this": {
+            "fields": ["body"], "like_text": "quik brown", "fuzziness": 1}})
+        td = search_shard(ctx, q, 10, use_device=False)
+        assert set(_ids(ctx, td)) == {"0", "1", "2", "4"}
+
+    def test_flt_field_form(self, ctx):
+        q = parse_query({"fuzzy_like_this_field": {
+            "body": {"like_text": "foxx", "fuzziness": 1}}})
+        td = search_shard(ctx, q, 10, use_device=False)
+        assert _ids(ctx, td) == ["0"]
+
+    def test_legacy_similarity_float(self, ctx):
+        # 0.5 similarity on len-5 "quick" → 2 edits: "qck" misses (3 edits from
+        # quick... actually 2 deletions) — use "quicky" (1 edit) to stay clear
+        q = parse_query({"flt": {"fields": ["body"], "like_text": "quicky"}})
+        td = search_shard(ctx, q, 10, use_device=False)
+        assert "0" in _ids(ctx, td)
+
+
+class TestMoreLikeThisField:
+    def test_mlt_field(self, ctx):
+        q = parse_query({"more_like_this_field": {"body": {
+            "like_text": "quick brown fox", "min_term_freq": 1,
+            "min_doc_freq": 1, "minimum_should_match": 1}}})
+        td = search_shard(ctx, q, 10, use_device=False)
+        assert "0" in _ids(ctx, td) and td.total >= 3
+
+
+class TestWrapper:
+    def test_wrapper_query_base64(self, ctx):
+        raw = json.dumps({"term": {"tag": "a"}})
+        q = parse_query({"wrapper": {
+            "query": base64.b64encode(raw.encode()).decode()}})
+        td = search_shard(ctx, q, 10, use_device=False)
+        assert sorted(_ids(ctx, td)) == ["0", "2"]
+
+    def test_wrapper_filter_raw_json(self, ctx):
+        f = parse_filter({"wrapper": {"query": '{"term": {"tag": "b"}}'}})
+        assert _mask_ids(ctx, f) == ["1", "4"]
+
+    def test_wrapper_malformed_raises(self, ctx):
+        with pytest.raises(QueryParsingError):
+            parse_query({"wrapper": {"query": "not json at all {"}})
+
+
+class TestGeoFilters:
+    def test_geo_polygon(self, ctx):
+        # triangle around western Europe: Amsterdam, Berlin, Paris in; SF out
+        f = parse_filter({"geo_polygon": {"loc": {"points": [
+            {"lat": 60.0, "lon": 0.0}, {"lat": 60.0, "lon": 20.0},
+            {"lat": 40.0, "lon": 10.0}, {"lat": 40.0, "lon": -5.0}]}}})
+        assert _mask_ids(ctx, f) == ["0", "1", "2"]
+
+    def test_geo_distance_range(self, ctx):
+        # from Amsterdam: Berlin ~577km, Paris ~430km, SF ~8800km
+        f = parse_filter({"geo_distance_range": {
+            "from": "500km", "to": "1000km",
+            "loc": {"lat": 52.37, "lon": 4.89}}})
+        assert _mask_ids(ctx, f) == ["1"]
+        f2 = parse_filter({"geo_distance_range": {
+            "gt": "0km", "lt": "500km", "loc": {"lat": 52.37, "lon": 4.89}}})
+        assert _mask_ids(ctx, f2) == ["2"]  # self at exactly 0 excluded by gt
+        f3 = parse_filter({"geo_distance_range": {
+            "gte": "0km", "lt": "500km", "loc": {"lat": 52.37, "lon": 4.89}}})
+        assert _mask_ids(ctx, f3) == ["0", "2"]  # gte includes the origin doc
+
+    def test_geo_polygon_rejects_degenerate(self, ctx):
+        with pytest.raises(QueryParsingError):
+            parse_filter({"geo_polygon": {"loc": {"points": [
+                {"lat": 0, "lon": 0}, {"lat": 0, "lon": 0}]}}})
+
+
+class TestIndicesTargeting:
+    def test_indices_filter_matching_index(self, ctx):
+        f = parse_filter({"indices": {
+            "indices": ["dsl_*"], "filter": {"term": {"tag": "a"}},
+            "no_match_filter": "none"}})
+        assert _mask_ids(ctx, f) == ["0", "2"]
+
+    def test_indices_filter_non_matching_defaults_all(self, ctx):
+        f = parse_filter({"indices": {
+            "index": "other", "filter": {"term": {"tag": "a"}}}})
+        assert len(_mask_ids(ctx, f)) == 5  # no_match default = all
+
+    def test_indices_filter_non_matching_none(self, ctx):
+        f = parse_filter({"indices": {
+            "index": "other", "filter": {"term": {"tag": "a"}},
+            "no_match_filter": "none"}})
+        assert _mask_ids(ctx, f) == []
+
+    def test_indices_filter_cache_distinguishes_no_match(self, ctx):
+        # two filters differing ONLY in no_match_filter must not collide in
+        # the per-segment filter cache
+        f1 = parse_filter({"indices": {
+            "index": "other", "filter": {"term": {"tag": "a"}},
+            "no_match_filter": {"term": {"tag": "b"}}}})
+        f2 = parse_filter({"indices": {
+            "index": "other", "filter": {"term": {"tag": "a"}},
+            "no_match_filter": {"term": {"tag": "c"}}}})
+        assert _mask_ids(ctx, f1) == ["1", "4"]
+        assert _mask_ids(ctx, f2) == ["3"]
+
+    def test_indices_query_targets_index(self, ctx):
+        q = parse_query({"indices": {
+            "indices": ["dsl_tail"], "query": {"term": {"tag": "c"}},
+            "no_match_query": "none"}})
+        td = search_shard(ctx, q, 10, use_device=False)
+        assert _ids(ctx, td) == ["3"]
+        q2 = parse_query({"indices": {
+            "indices": ["other"], "query": {"term": {"tag": "c"}},
+            "no_match_query": "none"}})
+        td2 = search_shard(ctx, q2, 10, use_device=False)
+        assert td2.total == 0
+
+
+class TestTermsLookupUnit:
+    def test_rewrite_replaces_lookup(self):
+        body = {"query": {"filtered": {"query": {"match_all": {}},
+                "filter": {"terms": {"tag": {
+                    "index": "users", "type": "u", "id": "1",
+                    "path": "prefs.tags"}}}}}}
+        got = resolve_terms_lookups(body, lambda i, t, d, r: {
+            "found": True, "_source": {"prefs": {"tags": ["a", "c"]}}})
+        assert got["query"]["filtered"]["filter"] == {"terms": {"tag": ["a", "c"]}}
+        assert body["query"]["filtered"]["filter"]["terms"]["tag"]["id"] == "1"
+
+    def test_missing_doc_resolves_empty(self):
+        body = {"filter": {"terms": {"tag": {"index": "x", "id": "9",
+                                             "path": "p"}}}}
+        got = resolve_terms_lookups(body, lambda i, t, d, r: {"found": False})
+        assert got["filter"]["terms"]["tag"] == []
+
+    def test_plain_terms_untouched(self):
+        body = {"filter": {"terms": {"tag": ["a", "b"]}}}
+        assert resolve_terms_lookups(body, None) is body
+
+    def test_unresolved_lookup_raises_at_parse(self):
+        with pytest.raises(QueryParsingError):
+            parse_filter({"terms": {"tag": {"index": "x", "id": "1",
+                                            "path": "p"}}})
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    registry = LocalTransportRegistry()
+    n = Node(name="dsl_node", registry=registry,
+             data_path=str(tmp_path_factory.mktemp("dsl_node")))
+    n.start([n.local_node.transport_address])
+    n.wait_for_master()
+    client = n.client()
+    client.create_index("users", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 0}})
+    client.create_index("tweets", {"settings": {
+        "number_of_shards": 2, "number_of_replicas": 0}})
+    client.cluster_health(wait_for_status="green")
+    client.index("users", "user", {"name": "kim",
+                                   "follows": ["ana", "bo"]}, id="1")
+    for i, (author, text) in enumerate([
+            ("ana", "hello world"), ("bo", "goodbye world"),
+            ("cai", "other post"), ("ana", "second post")]):
+        client.index("tweets", "tweet", {"author": author, "text": text},
+                     id=str(i))
+    client.refresh("users")
+    client.refresh("tweets")
+    yield n, client
+    n.close()
+
+
+class TestTermsLookupEndToEnd:
+    def test_lookup_through_get_path(self, node):
+        # the canonical reference example: tweets by users kim follows
+        _n, client = node
+        r = client.search("tweets", {"query": {"filtered": {
+            "query": {"match_all": {}},
+            "filter": {"terms": {"author": {
+                "index": "users", "type": "user", "id": "1",
+                "path": "follows"}}}}}})
+        ids = sorted(h["_id"] for h in r["hits"]["hits"])
+        assert ids == ["0", "1", "3"]
+
+    def test_lookup_missing_doc_matches_nothing(self, node):
+        _n, client = node
+        r = client.search("tweets", {"query": {"filtered": {
+            "query": {"match_all": {}},
+            "filter": {"terms": {"author": {
+                "index": "users", "type": "user", "id": "404",
+                "path": "follows"}}}}}})
+        assert r["hits"]["total"] == 0
+
+    def test_indices_filter_end_to_end(self, node):
+        # searching tweets: the tag filter applies only on "users"
+        _n, client = node
+        r = client.search("tweets", {"query": {"filtered": {
+            "query": {"match_all": {}},
+            "filter": {"indices": {
+                "index": "users",
+                "filter": {"term": {"author": "nobody"}}}}}}})
+        assert r["hits"]["total"] == 4  # no_match default: all
+
+
+@pytest.fixture(scope="module")
+def pc_ctx(tmp_path_factory):
+    """Parent/child corpus for the has_child / has_parent FILTER forms."""
+    path = tmp_path_factory.mktemp("dsl_pc")
+    settings = Settings.from_flat({})
+    svc = MapperService(settings)
+    svc.put_mapping("blog", {"properties": {
+        "title": {"type": "string"}}})
+    svc.put_mapping("comment", {"_parent": {"type": "blog"}, "properties": {
+        "text": {"type": "string"}}})
+    eng = Engine(str(path), svc)
+    eng.index("blog", "b1", {"title": "jax on tpu"})
+    eng.index("blog", "b2", {"title": "numpy tricks"})
+    eng.index("blog", "b3", {"title": "silent post"})
+    eng.index("comment", "c1", {"text": "great article"}, parent="b1")
+    eng.index("comment", "c2", {"text": "nice read great"}, parent="b2")
+    eng.index("comment", "c3", {"text": "meh"}, parent="b2")
+    eng.refresh()
+    c = ShardContext(eng.acquire_searcher(), svc,
+                     SimilarityService(settings, mapper_service=svc))
+    yield c
+    eng.close()
+
+
+class TestParentChildFilters:
+    def test_has_child_filter(self, pc_ctx):
+        f = parse_filter({"has_child": {
+            "type": "comment", "query": {"term": {"text": "great"}}}})
+        assert sorted(_mask_ids(pc_ctx, f)) == ["b1", "b2"]
+
+    def test_has_child_filter_with_filter_body(self, pc_ctx):
+        f = parse_filter({"has_child": {
+            "type": "comment", "filter": {"term": {"text": "meh"}}}})
+        assert _mask_ids(pc_ctx, f) == ["b2"]
+
+    def test_has_parent_filter(self, pc_ctx):
+        f = parse_filter({"has_parent": {
+            "parent_type": "blog", "query": {"term": {"title": "jax"}}}})
+        assert _mask_ids(pc_ctx, f) == ["c1"]
+
+    def test_has_child_composes_in_bool_filter(self, pc_ctx):
+        f = parse_filter({"bool": {
+            "must": [{"has_child": {"type": "comment",
+                                    "query": {"term": {"text": "great"}}}}],
+            "must_not": [{"term": {"title": "numpy"}}]}})
+        assert _mask_ids(pc_ctx, f) == ["b1"]
+
+    def test_has_child_filter_sees_new_children(self, tmp_path):
+        # the cross-segment join must never serve a stale per-segment cache:
+        # a child indexed into a LATER segment changes an EARLIER segment's mask
+        settings = Settings.from_flat({})
+        svc = MapperService(settings)
+        svc.put_mapping("blog", {"properties": {"title": {"type": "string"}}})
+        svc.put_mapping("comment", {"_parent": {"type": "blog"},
+                                    "properties": {"text": {"type": "string"}}})
+        eng = Engine(str(tmp_path), svc)
+        eng.index("blog", "p1", {"title": "lonely"})
+        eng.refresh()
+        c1 = ShardContext(eng.acquire_searcher(), svc,
+                          SimilarityService(settings, mapper_service=svc))
+        f = parse_filter({"has_child": {
+            "type": "comment", "query": {"term": {"text": "late"}}}})
+        assert _mask_ids(c1, f) == []  # no children yet (primes any cache)
+        eng.index("comment", "c9", {"text": "late arrival"}, parent="p1")
+        eng.refresh()
+        c2 = ShardContext(eng.acquire_searcher(), svc,
+                          SimilarityService(settings, mapper_service=svc))
+        assert _mask_ids(c2, f) == ["p1"]  # the new child is visible
+        eng.close()
