@@ -122,6 +122,8 @@ class Engine:
         self._uid_index: dict[str, tuple[int, int]] = {}  # uid -> (gen, local) frozen
         self._pending_deletes: list[tuple] = []  # locations to tombstone at refresh
         self._closed = False
+        self._recovery_holds: dict[str, float] = {}  # hold id -> expiry ts
+        self._deferred_segment_deletes: list[int] = []  # gens pinned by holds
         self.settings = settings
         from .merge_policy import TieredMergePolicy
 
@@ -401,7 +403,11 @@ class Engine:
                 translog_gen=new_tgen,
                 extra={"tombstones": live_tombstones},
             )
-            self.translog.prune_before(new_tgen)
+            if not self._recovery_held():
+                # an ongoing peer recovery still needs the older generations:
+                # pruning them would lose the phase-2/3 replay window (ref: 1.x
+                # InternalEngine's onGoingRecoveries gate on translog deletion)
+                self.translog.prune_before(new_tgen)
             self.stats["flush_total"] += 1
             self.stats["flush_time_ms"] += (time.monotonic() - t0) * 1000
             return True
@@ -409,6 +415,66 @@ class Engine:
     def maybe_flush(self):
         if self.translog.should_flush():
             self.flush()
+
+    # --------------------------------------------------------- peer recovery
+    def acquire_recovery_hold(self, ttl: float = 600.0) -> str:
+        """An ongoing peer recovery pins this engine's on-disk artifacts:
+        flushes keep committing but stop pruning translog generations, and
+        merged-away segment files defer deletion (a recovery target may still
+        be chunk-pulling them). Ref: RecoverySource phases + the 1.x engine's
+        recovery-count gate on translog deletion. The TTL bounds the leak when
+        a target dies mid-flight; long recoveries must touch_recovery_hold()
+        as they make progress — handlers REJECT an expired hold rather than
+        serve a silently-shortened replay window."""
+        import uuid
+
+        hid = uuid.uuid4().hex
+        with self._lock:
+            self._recovery_holds[hid] = time.time() + ttl
+        return hid
+
+    def touch_recovery_hold(self, hold_id: str | None, ttl: float = 600.0) -> bool:
+        """Extend a live hold; False if it already expired/released (the
+        recovery must restart — its pinned files may be gone)."""
+        with self._lock:
+            self._recovery_held()
+            if hold_id not in self._recovery_holds:
+                return False
+            self._recovery_holds[hold_id] = time.time() + ttl
+            return True
+
+    def release_recovery_hold(self, hold_id: str | None):
+        with self._lock:
+            self._recovery_holds.pop(hold_id, None)
+            self._recovery_held()  # flush deferred deletions when last hold drops
+
+    def _recovery_held(self) -> bool:
+        now = time.time()
+        for hid in [h for h, exp in self._recovery_holds.items() if exp < now]:
+            del self._recovery_holds[hid]
+        if not self._recovery_holds and self._deferred_segment_deletes:
+            for g in self._deferred_segment_deletes:
+                self.store.delete_segment(g)
+            self._deferred_segment_deletes = []
+        return bool(self._recovery_holds)
+
+    def _delete_segment_files(self, gen: int):
+        """Merged-away segment files delete immediately — unless a recovery
+        hold is live, in which case deletion defers until the last hold drops
+        (the chunk-pull phase reads these files outside the engine lock)."""
+        if self._recovery_held():
+            self._deferred_segment_deletes.append(gen)
+        else:
+            self.store.delete_segment(gen)
+
+    def translog_ops_since(self, gen: int, count: int) -> list:
+        """Recovery phase 3: every op appended after the phase-2 snapshot
+        position, collected UNDER the engine write lock — no operation can land
+        between this snapshot and the caller handing the replica to live
+        replication (ref: RecoverySource.java:257-264, phase3 under the write
+        lock)."""
+        with self._lock:
+            return self.translog.read_ops(from_gen=gen)[count:]
 
     def optimize(self, max_num_segments: int = 1):
         """Force-merge (ref: InternalEngine.maybeMerge / optimize API)."""
@@ -444,7 +510,7 @@ class Engine:
             for g in old_gens:
                 self._persisted_gens.discard(g)
                 self._segment_files.pop(str(g), None)
-                self.store.delete_segment(g)
+                self._delete_segment_files(g)
             self._searcher = Searcher(list(self._segments))
             self.stats["merge_total"] += 1
 
@@ -484,7 +550,7 @@ class Engine:
         for g in old_gens:
             self._persisted_gens.discard(g)
             self._segment_files.pop(str(g), None)
-            self.store.delete_segment(g)
+            self._delete_segment_files(g)
         self._searcher = Searcher(list(self._segments))
         self.stats["merge_total"] += 1
 
@@ -502,8 +568,23 @@ class Engine:
     # ------------------------------------------------------------------ recovery
     def recover_from_store(self) -> int:
         """Gateway recovery: load last commit's segments, then replay the translog
-        (ref: IndexShard.performRecoveryOperation:743 / local gateway)."""
+        (ref: IndexShard.performRecoveryOperation:743 / local gateway).
+
+        Rebuilds from DURABLE state only: any pre-existing in-memory state is
+        dropped first. A recovering replica may have live-replicated ops in its
+        buffer/version map; keeping the version map while discarding the buffer
+        would make the later phase-2/3 replay of those ops a version-conflict
+        no-op against a ghost entry — a lost write (caught by
+        tests/test_recovery_under_writes.py). Every dropped op is re-delivered:
+        pre-flush ops are in the copied segment files, post-flush ops in the
+        phase-2/3 translog stream."""
         with self._lock:
+            self._segments = []
+            self._segment_files = {}
+            self._persisted_gens = set()
+            self._version_map = {}
+            self._uid_index = {}
+            self._pending_deletes = []
             commit = self.store.read_last_commit()
             replayed = 0
             if commit:

@@ -18,12 +18,14 @@ from __future__ import annotations
 import base64
 import os
 import threading
+import time
 from dataclasses import dataclass, field as dc_field
 
 from .common.errors import IndexShardMissingError, SearchEngineError
 from .common.logging import get_logger
 from .common.settings import Settings
 from .index.engine import Engine
+from .index.store import _crc_file
 from .index.translog import TranslogOp, CREATE, INDEX, DELETE
 from .mapper import MapperService
 from .search.similarity import SimilarityService
@@ -32,7 +34,9 @@ from .cluster.state import INITIALIZING, STARTED, ClusterState, ShardRouting
 ACTION_SHARD_STARTED = "internal:cluster/shard/started"
 ACTION_SHARD_FAILED = "internal:cluster/shard/failed"
 ACTION_RECOVERY_FILES = "internal:index/shard/recovery/files"
+ACTION_RECOVERY_CHUNK = "internal:index/shard/recovery/chunk"
 ACTION_RECOVERY_TRANSLOG = "internal:index/shard/recovery/translog"
+ACTION_RECOVERY_FINALIZE = "internal:index/shard/recovery/finalize"
 
 # shard lifecycle (ref: IndexShardState CREATED→RECOVERING→POST_RECOVERY→STARTED)
 CREATED, RECOVERING, POST_RECOVERY, SHARD_STARTED, CLOSED = (
@@ -98,7 +102,9 @@ class IndicesService:
         self.logger = get_logger("indices", node=node_name)
         self._lock = threading.RLock()
         transport.register_handler(ACTION_RECOVERY_FILES, self._handle_recovery_files)
+        transport.register_handler(ACTION_RECOVERY_CHUNK, self._handle_recovery_chunk)
         transport.register_handler(ACTION_RECOVERY_TRANSLOG, self._handle_recovery_translog)
+        transport.register_handler(ACTION_RECOVERY_FINALIZE, self._handle_recovery_finalize)
         cluster_service.add_listener(self.cluster_changed)
 
     # ------------------------------------------------------------ memory control
@@ -252,7 +258,19 @@ class IndicesService:
             self._report_failed(routing, str(e))
 
     def _peer_recover(self, shard: IndexShard, state: ClusterState):
-        """Replica recovery from the primary's node (3-phase, ref: RecoverySource)."""
+        """Replica recovery from the primary's node — the reference's 3 phases
+        (ref: indices/recovery/RecoverySource.java:119-264):
+
+        phase 1  manifest diffed by checksum, then CHUNKED file pulls with a
+                 target-side byte-rate throttle (RecoverySettings.java:
+                 file_chunk_size / max_bytes_per_sec) — one giant blob per RPC
+                 would head-of-line-block the transport and spike memory
+        phase 2  translog replay from the phase-1 commit's generation while the
+                 primary keeps serving writes (generations pinned by a hold)
+        phase 3  the remaining op tail collected UNDER the primary's engine
+                 write lock — closes the lost-write window between the phase-2
+                 snapshot and live replication taking over
+        """
         group = state.routing_table.index(shard.index).shard(shard.shard_id)
         primary = group.primary
         if primary is None or not primary.assigned:
@@ -260,55 +278,190 @@ class IndicesService:
         primary_node = state.nodes.get(primary.node_id)
         if primary_node is None:
             raise SearchEngineError("primary node not in cluster")
-        # phase 1: segment files (diffed by checksum)
+        svc = self.indices[shard.index]
+        chunk_size = svc.settings.get_bytes(
+            "indices.recovery.file_chunk_size", 512 * 1024)
+        max_bps = svc.settings.get_bytes(
+            "indices.recovery.max_bytes_per_sec", 40 * 1024 * 1024)
+
+        # ---- phase 1: manifest + chunked pulls ----
         local_files = shard.engine.store.list_files()
         resp = self.transport.submit_request(
             primary_node.transport_address, ACTION_RECOVERY_FILES,
             {"index": shard.index, "shard": shard.shard_id,
              "have": {n: f["checksum"] for n, f in local_files.items()}},
             timeout=60.0)
-        store_dir = shard.engine.store.dir
-        for name, b64 in resp["files"].items():
-            with open(os.path.join(store_dir, name), "wb") as fh:
-                fh.write(base64.b64decode(b64))
-        reused = resp.get("reused", 0)
-        shard.recovery_info = {"files": len(resp["files"]), "reused": reused}
-        shard.engine.recover_from_store()
-        # phase 2/3: translog ops since the primary's snapshot
-        resp2 = self.transport.submit_request(
-            primary_node.transport_address, ACTION_RECOVERY_TRANSLOG,
-            {"index": shard.index, "shard": shard.shard_id}, timeout=60.0)
-        for op_b64 in resp2["ops"]:
-            op = TranslogOp.decode(base64.b64decode(op_b64))
-            shard.engine.apply_replicated_op(op)
-        self.logger.info("peer-recovered [%s][%d]: %d files (%d reused), %d translog ops",
-                         shard.index, shard.shard_id, len(resp["files"]), reused,
-                         len(resp2["ops"]))
+        hold = resp.get("hold")
+        try:
+            store_dir = shard.engine.store.dir
+            # stale local leftovers (a demoted former primary's higher-numbered
+            # commit, orphaned segments) would beat the copied commit in
+            # read_last_commit's max() — the store must end up EXACTLY the
+            # primary's file set
+            keep = set(resp.get("names", ()))
+            for name in list(shard.engine.store.list_files()):
+                if name not in keep:
+                    os.unlink(os.path.join(store_dir, name))
+            received = 0
+            throttle_s = 0.0
+            t0 = time.monotonic()
+            for name, length, checksum in resp["manifest"]:
+                tmp = os.path.join(store_dir, name + ".tmp")
+                with open(tmp, "wb") as fh:
+                    off = 0
+                    while off < length:
+                        n = min(chunk_size, length - off)
+                        r = self.transport.submit_request(
+                            primary_node.transport_address, ACTION_RECOVERY_CHUNK,
+                            {"index": shard.index, "shard": shard.shard_id,
+                             "name": name, "offset": off, "length": n,
+                             "hold": hold},
+                            timeout=60.0)
+                        data = base64.b64decode(r["data"])
+                        if not data:
+                            raise SearchEngineError(
+                                f"short read recovering [{name}] at {off}")
+                        fh.write(data)
+                        off += len(data)
+                        received += len(data)
+                        if max_bps and max_bps > 0:
+                            # target-side throttle: pace total bytes against the
+                            # budget (RecoverySettings.rateLimiter equivalent)
+                            ahead = received / max_bps - (time.monotonic() - t0)
+                            if ahead > 0:
+                                time.sleep(ahead)
+                                throttle_s += ahead
+                if _crc_file(tmp) != checksum:
+                    raise SearchEngineError(
+                        f"checksum mismatch recovering [{name}]")
+                os.replace(tmp, os.path.join(store_dir, name))
+            reused = resp.get("reused", 0)
+            shard.recovery_info = {
+                "files": len(resp["manifest"]), "reused": reused,
+                "bytes": received, "throttle_ms": int(throttle_s * 1000)}
+            shard.engine.recover_from_store()
+
+            # ---- phase 2: translog from the phase-1 commit's generation ----
+            resp2 = self.transport.submit_request(
+                primary_node.transport_address, ACTION_RECOVERY_TRANSLOG,
+                {"index": shard.index, "shard": shard.shard_id,
+                 "from_gen": resp.get("base_gen"), "hold": hold}, timeout=60.0)
+            for op_b64 in resp2["ops"]:
+                op = TranslogOp.decode(base64.b64decode(op_b64))
+                shard.engine.apply_replicated_op(op)
+
+            # ---- phase 3: final tail under the primary's write lock ----
+            resp3 = self.transport.submit_request(
+                primary_node.transport_address, ACTION_RECOVERY_FINALIZE,
+                {"index": shard.index, "shard": shard.shard_id,
+                 "gen": resp2["gen"], "count": resp2["count"], "hold": hold},
+                timeout=60.0)
+            hold = None  # finalize released it primary-side
+            for op_b64 in resp3["ops"]:
+                op = TranslogOp.decode(base64.b64decode(op_b64))
+                shard.engine.apply_replicated_op(op)
+            self.logger.info(
+                "peer-recovered [%s][%d]: %d files (%d reused, %d bytes, "
+                "throttled %.0fms), %d + %d translog ops",
+                shard.index, shard.shard_id, len(resp["manifest"]), reused,
+                received, throttle_s * 1000, len(resp2["ops"]),
+                len(resp3["ops"]))
+        finally:
+            if hold is not None:
+                # recovery died mid-flight: release the primary's translog pin
+                # eagerly instead of waiting out the TTL
+                try:
+                    self.transport.submit_request(
+                        primary_node.transport_address, ACTION_RECOVERY_FINALIZE,
+                        {"index": shard.index, "shard": shard.shard_id,
+                         "release_only": True, "hold": hold}, timeout=10.0)
+                except SearchEngineError:
+                    pass  # TTL expiry cleans up
 
     def _handle_recovery_files(self, request, channel):
-        """Primary side of phase 1: flush, diff, stream missing files."""
+        """Primary side of phase 1: flush, diff by checksum, return the manifest
+        (files stream back later in chunks) + a translog hold + the commit's
+        translog generation for phase 2."""
         shard = self.shard_or_none(request["index"], request["shard"])
         if shard is None:
             raise IndexShardMissingError(f"[{request['index']}][{request['shard']}]")
-        shard.engine.flush(force=True)
-        files = shard.engine.store.list_files()
+        eng = shard.engine
+        # flush + file-name snapshot + base_gen captured atomically under the
+        # engine lock: a concurrent flush between them would roll the generation
+        # and leave ops in neither the manifest's segments nor phase 2's replay.
+        # The CRC scan runs OUTSIDE the lock (multi-GB shards must not stall
+        # indexing on it) — safe because the hold defers segment deletion and
+        # store files are write-once.
+        with eng._lock:
+            eng.flush(force=True)
+            hold = eng.acquire_recovery_hold()
+            base_gen = eng.translog.gen
+            names = [n for n in sorted(os.listdir(eng.store.dir))
+                     if os.path.isfile(os.path.join(eng.store.dir, n))
+                     and not n.endswith(".tmp")]
         have = request.get("have", {})
-        out = {}
+        manifest = []
         reused = 0
-        for name, info in files.items():
-            if have.get(name) == info["checksum"]:
+        for name in names:
+            p = os.path.join(eng.store.dir, name)
+            checksum = _crc_file(p)
+            if have.get(name) == checksum:
                 reused += 1
                 continue
-            with open(os.path.join(shard.engine.store.dir, name), "rb") as fh:
-                out[name] = base64.b64encode(fh.read()).decode("ascii")
-        return {"files": out, "reused": reused}
+            manifest.append((name, os.path.getsize(p), checksum))
+        return {"manifest": manifest, "reused": reused, "hold": hold,
+                "base_gen": base_gen, "names": names}
 
-    def _handle_recovery_translog(self, request, channel):
+    def _shard_engine(self, request):
         shard = self.shard_or_none(request["index"], request["shard"])
         if shard is None:
             raise IndexShardMissingError(f"[{request['index']}][{request['shard']}]")
-        ops = shard.engine.translog.snapshot()
-        return {"ops": [base64.b64encode(op.encode()).decode("ascii") for op in ops]}
+        return shard.engine
+
+    @staticmethod
+    def _touch_hold(eng, request):
+        """Keep the recovery hold alive as phases progress; an expired hold
+        means pinned translog/segment files may be gone — fail the recovery
+        loudly instead of serving a silently-shortened replay window."""
+        hold = request.get("hold")
+        if hold is not None and not eng.touch_recovery_hold(hold):
+            raise SearchEngineError("recovery hold expired — restart recovery")
+
+    def _handle_recovery_chunk(self, request, channel):
+        """One bounded slice of one store file (ref: RecoverySource's
+        file_chunk_size stream; the target paces the pulls)."""
+        eng = self._shard_engine(request)
+        self._touch_hold(eng, request)
+        path = os.path.join(eng.store.dir, os.path.basename(str(request["name"])))
+        with open(path, "rb") as fh:
+            fh.seek(int(request["offset"]))
+            data = fh.read(int(request["length"]))
+        return {"data": base64.b64encode(data).decode("ascii")}
+
+    def _handle_recovery_translog(self, request, channel):
+        eng = self._shard_engine(request)
+        self._touch_hold(eng, request)
+        gen = request.get("from_gen")
+        if gen is None:
+            gen = eng.translog.gen
+        ops = eng.translog.read_ops(from_gen=int(gen))
+        return {"ops": [base64.b64encode(op.encode()).decode("ascii") for op in ops],
+                "gen": int(gen), "count": len(ops)}
+
+    def _handle_recovery_finalize(self, request, channel):
+        """Phase 3 (primary side): the op tail since the phase-2 snapshot,
+        collected under the engine write lock, then the recovery hold released."""
+        eng = self._shard_engine(request)
+        try:
+            if request.get("release_only"):
+                return {"ops": []}
+            self._touch_hold(eng, request)
+            tail = eng.translog_ops_since(int(request["gen"]),
+                                          int(request["count"]))
+            return {"ops": [base64.b64encode(op.encode()).decode("ascii")
+                            for op in tail]}
+        finally:
+            eng.release_recovery_hold(request.get("hold"))
 
     # ------------------------------------------------------------ shard state
     def _report_started(self, routing: ShardRouting):
@@ -319,8 +472,6 @@ class IndicesService:
                              {"shard": routing.to_dict(), "reason": reason})
 
     def _send_to_master(self, action: str, body: dict, retries: int = 10):
-        import time
-
         for _ in range(retries):
             master = self.cluster_service.state.nodes.master
             if master is not None:

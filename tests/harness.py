@@ -54,6 +54,11 @@ class TestCluster:
                     data_path=(f"{self.data_root}/{nname}" if self.data_root
                                else None))
         node.start([node.local_node.transport_address] if not self.nodes else None)
+        # block until the join's state publish lands: a client bound to this
+        # node before then sees an EMPTY metadata (version 0) and raises
+        # IndexMissing on perfectly healthy indices (observed as a chaos-suite
+        # flake when client() picked a just-added node)
+        node.wait_for_master(timeout=15.0)
         self.nodes[nname] = node
         return node
 

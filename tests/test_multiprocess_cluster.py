@@ -1,0 +1,158 @@
+"""True multi-process cluster: N `bin/estpu` OS processes over real TCP.
+
+Every other cluster test runs N nodes in ONE process (tests/harness.py). This
+suite boots the production topology — separate interpreters, unicast seeds,
+TCP transport, HTTP — and drives it end to end: form cluster, index,
+replicate, search, kill a node, recover.
+
+ref: discovery/zen/ZenDiscovery.java:294 (the join flow this crosses a real
+process boundary for) + bootstrap/Bootstrap.java:143 (the launcher)."""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class EstpuProc:
+    """One `python -m elasticsearch_tpu` OS process with ephemeral ports."""
+
+    def __init__(self, name: str, data: str, seeds: str | None):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+               "PYTHONUNBUFFERED": "1"}
+        cmd = [sys.executable, "-m", "elasticsearch_tpu",
+               f"-Dnode.name={name}", "--data", data, "--http-port", "0",
+               "--transport", "tcp"]
+        if seeds:
+            cmd += ["--seeds", seeds]
+        self.proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                     stderr=subprocess.DEVNULL, text=True,
+                                     env=env, cwd=REPO)
+        self.name = name
+        self.transport_addr = None
+        self.http_port = None
+
+    def wait_started(self, timeout: float = 90.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            line = self.proc.stdout.readline()
+            if not line:
+                if self.proc.poll() is not None:
+                    raise RuntimeError(f"{self.name} died rc={self.proc.returncode}")
+                time.sleep(0.1)
+                continue
+            m = re.search(r"started — transport (\S+), http port (\d+)", line)
+            if m:
+                self.transport_addr = m.group(1).rstrip(",")
+                self.http_port = int(m.group(2))
+                return self
+        raise TimeoutError(f"{self.name} did not start in {timeout}s")
+
+    def kill(self):
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGKILL)
+            self.proc.wait(timeout=10)
+
+    def terminate(self):
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                self.kill()
+
+
+def _req(port: int, method: str, path: str, body=None, timeout=15.0):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return json.loads(e.read() or b"{}")
+
+
+def _wait_status(port: int, want: set, index=None, timeout: float = 60.0):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            path = f"/_cluster/health{'/' + index if index else ''}"
+            last = _req(port, "GET", path, timeout=5.0)
+            if last.get("status") in want:
+                return last
+        except Exception:  # noqa: BLE001 — node may still be booting
+            pass
+        time.sleep(0.5)
+    raise TimeoutError(f"cluster never reached {want}: {last}")
+
+
+def test_three_process_cluster_lifecycle(tmp_path):
+    procs: list[EstpuProc] = []
+    try:
+        n1 = EstpuProc("mp1", str(tmp_path / "mp1"), None)
+        procs.append(n1)
+        n1.wait_started()
+        seed = n1.transport_addr
+        n2 = EstpuProc("mp2", str(tmp_path / "mp2"), seed)
+        n3 = EstpuProc("mp3", str(tmp_path / "mp3"), seed)
+        procs += [n2, n3]
+        n2.wait_started()
+        n3.wait_started()
+
+        # cluster forms across process boundaries
+        h = _wait_status(n1.http_port, {"green", "yellow"})
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            h = _req(n1.http_port, "GET", "/_cluster/health", timeout=5.0)
+            if h.get("number_of_nodes") == 3:
+                break
+            time.sleep(0.5)
+        assert h["number_of_nodes"] == 3, h
+
+        # index with replicas spread over the processes
+        r = _req(n1.http_port, "PUT", "/mpidx", {"settings": {
+            "number_of_shards": 2, "number_of_replicas": 1}})
+        assert r.get("acknowledged") is True, r
+        _wait_status(n1.http_port, {"green"}, index="mpidx")
+        for i in range(30):
+            r = _req(n2.http_port, "PUT", f"/mpidx/doc/{i}",
+                     {"n": i, "body": f"payload {i}"})
+            assert r.get("_id") == str(i), r
+        _req(n1.http_port, "POST", "/mpidx/_refresh")
+
+        # search served from a DIFFERENT process than the writer used
+        r = _req(n3.http_port, "GET", "/mpidx/_search",
+                 {"query": {"match_all": {}}, "size": 0})
+        assert r["hits"]["total"] == 30, r
+
+        # kill a process hard; the survivors promote/reallocate back to green
+        victims = [p for p in procs if p is not n1]
+        victims[0].kill()
+        _wait_status(n1.http_port, {"green"}, index="mpidx", timeout=90.0)
+        r = _req(n1.http_port, "GET", "/mpidx/_search",
+                 {"query": {"match_all": {}}, "size": 0})
+        assert r["hits"]["total"] == 30, r
+
+        # the cluster still accepts writes after the node loss
+        r = _req(n1.http_port, "PUT", "/mpidx/doc/after",
+                 {"n": 99, "body": "post-failure write"})
+        assert r.get("_version") == 1, r
+        _req(n1.http_port, "POST", "/mpidx/_refresh")
+        r = _req(n1.http_port, "GET", "/mpidx/_count",
+                 {"query": {"match_all": {}}})
+        assert r["count"] == 31, r
+    finally:
+        for p in procs:
+            p.terminate()

@@ -1,0 +1,107 @@
+"""Peer recovery under concurrent indexing — the lost-write hunt.
+
+ref: indices/recovery/RecoverySource.java:119-264 (3 phases: chunked file copy,
+translog replay, final catch-up under the engine write lock) and
+RecoverySettings.java:1 (file_chunk_size / max_bytes_per_sec).
+
+The dangerous window: an op that (a) misses the phase-2 translog snapshot and
+(b) was live-replicated before the replica could apply it. Phase 3 collects the
+tail under the primary's write lock, so nothing can fall between the snapshot
+and live replication taking over. This suite indexes CONTINUOUSLY while a
+replica peer-recovers, then diffs primary vs replica doc-for-doc — across
+seeds (set ESTPU_RECOVERY_SEEDS to widen; the VERDICT gate ran 100)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from tests.harness import TestCluster
+
+N_SEEDS = int(os.environ.get("ESTPU_RECOVERY_SEEDS", 5))
+
+
+def _shard_docs(node, index):
+    """(id -> version) across every STARTED local shard copy of `index`."""
+    svc = node.indices.indices.get(index)
+    out = {}
+    if svc is None:
+        return out
+    for sid, shard in svc.shards.items():
+        shard.engine.refresh()
+        searcher = shard.engine.acquire_searcher()
+        for seg in searcher.segments:
+            live = seg.live & seg.parent_mask
+            for local in live.nonzero()[0]:
+                out[(sid, seg.ids[local])] = int(seg.versions[local])
+    return out
+
+
+@pytest.mark.parametrize("seed", range(N_SEEDS))
+def test_no_lost_writes_during_replica_recovery(tmp_path, seed):
+    with TestCluster(n_nodes=1, data_root=tmp_path / str(seed),
+                     name=f"rw{seed}", seed=seed) as cluster:
+        client = cluster.client()
+        client.create_index("journal", {"settings": {
+            "number_of_shards": 2, "number_of_replicas": 1,
+            # small chunks so the file phase takes multiple round-trips while
+            # the writer keeps indexing (exercises the hold + phase 3 path)
+            "indices.recovery.file_chunk_size": "2kb"}})
+        client.cluster_health(wait_for_status="yellow")
+        for i in range(60):
+            client.index("journal", "doc", {"n": i, "body": f"pre {i}"},
+                         id=f"pre-{i}")
+        client.flush("journal")
+
+        stop = threading.Event()
+        written: dict = {}
+        errors: list = []
+
+        def writer():
+            j = 0
+            rng_node = cluster.nodes[next(iter(cluster.nodes))]
+            c = rng_node.client()
+            while not stop.is_set():
+                try:
+                    r = c.index("journal", "doc",
+                                {"n": j, "body": f"live {j}"}, id=f"live-{j}")
+                    written[f"live-{j}"] = r["_version"]
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+                j += 1
+                time.sleep(0.002)
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        # the second node joins mid-write-storm: replicas INITIALIZE and
+        # peer-recover from the primaries while ops keep flowing
+        n2 = cluster.add_node()
+        cluster.ensure_green("journal", timeout=60.0)
+        time.sleep(0.1)
+        stop.set()
+        t.join(timeout=10.0)
+        assert not errors, errors[:3]
+        # let in-flight replication drain, then force visibility everywhere
+        time.sleep(0.3)
+        client.refresh("journal")
+
+        nodes = list(cluster.nodes.values())
+        assert len(nodes) == 2
+        docs_a = _shard_docs(nodes[0], "journal")
+        docs_b = _shard_docs(nodes[1], "journal")
+        # every shard has one copy on each node (2 shards × 1 replica):
+        # the doc-for-doc diff IS the lost-write detector
+        assert set(docs_a) == set(docs_b), (
+            f"doc set diverged: only-primary={set(docs_a) ^ set(docs_b)}")
+        for key in docs_a:
+            assert docs_a[key] == docs_b[key], (
+                f"version diverged on {key}: {docs_a[key]} vs {docs_b[key]}")
+        # sanity: the writer actually raced the recovery
+        assert len(written) > 10
+        # recovery really went through the chunked path
+        rec = [s.recovery_info for svc in n2.indices.indices.values()
+               for s in svc.shards.values()
+               if getattr(s, "recovery_info", None)]
+        assert any(r.get("bytes", 0) > 0 for r in rec), rec
