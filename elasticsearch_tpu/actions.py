@@ -86,6 +86,7 @@ A_PUT_TEMPLATE = "indices:admin/template/put"
 A_DELETE_TEMPLATE = "indices:admin/template/delete"
 A_CLUSTER_SETTINGS = "cluster:admin/settings/update"
 A_REROUTE = "cluster:admin/reroute"
+A_SHUTDOWN_NODE = "cluster:admin/nodes/shutdown"
 A_MAPPING_UPDATED = "internal:cluster/mapping_updated"
 
 A_INDEX_PRIMARY = "indices:data/write/index[p]"
@@ -197,6 +198,53 @@ class ActionModule:
 
         t.register_handler(A_CLIENT_NODES, self._s_client_nodes, executor="management")
         t.register_handler(A_CLIENT_EXEC, self._s_client_exec, executor="generic")
+        t.register_handler(A_SHUTDOWN_NODE, self._s_shutdown_node,
+                           executor="management")
+
+    # ================= node shutdown =================
+    def nodes_shutdown(self, node_ids=None, delay_s: float = 0.2) -> dict:
+        """ref: TransportNodesShutdownAction — fan a shutdown order to the
+        resolved nodes; each closes itself after `delay` (so the ack can make
+        it back out first). node_ids: None/_all, _local, _master, or ids/names."""
+        state = self.cluster_service.state
+        targets = []
+        spec = node_ids
+        if spec in (None, "", "_all"):
+            targets = list(state.nodes.nodes)
+        else:
+            wanted = [s.strip() for s in str(spec).split(",") if s.strip()]
+            for w in wanted:
+                if w == "_local":
+                    targets.append(state.nodes.get(self.node.local_node.id))
+                elif w == "_master":
+                    targets.append(state.nodes.master)
+                else:
+                    targets.extend(n for n in state.nodes.nodes
+                                   if n.id == w or n.name == w)
+        targets = [t2 for t2 in targets if t2 is not None]
+        acked = {}
+        for n in targets:
+            try:
+                self.transport.submit_request(
+                    n, A_SHUTDOWN_NODE, {"delay_s": delay_s}, timeout=10.0)
+                acked[n.id] = {"name": n.name}
+            except SearchEngineError:
+                pass  # already gone — shutdown is best-effort, like the reference
+        return {"cluster_name": state.cluster_name, "nodes": acked}
+
+    def _s_shutdown_node(self, request, channel):
+        delay = float(request.get("delay_s", 0.2))
+
+        def _close():
+            time.sleep(delay)
+            try:
+                self.node.close()
+            except Exception:  # noqa: BLE001 — shutdown must not raise upward
+                pass
+
+        threading.Thread(target=_close, daemon=True,
+                         name=f"estpu-shutdown[{self.node.name}]").start()
+        return {"ok": True}
 
     # ================= transport-client proxy =================
     def _s_client_nodes(self, request, channel):

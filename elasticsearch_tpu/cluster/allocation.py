@@ -47,6 +47,11 @@ class Decider:
     def can_allocate(self, shard: ShardRouting, node_id: str, ctx: "AllocationContext") -> str:
         return YES
 
+    def can_rebalance(self, shard: ShardRouting, ctx: "AllocationContext") -> str:
+        """May this STARTED shard start relocating at all? (target-node fitness
+        is can_allocate's job — ref: AllocationDecider.canRebalance)."""
+        return YES
+
 
 class SameShardDecider(Decider):
     name = "same_shard"
@@ -165,6 +170,99 @@ class DiskThresholdDecider(Decider):
         return NO if usage >= high else YES
 
 
+class ShardsLimitDecider(Decider):
+    """ref: ShardsLimitAllocationDecider.java — per-index cap on shards per
+    node (index.routing.allocation.total_shards_per_node, -1 = unlimited)."""
+
+    name = "shards_limit"
+
+    def can_allocate(self, shard, node_id, ctx):
+        limit = ctx.index_settings(shard.index).get_int(
+            "index.routing.allocation.total_shards_per_node", -1)
+        if limit is None or limit <= 0:
+            return YES
+        on_node = sum(1 for s in ctx.shards_on_node(node_id)
+                      if s.index == shard.index)
+        return NO if on_node >= limit else YES
+
+
+class SnapshotInProgressDecider(Decider):
+    """ref: SnapshotInProgressAllocationDecider.java — a shard whose index is
+    being snapshotted must not move (the snapshot streams the primary's store;
+    relocation would yank the files out from under it)."""
+
+    name = "snapshot_in_progress"
+
+    def can_rebalance(self, shard, ctx):
+        return NO if shard.index in ctx.snapshotting else YES
+
+    def can_allocate(self, shard, node_id, ctx):
+        # new UNASSIGNED copies are fine (they recover from the primary without
+        # moving it); only the relocation of an existing copy is gated, which
+        # can_rebalance already covers — mirror the reference's scope
+        return YES
+
+
+class NodeVersionDecider(Decider):
+    """ref: NodeVersionAllocationDecider.java — during a rolling upgrade a
+    replica must never land on an OLDER node than its primary's: segments only
+    stream forward-compatibly."""
+
+    name = "node_version"
+
+    def can_allocate(self, shard, node_id, ctx):
+        target = ctx.state.nodes.get(node_id)
+        if target is None:
+            return NO
+        if shard.primary:
+            return YES
+        group = ctx.state.routing_table.index(shard.index).shard(shard.shard_id)
+        p = group.primary
+        if p is None or not p.assigned:
+            return YES
+        pnode = ctx.state.nodes.get(p.node_id)
+        if pnode is None:
+            return YES
+        return NO if target.version_id < pnode.version_id else YES
+
+
+class ClusterRebalanceDecider(Decider):
+    """ref: ClusterRebalanceAllocationDecider.java —
+    cluster.routing.allocation.allow_rebalance:
+      always | indices_primaries_active | indices_all_active (default)."""
+
+    name = "cluster_rebalance"
+
+    def can_rebalance(self, shard, ctx):
+        mode = ctx.settings.get_str(
+            "cluster.routing.allocation.allow_rebalance", "indices_all_active")
+        if mode == "always":
+            return YES
+        shards = list(ctx.state.routing_table.all_shards())
+        if mode == "indices_primaries_active":
+            ok = all(s.active for s in shards if s.primary)
+        else:  # indices_all_active
+            ok = all(s.active for s in shards)
+        return YES if ok else NO
+
+
+class ConcurrentRebalanceDecider(Decider):
+    """ref: ConcurrentRebalanceAllocationDecider.java —
+    cluster.routing.allocation.cluster_concurrent_rebalance (default 2)
+    bounds in-flight relocations cluster-wide."""
+
+    name = "concurrent_rebalance"
+
+    def can_rebalance(self, shard, ctx):
+        limit = ctx.settings.get_int(
+            "cluster.routing.allocation.cluster_concurrent_rebalance", 2)
+        if limit is None or limit < 0:
+            return YES
+        relocating = sum(1 for s in ctx.state.routing_table.all_shards()
+                         if s.state == RELOCATING)
+        return THROTTLE if relocating >= limit else YES
+
+
 DEFAULT_DECIDERS = (
     SameShardDecider(),
     ReplicaAfterPrimaryDecider(),
@@ -173,15 +271,22 @@ DEFAULT_DECIDERS = (
     AwarenessDecider(),
     ThrottlingDecider(),
     DiskThresholdDecider(),
+    ShardsLimitDecider(),
+    SnapshotInProgressDecider(),
+    NodeVersionDecider(),
+    ClusterRebalanceDecider(),
+    ConcurrentRebalanceDecider(),
 )
 
 
 class AllocationContext:
     def __init__(self, state: ClusterState, settings: Settings,
-                 disk_usages: dict | None = None):
+                 disk_usages: dict | None = None,
+                 snapshotting: set | None = None):
         self.state = state
         self.settings = settings
         self.disk_usages = disk_usages or {}
+        self.snapshotting = snapshotting or set()  # index names being snapshotted
         self._by_node: dict[str, list[ShardRouting]] = {}
         for s in state.routing_table.all_shards():
             if s.node_id:
@@ -211,12 +316,25 @@ class AllocationService:
         self.deciders = deciders
         self.logger = get_logger("cluster.allocation")
         self.disk_usages: dict[str, float] = {}
+        # index names with a snapshot in flight (SnapshotsService maintains;
+        # read by SnapshotInProgressDecider)
+        self.snapshotting_indices: set[str] = set()
 
     # --- decider chain ------------------------------------------------------
     def _decide(self, shard: ShardRouting, node_id: str, ctx: AllocationContext) -> str:
         throttled = False
         for d in self.deciders:
             v = d.can_allocate(shard, node_id, ctx)
+            if v == NO:
+                return NO
+            if v == THROTTLE:
+                throttled = True
+        return THROTTLE if throttled else YES
+
+    def _decide_rebalance(self, shard: ShardRouting, ctx: AllocationContext) -> str:
+        throttled = False
+        for d in self.deciders:
+            v = d.can_rebalance(shard, ctx)
             if v == NO:
                 return NO
             if v == THROTTLE:
@@ -232,8 +350,12 @@ class AllocationService:
 
     # --- operations ---------------------------------------------------------
     def reroute(self, state: ClusterState) -> ClusterState:
-        """Assign as many UNASSIGNED shards as deciders allow; primaries first."""
-        ctx = AllocationContext(state, self._merged_settings(state), self.disk_usages)
+        """Assign as many UNASSIGNED shards as deciders allow (primaries
+        first), then consider REBALANCING started replicas from heavy nodes to
+        light ones (ref: BalancedShardsAllocator.balance, gated by the
+        can_rebalance chain)."""
+        ctx = AllocationContext(state, self._merged_settings(state),
+                                self.disk_usages, self.snapshotting_indices)
         data_nodes = [n.id for n in state.nodes.data_nodes()]
         if not data_nodes:
             return state
@@ -262,9 +384,47 @@ class AllocationService:
                         changed = True
                 groups.append(shards)
             new_tables[name] = groups
+        changed = self._rebalance(ctx, data_nodes, new_tables) or changed
         if not changed:
             return state
         return self._rebuild(state, new_tables)
+
+    def _rebalance(self, ctx: AllocationContext, data_nodes: list,
+                   new_tables: dict) -> bool:
+        """One relocation per reroute when the node weights are lopsided:
+        the heaviest node's most movable STARTED replica relocates to the
+        lightest node (source → RELOCATING, a target copy INITIALIZING with
+        relocating_node back-pointers — the reference's relocation pair).
+        Primaries stay put (a deliberate simplification: primary relocation
+        needs dual-primary handling the write path doesn't model)."""
+        if len(data_nodes) < 2:
+            return False
+        threshold = ctx.settings.get_float(
+            "cluster.routing.allocation.balance.threshold", 1.0)
+        counts = {nid: len(ctx.shards_on_node(nid)) for nid in data_nodes}
+        heavy = max(data_nodes, key=lambda n: (counts[n], n))
+        light = min(data_nodes, key=lambda n: (counts[n], n))
+        if counts[heavy] - counts[light] <= max(threshold, 1.0):
+            return False
+        for name, groups in new_tables.items():
+            for shards in groups:
+                for i, s in enumerate(shards):
+                    if (s.state != STARTED or s.primary or s.node_id != heavy
+                            or s.relocating_node is not None):
+                        continue
+                    if self._decide_rebalance(s, ctx) != YES:
+                        continue
+                    if self._decide(s, light, ctx) != YES:
+                        continue
+                    shards[i] = replace(s, state=RELOCATING,
+                                        relocating_node=light)
+                    target = replace(s, node_id=light, state=INITIALIZING,
+                                     relocating_node=heavy)
+                    shards.append(target)
+                    ctx.replace_shard(s, shards[i])
+                    ctx._by_node.setdefault(light, []).append(target)
+                    return True
+        return False
 
     def apply_started_shards(self, state: ClusterState, started: list[ShardRouting]) -> ClusterState:
         keys = {(s.index, s.shard_id, s.node_id) for s in started}
@@ -274,12 +434,23 @@ class AllocationService:
             groups = []
             for grp in table.shards:
                 shards = []
+                drop_relocation_sources = set()  # node ids whose handoff completed
                 for s in grp.shards:
                     if s.state == INITIALIZING and (s.index, s.shard_id, s.node_id) in keys:
-                        shards.append(replace(s, state=STARTED))
+                        if s.relocating_node is not None:
+                            # relocation target caught up: it takes over and the
+                            # RELOCATING source copy retires (ref: routing
+                            # relocation completion)
+                            drop_relocation_sources.add(s.relocating_node)
+                        shards.append(replace(s, state=STARTED,
+                                              relocating_node=None))
                         changed = True
                     else:
                         shards.append(s)
+                if drop_relocation_sources:
+                    shards = [s for s in shards
+                              if not (s.state == RELOCATING
+                                      and s.node_id in drop_relocation_sources)]
                 groups.append(shards)
             new_tables[name] = groups
         if not changed:
@@ -288,12 +459,34 @@ class AllocationService:
 
     def apply_failed_shard(self, state: ClusterState, failed: ShardRouting) -> ClusterState:
         """Remove the failed copy; promote an active replica when a primary dies;
-        schedule a fresh UNASSIGNED copy (ref: AllocationService.applyFailedShard:91)."""
+        schedule a fresh UNASSIGNED copy (ref: AllocationService.applyFailedShard:91).
+        Relocation pairs unwind: a failed TARGET reverts its source to STARTED;
+        a failed SOURCE also drops its half-recovered target."""
         new_tables = {}
         for name, table in state.routing_table.indices:
             groups = []
             for grp in table.shards:
                 shards = list(grp.shards)
+                hit = next((s for s in shards
+                            if (s.index, s.shard_id, s.node_id)
+                            == (failed.index, failed.shard_id, failed.node_id)), None)
+                if (hit is not None and hit.state == INITIALIZING
+                        and hit.relocating_node is not None):
+                    # failed relocation target: revert the source, drop the target
+                    shards = [
+                        (replace(s, state=STARTED, relocating_node=None)
+                         if s.state == RELOCATING and s.node_id == hit.relocating_node
+                         else s)
+                        for s in shards if s is not hit
+                    ]
+                    groups.append(shards)
+                    continue
+                if (hit is not None and hit.state == RELOCATING
+                        and hit.relocating_node is not None):
+                    # failed relocation source: its half-recovered target dies too
+                    shards = [s for s in shards
+                              if not (s.state == INITIALIZING
+                                      and s.node_id == hit.relocating_node)]
                 for i, s in enumerate(shards):
                     if (s.index, s.shard_id, s.node_id) == (failed.index, failed.shard_id, failed.node_id):
                         was_primary = s.primary

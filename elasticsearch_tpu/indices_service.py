@@ -29,7 +29,8 @@ from .index.store import _crc_file
 from .index.translog import TranslogOp, CREATE, INDEX, DELETE
 from .mapper import MapperService
 from .search.similarity import SimilarityService
-from .cluster.state import INITIALIZING, STARTED, ClusterState, ShardRouting
+from .cluster.state import (INITIALIZING, RELOCATING, STARTED, ClusterState,
+                            ShardRouting)
 
 ACTION_SHARD_STARTED = "internal:cluster/shard/started"
 ACTION_SHARD_FAILED = "internal:cluster/shard/failed"
@@ -203,7 +204,10 @@ class IndicesService:
         # 2. per assigned shard on this node: create + recover
         my_shards: dict[tuple, ShardRouting] = {}
         for s in state.routing_table.all_shards():
-            if s.node_id == self.node_id and s.state in (INITIALIZING, STARTED):
+            if s.node_id == self.node_id and s.state in (INITIALIZING, STARTED,
+                                                         RELOCATING):
+                # RELOCATING included: the source keeps serving (and feeding the
+                # target's recovery) until the handoff completes
                 my_shards[(s.index, s.shard_id)] = s
         # remove local shards no longer assigned here
         for name, svc in list(self.indices.items()):

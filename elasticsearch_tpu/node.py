@@ -639,8 +639,14 @@ class Client:
 
     def _health(self, index=None):
         state = self.node.cluster_service.state
-        shards = [s for s in state.routing_table.all_shards()
-                  if index is None or s.index == index]
+        all_shards = [s for s in state.routing_table.all_shards()
+                      if index is None or s.index == index]
+        # relocation TARGETS are surplus copies of an already-active shard:
+        # they must not drag status to yellow (the reference stays green while
+        # relocating — the group's required copies are all active)
+        shards = [s for s in all_shards
+                  if not (s.state == "INITIALIZING"
+                          and s.relocating_node is not None)]
         total = len(shards)
         active = sum(1 for s in shards if s.active)
         primaries = [s for s in shards if s.primary]
@@ -779,6 +785,68 @@ class Client:
             "search_serving": serving,
             **self.node.monitor.full_stats(),
         }}}
+
+    def cluster_stats(self):
+        """ref: action/admin/cluster/stats/TransportClusterStatsAction — the
+        cluster-wide rollup: index/shard/doc counts aggregated by fanning the
+        per-node stats through the client-exec proxy, node counts from state."""
+        from .client import A_CLIENT_EXEC
+
+        state = self.node.cluster_service.state
+        shards = list(state.routing_table.all_shards())
+        doc_count = deleted = segments = 0
+        per_node = {}
+        for n in state.nodes.nodes:
+            try:
+                if n.id == self.node.node_id:
+                    per_node[n.id] = self.nodes_stats()["nodes"][n.id]
+                else:
+                    r = self.node.transport.submit_request(
+                        n, A_CLIENT_EXEC, {"method": "nodes_stats"},
+                        timeout=10.0)
+                    per_node[n.id] = r["r"]["nodes"][n.id]
+            except SearchEngineError:
+                continue  # a dropping node must not fail the rollup
+        for stats in per_node.values():
+            for idx in stats.get("indices", {}).values():
+                for shard in idx.get("shards", {}).values():
+                    if not shard.get("primary"):
+                        continue  # docs count primaries only (reference)
+                    doc_count += shard.get("docs", {}).get("count", 0)
+                    deleted += shard.get("docs", {}).get("deleted", 0)
+                    segments += shard.get("segments", 0)
+        nodes = state.nodes.nodes
+        count = {
+            "total": len(nodes),
+            "master_only": sum(1 for n in nodes if n.master_eligible and not n.data),
+            "data_only": sum(1 for n in nodes if n.data and not n.master_eligible),
+            "master_data": sum(1 for n in nodes if n.master_eligible and n.data),
+            "client": sum(1 for n in nodes if not n.master_eligible and not n.data),
+        }
+        return {
+            "timestamp": int(time.time() * 1000),
+            "cluster_name": state.cluster_name,
+            "status": self._health()["status"],
+            "indices": {
+                "count": len(state.metadata.index_names()),
+                "shards": {
+                    "total": len(shards),
+                    "primaries": sum(1 for s in shards if s.primary),
+                    "replication": (
+                        (len(shards) - sum(1 for s in shards if s.primary))
+                        / max(sum(1 for s in shards if s.primary), 1)),
+                },
+                "docs": {"count": doc_count, "deleted": deleted},
+                "segments": {"count": segments},
+            },
+            "nodes": {
+                "count": count,
+                "versions": sorted({str(n.version_id) for n in nodes}),
+            },
+        }
+
+    def nodes_shutdown(self, node_ids=None, delay_s: float = 0.2):
+        return self.node.actions.nodes_shutdown(node_ids, delay_s=delay_s)
 
     # --- percolate ----------------------------------------------------------
     def percolate(self, index, body):

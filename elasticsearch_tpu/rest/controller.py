@@ -816,6 +816,16 @@ def build_rest_controller(node) -> RestController:
                                                index=r.path_params["index"],
                                                index_templates=r.param("index_templates")))
     rc.register("GET", "/_cluster/pending_tasks", lambda r: client.pending_tasks())
+    rc.register("GET", "/_cluster/stats", lambda r: client.cluster_stats())
+    rc.register("GET", "/_cluster/stats/nodes/{node_id}",
+                lambda r: client.cluster_stats())
+    # node shutdown (ref: cluster.nodes.shutdown spec + RestNodesShutdownAction)
+    rc.register("POST", "/_shutdown",
+                lambda r: client.nodes_shutdown(None))
+    rc.register("POST", "/_cluster/nodes/_shutdown",
+                lambda r: client.nodes_shutdown(None))
+    rc.register("POST", "/_cluster/nodes/{node_id}/_shutdown",
+                lambda r: client.nodes_shutdown(r.path_params["node_id"]))
     rc.register("PUT", "/_cluster/settings",
                 lambda r: client.cluster_update_settings(
                     _parse_body(r), flat=r.bool_param("flat_settings")))

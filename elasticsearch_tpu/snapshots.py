@@ -310,6 +310,27 @@ class SnapshotsService:
             "start_time_ms": int(t0 * 1000), "indices": {},
         }
         failures = []
+        # gate rebalancing of these indices while their primaries stream out
+        # (SnapshotInProgressAllocationDecider reads this set)
+        alloc = getattr(self.node, "allocation", None)
+        if alloc is not None:
+            alloc.snapshotting_indices.update(indices)
+        try:
+            failures = self._snapshot_indices(state, indices, repo, meta)
+        finally:
+            if alloc is not None:
+                alloc.snapshotting_indices.difference_update(indices)
+        meta["state"] = "SUCCESS" if not failures else "PARTIAL"
+        meta["failures"] = failures
+        meta["end_time_ms"] = int(time.time() * 1000)
+        repo.write_snapshot(snapshot, meta)
+        return {"snapshot": {"snapshot": snapshot, "state": meta["state"],
+                             "indices": list(meta["indices"]),
+                             "failures": failures,
+                             "duration_in_millis": meta["end_time_ms"] - meta["start_time_ms"]}}
+
+    def _snapshot_indices(self, state, indices, repo, meta) -> list:
+        failures = []
         for index in indices:
             imeta = state.metadata.index(index)
             table = state.routing_table.index(index)
@@ -328,14 +349,7 @@ class SnapshotsService:
                 except SearchEngineError as e:
                     failures.append(f"[{index}][{primary.shard_id}] {e}")
             meta["indices"][index] = entry
-        meta["state"] = "SUCCESS" if not failures else "PARTIAL"
-        meta["failures"] = failures
-        meta["end_time_ms"] = int(time.time() * 1000)
-        repo.write_snapshot(snapshot, meta)
-        return {"snapshot": {"snapshot": snapshot, "state": meta["state"],
-                             "indices": list(meta["indices"]),
-                             "failures": failures,
-                             "duration_in_millis": meta["end_time_ms"] - meta["start_time_ms"]}}
+        return failures
 
     def _handle_snapshot_shard(self, request, channel):
         """Data-node side: flush + copy this shard's files into the repo (incremental)."""

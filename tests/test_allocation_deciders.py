@@ -1,0 +1,227 @@
+"""Allocation decider chain + rebalancing — pure functions over fake
+ClusterStates (the reference's ElasticsearchAllocationTestCase trick).
+
+ref: cluster/routing/allocation/decider/ShardsLimitAllocationDecider.java,
+SnapshotInProgressAllocationDecider.java, NodeVersionAllocationDecider.java,
+ClusterRebalanceAllocationDecider.java,
+ConcurrentRebalanceAllocationDecider.java and
+allocator/BalancedShardsAllocator.java's rebalance step."""
+
+import pytest
+
+from elasticsearch_tpu.cluster.allocation import (
+    AllocationService,
+    new_index_routing,
+)
+from elasticsearch_tpu.cluster.state import (
+    INITIALIZING,
+    RELOCATING,
+    STARTED,
+    UNASSIGNED,
+    ClusterState,
+    DiscoveryNode,
+    DiscoveryNodes,
+    IndexMetaData,
+    MetaData,
+    RoutingTable,
+)
+from elasticsearch_tpu.common.settings import Settings
+
+
+def _node(i, version_id=10000, attrs=()):
+    return DiscoveryNode(id=f"n{i}", name=f"n{i}", transport_address=f"local://n{i}",
+                         attrs=attrs, version_id=version_id)
+
+
+def _state(n_nodes=3, shards=2, replicas=1, index="idx", index_settings=None,
+           node_versions=None):
+    nodes = tuple(
+        _node(i, version_id=(node_versions or {}).get(i, 10000))
+        for i in range(n_nodes))
+    settings_map = {"index.number_of_shards": shards,
+                    "index.number_of_replicas": replicas,
+                    **(index_settings or {})}
+    meta = IndexMetaData(name=index,
+                         settings_map=tuple(settings_map.items()))
+    return ClusterState(
+        cluster_name="test",
+        nodes=DiscoveryNodes(nodes=nodes, master_id="n0", local_id="n0"),
+        metadata=MetaData(indices=((index, meta),)),
+        routing_table=RoutingTable(
+            ((index, new_index_routing(index, shards, replicas)),)),
+    )
+
+
+def _start_all(svc, state):
+    for _ in range(4):
+        state = svc.reroute(state)
+        init = [s for s in state.routing_table.all_shards()
+                if s.state == INITIALIZING and s.relocating_node is None]
+        if not init:
+            break
+        state = svc.apply_started_shards(state, init)
+    return state
+
+
+class TestShardsLimit:
+    def test_total_shards_per_node_caps_allocation(self):
+        # 4 shards x 1 copy on 2 nodes with limit 1: only 2 can place
+        svc = AllocationService()
+        state = svc.reroute(_state(
+            n_nodes=2, shards=4, replicas=0,
+            index_settings={"index.routing.allocation.total_shards_per_node": 1}))
+        assigned = [s for s in state.routing_table.all_shards() if s.assigned]
+        unassigned = [s for s in state.routing_table.all_shards()
+                      if s.state == UNASSIGNED]
+        assert len(assigned) == 2 and len(unassigned) == 2
+        per_node = {}
+        for s in assigned:
+            per_node[s.node_id] = per_node.get(s.node_id, 0) + 1
+        assert all(v == 1 for v in per_node.values())
+
+    def test_unlimited_by_default(self):
+        svc = AllocationService()
+        state = _start_all(svc, _state(n_nodes=1, shards=4, replicas=0))
+        assert all(s.state == STARTED
+                   for s in state.routing_table.all_shards())
+
+
+class TestNodeVersion:
+    def test_replica_refuses_older_node_than_primary(self):
+        # n0 new (10100), n1 old (10000): if the primary lands on n0, the
+        # replica cannot go to the older n1
+        svc = AllocationService()
+        state = _state(n_nodes=2, shards=1, replicas=1,
+                       node_versions={0: 10100, 1: 10000})
+        state = svc.reroute(state)
+        state = svc.apply_started_shards(
+            state, [s for s in state.routing_table.all_shards() if s.primary])
+        state = svc.reroute(state)
+        group = state.routing_table.index("idx").shard(0)
+        primary = group.primary
+        replica = [s for s in group.shards if not s.primary][0]
+        if primary.node_id == "n0":
+            assert replica.state == UNASSIGNED  # n1 is older — refused
+        else:
+            assert replica.assigned  # n0 is newer — fine
+
+    def test_same_version_allocates(self):
+        svc = AllocationService()
+        state = _start_all(svc, _state(n_nodes=2, shards=1, replicas=1))
+        assert all(s.state == STARTED
+                   for s in state.routing_table.all_shards())
+
+
+class TestSnapshotInProgress:
+    def test_snapshotting_index_never_rebalances(self):
+        svc = AllocationService()
+        state = _start_all(svc, _state(n_nodes=2, shards=3, replicas=1))
+        # imbalance arrives with a third empty node joining (replicas present:
+        # the rebalancer moves replicas only — primaries stay put by design)
+        state = ClusterState(
+            cluster_name=state.cluster_name,
+            nodes=DiscoveryNodes(nodes=(*state.nodes.nodes, _node(2)),
+                                 master_id="n0", local_id="n0"),
+            metadata=state.metadata, routing_table=state.routing_table,
+            version=state.version + 1)
+        svc.snapshotting_indices.add("idx")
+        state2 = svc.reroute(state)
+        assert not [s for s in state2.routing_table.all_shards()
+                    if s.state == RELOCATING]
+        svc.snapshotting_indices.clear()
+        state3 = svc.reroute(state)
+        assert [s for s in state3.routing_table.all_shards()
+                if s.state == RELOCATING]
+
+
+class TestRebalance:
+    def _imbalanced(self, svc, shards=3):
+        state = _start_all(svc, _state(n_nodes=2, shards=shards, replicas=1))
+        # a fresh empty node joins: weights are now lopsided
+        return ClusterState(
+            cluster_name=state.cluster_name,
+            nodes=DiscoveryNodes(nodes=(*state.nodes.nodes, _node(2)),
+                                 master_id="n0", local_id="n0"),
+            metadata=state.metadata, routing_table=state.routing_table,
+            version=state.version + 1)
+
+    def test_rebalance_relocates_to_new_node(self):
+        svc = AllocationService()
+        state = svc.reroute(self._imbalanced(svc))
+        relocating = [s for s in state.routing_table.all_shards()
+                      if s.state == RELOCATING]
+        targets = [s for s in state.routing_table.all_shards()
+                   if s.state == INITIALIZING and s.relocating_node is not None]
+        assert len(relocating) == 1 and len(targets) == 1
+        assert targets[0].node_id == "n2"
+        assert targets[0].relocating_node == relocating[0].node_id
+
+    def test_relocation_completes_on_target_start(self):
+        svc = AllocationService()
+        state = svc.reroute(self._imbalanced(svc))
+        target = [s for s in state.routing_table.all_shards()
+                  if s.state == INITIALIZING and s.relocating_node][0]
+        state = svc.apply_started_shards(state, [target])
+        group = state.routing_table.index("idx").shard(target.shard_id)
+        assert len(group.shards) == 2  # primary + the relocated replica
+        moved = [s for s in group.shards if not s.primary]
+        assert [s.node_id for s in moved] == ["n2"]
+        assert moved[0].state == STARTED and moved[0].relocating_node is None
+        assert not [s for s in group.shards if s.state == RELOCATING]
+
+    def test_relocation_target_failure_reverts_source(self):
+        svc = AllocationService()
+        state = svc.reroute(self._imbalanced(svc))
+        target = [s for s in state.routing_table.all_shards()
+                  if s.state == INITIALIZING and s.relocating_node][0]
+        state = svc.apply_failed_shard(state, target)
+        group = state.routing_table.index("idx").shard(target.shard_id)
+        # the data-bearing source copy survived on its original node (reverted
+        # to STARTED — the trailing reroute may legitimately retry, putting it
+        # straight back into RELOCATING with a fresh target pair)
+        src = [s for s in group.shards
+               if not s.primary and s.node_id == target.relocating_node]
+        assert len(src) == 1 and src[0].state in (STARTED, RELOCATING)
+        retry_targets = [s for s in group.shards
+                         if s.state == INITIALIZING and s.relocating_node]
+        for t in retry_targets:
+            assert t.relocating_node == src[0].node_id
+
+    def test_concurrent_rebalance_limit(self):
+        svc = AllocationService(Settings.from_flat(
+            {"cluster.routing.allocation.cluster_concurrent_rebalance": 0}))
+        state = svc.reroute(self._imbalanced(svc))
+        assert not [s for s in state.routing_table.all_shards()
+                    if s.state == RELOCATING]
+
+    def test_cluster_rebalance_waits_for_all_active(self):
+        svc = AllocationService()
+        state = self._imbalanced(svc)
+        # one shard back to UNASSIGNED: indices_all_active (default) gates
+        from dataclasses import replace as dc_replace
+
+        name, table = state.routing_table.indices[0]
+        g0 = table.shards[0]
+        broken = dc_replace(g0.shards[0], node_id=None, state=UNASSIGNED)
+        from elasticsearch_tpu.cluster.state import (IndexRoutingTable,
+                                                     IndexShardRoutingTable)
+
+        new_groups = (IndexShardRoutingTable((broken,)),) + table.shards[1:]
+        state = ClusterState(
+            cluster_name=state.cluster_name, nodes=state.nodes,
+            metadata=state.metadata,
+            routing_table=RoutingTable(
+                ((name, IndexRoutingTable(name, new_groups)),)),
+            version=state.version + 1)
+        # remove n2's capacity problem: the unassigned shard will allocate to
+        # n2 (fine) but NO relocation may start while anything is inactive
+        state2 = svc.reroute(state)
+        assert not [s for s in state2.routing_table.all_shards()
+                    if s.state == RELOCATING]
+
+    def test_balanced_cluster_does_not_thrash(self):
+        svc = AllocationService()
+        state = _start_all(svc, _state(n_nodes=2, shards=4, replicas=1))
+        state2 = svc.reroute(state)
+        assert not [s for s in state2.routing_table.all_shards()
+                    if s.state == RELOCATING]

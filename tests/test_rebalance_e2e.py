@@ -1,0 +1,99 @@
+"""Live rebalancing + cluster stats + node shutdown, end to end.
+
+ref: allocator/BalancedShardsAllocator.java (relocation pairs driven through
+real peer recovery), TransportClusterStatsAction, TransportNodesShutdownAction."""
+
+import time
+
+from tests.harness import TestCluster
+
+
+def test_node_join_triggers_relocation_to_balance(tmp_path):
+    with TestCluster(n_nodes=2, data_root=tmp_path, seed=3) as cluster:
+        client = cluster.client()
+        client.create_index("reb", {"settings": {
+            "number_of_shards": 3, "number_of_replicas": 1}})
+        cluster.ensure_green("reb")
+        for i in range(40):
+            client.index("reb", "doc", {"n": i}, id=str(i))
+        client.refresh("reb")
+
+        n3 = cluster.add_node()
+        # the join's reroute starts relocations; they complete via real peer
+        # recovery and the cluster re-greens with copies on the new node
+        deadline = time.time() + 30
+        moved = 0
+        while time.time() < deadline:
+            state = n3.cluster_service.state
+            on_n3 = [s for s in state.routing_table.all_shards()
+                     if s.node_id == n3.local_node.id and s.state == "STARTED"]
+            relocating = [s for s in state.routing_table.all_shards()
+                          if s.state == "RELOCATING"]
+            if on_n3 and not relocating:
+                moved = len(on_n3)
+                break
+            time.sleep(0.2)
+        assert moved >= 1, "no shard relocated to the new node"
+        cluster.ensure_green("reb")
+        # health stays consistent and data survived the move
+        r = cluster.client().search("reb", {"query": {"match_all": {}},
+                                            "size": 0})
+        assert r["hits"]["total"] == 40
+
+
+def test_health_stays_green_during_relocation(tmp_path):
+    with TestCluster(n_nodes=2, data_root=tmp_path, seed=5) as cluster:
+        client = cluster.client()
+        client.create_index("grn", {"settings": {
+            "number_of_shards": 3, "number_of_replicas": 1}})
+        cluster.ensure_green("grn")
+        cluster.add_node()
+        # sample health while relocations are (maybe) in flight: a relocation
+        # target must never drag status below green (reference behavior)
+        for _ in range(20):
+            h = cluster.client().cluster_health("grn")
+            assert h["status"] == "green", h
+            if h["relocating_shards"] == 0:
+                break
+            time.sleep(0.1)
+
+
+def test_cluster_stats_aggregates_across_nodes(tmp_path):
+    with TestCluster(n_nodes=2, data_root=tmp_path, seed=7) as cluster:
+        client = cluster.client()
+        client.create_index("cs", {"settings": {
+            "number_of_shards": 2, "number_of_replicas": 1}})
+        cluster.ensure_green("cs")
+        for i in range(25):
+            client.index("cs", "doc", {"n": i}, id=str(i))
+        client.refresh("cs")
+        stats = cluster.client().cluster_stats()
+        assert stats["status"] == "green"
+        assert stats["indices"]["count"] == 1
+        assert stats["indices"]["shards"]["total"] == 4
+        assert stats["indices"]["shards"]["primaries"] == 2
+        assert stats["indices"]["docs"]["count"] == 25  # primaries only
+        assert stats["nodes"]["count"]["total"] == 2
+        assert stats["nodes"]["count"]["master_data"] == 2
+
+
+def test_node_shutdown_action(tmp_path):
+    with TestCluster(n_nodes=3, data_root=tmp_path, seed=9) as cluster:
+        client = cluster.client()
+        client.create_index("sd", {"settings": {
+            "number_of_shards": 2, "number_of_replicas": 1}})
+        cluster.ensure_green("sd")
+        master = cluster.master_name()
+        victim_name = next(n for n in cluster.nodes if n != master)
+        victim = cluster.nodes[victim_name]
+        r = cluster.nodes[master].client().nodes_shutdown(victim.local_node.id)
+        assert victim.local_node.id in r["nodes"]
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            h = cluster.nodes[master].client().cluster_health("sd")
+            if h["number_of_nodes"] == 2 and h["status"] == "green":
+                break
+            time.sleep(0.2)
+        assert h["number_of_nodes"] == 2, h
+        assert h["status"] == "green", h  # replicas re-spread after the leave
+        cluster.nodes.pop(victim_name, None)  # already closed itself
