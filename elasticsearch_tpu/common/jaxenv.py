@@ -1,16 +1,28 @@
-"""JAX platform selection for this container.
+"""JAX platform selection + runtime sanitizer for this container.
 
 The image pins JAX_PLATFORMS to a real-TPU plugin and imports jax at interpreter
 startup via a sitecustomize hook, so an environ set alone does not stick — the live
 jax config must be updated too, or jax.devices() blocks initializing the TPU backend
 even when the caller wants a CPU mesh. One helper so the recipe can't drift between
 the test conftest, the driver entry, and the bench fallback.
+
+This module is the ONLY sanctioned writer of JAX_PLATFORMS / jax_platforms /
+XLA_FLAGS — tools/tpulint rule TPU005 enforces that statically.
+
+It also hosts the runtime half of the tpulint story: `sanitize()` arms
+jax.transfer_guard around a query phase and counts compile events, so tests can
+assert a per-phase compile budget and a zero-implicit-transfer invariant — the
+dynamic check backing the static TPU001/TPU002 rules (see tests/test_sanitizer.py
+and the `jax_sanitizer` conftest fixture).
 """
 
 from __future__ import annotations
 
+import contextlib
 import os
 import re
+import threading
+from dataclasses import dataclass, field
 
 
 def force_cpu_platform(n_devices: int | None = None) -> None:
@@ -33,3 +45,108 @@ def force_cpu_platform(n_devices: int | None = None) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer: transfer guard + compile-event counting
+# ---------------------------------------------------------------------------
+
+# every backend compile emits exactly one of these duration events
+# (jax 0.4.x: /jax/core/compile/backend_compile_duration); counting them is
+# backend-agnostic and — unlike parsing jax_log_compiles output — race-free
+_COMPILE_EVENT_SUBSTR = "backend_compile"
+
+
+@dataclass
+class SanitizerReport:
+    """What happened inside one sanitize() scope."""
+
+    compiles: int = 0
+    compile_events: list = field(default_factory=list)  # (event_key,) per compile
+
+    def note(self, key: str) -> None:
+        self.compiles += 1
+        self.compile_events.append(key)
+
+
+class _CompileCounter:
+    """Process-wide compile-event listener fanning out to active scopes.
+
+    jax.monitoring has register-only semantics (no unregister), so ONE listener
+    is installed lazily and forever; scopes subscribe/unsubscribe from it.
+    Thread-safe: serving runs queries from pool threads.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._installed = False
+        self._active: list[SanitizerReport] = []
+
+    def _listener(self, key: str, duration: float, **_kw) -> None:
+        if _COMPILE_EVENT_SUBSTR not in key:
+            return
+        # note() under the lock: concurrent pool-thread compiles must not lose
+        # increments, or a blown budget could pass silently
+        with self._lock:
+            for r in self._active:
+                r.note(key)
+
+    def subscribe(self, report: SanitizerReport) -> None:
+        import jax.monitoring
+
+        with self._lock:
+            if not self._installed:
+                jax.monitoring.register_event_duration_secs_listener(self._listener)
+                self._installed = True
+            self._active.append(report)
+
+    def unsubscribe(self, report: SanitizerReport) -> None:
+        with self._lock:
+            if report in self._active:
+                self._active.remove(report)
+
+
+_counter = _CompileCounter()
+
+
+class CompileBudgetExceeded(AssertionError):
+    """Raised when a sanitize(max_compiles=N) scope observed more than N
+    backend compiles — a retrace hazard made loud (tpulint TPU002's runtime
+    twin)."""
+
+
+@contextlib.contextmanager
+def sanitize(max_compiles: int | None = None, transfers: str = "disallow"):
+    """Arm the JAX runtime sanitizers around a query phase.
+
+    - transfer guard at level `transfers` ("disallow" = implicit transfers
+      raise; explicit jax.device_put/device_get stay legal, so correctly
+      batched host pulls pass while a stray float(device_scalar) fails;
+      "log" = warn only; "off" = disabled),
+    - compile-event counting: the yielded SanitizerReport carries .compiles;
+      if max_compiles is not None the scope raises CompileBudgetExceeded on
+      exit when the budget was blown.
+
+    Usage (the test-harness invariant: a warmed query path neither recompiles
+    nor implicitly transfers):
+
+        with sanitize(max_compiles=0) as rep:
+            run_query_again()
+        assert rep.compiles == 0  # implied by max_compiles=0
+    """
+    import jax
+
+    report = SanitizerReport()
+    _counter.subscribe(report)
+    guard = (jax.transfer_guard(transfers) if transfers != "off"
+             else contextlib.nullcontext())
+    try:
+        with guard:
+            yield report
+    finally:
+        _counter.unsubscribe(report)
+    if max_compiles is not None and report.compiles > max_compiles:
+        raise CompileBudgetExceeded(
+            f"compile budget exceeded: {report.compiles} backend compile(s) "
+            f"observed, budget {max_compiles} — a shape/static-arg drifted and "
+            f"the executable cache missed (events: {report.compile_events})")

@@ -535,12 +535,15 @@ def _merge_seg_hits(seg_hits, totals, Q: int, k: int) -> list[TopDocs]:
     all_scores = np.concatenate([s for (s, _d) in seg_hits], axis=1)
     all_docs = np.concatenate([d for (_s, d) in seg_hits], axis=1)
     out = []
+    totals_h = totals.tolist()
     for qi in range(Q):
         order = np.lexsort((all_docs[qi], -all_scores[qi]))[:k]
-        hits = [(float(all_scores[qi, j]), int(all_docs[qi, j]))
-                for j in order if np.isfinite(all_scores[qi, j])]
+        order = order[np.isfinite(all_scores[qi, order])]
+        # one batched pull per query, not 2k scalar conversions (tpulint TPU001)
+        hits = list(zip(all_scores[qi, order].tolist(),
+                        all_docs[qi, order].tolist()))
         out.append(TopDocs(
-            total=int(totals[qi]),
+            total=totals_h[qi],
             hits=hits,
             max_score=hits[0][0] if hits else float("nan"),
         ))
@@ -782,15 +785,18 @@ def execute_flat_sorted(plan: FlatPlan, ctx: ShardContext, k: int, spec):
         keys, docs, scores, qmax, tq = score_sorted_batch(
             packed, batch, max(k, 1), jnp.asarray(key_row), spec.reverse,
             fmask=fmask)
-        seg_total = int(tq[0])
+        # batched host pulls: one .tolist() per row instead of a float()/int()
+        # scalar conversion per hit (tpulint TPU001)
+        (seg_total,) = tq.tolist()
         total += seg_total
         if seg_total:
-            m = float(qmax[0])
+            (m,) = qmax.tolist()
             max_score = m if max_score != max_score else max(max_score, m)
-        for j in range(min(seg_total, keys.shape[1])):
-            local = int(docs[0, j])
-            cand.append((float(keys[0, j]), base + local, si, local,
-                         float(scores[0, j])))
+        n = min(seg_total, keys.shape[1])
+        cand.extend(
+            (ki, base + di, si, di, sc)
+            for ki, di, sc in zip(keys[0, :n].tolist(), docs[0, :n].tolist(),
+                                  scores[0, :n].tolist()))
     cand.sort(key=lambda e: (-e[0] if spec.reverse else e[0], e[1]))
     return total, max_score, cand[: max(k, 0)]
 
@@ -1917,7 +1923,7 @@ def _host_search(ctx: ShardContext, query: Query, k: int,
     scores = np.concatenate(all_scores)
     docs = np.concatenate(all_docs)
     order = np.lexsort((docs, -scores))[:k]
-    hits = [(float(scores[i]), int(docs[i])) for i in order]
+    hits = list(zip(scores[order].tolist(), docs[order].tolist()))
     return TopDocs(total, hits, float(scores.max()))
 
 

@@ -11,6 +11,8 @@ from elasticsearch_tpu.common.jaxenv import force_cpu_platform
 # imported at interpreter startup by a sitecustomize hook — see jaxenv.py.
 force_cpu_platform(n_devices=8)
 
+import os
+
 import numpy as np
 import pytest
 
@@ -18,3 +20,31 @@ import pytest
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)
+
+
+# Device-heavy test modules run under the runtime sanitizer
+# (common/jaxenv.sanitize): transfer-guard in "log" mode (implicit host syncs
+# show up in captured stderr without failing unrelated assertions) plus
+# compile-event counting. Set ESTPU_COMPILE_BUDGET=<n> to turn the count into
+# a hard per-test ceiling — the runtime twin of tpulint TPU001/TPU002.
+_SANITIZED_MODULES = {
+    "test_pallas_kernels",
+    "test_device_aggs",
+    "test_device_sort",
+    "test_parallel_search",
+    "test_mesh_serving",
+}
+
+
+@pytest.fixture(autouse=True)
+def jax_sanitizer(request):
+    mod = request.module.__name__.rsplit(".", 1)[-1]
+    if mod not in _SANITIZED_MODULES:
+        yield None
+        return
+    from elasticsearch_tpu.common.jaxenv import sanitize
+
+    budget = os.environ.get("ESTPU_COMPILE_BUDGET")
+    with sanitize(max_compiles=int(budget) if budget else None,
+                  transfers="log") as report:
+        yield report

@@ -1,0 +1,101 @@
+"""Runtime sanitizer (common/jaxenv.sanitize): the dynamic half of tpulint.
+
+The load-bearing invariant: a WARMED single-shard query path neither
+recompiles nor implicitly transfers — the second identical query must run
+entirely from the executable cache under jax.transfer_guard("disallow").
+This is the runtime proof behind the shape-bucketing design (ops/scoring
+_compiled_cache, device_index._pow2_bucket) that tpulint TPU002 guards
+statically.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.jaxenv import (
+    CompileBudgetExceeded,
+    SanitizerReport,
+    sanitize,
+)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index import Engine
+from elasticsearch_tpu.mapper import MapperService
+from elasticsearch_tpu.search import ShardContext, parse_query, search_shard
+from elasticsearch_tpu.search.similarity import SimilarityService
+
+DOCS = [
+    "the quick brown fox jumps over the lazy dog",
+    "quick brown foxes leap over lazy dogs in summer",
+    "the red fox and the brown bear",
+    "lazy afternoon with a quick snack",
+    "dogs and cats living together",
+    "the brown dog sleeps all day",
+]
+
+
+@pytest.fixture
+def shard_ctx(tmp_path):
+    settings = Settings.from_flat({})
+    svc = MapperService(settings)
+    e = Engine(str(tmp_path / "shard0"), svc)
+    for i, text in enumerate(DOCS):
+        e.index("doc", str(i), {"body": text})
+    e.refresh()
+    return ShardContext(e.acquire_searcher(), svc,
+                        SimilarityService(settings, mapper_service=svc))
+
+
+def test_second_identical_query_zero_recompiles(shard_ctx):
+    q = parse_query({"match": {"body": "quick brown fox"}})
+    warm = search_shard(shard_ctx, q, k=5)  # first run may compile freely
+    with sanitize(max_compiles=0, transfers="disallow") as rep:
+        again = search_shard(shard_ctx, q, k=5)
+    assert rep.compiles == 0, rep.compile_events
+    assert again.hits == warm.hits
+    assert again.total == warm.total
+
+
+def test_compile_counter_sees_fresh_compile():
+    with sanitize(transfers="off") as rep:
+        # a brand-new wrapper object can't hit any jit cache
+        jax.jit(lambda x: x * 3.25 + 1.0)(jnp.ones(7)).block_until_ready()
+    assert rep.compiles >= 1
+    assert all("compile" in e for e in rep.compile_events)
+
+
+def test_compile_budget_trips():
+    with pytest.raises(CompileBudgetExceeded):
+        with sanitize(max_compiles=0, transfers="off"):
+            jax.jit(lambda x: x * 2.5 - 3.0)(jnp.ones(5)).block_until_ready()
+
+
+def test_transfer_guard_blocks_implicit_pull():
+    x = jnp.arange(8, dtype=jnp.float32)
+    with pytest.raises(Exception, match="[Dd]isallow"):
+        with sanitize(transfers="disallow"):
+            float(x[0])  # tpulint: ignore[TPU001] — the TP this test exists for
+
+
+def test_transfer_guard_allows_batched_explicit_pull():
+    x = jnp.arange(8, dtype=jnp.float32)
+    with sanitize(transfers="disallow") as rep:
+        host = jax.device_get(x)  # the sanctioned batched idiom
+        vals = host.tolist()
+    assert vals == list(range(8))
+    assert isinstance(rep, SanitizerReport)
+
+
+def test_nested_scopes_count_independently():
+    with sanitize(transfers="off") as outer:
+        jax.jit(lambda x: x + 0.125)(jnp.ones(3)).block_until_ready()
+        with sanitize(transfers="off") as inner:
+            pass  # nothing compiles in here
+    assert outer.compiles >= 1
+    assert inner.compiles == 0
+
+
+def test_sanitizer_off_mode_is_inert():
+    x = jnp.ones(4)
+    with sanitize(transfers="off"):
+        assert np.isfinite(float(x.sum()))  # implicit pull allowed when off

@@ -1,0 +1,170 @@
+"""tpulint self-test: the seeded fixture corpus + the repo gate.
+
+Tier-1 runs this, so CI enforces the analyzer with no new infrastructure:
+
+- every rule family has a true-positive fixture whose `# TP`-marked lines must
+  be flagged EXACTLY (no extras, no misses) and a false-positive fixture that
+  must stay silent — the corpus is the rules' behavioral spec;
+- the repo itself must be clean modulo tools/tpulint/baseline.json (new
+  hot-path violations fail this test, which is the whole point);
+- the CLI contract: `python -m tools.tpulint --check` exits non-zero on the
+  violation corpus and 0 on the baselined repo, with --json output.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.tpulint import lint_paths, load_baseline  # noqa: E402
+from tools.tpulint.engine import diff_baseline, parse_file  # noqa: E402
+
+FIXDIR = os.path.join(REPO, "tests", "tpulint_fixtures")
+RULES = ["TPU001", "TPU002", "TPU003", "TPU004", "TPU005"]
+
+
+def _marked_lines(path: str) -> set:
+    with open(path, encoding="utf-8") as f:
+        return {i for i, ln in enumerate(f.read().splitlines(), 1)
+                if "# TP" in ln}
+
+
+# ---------------------------------------------------------------------------
+# fixture corpus: exact line agreement per rule family
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_true_positive_corpus_exact(rule):
+    path = os.path.join(FIXDIR, f"tp_{rule.lower()}.py")
+    flagged = {f.line for f in lint_paths([path]) if f.rule == rule}
+    assert flagged == _marked_lines(path), (
+        f"{rule}: flagged lines {sorted(flagged)} != "
+        f"marked lines {sorted(_marked_lines(path))}")
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_false_positive_corpus_silent(rule):
+    path = os.path.join(FIXDIR, f"fp_{rule.lower()}.py")
+    findings = [f for f in lint_paths([path]) if f.rule == rule]
+    assert not findings, [f.to_dict() for f in findings]
+
+
+def test_suppression_comment(tmp_path):
+    src = tmp_path / "supp.py"
+    src.write_text(
+        "def f(xs):\n"
+        "    a = xs.item()  # tpulint: ignore[TPU001]\n"
+        "    b = xs.item()  # tpulint: ignore\n"
+        "    c = xs.item()\n"
+        "    return a, b, c\n")
+    findings = [f for f in lint_paths([str(src)]) if f.rule == "TPU001"]
+    assert [f.line for f in findings] == [4]
+
+
+def test_unparseable_file_is_skipped(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    assert parse_file(str(bad), explicit=True) is None
+    assert lint_paths([str(bad)]) == []
+
+
+# ---------------------------------------------------------------------------
+# the repo gate (this IS the CI enforcement)
+# ---------------------------------------------------------------------------
+
+
+def test_repo_clean_under_baseline():
+    findings = lint_paths(None)
+    new, _stale = diff_baseline(findings, load_baseline())
+    assert not new, (
+        "new tpulint findings — fix them or (for deliberate exceptions) add a "
+        "`# tpulint: ignore[RULE]` comment; do NOT grow baseline.json:\n  "
+        + "\n  ".join(f"{f.key}  {f.message}" for f in new))
+
+
+def test_baseline_entries_not_stale_in_bulk():
+    """A mostly-stale baseline means line numbers drifted wholesale (e.g. a
+    big refactor) — regenerate it so the grandfather list stays honest."""
+    findings = lint_paths(None)
+    baseline = load_baseline()
+    _new, stale = diff_baseline(findings, baseline)
+    if baseline:
+        assert len(stale) < max(3, len(baseline) // 2), (
+            f"{len(stale)}/{len(baseline)} baseline entries no longer fire — "
+            "run `python -m tools.tpulint --update-baseline`")
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", *args],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+
+
+def test_cli_check_fails_on_violation_corpus():
+    tp_files = [os.path.join(FIXDIR, f"tp_{r.lower()}.py") for r in RULES]
+    res = _run_cli("--check", "--json", "--no-baseline", *tp_files)
+    assert res.returncode == 1, res.stderr
+    data = json.loads(res.stdout)
+    assert data["ok"] is False
+    assert {f["rule"] for f in data["findings"]} == set(RULES)
+
+
+def test_cli_check_passes_on_repo():
+    res = _run_cli("--check")
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_cli_json_shape():
+    res = _run_cli("--json")
+    data = json.loads(res.stdout)
+    for key in ("findings", "new", "grandfathered", "stale_baseline", "ok"):
+        assert key in data
+    for f in data["findings"]:
+        assert set(f) == {"path", "line", "rule", "message", "key"}
+
+
+def test_cli_rules_table():
+    res = _run_cli("--rules")
+    assert res.returncode == 0
+    for rule in RULES:
+        assert rule in res.stdout
+
+
+def test_cli_update_baseline_refuses_subset_scope():
+    """A path-restricted --update-baseline would truncate every other file's
+    grandfathered entries — it must refuse, leaving baseline.json untouched."""
+    baseline_path = os.path.join(REPO, "tools", "tpulint", "baseline.json")
+    with open(baseline_path, encoding="utf-8") as f:
+        before = f.read()
+    res = _run_cli("--update-baseline",
+                   os.path.join(FIXDIR, "tp_tpu001.py"))
+    assert res.returncode == 2
+    with open(baseline_path, encoding="utf-8") as f:
+        assert f.read() == before
+
+
+def test_cli_subset_run_reports_no_stale_entries():
+    """Linting one file must not advise deleting baseline entries that belong
+    to files outside the subset."""
+    res = _run_cli("--json", os.path.join(FIXDIR, "fp_tpu001.py"))
+    data = json.loads(res.stdout)
+    assert data["stale_baseline"] == []
+
+
+def test_duplicate_findings_on_one_line_collapse():
+    findings = lint_paths(
+        [os.path.join(REPO, "elasticsearch_tpu", "parallel", "mesh_search.py")])
+    keys = [f.key for f in findings]
+    assert len(keys) == len(set(keys)), keys

@@ -1,0 +1,32 @@
+"""tpulint fixture — FALSE positives for TPU001: none of these may fire.
+
+The batched idioms the rule is steering people toward, plus host-only code
+that shares surface syntax with the flagged patterns.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def clean_merge(dev_scores, dev_docs, rows):
+    host_scores = np.asarray(dev_scores)  # ONE batched pull outside any loop
+    scores = host_scores.tolist()  # batched conversion
+    first = float(scores[0]) if scores else 0.0  # scalar cast outside a loop
+    acc = 0.0
+    for s in scores:
+        acc += float(s)  # float() on a bare name: host list iteration
+    return first, acc
+
+
+def clean_host_math(rows):
+    host = np.arange(8)
+    if host.size:  # attribute test on a numpy value
+        rows = rows + 1
+    counts = [int(n) for n in range(4)]  # int() on a bare loop var
+    return rows, counts
+
+
+def clean_device_compose(x):
+    mask = jnp.isfinite(x)
+    masked = jnp.where(mask, x, 0.0)  # device values stay composed on device
+    return jnp.sum(masked)

@@ -1,0 +1,44 @@
+"""tpulint fixture — FALSE positives for TPU002: none of these may fire.
+
+The repo's sanctioned caching idioms (scoring._compiled_cache,
+mesh_search self._compiled) in miniature.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_cache: dict = {}
+
+
+def cached_wrapper(key, x):
+    fn = _cache.get(key)
+    if fn is None:
+        fn = jax.jit(jnp.sum)  # escapes into the module cache below
+        _cache[key] = fn
+    return fn(x)
+
+
+class Holder:
+    def build(self, x):
+        fn = jax.jit(jnp.cumsum)  # escapes onto the instance
+        self._fn = fn
+        return fn(x)
+
+
+def returned_wrapper():
+    fn = jax.jit(jnp.sort)  # escapes via return — caller owns caching
+    return fn
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def static_shape(x, n):
+    return x + jnp.zeros(n)  # n is static: shape use is fine
+
+
+module_level = jax.jit(jnp.sum)  # module-level wrapper lives forever
+
+
+def plain_args(x):
+    return module_level(x)  # array arg, hashable signature

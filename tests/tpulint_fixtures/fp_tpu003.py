@@ -1,0 +1,31 @@
+"""tpulint fixture — FALSE positives for TPU003: none of these may fire."""
+
+import jax
+
+
+def clean_traced(v):
+    parts = []
+    for i in range(3):
+        parts.append(v * i)  # append to a LOCAL list: legal inside a trace
+    return sum(parts)
+
+
+clean_fn = jax.jit(clean_traced)
+
+
+class HostSide:
+    """Untraced object code may do all of this freely."""
+
+    def update(self, x):
+        self.state = x  # self assignment outside any trace
+        out = []
+        out.append(x)
+        return out
+
+
+_host_log = []
+
+
+def untraced_logger(v):
+    _host_log.append(v)  # closure append outside any trace
+    return v

@@ -1,0 +1,36 @@
+"""tpulint fixture — FALSE positives for TPU004: none of these may fire."""
+
+import threading
+
+import numpy as np
+import jax.numpy as jnp
+
+
+class Ordered:
+    """One global acquisition order, host-only critical sections."""
+
+    def __init__(self):
+        self._first = threading.Lock()
+        self._second = threading.Lock()
+
+    def pair_one(self):
+        with self._first:
+            with self._second:  # consistent order everywhere: no cycle
+                x = np.zeros(3)  # host work under lock is fine
+        return x
+
+    def pair_two(self):
+        with self._first:
+            with self._second:
+                return 1
+
+    def dispatch_outside(self, x):
+        with self._first:
+            n = len(x)
+        return jnp.zeros(n)  # device dispatch after the lock is released
+
+    def callback_defined_under_lock(self):
+        with self._first:
+            def later(x):
+                return jnp.sum(x)  # runs later, NOT while the lock is held
+        return later

@@ -1,0 +1,40 @@
+"""tpulint fixture — TRUE positives for TPU001 (implicit host sync).
+
+Never imported: parsed by tests/test_tpulint.py. Every line carrying a
+TP marker comment must be flagged with TPU001; the test asserts exact line
+agreement, so this file doubles as the rule's behavioral spec.
+"""
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+
+def leaky_merge(dev_scores, dev_docs, rows):
+    total = dev_scores.sum().item()  # TP: .item() is the canonical sync
+    out = []
+    for j in range(10):
+        out.append(float(dev_scores[0, j]))  # TP: per-element float() in loop
+        d = int(dev_docs[j])  # TP: per-element int() in loop
+        out.append(d)
+    hits = [bool(rows[i]) for i in range(4)]  # TP: bool(subscript) in comp
+    return total, out, hits
+
+
+def leaky_transfers(dev_scores, dev_docs, rows):
+    pulled = []
+    for _r in rows:
+        arr = np.asarray(dev_scores)  # TP: conversion inside a loop
+        got = jax.device_get(dev_docs)  # TP: device_get inside a loop
+        pulled.append((arr, got))
+    return pulled
+
+
+def leaky_branch(x):
+    flags = jnp.isfinite(x)
+    if flags:  # TP: if on a jnp-produced value
+        return 1
+    while flags:  # TP: while on a jnp-produced value
+        break
+    assert flags  # TP: assert on a jnp-produced value
+    return 0

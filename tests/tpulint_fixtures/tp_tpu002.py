@@ -1,0 +1,34 @@
+"""tpulint fixture — TRUE positives for TPU002 (retrace hazards)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def per_call_wrapper(x):
+    return jax.jit(lambda v: v * 2)(x)  # TP: jit built+called per invocation
+
+
+def uncached_wrapper(x):
+    fn = jax.jit(jnp.sum)  # TP: wrapper local to the frame, never cached
+    return fn(x)
+
+
+@jax.jit
+def shape_from_param(x, n):
+    return x + jnp.zeros(n)  # TP: param used as Python shape in bare @jit
+
+
+@functools.partial(jax.jit)
+def loop_over_param(x, steps):
+    for i in range(steps):  # TP: range(param) in bare @jit
+        x = x + i
+    return x
+
+
+jitted_sum = jax.jit(jnp.sum)
+
+
+def unhashable_args(x):
+    return jitted_sum([x, x])  # TP: list literal into a jitted callable

@@ -1,0 +1,42 @@
+"""tpulint fixture — TRUE positives for TPU003 (tracer leaks)."""
+
+import jax
+
+_trace_log = []
+_last_value = None
+
+
+class Holder:
+    def compute(self, x):
+        def traced(v):
+            self.cache = v * 2  # TP: self assignment during trace
+            _trace_log.append(v)  # TP: closure append during trace
+            return v * 2
+
+        fn = jax.jit(traced)
+        return fn
+
+
+def make_global_leak():
+    def traced(v):
+        global _last_value
+        _last_value = v  # TP: global assignment during trace
+        return v
+
+    fn = jax.jit(traced)
+    return fn
+
+
+_acc = []
+
+
+def _transitive_helper(v):
+    _acc.append(v)  # TP: reached through the traced call graph
+    return v
+
+
+def traced_root(v):
+    return _transitive_helper(v) * 2
+
+
+root_fn = jax.jit(traced_root)

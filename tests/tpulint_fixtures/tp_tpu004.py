@@ -1,0 +1,27 @@
+"""tpulint fixture — TRUE positives for TPU004 (lock hazards)."""
+
+import threading
+
+import jax.numpy as jnp
+
+
+class Service:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:  # TP: a→b edge of the cycle
+                pass
+
+    def backward(self):
+        with self._b:
+            with self._a:  # TP: b→a edge of the cycle
+                pass
+
+    def dispatch_under_lock(self, x):
+        with self._a:
+            y = jnp.sum(x)  # TP: device dispatch while holding a lock
+            y.block_until_ready()  # TP: device sync while holding a lock
+        return y

@@ -1,0 +1,15 @@
+"""tpulint fixture — TRUE positives for TPU005 (platform drift)."""
+
+import os
+
+import jax
+
+
+def hijack_platform():
+    os.environ["JAX_PLATFORMS"] = "cpu"  # TP: env write outside jaxenv
+    os.environ.setdefault("JAX_PLATFORMS", "tpu")  # TP
+    os.environ.pop("JAX_PLATFORMS", None)  # TP
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"  # TP
+    jax.config.update("jax_platforms", "cpu")  # TP: live config flip
+    os.environ.update({"JAX_PLATFORMS": "cpu"})  # TP
+    del os.environ["JAX_PLATFORMS"]  # TP

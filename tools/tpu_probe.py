@@ -1,12 +1,16 @@
 """Forensic TPU backend probe.
 
 Attempts to initialize the configured JAX backend (axon TPU plugin in this
-container) with a long deadline, multiple retries, and full diagnostic capture:
+container) with a fail-fast deadline (default 30 s per attempt — the known
+jax.devices() hang wedged whole bench runs at the old 600 s; PROBE_TIMEOUT
+raises it for genuine forensic sessions), multiple retries, and full
+diagnostic capture:
 
 - environment snapshot (JAX/TPU/AXON env vars, /opt/axon presence, ports),
 - the probe subprocess's COMPLETE stdout+stderr,
-- faulthandler stack dumps every 60s while the child is alive, so a hang
-  leaves a trace of WHERE init is stuck (socket connect, grant claim, ...),
+- faulthandler stack dumps every 15s while the child is alive, so even a
+  fail-fast attempt leaves a trace of WHERE init is stuck (socket connect,
+  grant claim, ...),
 - a trivial 1-element device program before anything corpus-sized,
 - stale lockfile / leftover process checks between attempts.
 
@@ -31,7 +35,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CHILD = r"""
 import faulthandler, os, sys, time
 log = open(os.environ["PROBE_TRACE"], "w")
-faulthandler.dump_traceback_later(60, repeat=True, file=log)
+faulthandler.dump_traceback_later(
+    int(os.environ.get("PROBE_TRACE_INTERVAL", 15)), repeat=True, file=log)
 t0 = time.time()
 print(f"[child] importing jax (JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS')})",
       flush=True)
@@ -116,7 +121,7 @@ def main() -> int:
     ap.add_argument("--attempts", type=int,
                     default=int(os.environ.get("PROBE_ATTEMPTS", 3)))
     ap.add_argument("--timeout", type=int,
-                    default=int(os.environ.get("PROBE_TIMEOUT", 600)))
+                    default=int(os.environ.get("PROBE_TIMEOUT", 30)))
     args = ap.parse_args()
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
 

@@ -1,0 +1,37 @@
+"""tpulint — static analysis for JAX/TPU hot-path hazards in elasticsearch_tpu.
+
+The device-resident index and fused scoring kernels are this system's Lucene
+(SURVEY.md §2.8); their perf record lives or dies on three invariants that
+nothing in Python enforces: no implicit host sync on the query path, no
+uncached retraces, and no device dispatch while holding an engine lock.
+tpulint makes regressions against those invariants a CI failure, the way
+TSan/ASan guard a training stack.
+
+Rule families (each in tools/tpulint/rules/):
+
+  TPU001  implicit host sync   — float()/int()/bool()/.item()/np.asarray pulls
+                                 of device values inside hot-path modules
+  TPU002  retrace hazard       — jax.jit re-wrapped per call, or jitted
+                                 functions fed varying Python scalars /
+                                 unhashable static args
+  TPU003  tracer leak          — tracers escaping jitted code via self./global
+                                 assignment or closure appends
+  TPU004  lock hazard          — lock-acquisition-order cycles and device
+                                 dispatch performed while holding a lock
+  TPU005  platform drift       — JAX_PLATFORMS / jax_platforms writes outside
+                                 common/jaxenv.py
+
+Usage:
+    python -m tools.tpulint --check [--json] [--baseline PATH] [paths...]
+
+Findings are keyed `path:line:rule`. tools/tpulint/baseline.json grandfathers
+pre-existing violations: new findings fail `--check`, fixed ones are reported
+so the baseline can be burned down (see ARCHITECTURE.md "tpulint").
+
+Suppress a single line with  `# tpulint: ignore[TPU00N]`  (or a bare
+`# tpulint: ignore` for all rules).
+"""
+
+from .engine import Finding, lint_file, lint_paths, load_baseline  # noqa: F401
+
+__all__ = ["Finding", "lint_file", "lint_paths", "load_baseline"]

@@ -1,0 +1,100 @@
+"""CLI: python -m tools.tpulint [--check] [--json] [--baseline P] [--update-baseline] [paths...]
+
+Exit codes: 0 = clean (no findings outside the baseline); 1 = new findings;
+2 = usage error. Without --check, findings are printed but the exit code is 0
+unless --check is given (so ad-hoc runs over fixtures don't fail shells).
+
+Stale baseline entries (grandfathered findings that no longer fire) are
+reported on stderr as a nudge to shrink baseline.json — they never fail the
+run, so fixing a finding is always safe without a lockstep baseline edit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .engine import (
+    DEFAULT_BASELINE,
+    diff_baseline,
+    lint_paths,
+    load_baseline,
+    save_baseline,
+)
+from .rules import RULE_DOCS
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.tpulint",
+        description="JAX/TPU hot-path static analyzer (TPU001-TPU005)")
+    ap.add_argument("paths", nargs="*",
+                    help="files to lint (default: elasticsearch_tpu/**/*.py)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when findings outside the baseline exist")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit machine-readable JSON on stdout")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline path (default {DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every finding as new")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to the current findings")
+    ap.add_argument("--rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        for rid, doc in sorted(RULE_DOCS.items()):
+            print(f"{rid}  {doc}")
+        return 0
+
+    full_scope = not args.paths
+    if args.update_baseline and not full_scope:
+        # a subset rewrite would silently drop every other file's grandfathered
+        # entries and break the tier-1 gate
+        print("--update-baseline requires the default full scope "
+              "(no explicit paths)", file=sys.stderr)
+        return 2
+
+    findings = lint_paths(args.paths or None)
+    baseline = set() if args.no_baseline else load_baseline(args.baseline)
+    new, stale = diff_baseline(findings, baseline)
+    if not full_scope:
+        stale = []  # baseline entries outside the linted subset are not stale
+
+    if args.update_baseline:
+        save_baseline(findings, args.baseline)
+        print(f"baseline updated: {len(findings)} finding(s) grandfathered",
+              file=sys.stderr)
+        return 0
+
+    if args.as_json:
+        json.dump({
+            "findings": [f.to_dict() for f in findings],
+            "new": [f.key for f in new],
+            "grandfathered": sorted({f.key for f in findings} - {f.key for f in new}),
+            "stale_baseline": stale,
+            "ok": not new,
+        }, sys.stdout, indent=1)
+        print()
+    else:
+        for f in findings:
+            tag = "" if f.key in baseline else " [NEW]"
+            print(f"{f.key}{tag}  {f.message}")
+        print(f"{len(findings)} finding(s): {len(new)} new, "
+              f"{len(findings) - len(new)} grandfathered", file=sys.stderr)
+        if stale:
+            print(f"{len(stale)} stale baseline entr(y/ies) — safe to remove:",
+                  file=sys.stderr)
+            for k in stale:
+                print(f"  {k}", file=sys.stderr)
+
+    if args.check and new:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

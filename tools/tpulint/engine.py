@@ -1,0 +1,167 @@
+"""tpulint engine: file discovery, AST parsing, suppression, baseline diffing.
+
+Rules are pure functions over parsed sources (tools/tpulint/rules/); the engine
+owns everything rule-independent so each rule stays a small AST walk:
+
+- which files are in scope and what ROLE they play (hot-path for TPU001/002/003,
+  lock-scope for TPU004, platform-exempt for TPU005),
+- `# tpulint: ignore[RULE]` line suppressions,
+- the baseline diff (new findings fail; fixed-but-still-listed entries are
+  reported so the baseline gets burned down, never silently stale).
+
+Files passed explicitly (the fixture corpus in tests/) take every role, so the
+seeded true/false-positive files exercise each rule without living inside the
+engine package.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Role assignment (repo-relative, forward slashes). TPU001-003 look at the
+# device hot path; TPU004 at the engine's locking core; TPU005 everywhere in
+# the package except the one sanctioned platform writer.
+HOT_PREFIXES = ("elasticsearch_tpu/ops/", "elasticsearch_tpu/parallel/")
+HOT_FILES = ("elasticsearch_tpu/search/execute.py",)
+LOCK_PREFIXES = ("elasticsearch_tpu/transport/",)
+LOCK_FILES = ("elasticsearch_tpu/threadpool.py", "elasticsearch_tpu/cluster/service.py")
+PLATFORM_EXEMPT = ("elasticsearch_tpu/common/jaxenv.py",)
+
+_SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*ignore(?:\[([A-Z0-9, ]+)\])?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str  # repo-relative
+    line: int
+    rule: str  # "TPU001".."TPU005"
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}:{self.line}:{self.rule}"
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "line": self.line, "rule": self.rule,
+                "message": self.message, "key": self.key}
+
+
+@dataclass
+class SourceFile:
+    """One parsed file + its roles; the unit every rule consumes."""
+
+    relpath: str
+    tree: ast.Module
+    lines: list[str]
+    hot: bool  # TPU001/002/003 scope
+    lock_scope: bool  # TPU004 scope
+    platform_checked: bool  # TPU005 scope
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        if not (1 <= line <= len(self.lines)):
+            return False
+        m = _SUPPRESS_RE.search(self.lines[line - 1])
+        if not m:
+            return False
+        rules = m.group(1)
+        return rules is None or rule in {r.strip() for r in rules.split(",")}
+
+
+def _roles(relpath: str, explicit: bool) -> tuple[bool, bool, bool]:
+    if explicit and not relpath.startswith("elasticsearch_tpu/"):
+        return True, True, True  # fixture / ad-hoc file: every rule applies
+    hot = relpath.startswith(HOT_PREFIXES) or relpath in HOT_FILES
+    lock = relpath.startswith(LOCK_PREFIXES) or relpath in LOCK_FILES
+    plat = relpath not in PLATFORM_EXEMPT
+    return hot, lock, plat
+
+
+def parse_file(path: str, explicit: bool = False) -> SourceFile | None:
+    abspath = os.path.abspath(path)
+    relpath = os.path.relpath(abspath, REPO).replace(os.sep, "/")
+    try:
+        with open(abspath, encoding="utf-8") as f:
+            src = f.read()
+        tree = ast.parse(src, filename=relpath)
+    except (OSError, SyntaxError):
+        return None  # unreadable/unparseable files are not lint findings
+    hot, lock, plat = _roles(relpath, explicit)
+    return SourceFile(relpath=relpath, tree=tree, lines=src.splitlines(),
+                      hot=hot, lock_scope=lock, platform_checked=plat)
+
+
+def discover_default_paths() -> list[str]:
+    """The standing lint target: every .py under elasticsearch_tpu/."""
+    out = []
+    root = os.path.join(REPO, "elasticsearch_tpu")
+    for dirpath, _dirs, names in os.walk(root):
+        for n in sorted(names):
+            if n.endswith(".py"):
+                out.append(os.path.join(dirpath, n))
+    return out
+
+
+def lint_files(files: list[SourceFile]) -> list[Finding]:
+    from .rules import ALL_RULES
+
+    findings: list[Finding] = []
+    for rule in ALL_RULES:
+        findings.extend(rule.run(files))
+    by_file = {f.relpath: f for f in files}
+    kept = [f for f in findings
+            if not by_file[f.path].suppressed(f.line, f.rule)]
+    # identical violations on one line (two int() pulls in one statement)
+    # collapse to one finding, keeping counts consistent with the
+    # path:line:rule baseline keys
+    kept = list(dict.fromkeys(kept))
+    return sorted(kept, key=lambda f: (f.path, f.line, f.rule))
+
+
+def lint_paths(paths: list[str] | None = None) -> list[Finding]:
+    explicit = paths is not None
+    raw = paths if paths is not None else discover_default_paths()
+    files = [sf for p in raw if (sf := parse_file(p, explicit=explicit))]
+    return lint_files(files)
+
+
+def lint_file(path: str) -> list[Finding]:
+    return lint_paths([path])
+
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+def load_baseline(path: str | None = None) -> set[str]:
+    p = path or DEFAULT_BASELINE
+    try:
+        with open(p, encoding="utf-8") as f:
+            data = json.load(f)
+    except OSError:
+        return set()
+    return set(data.get("findings", []))
+
+
+def save_baseline(findings: list[Finding], path: str | None = None) -> None:
+    p = path or DEFAULT_BASELINE
+    with open(p, "w", encoding="utf-8") as f:
+        json.dump({"comment": "grandfathered tpulint findings — burn down, "
+                              "never add (new violations fail --check)",
+                   "findings": sorted({f2.key for f2 in findings})},
+                  f, indent=1)
+        f.write("\n")
+
+
+def diff_baseline(findings: list[Finding],
+                  baseline: set[str]) -> tuple[list[Finding], list[str]]:
+    """(new findings not grandfathered, stale baseline keys no longer firing)."""
+    keys = {f.key for f in findings}
+    new = [f for f in findings if f.key not in baseline]
+    stale = sorted(baseline - keys)
+    return new, stale

@@ -1,0 +1,20 @@
+"""tpulint rule registry — one module per rule family, each exposing
+RULE_ID, a one-line DOC, and run(files) -> list[Finding]."""
+
+from . import (
+    tpu001_host_sync,
+    tpu002_retrace,
+    tpu003_tracer_leak,
+    tpu004_locks,
+    tpu005_platform,
+)
+
+ALL_RULES = [
+    tpu001_host_sync,
+    tpu002_retrace,
+    tpu003_tracer_leak,
+    tpu004_locks,
+    tpu005_platform,
+]
+
+RULE_DOCS = {r.RULE_ID: r.DOC for r in ALL_RULES}

@@ -1,0 +1,146 @@
+"""TPU001 — implicit host sync on the device hot path.
+
+The query phase's whole perf story is "one fused program per (segment, batch)";
+a single stray scalar pull inside a per-segment or per-hit loop serializes the
+pipeline (device flush + D2H round trip per element — the regression VERDICT.md
+round 5 measured). In hot-path modules (ops/, parallel/, search/execute.py)
+this rule flags the patterns that smuggle syncs in:
+
+  a. `x.item()` anywhere — the canonical implicit sync.
+  b. `float(x[...])` / `int(x[...])` / `bool(x[...])` inside a for/while loop
+     or comprehension — per-element scalar pulls; batch them into ONE
+     `jax.device_get` / `.tolist()` outside the loop.
+  c. `np.asarray(x)` / `np.array(x)` / `jax.device_get(x)` on a bare name
+     inside a loop — a per-iteration transfer that belongs outside the loop.
+  d. `if`/`while`/`assert` branching on a value produced by a `jnp.*` call in
+     the same function — forces a blocking device read at trace/run time.
+
+Rules b/c are shape heuristics, not type inference: they also fire on host
+numpy arrays, where the per-element loop is still the slow idiom and the
+`.tolist()` fix is identical. Suppress deliberate cases with
+`# tpulint: ignore[TPU001]`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, SourceFile
+
+RULE_ID = "TPU001"
+DOC = "implicit host sync (scalar pulls / .item() / device branching) in hot path"
+
+_SCALAR_CASTS = {"float", "int", "bool"}
+_LOOP_NODES = (ast.For, ast.While, ast.AsyncFor, ast.ListComp, ast.SetComp,
+               ast.DictComp, ast.GeneratorExp)
+_CONVERTERS = {("np", "asarray"), ("np", "array"), ("numpy", "asarray"),
+               ("numpy", "array"), ("jax", "device_get")}
+
+
+def _dotted(node: ast.AST) -> tuple[str, ...] | None:
+    """A call target like np.asarray → ("np", "asarray")."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+class _FuncVisitor(ast.NodeVisitor):
+    """Per-function walk tracking loop depth and jnp-produced names."""
+
+    def __init__(self, sf: SourceFile, out: list[Finding]):
+        self.sf = sf
+        self.out = out
+        self.loop_depth = 0
+        self.device_names: set[str] = set()
+
+    # -- device-name dataflow (single-assignment heuristic) ------------------
+    def visit_Assign(self, node: ast.Assign):
+        if isinstance(node.value, ast.Call):
+            d = _dotted(node.value.func)
+            if d and d[0] in ("jnp", "lax") and d[-1] != "asarray":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.device_names.add(t.id)
+        self.generic_visit(node)
+
+    # -- loop tracking -------------------------------------------------------
+    def _visit_loop(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = visit_While = visit_AsyncFor = _visit_loop
+    visit_ListComp = visit_SetComp = visit_DictComp = _visit_loop
+    visit_GeneratorExp = _visit_loop
+
+    # -- the flagged patterns ------------------------------------------------
+    def _flag(self, node: ast.AST, msg: str):
+        self.out.append(Finding(self.sf.relpath, node.lineno, RULE_ID, msg))
+
+    def visit_Call(self, node: ast.Call):
+        # a. x.item()
+        if (isinstance(node.func, ast.Attribute) and node.func.attr == "item"
+                and not node.args and not node.keywords):
+            self._flag(node, ".item() is an implicit device→host sync; use one "
+                             "batched jax.device_get instead")
+        # b. float/int/bool(x[...]) inside a loop
+        elif (isinstance(node.func, ast.Name) and node.func.id in _SCALAR_CASTS
+              and self.loop_depth > 0 and len(node.args) == 1
+              and isinstance(node.args[0], ast.Subscript)):
+            self._flag(node, f"per-element {node.func.id}() scalar pull inside a "
+                             "loop; batch into one jax.device_get/.tolist() "
+                             "outside the loop")
+        # c. np.asarray / jax.device_get on a bare name inside a loop
+        elif self.loop_depth > 0 and len(node.args) >= 1 \
+                and isinstance(node.args[0], ast.Name):
+            d = _dotted(node.func)
+            if d is not None and (d[0], d[-1]) in _CONVERTERS:
+                self._flag(node, f"{'.'.join(d)}() transfer inside a loop; "
+                                 "hoist or batch the conversion")
+        self.generic_visit(node)
+
+    def _check_branch(self, test: ast.AST, node: ast.AST, kind: str):
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Name) and sub.id in self.device_names:
+                self._flag(node, f"{kind} on device value `{sub.id}` (produced "
+                                 "by a jnp call) blocks on a device→host read")
+                return
+
+    def visit_If(self, node: ast.If):
+        self._check_branch(node.test, node, "branching")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While):
+        self._check_branch(node.test, node, "while-looping")
+        self._visit_loop(node)
+
+    def visit_Assert(self, node: ast.Assert):
+        self._check_branch(node.test, node, "asserting")
+        self.generic_visit(node)
+
+    # nested defs are separate scopes (their bodies don't run inside this
+    # function's loops) — each gets its own visitor pass from run()
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def run(files: list[SourceFile]) -> list[Finding]:
+    out: list[Finding] = []
+    for sf in files:
+        if not sf.hot:
+            continue
+        scopes: list = [sf.tree]
+        scopes.extend(n for n in ast.walk(sf.tree)
+                      if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+        for scope in scopes:
+            v = _FuncVisitor(sf, out)
+            for stmt in scope.body:
+                v.visit(stmt)
+    return out

@@ -1,0 +1,184 @@
+"""TPU002 — retrace hazards: jit executables that can't cache.
+
+A jit executable only pays for itself when the SAME wrapper object is reused;
+the shape-bucketing design (device_index._pow2_bucket, scoring._compiled_cache)
+exists so executables cache across refreshes. This rule flags the ways a
+wrapper (or its cache key) silently stops being reusable:
+
+  a. `jax.jit(f)(x)` — wrapper built and discarded per call: every invocation
+     retraces and recompiles.
+  b. `fn = jax.jit(...)` inside a function where `fn` never escapes to a cache
+     (module global, `cache[key] = fn`, `self.attr = fn`, or `return fn`):
+     the wrapper dies with the frame, so the next call rebuilds it.
+  c. a function decorated with bare `@jax.jit` (no static_argnums/argnames)
+     whose body uses a parameter as a Python int — `range(p)`, `np.zeros(p)`,
+     shape tuples — which is either a tracer error or a retrace per distinct
+     value; mark the parameter static.
+  d. calling a known-jitted name with a `[...]`/`{...}` literal argument:
+     unhashable as a static arg, and as a pytree its dict key-set/list length
+     is part of the trace signature — varying shapes retrace every call.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, SourceFile
+
+RULE_ID = "TPU002"
+DOC = "retrace hazard: uncached jit wrappers / non-static shape params / unhashable args"
+
+_SHAPE_SINKS = {"range", "zeros", "ones", "full", "empty", "arange", "reshape",
+                "broadcast_to"}
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    """jax.jit(...) / jit(...) / functools.partial(jax.jit, ...)."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "jit":
+        return True
+    if isinstance(f, ast.Name) and f.id == "jit":
+        return True
+    if isinstance(f, ast.Attribute) and f.attr == "partial" or \
+            isinstance(f, ast.Name) and getattr(f, "id", "") == "partial":
+        return bool(node.args) and _is_jit_name(node.args[0])
+    return False
+
+
+def _is_jit_name(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == "jit") or \
+        (isinstance(node, ast.Name) and node.id == "jit")
+
+
+def _jit_has_statics(call: ast.Call) -> bool:
+    return any(kw.arg in ("static_argnums", "static_argnames")
+               for kw in call.keywords)
+
+
+def _flag(out, sf, node, msg):
+    out.append(Finding(sf.relpath, node.lineno, RULE_ID, msg))
+
+
+def _check_immediate_call(sf: SourceFile, out: list[Finding]):
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and _is_jit_call(node.func):
+            _flag(out, sf, node, "jax.jit(...) built and called in one "
+                                 "expression — retraces+recompiles every call; "
+                                 "cache the wrapper")
+
+
+def _check_uncached_wrapper(sf: SourceFile, out: list[Finding]):
+    """Rule b: inside each function, a jit result assigned to a local that
+    never escapes (no cache store, attribute store, or return)."""
+    for fn in ast.walk(sf.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        jit_locals: dict[str, ast.AST] = {}
+        escaped: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _is_jit_call(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        jit_locals.setdefault(t.id, node)
+                    else:
+                        # direct store into cache/attr — escapes by construction
+                        pass
+            elif isinstance(node, ast.Assign):
+                # name escaping via cache[key] = fn / self.attr = fn / x = fn
+                if isinstance(node.value, ast.Name):
+                    for t in node.targets:
+                        if isinstance(t, (ast.Subscript, ast.Attribute)):
+                            escaped.add(node.value.id)
+            elif isinstance(node, ast.Return) and isinstance(node.value, ast.Name):
+                escaped.add(node.value.id)
+            elif isinstance(node, ast.Call):
+                # passed into something that may retain it (cache.setdefault,
+                # functools.lru_cache internals, ...) — give benefit of doubt
+                for a in node.args:
+                    if isinstance(a, ast.Name):
+                        escaped.add(a.id)
+        for name, node in jit_locals.items():
+            if name not in escaped:
+                _flag(out, sf, node, f"jit wrapper `{name}` is local to this "
+                                     "function and never cached — it is "
+                                     "rebuilt (and retraced) on every call")
+
+
+def _check_nonstatic_shape_params(sf: SourceFile, out: list[Finding]):
+    """Rule c: bare @jit functions using a param in a Python-int shape sink."""
+    for fn in ast.walk(sf.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        jit_deco = None
+        for deco in fn.decorator_list:
+            if _is_jit_name(deco):
+                jit_deco = deco
+                break
+            if isinstance(deco, ast.Call) and (_is_jit_name(deco.func)
+                                               or _is_jit_call(deco)):
+                if not _jit_has_statics(deco):
+                    jit_deco = deco
+                break
+        if jit_deco is None:
+            continue
+        params = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = (node.func.id if isinstance(node.func, ast.Name)
+                    else node.func.attr if isinstance(node.func, ast.Attribute)
+                    else None)
+            if name not in _SHAPE_SINKS:
+                continue
+            for a in node.args:
+                if isinstance(a, ast.Name) and a.id in params:
+                    _flag(out, sf, node,
+                          f"param `{a.id}` used as a Python int in {name}() "
+                          "inside a bare @jit function — tracer error or "
+                          "retrace per value; add static_argnums/argnames")
+
+
+def _known_jitted_names(sf: SourceFile) -> set[str]:
+    names = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and _is_jit_call(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_name(d) or (isinstance(d, ast.Call)
+                                       and _is_jit_name(d.func))
+                   for d in node.decorator_list):
+                names.add(node.name)
+    return names
+
+
+def _check_unhashable_args(sf: SourceFile, out: list[Finding]):
+    jitted = _known_jitted_names(sf)
+    if not jitted:
+        return
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id in jitted:
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(a, (ast.List, ast.Dict, ast.Set)):
+                    _flag(out, sf, node,
+                          f"literal {type(a).__name__.lower()} passed to "
+                          f"jitted `{node.func.id}` — unhashable as a static "
+                          "arg and its shape is part of the trace signature; "
+                          "pass a tuple/array or mark shapes static")
+                    break
+
+
+def run(files: list[SourceFile]) -> list[Finding]:
+    out: list[Finding] = []
+    for sf in files:
+        if not sf.hot:
+            continue
+        _check_immediate_call(sf, out)
+        _check_uncached_wrapper(sf, out)
+        _check_nonstatic_shape_params(sf, out)
+        _check_unhashable_args(sf, out)
+    return out

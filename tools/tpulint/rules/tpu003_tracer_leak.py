@@ -1,0 +1,162 @@
+"""TPU003 — tracer leaks: traced values escaping the jitted program.
+
+A tracer stored on `self`, a global, or a closed-over list outlives its trace
+and detonates later as a LeakedTracerError (or, worse, silently holds the whole
+trace-time graph alive). The traced scope here is computed transitively: a
+function is "traced" if it is decorated with jit, passed to jax.jit by name,
+or reachable through direct calls from such a function within the module —
+matching the scoring.py idiom where `jax.jit(wrapper)` wraps a closure that
+calls `_score_batch_impl` → `_dense_accumulate` → ...
+
+Inside traced functions this rule flags:
+
+  a. `self.attr = ...` — object state written during trace holds tracers.
+  b. assignment to a name declared `global`.
+  c. `.append(...)` / `.extend(...)` / `.add(...)` on a FREE variable (not a
+     local, not a parameter) — the closure-append leak.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, SourceFile
+
+RULE_ID = "TPU003"
+DOC = "tracer leak: self/global assignment or closure append inside jitted code"
+
+_MUTATORS = {"append", "extend", "add"}
+
+
+def _is_jit_name(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == "jit") or \
+        (isinstance(node, ast.Name) and node.id == "jit")
+
+
+def _collect_functions(tree: ast.Module) -> dict[str, list[ast.AST]]:
+    """Every def in the file by name — a LIST per name, because nested helper
+    names recur (two closures both called `traced`); tracing must reach all."""
+    out: dict[str, list[ast.AST]] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(n.name, []).append(n)
+    return out
+
+
+def _traced_roots(tree: ast.Module, fns: dict[str, ast.AST]) -> set[str]:
+    roots: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.decorator_list:
+                if _is_jit_name(d) or (isinstance(d, ast.Call)
+                                       and (_is_jit_name(d.func)
+                                            or any(_is_jit_name(a)
+                                                   for a in d.args))):
+                    roots.add(node.name)
+        elif isinstance(node, ast.Call) and _is_jit_name(node.func):
+            for a in node.args:
+                if isinstance(a, ast.Name) and a.id in fns:
+                    roots.add(a.id)
+    return roots
+
+
+def _called_names(fn: ast.AST) -> set[str]:
+    return {n.func.id for n in ast.walk(fn)
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name)}
+
+
+def _traced_closure(tree: ast.Module) -> list[tuple[str, ast.AST]]:
+    """Transitive closure of traced functions over the intra-module call graph
+    (by-name resolution: every def sharing a traced name is analyzed)."""
+    fns = _collect_functions(tree)
+    pending = list(_traced_roots(tree, fns))
+    traced: set[str] = set()
+    while pending:
+        name = pending.pop()
+        if name in traced or name not in fns:
+            continue
+        traced.add(name)
+        for node in fns[name]:
+            pending.extend(c for c in _called_names(node) if c in fns)
+    return [(n, node) for n in sorted(traced) for node in fns[n]]
+
+
+def _locals_of(fn: ast.AST) -> set[str]:
+    out = {a.arg for a in fn.args.args + fn.args.kwonlyargs + fn.args.posonlyargs}
+    if fn.args.vararg:
+        out.add(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        out.add(fn.args.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+            out.add(node.name)
+            continue
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        out.add(sub.id)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            if isinstance(node.target, ast.Name):
+                out.add(node.target.id)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            for sub in ast.walk(node.target):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                for sub in ast.walk(gen.target):
+                    if isinstance(sub, ast.Name):
+                        out.add(sub.id)
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    for sub in ast.walk(item.optional_vars):
+                        if isinstance(sub, ast.Name):
+                            out.add(sub.id)
+    return out
+
+
+def run(files: list[SourceFile]) -> list[Finding]:
+    out: list[Finding] = []
+    for sf in files:
+        if not sf.hot:
+            continue
+        for name, fn in _traced_closure(sf.tree):
+            globals_decl: set[str] = set()
+            local_names = _locals_of(fn)
+            nested = {n for n in ast.walk(fn)
+                      if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                      and n is not fn}
+            nested_nodes = {id(x) for inner in nested for x in ast.walk(inner)}
+            for node in ast.walk(fn):
+                if id(node) in nested_nodes:
+                    continue  # nested defs analyzed via their own traced entry
+                if isinstance(node, ast.Global):
+                    globals_decl.update(node.names)
+                elif isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and t.value.id == "self":
+                            out.append(Finding(
+                                sf.relpath, node.lineno, RULE_ID,
+                                f"assignment to self.{t.attr} inside traced "
+                                f"function `{name}` leaks tracers into object "
+                                "state"))
+                        elif isinstance(t, ast.Name) and t.id in globals_decl:
+                            out.append(Finding(
+                                sf.relpath, node.lineno, RULE_ID,
+                                f"assignment to global `{t.id}` inside traced "
+                                f"function `{name}` leaks tracers"))
+                elif isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _MUTATORS and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id not in local_names:
+                    out.append(Finding(
+                        sf.relpath, node.lineno, RULE_ID,
+                        f".{node.func.attr}() on closed-over "
+                        f"`{node.func.value.id}` inside traced function "
+                        f"`{name}` leaks tracers out of the trace"))
+    return out
