@@ -115,8 +115,12 @@ class CompileBudgetExceeded(AssertionError):
     twin)."""
 
 
+_UNSET = object()
+
+
 @contextlib.contextmanager
-def sanitize(max_compiles: int | None = None, transfers: str = "disallow"):
+def sanitize(max_compiles: int | None | object = _UNSET,
+             transfers: str | None = None):
     """Arm the JAX runtime sanitizers around a query phase.
 
     - transfer guard at level `transfers` ("disallow" = implicit transfers
@@ -127,6 +131,19 @@ def sanitize(max_compiles: int | None = None, transfers: str = "disallow"):
       if max_compiles is not None the scope raises CompileBudgetExceeded on
       exit when the budget was blown.
 
+    Defaults come from the environment so the conftest gate, CI, and ad-hoc
+    debugging share one knob (the tpulint baseline is empty, so "disallow"
+    is the standing mode — ROADMAP burn-down item, PR 2):
+
+      ESTPU_SANITIZE        transfer level when `transfers` is None
+                            (default "disallow"; set =log as the escape
+                            hatch while debugging a new implicit transfer,
+                            =off to disarm entirely)
+      ESTPU_COMPILE_BUDGET  int; when `max_compiles` is not given, a HARD
+                            per-scope ceiling — the scope raises
+                            CompileBudgetExceeded beyond it (empty/unset =
+                            count but don't enforce)
+
     Usage (the test-harness invariant: a warmed query path neither recompiles
     nor implicitly transfers):
 
@@ -135,6 +152,12 @@ def sanitize(max_compiles: int | None = None, transfers: str = "disallow"):
         assert rep.compiles == 0  # implied by max_compiles=0
     """
     import jax
+
+    if transfers is None:
+        transfers = os.environ.get("ESTPU_SANITIZE", "disallow")
+    if max_compiles is _UNSET:
+        budget = os.environ.get("ESTPU_COMPILE_BUDGET")
+        max_compiles = int(budget) if budget else None
 
     report = SanitizerReport()
     _counter.subscribe(report)

@@ -339,27 +339,37 @@ def _stack_args(packed: PackedSegment, batch: TermBatch):
     return norms_stack, caches
 
 
+def _scalar_f32(x):
+    """Device f32 scalar via EXPLICIT placement: eager jnp.float32(x) routes a
+    0-d convert_element_type through an implicit host→device transfer, which
+    the transfer_guard("disallow") sanitizer rejects at dispatch sites."""
+    import jax
+
+    return jax.device_put(np.float32(x))
+
+
 def score_fs_rows_batch(packed: PackedSegment, batch: TermBatch, k: int,
                         g_row, applies_row, max_boost: float, fboost: float,
                         min_score, bmode: str, no_functions: bool):
     """Dense launch with host-combined function rows; returns (scores, docs, total)
     numpy [Q, k]/[Q]."""
+    import jax
     import jax.numpy as jnp
 
     norms_stack, caches = _stack_args(packed, batch)
     fn = _get_fs_compiled(
         "rows", batch.n_queries, min(k, packed.doc_pad), packed.doc_pad,
         bmode=bmode, use_min_score=min_score is not None, no_functions=no_functions)
-    top_scores, top_docs, total = fn(
+    out = fn(
         packed.blk_docs, packed.blk_freqs, packed.live_parent, norms_stack, caches,
         jnp.asarray(batch.qidx), jnp.asarray(batch.blk), jnp.asarray(batch.weight),
         jnp.asarray(batch.fidx), jnp.asarray(batch.group), jnp.asarray(batch.tfmode),
         jnp.asarray(batch.n_must), jnp.asarray(batch.msm), jnp.asarray(batch.coord),
         jnp.asarray(g_row, jnp.float32), jnp.asarray(applies_row, bool),
-        jnp.float32(max_boost), jnp.float32(fboost),
-        jnp.float32(min_score if min_score is not None else 0.0),
+        _scalar_f32(max_boost), _scalar_f32(fboost),
+        _scalar_f32(min_score if min_score is not None else 0.0),
     )
-    return np.asarray(top_scores), np.asarray(top_docs), np.asarray(total)
+    return jax.device_get(out)
 
 
 def score_fs_script_batch(packed: PackedSegment, batch: TermBatch, k: int,
@@ -384,9 +394,9 @@ def score_fs_script_batch(packed: PackedSegment, batch: TermBatch, k: int,
         tuple(jnp.asarray(c, jnp.float32) for c in col_rows),
         jnp.asarray(fmask_row, bool), jnp.asarray(bad_row, bool),
         jnp.asarray(parent_row, bool),
-        jnp.float32(weight if weight is not None else 1.0),
-        jnp.float32(max_boost), jnp.float32(fboost),
-        jnp.float32(min_score if min_score is not None else 0.0),
+        _scalar_f32(weight if weight is not None else 1.0),
+        _scalar_f32(max_boost), _scalar_f32(fboost),
+        _scalar_f32(min_score if min_score is not None else 0.0),
     )
     return (np.asarray(top_scores), np.asarray(top_docs), np.asarray(total),
             np.asarray(bad))
@@ -595,19 +605,19 @@ def score_agg_batch(packed: PackedSegment, batch: TermBatch, k: int,
         # broadcastable no-op mask: [1, 1] & [Q, Dpad] — avoids allocating and
         # transferring a full all-true mask on the unfiltered aggs hot path
         fmask = np.ones((1, 1), dtype=bool)
-    top_scores, top_docs, total, counts, stats, bucket_counts = fn(
+    out = fn(
         packed.blk_docs, packed.blk_freqs, packed.live_parent, norms_stack, caches,
         jnp.asarray(batch.qidx), jnp.asarray(batch.blk), jnp.asarray(batch.weight),
         jnp.asarray(batch.fidx), jnp.asarray(batch.group), jnp.asarray(batch.tfmode),
         jnp.asarray(batch.n_must), jnp.asarray(batch.msm), jnp.asarray(batch.coord),
-        agg_row_stack, tuple(bucket_pairs), jnp.asarray(fmask),
+        # jnp.asarray commits a host stack explicitly (no-op for device
+        # arrays); a raw numpy arg would be an implicit H2D at dispatch
+        jnp.asarray(agg_row_stack), tuple(bucket_pairs), jnp.asarray(fmask),
     )
-    return (np.asarray(top_scores), np.asarray(top_docs), np.asarray(total),
-            np.asarray(counts), np.asarray(stats),
-            tuple((np.asarray(c),
-                   None if sc is None else np.asarray(sc),
-                   None if ss is None else np.asarray(ss))
-                  for (c, sc, ss) in bucket_counts))
+    # ONE explicit pull for the whole result pytree (None leaves pass through):
+    # per-leaf np.asarray was a transfer per output — and an implicit one, which
+    # the promoted transfer_guard("disallow") sanitizer now rejects
+    return jax.device_get(out)
 
 
 def _detect_simple(batch: TermBatch) -> bool:
@@ -915,12 +925,10 @@ def score_flat_sparse(packed: PackedSegment, clause_lists: list, n_must: np.ndar
     docs = np.full((Q, k), packed.doc_pad, np.int32)
     totals = np.zeros(Q, np.int64)
     results = [(sb, score_sparse_batch_async(packed, sb, k)) for sb in batches]
-    if results:
-        jax.block_until_ready([r for (_sb, r) in results])
-    for sb, (s, d, t) in results:
-        s = np.asarray(s)
-        d = np.asarray(d)
-        t = np.asarray(t)
+    # all buckets launched async above; ONE explicit device_get drains them
+    # (it blocks until ready) instead of a per-bucket-per-array np.asarray pull
+    pulled = jax.device_get([r for (_sb, r) in results]) if results else []
+    for (sb, _r), (s, d, t) in zip(results, pulled):
         rows = sb.qids >= 0
         qid = sb.qids[rows]
         kk = s.shape[1]

@@ -89,9 +89,12 @@ def _combine_segments(searcher: Searcher) -> ShardCSR:
         for f, st in seg.field_stats.items():
             sum_ttf[f] = sum_ttf.get(f, 0) + st.sum_ttf
             field_doc_count[f] = field_doc_count.get(f, 0) + st.doc_count
+        # one batched pull of the offsets per segment; the per-term int() pair
+        # was a scalar extraction per posting list (the _merge_seg_hits shape)
+        offs = seg.post_offsets.tolist()
         for f, td in seg.term_dict.items():
             for term, tid in td.items():
-                s, e = int(seg.post_offsets[tid]), int(seg.post_offsets[tid + 1])
+                s, e = offs[tid], offs[tid + 1]
                 key = (f, term)
                 row = rows.get(key)
                 if row is None:
@@ -187,9 +190,11 @@ def build_sharded_index(searchers: list[Searcher], fields: list[str],
             flat_freqs[slots] = c.post_freqs
         tb = {}
         tdf = {}
+        bs = blk_start.tolist()  # batched: one pull instead of 2 per term
+        cnt = counts.tolist()
         for key, tid in c.term_ids.items():
-            tb[key] = (int(blk_start[tid]), int(blk_start[tid + 1]))
-            tdf[key] = int(counts[tid])
+            tb[key] = (bs[tid], bs[tid + 1])
+            tdf[key] = cnt[tid]
         shard_term_blocks.append(tb)
         shard_term_df.append(tdf)
         live[si, : c.doc_count] = c.live_parent
@@ -457,7 +462,10 @@ def _mesh_score_program(k: int, n_queries: int, doc_pad: int, similarity_kind: i
             from ..ops.scoring import _bucket_scatter
 
             for (nb, sub_idx), (pdoc, pbucket) in zip(bucket_specs, bucket_pairs):
-                sub_stack = (agg_rows[0][np.asarray(sub_idx)]
+                # sub_idx is a static tuple; jnp.asarray keeps the row-select
+                # a device gather instead of an f64 numpy constant built at
+                # trace time (TPU001/TPU009)
+                sub_stack = (agg_rows[0][jnp.asarray(sub_idx)]
                              if sub_idx else None)
                 cnts, sub_cnt, sub_stats = _bucket_scatter(
                     match, pdoc[0], pbucket[0], nb, sub_stack)
@@ -657,6 +665,8 @@ class MeshSearchExecutor:
         mirrors SortSpec.reverse. active: bool [S] shard-subset mask.
         bucket_pairs: per bucket agg (pdoc [S, P], pbucket [S, P], nb,
         sub_row_idx tuple|None) — results in MeshTopDocs.bucket_results."""
+        import inspect
+
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
@@ -665,6 +675,11 @@ class MeshSearchExecutor:
             from jax import shard_map  # jax >= 0.7 public API
         except ImportError:  # pragma: no cover - older jax
             from jax.experimental.shard_map import shard_map
+        # the replication-check knob was renamed check_rep -> check_vma across
+        # jax versions; same semantics (outputs here are P() by construction)
+        _sm_params = inspect.signature(shard_map).parameters
+        sm_relax = ({"check_vma": False} if "check_vma" in _sm_params
+                    else {"check_rep": False})
 
         idx = self.index
         Q = len(plans)
@@ -688,6 +703,27 @@ class MeshSearchExecutor:
         key = (Q, k, qidx.shape[1], coord.shape[1], has_filter, has_stack,
                has_aggs, has_post, has_min, has_sort, sort_desc, has_active,
                bucket_specs)
+        in_specs = [
+            P("shards"), P("shards"), P("shards"), P("shards"),  # index
+            P("shards"), P("shards"), P("shards"), P("shards"), P("shards"), P("shards"),  # entries
+            P("shards"), P(), P(), P(),  # clause tables (df sharded)
+            P("shards"), P("shards"),  # stats
+            P(), P(), P(),  # per-query
+        ]
+        if has_filter:
+            in_specs.append(P("shards"))
+        if has_stack:
+            in_specs.append(P("shards"))
+        if has_post:
+            in_specs.append(P("shards"))
+        if has_min:
+            in_specs.append(P())
+        if has_sort:
+            in_specs.append(P("shards"))
+        if has_active:
+            in_specs.append(P("shards"))
+        for _spec in bucket_specs:
+            in_specs.extend([P("shards"), P("shards")])
         fn = self._compiled.get(key)
         if fn is None:
             program = _mesh_score_program(k, Q, idx.doc_pad, self.similarity_kind,
@@ -700,80 +736,67 @@ class MeshSearchExecutor:
                                           use_active=has_active,
                                           use_stack=has_stack,
                                           bucket_specs=bucket_specs)
-            in_specs = [
-                P("shards"), P("shards"), P("shards"), P("shards"),  # index
-                P("shards"), P("shards"), P("shards"), P("shards"), P("shards"), P("shards"),  # entries
-                P("shards"), P(), P(), P(),  # clause tables (df sharded)
-                P("shards"), P("shards"),  # stats
-                P(), P(), P(),  # per-query
-            ]
-            if has_filter:
-                in_specs.append(P("shards"))
-            if has_stack:
-                in_specs.append(P("shards"))
-            if has_post:
-                in_specs.append(P("shards"))
-            if has_min:
-                in_specs.append(P())
-            if has_sort:
-                in_specs.append(P("shards"))
-            if has_active:
-                in_specs.append(P("shards"))
-            for _spec in bucket_specs:
-                in_specs.extend([P("shards"), P("shards")])
             n_out = 4 + (1 if has_sort else 0) + (2 if has_aggs else 0) \
                 + sum(3 if sub else 1 for (_nb, sub) in bucket_specs)
             fn = shard_map(
                 program, mesh=self.mesh,
                 in_specs=tuple(in_specs),
                 out_specs=tuple(P() for _ in range(n_out)),
-                check_vma=False,
+                **sm_relax,
             )
             fn = jax.jit(fn)
             self._compiled[key] = fn
-        args = [
+        raw = [
             idx.blk_docs, idx.blk_freqs, idx.norms, idx.live,
-            jnp.asarray(qidx), jnp.asarray(blk), jnp.asarray(clause_id),
-            jnp.asarray(fidx), jnp.asarray(group), jnp.asarray(tfmode),
-            jnp.asarray(df_local), jnp.asarray(boost), jnp.asarray(clause_qidx),
-            jnp.asarray(clause_scoring),
-            jnp.asarray(idx.max_doc), jnp.asarray(idx.sum_ttf),
-            jnp.asarray(n_must), jnp.asarray(msm), jnp.asarray(coord),
+            qidx, blk, clause_id, fidx, group, tfmode,
+            df_local, boost, clause_qidx, clause_scoring,
+            idx.max_doc, idx.sum_ttf, n_must, msm, coord,
         ]
         if has_filter:
-            args.append(jnp.asarray(filter_masks))
+            raw.append(filter_masks)
         if has_stack:
-            args.append(agg_rows if not isinstance(agg_rows, np.ndarray)
-                        else jnp.asarray(agg_rows))
+            raw.append(agg_rows)
         if has_post:
-            args.append(jnp.asarray(post_masks))
+            raw.append(post_masks)
         if has_min:
-            args.append(jnp.float32(min_score))
+            raw.append(np.float32(min_score))
         if has_sort:
-            args.append(jnp.asarray(sort_keys))
+            raw.append(sort_keys)
         if has_active:
-            args.append(jnp.asarray(active))
+            raw.append(active)
         for (pd, pb, _nb, _sub) in bucket_pairs:
-            args.append(jnp.asarray(pd))
-            args.append(jnp.asarray(pb))
+            raw.append(pd)
+            raw.append(pb)
+        # EXPLICIT placement with the program's exact shardings. jnp.asarray
+        # committed each arg to the default device, and dispatch then resharded
+        # it onto the mesh — an implicit device-to-device copy per argument per
+        # query, which transfer_guard("disallow") rejects. device_put on an
+        # already-correctly-placed array (the packed index, cached agg stacks)
+        # is a no-op.
+        from jax.sharding import NamedSharding
 
-        outs = list(fn(*args))
-        top_scores = np.asarray(outs.pop(0))[0]
-        top_ids = np.asarray(outs.pop(0))[0]
-        shard_totals = np.asarray(outs.pop(0))[0]  # [S, Q]
-        qmax = np.asarray(outs.pop(0))[0]  # [S, Q]
-        out_sort_keys = np.asarray(outs.pop(0))[0] if has_sort else None
+        args = [jax.device_put(a, NamedSharding(self.mesh, s))
+                for a, s in zip(raw, in_specs)]
+
+        # ONE explicit pull for every program output — per-output np.asarray was
+        # an implicit transfer each, which transfer_guard("disallow") rejects
+        outs = list(jax.device_get(fn(*args)))
+        top_scores = outs.pop(0)[0]
+        top_ids = outs.pop(0)[0]
+        shard_totals = outs.pop(0)[0]  # [S, Q]
+        qmax = outs.pop(0)[0]  # [S, Q]
+        out_sort_keys = outs.pop(0)[0] if has_sort else None
         agg_counts = agg_stats = None
         if has_aggs:
-            agg_counts = np.asarray(outs.pop(0))[0]  # [S, Q, F]
-            agg_stats = np.asarray(outs.pop(0))[0]  # [S, Q, F, 4]
+            agg_counts = outs.pop(0)[0]  # [S, Q, F]
+            agg_stats = outs.pop(0)[0]  # [S, Q, F, 4]
         bucket_results = []
         for (_nb, sub) in bucket_specs:
-            cnts = np.asarray(outs.pop(0))[0]  # [S, Q, NB]
+            cnts = outs.pop(0)[0]  # [S, Q, NB]
             sc = ss = None
             if sub:
-                sc = np.asarray(outs.pop(0))[0]  # [S, Q, Fs, NB]
-                ss = np.asarray(outs.pop(0))[0]  # [S, Q, Fs, NB, 4]
+                sc = outs.pop(0)[0]  # [S, Q, Fs, NB]
+                ss = outs.pop(0)[0]  # [S, Q, Fs, NB, 4]
             bucket_results.append((cnts, sc, ss))
         valid_rank = np.isfinite(out_sort_keys if has_sort else top_scores)
         shard = np.where((top_ids >= 0) & valid_rank, top_ids // idx.doc_pad, -1)

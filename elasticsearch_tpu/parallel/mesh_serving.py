@@ -273,29 +273,36 @@ class MeshServingService:
         self.mesh_queries += 1
 
         track = bool(req.track_scores) if req.sort else True
+        # batch every host read ONCE: the executor already device_get the
+        # whole program output, so these are pure-host .tolist() conversions —
+        # the per-element float()/int() pulls this replaces were a scalar
+        # extraction per hit per shard (the grandfathered TPU001 block)
+        shard_row = out.shard[0].tolist()
+        score_row = out.scores[0].tolist()
+        doc_row = out.doc[0].tolist()
+        totals_col = out.shard_totals[:, 0].tolist()
+        qmax_col = out.qmax[:, 0].tolist()
         results = []
         for ordinal, copy in enumerate(shards):
             sid = copy.shard_id
-            sel = [j for j in range(out.scores.shape[1])
-                   if out.shard[0][j] == sid]
+            sel = [j for j, sh in enumerate(shard_row) if sh == sid]
             if req.sort:
-                locals_ = [int(out.doc[0][j]) for j in sel]
+                locals_ = [doc_row[j] for j in sel]
                 sort_vals = self._sort_values(req.sort, ctxs[sid],
                                               searchers[sid], locals_)
-                rows = [(float(out.scores[0][j]) if track else float("nan"),
-                         int(out.doc[0][j]), sort_vals[i])
+                rows = [(score_row[j] if track else float("nan"),
+                         doc_row[j], sort_vals[i])
                         for i, j in enumerate(sel)]
             else:
-                rows = [(float(out.scores[0][j]), int(out.doc[0][j]), None)
-                        for j in sel]
-            qm = out.qmax[sid, 0]
+                rows = [(score_row[j], doc_row[j], None) for j in sel]
+            qm = qmax_col[sid]
             agg_partials = self._shard_agg_partials(
                 req, metric_fields, bucket_names, bucket_subs, fpos,
                 bucket_keys_per, out, sid, searchers[sid])
             result = ShardQueryResult(
-                total=int(out.shard_totals[sid, 0]),
+                total=totals_col[sid],
                 docs=rows,
-                max_score=float(qm) if np.isfinite(qm) else float("nan"),
+                max_score=qm if np.isfinite(qm) else float("nan"),
                 agg_partials=agg_partials,
                 shard_id=ordinal,
             )
@@ -428,9 +435,13 @@ class MeshServingService:
         bases = np.asarray(searcher.bases)
         out: list = [None] * len(locals_)
         by_seg: dict = {}
-        for i, g in enumerate(locals_):
-            si = int(np.searchsorted(bases, g, side="right") - 1)
-            by_seg.setdefault(si, []).append((i, g - int(bases[si])))
+        # one vectorized searchsorted for ALL docs (the per-doc int() pair was
+        # a scalar extraction per hit), then pure-list bucketing
+        seg_of = (np.searchsorted(bases, np.asarray(locals_, dtype=np.int64),
+                                  side="right") - 1).tolist()
+        base_list = bases.tolist()
+        for i, (g, si) in enumerate(zip(locals_, seg_of)):
+            by_seg.setdefault(si, []).append((i, g - base_list[si]))
         for si, items in by_seg.items():
             seg = searcher.segments[si]
             vals = sort_values_for_docs(

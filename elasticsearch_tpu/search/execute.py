@@ -812,6 +812,7 @@ def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
     folds scatter along the same pairs. Serving uses this when every
     aggregation is device-eligible (service.execute_query_phase →
     aggregations.device_agg_fields / device_bucket_eligible)."""
+    import jax
     import jax.numpy as jnp
 
     from ..ops.device_index import ensure_agg_rows, packed_for
@@ -839,10 +840,13 @@ def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
             if dev is None:
                 from .aggregations import _bucket_cache_put
 
+                # explicit device_put: eager jnp.zeros builds its fill scalar
+                # through an implicit host→device transfer, which the
+                # transfer_guard("disallow") sanitizer rejects
                 dev = _bucket_cache_put(
                     packed.bucket_cols, ck,
                     (jnp.asarray(pdoc), jnp.asarray(pbucket),
-                     jnp.zeros(len(keys), jnp.int32)))
+                     jax.device_put(np.zeros(len(keys), np.int32))))
             sub_stack = None
             if sub_order:
                 sub_stack = ensure_agg_rows(seg, packed, sub_order)
@@ -1501,8 +1505,8 @@ def _positions_by_doc(seg: FrozenSegment, field: str, term: str) -> dict[int, se
         return {}
     s, e = int(seg.post_offsets[tid]), int(seg.post_offsets[tid + 1])
     out = {}
-    for i in range(s, e):
-        d = int(seg.post_docs[i])
+    docs = seg.post_docs[s:e].tolist()  # one batched pull, not int() per doc
+    for i, d in zip(range(s, e), docs):
         out[d] = set(seg.positions[seg.pos_offsets[i]: seg.pos_offsets[i + 1]].tolist())
     return out
 
@@ -1860,13 +1864,16 @@ def _shard_join(ctx: ShardContext, q: Query):
             scorer = HostScorer(ctx, seg, 1.0)
             s, m = scorer.eval(q.query)
             m = m & np.asarray([t == q.child_type for t in seg.types], dtype=bool)
-            for local in np.nonzero(m)[0]:
-                pid = (seg.str_values("_parent", int(local)) or [None])[0]
+            locs = np.nonzero(m)[0]
+            # batch the matched scores in one pull; float(s[local]) per child
+            # was a scalar extraction per matching doc
+            for local, sval in zip(locs.tolist(), s[locs].tolist()):
+                pid = (seg.str_values("_parent", local) or [None])[0]
                 if pid is None:
                     continue
                 prev = parent_ids.get(pid, 0.0)
-                parent_ids[pid] = max(prev, float(s[local])) if q.score_mode == "max" \
-                    else prev + float(s[local])
+                parent_ids[pid] = max(prev, sval) if q.score_mode == "max" \
+                    else prev + sval
         for seg in ctx.searcher.segments:
             match = np.zeros(seg.doc_count, bool)
             scores = np.zeros(seg.doc_count, np.float32)
@@ -1882,8 +1889,9 @@ def _shard_join(ctx: ShardContext, q: Query):
         scorer = HostScorer(ctx, seg, 1.0)
         s, m = scorer.eval(q.query)
         m = m & np.asarray([t == q.parent_type for t in seg.types], dtype=bool)
-        for local in np.nonzero(m)[0]:
-            matched_parents[str(seg.ids[local])] = float(s[local])
+        locs = np.nonzero(m)[0]
+        for local, sval in zip(locs.tolist(), s[locs].tolist()):
+            matched_parents[str(seg.ids[local])] = sval
     for seg in ctx.searcher.segments:
         match = np.zeros(seg.doc_count, bool)
         scores = np.zeros(seg.doc_count, np.float32)

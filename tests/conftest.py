@@ -11,8 +11,6 @@ from elasticsearch_tpu.common.jaxenv import force_cpu_platform
 # imported at interpreter startup by a sitecustomize hook — see jaxenv.py.
 force_cpu_platform(n_devices=8)
 
-import os
-
 import numpy as np
 import pytest
 
@@ -23,9 +21,12 @@ def rng():
 
 
 # Device-heavy test modules run under the runtime sanitizer
-# (common/jaxenv.sanitize): transfer-guard in "log" mode (implicit host syncs
-# show up in captured stderr without failing unrelated assertions) plus
-# compile-event counting. Set ESTPU_COMPILE_BUDGET=<n> to turn the count into
+# (common/jaxenv.sanitize): transfer-guard HARD "disallow" (the tpulint
+# TPU001 baseline is empty — every hot-path pull is an explicit
+# jax.device_get/.tolist() batch now, so any implicit transfer is a
+# regression and raises) plus compile-event counting. Env knobs, both read
+# by sanitize() itself: ESTPU_SANITIZE=log is the debugging escape hatch
+# (warn instead of raise); ESTPU_COMPILE_BUDGET=<n> makes the compile count
 # a hard per-test ceiling — the runtime twin of tpulint TPU001/TPU002.
 _SANITIZED_MODULES = {
     "test_pallas_kernels",
@@ -44,7 +45,5 @@ def jax_sanitizer(request):
         return
     from elasticsearch_tpu.common.jaxenv import sanitize
 
-    budget = os.environ.get("ESTPU_COMPILE_BUDGET")
-    with sanitize(max_compiles=int(budget) if budget else None,
-                  transfers="log") as report:
+    with sanitize() as report:
         yield report

@@ -99,3 +99,68 @@ def test_sanitizer_off_mode_is_inert():
     x = jnp.ones(4)
     with sanitize(transfers="off"):
         assert np.isfinite(float(x.sum()))  # implicit pull allowed when off
+
+
+def test_env_escape_hatch_log_mode(monkeypatch):
+    """ESTPU_SANITIZE=log downgrades the default hard guard to warn-only —
+    the debugging escape hatch documented in jaxenv.sanitize()."""
+    monkeypatch.setenv("ESTPU_SANITIZE", "log")
+    x = jnp.arange(4, dtype=jnp.float32)
+    with sanitize() as rep:
+        val = float(x[0])  # tpulint: ignore[TPU001] — must only WARN under log
+    assert val == 0.0
+    assert isinstance(rep, SanitizerReport)
+
+
+def test_env_compile_budget_is_hard(monkeypatch):
+    """ESTPU_COMPILE_BUDGET is enforced (not just counted) when sanitize()
+    is entered without an explicit max_compiles — the conftest gate's knob."""
+    monkeypatch.setenv("ESTPU_COMPILE_BUDGET", "0")
+    with pytest.raises(CompileBudgetExceeded):
+        with sanitize(transfers="off"):
+            jax.jit(lambda x: x * 7.5 + 0.25)(jnp.ones(6)).block_until_ready()
+
+
+# ---------------------------------------------------------------------------
+# the SPMD collective path on a 1-device mesh: runtime + static, together
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_collective_path_warm_and_tpu006_clean(shard_ctx):
+    """The serving loop over the shard_map'd program (psum DFS + all_gather
+    reduce, parallel/mesh_search.py) on a 1-DEVICE mesh: after warming, a
+    repeat of the same search must run with 0 recompiles and no implicit
+    transfers under the hard guard. Statically, the deduped tpulint corpus
+    check over the collective paths (mesh_serving + mesh_search) must carry
+    0 TPU006 findings — the dynamic and static halves of the same invariant."""
+    import os as _os
+
+    from jax.sharding import Mesh
+
+    from elasticsearch_tpu.parallel.mesh_search import (
+        MeshSearchExecutor,
+        build_sharded_index,
+    )
+    from elasticsearch_tpu.search import parse_query
+    from elasticsearch_tpu.search.execute import lower_flat
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("shards",))
+    sidx = build_sharded_index([shard_ctx.searcher], fields=["body"], mesh=mesh)
+    ex = MeshSearchExecutor(sidx, mesh, similarity="BM25")
+    plan = lower_flat(parse_query({"match": {"body": "quick brown fox"}}),
+                      shard_ctx)
+    assert plan is not None
+    warm = ex.search([plan], k=5)  # first run compiles freely
+    with sanitize(max_compiles=0, transfers="disallow") as rep:
+        again = ex.search([plan], k=5)  # the warmed serving loop
+    assert rep.compiles == 0, rep.compile_events
+    np.testing.assert_array_equal(again.doc, warm.doc)
+    np.testing.assert_array_equal(again.totals, warm.totals)
+
+    from tools.tpulint import lint_paths
+
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    paths = [_os.path.join(repo, "elasticsearch_tpu", "parallel", f)
+             for f in ("mesh_serving.py", "mesh_search.py")]
+    tpu006 = [f for f in lint_paths(paths) if f.rule == "TPU006"]
+    assert tpu006 == [], [f.to_dict() for f in tpu006]

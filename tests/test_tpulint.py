@@ -26,7 +26,8 @@ from tools.tpulint import lint_paths, load_baseline  # noqa: E402
 from tools.tpulint.engine import diff_baseline, parse_file  # noqa: E402
 
 FIXDIR = os.path.join(REPO, "tests", "tpulint_fixtures")
-RULES = ["TPU001", "TPU002", "TPU003", "TPU004", "TPU005"]
+RULES = ["TPU001", "TPU002", "TPU003", "TPU004", "TPU005",
+         "TPU006", "TPU007", "TPU008", "TPU009"]
 
 
 def _marked_lines(path: str) -> set:
@@ -76,6 +77,53 @@ def test_unparseable_file_is_skipped(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# the interprocedural engine: hazards the file-local engine missed
+# ---------------------------------------------------------------------------
+
+
+def test_interproc_device_return_branch_hazard():
+    """TPU001 rule d through the call graph: branching on a value returned by
+    a jnp-producing helper (one and two hops) — the file-local engine kept
+    device_names empty for the caller and missed both lines."""
+    path = os.path.join(FIXDIR, "tp_tpu001_interproc.py")
+    flagged = {f.line for f in lint_paths([path]) if f.rule == "TPU001"}
+    assert flagged == _marked_lines(path), sorted(flagged)
+
+
+def test_interproc_factory_not_device_returning(tmp_path):
+    """A factory returning a device-producing CLOSURE is not itself
+    device-returning — nested-def bodies must not be attributed to the parent
+    (regression: the branch `if g:` on the returned function object must stay
+    silent)."""
+    src = tmp_path / "factory_case.py"
+    src.write_text(
+        "import jax.numpy as jnp\n"
+        "def make_scorer():\n"
+        "    def inner():\n"
+        "        return jnp.zeros(3)\n"
+        "    return inner\n"
+        "def hot():\n"
+        "    g = make_scorer()\n"
+        "    if g:\n"
+        "        return 1\n"
+        "    return 0\n")
+    assert [f for f in lint_paths([str(src)]) if f.rule == "TPU001"] == []
+
+
+def test_interproc_cross_module_tracer_leak():
+    """TPU003 across modules: a jit root in one file imports and calls a
+    helper whose closure-append leak lives in another file. The helper alone
+    is silent (nothing traced); together, the project-wide traced closure
+    flags the leak IN THE HELPER FILE."""
+    helper = os.path.join(FIXDIR, "tp_xmod_tpu003_helper.py")
+    root = os.path.join(FIXDIR, "tp_xmod_tpu003_root.py")
+    assert [f for f in lint_paths([helper]) if f.rule == "TPU003"] == []
+    both = [f for f in lint_paths([helper, root]) if f.rule == "TPU003"]
+    assert [(f.path.rsplit("/", 1)[-1], f.line) for f in both] == \
+        [("tp_xmod_tpu003_helper.py", 17)], [f.to_dict() for f in both]
+
+
+# ---------------------------------------------------------------------------
 # the repo gate (this IS the CI enforcement)
 # ---------------------------------------------------------------------------
 
@@ -89,8 +137,16 @@ def test_repo_clean_under_baseline():
         + "\n  ".join(f"{f.key}  {f.message}" for f in new))
 
 
+def test_baseline_is_empty_and_stays_empty():
+    """PR 2 burned the 20 grandfathered TPU001 findings down to zero; the
+    baseline must never regrow (new findings already fail
+    test_repo_clean_under_baseline — this pins the EMPTY state itself)."""
+    assert load_baseline() == set(), (
+        "baseline.json regrew — fix the findings instead of grandfathering")
+
+
 def test_baseline_entries_not_stale_in_bulk():
-    """A mostly-stale baseline means line numbers drifted wholesale (e.g. a
+    """A mostly-stale baseline means fingerprints drifted wholesale (e.g. a
     big refactor) — regenerate it so the grandfather list stays honest."""
     findings = lint_paths(None)
     baseline = load_baseline()
@@ -99,6 +155,68 @@ def test_baseline_entries_not_stale_in_bulk():
         assert len(stale) < max(3, len(baseline) // 2), (
             f"{len(stale)}/{len(baseline)} baseline entries no longer fire — "
             "run `python -m tools.tpulint --update-baseline`")
+
+
+# ---------------------------------------------------------------------------
+# fingerprint-stable baseline
+# ---------------------------------------------------------------------------
+
+
+_VIOLATION = ("import jax.numpy as jnp\n"
+              "def f(xs):\n"
+              "    return xs.item()\n")
+
+
+def test_fingerprint_survives_line_shift(tmp_path):
+    """Inserting lines ABOVE a grandfathered finding must not invalidate the
+    baseline (the PR-1 path:line:rule keys broke on every unrelated edit)."""
+    from tools.tpulint.engine import lint_paths as lp, save_baseline
+
+    src = tmp_path / "mod.py"
+    src.write_text(_VIOLATION)
+    first = lp([str(src)])
+    assert len(first) == 1 and first[0].rule == "TPU001"
+    bl = tmp_path / "bl.json"
+    save_baseline(first, str(bl))
+    # unrelated edit above the finding: line number moves, fingerprint doesn't
+    src.write_text("# a new comment\nX = 1\n" + _VIOLATION)
+    shifted = lp([str(src)])
+    assert shifted[0].line == first[0].line + 2
+    new, stale = diff_baseline(shifted, load_baseline(str(bl)))
+    assert new == [] and stale == []
+
+
+def test_fingerprint_duplicate_lines_occurrence_indexed(tmp_path):
+    """Two identical violating lines get distinct #n-suffixed fingerprints so
+    fixing one of them cannot hide the other behind the baseline."""
+    src = tmp_path / "dup.py"
+    src.write_text("def f(a, b):\n"
+                   "    x = a.item()\n"
+                   "    y = b.item()\n"
+                   "    x = a.item()\n"
+                   "    return x, y\n")
+    fs = lint_paths([str(src)])
+    fps = [f.fingerprint for f in fs if f.rule == "TPU001"]
+    assert len(fps) == len(set(fps)) == 3, fps
+    assert sum(1 for fp in fps if "#" in fp) == 1  # the repeated line
+
+
+def test_old_format_baseline_migrates_on_load(tmp_path):
+    """PR-1 path:line:rule baselines load as fingerprints (one-shot) so the
+    gate never breaks mid-upgrade."""
+    import json as _json
+
+    from tools.tpulint.engine import REPO as _REPO
+
+    src = tmp_path / "legacy.py"
+    src.write_text(_VIOLATION)
+    rel = os.path.relpath(str(src), _REPO).replace(os.sep, "/")
+    bl = tmp_path / "old.json"
+    bl.write_text(_json.dumps({"findings": [f"{rel}:3:TPU001"]}))
+    migrated = load_baseline(str(bl))
+    findings = lint_paths([str(src)])
+    new, _stale = diff_baseline(findings, migrated)
+    assert new == [], (migrated, [f.fingerprint for f in findings])
 
 
 # ---------------------------------------------------------------------------
@@ -132,7 +250,29 @@ def test_cli_json_shape():
     for key in ("findings", "new", "grandfathered", "stale_baseline", "ok"):
         assert key in data
     for f in data["findings"]:
-        assert set(f) == {"path", "line", "rule", "message", "key"}
+        assert set(f) == {"path", "line", "rule", "message", "key",
+                          "fingerprint"}
+
+
+def test_cli_github_format_annotations():
+    """--format github emits one ::error workflow-annotation line per NEW
+    finding, parseable by GitHub Actions with no extra tooling."""
+    tp = os.path.join(FIXDIR, "tp_tpu001.py")
+    res = _run_cli("--format", "github", "--no-baseline", tp)
+    lines = [ln for ln in res.stdout.splitlines() if ln]
+    assert lines and all(ln.startswith("::error file=") for ln in lines)
+    assert all(",line=" in ln and "title=tpulint TPU" in ln and "::" in ln[8:]
+               for ln in lines)
+
+
+def test_cli_exit_code_contract():
+    """0 = clean (and ALWAYS 0 without --check), 1 = --check with new
+    findings, 2 = usage error — documented in the module docstring."""
+    tp = os.path.join(FIXDIR, "tp_tpu001.py")
+    assert _run_cli("--no-baseline", tp).returncode == 0  # findings, no --check
+    assert _run_cli("--check", "--no-baseline", tp).returncode == 1
+    assert _run_cli("--json", "--format", "text").returncode == 2
+    assert _run_cli("--update-baseline", tp).returncode == 2
 
 
 def test_cli_rules_table():

@@ -1,0 +1,61 @@
+"""tpulint fixture — FALSE positives for TPU006: everything here must stay
+silent. Mirrors the real SPMD idioms in parallel/mesh_search.py: collectives
+over declared mesh axes inside shard_map'd functions, the escaping-closure
+factory pattern, and dynamic axis names the analyzer can't prove wrong.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("replicas", "shards"))
+
+
+def mapped_ok(x):
+    # direct shard_map target, declared axes — silent
+    total = jax.lax.psum(jnp.sum(x), "shards")
+    gathered = jax.lax.all_gather(x, "replicas")
+    idx = jax.lax.axis_index("shards")
+    return total, gathered, idx
+
+
+def reduce_helper(x):
+    # covered transitively from mapped_ok2 — silent
+    return jax.lax.psum(x, "shards")
+
+
+def mapped_ok2(x):
+    return reduce_helper(x * 2)
+
+
+def make_program(k: int):
+    # the factory pattern: the closure escapes via return, some caller
+    # shard_maps it later (mesh_search._mesh_score_program) — benefit of
+    # the doubt, silent
+    def program(x):
+        return jax.lax.psum(x * k, "shards")
+
+    return program
+
+
+def dynamic_axis(x, axis_name):
+    # covered (shard_map'd below) and the axis is dynamic — not provably
+    # wrong, silent
+    return jax.lax.psum(x, axis_name)
+
+
+def run(x):
+    f = shard_map(mapped_ok, mesh=mesh, in_specs=(P("shards"),),
+                  out_specs=(P(), P(), P()))
+    g = shard_map(mapped_ok2, mesh=mesh, in_specs=(P("shards"),), out_specs=P())
+    h = shard_map(make_program(3), mesh=mesh, in_specs=(P("shards"),),
+                  out_specs=P())
+    d = shard_map(dynamic_axis, mesh=mesh, in_specs=(P("shards"), None),
+                  out_specs=P())
+    return f(x), g(x), h(x), d(x, "shards")

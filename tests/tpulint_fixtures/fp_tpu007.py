@@ -1,0 +1,42 @@
+"""tpulint fixture — FALSE positives for TPU007: everything here must stay
+silent. Mirrors mesh_search's real spec construction: matching arity,
+declared axes, dynamically-built spec lists, *args programs.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("replicas", "shards"))
+
+
+def two_arg_program(docs, freqs):
+    return docs + freqs
+
+
+def vararg_program(docs, *extra):
+    return docs
+
+
+def build(has_extra: bool):
+    # matching arity, declared axes — silent
+    f = shard_map(two_arg_program, mesh=mesh,
+                  in_specs=(P("shards"), P("shards")), out_specs=P())
+    # *args target: arity open — silent
+    g = shard_map(vararg_program, mesh=mesh,
+                  in_specs=(P("shards"), P("shards"), P()), out_specs=P())
+    # dynamically-assembled specs (the mesh_search idiom) — silent
+    specs = [P("shards"), P("shards")]
+    if has_extra:
+        specs.append(P())
+    h = shard_map(two_arg_program, mesh=mesh, in_specs=tuple(specs),
+                  out_specs=P())
+    # PartitionSpec with declared axes, incl. NamedSharding placement — silent
+    sharding = NamedSharding(mesh, P("replicas", "shards"))
+    empty = P()
+    return f, g, h, sharding, empty

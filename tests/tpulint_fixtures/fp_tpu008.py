@@ -1,0 +1,42 @@
+"""tpulint fixture — FALSE positives for TPU008: everything here must stay
+silent. The standard donation idioms: rebind the name to the result, read
+BEFORE donating, donate different buffers per call, loop-carried rebinds.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _step(state, xs):
+    return state + xs.sum()
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def decorated_step(state, xs):
+    return state * 2 + xs
+
+
+def rebind_idiom(state, xs):
+    step = jax.jit(_step, donate_argnums=(0,))
+    state = step(state, xs)  # rebinding revives the name
+    return state + 1
+
+
+def read_before_donate(state, xs):
+    checksum = jnp.sum(state)  # reads strictly before the donating call
+    step = jax.jit(_step, donate_argnums=(0,))
+    return step(state, xs), checksum
+
+
+def loop_carried(state, batches):
+    for xs in batches:
+        state = decorated_step(state, xs)  # rebound every iteration
+    return state
+
+
+def non_donating_wrapper(state, xs):
+    plain = jax.jit(_step)  # no donate_* — reads after the call are fine
+    out = plain(state, xs)
+    return out, state + 1

@@ -1,0 +1,37 @@
+"""tpulint fixture — FALSE positives for TPU009: everything here must stay
+silent. Explicit-dtype trace-time constants (the DL_TABLE idiom), jnp
+constructors (x64-governed, not numpy-default), and numpy-default
+constructions OUTSIDE any traced region (host-side assembly is allowed to be
+int64 — it gets cast at the device_put boundary on purpose).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def decorated_kernel(x):
+    table = np.arange(256, dtype=np.uint8)  # explicit dtype — silent
+    bias = np.zeros(x.shape[0], dtype=np.float32)  # explicit dtype — silent
+    acc = jnp.zeros(x.shape[0])  # jnp: governed by jax_enable_x64 — silent
+    return x + jnp.asarray(table)[0] + jnp.asarray(bias) + acc
+
+
+def host_side_assembly(entries):
+    # NOT traced (never jitted, not called from a jit root): host numpy with
+    # default dtypes is the normal packing idiom — silent
+    offsets = np.zeros(len(entries) + 1)
+    counts = np.arange(len(entries))
+    return offsets, counts
+
+
+def _helper(x):
+    return x * jnp.float32(2.0)  # f32 scalar — silent
+
+
+def wrapper(x):
+    return _helper(x)
+
+
+fn = jax.jit(wrapper)

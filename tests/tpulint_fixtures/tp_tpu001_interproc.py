@@ -1,0 +1,43 @@
+"""tpulint fixture — TRUE positives for TPU001's INTERPROCEDURAL extension.
+
+The PR-1 file-local engine analyzed each function in isolation: a branch on a
+value produced by a helper call was invisible because only direct `jnp.*`
+assignments marked a name as device-resident. The two `TP` lines here were
+verified to be MISSED by the file-local engine (device_names empty for
+`decide`) and are caught by the pass-1 device-returning fixpoint: `_device_total`
+returns a jnp call, `_two_hops` returns `_device_total(...)` one hop further.
+
+Never imported: parsed by tests/test_tpulint.py; exact `TP` line agreement.
+"""
+
+import jax.numpy as jnp
+
+
+def _device_total(xs):
+    return jnp.sum(xs)
+
+
+def _two_hops(xs):
+    return _device_total(xs * 2)
+
+
+def decide(xs):
+    total = _device_total(xs)
+    if total > 0:  # TP: branch on a device value produced ONE CALL AWAY
+        return 1
+    hopped = _two_hops(xs)
+    while hopped:  # TP: device value through TWO call hops (fixpoint)
+        break
+    return 0
+
+
+def host_path(xs):
+    # a helper that returns a HOST value (tolist) must not poison the branch
+    vals = _host_list(xs)
+    if vals:  # silent: _host_list returns .tolist(), not a device value
+        return len(vals)
+    return 0
+
+
+def _host_list(xs):
+    return jnp.asarray(xs).tolist()

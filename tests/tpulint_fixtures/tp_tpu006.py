@@ -1,0 +1,52 @@
+"""tpulint fixture — TRUE positives for TPU006 (SPMD collective hazards).
+
+Never imported: parsed by tests/test_tpulint.py. Every `TP`-marked line must
+be flagged with TPU006; exact line agreement is asserted, so this file is the
+rule's behavioral spec.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+mesh = Mesh(np.array(jax.devices()[:4]), ("shards",))
+
+
+def mapped_wrong_axis(x):
+    # inside shard_map (passed by name below) but the axis name doesn't exist
+    local = jnp.sum(x)
+    return jax.lax.psum(local, "shardz")  # TP: no Mesh declares axis "shardz"
+
+
+def mapped_wrong_axis_gather(x):
+    return jax.lax.all_gather(x, axis_name="replicaz")  # TP: unknown mesh axis
+
+
+def not_mapped_at_all(x):
+    # this function is called directly (below) and never shard_map'd: there is
+    # no named axis here at runtime
+    return jax.lax.psum(jnp.sum(x), "shards")  # TP: collective outside shard_map
+
+
+def helper_reached_from_mapped(x):
+    # covered transitively: mapped_entry (shard_map'd) calls this — the axis
+    # check still applies through the call graph
+    return jax.lax.pmax(x, "bad_axis")  # TP: unknown axis via transitive cover
+
+
+def mapped_entry(x):
+    return helper_reached_from_mapped(jnp.abs(x))
+
+
+def run(x):
+    f = shard_map(mapped_wrong_axis, mesh=mesh, in_specs=None, out_specs=None)
+    g = shard_map(mapped_wrong_axis_gather, mesh=mesh, in_specs=None,
+                  out_specs=None)
+    h = shard_map(mapped_entry, mesh=mesh, in_specs=None, out_specs=None)
+    return f(x), g(x), h(x), not_mapped_at_all(x)

@@ -1,0 +1,30 @@
+"""tpulint fixture — TRUE positives for TPU007 (shard_map spec drift).
+
+Never imported: parsed by tests/test_tpulint.py; exact `TP` line agreement.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+mesh = Mesh(np.array(jax.devices()[:2]), ("shards",))
+
+
+def two_arg_program(docs, freqs):
+    return docs + freqs
+
+
+def build():
+    f = shard_map(two_arg_program, mesh=mesh,  # TP: 3 in_specs, 2 params
+                  in_specs=(P("shards"), P("shards"), P("shards")),
+                  out_specs=P())
+    g = shard_map(two_arg_program, mesh=mesh,  # TP: 1 in_spec, 2 params
+                  in_specs=(P("shards"),),
+                  out_specs=P())
+    bad_spec = P("replicaz")  # TP: no Mesh declares axis "replicaz"
+    return f, g, bad_spec

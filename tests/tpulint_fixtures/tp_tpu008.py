@@ -1,0 +1,36 @@
+"""tpulint fixture — TRUE positives for TPU008 (use-after-donate).
+
+Never imported: parsed by tests/test_tpulint.py; exact `TP` line agreement.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _step(state, xs):
+    return state + xs.sum()
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def decorated_step(state, xs):
+    return state * 2 + xs
+
+
+def wrapper_donation(state, xs):
+    step = jax.jit(_step, donate_argnums=(0,))
+    new_state = step(state, xs)
+    stale = state + 1  # TP: `state` was donated to `step` above
+    return new_state, stale
+
+
+def kwarg_donation(state, xs):
+    step = jax.jit(_step, donate_argnames=("state",))
+    new_state = step(state=state, xs=xs)
+    return jnp.sum(state), new_state  # TP: donated-by-name buffer re-read
+
+
+def decorated_donation(state, xs):
+    out = decorated_step(state, xs)
+    return out, state.shape  # TP: read after donation to decorated_step

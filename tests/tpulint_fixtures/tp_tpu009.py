@@ -1,0 +1,30 @@
+"""tpulint fixture — TRUE positives for TPU009 (dtype drift into jit regions).
+
+Never imported: parsed by tests/test_tpulint.py; exact `TP` line agreement.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def decorated_kernel(x):
+    table = np.arange(256)  # TP: numpy default int64 inside a jit region
+    bias = np.zeros(x.shape[0])  # TP: numpy default float64
+    return x + jnp.asarray(table)[0] + jnp.asarray(bias)
+
+
+def _helper_reached_from_jit(x):
+    # traced transitively: wrapper (jitted below) calls this
+    scale = np.full(4, 0.5)  # TP: default float64 one call away from the jit
+    return x * jnp.asarray(scale)
+
+
+def wrapper(x):
+    y = jnp.asarray(x, dtype=jnp.float64)  # TP: explicit f64 dtype in trace
+    w = np.float64(2.0) * 1.0  # TP: f64 scalar cast in trace
+    return _helper_reached_from_jit(y) * w
+
+
+fn = jax.jit(wrapper)

@@ -1,0 +1,18 @@
+"""tpulint fixture — the HELPER half of the cross-MODULE TPU003 pair.
+
+`leaky_accumulate` appends to a module-level list. Linted ALONE this file is
+silent — nothing in it is jitted, and the PR-1 engine (module-local traced
+closure) could never flag it. Linted TOGETHER with tp_xmod_tpu003_root.py
+(which jits a function that imports and calls this one), the project-wide
+traced closure marks it traced and the `TP` line must fire.
+
+Never imported: parsed by tests/test_tpulint.py.
+"""
+
+_TRACE_LOG = []
+
+
+def leaky_accumulate(x):
+    y = x * 2
+    _TRACE_LOG.append(y)  # TP (only with the root file): closure-append leak
+    return y

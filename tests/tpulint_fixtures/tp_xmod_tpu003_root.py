@@ -1,0 +1,20 @@
+"""tpulint fixture — the ROOT half of the cross-MODULE TPU003 pair.
+
+`kernel` is jitted here and calls `leaky_accumulate` imported from
+tp_xmod_tpu003_helper.py. The PR-1 engine resolved the traced closure within
+one module only, so the helper's closure-append leak was invisible; the
+project-wide call graph follows the import and flags it IN THE HELPER FILE.
+
+Never imported: parsed by tests/test_tpulint.py.
+"""
+
+import jax
+
+from tp_xmod_tpu003_helper import leaky_accumulate
+
+
+def kernel(x):
+    return leaky_accumulate(x) + 1
+
+
+fn = jax.jit(kernel)

@@ -1,0 +1,6 @@
+"""Developer tooling for the elasticsearch_tpu tree (tpulint lives here).
+
+A real package (not a namespace package) so setuptools' package discovery
+finds `tools.tpulint` and the `tpulint` console script resolves after
+`pip install -e .`.
+"""

@@ -7,26 +7,47 @@ uncached retraces, and no device dispatch while holding an engine lock.
 tpulint makes regressions against those invariants a CI failure, the way
 TSan/ASan guard a training stack.
 
+The engine is two-pass and interprocedural: pass 1 (tools/tpulint/project.py)
+builds a repo-wide symbol table, call graph, and device-context propagation
+(jit/shard_map regions flow through helper calls, across modules); pass 2 runs
+the rule families over it.
+
 Rule families (each in tools/tpulint/rules/):
 
   TPU001  implicit host sync   — float()/int()/bool()/.item()/np.asarray pulls
-                                 of device values inside hot-path modules
+                                 of device values inside hot-path modules, and
+                                 inside any function reachable from a traced
+                                 region; device-ness follows helper returns
   TPU002  retrace hazard       — jax.jit re-wrapped per call, or jitted
                                  functions fed varying Python scalars /
                                  unhashable static args
   TPU003  tracer leak          — tracers escaping jitted code via self./global
-                                 assignment or closure appends
+                                 assignment or closure appends; the traced
+                                 closure crosses module boundaries
   TPU004  lock hazard          — lock-acquisition-order cycles and device
                                  dispatch performed while holding a lock
   TPU005  platform drift       — JAX_PLATFORMS / jax_platforms writes outside
                                  common/jaxenv.py
+  TPU006  SPMD collectives     — psum/all_gather/... axis names must name a
+                                 Mesh axis; collectives outside any shard_map
+                                 region are errors
+  TPU007  shard_map specs      — in_specs/out_specs arity vs the mapped
+                                 function's signature; PartitionSpec axis
+                                 validity
+  TPU008  use-after-donate     — donate_argnums/argnames buffers read after
+                                 the donating call
+  TPU009  dtype drift          — numpy-default/float64 constructions inside
+                                 jit/shard_map regions
 
 Usage:
-    python -m tools.tpulint --check [--json] [--baseline PATH] [paths...]
+    python -m tools.tpulint --check [--format text|json|github]
+                            [--baseline PATH] [paths...]
 
-Findings are keyed `path:line:rule`. tools/tpulint/baseline.json grandfathers
-pre-existing violations: new findings fail `--check`, fixed ones are reported
-so the baseline can be burned down (see ARCHITECTURE.md "tpulint").
+Findings display as `path:line:rule`; the baseline keys them by refactor-stable
+`path:rule:normalized-source-line` fingerprints. tools/tpulint/baseline.json
+grandfathers pre-existing violations: new findings fail `--check`, fixed ones
+are reported so the baseline can be burned down. The baseline is EMPTY as of
+PR 2 — keep it empty (see ARCHITECTURE.md "tpulint").
 
 Suppress a single line with  `# tpulint: ignore[TPU00N]`  (or a bare
 `# tpulint: ignore` for all rules).

@@ -1,8 +1,21 @@
-"""CLI: python -m tools.tpulint [--check] [--json] [--baseline P] [--update-baseline] [paths...]
+"""CLI: python -m tools.tpulint [--check] [--format F] [--baseline P] [paths...]
+(also installed as the `tpulint` console script — see pyproject.toml).
 
-Exit codes: 0 = clean (no findings outside the baseline); 1 = new findings;
-2 = usage error. Without --check, findings are printed but the exit code is 0
-unless --check is given (so ad-hoc runs over fixtures don't fail shells).
+Exit-code contract (stable; CI and the pre-push hook depend on it):
+
+  0  clean — no findings outside the baseline (without --check, ALWAYS 0 so
+     ad-hoc runs over fixtures don't fail shells)
+  1  --check given and at least one NEW (non-grandfathered) finding exists
+  2  usage error (bad flag combination, e.g. --update-baseline with paths)
+
+Output formats (--format, default text; --json is an alias for --format json):
+
+  text    one `path:line:RULE [NEW] message` line per finding + a stderr tally
+  json    machine-readable object: findings (with refactor-stable
+          fingerprints), new, grandfathered, stale_baseline, ok
+  github  GitHub Actions workflow annotations — `::error` for new findings,
+          `::warning` for grandfathered ones — so the gate renders inline on
+          PR diffs with no extra tooling
 
 Stale baseline entries (grandfathered findings that no longer fire) are
 reported on stderr as a nudge to shrink baseline.json — they never fail the
@@ -25,16 +38,56 @@ from .engine import (
 from .rules import RULE_DOCS
 
 
+def _emit_text(findings, new_keys, baseline, stale):
+    for f in findings:
+        tag = "" if f.fingerprint in baseline else " [NEW]"
+        print(f"{f.key}{tag}  {f.message}")
+    print(f"{len(findings)} finding(s): {len(new_keys)} new, "
+          f"{len(findings) - len(new_keys)} grandfathered", file=sys.stderr)
+    if stale:
+        print(f"{len(stale)} stale baseline entr(y/ies) — safe to remove:",
+              file=sys.stderr)
+        for k in stale:
+            print(f"  {k}", file=sys.stderr)
+
+
+def _emit_json(findings, new, stale):
+    json.dump({
+        "findings": [f.to_dict() for f in findings],
+        "new": [f.key for f in new],
+        "grandfathered": sorted({f.key for f in findings} - {f.key for f in new}),
+        "stale_baseline": stale,
+        "ok": not new,
+    }, sys.stdout, indent=1)
+    print()
+
+
+def _emit_github(findings, new_fps):
+    """::error/::warning annotation lines (GitHub Actions workflow commands).
+    Newlines can't appear in the message; the rule id rides in title=."""
+    for f in findings:
+        level = "error" if f.fingerprint in new_fps else "warning"
+        msg = f.message.replace("\n", " ")
+        print(f"::{level} file={f.path},line={f.line},"
+              f"title=tpulint {f.rule}::{msg}")
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="tools.tpulint",
-        description="JAX/TPU hot-path static analyzer (TPU001-TPU005)")
+        description="JAX/TPU hot-path static analyzer (TPU001-TPU009)",
+        epilog="exit codes: 0 clean, 1 new findings (--check only), "
+               "2 usage error")
     ap.add_argument("paths", nargs="*",
                     help="files to lint (default: elasticsearch_tpu/**/*.py)")
     ap.add_argument("--check", action="store_true",
                     help="exit 1 when findings outside the baseline exist")
+    ap.add_argument("--format", choices=("text", "json", "github"),
+                    default=None, dest="fmt",
+                    help="output format (default text; github = workflow "
+                         "annotations)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="emit machine-readable JSON on stdout")
+                    help="alias for --format json")
     ap.add_argument("--baseline", default=None,
                     help=f"baseline path (default {DEFAULT_BASELINE})")
     ap.add_argument("--no-baseline", action="store_true",
@@ -49,6 +102,11 @@ def main(argv: list[str] | None = None) -> int:
         for rid, doc in sorted(RULE_DOCS.items()):
             print(f"{rid}  {doc}")
         return 0
+
+    if args.fmt and args.as_json and args.fmt != "json":
+        print("--json conflicts with --format " + args.fmt, file=sys.stderr)
+        return 2
+    fmt = args.fmt or ("json" if args.as_json else "text")
 
     full_scope = not args.paths
     if args.update_baseline and not full_scope:
@@ -70,26 +128,12 @@ def main(argv: list[str] | None = None) -> int:
               file=sys.stderr)
         return 0
 
-    if args.as_json:
-        json.dump({
-            "findings": [f.to_dict() for f in findings],
-            "new": [f.key for f in new],
-            "grandfathered": sorted({f.key for f in findings} - {f.key for f in new}),
-            "stale_baseline": stale,
-            "ok": not new,
-        }, sys.stdout, indent=1)
-        print()
+    if fmt == "json":
+        _emit_json(findings, new, stale)
+    elif fmt == "github":
+        _emit_github(findings, {f.fingerprint for f in new})
     else:
-        for f in findings:
-            tag = "" if f.key in baseline else " [NEW]"
-            print(f"{f.key}{tag}  {f.message}")
-        print(f"{len(findings)} finding(s): {len(new)} new, "
-              f"{len(findings) - len(new)} grandfathered", file=sys.stderr)
-        if stale:
-            print(f"{len(stale)} stale baseline entr(y/ies) — safe to remove:",
-                  file=sys.stderr)
-            for k in stale:
-                print(f"  {k}", file=sys.stderr)
+        _emit_text(findings, [f.key for f in new], baseline, stale)
 
     if args.check and new:
         return 1

@@ -1,13 +1,21 @@
 """tpulint engine: file discovery, AST parsing, suppression, baseline diffing.
 
-Rules are pure functions over parsed sources (tools/tpulint/rules/); the engine
+Rules are functions over parsed sources (tools/tpulint/rules/) plus the
+interprocedural Project context (tools/tpulint/project.py — pass 1: repo-wide
+symbol table, call graph, jit/shard_map device-context propagation). The engine
 owns everything rule-independent so each rule stays a small AST walk:
 
 - which files are in scope and what ROLE they play (hot-path for TPU001/002/003,
-  lock-scope for TPU004, platform-exempt for TPU005),
+  lock-scope for TPU004, platform-exempt for TPU005; the SPMD family
+  TPU006-009 keys off the Project's traced/shard_map closures instead),
 - `# tpulint: ignore[RULE]` line suppressions,
 - the baseline diff (new findings fail; fixed-but-still-listed entries are
   reported so the baseline gets burned down, never silently stale).
+
+Baseline entries are keyed by refactor-stable FINGERPRINTS —
+`path:rule:normalized-source-line[#occurrence]` — so edits above a
+grandfathered finding neither invalidate the baseline nor mask regressions;
+old `path:line:rule` baselines migrate one-shot on load (see load_baseline).
 
 Files passed explicitly (the fixture corpus in tests/) take every role, so the
 seeded true/false-positive files exercise each rule without living inside the
@@ -20,7 +28,7 @@ import ast
 import json
 import os
 import re
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -40,8 +48,13 @@ _SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*ignore(?:\[([A-Z0-9, ]+)\])?")
 class Finding:
     path: str  # repo-relative
     line: int
-    rule: str  # "TPU001".."TPU005"
+    rule: str  # "TPU001".."TPU009"
     message: str
+    # refactor-stable baseline key, assigned by lint_files after dedup:
+    # "path:rule:<normalized source line>[#n]" (n disambiguates identical
+    # lines; line NUMBERS never enter the fingerprint, so edits above a
+    # grandfathered finding don't invalidate the baseline)
+    fingerprint: str = ""
 
     @property
     def key(self) -> str:
@@ -49,7 +62,17 @@ class Finding:
 
     def to_dict(self) -> dict:
         return {"path": self.path, "line": self.line, "rule": self.rule,
-                "message": self.message, "key": self.key}
+                "message": self.message, "key": self.key,
+                "fingerprint": self.fingerprint}
+
+
+def normalize_src(line: str) -> str:
+    """Whitespace-insensitive form of a source line for fingerprinting."""
+    return re.sub(r"\s+", " ", line.strip())
+
+
+def _fingerprint_base(path: str, rule: str, src_line: str) -> str:
+    return f"{path}:{rule}:{normalize_src(src_line)}"
 
 
 @dataclass
@@ -108,11 +131,13 @@ def discover_default_paths() -> list[str]:
 
 
 def lint_files(files: list[SourceFile]) -> list[Finding]:
+    from .project import Project
     from .rules import ALL_RULES
 
+    project = Project(files)
     findings: list[Finding] = []
     for rule in ALL_RULES:
-        findings.extend(rule.run(files))
+        findings.extend(rule.run(files, project))
     by_file = {f.relpath: f for f in files}
     kept = [f for f in findings
             if not by_file[f.path].suppressed(f.line, f.rule)]
@@ -120,7 +145,24 @@ def lint_files(files: list[SourceFile]) -> list[Finding]:
     # collapse to one finding, keeping counts consistent with the
     # path:line:rule baseline keys
     kept = list(dict.fromkeys(kept))
-    return sorted(kept, key=lambda f: (f.path, f.line, f.rule))
+    kept = sorted(kept, key=lambda f: (f.path, f.line, f.rule))
+    return _assign_fingerprints(kept, by_file)
+
+
+def _assign_fingerprints(findings: list[Finding],
+                         by_file: dict[str, SourceFile]) -> list[Finding]:
+    """Stamp each finding with its stable baseline key; identical source lines
+    in one file get #1, #2... suffixes in line order so dedup stays exact."""
+    seen: dict[str, int] = {}
+    out = []
+    for f in findings:
+        sf = by_file.get(f.path)
+        src = sf.lines[f.line - 1] if sf and 1 <= f.line <= len(sf.lines) else ""
+        base = _fingerprint_base(f.path, f.rule, src)
+        n = seen.get(base, 0)
+        seen[base] = n + 1
+        out.append(replace(f, fingerprint=base if n == 0 else f"{base}#{n}"))
+    return out
 
 
 def lint_paths(paths: list[str] | None = None) -> list[Finding]:
@@ -138,22 +180,61 @@ DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "baseline.json")
 
 
+_OLD_KEY_RE = re.compile(r"^(?P<path>.+):(?P<line>\d+):(?P<rule>TPU\d{3})$")
+
+
 def load_baseline(path: str | None = None) -> set[str]:
+    """Baseline fingerprints. Version-2 files hold fingerprints verbatim;
+    version-1 files (PR 1's `path:line:rule` keys) are migrated ONE-SHOT by
+    reading each entry's current source line — after any refactor the line
+    numbers are stale, which is exactly why the fingerprint format exists, so
+    entries whose file/line no longer exists simply drop (they'd have been
+    stale anyway)."""
     p = path or DEFAULT_BASELINE
     try:
         with open(p, encoding="utf-8") as f:
             data = json.load(f)
     except OSError:
         return set()
-    return set(data.get("findings", []))
+    entries = data.get("findings", [])
+    if data.get("version", 1) >= 2:
+        return set(entries)
+    migrated: set[str] = set()
+    seen: dict[str, int] = {}
+    for key in sorted(entries, key=_old_key_sort):
+        m = _OLD_KEY_RE.match(key)
+        if not m:
+            migrated.add(key)  # already a fingerprint — pass through
+            continue
+        relpath, line, rule = m.group("path"), int(m.group("line")), m.group("rule")
+        try:
+            with open(os.path.join(REPO, relpath), encoding="utf-8") as f:
+                lines = f.read().splitlines()
+        except OSError:
+            continue
+        if not 1 <= line <= len(lines):
+            continue
+        base = _fingerprint_base(relpath, rule, lines[line - 1])
+        n = seen.get(base, 0)
+        seen[base] = n + 1
+        migrated.add(base if n == 0 else f"{base}#{n}")
+    return migrated
+
+
+def _old_key_sort(key: str):
+    m = _OLD_KEY_RE.match(key)
+    return (m.group("path"), int(m.group("line")), m.group("rule")) if m \
+        else (key, 0, "")
 
 
 def save_baseline(findings: list[Finding], path: str | None = None) -> None:
     p = path or DEFAULT_BASELINE
     with open(p, "w", encoding="utf-8") as f:
         json.dump({"comment": "grandfathered tpulint findings — burn down, "
-                              "never add (new violations fail --check)",
-                   "findings": sorted({f2.key for f2 in findings})},
+                              "never add (new violations fail --check); keys "
+                              "are path:rule:normalized-line fingerprints",
+                   "version": 2,
+                   "findings": sorted({f2.fingerprint for f2 in findings})},
                   f, indent=1)
         f.write("\n")
 
@@ -161,7 +242,7 @@ def save_baseline(findings: list[Finding], path: str | None = None) -> None:
 def diff_baseline(findings: list[Finding],
                   baseline: set[str]) -> tuple[list[Finding], list[str]]:
     """(new findings not grandfathered, stale baseline keys no longer firing)."""
-    keys = {f.key for f in findings}
-    new = [f for f in findings if f.key not in baseline]
-    stale = sorted(baseline - keys)
+    fps = {f.fingerprint for f in findings}
+    new = [f for f in findings if f.fingerprint not in baseline]
+    stale = sorted(baseline - fps)
     return new, stale

@@ -1,5 +1,5 @@
 """tpulint rule registry — one module per rule family, each exposing
-RULE_ID, a one-line DOC, and run(files) -> list[Finding]."""
+RULE_ID, a one-line DOC, and run(files, project) -> list[Finding]."""
 
 from . import (
     tpu001_host_sync,
@@ -7,6 +7,10 @@ from . import (
     tpu003_tracer_leak,
     tpu004_locks,
     tpu005_platform,
+    tpu006_collectives,
+    tpu007_shard_specs,
+    tpu008_donate,
+    tpu009_dtype_drift,
 )
 
 ALL_RULES = [
@@ -15,6 +19,10 @@ ALL_RULES = [
     tpu003_tracer_leak,
     tpu004_locks,
     tpu005_platform,
+    tpu006_collectives,
+    tpu007_shard_specs,
+    tpu008_donate,
+    tpu009_dtype_drift,
 ]
 
 RULE_DOCS = {r.RULE_ID: r.DOC for r in ALL_RULES}

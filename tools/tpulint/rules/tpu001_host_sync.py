@@ -19,6 +19,16 @@ Rules b/c are shape heuristics, not type inference: they also fire on host
 numpy arrays, where the per-element loop is still the slow idiom and the
 `.tolist()` fix is identical. Suppress deliberate cases with
 `# tpulint: ignore[TPU001]`.
+
+Interprocedural (pass 2 over project.py's call graph):
+
+  e. rule d follows helper calls one or more hops: `t = helper(x)` marks `t`
+     as a device value when `helper` (resolved module-locally or through
+     imports) transitively returns a `jnp.*`/`lax.*` result — the file-local
+     engine only saw direct jnp assignments and missed the branch hazard.
+  f. the a-d checks also run inside functions OUTSIDE hot files when they are
+     reachable from a jit/shard_map region (project.traced): a host sync
+     there executes under tracing no matter which file it lives in.
 """
 
 from __future__ import annotations
@@ -52,17 +62,24 @@ def _dotted(node: ast.AST) -> tuple[str, ...] | None:
 class _FuncVisitor(ast.NodeVisitor):
     """Per-function walk tracking loop depth and jnp-produced names."""
 
-    def __init__(self, sf: SourceFile, out: list[Finding]):
+    def __init__(self, sf: SourceFile, out: list[Finding],
+                 device_fns: set[str] = frozenset()):
         self.sf = sf
         self.out = out
         self.loop_depth = 0
         self.device_names: set[str] = set()
+        # names of helpers (local or imported) that transitively return a
+        # jnp/lax value — assignments from them propagate device-ness (rule e)
+        self.device_fns = device_fns
 
     # -- device-name dataflow (single-assignment heuristic) ------------------
     def visit_Assign(self, node: ast.Assign):
         if isinstance(node.value, ast.Call):
             d = _dotted(node.value.func)
-            if d and d[0] in ("jnp", "lax") and d[-1] != "asarray":
+            produces_device = d and (
+                (d[0] in ("jnp", "lax") and d[-1] != "asarray")
+                or (len(d) == 1 and d[0] in self.device_fns))
+            if produces_device:
                 for t in node.targets:
                     if isinstance(t, ast.Name):
                         self.device_names.add(t.id)
@@ -131,16 +148,24 @@ class _FuncVisitor(ast.NodeVisitor):
     visit_AsyncFunctionDef = visit_FunctionDef
 
 
-def run(files: list[SourceFile]) -> list[Finding]:
+def run(files: list[SourceFile], project=None) -> list[Finding]:
     out: list[Finding] = []
     for sf in files:
-        if not sf.hot:
+        device_fns = (project.device_returning_names(sf)
+                      if project is not None else frozenset())
+        if sf.hot:
+            scopes: list = [sf.tree]
+            scopes.extend(n for n in ast.walk(sf.tree)
+                          if isinstance(n, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)))
+        elif project is not None:
+            # rule f: device context flowed here through the call graph — a
+            # host sync inside a traced helper is a hazard wherever it lives
+            scopes = [fi.node for fi in project.traced_functions_in(sf)]
+        else:
             continue
-        scopes: list = [sf.tree]
-        scopes.extend(n for n in ast.walk(sf.tree)
-                      if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
         for scope in scopes:
-            v = _FuncVisitor(sf, out)
+            v = _FuncVisitor(sf, out, device_fns)
             for stmt in scope.body:
                 v.visit(stmt)
     return out

@@ -172,7 +172,7 @@ def _check_unhashable_args(sf: SourceFile, out: list[Finding]):
                     break
 
 
-def run(files: list[SourceFile]) -> list[Finding]:
+def run(files: list[SourceFile], project=None) -> list[Finding]:
     out: list[Finding] = []
     for sf in files:
         if not sf.hot:

@@ -2,11 +2,14 @@
 
 A tracer stored on `self`, a global, or a closed-over list outlives its trace
 and detonates later as a LeakedTracerError (or, worse, silently holds the whole
-trace-time graph alive). The traced scope here is computed transitively: a
-function is "traced" if it is decorated with jit, passed to jax.jit by name,
-or reachable through direct calls from such a function within the module —
-matching the scoring.py idiom where `jax.jit(wrapper)` wraps a closure that
-calls `_score_batch_impl` → `_dense_accumulate` → ...
+trace-time graph alive). The traced scope is the PROJECT-WIDE transitive
+closure (tools/tpulint/project.py): a function is "traced" if it is decorated
+with jit/shard_map, passed to jax.jit / shard_map by name, or reachable through
+resolved calls from such a function — across module boundaries, so the
+scoring.py idiom (`jax.jit(wrapper)` wrapping a closure that calls
+`_score_batch_impl` → `_dense_accumulate` → ...) AND a leaky helper imported
+from another file are both covered. The PR-1 engine resolved calls only within
+one module and missed the imported-helper case.
 
 Inside traced functions this rule flags:
 
@@ -26,59 +29,6 @@ RULE_ID = "TPU003"
 DOC = "tracer leak: self/global assignment or closure append inside jitted code"
 
 _MUTATORS = {"append", "extend", "add"}
-
-
-def _is_jit_name(node: ast.AST) -> bool:
-    return (isinstance(node, ast.Attribute) and node.attr == "jit") or \
-        (isinstance(node, ast.Name) and node.id == "jit")
-
-
-def _collect_functions(tree: ast.Module) -> dict[str, list[ast.AST]]:
-    """Every def in the file by name — a LIST per name, because nested helper
-    names recur (two closures both called `traced`); tracing must reach all."""
-    out: dict[str, list[ast.AST]] = {}
-    for n in ast.walk(tree):
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            out.setdefault(n.name, []).append(n)
-    return out
-
-
-def _traced_roots(tree: ast.Module, fns: dict[str, ast.AST]) -> set[str]:
-    roots: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for d in node.decorator_list:
-                if _is_jit_name(d) or (isinstance(d, ast.Call)
-                                       and (_is_jit_name(d.func)
-                                            or any(_is_jit_name(a)
-                                                   for a in d.args))):
-                    roots.add(node.name)
-        elif isinstance(node, ast.Call) and _is_jit_name(node.func):
-            for a in node.args:
-                if isinstance(a, ast.Name) and a.id in fns:
-                    roots.add(a.id)
-    return roots
-
-
-def _called_names(fn: ast.AST) -> set[str]:
-    return {n.func.id for n in ast.walk(fn)
-            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name)}
-
-
-def _traced_closure(tree: ast.Module) -> list[tuple[str, ast.AST]]:
-    """Transitive closure of traced functions over the intra-module call graph
-    (by-name resolution: every def sharing a traced name is analyzed)."""
-    fns = _collect_functions(tree)
-    pending = list(_traced_roots(tree, fns))
-    traced: set[str] = set()
-    while pending:
-        name = pending.pop()
-        if name in traced or name not in fns:
-            continue
-        traced.add(name)
-        for node in fns[name]:
-            pending.extend(c for c in _called_names(node) if c in fns)
-    return [(n, node) for n in sorted(traced) for node in fns[n]]
 
 
 def _locals_of(fn: ast.AST) -> set[str]:
@@ -118,45 +68,51 @@ def _locals_of(fn: ast.AST) -> set[str]:
     return out
 
 
-def run(files: list[SourceFile]) -> list[Finding]:
-    out: list[Finding] = []
-    for sf in files:
-        if not sf.hot:
-            continue
-        for name, fn in _traced_closure(sf.tree):
-            globals_decl: set[str] = set()
-            local_names = _locals_of(fn)
-            nested = {n for n in ast.walk(fn)
-                      if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-                      and n is not fn}
-            nested_nodes = {id(x) for inner in nested for x in ast.walk(inner)}
-            for node in ast.walk(fn):
-                if id(node) in nested_nodes:
-                    continue  # nested defs analyzed via their own traced entry
-                if isinstance(node, ast.Global):
-                    globals_decl.update(node.names)
-                elif isinstance(node, ast.Assign):
-                    for t in node.targets:
-                        if isinstance(t, ast.Attribute) and \
-                                isinstance(t.value, ast.Name) and t.value.id == "self":
-                            out.append(Finding(
-                                sf.relpath, node.lineno, RULE_ID,
-                                f"assignment to self.{t.attr} inside traced "
-                                f"function `{name}` leaks tracers into object "
-                                "state"))
-                        elif isinstance(t, ast.Name) and t.id in globals_decl:
-                            out.append(Finding(
-                                sf.relpath, node.lineno, RULE_ID,
-                                f"assignment to global `{t.id}` inside traced "
-                                f"function `{name}` leaks tracers"))
-                elif isinstance(node, ast.Call) and \
-                        isinstance(node.func, ast.Attribute) and \
-                        node.func.attr in _MUTATORS and \
-                        isinstance(node.func.value, ast.Name) and \
-                        node.func.value.id not in local_names:
+def _check_traced_fn(sf: SourceFile, name: str, fn: ast.AST,
+                     out: list[Finding]) -> None:
+    globals_decl: set[str] = set()
+    local_names = _locals_of(fn)
+    nested = {n for n in ast.walk(fn)
+              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+              and n is not fn}
+    nested_nodes = {id(x) for inner in nested for x in ast.walk(inner)}
+    for node in ast.walk(fn):
+        if id(node) in nested_nodes:
+            continue  # nested defs analyzed via their own traced entry
+        if isinstance(node, ast.Global):
+            globals_decl.update(node.names)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and t.value.id == "self":
                     out.append(Finding(
                         sf.relpath, node.lineno, RULE_ID,
-                        f".{node.func.attr}() on closed-over "
-                        f"`{node.func.value.id}` inside traced function "
-                        f"`{name}` leaks tracers out of the trace"))
+                        f"assignment to self.{t.attr} inside traced "
+                        f"function `{name}` leaks tracers into object "
+                        "state"))
+                elif isinstance(t, ast.Name) and t.id in globals_decl:
+                    out.append(Finding(
+                        sf.relpath, node.lineno, RULE_ID,
+                        f"assignment to global `{t.id}` inside traced "
+                        f"function `{name}` leaks tracers"))
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id not in local_names:
+            out.append(Finding(
+                sf.relpath, node.lineno, RULE_ID,
+                f".{node.func.attr}() on closed-over "
+                f"`{node.func.value.id}` inside traced function "
+                f"`{name}` leaks tracers out of the trace"))
+
+
+def run(files: list[SourceFile], project=None) -> list[Finding]:
+    out: list[Finding] = []
+    if project is None:
+        return out
+    for sf in files:
+        for fi in sorted(project.traced_functions_in(sf),
+                         key=lambda fi: (fi.qualname, fi.node.lineno)):
+            _check_traced_fn(sf, fi.name, fi.node, out)
     return out

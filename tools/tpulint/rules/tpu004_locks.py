@@ -192,7 +192,7 @@ def _cycle_edges(edges: dict[tuple[str, str], tuple[str, int]]) -> list[tuple]:
     return out
 
 
-def run(files: list[SourceFile]) -> list[Finding]:
+def run(files: list[SourceFile], project=None) -> list[Finding]:
     out: list[Finding] = []
     in_scope = [sf for sf in files if sf.lock_scope]
     if not in_scope:

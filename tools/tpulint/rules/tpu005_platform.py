@@ -48,7 +48,7 @@ def _flag(out, sf, node, msg):
     out.append(Finding(sf.relpath, node.lineno, RULE_ID, msg))
 
 
-def run(files: list[SourceFile]) -> list[Finding]:
+def run(files: list[SourceFile], project=None) -> list[Finding]:
     out: list[Finding] = []
     for sf in files:
         if not sf.platform_checked:

@@ -1,0 +1,143 @@
+"""TPU006 — SPMD collective axis-name consistency.
+
+The mesh query path (parallel/mesh_search.py) is one shard_map'd program whose
+DFS phase psums term stats over the "shards" axis and whose reduce phase rides
+all_gather. Two ways that silently breaks:
+
+  a. a collective (`psum`/`pmax`/`all_gather`/`axis_index`/...) naming an axis
+     that no `Mesh(...)` in the project declares — an unbound-axis error at
+     trace time at best, a collective over the WRONG axis after a mesh-layout
+     refactor at worst. Axis arguments that are string literals (or tuples of
+     them) are checked against the project's literal mesh axes; when the
+     enclosing shard_map's `mesh=` argument resolves to a specific Mesh
+     construction, the check narrows to that mesh's axes.
+  b. a collective in a function that is never inside any shard_map region —
+     outside shard_map there is no named axis to reduce over, so the call
+     raises (or, pasted into a jit-only path, never ran where the author
+     thought). "Inside" is interprocedural (project.shard_map_covered):
+     functions passed to shard_map by name, their transitive callees across
+     modules, and factory-made closures that escape their builder
+     (mesh_search._mesh_score_program returns `program`; benefit of the doubt).
+
+Functions that merely escape into unresolvable call sites are NOT flagged —
+static analysis can't see a dynamic shard_map wrap, and a false "outside
+shard_map" error on the one real SPMD program would poison the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, SourceFile
+
+RULE_ID = "TPU006"
+DOC = "collective axis not a mesh axis / collective outside any shard_map region"
+
+_COLLECTIVES = {"psum", "pmax", "pmin", "pmean", "all_gather", "all_to_all",
+                "ppermute", "pshuffle", "psum_scatter", "axis_index",
+                "axis_size"}
+# axis argument position per collective (0-based, after the data operand(s))
+_AXIS_KWARGS = {"axis_name", "axis_index_groups"}
+
+
+def _dotted(node: ast.AST) -> tuple[str, ...] | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _axis_literals(node: ast.AST) -> list[str] | None:
+    """Literal axis name(s) from an axis argument, or None when dynamic."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append(el.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _collective_axis_arg(call: ast.Call, name: str) -> ast.AST | None:
+    """The axis-name argument of a collective call, if present."""
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    # positional: axis_index(axis_name) is arg 0, everything else arg 1
+    pos = 0 if name in ("axis_index", "axis_size") else 1
+    if len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+def run(files: list[SourceFile], project=None) -> list[Finding]:
+    out: list[Finding] = []
+    if project is None:
+        return out
+    axes = project.mesh_axes
+    for sf in files:
+        covered_nodes = set()
+        all_fn_nodes = {}
+        for fi2 in project.functions:
+            if fi2.sf is sf:
+                all_fn_nodes[id(fi2.node)] = fi2
+                if fi2.fid in project.shard_map_covered:
+                    covered_nodes.add(id(fi2.node))
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.stack: list[int] = []  # id()s of enclosing fn nodes
+
+            def _visit_fn(self, node):
+                self.stack.append(id(node))
+                self.generic_visit(node)
+                self.stack.pop()
+
+            visit_FunctionDef = visit_AsyncFunctionDef = _visit_fn
+
+            def visit_Call(self, node: ast.Call):
+                d = _dotted(node.func)
+                if d and d[-1] in _COLLECTIVES and len(d) >= 2 \
+                        and d[-2] == "lax":
+                    self._check(node, d[-1])
+                self.generic_visit(node)
+
+            def _check(self, node: ast.Call, name: str):
+                in_covered = any(fnid in covered_nodes for fnid in self.stack)
+                if not in_covered:
+                    enclosing = next(
+                        (all_fn_nodes[fnid].qualname for fnid in
+                         reversed(self.stack) if fnid in all_fn_nodes),
+                        "<module>")
+                    out.append(Finding(
+                        sf.relpath, node.lineno, RULE_ID,
+                        f"lax.{name}(...) in `{enclosing}` which is never "
+                        "inside a shard_map region — there is no named mesh "
+                        "axis here; wrap the caller in shard_map or drop the "
+                        "collective"))
+                    return
+                axis_arg = _collective_axis_arg(node, name)
+                if axis_arg is None:
+                    return
+                names = _axis_literals(axis_arg)
+                if names is None or not axes:
+                    return  # dynamic axis / no literal meshes — can't validate
+                for ax in names:
+                    if ax not in axes:
+                        out.append(Finding(
+                            sf.relpath, node.lineno, RULE_ID,
+                            f"lax.{name}(..., {ax!r}): no Mesh in the project "
+                            f"declares axis {ax!r} (known axes: "
+                            f"{sorted(axes)}) — the collective would not "
+                            "bind to the enclosing shard_map's mesh"))
+
+        V().visit(sf.tree)
+    return out

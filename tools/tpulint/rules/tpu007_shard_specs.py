@@ -1,0 +1,99 @@
+"""TPU007 — shard_map spec shape: in/out_specs vs signature, axis validity.
+
+`shard_map(f, mesh=m, in_specs=..., out_specs=...)` fails at trace time — or
+worse, silently replicates an array that was meant to be sharded — when the
+spec tuple drifts out of sync with `f`'s signature after a refactor, or when a
+`PartitionSpec` names an axis the mesh doesn't have. Statically checkable
+whenever the pieces are literal:
+
+  a. `in_specs` literal tuple/list length != the positional-parameter count of
+     `f` (resolved through the project symbol table; skipped when `f` takes
+     *args or is unresolvable, and when in_specs is built dynamically — the
+     mesh_search executor assembles its spec list imperatively and is
+     deliberately out of scope).
+  b. every `PartitionSpec`/`P` call whose string arguments name an axis no
+     `Mesh(...)` in the project declares — applied everywhere (NamedSharding
+     placements drift the same way), not just inside shard_map calls.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, SourceFile
+
+RULE_ID = "TPU007"
+DOC = "shard_map in/out_specs arity mismatch / PartitionSpec names unknown mesh axis"
+
+_SM_NAMES = {"shard_map", "pjit", "xmap"}
+_PSPEC_NAMES = {"PartitionSpec", "P"}
+
+
+def _dotted_last(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _positional_arity(fn: ast.AST) -> int | None:
+    """Positional-parameter count, or None when *args makes arity open."""
+    if fn.args.vararg is not None:
+        return None
+    n = len(fn.args.posonlyargs) + len(fn.args.args)
+    # methods: self/cls are not mapped-over operands — but shard_map'd
+    # functions are free functions in practice; keep the raw count and let
+    # resolution-by-name stay conservative
+    return n
+
+
+def run(files: list[SourceFile], project=None) -> list[Finding]:
+    out: list[Finding] = []
+    if project is None:
+        return out
+    axes = project.mesh_axes
+    from ..project import module_name
+
+    for sf in files:
+        mod = module_name(sf.relpath)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted_last(node.func)
+            # b. PartitionSpec axis validity (everywhere literal meshes exist)
+            if name in _PSPEC_NAMES and axes:
+                for a in node.args:
+                    if isinstance(a, ast.Constant) and isinstance(a.value, str) \
+                            and a.value not in axes:
+                        out.append(Finding(
+                            sf.relpath, node.lineno, RULE_ID,
+                            f"PartitionSpec({a.value!r}): no Mesh in the "
+                            f"project declares axis {a.value!r} (known axes: "
+                            f"{sorted(axes)})"))
+                continue
+            # a. shard_map arity
+            if name not in _SM_NAMES or not node.args:
+                continue
+            fn_arg = node.args[0]
+            if not isinstance(fn_arg, ast.Name):
+                continue
+            fids = project.resolve(mod, (fn_arg.id,))
+            arities = {_positional_arity(project.functions[fid].node)
+                       for fid in fids}
+            arities.discard(None)
+            if not arities:
+                continue
+            in_specs = next((kw.value for kw in node.keywords
+                             if kw.arg == "in_specs"), None)
+            if isinstance(in_specs, (ast.Tuple, ast.List)):
+                n_specs = len(in_specs.elts)
+                if all(n_specs != a for a in arities):
+                    out.append(Finding(
+                        sf.relpath, node.lineno, RULE_ID,
+                        f"shard_map in_specs has {n_specs} entr"
+                        f"{'y' if n_specs == 1 else 'ies'} but "
+                        f"`{fn_arg.id}` takes "
+                        f"{sorted(arities)} positional parameter(s) — specs "
+                        "and signature drifted"))
+    return out

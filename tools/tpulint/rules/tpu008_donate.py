@@ -1,0 +1,171 @@
+"""TPU008 — use-after-donate: reading a buffer after jit donated it.
+
+`donate_argnums`/`donate_argnames` lets XLA alias an input buffer into the
+output — the input is DEAD after the call. Reading it again returns garbage on
+TPU (and raises only under some backends/modes), the worst kind of
+works-on-CPU bug. Per function body this rule tracks:
+
+  - wrappers built with donation: `w = jax.jit(f, donate_argnums=(0,))`,
+    `@partial(jax.jit, donate_argnames=("state",))` decorated defs, resolved
+    module-locally (by name) like every other tpulint dataflow;
+  - calls through them: the argument NAME bound to a donated position/keyword
+    is marked dead at the call line;
+  - any later Name read of a dead buffer in the same function → finding.
+    Rebinding the name (assignment, for-target) revives it — the usual
+    `state = step(state, x)` donation idiom stays silent.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, SourceFile
+
+RULE_ID = "TPU008"
+DOC = "use-after-donate: donated jit buffer read after the donating call"
+
+
+def _dotted(node: ast.AST) -> tuple[str, ...] | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _is_jit(node: ast.AST) -> bool:
+    d = _dotted(node)
+    return bool(d) and d[-1] == "jit"
+
+
+def _donation(call: ast.Call) -> tuple[tuple[int, ...], tuple[str, ...]] | None:
+    """(donated positional indices, donated kwarg names) of a jit(...) call
+    carrying donate_*, with literal int/str tuples; None when not donating."""
+    nums: list[int] = []
+    names: list[str] = []
+    for kw in call.keywords:
+        if kw.arg not in ("donate_argnums", "donate_argnames"):
+            continue
+        vals = kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List)) \
+            else [kw.value]
+        for v in vals:
+            if isinstance(v, ast.Constant):
+                if isinstance(v.value, int):
+                    nums.append(v.value)
+                elif isinstance(v.value, str):
+                    names.append(v.value)
+    if not nums and not names:
+        return None
+    return tuple(nums), tuple(names)
+
+
+def _donating_jit_call(node: ast.AST):
+    """jit(..., donate_*) | partial(jit, ..., donate_*) -> donation spec."""
+    if not isinstance(node, ast.Call):
+        return None
+    if _is_jit(node.func):
+        return _donation(node)
+    d = _dotted(node.func)
+    if d and d[-1] == "partial" and node.args and _is_jit(node.args[0]):
+        return _donation(node)
+    return None
+
+
+def _collect_donors(sf: SourceFile) -> dict[str, tuple]:
+    """name -> donation spec for SHARED scopes: decorated defs anywhere and
+    module-level wrapper assignments. Wrapper locals (`step = jax.jit(...)`
+    inside a function) are function-scoped — two functions can bind the same
+    name to different donation specs — so _BodyVisitor registers those as it
+    walks each body."""
+    donors: dict[str, tuple] = {}
+    for node in ast.iter_child_nodes(sf.tree):
+        if isinstance(node, ast.Assign):
+            spec = _donating_jit_call(node.value)
+            if spec:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        donors[t.id] = spec
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                spec = _donating_jit_call(deco)
+                if spec:
+                    donors[node.name] = spec
+    return donors
+
+
+class _BodyVisitor(ast.NodeVisitor):
+    """Line-ordered walk of one function: donation kills names, reads of dead
+    names report, rebinds revive."""
+
+    def __init__(self, sf: SourceFile, donors: dict[str, tuple],
+                 out: list[Finding]):
+        self.sf = sf
+        self.donors = dict(donors)  # own copy: local wrappers join per body
+        self.out = out
+        self.dead: dict[str, tuple[str, int]] = {}  # name -> (wrapper, line)
+
+    def visit_Call(self, node: ast.Call):
+        # arguments are read BEFORE the call kills them
+        self.generic_visit(node)
+        if isinstance(node.func, ast.Name) and node.func.id in self.donors:
+            nums, names = self.donors[node.func.id]
+            for i in nums:
+                if i < len(node.args) and isinstance(node.args[i], ast.Name):
+                    self.dead[node.args[i].id] = (node.func.id, node.lineno)
+            for kw in node.keywords:
+                if kw.arg in names and isinstance(kw.value, ast.Name):
+                    self.dead[kw.value.id] = (node.func.id, node.lineno)
+
+    def visit_Assign(self, node: ast.Assign):
+        self.visit(node.value)
+        spec = _donating_jit_call(node.value)
+        for t in node.targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Name):
+                    self.dead.pop(sub.id, None)
+                    if spec:  # function-local donating wrapper
+                        self.donors[sub.id] = spec
+
+    def visit_For(self, node):
+        for sub in ast.walk(node.target):
+            if isinstance(sub, ast.Name):
+                self.dead.pop(sub.id, None)
+        self.generic_visit(node)
+
+    visit_AsyncFor = visit_For
+
+    def visit_Name(self, node: ast.Name):
+        if isinstance(node.ctx, ast.Load) and node.id in self.dead:
+            wrapper, line = self.dead[node.id]
+            self.out.append(Finding(
+                self.sf.relpath, node.lineno, RULE_ID,
+                f"`{node.id}` was donated to `{wrapper}` on line {line} — its "
+                "buffer is aliased into the output and reading it is "
+                "undefined; use the call's result instead"))
+            # one report per kill keeps the signal reviewable
+            del self.dead[node.id]
+
+    def visit_FunctionDef(self, node):
+        pass  # nested defs run later, after this frame's locals rebind
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def run(files: list[SourceFile], project=None) -> list[Finding]:
+    out: list[Finding] = []
+    for sf in files:
+        if not any(_donating_jit_call(n) for n in ast.walk(sf.tree)
+                   if isinstance(n, ast.Call)):
+            continue  # no donation anywhere in this file
+        donors = _collect_donors(sf)
+        fns = [n for n in ast.walk(sf.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for fn in fns:
+            v = _BodyVisitor(sf, donors, out)
+            for stmt in fn.body:
+                v.visit(stmt)
+    return out

@@ -1,0 +1,105 @@
+"""TPU009 — dtype drift: float64/numpy-default dtypes reaching jit regions.
+
+JAX runs x64-disabled here: a numpy array built with the DEFAULT dtype
+(float64/int64) inside a traced region silently downcasts at the jit boundary
+— and every distinct weak/strong dtype mix is a fresh trace signature, so the
+drift also burns the executable cache (the TPU002 failure mode, entered through
+a dtype instead of a shape). With x64 on it is worse: the whole program
+silently runs in f64 at half the FLOPs. Inside the PROJECT-WIDE traced closure
+(jit/shard_map roots + transitive callees, tools/tpulint/project.py) this rule
+flags:
+
+  a. numpy constructors with no dtype= — np.array/asarray/zeros/ones/full/
+     empty/arange/eye/linspace (np.asarray of an existing array preserves its
+     dtype, but of a Python list/scalar it manufactures float64 — at trace
+     time both become baked-in constants, so the explicit dtype is the only
+     version that survives review);
+  b. explicit float64: dtype="float64"/np.float64/jnp.float64 arguments and
+     np.float64(...)/jnp.float64(...) casts.
+
+Trace-time-constant numpy is legal and common (lookup tables, masks) — the fix
+is never "remove numpy", it is `dtype=np.float32` (or int32/bool) so the
+constant matches what the TPU program actually computes in.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, SourceFile
+
+RULE_ID = "TPU009"
+DOC = "dtype drift: numpy-default/float64 construction inside a jit/shard_map region"
+
+_NP_CTORS = {"array", "asarray", "zeros", "ones", "full", "empty", "arange",
+             "eye", "linspace"}
+_NP_MODULES = {"np", "numpy"}
+
+
+def _dotted(node: ast.AST) -> tuple[str, ...] | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _is_f64(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and node.value in ("float64", "double"):
+        return True
+    d = _dotted(node)
+    return bool(d) and d[-1] in ("float64", "double")
+
+
+def _check_call(sf: SourceFile, node: ast.Call, where: str,
+                out: list[Finding]) -> None:
+    d = _dotted(node.func)
+    if not d:
+        return
+    dtype_kw = next((kw.value for kw in node.keywords if kw.arg == "dtype"),
+                    None)
+    # b. explicit float64 anywhere in the call
+    if d[-1] in ("float64", "double") and d[0] in _NP_MODULES | {"jnp", "jax"}:
+        out.append(Finding(
+            sf.relpath, node.lineno, RULE_ID,
+            f"{'.'.join(d)}(...) inside traced `{where}` — an f64 value in an "
+            "x64-disabled program silently downcasts (and retraces); build "
+            "f32 directly"))
+        return
+    if dtype_kw is not None and _is_f64(dtype_kw):
+        out.append(Finding(
+            sf.relpath, node.lineno, RULE_ID,
+            f"dtype=float64 passed to {'.'.join(d)}() inside traced "
+            f"`{where}` — use float32 (x64 is disabled; f64 constants "
+            "downcast at the jit boundary)"))
+        return
+    # a. numpy constructor with the default dtype
+    if d[0] in _NP_MODULES and d[-1] in _NP_CTORS and dtype_kw is None:
+        out.append(Finding(
+            sf.relpath, node.lineno, RULE_ID,
+            f"{'.'.join(d)}() with no dtype= inside traced `{where}` — numpy "
+            "defaults to float64/int64, which downcasts (or retraces) at the "
+            "jit boundary; pass dtype=np.float32/int32 explicitly"))
+
+
+def run(files: list[SourceFile], project=None) -> list[Finding]:
+    out: list[Finding] = []
+    if project is None:
+        return out
+    for sf in files:
+        for fi in sorted(project.traced_functions_in(sf),
+                         key=lambda fi: fi.node.lineno):
+            nested = {id(x)
+                      for n in ast.walk(fi.node)
+                      if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                      and n is not fi.node
+                      for x in ast.walk(n)}
+            for node in ast.walk(fi.node):
+                if id(node) in nested:
+                    continue  # nested traced defs get their own entry
+                if isinstance(node, ast.Call):
+                    _check_call(sf, node, fi.qualname, out)
+    return out
