@@ -26,7 +26,10 @@ import threading
 import time
 import uuid
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
 
+from .common.deadline import NO_DEADLINE, Deadline
+from .common.retry import RetryPolicy
 from .common.errors import (
     ActionNotFoundError,
     DocumentMissingError,
@@ -154,6 +157,11 @@ class ActionModule:
         self._pinned: dict[int, tuple] = {}  # cid -> (expiry, index, shard, ctx)
         self._pinned_lock = threading.Lock()
         self._pinned_next = [1]
+        # write-path retry schedule (replica fan-out, shard-failed reports):
+        # transient transport failures back off with decorrelated jitter, then
+        # exhaustion is REPORTED to the master — never swallowed (tests swap in
+        # a faster policy)
+        self.retry_policy = RetryPolicy(max_attempts=3, base_s=0.05, cap_s=1.0)
         t = self.transport
         # master-node actions
         for action, fn in [
@@ -1020,9 +1028,14 @@ class ActionModule:
             pass
         return {"ok": True}
 
+    REPLICA_OP_TIMEOUT = 30.0
+
     def _replicate(self, index: str, shard_id: int, action: str, request: dict):
         """Fan the op to every assigned replica concurrently, wait for all acks
-        (sync replication default); failures fail the shard upward
+        (sync replication default). Transient failures retry through the write
+        retry policy (backoff + jitter); on exhaustion the copy is reported
+        shard-failed to the master so it gets routed out and resynced — a
+        swallowed replica failure is silent divergence until the next recovery
         (ref: :245 fan-out + ShardStateAction on replica error)."""
         state = self.cluster_service.state
         group = state.routing_table.index(index).shard(shard_id)
@@ -1033,19 +1046,52 @@ class ActionModule:
             node = state.nodes.get(replica.node_id)
             if node is None:
                 continue
-            futs.append((replica, self.transport.send_request(node, action, request)))
-        for replica, fut in futs:
+            futs.append((replica, node,
+                         self.transport.send_request(node, action, request)))
+        for replica, node, fut in futs:
             try:
-                fut_result(fut, 30.0)
+                self._await_replica_op(node, action, request, fut)
             except SearchEngineError as e:
-                self.logger.warning("replica [%s][%d] on %s failed: %s — reporting",
-                                    index, shard_id, replica.node_id, e)
-                try:
-                    self.transport.submit_request(
-                        self.node.local_node, ACTION_SHARD_FAILED,
-                        {"shard": replica.to_dict(), "reason": str(e)}, timeout=10.0)
-                except SearchEngineError:
-                    pass
+                self._report_replica_failed(index, shard_id, replica, e)
+
+    def _await_replica_op(self, node, action: str, request: dict, first_fut=None):
+        """Wait for one replica's ack (first attempt may already be in flight
+        for fan-out concurrency; retries re-send sequentially with backoff).
+        The WHOLE retry sequence shares one REPLICA_OP_TIMEOUT deadline — a
+        downed replica costs a synchronous write the same worst-case wait as
+        the pre-retry single attempt did, not attempts x timeout."""
+        deadline = Deadline.after(self.REPLICA_OP_TIMEOUT)
+        pending = [first_fut] if first_fut is not None else []
+
+        def one_attempt():
+            # blocking wait — fut_result bounds it, no per-request timer
+            budget = deadline.clamp(self.REPLICA_OP_TIMEOUT)
+            fut = pending.pop() if pending else \
+                self.transport.send_request(node, action, request)
+            return fut_result(fut, budget)
+
+        return self.retry_policy.call(one_attempt, deadline=deadline,
+                                      describe=f"replica op [{action}]")
+
+    def _report_replica_failed(self, index: str, shard_id: int, replica, error):
+        """Mark a replica copy failed on the master (ref: ShardStateAction).
+        The report itself retries; if even that exhausts, log at ERROR — the
+        one thing this path must never do is stay silent."""
+        self.logger.warning("replica [%s][%d] on %s failed: %s — reporting "
+                            "shard-failed", index, shard_id, replica.node_id, error)
+        try:
+            self.retry_policy.call(
+                lambda: self.transport.submit_request(
+                    self.node.local_node, ACTION_SHARD_FAILED,
+                    {"shard": replica.to_dict(), "reason": str(error)},
+                    timeout=10.0),
+                deadline=Deadline.after(20.0),
+                describe="shard-failed report")
+        except SearchEngineError as e:
+            self.logger.error(
+                "could not report shard-failed for [%s][%d] on %s (%s); the "
+                "copy may serve stale reads until the next cluster-state "
+                "change or recovery", index, shard_id, replica.node_id, e)
 
     def bulk(self, operations: list[dict], refresh=False) -> dict:
         """Coordinator: group ops per (index, shard) → one A_BULK_SHARD per group
@@ -1086,18 +1132,41 @@ class ActionModule:
         # all shard sub-batches in flight at once (ref: TransportBulkAction fans
         # TransportShardBulkAction per shard asynchronously)
         bulk_futs = []
-        for (index, shard_id), items in by_shard.items():
-            group = state.routing_table.index(index).shard(shard_id)
+
+        def primary_node(st, index, shard_id):
+            group = st.routing_table.index(index).shard(shard_id)
             primary = group.primary
-            node = state.nodes.get(primary.node_id) if primary and primary.assigned else None
-            if node is None:
-                for i, item in items:
-                    results[i] = {"error": "primary unavailable", "status": 503, **item}
-                continue
+            return st.nodes.get(primary.node_id) \
+                if primary and primary.assigned else None
+
+        def dispatch_group(node, index, shard_id, items):
             bulk_futs.append((items, self.transport.send_request(
                 node, A_BULK_SHARD,
                 {"index": index, "shard": shard_id, "refresh": refresh,
                  "items": [item for _, item in items]})))
+
+        unrouted = []
+        for (index, shard_id), items in by_shard.items():
+            node = primary_node(state, index, shard_id)
+            if node is None:
+                unrouted.append(((index, shard_id), items))
+                continue
+            dispatch_group(node, index, shard_id, items)
+        if unrouted:
+            # one retry against a FRESH cluster state: an unassigned primary is
+            # usually mid-failover, and the next published state names its new
+            # home (ref: TransportBulkAction retrying unavailable primaries on
+            # cluster-state change)
+            time.sleep(0.1)
+            state = self.cluster_service.state
+            for (index, shard_id), items in unrouted:
+                node = primary_node(state, index, shard_id)
+                if node is None:
+                    for i, item in items:
+                        results[i] = {"error": "primary unavailable",
+                                      "status": 503, **item}
+                else:
+                    dispatch_group(node, index, shard_id, items)
         for items, fut in bulk_futs:
             try:
                 resp = fut_result(fut, 60.0)
@@ -1142,7 +1211,11 @@ class ActionModule:
                 out.append({"_index": index, "_type": item.get("type"),
                             "_id": item.get("id"), "error": e.to_dict(),
                             "status": e.status, "op": op})
-        # replicas get individual replicated ops (simple + idempotent via versions)
+        # replicas get individual replicated ops (simple + idempotent via
+        # versions). Transient failures retry with backoff; when a replica
+        # exhausts its retries it is reported shard-failed and the REST of the
+        # stream to that copy stops — recovery resyncs the whole copy, and
+        # silently skipping ops would leave it diverged from the primary
         state = self.cluster_service.state
         group = state.routing_table.index(index).shard(shard_id)
         for replica in group.replicas():
@@ -1154,21 +1227,25 @@ class ActionModule:
             for item, r in zip(request["items"], out):
                 if "error" in r:
                     continue
+                if item.get("op") in ("index", "create", "update"):
+                    rep_action, rep_req = A_INDEX_REPLICA, {
+                        "index": index, "shard": shard_id, "type": item["type"],
+                        "id": item["id"], "source": item.get("source") or {},
+                        "routing": item.get("routing"),
+                        "version": r.get("_version"), "version_type": "external",
+                    }
+                elif item.get("op") == "delete":
+                    rep_action, rep_req = A_DELETE_REPLICA, {
+                        "index": index, "shard": shard_id, "type": item["type"],
+                        "id": item["id"],
+                    }
+                else:
+                    continue
                 try:
-                    if item.get("op") in ("index", "create", "update"):
-                        self.transport.submit_request(node, A_INDEX_REPLICA, {
-                            "index": index, "shard": shard_id, "type": item["type"],
-                            "id": item["id"], "source": item.get("source") or {},
-                            "routing": item.get("routing"),
-                            "version": r.get("_version"), "version_type": "external",
-                        }, timeout=30.0)
-                    elif item.get("op") == "delete":
-                        self.transport.submit_request(node, A_DELETE_REPLICA, {
-                            "index": index, "shard": shard_id, "type": item["type"],
-                            "id": item["id"],
-                        }, timeout=30.0)
-                except SearchEngineError:
-                    pass
+                    self._await_replica_op(node, rep_action, rep_req)
+                except SearchEngineError as e:
+                    self._report_replica_failed(index, shard_id, replica, e)
+                    break
         if request.get("refresh"):
             shard.engine.refresh()
         shard.engine.maybe_flush()
@@ -1398,7 +1475,7 @@ class ActionModule:
 
     # ================= scatter-gather search =================
     def search(self, index_expr, body: dict | None = None, search_type="query_then_fetch",
-               routing=None, preference=None) -> dict:
+               routing=None, preference=None, deadline: Deadline | None = None) -> dict:
         t0 = time.monotonic()
         state = self.cluster_service.state
         indices = state.metadata.resolve_indices(index_expr)
@@ -1410,6 +1487,13 @@ class ActionModule:
         # then sees identical literal values (ref: TermsFilterParser lookup)
         body = resolve_terms_lookups(body, self._lookup_get)
         req = parse_search_body(body)
+        # ONE deadline for the whole request (REST `?timeout=` / body `timeout`):
+        # every per-attempt transport timeout, failover-chain cap, and per-shard
+        # segment clamp below derives from its REMAINING budget — k slow hops
+        # run down one clock instead of stacking k fresh timeouts
+        if deadline is None:
+            deadline = Deadline.after(req.timeout_s) if req.timeout_s is not None \
+                else NO_DEADLINE
         shards = self.routing.search_shards(state, indices, routing, preference)
 
         # co-located shards + flat query → one SPMD program over the device mesh
@@ -1442,7 +1526,7 @@ class ActionModule:
                 })) for copy in shards]
             dfs_results = []
             for ordinal, (copy, fut) in enumerate(dfs_futs):
-                r = self._dfs_shard_result(state, copy, body, fut)
+                r = self._dfs_shard_result(state, copy, body, fut, deadline)
                 if r is None:
                     dfs_failed.add(ordinal)
                     continue
@@ -1470,16 +1554,18 @@ class ActionModule:
         # (ref: TransportSearchTypeAction.java:135-216 async performFirstPhase)
         query_futs = [
             None if ordinal in dfs_failed else
-            self._query_shard_async(state, copy, body, alias_filters, dfs_stats)
+            self._query_shard_async(state, copy, body, alias_filters, dfs_stats,
+                                    deadline)
             for ordinal, copy in enumerate(shards)]
-        # shared deadline: chains resolve themselves (every attempt is timer-bounded),
-        # so this is a backstop — without sharing it, k hung shards would stack k
-        # fresh waits instead of running down one clock. Scale it to the longest
-        # possible failover chain so a chain with many hung copies can't outlive it.
+        # shared backstop: chains resolve themselves (every attempt is
+        # timer-bounded), so this only catches a wedged chain — scaled to the
+        # longest possible failover chain, and clamped by the request deadline
+        # (plus grace for in-flight partials to land) when one is set.
         max_chain = max((getattr(f, "max_attempts", 1) for f in query_futs
                          if f is not None), default=1)
-        deadline = (time.monotonic()
-                    + self.QUERY_ATTEMPT_TIMEOUT * max(1, max_chain) + 5.0)
+        backstop = deadline.clamp(
+            self.QUERY_ATTEMPT_TIMEOUT * max(1, max_chain))
+        collect_by = time.monotonic() + backstop + 5.0
         for ordinal, (copy, fut) in enumerate(zip(shards, query_futs)):
             if fut is None:
                 failures.append({"index": copy.index, "shard": copy.shard_id,
@@ -1487,8 +1573,8 @@ class ActionModule:
                 continue
             try:
                 r, used, err = fut.result(
-                    timeout=max(0.0, deadline - time.monotonic()))
-            except TimeoutError:
+                    timeout=max(0.0, collect_by - time.monotonic()))
+            except (TimeoutError, FutureTimeoutError):
                 r, used, err = None, None, TransportError("query phase timed out")
                 cancel = getattr(fut, "cancel_chain", None)
                 if cancel is not None:
@@ -1498,14 +1584,34 @@ class ActionModule:
                 r.shard_id = ordinal
                 results.append(r)
             else:
-                failures.append({"index": copy.index, "shard": copy.shard_id,
-                                 "reason": str(err)})
-        return self._finish_search(req, body, results, failures, shards, shard_meta, t0)
+                # one failure entry per attempted copy (ref: ShardSearchFailure
+                # carries the shard target) — chains record each downed copy.
+                # The terminal error is appended too unless it IS the last
+                # recorded attempt error: a backstop/budget cutoff with an
+                # attempt still in flight must not vanish from the response
+                per_copy = list(getattr(fut, "attempt_errors", None) or [])
+                if err is not None and \
+                        (not per_copy or per_copy[-1][1] is not err):
+                    per_copy.append((copy.node_id, err))
+                for node_id, copy_err in per_copy:
+                    failures.append({"index": copy.index, "shard": copy.shard_id,
+                                     "node": node_id, "reason": str(copy_err)})
+        # shard-side partials mark timed_out in the reduce (sort_docs); chain
+        # exhaustion by deadline must surface it too, even with no results back
+        return self._finish_search(req, body, results, failures, shards,
+                                   shard_meta, t0, timed_out=deadline.expired())
 
-    def _finish_search(self, req, body, results, failures, shards, shard_meta, t0):
+    def _finish_search(self, req, body, results, failures, shards, shard_meta, t0,
+                       timed_out: bool = False):
         """Reduce + fetch + response assembly, shared by the transport scatter-gather
-        and the mesh SPMD query phase (both deliver per-ordinal ShardQueryResults)."""
+        and the mesh SPMD query phase (both deliver per-ordinal ShardQueryResults).
+        The fetch phase deliberately ignores the request deadline: winners are
+        already chosen, and hydrating them is what makes a timed-out response a
+        PARTIAL answer instead of an empty one (ref: the reference's fetch runs
+        after TimeLimitingCollector fires too). `timed_out` ORs in coordinator-
+        level budget expiry; shard-level partials are folded in by sort_docs."""
         merged = sort_docs(req, results)
+        merged.timed_out = merged.timed_out or timed_out
         page = merged.hits[req.from_: req.from_ + req.size]
         # fetch phase: winners only, grouped per shard, all shards in flight at once
         # (ref: TransportSearchQueryThenFetchAction.java:93-147)
@@ -1563,17 +1669,21 @@ class ActionModule:
 
     QUERY_ATTEMPT_TIMEOUT = 60.0
 
-    def _dfs_shard_result(self, state, copy: ShardRouting, body, first_fut):
+    def _dfs_shard_result(self, state, copy: ShardRouting, body, first_fut,
+                          deadline: Deadline = NO_DEADLINE):
         """DFS phase for one shard group with failover across its copies (the
         first attempt is already in flight for fan-out concurrency; failover
         attempts are sequential — rare). Returns the stats dict, or None when no
-        copy on a live node serves it."""
+        copy on a live node serves it. Per-attempt waits and the failover chain
+        are bounded by the request deadline's remaining budget."""
         group = state.routing_table.index(copy.index).shard(copy.shard_id)
         candidates = [copy] + [s for s in group.active_shards()
                                if s.node_id != copy.node_id]
         fut = first_fut
         for cand in candidates:
             if fut is None:
+                if deadline.expired():
+                    return None  # no budget left for another copy
                 node = state.nodes.get(cand.node_id)
                 if node is None:
                     continue
@@ -1581,20 +1691,24 @@ class ActionModule:
                     "index": cand.index, "shard": cand.shard_id,
                     "body": body or {}})
             try:
-                return fut_result(fut, 30.0)
+                return fut_result(fut, deadline.clamp(30.0))
             except SearchEngineError:  # TransportError subclasses it
                 fut = None  # next copy
         return None
 
     def _query_shard_async(self, state, copy: ShardRouting, body, alias_filters,
-                           dfs_stats) -> Future:
+                           dfs_stats, deadline: Deadline = NO_DEADLINE) -> Future:
         """Per-shard query phase with failover to the next active copy, driven
         entirely by future callbacks — the coordinator parks no thread per shard
         (ref: performFirstPhase + onFirstPhaseResult failover,
-        TransportSearchTypeAction.java:135-216,292). Each attempt carries its own
-        timeout (a hung node must not stall the chain — the old blocking version
-        failed over on ReceiveTimeoutError and this one must too). Resolves to
-        (ShardQueryResult | None, node | None, error | None)."""
+        TransportSearchTypeAction.java:135-216,292). Each attempt's timeout is
+        the flat attempt budget clamped to the request deadline's REMAINING
+        budget, and the chain itself gives up (instead of trying the next copy)
+        once the deadline expires — the failover-chain cap. The remaining
+        budget rides the request as `deadline_s` so the shard clamps its own
+        segment loop. Resolves to (ShardQueryResult | None, node | None,
+        error | None); every failed attempt is recorded on the returned
+        future's `attempt_errors` as (node_id, error)."""
         done: Future = Future()
         group = state.routing_table.index(copy.index).shard(copy.shard_id)
         candidates = [copy] + [s for s in group.active_shards()
@@ -1604,9 +1718,18 @@ class ActionModule:
         cancelled = threading.Event()
         done.cancel_chain = cancelled.set  # type: ignore[attr-defined]
         done.max_attempts = len(candidates)  # type: ignore[attr-defined]
+        attempt_errors: list = []
+        done.attempt_errors = attempt_errors  # type: ignore[attr-defined]
 
         def attempt(i: int, last_err):
             if cancelled.is_set():
+                return
+            if i > 0 and last_err is not None and deadline.expired():
+                # budget exhausted mid-chain: trying another copy could only
+                # answer after the caller stopped caring — report instead
+                done.set_result((None, None, ReceiveTimeoutError(
+                    f"search budget exhausted after {i} attempt(s) on "
+                    f"[{copy.index}][{copy.shard_id}]: {last_err}")))
                 return
             while i < len(candidates) and state.nodes.get(candidates[i].node_id) is None:
                 i += 1
@@ -1624,6 +1747,9 @@ class ActionModule:
                 "body": body or {},
                 "alias_filter": alias_filters.get(candidate.index),
                 "dfs": dfs_stats,
+                # remaining budget as a DURATION (monotonic clocks don't cross
+                # processes); the shard restarts its own clock from it
+                "deadline_s": deadline.remaining(),
             })
             # exactly one of {response callback, attempt timer} consumes the attempt
             consumed_lock = threading.Lock()
@@ -1638,11 +1764,13 @@ class ActionModule:
 
             def on_timeout():
                 if consume():
-                    attempt(i + 1, ReceiveTimeoutError(
-                        f"query phase attempt to [{candidate.node_id}] timed out"))
+                    err = ReceiveTimeoutError(
+                        f"query phase attempt to [{candidate.node_id}] timed out")
+                    attempt_errors.append((candidate.node_id, err))
+                    attempt(i + 1, err)
 
             timer = self.node.threadpool.schedule(
-                self.QUERY_ATTEMPT_TIMEOUT, "generic", on_timeout)
+                deadline.clamp(self.QUERY_ATTEMPT_TIMEOUT), "generic", on_timeout)
 
             def on_done(f):
                 if not consume():
@@ -1656,6 +1784,7 @@ class ActionModule:
                         # this state was read (ref: onFirstPhaseResult treats
                         # every shard exception as failover, :292); terminal
                         # only when the chain runs out of candidates
+                        attempt_errors.append((candidate.node_id, err))
                         attempt(i + 1, err)
                         return
                     r = f.result()
@@ -1668,6 +1797,7 @@ class ActionModule:
                         suggest=r.get("suggest"),
                         context_id=r.get("ctx_id"),
                         shard_id=candidate.shard_id,
+                        timed_out=bool(r.get("timed_out")),
                     )
                     result.index_name = candidate.index  # type: ignore[attr-defined]
                     done.set_result((result, node, None))
@@ -1732,8 +1862,14 @@ class ActionModule:
             body["query"] = {"filtered": {"query": query, "filter": alias_filter}}
         req = parse_search_body(body)
         ctx = self._shard_ctx(index, shard_id, request.get("dfs"))
+        # shard-side budget: the tighter of the coordinator's remaining budget
+        # (shipped as a duration in `deadline_s`) and the body's own `timeout`
+        budget = request.get("deadline_s")
+        if req.timeout_s is not None:
+            budget = req.timeout_s if budget is None else min(budget, req.timeout_s)
+        deadline = Deadline.after(budget) if budget is not None else NO_DEADLINE
         t_q = time.monotonic()
-        result = execute_query_phase(ctx, req, shard_id=shard_id)
+        result = execute_query_phase(ctx, req, shard_id=shard_id, deadline=deadline)
         self._maybe_slowlog(index, shard_id, body, (time.monotonic() - t_q))
         return {
             "total": result.total,
@@ -1742,6 +1878,7 @@ class ActionModule:
             "agg_partials": _encode_partials(result.agg_partials),
             "facet_partials": _encode_partials(result.facet_partials),
             "suggest": result.suggest,
+            "timed_out": result.timed_out,
             # fetch must read the SAME point-in-time searcher these doc ids
             # come from (a merge between phases moves local ids)
             "ctx_id": self._pin_context(index, shard_id, ctx),

@@ -421,6 +421,11 @@ def build_rest_controller(node) -> RestController:
                 if req.param("_source_exclude") else []}
         if req.param("fields") is not None:
             body["fields"] = str(req.param("fields")).split(",")
+        if req.param("timeout") is not None:
+            # `?timeout=50ms` enters the one per-request Deadline here (ref:
+            # RestSearchAction parsing timeout into the SearchSourceBuilder);
+            # parse_search_body turns it into ParsedSearchRequest.timeout_s
+            body["timeout"] = req.param("timeout")
         return body
 
     def search(req):

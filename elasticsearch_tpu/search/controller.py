@@ -116,7 +116,9 @@ class MergedTopDocs:
 
 def sort_docs(req: ParsedSearchRequest, shard_results: list[ShardQueryResult]) -> MergedTopDocs:
     """Global top-(from+size) merge across shards. Score order: (score desc, shard asc,
-    doc asc). Field order: sort-value tuples via the shared comparator."""
+    doc asc). Field order: sort-value tuples via the shared comparator. A single
+    shard-level partial (deadline expired mid-collection) marks the whole merged
+    result timed_out — totals and aggregations cover only the scored segments."""
     total = sum(r.total for r in shard_results)
     max_score = float("nan")
     for r in shard_results:
@@ -136,7 +138,8 @@ def sort_docs(req: ParsedSearchRequest, shard_results: list[ShardQueryResult]) -
     else:
         entries.sort(key=lambda e: (-e[0] if e[0] == e[0] else float("inf"), e[1], e[2]))
     k = req.from_ + req.size
-    return MergedTopDocs(total=total, max_score=max_score, hits=entries[:k])
+    return MergedTopDocs(total=total, max_score=max_score, hits=entries[:k],
+                         timed_out=any(r.timed_out for r in shard_results))
 
 
 def merge_responses(req: ParsedSearchRequest, merged: MergedTopDocs,

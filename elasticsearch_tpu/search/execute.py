@@ -142,6 +142,9 @@ class TopDocs:
     total: int
     hits: list  # [(score, global_doc)]
     max_score: float
+    # the shard's time budget ran out mid-collection: hits/total cover only the
+    # segments scored before expiry (ref: TimeLimitingCollector partial results)
+    timed_out: bool = False
 
 
 @dataclass
@@ -1822,15 +1825,20 @@ def host_match_mask(query: Query, seg: FrozenSegment, ctx: ShardContext) -> np.n
 
 
 def search_shard(ctx: ShardContext, query: Query, k: int, use_device: bool = True,
-                 extra_filter: Filter | None = None) -> TopDocs:
+                 extra_filter: Filter | None = None, deadline=None) -> TopDocs:
     return search_shard_batch(ctx, [query], k, use_device=use_device,
-                              extra_filter=extra_filter)[0]
+                              extra_filter=extra_filter, deadline=deadline)[0]
 
 
 def search_shard_batch(ctx: ShardContext, queries: list[Query], k: int,
                        use_device: bool = True,
-                       extra_filter: Filter | None = None) -> list[TopDocs]:
-    """Execute a batch: flat-lowerable queries fused onto the device, the rest host."""
+                       extra_filter: Filter | None = None,
+                       deadline=None) -> list[TopDocs]:
+    """Execute a batch: flat-lowerable queries fused onto the device, the rest host.
+
+    `deadline` (common.deadline.Deadline) clamps HOST execution at segment
+    granularity; device launches are never interrupted (a deadline check cannot
+    cross into traced code), so the flat path runs whole once started."""
     results: list[TopDocs | None] = [None] * len(queries)
     flat_idx: list[int] = []
     flat_plans: list[FlatPlan] = []
@@ -1845,7 +1853,7 @@ def search_shard_batch(ctx: ShardContext, queries: list[Query], k: int,
             results[i] = td
     for i, q in enumerate(queries):
         if results[i] is None:
-            results[i] = _host_search(ctx, q, k, extra_filter)
+            results[i] = _host_search(ctx, q, k, extra_filter, deadline)
     return results  # type: ignore[return-value]
 
 
@@ -1906,13 +1914,19 @@ def _shard_join(ctx: ShardContext, q: Query):
 
 
 def _host_search(ctx: ShardContext, query: Query, k: int,
-                 extra_filter: Filter | None = None) -> TopDocs:
+                 extra_filter: Filter | None = None, deadline=None) -> TopDocs:
     qn = query_norm_for(query, ctx)
     all_scores: list[np.ndarray] = []
     all_docs: list[np.ndarray] = []
     total = 0
+    timed_out = False
     join = _shard_join(ctx, query)
     for si, (seg, base) in enumerate(zip(ctx.searcher.segments, ctx.searcher.bases)):
+        # host-side segment boundary: the one legal clamp point (never inside
+        # a traced region) — expiry keeps the segments already scored
+        if deadline is not None and deadline.expired():
+            timed_out = True
+            break
         if join is not None:
             scores, match = join[si]
         else:
@@ -1927,12 +1941,12 @@ def _host_search(ctx: ShardContext, query: Query, k: int,
             all_scores.append(scores[idx])
             all_docs.append(idx + base)
     if not all_scores:
-        return TopDocs(0, [], float("nan"))
+        return TopDocs(0, [], float("nan"), timed_out=timed_out)
     scores = np.concatenate(all_scores)
     docs = np.concatenate(all_docs)
     order = np.lexsort((docs, -scores))[:k]
     hits = list(zip(scores[order].tolist(), docs[order].tolist()))
-    return TopDocs(total, hits, float(scores.max()))
+    return TopDocs(total, hits, float(scores.max()), timed_out=timed_out)
 
 
 def count_shard(ctx: ShardContext, query: Query, extra_filter: Filter | None = None) -> int:
@@ -1945,10 +1959,12 @@ def count_shard(ctx: ShardContext, query: Query, extra_filter: Filter | None = N
     return total
 
 
-def match_masks(ctx: ShardContext, query: Query, extra_filter: Filter | None = None):
-    """Per-segment (scores, match) for aggregation/fetch sub-phases."""
+def iter_match_masks(ctx: ShardContext, query: Query,
+                     extra_filter: Filter | None = None):
+    """Lazily yield per-segment (scores, match): deadline-aware callers
+    (execute_query_phase's general path) stop consuming at segment granularity
+    and keep the segments already scored as a partial result."""
     qn = query_norm_for(query, ctx)
-    out = []
     join = _shard_join(ctx, query)
     for si, seg in enumerate(ctx.searcher.segments):
         if join is not None:
@@ -1959,5 +1975,9 @@ def match_masks(ctx: ShardContext, query: Query, extra_filter: Filter | None = N
         match = match & seg.live & seg.parent_mask
         if extra_filter is not None:
             match = match & segment_mask(seg, extra_filter, ctx)
-        out.append((scores, match))
-    return out
+        yield (scores, match)
+
+
+def match_masks(ctx: ShardContext, query: Query, extra_filter: Filter | None = None):
+    """Per-segment (scores, match) for aggregation/fetch sub-phases."""
+    return list(iter_match_masks(ctx, query, extra_filter))

@@ -16,6 +16,7 @@ from dataclasses import dataclass, field as dc_field
 
 import numpy as np
 
+from ..common.deadline import NO_DEADLINE, Deadline, parse_timevalue
 from ..common.errors import (
     QueryParsingError,
     SearchContextMissingError,
@@ -28,6 +29,7 @@ from .execute import (
     TopDocs,
     lower_flat,
     execute_flat_batch,
+    iter_match_masks,
     match_masks,
     query_norm_for,
     search_shard,
@@ -66,6 +68,10 @@ class ParsedSearchRequest:
 
 def parse_search_body(body: dict | None) -> ParsedSearchRequest:
     body = body or {}
+    try:
+        timeout_s = parse_timevalue(body.get("timeout"))
+    except ValueError as e:
+        raise QueryParsingError(str(e)) from None  # malformed timeout is a 400
     query = parse_query(body.get("query")) if body.get("query") else MatchAllQuery()
     # top-level "filter" is the POST filter (applied to hits, not aggs/facets) —
     # ref: DefaultSearchContext.parsedPostFilter
@@ -88,6 +94,9 @@ def parse_search_body(body: dict | None) -> ParsedSearchRequest:
         body=body,
         track_scores=bool(body.get("track_scores", False)),
         explain=bool(body.get("explain", False)),
+        # ref: the request-body `timeout` TimeValue ("50ms"/"2s"; bare ms) that
+        # bounds the query phase — enforced at segment granularity on the host
+        timeout_s=timeout_s,
     )
 
 
@@ -105,6 +114,9 @@ class ShardQueryResult:
     suggest: dict | None = None
     context_id: int | None = None
     shard_id: int = 0
+    # deadline expired mid-collection: docs/total/partials cover the segments
+    # scored before expiry (the coordinator surfaces this as `timed_out: true`)
+    timed_out: bool = False
 
 
 # process-wide serving-path counters (which executor served the query phase —
@@ -145,11 +157,24 @@ def _device_failed(e: BaseException):
 
 
 def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
-                        use_device: bool = True, shard_id: int = 0) -> ShardQueryResult:
+                        use_device: bool = True, shard_id: int = 0,
+                        deadline: Deadline | None = None) -> ShardQueryResult:
+    # the shard's time budget: coordinator-supplied remaining budget when the
+    # request came over transport, else the request's own `timeout`. Enforced
+    # ONLY at host-side segment boundaries — a device launch, once started,
+    # always completes whole (deadline checks never cross into traced code).
+    if deadline is None:
+        deadline = Deadline.after(req.timeout_s) if req.timeout_s is not None \
+            else NO_DEADLINE
     k = req.from_ + req.size
     needs_masks = bool(req.aggs or req.facets or req.sort or req.post_filter
                        or req.rescore or req.min_score is not None)
     suggest_out = run_suggest(ctx, req.suggest) if req.suggest else None
+    if deadline.expired():
+        # budget gone before any segment was scored: legal partial = nothing
+        return ShardQueryResult(total=0, docs=[], max_score=float("nan"),
+                                suggest=suggest_out, shard_id=shard_id,
+                                timed_out=True)
 
     if not needs_masks:
         plan = lower_flat(req.query, ctx) if use_device else None
@@ -170,10 +195,10 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
                     shard_id=shard_id,
                 )
         _count("host")
-        td = _host_topk(ctx, req, k)
+        td = _host_topk(ctx, req, k, deadline)
         return ShardQueryResult(total=td.total, docs=[(s, d, None) for s, d in td.hits],
                                 max_score=td.max_score, suggest=suggest_out,
-                                shard_id=shard_id)
+                                shard_id=shard_id, timed_out=td.timed_out)
 
     # device metric-agg path: when the ONLY mask consumer is a set of
     # device-eligible metric aggs, the agg reduction fuses into the scoring
@@ -251,16 +276,25 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
             _count("device_sort")
             return device
 
-    # general path: dense per-segment masks drive sort/aggs/rescore
+    # general path: dense per-segment masks drive sort/aggs/rescore. Masks are
+    # consumed lazily so the deadline clamps BETWEEN segments: expiry keeps the
+    # segments already scored as an honest partial (timed_out below)
     _count("host")
-    seg_results = match_masks(ctx, req.query)
+    timed_out = False
+    seg_results = []
+    masks_iter = iter_match_masks(ctx, req.query)
     seg_masks_for_aggs = []
     all_entries = []  # (sortkeys..., score, global_doc, seg_idx, local)
     total = 0
     max_score = float("nan")
-    for si, ((scores, match), seg, base) in enumerate(
-        zip(seg_results, ctx.searcher.segments, ctx.searcher.bases)
+    for si, (seg, base) in enumerate(
+        zip(ctx.searcher.segments, ctx.searcher.bases)
     ):
+        if si > 0 and deadline.expired():
+            timed_out = True
+            break
+        scores, match = next(masks_iter)
+        seg_results.append((scores, match))
         if req.min_score is not None:
             match = match & (scores >= np.float32(req.min_score))
         seg_masks_for_aggs.append((seg, match, scores))
@@ -326,6 +360,7 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
     return ShardQueryResult(
         total=total, docs=docs, max_score=max_score, agg_partials=agg_partials,
         facet_partials=facet_partials, suggest=suggest_out, shard_id=shard_id,
+        timed_out=timed_out,
     )
 
 
@@ -489,8 +524,10 @@ def _score_in_sort(sort: list) -> bool:
     return any(s.kind == "score" for s in sort)
 
 
-def _host_topk(ctx: ShardContext, req: ParsedSearchRequest, k: int) -> TopDocs:
-    return search_shard(ctx, req.query, max(k, 1), use_device=False)
+def _host_topk(ctx: ShardContext, req: ParsedSearchRequest, k: int,
+               deadline: Deadline = NO_DEADLINE) -> TopDocs:
+    return search_shard(ctx, req.query, max(k, 1), use_device=False,
+                        deadline=deadline)
 
 
 def _apply_rescore(ctx: ShardContext, req: ParsedSearchRequest, top: list) -> list:

@@ -16,7 +16,7 @@ import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 
 from ..common.errors import NodeNotConnectedError, TransportError
-from .service import TransportChannel
+from .service import TransportChannel, complete_fut
 
 
 class LocalTransportRegistry:
@@ -81,23 +81,26 @@ class LocalTransport:
         address = getattr(node, "transport_address", node)
         if self.registry.is_blocked(self.address, address):
             self.registry.dropped_count += 1
-            fut.set_exception(NodeNotConnectedError(f"[{address}] dropped (partition)"))
+            complete_fut(fut, error=NodeNotConnectedError(
+                f"[{address}] dropped (partition)"))
             return
         target = self.registry.nodes.get(address)
         if target is None or target._closed:
-            fut.set_exception(NodeNotConnectedError(f"no node at [{address}]"))
+            complete_fut(fut, error=NodeNotConnectedError(f"no node at [{address}]"))
             return
 
         def respond(response, error):
-            # response path also crosses the (simulated) wire
+            # response path also crosses the (simulated) wire; the future may
+            # already hold a response timeout — late answers are discarded
             if self.registry.is_blocked(self.address, address):
                 self.registry.dropped_count += 1
-                fut.set_exception(NodeNotConnectedError(f"[{address}] response dropped"))
+                complete_fut(fut, error=NodeNotConnectedError(
+                    f"[{address}] response dropped"))
                 return
             if error is not None:
-                fut.set_exception(error)
+                complete_fut(fut, error=error)
             else:
-                fut.set_result(response)
+                complete_fut(fut, response)
 
         channel = TransportChannel(respond)
 
@@ -110,7 +113,7 @@ class LocalTransport:
         try:
             target._pool.submit(deliver)
         except RuntimeError:
-            fut.set_exception(NodeNotConnectedError(f"node [{address}] shut down"))
+            complete_fut(fut, error=NodeNotConnectedError(f"node [{address}] shut down"))
 
     def close(self):
         self._closed = True

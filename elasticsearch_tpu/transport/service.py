@@ -12,7 +12,8 @@ from __future__ import annotations
 
 import itertools
 import threading
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Any, Callable
 
 from ..common.errors import (
@@ -27,11 +28,30 @@ from ..common.stream import StreamInput, StreamOutput
 
 
 def fut_result(fut: Future, timeout: float | None = 30.0):
-    """Await a transport future, converting timeout."""
+    """Await a transport future, converting timeout.
+
+    Catches BOTH timeout classes: before Python 3.11,
+    concurrent.futures.TimeoutError is NOT the builtin TimeoutError — catching
+    only the builtin let raw futures timeouts leak to callers (the
+    test_handler_slow_response_timeout seed failure)."""
     try:
         return fut.result(timeout=timeout)
-    except TimeoutError:
+    except (TimeoutError, FutureTimeoutError):
         raise ReceiveTimeoutError("request timed out") from None
+
+
+def complete_fut(fut: Future, result=None, error: Exception | None = None) -> bool:
+    """Resolve a future exactly once. Transport futures race between the
+    response path, injected faults, and response-timeout timers — whichever
+    lands first wins and the rest become no-ops."""
+    try:
+        if error is not None:
+            fut.set_exception(error)
+        else:
+            fut.set_result(result)
+        return True
+    except InvalidStateError:
+        return False
 
 
 class TransportRequestHandler:
@@ -74,7 +94,11 @@ class TransportService:
         self.handlers: dict[str, TransportRequestHandler] = {}
         self._req_ids = itertools.count(1)
         self.logger = get_logger("transport")
-        self.stats = {"rx_count": 0, "tx_count": 0}
+        self.stats = {"rx_count": 0, "tx_count": 0, "timed_out_count": 0,
+                      "faults_injected": 0}
+        # MockTransportService-style fault injection (transport/faults.py):
+        # installed on live nodes by chaos tests, None in production
+        self.fault_policy = None
         backend.bind(self)
 
     # --- registry -----------------------------------------------------------
@@ -90,47 +114,123 @@ class TransportService:
 
     def send_request(self, node, action: str, request: dict,
                      timeout: float | None = None) -> Future:
+        """Dispatch `request` to `node`, returning a Future for the response.
+
+        A non-None `timeout` arms a timer that fails the future with
+        ReceiveTimeoutError when no response lands in time — for
+        callback-driven callers with no thread parked in fut_result. Blocking
+        callers should pass no timeout here (fut_result bounds the wait
+        without a timer thread per request). Late responses to an already
+        timed-out future are discarded (complete_fut)."""
         fut: Future = Future()
         self.stats["tx_count"] += 1
+        if timeout is not None:
+            self._arm_response_timeout(fut, action, timeout)
         try:
-            # Self-addressed requests short-circuit past the backend (the reference
-            # TransportService does the same for localNode): still codec-roundtripped
-            # for wire-compat assertions, but no socket / simulated-network hop.
-            if self._is_local(node):
-                payload = _roundtrip(request)
-
-                def respond(response, error):
-                    if error is not None:
-                        fut.set_exception(error)
-                    else:
-                        fut.set_result(_roundtrip(response))
-
-                channel = TransportChannel(respond)
-                if self.threadpool is not None:
-                    self.threadpool.submit("generic", self.dispatch, action, payload,
-                                           channel)
-                else:
-                    self.dispatch(action, payload, channel)
-                return fut
-            # Backends that truly serialize (TCP) skip the assert-roundtrip — the
-            # payload already crosses the real codec exactly once on the wire.
-            payload = request if getattr(self.backend, "serializes", False) \
-                else _roundtrip(request)
-            self.backend.send(node, action, payload, fut)
+            rule = None if self.fault_policy is None else \
+                self.fault_policy.decide(action, getattr(node, "transport_address",
+                                                         node), request, "send")
+            if rule is not None:
+                self.stats["faults_injected"] += 1
+                if self._apply_send_fault(rule, fut, node, action, request):
+                    return fut
+            self._send_now(node, action, request, fut)
         except SearchEngineError as e:
-            fut.set_exception(e)
+            complete_fut(fut, error=e)
         except Exception as e:  # noqa: BLE001
-            fut.set_exception(TransportError(str(e), cause=e))
+            complete_fut(fut, error=TransportError(str(e), cause=e))
         return fut
+
+    def _send_now(self, node, action: str, request: dict, fut: Future):
+        # Self-addressed requests short-circuit past the backend (the reference
+        # TransportService does the same for localNode): still codec-roundtripped
+        # for wire-compat assertions, but no socket / simulated-network hop.
+        if self._is_local(node):
+            payload = _roundtrip(request)
+
+            def respond(response, error):
+                if error is not None:
+                    complete_fut(fut, error=error)
+                else:
+                    complete_fut(fut, _roundtrip(response))
+
+            channel = TransportChannel(respond)
+            if self.threadpool is not None:
+                self.threadpool.submit("generic", self.dispatch, action, payload,
+                                       channel)
+            else:
+                self.dispatch(action, payload, channel)
+            return
+        # Backends that truly serialize (TCP) skip the assert-roundtrip — the
+        # payload already crosses the real codec exactly once on the wire.
+        payload = request if getattr(self.backend, "serializes", False) \
+            else _roundtrip(request)
+        self.backend.send(node, action, payload, fut)
+
+    def _apply_send_fault(self, rule, fut: Future, node, action: str,
+                          request: dict) -> bool:
+        """Apply a send-side fault rule. True = the send was consumed (do not
+        forward); False = forward normally (delay rules re-enter via timer)."""
+        if rule.kind == "drop":
+            return True  # message lost; only a response timeout resolves fut
+        if rule.kind in ("disconnect", "error"):
+            complete_fut(fut, error=rule.make_error())
+            return True
+        # delay: deliver the real send after delay_s on a daemon timer
+        def fire():
+            try:
+                self._send_now(node, action, request, fut)
+            except Exception as e:  # noqa: BLE001 — timer thread must not die silent
+                complete_fut(fut, error=TransportError(str(e), cause=e))
+
+        t = threading.Timer(rule.delay_s, fire)
+        t.daemon = True
+        t.start()
+        return True
+
+    def _arm_response_timeout(self, fut: Future, action: str, timeout: float):
+        def on_timeout():
+            if complete_fut(fut, error=ReceiveTimeoutError(
+                    f"[{action}] received no response within [{timeout}s]")):
+                self.stats["timed_out_count"] += 1
+
+        timer = threading.Timer(max(0.0, timeout), on_timeout)
+        timer.daemon = True
+        timer.start()
+        fut.add_done_callback(lambda _f: timer.cancel())
 
     def submit_request(self, node, action: str, request: dict,
                        timeout: float | None = 30.0) -> dict:
-        """Blocking convenience."""
+        """Blocking convenience. The bound comes from fut_result's blocking
+        wait — no per-request timer thread; send_request's future-level
+        timeout is for CALLBACK-driven callers that have no thread parked."""
         return fut_result(self.send_request(node, action, request), timeout)
 
     # --- receiving (called by backends) -------------------------------------
     def dispatch(self, action: str, request: Any, channel: TransportChannel):
         self.stats["rx_count"] += 1
+        # recv-side rules match the RECEIVING node's own address (the sender
+        # is not identified at this layer)
+        rule = None if self.fault_policy is None else \
+            self.fault_policy.decide(action, getattr(self.backend, "address", ""),
+                                     request, "recv")
+        if rule is not None:
+            self.stats["faults_injected"] += 1
+            if rule.kind == "drop":
+                return  # handler never runs; the sender's timeout surfaces it
+            if rule.kind in ("disconnect", "error"):
+                channel.send_failure(rule.make_error())
+                return
+            # delay: run the handler after delay_s — the deterministic "slow
+            # handler" that response-timeout tests are built on
+            t = threading.Timer(rule.delay_s,
+                                lambda: self._dispatch_now(action, request, channel))
+            t.daemon = True
+            t.start()
+            return
+        self._dispatch_now(action, request, channel)
+
+    def _dispatch_now(self, action: str, request: Any, channel: TransportChannel):
         handler = self.handlers.get(action)
         if handler is None:
             channel.send_failure(ActionNotFoundError(f"no handler for action [{action}]"))

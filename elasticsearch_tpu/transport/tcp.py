@@ -34,7 +34,7 @@ from ..common.errors import (
 )
 from ..common.logging import get_logger
 from ..common.stream import StreamInput, StreamOutput
-from .service import TransportChannel
+from .service import TransportChannel, complete_fut
 
 MAGIC = b"ET"
 FLAG_RESPONSE = 1
@@ -238,10 +238,12 @@ class TcpTransport:
         if entry is None:
             return
         fut = entry[0]
+        # a response timeout may have already failed this future — late
+        # frames are discarded, matching the reference's timeout handler
         if flags & FLAG_ERROR:
-            fut.set_exception(_rebuild_error(payload.get("error", {})))
+            complete_fut(fut, error=_rebuild_error(payload.get("error", {})))
         else:
-            fut.set_result(payload.get("body"))
+            complete_fut(fut, payload.get("body"))
 
     def _on_conn_closed(self, conn: _Connection):
         """Fail every request still in flight on a dead connection."""
@@ -249,8 +251,7 @@ class TcpTransport:
             dead = [rid for rid, (_, c) in self._pending.items() if c is conn]
             entries = [self._pending.pop(rid) for rid in dead]
         for fut, _ in entries:
-            if not fut.done():
-                fut.set_exception(NodeNotConnectedError("connection closed"))
+            complete_fut(fut, error=NodeNotConnectedError("connection closed"))
 
     def _connection(self, address: str, pool: str) -> _Connection:
         with self._outbound_lock:
@@ -291,26 +292,34 @@ class TcpTransport:
     def send(self, node, action: str, request, fut: Future):
         address = getattr(node, "transport_address", node)
         if self._closed:
-            fut.set_exception(NodeNotConnectedError("transport closed"))
+            complete_fut(fut, error=NodeNotConnectedError("transport closed"))
             return
         with self._id_lock:
             req_id = next(self._req_ids)
         try:
             conn = self._connection(address, _pool_for(action))
         except SearchEngineError as e:
-            fut.set_exception(e)
+            complete_fut(fut, error=e)
             return
         with self._pending_lock:
             self._pending[req_id] = (fut, conn)
+        # reap the pending entry however the future resolves — a response
+        # frame, a connection close, OR an external failure (response-timeout
+        # timer, injected drop): without this, requests that never get a frame
+        # leak (fut, conn) tuples for the life of a healthy connection
+        fut.add_done_callback(lambda _f, rid=req_id: self._reap_pending(rid))
         frame = _encode({"id": req_id, "action": action, "body": request},
                         0, self.compress)
         try:
             conn.write_frame(frame)
         except OSError as e:
-            with self._pending_lock:
-                self._pending.pop(req_id, None)
             conn.close()
-            fut.set_exception(NodeNotConnectedError(f"send to [{address}] failed: {e}"))
+            complete_fut(fut, error=NodeNotConnectedError(
+                f"send to [{address}] failed: {e}"))
+
+    def _reap_pending(self, req_id: int):
+        with self._pending_lock:
+            self._pending.pop(req_id, None)
 
     def close(self):
         if self._closed:

@@ -19,6 +19,7 @@ import contextlib
 import random
 
 from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.transport.faults import FaultPolicy
 from elasticsearch_tpu.transport.local import LocalTransportRegistry
 
 
@@ -86,6 +87,30 @@ class TestCluster:
     def client(self):
         """A client on a random live node (the reference randomizes too)."""
         return self.nodes[self.rng.choice(list(self.nodes))].client()
+
+    # -- fault injection (transport/faults.py) -----------------------------
+    def fault_policy(self, node_name: str, seed: int | None = None) -> FaultPolicy:
+        """Install (or return the already-installed) FaultPolicy on one live
+        node's TransportService — the MockTransportService hook. An EXPLICIT
+        seed always installs a fresh policy (replayability demands a pristine
+        RNG, not one another test already advanced); without a seed, an
+        existing policy is reused and a new one draws from the cluster RNG."""
+        service = self.nodes[node_name].transport
+        if seed is not None:
+            FaultPolicy(seed).install(service)
+        elif service.fault_policy is None:
+            FaultPolicy(self.rng.randrange(2 ** 31)).install(service)
+        return service.fault_policy
+
+    def clear_faults(self):
+        """Drop every installed fault rule on every live node."""
+        for node in self.nodes.values():
+            if node.transport.fault_policy is not None:
+                node.transport.fault_policy.clear()
+
+    def address(self, node_name: str) -> str:
+        """A node's transport address — the `node=` pattern FaultRules match."""
+        return self.nodes[node_name].local_node.transport_address
 
     def ensure_green(self, index=None, timeout: float = 30.0):
         h = self.client().cluster_health(index, wait_for_status="green",

@@ -55,6 +55,10 @@ def test_query_phase_is_concurrent(node):
     client.refresh("t")
 
     _slow_query_phase(node)
+    # warm the exact query once OUTSIDE the timed region: whether the device
+    # program is already compiled depends on which tests ran earlier in the
+    # process, and a cold first compile (~0.7s) dwarfs the concurrency margin
+    client.search(["t"], {"query": {"match": {"body": "common"}}})
     t0 = time.monotonic()
     r = client.search(["t"], {"query": {"match": {"body": "common"}}})
     took = time.monotonic() - t0

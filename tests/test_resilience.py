@@ -1,0 +1,531 @@
+"""Request-resilience layer tests: deadlines, retry/backoff, fault injection.
+
+The reproduction of the reference's resilience test surface
+(SearchWithRandomExceptionsTests / MockTransportService chaos +
+TimeLimitingCollector semantics): searches under injected network faults must
+degrade to accurate partial responses — 200 with honest `_shards` accounting —
+and the write path must never silently drop a replica op.
+
+Everything here is deterministic: faults come from seeded FaultPolicy rules,
+backoff schedules from seeded RNGs, and "slow" is an injected transport delay,
+never a handler sleep racing the wall clock.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.deadline import NO_DEADLINE, Deadline, parse_timevalue
+from elasticsearch_tpu.common.errors import (
+    NodeNotConnectedError,
+    ReceiveTimeoutError,
+    TransportError,
+    VersionConflictError,
+)
+from elasticsearch_tpu.common.retry import (
+    RetryExhaustedError,
+    RetryPolicy,
+    is_transient,
+)
+from elasticsearch_tpu.transport.faults import FaultPolicy, FaultRule
+from elasticsearch_tpu.transport.local import LocalTransport, LocalTransportRegistry
+from elasticsearch_tpu.transport.service import TransportService
+
+from .harness import TestCluster
+
+pytestmark = pytest.mark.resilience
+
+A_QUERY = "indices:data/read/search[phase/query]"
+
+
+# ---------------------------------------------------------------------------
+# Deadline / timevalue units
+# ---------------------------------------------------------------------------
+
+
+def test_parse_timevalue_units():
+    assert parse_timevalue("50ms") == pytest.approx(0.05)
+    assert parse_timevalue("2s") == pytest.approx(2.0)
+    assert parse_timevalue("1m") == pytest.approx(60.0)
+    assert parse_timevalue("1h") == pytest.approx(3600.0)
+    # bare numbers are MILLISECONDS (reference TimeValue default)
+    assert parse_timevalue(500) == pytest.approx(0.5)
+    assert parse_timevalue("250") == pytest.approx(0.25)
+    # no budget: None, and the reference's -1 sentinel
+    assert parse_timevalue(None) is None
+    assert parse_timevalue(-1) is None
+    assert parse_timevalue("-1") is None
+    with pytest.raises(ValueError):
+        parse_timevalue("fast-ish")
+
+
+def test_deadline_budget_and_clamp():
+    d = Deadline.after(10.0)
+    assert d.bounded and not d.expired()
+    assert 9.0 < d.remaining() <= 10.0
+    # clamp takes the tighter of the two
+    assert d.clamp(5.0) == pytest.approx(5.0, abs=0.1)
+    assert d.clamp(60.0) == pytest.approx(d.remaining(), abs=0.1)
+    assert d.clamp(None) == pytest.approx(d.remaining(), abs=0.1)
+
+
+def test_deadline_expiry():
+    d = Deadline.after(0.0)
+    assert d.expired()
+    assert d.remaining() == 0.0
+    assert d.clamp(30.0) == 0.0
+
+
+def test_unbounded_deadline_is_inert():
+    d = Deadline.after(None)
+    assert not d.bounded and not d.expired()
+    assert d.remaining() is None
+    assert d.clamp(30.0) == 30.0
+    assert d.clamp(None) is None
+    assert NO_DEADLINE.clamp(7.0) == 7.0
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy: jitter bounds, classification, deadline budget
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_jitter_bounds():
+    """Decorrelated jitter: every sleep lands in [base, cap] and never exceeds
+    3x the previous sleep."""
+    policy = RetryPolicy(max_attempts=10, base_s=0.05, cap_s=2.0,
+                         rng=random.Random(7))
+    prev = None
+    for _ in range(200):
+        nxt = policy.next_backoff(prev)
+        assert policy.base_s <= nxt <= policy.cap_s
+        assert nxt <= max(policy.base_s, (prev if prev is not None
+                                          else policy.base_s) * 3.0) + 1e-9
+        prev = nxt
+
+
+def test_backoff_is_seeded_deterministic():
+    a = RetryPolicy(rng=random.Random(13))
+    b = RetryPolicy(rng=random.Random(13))
+    sa = sb = None
+    for _ in range(20):
+        sa, sb = a.next_backoff(sa), b.next_backoff(sb)
+        assert sa == sb
+
+
+def test_retry_transient_then_success_counts_attempts():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransportError("blip")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=5, rng=random.Random(0), sleep=lambda s: None)
+    assert policy.call(flaky) == "ok"
+    assert len(calls) == 3
+
+
+def test_no_retry_on_non_transient():
+    calls = []
+
+    def conflict():
+        calls.append(1)
+        raise VersionConflictError("d#1", 2, 1)
+
+    policy = RetryPolicy(max_attempts=5, rng=random.Random(0), sleep=lambda s: None)
+    with pytest.raises(VersionConflictError):
+        policy.call(conflict)
+    assert len(calls) == 1  # deterministic failures never retry
+
+
+def test_retry_exhaustion_carries_cause():
+    policy = RetryPolicy(max_attempts=3, rng=random.Random(0), sleep=lambda s: None)
+    with pytest.raises(RetryExhaustedError) as ei:
+        policy.call(lambda: (_ for _ in ()).throw(NodeNotConnectedError("gone")))
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.cause, NodeNotConnectedError)
+
+
+def test_retry_deadline_exhaustion_stops_early():
+    """A sleep that would eat the whole remaining budget is not taken — the
+    policy reports exhaustion instead of sleeping past the deadline."""
+    slept = []
+
+    def sleeping(s):
+        slept.append(s)
+        time.sleep(s)
+
+    policy = RetryPolicy(max_attempts=50, base_s=0.1, cap_s=0.1,
+                         rng=random.Random(0), sleep=sleeping)
+    deadline = Deadline.after(0.25)
+    calls = []
+
+    def always_down():
+        calls.append(1)
+        raise TransportError("down")
+
+    with pytest.raises(RetryExhaustedError):
+        policy.call(always_down, deadline=deadline)
+    assert len(calls) <= 4  # nowhere near the attempt cap — budget won
+    assert sum(slept) <= 0.25 + 1e-6
+
+
+def test_is_transient_classification():
+    assert is_transient(NodeNotConnectedError("x"))
+    assert is_transient(ReceiveTimeoutError("x"))
+    assert is_transient(TransportError("x"))
+    assert not is_transient(VersionConflictError("d#1", 2, 1))
+    from elasticsearch_tpu.common.errors import ActionNotFoundError
+    assert not is_transient(ActionNotFoundError("no handler"))
+
+
+# ---------------------------------------------------------------------------
+# FaultPolicy over a live transport pair
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def local_pair():
+    registry = LocalTransportRegistry()
+    a = TransportService(LocalTransport("a:1", registry))
+    b = TransportService(LocalTransport("b:1", registry))
+    b.register_handler("t/echo", lambda req, ch: {"v": req.get("v")})
+    yield a, b
+    a.close()
+    b.close()
+
+
+def test_fault_error_and_disconnect_rules(local_pair):
+    a, b = local_pair
+    policy = FaultPolicy(seed=1).install(a)
+    policy.error(TransportError("injected"), action="t/echo", max_hits=1)
+    with pytest.raises(TransportError, match="injected"):
+        a.submit_request("b:1", "t/echo", {"v": 1}, timeout=5)
+    policy.disconnect(action="t/echo", max_hits=1)
+    with pytest.raises(NodeNotConnectedError):
+        a.submit_request("b:1", "t/echo", {"v": 2}, timeout=5)
+    # both rules disarmed: the path heals
+    assert a.submit_request("b:1", "t/echo", {"v": 3}, timeout=5) == {"v": 3}
+    assert policy.injected == 2
+
+
+def test_fault_drop_surfaces_as_response_timeout(local_pair):
+    a, b = local_pair
+    FaultPolicy(seed=1).install(a)
+    a.fault_policy.drop(action="t/echo", max_hits=1)
+    with pytest.raises(ReceiveTimeoutError):
+        a.submit_request("b:1", "t/echo", {"v": 1}, timeout=0.2)
+    assert a.submit_request("b:1", "t/echo", {"v": 2}, timeout=5) == {"v": 2}
+
+
+def test_fault_delay_rule_delays_but_delivers(local_pair):
+    a, b = local_pair
+    FaultPolicy(seed=1).install(a)
+    a.fault_policy.delay(0.15, action="t/echo", max_hits=1)
+    t0 = time.monotonic()
+    assert a.submit_request("b:1", "t/echo", {"v": 9}, timeout=5) == {"v": 9}
+    assert time.monotonic() - t0 >= 0.15
+
+
+def test_recv_rule_matches_receiver_address(local_pair):
+    """direction="recv" rules match the RECEIVING node's own address — a node
+    pattern must select the faulted receiver, not silently never fire."""
+    a, b = local_pair
+    FaultPolicy(seed=1).install(b)
+    b.fault_policy.error(TransportError("recv-injected"), action="t/echo",
+                         node="b:1", direction="recv")
+    with pytest.raises(TransportError, match="recv-injected"):
+        a.submit_request("b:1", "t/echo", {"v": 1}, timeout=5)
+    # a rule for some OTHER receiver stays dormant
+    b.fault_policy.clear()
+    b.fault_policy.error(TransportError("wrong node"), action="t/echo",
+                         node="z:9", direction="recv")
+    assert a.submit_request("b:1", "t/echo", {"v": 2}, timeout=5) == {"v": 2}
+
+
+def test_fault_rule_node_and_where_matching(local_pair):
+    a, b = local_pair
+    policy = FaultPolicy(seed=1).install(a)
+    # node pattern that matches nothing we send to
+    policy.disconnect(action="t/echo", node="z:*")
+    # where-refinement: only requests for shard 0
+    policy.error(TransportError("shard0 only"), action="t/echo",
+                 where=lambda act, addr, req: (req or {}).get("shard") == 0)
+    assert a.submit_request("b:1", "t/echo", {"v": 1, "shard": 1}, timeout=5) \
+        == {"v": 1}
+    with pytest.raises(TransportError, match="shard0 only"):
+        a.submit_request("b:1", "t/echo", {"v": 1, "shard": 0}, timeout=5)
+
+
+def test_fault_probability_replays_from_seed():
+    decisions = []
+    for _ in range(2):
+        policy = FaultPolicy(seed=42)
+        policy.error(probability=0.5, action="t/*")
+        decisions.append([policy.decide("t/echo", "n:1", {}) is not None
+                          for _ in range(64)])
+    assert decisions[0] == decisions[1]
+    assert any(decisions[0]) and not all(decisions[0])
+
+
+def test_fault_rule_rejects_unknown_kind():
+    with pytest.raises(ValueError):
+        FaultRule(kind="explode")
+    with pytest.raises(ValueError):
+        FaultRule(direction="sideways")
+
+
+# ---------------------------------------------------------------------------
+# cluster: search under injected faults (the acceptance scenarios)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def two_node_cluster(tmp_path_factory):
+    with TestCluster(n_nodes=2, data_root=tmp_path_factory.mktemp("resil"),
+                     seed=11, name="rs") as cluster:
+        # pin the transport scatter-gather path: the mesh SPMD bypass serves
+        # co-located copies without any RPC, which would dodge injected faults
+        for node in cluster.nodes.values():
+            node.actions.mesh_serving.enabled = False
+        client = cluster.client()
+        client.create_index("resil", {"settings": {
+            "index.number_of_shards": 2, "index.number_of_replicas": 1}})
+        cluster.ensure_green("resil")
+        for i in range(40):
+            client.index("resil", "doc", {"title": f"hello world {i}", "n": i},
+                         id=str(i))
+        client.refresh("resil")
+        yield cluster
+
+
+def _search_node(cluster):
+    name = sorted(cluster.nodes)[0]
+    return name, cluster.nodes[name]
+
+
+def test_search_fails_over_around_disconnect_faults(two_node_cluster):
+    """(a1) one copy of every group downed via disconnect rules: failover to
+    the other copy keeps the search whole — 200, zero failed shards."""
+    cluster = two_node_cluster
+    name, node = _search_node(cluster)
+    other = next(n for n in sorted(cluster.nodes) if n != name)
+    policy = cluster.fault_policy(name, seed=3)
+    try:
+        rule = policy.disconnect(action=A_QUERY, node=cluster.address(other))
+        # _prefer_node pins the REMOTE (faulted) copy as every chain's first
+        # candidate, so the test cannot vacuously pass by local-only routing
+        resp = node.client().search(
+            "resil", {"query": {"match": {"title": "hello"}}},
+            preference=f"_prefer_node:{cluster.nodes[other].local_node.id}")
+        assert resp["hits"]["total"] == 40
+        assert resp["_shards"]["failed"] == 0
+        assert resp["_shards"]["successful"] == resp["_shards"]["total"]
+        assert resp["timed_out"] is False
+        # every shard group's first attempt hit the downed copy; failover is
+        # what kept failed == 0
+        assert rule.hits >= 2, rule.hits
+    finally:
+        cluster.clear_faults()
+
+
+def test_search_reports_failure_per_downed_copy(two_node_cluster):
+    """(a2) EVERY copy of every group downed: chains exhaust — still 200, with
+    _shards.failed == number of exhausted chains and a failure entry naming
+    each downed copy."""
+    cluster = two_node_cluster
+    name, node = _search_node(cluster)
+    policy = cluster.fault_policy(name, seed=4)
+    try:
+        policy.disconnect(action=A_QUERY)  # all copies, all nodes
+        resp = node.client().search("resil", {"query": {"match": {"title": "hello"}}})
+        assert resp["hits"]["total"] == 0
+        assert resp["hits"]["hits"] == []
+        assert resp["_shards"]["failed"] == resp["_shards"]["total"] == 2
+        assert resp["_shards"]["successful"] == 0
+        failures = resp["_shards"]["failures"]
+        # 2 groups x 2 copies — one entry per downed copy, naming its node
+        assert len(failures) == 4
+        assert all(f.get("node") for f in failures)
+        per_shard = {f["shard"] for f in failures}
+        assert per_shard == {0, 1}
+    finally:
+        cluster.clear_faults()
+
+
+def test_timeout_against_delayed_shard_returns_partial(two_node_cluster):
+    """(b) `timeout=50ms` with one shard's transport delay-faulted: the
+    response arrives promptly, timed_out, with the healthy shard's hits."""
+    cluster = two_node_cluster
+    name, node = _search_node(cluster)
+    body = {"query": {"match": {"title": "hello"}}, "size": 40}
+    # warm the exact query first (device compile happens once per plan shape):
+    # the budget below must race the injected TRANSPORT delay, not a cold jit
+    warm = node.client().search("resil", body)
+    assert warm["hits"]["total"] == 40
+    policy = cluster.fault_policy(name, seed=5)
+    try:
+        policy.delay(0.6, action=A_QUERY,
+                     where=lambda act, addr, req: (req or {}).get("shard") == 0)
+        t0 = time.monotonic()
+        resp = node.client().search("resil", {**body, "timeout": "150ms"})
+        took = time.monotonic() - t0
+        assert resp["timed_out"] is True
+        # partial: shard 1 answered, shard 0's chain ran out of budget
+        assert 0 < resp["hits"]["total"] < 40
+        assert len(resp["hits"]["hits"]) == resp["hits"]["total"]
+        assert resp["_shards"]["failed"] >= 1
+        assert any(f["shard"] == 0 for f in resp["_shards"]["failures"])
+        # the whole point: no 60s attempt timeout, no stacked waits
+        assert took < 6.0
+    finally:
+        cluster.clear_faults()
+
+
+def test_search_timeout_via_rest_query_param(two_node_cluster):
+    """REST `?timeout=` reaches ParsedSearchRequest.timeout_s and an untroubled
+    search completes well inside it, timed_out false."""
+    cluster = two_node_cluster
+    _name, node = _search_node(cluster)
+    from elasticsearch_tpu.rest import RestRequest, build_rest_controller
+
+    rc = build_rest_controller(node)
+    resp = rc.dispatch(RestRequest(method="GET", path="/resil/_search",
+                                   params={"timeout": "30s", "size": "5"}))
+    assert resp.status == 200
+    assert resp.body["timed_out"] is False
+    assert resp.body["hits"]["total"] == 40
+    assert len(resp.body["hits"]["hits"]) == 5
+    # a malformed timeout is a parse error (400), not a 500
+    bad = rc.dispatch(RestRequest(method="GET", path="/resil/_search",
+                                  params={"timeout": "soonish"}))
+    assert bad.status == 400
+
+
+# ---------------------------------------------------------------------------
+# shard-side deadline: segment-granularity partial results
+# ---------------------------------------------------------------------------
+
+
+def test_query_phase_expired_deadline_returns_empty_partial(two_node_cluster):
+    cluster = two_node_cluster
+    _name, node = _search_node(cluster)
+    from elasticsearch_tpu.search.service import execute_query_phase, parse_search_body
+
+    shard_id, ctx = _any_local_shard_ctx(node, "resil")
+    req = parse_search_body({"query": {"match": {"title": "hello"}},
+                             "sort": [{"n": "asc"}]})
+    r = execute_query_phase(ctx, req, shard_id=shard_id,
+                            deadline=Deadline.after(0.0))
+    assert r.timed_out is True
+    assert r.docs == [] and r.total == 0
+
+
+def test_query_phase_generous_deadline_is_complete(two_node_cluster):
+    cluster = two_node_cluster
+    _name, node = _search_node(cluster)
+    from elasticsearch_tpu.search.service import execute_query_phase, parse_search_body
+
+    shard_id, ctx = _any_local_shard_ctx(node, "resil")
+    req = parse_search_body({"query": {"match": {"title": "hello"}},
+                             "sort": [{"n": "asc"}], "size": 40})
+    full = execute_query_phase(ctx, req, shard_id=shard_id)
+    bounded = execute_query_phase(ctx, req, shard_id=shard_id,
+                                  deadline=Deadline.after(30.0))
+    assert bounded.timed_out is False
+    assert bounded.total == full.total
+    assert [d[1] for d in bounded.docs] == [d[1] for d in full.docs]
+
+
+def _any_local_shard_ctx(node, index):
+    svc = node.indices.index_service(index)
+    shard_id = sorted(svc.shards)[0]
+    return shard_id, node.actions._shard_ctx(index, shard_id)
+
+
+# ---------------------------------------------------------------------------
+# write path: no replica failure is silently swallowed
+# ---------------------------------------------------------------------------
+
+
+def test_dead_replica_is_reported_shard_failed(tmp_path):
+    """Regression for the bare `except SearchEngineError: pass` replica loops:
+    with the replica's write transport hard-down (disconnect faults on the
+    primary node's sender), a bulk must still succeed on the primary AND the
+    master must mark the replica copy failed — not leave it silently
+    diverging until the next recovery."""
+    with TestCluster(n_nodes=2, data_root=tmp_path, seed=21, name="rw") as cluster:
+        client = cluster.client()
+        client.create_index("wr", {"settings": {
+            "index.number_of_shards": 1, "index.number_of_replicas": 1}})
+        cluster.ensure_green("wr")
+        # find the primary's node; fault ALL replica-bound write traffic from it
+        state = next(iter(cluster.nodes.values())).cluster_service.state
+        primary = state.routing_table.index("wr").shard(0).primary
+        primary_name = next(n for n, nd in cluster.nodes.items()
+                            if nd.local_node.id == primary.node_id)
+        primary_node = cluster.nodes[primary_name]
+        # fast retry schedule so exhaustion happens in test time
+        primary_node.actions.retry_policy = RetryPolicy(
+            max_attempts=2, base_s=0.01, cap_s=0.02, rng=random.Random(0))
+        policy = cluster.fault_policy(primary_name, seed=6)
+        policy.disconnect(action="indices:data/write/*[r]")
+
+        ops = [{"action": {"index": {"_index": "wr", "_type": "doc",
+                                     "_id": str(i)}},
+                "source": {"n": i}} for i in range(5)]
+        resp = primary_node.client().bulk(ops)
+        assert resp["errors"] is False  # primary writes all succeeded
+
+        # the master must observe the replica copy failed (routed out of the
+        # group) — poll briefly for the state update to land
+        def replica_routed_out():
+            st = primary_node.cluster_service.state
+            group = st.routing_table.index("wr").shard(0)
+            return all(not (r.active and r.node_id != primary.node_id)
+                       for r in group.shards)
+
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not replica_routed_out():
+            time.sleep(0.05)
+        assert replica_routed_out(), \
+            primary_node.cluster_service.state.routing_table.index("wr").shard(0)
+
+
+def test_single_doc_replica_failure_reported(tmp_path):
+    """Same guarantee on the non-bulk path (_replicate): index one doc with the
+    replica link down; the op acks and the copy is marked failed."""
+    with TestCluster(n_nodes=2, data_root=tmp_path, seed=22, name="rx") as cluster:
+        client = cluster.client()
+        client.create_index("one", {"settings": {
+            "index.number_of_shards": 1, "index.number_of_replicas": 1}})
+        cluster.ensure_green("one")
+        state = next(iter(cluster.nodes.values())).cluster_service.state
+        primary = state.routing_table.index("one").shard(0).primary
+        primary_name = next(n for n, nd in cluster.nodes.items()
+                            if nd.local_node.id == primary.node_id)
+        primary_node = cluster.nodes[primary_name]
+        primary_node.actions.retry_policy = RetryPolicy(
+            max_attempts=2, base_s=0.01, cap_s=0.02, rng=random.Random(0))
+        cluster.fault_policy(primary_name, seed=7).disconnect(
+            action="indices:data/write/*[r]")
+
+        r = primary_node.client().index("one", "doc", {"v": 1}, id="1")
+        assert r["_version"] == 1
+
+        def replica_failed():
+            st = primary_node.cluster_service.state
+            group = st.routing_table.index("one").shard(0)
+            return all(not (s.active and s.node_id != primary.node_id)
+                       for s in group.shards)
+
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not replica_failed():
+            time.sleep(0.05)
+        assert replica_failed()

@@ -86,17 +86,43 @@ def test_dead_node_raises_not_connected(pair):
 
 
 def test_handler_slow_response_timeout(pair):
+    """Response-timeout path, made deterministic: instead of a wall-clock
+    handler sleep racing teardown, a FaultPolicy recv-delay rule on the remote
+    service postpones the handler past the request timeout. The timeout is
+    enforced on the request future itself (ReceiveTimeoutError, not a leaked
+    concurrent.futures.TimeoutError — the pre-3.11 alias bug this test caught),
+    and the late response is then discarded, not delivered."""
+    from elasticsearch_tpu.transport.faults import FaultPolicy
+
     a, b = pair
-    gate = threading.Event()
+    handled = threading.Event()
 
     def slow(req, ch):
-        gate.wait(20)
-        return {}
+        handled.set()
+        return {"late": True}
 
     b.register_handler("test/slow", slow)
+    FaultPolicy(seed=0).install(b)
+    b.fault_policy.delay(1.0, action="test/slow", direction="recv")
     with pytest.raises(ReceiveTimeoutError):
-        a.submit_request(addr(b), "test/slow", {}, timeout=0.3)
-    gate.set()
+        a.submit_request(addr(b), "test/slow", {}, timeout=0.2)
+    # the delayed handler still runs — its answer must land nowhere
+    assert handled.wait(5.0)
+
+
+def test_fault_disconnect_rule_over_tcp(pair):
+    """A send-side disconnect rule fails fast with NodeNotConnectedError
+    without touching the (healthy) socket; removing the rule heals the path."""
+    from elasticsearch_tpu.transport.faults import FaultPolicy
+
+    a, b = pair
+    b.register_handler("test/echo", lambda req, ch: {"ok": True})
+    policy = FaultPolicy(seed=0).install(a)
+    rule = policy.disconnect(action="test/echo", max_hits=1)
+    with pytest.raises(NodeNotConnectedError):
+        a.submit_request(addr(b), "test/echo", {}, timeout=5)
+    assert rule.hits == 1
+    assert a.submit_request(addr(b), "test/echo", {}, timeout=5) == {"ok": True}
 
 
 def test_two_node_cluster_over_tcp(tmp_path):

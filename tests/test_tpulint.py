@@ -137,6 +137,19 @@ def test_repo_clean_under_baseline():
         + "\n  ".join(f"{f.key}  {f.message}" for f in new))
 
 
+def test_resilience_modules_scan_clean():
+    """The PR-3 resilience layer (deadline/retry/faults) is host-only control
+    code: deadline checks use time.monotonic on host paths and must never leak
+    into traced regions — pin that the repo scan covers these modules and finds
+    nothing (the baseline stays empty)."""
+    paths = [os.path.join(REPO, "elasticsearch_tpu", *parts) for parts in (
+        ("common", "deadline.py"), ("common", "retry.py"),
+        ("transport", "faults.py"), ("transport", "service.py"))]
+    for p in paths:
+        assert os.path.exists(p), p
+    assert lint_paths(paths) == []
+
+
 def test_baseline_is_empty_and_stays_empty():
     """PR 2 burned the 20 grandfathered TPU001 findings down to zero; the
     baseline must never regrow (new findings already fail
