@@ -32,12 +32,14 @@ from .common.deadline import NO_DEADLINE, Deadline
 from .common.retry import RetryPolicy
 from .common.errors import (
     ActionNotFoundError,
+    CircuitBreakingError,
     DocumentMissingError,
     IndexAlreadyExistsError,
     IndexMissingError,
     MasterNotDiscoveredError,
     NoShardAvailableError,
     ReceiveTimeoutError,
+    RejectedExecutionError,
     IndexWarmerMissingError,
     SearchEngineError,
     TransportError,
@@ -162,6 +164,11 @@ class ActionModule:
         # exhaustion is REPORTED to the master — never swallowed (tests swap in
         # a faster policy)
         self.retry_policy = RetryPolicy(max_attempts=3, base_s=0.05, cap_s=1.0)
+        # deadline-aware admission control: searches whose remaining budget
+        # cannot cover one observed shard phase are 429'd BEFORE the fan-out
+        from .search.service import SearchAdmissionController
+
+        self.admission = SearchAdmissionController()
         t = self.transport
         # master-node actions
         for action, fn in [
@@ -1494,6 +1501,10 @@ class ActionModule:
         if deadline is None:
             deadline = Deadline.after(req.timeout_s) if req.timeout_s is not None \
                 else NO_DEADLINE
+        # admission control: a budget that cannot cover one expected shard
+        # phase is rejected up front (429 + Retry-After) — running it would
+        # only burn workers on an answer the client already abandoned
+        self.admission.admit(deadline)
         shards = self.routing.search_shards(state, indices, routing, preference)
 
         # co-located shards + flat query → one SPMD program over the device mesh
@@ -1544,6 +1555,9 @@ class ActionModule:
             }
         results: list[ShardQueryResult] = []
         failures = []
+        # terminal error of each FAILED chain (None = not overload-shaped,
+        # e.g. a DFS phase dead on every copy) — decides 429 vs 200-partial
+        chain_terminals: list = []
         # merge identity is a coordinator-assigned ordinal — (index, shard) pairs from
         # different indices may share a shard id (ref: the per-request shard index in
         # TransportSearchTypeAction), so results carry the ordinal as shard_id
@@ -1552,6 +1566,7 @@ class ActionModule:
         # once and failover chains advance via future callbacks, so N-shard latency is
         # max(shard) not sum(shard) and no coordinator thread parks per shard
         # (ref: TransportSearchTypeAction.java:135-216 async performFirstPhase)
+        t_fanout = time.monotonic()
         query_futs = [
             None if ordinal in dfs_failed else
             self._query_shard_async(state, copy, body, alias_filters, dfs_stats,
@@ -1570,6 +1585,7 @@ class ActionModule:
             if fut is None:
                 failures.append({"index": copy.index, "shard": copy.shard_id,
                                  "reason": "dfs phase failed on every copy"})
+                chain_terminals.append(None)  # a data failure, never overload
                 continue
             try:
                 r, used, err = fut.result(
@@ -1583,6 +1599,12 @@ class ActionModule:
                 shard_meta[ordinal] = (copy.index, r.shard_id, used, r.context_id)
                 r.shard_id = ordinal
                 results.append(r)
+                # feed admission control: coordinator-observed shard-phase
+                # latency, fan-out → future RESOLUTION (stamped by the chain;
+                # falls back to now inside the callback race window) — the
+                # decaying signal the next request's budget is compared against
+                self.admission.observe(
+                    getattr(fut, "completed_at", time.monotonic()) - t_fanout)
             else:
                 # one failure entry per attempted copy (ref: ShardSearchFailure
                 # carries the shard target) — chains record each downed copy.
@@ -1596,6 +1618,31 @@ class ActionModule:
                 for node_id, copy_err in per_copy:
                     failures.append({"index": copy.index, "shard": copy.shard_id,
                                      "node": node_id, "reason": str(copy_err)})
+                terminal = err if err is not None \
+                    else per_copy[-1][1] if per_copy else None
+                chain_terminals.append(terminal)
+                # failed chains feed admission too — a degrading node whose
+                # phases all time out must RAISE the latency signal, not
+                # starve it (successes-only would freeze it at the healthy
+                # value). Overload rejections are excluded: they resolve
+                # near-instantly and would drag the signal DOWN mid-overload
+                if not isinstance(terminal, (CircuitBreakingError,
+                                             RejectedExecutionError)):
+                    self.admission.observe(
+                        getattr(fut, "completed_at", time.monotonic())
+                        - t_fanout)
+        overload = [e for e in chain_terminals
+                    if isinstance(e, (CircuitBreakingError,
+                                      RejectedExecutionError))]
+        if not results and chain_terminals \
+                and len(overload) == len(chain_terminals):
+            # EVERY shard's failover chain died on overload protection — this
+            # is a load-shed, not a data failure: surface the 429 (with its
+            # Retry-After hint) so clients back off instead of retrying hot.
+            # Any chain that died on something ELSE keeps the normal partial
+            # response with its _shards.failures entries — a permanent data
+            # failure must not masquerade as "retry later"
+            raise overload[-1]
         # shard-side partials mark timed_out in the reduce (sort_docs); chain
         # exhaustion by deadline must surface it too, even with no results back
         return self._finish_search(req, body, results, failures, shards,
@@ -1710,6 +1757,12 @@ class ActionModule:
         error | None); every failed attempt is recorded on the returned
         future's `attempt_errors` as (node_id, error)."""
         done: Future = Future()
+        # stamp resolution time for admission-control latency: the collection
+        # loop drains futures in ordinal order, so "time until collected" of a
+        # fast shard parked behind a slow chain would overstate its phase by
+        # the whole wait (first callback → runs at resolution)
+        done.add_done_callback(
+            lambda f: setattr(f, "completed_at", time.monotonic()))
         group = state.routing_table.index(copy.index).shard(copy.shard_id)
         candidates = [copy] + [s for s in group.active_shards()
                                if s.node_id != copy.node_id]
@@ -1851,7 +1904,7 @@ class ActionModule:
             }
         return ShardContext(shard.engine.acquire_searcher(), svc.mapper_service,
                             svc.similarity_service, global_stats,
-                            index_name=index)
+                            index_name=index, breakers=self.node.breakers)
 
     def _s_query_phase(self, request, channel):
         index, shard_id = request["index"], request["shard"]

@@ -1,46 +1,122 @@
-"""Memory circuit breaker.
+"""Hierarchical memory circuit breakers.
 
-Analogue of common/breaker/MemoryCircuitBreaker.java + the fielddata breaker service
-(indices/fielddata/breaker/InternalCircuitBreakerService.java): estimates bytes before a
-large allocation (device postings pack, fielddata load, aggregation arrays) and trips with
-CircuitBreakingError instead of OOMing the host or HBM."""
+Analogue of common/breaker/MemoryCircuitBreaker.java + the breaker service
+(indices/fielddata/breaker/InternalCircuitBreakerService.java, later
+HierarchyCircuitBreakerService): estimate bytes BEFORE a large allocation
+(host merge buffers, device-index packing, agg bucket materialization, mesh
+result assembly, in-flight transport messages) and trip with
+CircuitBreakingError (HTTP 429) instead of OOMing the host or HBM.
+
+Hierarchy: every child breaker (`request`, `fielddata`, `in_flight_requests`)
+has its own limit, and all children share ONE parent budget — a request that
+fits its child limit still trips when the node as a whole is out of headroom.
+
+Rules:
+
+- estimate-before-allocate, release in `finally` — accounting is transient, so
+  a drained node always returns to 0 estimated bytes;
+- accounting is HOST-side only and must never run inside traced (jit/shard_map)
+  code: a breaker call during tracing either freezes the first call's estimate
+  into the program or retraces per request (tpulint TPU010 enforces this);
+- lock order is child → parent, never the reverse — children never call into
+  each other and the parent never calls into a child, so there is no cycle.
+"""
 
 from __future__ import annotations
 
+import contextlib
 import threading
 
 from .errors import CircuitBreakingError
-from .units import parse_ratio_or_bytes
+from .units import parse_bytes, parse_ratio_or_bytes
 
 
 class MemoryCircuitBreaker:
-    def __init__(self, limit_bytes: int, overhead: float = 1.0, name: str = "fielddata"):
+    """One named breaker. `parent` (another MemoryCircuitBreaker, no parent of
+    its own) is consulted AFTER the child's own limit passes, so a trip at
+    either level leaves both levels' accounting untouched."""
+
+    def __init__(self, limit_bytes: int, overhead: float = 1.0,
+                 name: str = "fielddata",
+                 parent: "MemoryCircuitBreaker | None" = None):
         self.name = name
         self.limit = int(limit_bytes)
         self.overhead = overhead
+        self.parent = parent
         self._used = 0
         self._trip_count = 0
+        self._leak_detected = 0
         self._lock = threading.Lock()
 
+    def _check(self, new_used: int, label: str, child: str | None = None):
+        """Raise (and count the trip) when `new_used` would exceed the limit.
+        Caller holds self._lock."""
+        if self.limit > 0 and new_used * self.overhead > self.limit:
+            self._trip_count += 1
+            who = f"[{self.name}]" if child is None else \
+                f"[{self.name}] (via [{child}])"
+            err = CircuitBreakingError(
+                f"{who} data for [{label}] would be larger than limit of "
+                f"[{self.limit}] bytes (estimated [{new_used}])")
+            # WHICH breaker tripped decides degrade-vs-shed upstream: a
+            # fielddata trip can fall back to the host scorer, a request or
+            # parent trip means the node is out of budget and must 429
+            err.breaker = self.name
+            raise err
+
     def add_estimate_and_maybe_break(self, bytes_: int, label: str = "") -> int:
+        """Reserve `bytes_` or raise CircuitBreakingError. The read-modify-write
+        is fully under the lock: concurrent searches can never jointly blow
+        past the limit between the check and the commit."""
+        bytes_ = int(bytes_)
+        if bytes_ < 0:
+            self.release(-bytes_)
+            return self._used
         with self._lock:
             new_used = self._used + bytes_
-            if self.limit > 0 and new_used * self.overhead > self.limit:
-                self._trip_count += 1
-                raise CircuitBreakingError(
-                    f"[{self.name}] data for [{label}] would be larger than limit of "
-                    f"[{self.limit}] bytes (estimated [{new_used}])"
-                )
+            self._check(new_used, label)
+            if self.parent is not None:
+                # child → parent lock order, always; a parent trip propagates
+                # before the child commits, so nothing needs unwinding
+                self.parent._add_from_child(bytes_, label, self.name)
+            self._used = new_used
+            return self._used
+
+    def _add_from_child(self, bytes_: int, label: str, child: str) -> int:
+        with self._lock:
+            new_used = self._used + bytes_
+            self._check(new_used, label, child=child)
             self._used = new_used
             return self._used
 
     def add_without_breaking(self, bytes_: int) -> int:
+        """Adjust accounting without the limit check (post-hoc corrections).
+        Negative amounts clamp at zero like release()."""
+        bytes_ = int(bytes_)
+        if bytes_ < 0:
+            self.release(-bytes_)
+            return self._used
         with self._lock:
             self._used += bytes_
-            return self._used
+        if self.parent is not None:
+            self.parent.add_without_breaking(bytes_)
+        return self._used
 
     def release(self, bytes_: int):
-        self.add_without_breaking(-bytes_)
+        """Return reserved bytes. Over-release (double release, or releasing
+        more than held) clamps at zero and counts a leak instead of driving
+        `used` negative — negative accounting silently inflates headroom for
+        every later request, which is how a tracked budget rots."""
+        bytes_ = int(bytes_)
+        if bytes_ <= 0:
+            return
+        with self._lock:
+            freed = min(bytes_, self._used)
+            if freed < bytes_:
+                self._leak_detected += 1
+            self._used -= freed
+        if self.parent is not None and freed:
+            self.parent.release(freed)
 
     @property
     def used(self) -> int:
@@ -50,40 +126,92 @@ class MemoryCircuitBreaker:
     def trip_count(self) -> int:
         return self._trip_count
 
+    @property
+    def leak_detected(self) -> int:
+        return self._leak_detected
+
+    def stats(self) -> dict:
+        return {
+            "limit": self.limit,
+            "limit_size_in_bytes": self.limit,
+            "estimated": self._used,
+            "estimated_size_in_bytes": self._used,
+            "overhead": self.overhead,
+            "tripped": self._trip_count,
+            "leak_detected": self._leak_detected,
+        }
+
+
+@contextlib.contextmanager
+def reserve(breaker: MemoryCircuitBreaker | None, bytes_: int, label: str = ""):
+    """Estimate-before-allocate scope: charge on entry, ALWAYS release on exit.
+
+    `breaker=None` (an unwired context — unit tests, standalone shard work) is
+    a no-op, so hot-spot call sites never need to special-case it. Must never
+    wrap traced code (tpulint TPU010)."""
+    if breaker is None or bytes_ <= 0:
+        yield 0
+        return
+    breaker.add_estimate_and_maybe_break(int(bytes_), label)
+    try:
+        yield int(bytes_)
+    finally:
+        breaker.release(int(bytes_))
+
 
 class CircuitBreakerService:
-    """Registry of named breakers; budget defaults follow the reference's
-    indices.fielddata.breaker.limit (80% of heap → here: of a configured budget)."""
+    """The node's breaker hierarchy. One parent budget
+    (`indices.breaker.total.limit`, default 70% of the configured byte budget)
+    over three children:
+
+    - `request`     — per-request host materialization: merge buffers, dense
+                      masks, agg bucket arrays, mesh assembly
+                      (`indices.breaker.request.limit`, default 60%)
+    - `fielddata`   — device-index column loads / segment packing
+                      (`indices.fielddata.breaker.limit`, default 80%,
+                      overhead `indices.fielddata.breaker.overhead` 1.03)
+    - `in_flight_requests` — encoded transport message bytes currently in
+                      flight (`network.breaker.inflight_requests.limit`,
+                      default 100%)
+
+    The byte budget itself comes from `indices.breaker.total_budget`
+    ("64kb" / "2gb" / raw bytes; default = the `total_budget_bytes` argument) —
+    chaos tests shrink it to force trips without gigabyte allocations."""
 
     def __init__(self, settings=None, total_budget_bytes: int = 8 << 30):
         from .settings import Settings
 
         settings = settings or Settings.EMPTY
-        limit = parse_ratio_or_bytes(
-            settings.get("indices.fielddata.breaker.limit"), total_budget_bytes, default="80%"
-        )
+        budget = parse_bytes(settings.get("indices.breaker.total_budget"),
+                             default=int(total_budget_bytes))
+        self.total_budget = budget
+        self.parent = MemoryCircuitBreaker(
+            parse_ratio_or_bytes(settings.get("indices.breaker.total.limit"),
+                                 budget, default="70%"),
+            1.0, "parent")
         overhead = settings.get_float("indices.fielddata.breaker.overhead", 1.03)
         self.breakers: dict[str, MemoryCircuitBreaker] = {
-            "fielddata": MemoryCircuitBreaker(limit, overhead, "fielddata"),
+            "fielddata": MemoryCircuitBreaker(
+                parse_ratio_or_bytes(
+                    settings.get("indices.fielddata.breaker.limit"),
+                    budget, default="80%"),
+                overhead, "fielddata", parent=self.parent),
             "request": MemoryCircuitBreaker(
                 parse_ratio_or_bytes(
-                    settings.get("indices.breaker.request.limit"), total_budget_bytes, default="40%"
-                ),
-                1.0,
-                "request",
-            ),
+                    settings.get("indices.breaker.request.limit"),
+                    budget, default="60%"),
+                1.0, "request", parent=self.parent),
+            "in_flight_requests": MemoryCircuitBreaker(
+                parse_ratio_or_bytes(
+                    settings.get("network.breaker.inflight_requests.limit"),
+                    budget, default="100%"),
+                1.0, "in_flight_requests", parent=self.parent),
         }
 
     def breaker(self, name: str = "fielddata") -> MemoryCircuitBreaker:
         return self.breakers[name]
 
     def stats(self) -> dict:
-        return {
-            name: {
-                "limit_size_in_bytes": b.limit,
-                "estimated_size_in_bytes": b.used,
-                "overhead": b.overhead,
-                "tripped": b.trip_count,
-            }
-            for name, b in self.breakers.items()
-        }
+        out = {name: b.stats() for name, b in self.breakers.items()}
+        out["parent"] = self.parent.stats()
+        return out

@@ -191,9 +191,28 @@ class SearchContextMissingError(SearchEngineError):
 
 
 class CircuitBreakingError(SearchEngineError):
-    """Memory circuit breaker tripped (ref: common/breaker/CircuitBreakingException.java)."""
+    """Memory circuit breaker tripped (ref: common/breaker/CircuitBreakingException.java).
+
+    429: the node is out of memory headroom, not broken — clients should back
+    off and retry after `retry_after_s` (surfaced as the Retry-After header).
+    `breaker` names the tripped breaker ("request"/"fielddata"/"parent"/...)
+    so serving paths can distinguish degradable fielddata trips from
+    must-shed request/parent trips."""
 
     status = 429
+    retry_after_s = 1.0
+    breaker: str | None = None
+
+
+class RejectedExecutionError(SearchEngineError):
+    """A bounded executor queue (or admission control) rejected the task
+    (ref: EsRejectedExecutionException out of EsThreadPoolExecutor). Transient
+    by definition — the same work succeeds on a less-saturated node — so
+    common/retry.py classifies it retryable, and the REST layer maps it to
+    429 with a Retry-After hint."""
+
+    status = 429
+    retry_after_s = 1.0
 
 
 class SnapshotError(SearchEngineError):

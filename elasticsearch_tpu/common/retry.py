@@ -24,6 +24,7 @@ from .errors import (
     MasterNotDiscoveredError,
     NodeNotConnectedError,
     ReceiveTimeoutError,
+    RejectedExecutionError,
     TransportError,
     UnavailableShardsError,
 )
@@ -31,6 +32,9 @@ from .errors import (
 # Failures worth a second attempt: the remote may answer after a reconnect, a
 # re-elected master, or a published cluster state. ActionNotFoundError is a
 # TransportError subclass but deterministic (400) — excluded below.
+# RejectedExecutionError is saturation, not breakage: the queue drains, and
+# the backoff jitter is exactly what keeps the retry from re-creating the
+# spike that filled it.
 _TRANSIENT = (
     NodeNotConnectedError,
     ReceiveTimeoutError,
@@ -38,6 +42,7 @@ _TRANSIENT = (
     MasterNotDiscoveredError,
     UnavailableShardsError,
     EngineClosedError,
+    RejectedExecutionError,
 )
 
 

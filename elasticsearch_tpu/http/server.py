@@ -96,6 +96,8 @@ class HttpServer:
                 self.send_response(response.status)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(payload)))
+                for name, value in (getattr(response, "headers", None) or {}).items():
+                    self.send_header(name, str(value))
                 self.end_headers()
                 if method != "HEAD":
                     self.wfile.write(payload)

@@ -83,9 +83,16 @@ class Node:
             data=self.settings.get_bool("node.data", True),
         )
         self.threadpool = ThreadPool(self.settings)
+        # overload protection: the node's breaker hierarchy (parent budget over
+        # request / fielddata / in_flight_requests children) — consulted by the
+        # search hot spots via ShardContext and by the transport send path
+        from .common.breaker import CircuitBreakerService
+
+        self.breakers = CircuitBreakerService(self.settings)
         if backend is None:
             backend = LocalTransport(address, self.registry)
         self.transport = TransportService(backend, self.local_node, self.threadpool)
+        self.transport.in_flight_breaker = self.breakers.breaker("in_flight_requests")
         self.cluster_service = ClusterService(self.name)
         self.allocation = AllocationService(self.settings)
         self.operation_routing = OperationRouting()
@@ -780,6 +787,10 @@ class Client:
             "indices": self.node.indices.stats(),
             "transport": self.node.transport.stats,
             "thread_pool": self.node.threadpool.stats(),
+            # overload protection: breaker hierarchy + admission control —
+            # the operator's view of how close the node is to shedding load
+            "breakers": self.node.breakers.stats(),
+            "admission_control": self.node.actions.admission.stats(),
             # which executor served each query phase (device kernel variants vs
             # host scorer; process-wide rollup)
             "search_serving": serving,

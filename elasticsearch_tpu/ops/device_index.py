@@ -25,6 +25,7 @@ from dataclasses import dataclass, field as dc_field
 
 import numpy as np
 
+from ..common.breaker import reserve
 from ..index.segment import FrozenSegment
 
 BLOCK = 128  # lane width
@@ -86,10 +87,30 @@ class PackedSegment:
         return int(self.term_blk_start[tid]), int(self.term_blk_start[tid + 1])
 
 
+def pack_estimate_bytes(seg: FrozenSegment) -> int:
+    """Host-staging + device-upload bytes pack_segment will allocate — the
+    estimate the fielddata breaker checks BEFORE the first np.full. Derived
+    from the same shape math as the pack itself (docs+freqs staged host-side
+    AND uploaded, plus the Dpad-wide masks/columns)."""
+    counts = np.diff(seg.post_offsets)
+    nblks = (counts + BLOCK - 1) // BLOCK
+    NBpad = _pow2_bucket(int(nblks.sum()) + 1, 64)
+    Dpad = _pow2_bucket(max(seg.doc_count, 1), 128)
+    n_norm_fields = len(seg.norms)
+    n_dv = len(seg.dv_num)
+    # (docs i32 + freqs f32) × (host staging + device copy) + live mask +
+    # norms u8 + single-valued dv f64 columns
+    return (NBpad * BLOCK * 8 * 2 + Dpad * 2
+            + Dpad * n_norm_fields + Dpad * 8 * n_dv)
+
+
 def pack_segment(seg: FrozenSegment, fields: list[str] | None = None,
                  device_put=None) -> PackedSegment:
     """Pack a frozen segment's postings + norms + single-valued numeric columns for
-    device execution. `fields` limits norm upload (None = all text fields)."""
+    device execution. `fields` limits norm upload (None = all text fields).
+    Breaker-guarded callers (packed_for) reserve pack_estimate_bytes around
+    this call — estimate-before-allocate; the pack itself is host-side numpy +
+    device_put, never traced."""
     import jax.numpy as jnp
 
     put = device_put or (lambda x: jnp.asarray(x))
@@ -224,29 +245,36 @@ def _pad_agg_rows(rows: np.ndarray, doc_pad: int, base: int = 0,
     return out
 
 
-def ensure_agg_rows(seg: FrozenSegment, packed: PackedSegment, fields: list[str]):
+def ensure_agg_rows(seg: FrozenSegment, packed: PackedSegment, fields: list[str],
+                    breaker=None):
     """Device-resident [F, 5, Dpad] stack for `fields`, or None when any column
     is not f32-exact (callers fall back to the host collectors). Per-field rows
     cache HOST-side; only the per-tuple device stacks (FIFO-bounded) hold device
-    memory — mirroring ensure_mesh_agg_stack."""
+    memory — mirroring ensure_mesh_agg_stack.
+
+    `breaker` (fielddata) reserves the [F, 5, Dpad] f32 stack (host rows +
+    device copy) before it is built — the per-doc fold columns are the
+    fielddata-load analogue on this engine."""
     import jax.numpy as jnp
 
     key = tuple(fields)
     stack = packed.agg_stacks.get(key)
     if stack is not None:
         return stack
-    for f in fields:
-        if f not in packed.agg_rows:
-            rows = agg_doc_rows(seg, f)
-            packed.agg_rows[f] = (None if rows is None
-                                  else _pad_agg_rows(rows, packed.doc_pad))
-    if any(packed.agg_rows[f] is None for f in fields):
-        return None
-    stack = jnp.asarray(np.stack([packed.agg_rows[f] for f in fields])
-                        if fields else np.zeros((0, 5, packed.doc_pad), np.float32))
-    while len(packed.agg_stacks) >= 8:
-        packed.agg_stacks.pop(next(iter(packed.agg_stacks)))
-    packed.agg_stacks[key] = stack
+    est = len(fields) * 5 * packed.doc_pad * 4 * 2  # host rows + device stack
+    with reserve(breaker, est, f"<agg_rows>{list(fields)}"):
+        for f in fields:
+            if f not in packed.agg_rows:
+                rows = agg_doc_rows(seg, f)
+                packed.agg_rows[f] = (None if rows is None
+                                      else _pad_agg_rows(rows, packed.doc_pad))
+        if any(packed.agg_rows[f] is None for f in fields):
+            return None
+        stack = jnp.asarray(np.stack([packed.agg_rows[f] for f in fields])
+                            if fields else np.zeros((0, 5, packed.doc_pad), np.float32))
+        while len(packed.agg_stacks) >= 8:
+            packed.agg_stacks.pop(next(iter(packed.agg_stacks)))
+        packed.agg_stacks[key] = stack
     return stack
 
 
@@ -310,12 +338,19 @@ def ensure_tfn(seg: FrozenSegment, packed: PackedSegment,
     packed.tfn_tables = merged
 
 
-def packed_for(seg: FrozenSegment) -> PackedSegment:
-    """Per-segment cached packing; refreshes the live mask when tombstones changed."""
+def packed_for(seg: FrozenSegment, breaker=None) -> PackedSegment:
+    """Per-segment cached packing; refreshes the live mask when tombstones changed.
+
+    `breaker` (the node's fielddata child) is consulted ONLY on a cache miss:
+    the estimate covers the pack's host staging + device upload and is released
+    once the pack lands — transient accounting, so a drained node reads 0.
+    A trip raises CircuitBreakingError; serving falls back to the host scorer
+    (the one graceful-degradation edge the reference lacks)."""
     cache = seg._device_cache
     packed: PackedSegment | None = cache.get("packed")
     if packed is None:
-        packed = pack_segment(seg)
+        with reserve(breaker, pack_estimate_bytes(seg), f"<segment_pack>[{seg.gen}]"):
+            packed = pack_segment(seg)
         cache["packed"] = packed
         cache["live"] = True
     elif cache.get("live") is None:

@@ -20,6 +20,8 @@ import threading
 
 import numpy as np
 
+from ..common.breaker import reserve as breaker_reserve
+from ..common.errors import CircuitBreakingError
 from ..common.logging import get_logger
 from ..search.execute import lower_flat
 from ..search.filters import segment_mask
@@ -103,12 +105,27 @@ class MeshServingService:
         try:
             results = self._search_mesh(index, n_total, shards, req,
                                         use_global_stats)
+        except CircuitBreakingError:
+            # a tripped breaker means the NODE is out of budget — falling back
+            # to the transport path would re-materialize the same request-sized
+            # buffers it just rejected; shed the load instead (429 upstream)
+            raise
         except Exception as e:  # noqa: BLE001 — any mesh failure must not fail the search
             results = None
             self.logger.warning(f"mesh path failed, falling back to transport: {e}")
         if results is None:
             self.mesh_fallbacks += 1  # eligible-looking but fell back mid-flight
         return results
+
+    def _breakers(self):
+        """The owning node's CircuitBreakerService (None when the indices
+        service is not node-attached — standalone unit tests)."""
+        node = getattr(self.indices, "node", None)
+        return getattr(node, "breakers", None)
+
+    def _breaker(self, name: str):
+        svc = self._breakers()
+        return None if svc is None else svc.breaker(name)
 
     def _prune(self, state):
         """Drop executors (and their device-resident index arrays) for indices that no
@@ -136,7 +153,7 @@ class MeshServingService:
         from ..search.execute import ShardContext
 
         ctxs = [ShardContext(s, svc.mapper_service, svc.similarity_service,
-                             index_name=index)
+                             index_name=index, breakers=self._breakers())
                 for s in searchers]
         ctx0 = ctxs[0]
         query = req.query
@@ -210,109 +227,119 @@ class MeshServingService:
             if c.field not in executor.index.fields:
                 return None
 
-        def shard_masks(f):
-            masks = np.zeros((S, 1, doc_pad), bool)
-            for si, searcher in enumerate(searchers):
-                for seg, base in zip(searcher.segments, searcher.bases):
-                    masks[si, 0, base: base + seg.doc_count] = \
-                        segment_mask(seg, f, ctxs[si])
-            return masks
+        # mesh result assembly — per-shard mask canvases, sort-key rows,
+        # bucket pair canvases and the gathered program output — reserved on
+        # the request breaker for the duration of the program + assembly
+        # (host-side code around the SPMD launch; the launch itself is traced
+        # and carries no breaker calls — tpulint TPU010)
+        n_mask_kinds = (1 if filt is not None else 0) + \
+            (1 if req.post_filter is not None else 0)
+        assembly_est = S * doc_pad * (n_mask_kinds + 4 + 8) + S * doc_pad
+        with breaker_reserve(self._breaker("request"), assembly_est,
+                             f"<mesh_assembly>[{index}]"):
+            def shard_masks(f):
+                masks = np.zeros((S, 1, doc_pad), bool)
+                for si, searcher in enumerate(searchers):
+                    for seg, base in zip(searcher.segments, searcher.bases):
+                        masks[si, 0, base: base + seg.doc_count] = \
+                            segment_mask(seg, f, ctxs[si])
+                return masks
 
-        filter_masks = shard_masks(filt) if filt is not None else None
-        post_masks = (shard_masks(req.post_filter)
-                      if req.post_filter is not None else None)
+            filter_masks = shard_masks(filt) if filt is not None else None
+            post_masks = (shard_masks(req.post_filter)
+                          if req.post_filter is not None else None)
 
-        # ---- single-field sort: per-shard key rows (host-exact fold, f32-exact
-        # gate per segment — sorting.device_sort_key_row) ----
-        sort_spec = req.sort[0] if req.sort else None
-        sort_keys = None
-        if sort_spec is not None:
-            from ..search.sorting import device_sort_key_row
+            # ---- single-field sort: per-shard key rows (host-exact fold, f32-exact
+            # gate per segment — sorting.device_sort_key_row) ----
+            sort_spec = req.sort[0] if req.sort else None
+            sort_keys = None
+            if sort_spec is not None:
+                from ..search.sorting import device_sort_key_row
 
-            fill = np.finfo(np.float32).max * (-1.0 if sort_spec.reverse else 1.0)
-            sort_keys = np.full((S, doc_pad), fill, np.float32)
-            for si, searcher in enumerate(searchers):
-                for seg, base in zip(searcher.segments, searcher.bases):
-                    row = device_sort_key_row(sort_spec, seg, seg.doc_count)
-                    if row is None:
-                        return None  # column/spec needs the host path
-                    sort_keys[si, base: base + seg.doc_count] = row
+                fill = np.finfo(np.float32).max * (-1.0 if sort_spec.reverse else 1.0)
+                sort_keys = np.full((S, doc_pad), fill, np.float32)
+                for si, searcher in enumerate(searchers):
+                    for seg, base in zip(searcher.segments, searcher.bases):
+                        row = device_sort_key_row(sort_spec, seg, seg.doc_count)
+                        if row is None:
+                            return None  # column/spec needs the host path
+                        sort_keys[si, base: base + seg.doc_count] = row
 
-        # ---- ONE per-doc fold stack for metric aggs and bucket sub-aggs ----
-        all_stack_fields = tuple(sorted(
-            set(metric_fields.values())
-            | {f for (_subs, order) in bucket_subs.values() for f in order}))
-        agg_rows = None
-        if all_stack_fields:
-            from .mesh_search import ensure_mesh_agg_stack
+            # ---- ONE per-doc fold stack for metric aggs and bucket sub-aggs ----
+            all_stack_fields = tuple(sorted(
+                set(metric_fields.values())
+                | {f for (_subs, order) in bucket_subs.values() for f in order}))
+            agg_rows = None
+            if all_stack_fields:
+                from .mesh_search import ensure_mesh_agg_stack
 
-            agg_rows = ensure_mesh_agg_stack(executor.index, all_stack_fields)
-            if agg_rows is None:
-                return None  # column not f32-exact → transport/host path
-        fpos = {f: i for i, f in enumerate(all_stack_fields)}
+                agg_rows = ensure_mesh_agg_stack(executor.index, all_stack_fields)
+                if agg_rows is None:
+                    return None  # column not f32-exact → transport/host path
+            fpos = {f: i for i, f in enumerate(all_stack_fields)}
 
-        bucket_pairs, bucket_keys_per = self._bucket_pairs(
-            req, bucket_names, bucket_subs, fpos, searchers, ctxs, S)
-        if bucket_names and bucket_pairs is None:
-            return None
+            bucket_pairs, bucket_keys_per = self._bucket_pairs(
+                req, bucket_names, bucket_subs, fpos, searchers, ctxs, S)
+            if bucket_names and bucket_pairs is None:
+                return None
 
-        active = None
-        selected = sorted(c.shard_id for c in shards)
-        if selected != list(range(S)):
-            active = np.zeros(S, bool)
-            active[selected] = True
+            active = None
+            selected = sorted(c.shard_id for c in shards)
+            if selected != list(range(S)):
+                active = np.zeros(S, bool)
+                active[selected] = True
 
-        out = executor.search(
-            [plan], k, filter_masks=filter_masks, agg_rows=agg_rows,
-            use_metric_aggs=bool(metric_fields), post_masks=post_masks,
-            min_score=(float(req.min_score)
-                       if req.min_score is not None else None),
-            sort_keys=sort_keys,
-            sort_desc=bool(sort_spec.reverse) if sort_spec is not None else False,
-            active=active, bucket_pairs=bucket_pairs or None)
-        self.mesh_queries += 1
+            out = executor.search(
+                [plan], k, filter_masks=filter_masks, agg_rows=agg_rows,
+                use_metric_aggs=bool(metric_fields), post_masks=post_masks,
+                min_score=(float(req.min_score)
+                           if req.min_score is not None else None),
+                sort_keys=sort_keys,
+                sort_desc=bool(sort_spec.reverse) if sort_spec is not None else False,
+                active=active, bucket_pairs=bucket_pairs or None)
+            self.mesh_queries += 1
 
-        track = bool(req.track_scores) if req.sort else True
-        # batch every host read ONCE: the executor already device_get the
-        # whole program output, so these are pure-host .tolist() conversions —
-        # the per-element float()/int() pulls this replaces were a scalar
-        # extraction per hit per shard (the grandfathered TPU001 block)
-        shard_row = out.shard[0].tolist()
-        score_row = out.scores[0].tolist()
-        doc_row = out.doc[0].tolist()
-        totals_col = out.shard_totals[:, 0].tolist()
-        qmax_col = out.qmax[:, 0].tolist()
-        results = []
-        for ordinal, copy in enumerate(shards):
-            sid = copy.shard_id
-            sel = [j for j, sh in enumerate(shard_row) if sh == sid]
-            if req.sort:
-                locals_ = [doc_row[j] for j in sel]
-                sort_vals = self._sort_values(req.sort, ctxs[sid],
-                                              searchers[sid], locals_)
-                rows = [(score_row[j] if track else float("nan"),
-                         doc_row[j], sort_vals[i])
-                        for i, j in enumerate(sel)]
-            else:
-                rows = [(score_row[j], doc_row[j], None) for j in sel]
-            qm = qmax_col[sid]
-            agg_partials = self._shard_agg_partials(
-                req, metric_fields, bucket_names, bucket_subs, fpos,
-                bucket_keys_per, out, sid, searchers[sid])
-            result = ShardQueryResult(
-                total=totals_col[sid],
-                docs=rows,
-                max_score=qm if np.isfinite(qm) else float("nan"),
-                agg_partials=agg_partials,
-                shard_id=ordinal,
-            )
-            # pin the query-time searcher for the fetch phase (a merge between
-            # phases must not move local doc ids under the fetch)
-            pin = getattr(self, "pin_context", None)
-            if pin is not None:
-                result.context_id = pin(copy.index, sid, ctxs[sid])
-            results.append(result)
-        return results
+            track = bool(req.track_scores) if req.sort else True
+            # batch every host read ONCE: the executor already device_get the
+            # whole program output, so these are pure-host .tolist() conversions —
+            # the per-element float()/int() pulls this replaces were a scalar
+            # extraction per hit per shard (the grandfathered TPU001 block)
+            shard_row = out.shard[0].tolist()
+            score_row = out.scores[0].tolist()
+            doc_row = out.doc[0].tolist()
+            totals_col = out.shard_totals[:, 0].tolist()
+            qmax_col = out.qmax[:, 0].tolist()
+            results = []
+            for ordinal, copy in enumerate(shards):
+                sid = copy.shard_id
+                sel = [j for j, sh in enumerate(shard_row) if sh == sid]
+                if req.sort:
+                    locals_ = [doc_row[j] for j in sel]
+                    sort_vals = self._sort_values(req.sort, ctxs[sid],
+                                                  searchers[sid], locals_)
+                    rows = [(score_row[j] if track else float("nan"),
+                             doc_row[j], sort_vals[i])
+                            for i, j in enumerate(sel)]
+                else:
+                    rows = [(score_row[j], doc_row[j], None) for j in sel]
+                qm = qmax_col[sid]
+                agg_partials = self._shard_agg_partials(
+                    req, metric_fields, bucket_names, bucket_subs, fpos,
+                    bucket_keys_per, out, sid, searchers[sid])
+                result = ShardQueryResult(
+                    total=totals_col[sid],
+                    docs=rows,
+                    max_score=qm if np.isfinite(qm) else float("nan"),
+                    agg_partials=agg_partials,
+                    shard_id=ordinal,
+                )
+                # pin the query-time searcher for the fetch phase (a merge between
+                # phases must not move local doc ids under the fetch)
+                pin = getattr(self, "pin_context", None)
+                if pin is not None:
+                    result.context_id = pin(copy.index, sid, ctxs[sid])
+                results.append(result)
+            return results
 
     # ------------------------------------------------------------------
     _POSITIONAL_BUCKETS = None  # class-level lazy import cache

@@ -44,6 +44,9 @@ class RestResponse:
     status: int
     body: object
     content_type: str = "application/json"
+    # extra response headers (e.g. Retry-After on 429) — emitted verbatim by
+    # http/server.py
+    headers: dict = dc_field(default_factory=dict)
 
     def payload(self) -> bytes:
         if isinstance(self.body, (bytes,)):
@@ -90,7 +93,18 @@ class RestController:
                 return result
             return RestResponse(200, result)
         except SearchEngineError as e:
-            return RestResponse(e.status, {"error": e.to_dict(), "status": e.status})
+            headers = {}
+            if e.status == 429:
+                # overload rejections (breaker trip / queue rejection /
+                # admission control) carry a backoff hint: the 429 contract is
+                # "come back later", and Retry-After says when (whole seconds,
+                # rounded up, at least 1 — RFC 7231 delta-seconds)
+                import math
+
+                headers["Retry-After"] = str(max(
+                    1, int(math.ceil(getattr(e, "retry_after_s", 1.0)))))
+            return RestResponse(e.status, {"error": e.to_dict(),
+                                           "status": e.status}, headers=headers)
         except Exception as e:  # noqa: BLE001
             return RestResponse(500, {"error": {"type": type(e).__name__,
                                                 "reason": str(e)}, "status": 500})

@@ -27,6 +27,7 @@ from typing import Any
 
 import numpy as np
 
+from ..common.breaker import reserve
 from ..common.errors import QueryParsingError
 from ..mapper.core import parse_date_math
 from .filters import haversine_m, parse_distance, segment_mask
@@ -386,12 +387,30 @@ def bucket_cols_for(agg: Agg, seg, ctx=None) -> tuple:
     agg on one segment — deduplicated (doc, bucket) pairs, so the scatter counts
     DOCS exactly like the host's bucket masks (a doc with duplicate values
     counts once). Cached on the segment (host arrays; device copies cache on the
-    PackedSegment)."""
+    PackedSegment).
+
+    Bucket materialization is the reference's classic breaker customer (a
+    terms agg over a high-cardinality field): on a cache miss the pair-array
+    build is reserved on the request breaker through `ctx` — transient
+    (estimate during build, release after), host-side only."""
     field = agg.spec.get("field")
     ckey = bucket_cache_key(agg)
     cached = seg._device_cache.get(ckey)
     if cached is not None:
         return cached
+    breaker = ctx.breaker("request") if ctx is not None \
+        and getattr(ctx, "breakers", None) is not None else None
+    col = seg.dv_num.get(field) if field else None
+    n_vals = len(col[1]) if col is not None else 0
+    # per-doc pair slots + per-value intermediates (int64 pair keys, int32
+    # outputs, masks) — a deliberate over-estimate, like the reference's
+    # per-bucket overhead constant
+    with reserve(breaker, (seg.doc_count + n_vals) * 24,
+                 f"<bucket_cols>[{type(agg).__name__}]"):
+        return _bucket_cols_build(agg, seg, ctx, ckey, field)
+
+
+def _bucket_cols_build(agg: Agg, seg, ctx, ckey, field) -> tuple:
     empty = (np.zeros(0, np.int32), np.zeros(0, np.int32), [])
     if isinstance(agg, (FilterAgg, FiltersAgg, MissingAgg)):
         # mask-shaped buckets: host-evaluated per segment via the filter cache

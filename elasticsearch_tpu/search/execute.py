@@ -70,6 +70,7 @@ from .queries import (
     TermQuery,
     WildcardQuery,
 )
+from ..common.breaker import reserve
 from .similarity import (
     BM25Similarity,
     FreqNormSimilarity,
@@ -85,7 +86,8 @@ class ShardContext:
     """Shard-level stats + mapping access shared by planner and scorers."""
 
     def __init__(self, searcher: Searcher, mapper_service, similarity_service=None,
-                 global_stats: dict | None = None, index_name: str | None = None):
+                 global_stats: dict | None = None, index_name: str | None = None,
+                 breakers=None):
         self.searcher = searcher
         self.mapper_service = mapper_service
         self.similarity_service = similarity_service or SimilarityService(
@@ -97,6 +99,14 @@ class ShardContext:
         # which index this shard belongs to (indices query/filter targeting);
         # None = unknown → indices-targeted constructs assume a match
         self.index_name = index_name
+        # the node's CircuitBreakerService (None in unwired contexts — unit
+        # tests, standalone shard work): allocation hot spots reserve through
+        # breaker(name) and every charge site tolerates the None no-op
+        self.breakers = breakers
+
+    def breaker(self, name: str):
+        """The named circuit breaker, or None when no service is wired."""
+        return None if self.breakers is None else self.breakers.breaker(name)
 
     @property
     def max_doc(self) -> int:
@@ -504,7 +514,7 @@ def _execute_flat_plain(plans: list[FlatPlan], ctx: ShardContext, k: int) -> lis
     totals = np.zeros(Q, dtype=np.int64)
     seg_hits = []  # (scores [Q,k] f32, global_docs [Q,k] int64) per segment
     for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
-        packed = packed_for(seg)
+        packed = packed_for(seg, breaker=ctx.breaker("fielddata"))
         ensure_tfn(seg, packed, tfn_tables)
         clause_lists = []
         for (resolved, _f, _c, _coord) in finals:
@@ -527,29 +537,40 @@ def _execute_flat_plain(plans: list[FlatPlan], ctx: ShardContext, k: int) -> lis
         gdocs = np.where(valid, docs.astype(np.int64) + base, np.int64(2**62))
         seg_hits.append((np.where(valid, scores, -np.inf), gdocs))
 
-    return _merge_seg_hits(seg_hits, totals, Q, k)
+    return _merge_seg_hits(seg_hits, totals, Q, k,
+                           breaker=ctx.breaker("request"))
 
 
-def _merge_seg_hits(seg_hits, totals, Q: int, k: int) -> list[TopDocs]:
+def _merge_seg_hits(seg_hits, totals, Q: int, k: int,
+                    breaker=None) -> list[TopDocs]:
     """Cross-segment top-k merge: score desc, global doc asc — the Lucene
-    tie-break order (single site; shared by the plain and function_score paths)."""
+    tie-break order (single site; shared by the plain and function_score paths).
+
+    The host-side merge buffers (concatenated score/doc canvases plus the
+    per-query negated-score copy for lexsort) are reserved on the request
+    breaker BEFORE np.concatenate allocates them — a wide batch over many
+    segments is exactly the allocation the reference's request breaker guards."""
     if not seg_hits:
         return [TopDocs(total=0, hits=[], max_score=float("nan")) for _ in range(Q)]
-    all_scores = np.concatenate([s for (s, _d) in seg_hits], axis=1)
-    all_docs = np.concatenate([d for (_s, d) in seg_hits], axis=1)
-    out = []
-    totals_h = totals.tolist()
-    for qi in range(Q):
-        order = np.lexsort((all_docs[qi], -all_scores[qi]))[:k]
-        order = order[np.isfinite(all_scores[qi, order])]
-        # one batched pull per query, not 2k scalar conversions (tpulint TPU001)
-        hits = list(zip(all_scores[qi, order].tolist(),
-                        all_docs[qi, order].tolist()))
-        out.append(TopDocs(
-            total=totals_h[qi],
-            hits=hits,
-            max_score=hits[0][0] if hits else float("nan"),
-        ))
+    width = sum(s.shape[1] for (s, _d) in seg_hits)
+    # f32 scores + i64 docs concatenated, + one negated f32 row per lexsort
+    est = Q * width * (4 + 8) + width * 4
+    with reserve(breaker, est, "<merge_seg_hits>"):
+        all_scores = np.concatenate([s for (s, _d) in seg_hits], axis=1)
+        all_docs = np.concatenate([d for (_s, d) in seg_hits], axis=1)
+        out = []
+        totals_h = totals.tolist()
+        for qi in range(Q):
+            order = np.lexsort((all_docs[qi], -all_scores[qi]))[:k]
+            order = order[np.isfinite(all_scores[qi, order])]
+            # one batched pull per query, not 2k scalar conversions (tpulint TPU001)
+            hits = list(zip(all_scores[qi, order].tolist(),
+                            all_docs[qi, order].tolist()))
+            out.append(TopDocs(
+                total=totals_h[qi],
+                hits=hits,
+                max_score=hits[0][0] if hits else float("nan"),
+            ))
     return out
 
 
@@ -644,7 +665,7 @@ def _execute_flat_fs(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list[T
     seg_hits = []
     try:
         for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
-            packed = packed_for(seg)
+            packed = packed_for(seg, breaker=ctx.breaker("fielddata"))
             _ensure_norm_rows(packed, all_fields)
             entries = _dense_entries(finals, seg, packed, field_idx)
             batch = build_term_batch(entries, Q, n_must, msm, coord_tbl,
@@ -699,7 +720,8 @@ def _execute_flat_fs(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list[T
         host_idx = set(range(Q))
         seg_hits = []
 
-    merged = _merge_seg_hits(seg_hits, totals, Q, k)
+    merged = _merge_seg_hits(seg_hits, totals, Q, k,
+                             breaker=ctx.breaker("request"))
     return [
         _host_search(ctx, plans[qi].fs, k) if (qi in host_idx or not seg_hits)
         else merged[qi]
@@ -731,7 +753,7 @@ def _execute_flat_filtered(plans: list[FlatPlan], ctx: ShardContext,
     totals = np.zeros(Q, dtype=np.int64)
     seg_hits = []
     for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
-        packed = packed_for(seg)
+        packed = packed_for(seg, breaker=ctx.breaker("fielddata"))
         _ensure_norm_rows(packed, all_fields)
         fmask = np.zeros((Q, packed.doc_pad), dtype=bool)
         for qi, plan in enumerate(plans):
@@ -745,7 +767,8 @@ def _execute_flat_filtered(plans: list[FlatPlan], ctx: ShardContext,
         valid = (docs < min(packed.doc_pad, seg.doc_count)) & np.isfinite(scores)
         gdocs = np.where(valid, docs.astype(np.int64) + base, np.int64(2**62))
         seg_hits.append((np.where(valid, scores, -np.inf), gdocs))
-    return _merge_seg_hits(seg_hits, totals, Q, k)
+    return _merge_seg_hits(seg_hits, totals, Q, k,
+                           breaker=ctx.breaker("request"))
 
 
 def execute_flat_sorted(plan: FlatPlan, ctx: ShardContext, k: int, spec):
@@ -766,7 +789,8 @@ def execute_flat_sorted(plan: FlatPlan, ctx: ShardContext, k: int, spec):
      coord_tbl, n_must, msm) = _assemble_batch([plan], finals)
     # validate EVERY segment's eligibility before the first launch — a
     # late-segment refusal must not waste completed kernel work
-    packeds = [packed_for(seg) for seg in ctx.searcher.segments]
+    packeds = [packed_for(seg, breaker=ctx.breaker("fielddata"))
+               for seg in ctx.searcher.segments]
     key_rows = [device_sort_key_row(spec, seg, p.doc_pad)
                 for seg, p in zip(ctx.searcher.segments, packeds)]
     if any(r is None for r in key_rows):
@@ -829,9 +853,10 @@ def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
     seg_hits = []
     seg_stats = []
     for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
-        packed = packed_for(seg)
+        packed = packed_for(seg, breaker=ctx.breaker("fielddata"))
         _ensure_norm_rows(packed, all_fields)
-        stack = ensure_agg_rows(seg, packed, fields)
+        stack = ensure_agg_rows(seg, packed, fields,
+                                breaker=ctx.breaker("fielddata"))
         if stack is None:
             return None, None  # column not f32-exact → host collectors
         pair_args = []
@@ -852,7 +877,8 @@ def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
                      jax.device_put(np.zeros(len(keys), np.int32))))
             sub_stack = None
             if sub_order:
-                sub_stack = ensure_agg_rows(seg, packed, sub_order)
+                sub_stack = ensure_agg_rows(seg, packed, sub_order,
+                                            breaker=ctx.breaker("fielddata"))
                 if sub_stack is None:
                     return None, None  # sub column not f32-exact → host
             pair_args.append((dev[0], dev[1], dev[2], sub_stack))
@@ -879,7 +905,8 @@ def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
              None if ss is None else ss[0])
             for keys, (bc, sc, ss) in zip(seg_keys, bcounts)
         ]))
-    return _merge_seg_hits(seg_hits, totals, 1, k)[0], seg_stats
+    return _merge_seg_hits(seg_hits, totals, 1, k,
+                           breaker=ctx.breaker("request"))[0], seg_stats
 
 
 # ---------------------------------------------------------------------------

@@ -16,9 +16,12 @@ from dataclasses import dataclass, field as dc_field
 
 import numpy as np
 
+from ..common.breaker import reserve as breaker_reserve
 from ..common.deadline import NO_DEADLINE, Deadline, parse_timevalue
 from ..common.errors import (
+    CircuitBreakingError,
     QueryParsingError,
+    RejectedExecutionError,
     SearchContextMissingError,
     SearchEngineError,
 )
@@ -181,6 +184,10 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
         if plan is not None:
             try:
                 td = execute_flat_batch([plan], ctx, max(k, 1))[0]
+            except CircuitBreakingError as e:
+                if getattr(e, "breaker", None) != "fielddata":
+                    raise  # request/parent trip: load-shed (429), not degradable
+                _device_failed(e)  # out of device-pack budget → host serves
             except SearchEngineError:
                 raise  # domain errors (scripts, parsing) are the answer itself
             except Exception as e:  # noqa: BLE001 — device trouble must not
@@ -208,6 +215,11 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
             and req.min_score is None and not req.explain):
         try:
             device = _try_device_aggs(ctx, req, k, suggest_out, shard_id)
+        except CircuitBreakingError as e:
+            if getattr(e, "breaker", None) != "fielddata":
+                raise  # request/parent trip: load-shed (429), not degradable
+            _device_failed(e)  # out of device-pack budget → host collectors
+            device = None
         except SearchEngineError:
             raise  # domain errors (scripts, parsing) are the answer itself
         except Exception as e:  # noqa: BLE001
@@ -229,6 +241,10 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
         if plan is not None:
             try:
                 td = execute_flat_batch([plan], ctx, max(k, 1))[0]
+            except CircuitBreakingError as e:
+                if getattr(e, "breaker", None) != "fielddata":
+                    raise  # request/parent trip: load-shed (429), not degradable
+                _device_failed(e)  # out of device-pack budget → host serves
             except SearchEngineError:
                 raise  # domain errors are the answer itself
             except Exception as e:  # noqa: BLE001
@@ -250,6 +266,11 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
             and not req.explain):
         try:
             device = _try_device_post_filter(ctx, req, k, suggest_out, shard_id)
+        except CircuitBreakingError as e:
+            if getattr(e, "breaker", None) != "fielddata":
+                raise  # request/parent trip: load-shed (429), not degradable
+            _device_failed(e)  # out of device-pack budget → host serves
+            device = None
         except SearchEngineError:
             raise  # domain errors (scripts, parsing) are the answer itself
         except Exception as e:  # noqa: BLE001
@@ -267,6 +288,11 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
             and req.min_score is None and not req.explain):
         try:
             device = _try_device_sort(ctx, req, k, suggest_out, shard_id)
+        except CircuitBreakingError as e:
+            if getattr(e, "breaker", None) != "fielddata":
+                raise  # request/parent trip: load-shed (429), not degradable
+            _device_failed(e)  # out of device-pack budget → host serves
+            device = None
         except SearchEngineError:
             raise  # domain errors (scripts, parsing) are the answer itself
         except Exception as e:  # noqa: BLE001
@@ -276,92 +302,100 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
             _count("device_sort")
             return device
 
-    # general path: dense per-segment masks drive sort/aggs/rescore. Masks are
-    # consumed lazily so the deadline clamps BETWEEN segments: expiry keeps the
-    # segments already scored as an honest partial (timed_out below)
-    _count("host")
-    timed_out = False
-    seg_results = []
-    masks_iter = iter_match_masks(ctx, req.query)
-    seg_masks_for_aggs = []
-    all_entries = []  # (sortkeys..., score, global_doc, seg_idx, local)
-    total = 0
-    max_score = float("nan")
-    for si, (seg, base) in enumerate(
-        zip(ctx.searcher.segments, ctx.searcher.bases)
-    ):
-        if si > 0 and deadline.expired():
-            timed_out = True
-            break
-        scores, match = next(masks_iter)
-        seg_results.append((scores, match))
-        if req.min_score is not None:
-            match = match & (scores >= np.float32(req.min_score))
-        seg_masks_for_aggs.append((seg, match, scores))
-        hit_mask = match
-        if req.post_filter is not None:
-            hit_mask = match & segment_mask(seg, req.post_filter, ctx)
-        idx = np.nonzero(hit_mask)[0]
-        total += len(idx)
-        if not len(idx):
-            continue
-        seg_scores = scores[idx]
-        if len(seg_scores):
-            m = float(seg_scores.max())
-            max_score = m if max_score != max_score else max(max_score, m)
+    # general path: the whole host materialization (per-segment score/match
+    # arrays, agg/facet bucket state, the sort-entry list) is reserved on the
+    # request breaker UP FRONT — this is the node's "wide aggregation"
+    # overload face; the reservation holds until the partials are built and
+    # releases on exit (estimate-before-allocate; all host-side, never traced)
+    _mask_est = ctx.searcher.max_doc * (
+        5 + 16 * (len(req.aggs) + len(req.facets)))
+    with breaker_reserve(ctx.breaker("request"), _mask_est, "<query_phase_host>"):
+        # general path: dense per-segment masks drive sort/aggs/rescore. Masks are
+        # consumed lazily so the deadline clamps BETWEEN segments: expiry keeps the
+        # segments already scored as an honest partial (timed_out below)
+        _count("host")
+        timed_out = False
+        seg_results = []
+        masks_iter = iter_match_masks(ctx, req.query)
+        seg_masks_for_aggs = []
+        all_entries = []  # (sortkeys..., score, global_doc, seg_idx, local)
+        total = 0
+        max_score = float("nan")
+        for si, (seg, base) in enumerate(
+            zip(ctx.searcher.segments, ctx.searcher.bases)
+        ):
+            if si > 0 and deadline.expired():
+                timed_out = True
+                break
+            scores, match = next(masks_iter)
+            seg_results.append((scores, match))
+            if req.min_score is not None:
+                match = match & (scores >= np.float32(req.min_score))
+            seg_masks_for_aggs.append((seg, match, scores))
+            hit_mask = match
+            if req.post_filter is not None:
+                hit_mask = match & segment_mask(seg, req.post_filter, ctx)
+            idx = np.nonzero(hit_mask)[0]
+            total += len(idx)
+            if not len(idx):
+                continue
+            seg_scores = scores[idx]
+            if len(seg_scores):
+                m = float(seg_scores.max())
+                max_score = m if max_score != max_score else max(max_score, m)
+            if req.sort:
+                keycols = []
+                for spec in req.sort:
+                    col = apply_missing(sort_key_column(spec, seg, ctx, scores), spec)
+                    keycols.append(col[idx] * (-1.0 if spec.reverse else 1.0))
+                for j, local in enumerate(idx):
+                    all_entries.append(
+                        (tuple(kc[j] for kc in keycols), float(seg_scores[j]),
+                         base + int(local), si, int(local))
+                    )
+            else:
+                for j, local in enumerate(idx):
+                    all_entries.append(
+                        ((-float(seg_scores[j]),), float(seg_scores[j]),
+                         base + int(local), si, int(local))
+                    )
+        all_entries.sort(key=lambda e: (e[0], e[2]))
+        top = all_entries[: max(k, 0)]
+
+        # rescore: re-rank the top window with the rescore queries
+        if req.rescore and top:
+            top = _apply_rescore(ctx, req, top)
+
+        docs = []
+        # per-segment grouped sort-value extraction for response "sort" arrays
         if req.sort:
-            keycols = []
-            for spec in req.sort:
-                col = apply_missing(sort_key_column(spec, seg, ctx, scores), spec)
-                keycols.append(col[idx] * (-1.0 if spec.reverse else 1.0))
-            for j, local in enumerate(idx):
-                all_entries.append(
-                    (tuple(kc[j] for kc in keycols), float(seg_scores[j]),
-                     base + int(local), si, int(local))
-                )
+            sort_vals_by_rank = _sort_values_by_rank(
+                req.sort, ctx, [(si, local) for (_, _s, _g, si, local) in top],
+                scores_by_seg={si: r[0] for si, r in enumerate(seg_results)})
+            for rank, (_, s, g, si, local) in enumerate(top):
+                score = s if req.track_scores or _score_in_sort(req.sort) else float("nan")
+                docs.append((score, g, sort_vals_by_rank[rank]))
         else:
-            for j, local in enumerate(idx):
-                all_entries.append(
-                    ((-float(seg_scores[j]),), float(seg_scores[j]),
-                     base + int(local), si, int(local))
-                )
-    all_entries.sort(key=lambda e: (e[0], e[2]))
-    top = all_entries[: max(k, 0)]
+            docs = [(s, g, None) for (_, s, g, _si, _l) in top]
 
-    # rescore: re-rank the top window with the rescore queries
-    if req.rescore and top:
-        top = _apply_rescore(ctx, req, top)
-
-    docs = []
-    # per-segment grouped sort-value extraction for response "sort" arrays
-    if req.sort:
-        sort_vals_by_rank = _sort_values_by_rank(
-            req.sort, ctx, [(si, local) for (_, _s, _g, si, local) in top],
-            scores_by_seg={si: r[0] for si, r in enumerate(seg_results)})
-        for rank, (_, s, g, si, local) in enumerate(top):
-            score = s if req.track_scores or _score_in_sort(req.sort) else float("nan")
-            docs.append((score, g, sort_vals_by_rank[rank]))
-    else:
-        docs = [(s, g, None) for (_, s, g, _si, _l) in top]
-
-    agg_partials = []
-    facet_partials = []
-    if req.aggs:
-        agg_partials = [
-            {n: a.collect(seg, ctx, mask, scores) for n, a in req.aggs.items()}
-            for seg, mask, scores in seg_masks_for_aggs
-        ]
-    if req.facets:
-        facet_partials = [
-            {n: agg.collect(seg, ctx, mask, scores)
-             for n, (agg, _kind) in req.facets.items()}
-            for seg, mask, scores in seg_masks_for_aggs
-        ]
-    return ShardQueryResult(
-        total=total, docs=docs, max_score=max_score, agg_partials=agg_partials,
-        facet_partials=facet_partials, suggest=suggest_out, shard_id=shard_id,
-        timed_out=timed_out,
-    )
+        agg_partials = []
+        facet_partials = []
+        if req.aggs:
+            agg_partials = [
+                {n: a.collect(seg, ctx, mask, scores) for n, a in req.aggs.items()}
+                for seg, mask, scores in seg_masks_for_aggs
+            ]
+        if req.facets:
+            facet_partials = [
+                {n: agg.collect(seg, ctx, mask, scores)
+                 for n, (agg, _kind) in req.facets.items()}
+                for seg, mask, scores in seg_masks_for_aggs
+            ]
+        return ShardQueryResult(
+            total=total, docs=docs, max_score=max_score, agg_partials=agg_partials,
+            facet_partials=facet_partials, suggest=suggest_out, shard_id=shard_id,
+            timed_out=timed_out,
+        )
 
 
 def _try_device_aggs(ctx: ShardContext, req: ParsedSearchRequest, k: int,
@@ -700,3 +734,73 @@ class SearchService:
 
     def active_contexts(self) -> int:
         return len(self._contexts)
+
+
+# ---------------------------------------------------------------------------
+# deadline-aware admission control (coordinator side)
+# ---------------------------------------------------------------------------
+
+
+class SearchAdmissionController:
+    """Reject unservable searches BEFORE the fan-out.
+
+    A request whose remaining Deadline budget is smaller than the node's
+    recent shard-phase latency cannot finish in time — executing it anyway
+    burns a search worker, transport slots, and breaker headroom to produce
+    an answer the client has already given up on. The coordinator tracks
+    observed shard-phase latency in a MeanMetric (common/metrics.py) and
+    turns those requests into an immediate 429 with a Retry-After hint.
+
+    Unbounded requests (no `timeout`) are always admitted, and nothing is
+    rejected before `min_samples` observations — a cold node (whose first
+    searches include multi-second XLA compiles) must not poison admission
+    for everyone.
+
+    The admit() signal is an EWMA over the MeanMetric's samples, not the
+    lifetime mean: one slow failover chain must stop poisoning admission
+    within ~1/alpha further observations, while a lifetime mean would shed
+    servable load for hundreds of requests after a single 5s outlier.
+    """
+
+    EWMA_ALPHA = 0.2  # ~5-sample memory
+
+    def __init__(self, min_samples: int = 10):
+        from ..common.metrics import CounterMetric, MeanMetric
+
+        self.min_samples = min_samples
+        self.latency = MeanMetric()  # lifetime rollup (stats/observability)
+        self.rejected = CounterMetric()
+        self._ewma = 0.0  # the decaying signal admit() compares against
+        self._ewma_lock = threading.Lock()
+
+    def observe(self, seconds: float):
+        s = max(0.0, float(seconds))
+        self.latency.inc(s)
+        with self._ewma_lock:
+            self._ewma = s if self.latency.count <= 1 else \
+                self.EWMA_ALPHA * s + (1.0 - self.EWMA_ALPHA) * self._ewma
+
+    def admit(self, deadline: Deadline):
+        """Raise RejectedExecutionError (429) when the remaining budget cannot
+        cover one expected shard phase; no-op while unbounded or cold."""
+        remaining = deadline.remaining()
+        if remaining is None or self.latency.count < self.min_samples:
+            return
+        expected = self._ewma
+        if remaining < expected:
+            self.rejected.inc()
+            err = RejectedExecutionError(
+                f"rejected before fan-out: remaining budget "
+                f"[{remaining * 1000:.0f}ms] < expected shard phase "
+                f"[{expected * 1000:.0f}ms]")
+            # hint when the request WOULD be servable: one expected phase
+            err.retry_after_s = max(expected, 0.001)
+            raise err
+
+    def stats(self) -> dict:
+        return {
+            "observed": self.latency.count,
+            "mean_shard_phase_ms": round(self.latency.mean * 1000.0, 3),
+            "ewma_shard_phase_ms": round(self._ewma * 1000.0, 3),
+            "rejected": self.rejected.count,
+        }

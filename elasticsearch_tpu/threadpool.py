@@ -1,11 +1,16 @@
-"""Named thread pools + scheduler.
+"""Named thread pools + scheduler, with BOUNDED queues.
 
-Analogue of threadpool/ThreadPool.java: named executors (search/index/bulk/get/management/
-generic/...) with individual sizes, a shared scheduler for periodic jobs (refresh, translog
-flush, fault-detection pings), per-pool stats, and dynamic resize.
+Analogue of threadpool/ThreadPool.java + EsThreadPoolExecutor: named executors
+(search/index/bulk/get/management/generic/...) with individual sizes AND
+individual queue bounds. A pool whose queue is full REJECTS the task with
+RejectedExecutionError (HTTP 429, transient for the write-path retry policy)
+instead of queueing it forever — unbounded queues convert overload into
+latency and eventually OOM; bounded queues convert it into fast, retryable
+backpressure (PAPER.md layer 1/9's EsRejectedExecutionException).
 
-TPU note: device compute itself is dispatched asynchronously by JAX's runtime; these pools
-serve the HOST side — request fan-out, IO, recovery streaming, periodic maintenance.
+TPU note: device compute itself is dispatched asynchronously by JAX's runtime;
+these pools serve the HOST side — request fan-out, IO, recovery streaming,
+periodic maintenance.
 """
 
 from __future__ import annotations
@@ -14,6 +19,7 @@ import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
 
+from .common.errors import RejectedExecutionError
 from .common.logging import get_logger
 
 logger = get_logger("threadpool")
@@ -59,6 +65,20 @@ _DEFAULT_SIZES = {
     "optimize": 1,
 }
 
+# Queue bounds (`threadpool.<name>.queue_size`; -1 = unbounded). The dispatch
+# trampoline ("generic") and cluster-management pool stay unbounded — rejecting
+# the dispatcher would drop requests before any typed error could travel back.
+_DEFAULT_QUEUES = {
+    "generic": -1,
+    "management": -1,
+    "index": 200,
+    "bulk": 200,
+    "replica": 200,
+    "search": 1000,
+    "get": 1000,
+}
+_DEFAULT_QUEUE_SIZE = 1000
+
 
 class _ScheduledTask:
     def __init__(self, interval: float, fn, pool_submit, fixed_delay: bool = True):
@@ -71,32 +91,101 @@ class _ScheduledTask:
         self.cancelled.set()
 
 
+class _BoundedPool:
+    """ThreadPoolExecutor wrapper tracking queued/active/rejected/completed and
+    enforcing the queue bound. `queued` counts tasks submitted but not yet
+    picked up by a worker; rejection triggers when the queued backlog exceeds
+    the bound plus currently-idle workers (an idle worker consumes a submit
+    near-immediately, so it is headroom, not queue)."""
+
+    def __init__(self, name: str, size: int, queue_size: int):
+        self.name = name
+        self.size = size
+        self.queue_size = queue_size
+        self.executor = ThreadPoolExecutor(max_workers=size,
+                                           thread_name_prefix=f"estpu[{name}]")
+        self._lock = threading.Lock()
+        self.queued = 0
+        self.active = 0
+        self.rejected = 0
+        self.completed = 0
+
+    def submit(self, fn, *args, **kwargs) -> Future:
+        with self._lock:
+            if self.queue_size >= 0:
+                idle = max(0, self.size - self.active)
+                if self.queued - idle >= self.queue_size:
+                    self.rejected += 1
+                    raise RejectedExecutionError(
+                        f"rejected execution on [{self.name}]: queue capacity "
+                        f"[{self.queue_size}] full "
+                        f"(queued [{self.queued}], active [{self.active}])")
+            self.queued += 1
+        try:
+            return self.executor.submit(self._run, fn, args, kwargs)
+        except RuntimeError:
+            # executor shut down — still a rejection, just a terminal one
+            with self._lock:
+                self.queued -= 1
+                self.rejected += 1
+            raise RejectedExecutionError(
+                f"rejected execution on [{self.name}]: pool is shut down") \
+                from None
+
+    def _run(self, fn, args, kwargs):
+        with self._lock:
+            self.queued -= 1
+            self.active += 1
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            with self._lock:
+                self.active -= 1
+                self.completed += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "threads": self.size,
+                "queue": self.queued,
+                "queue_size": self.queue_size,
+                "active": self.active,
+                "rejected": self.rejected,
+                "completed": self.completed,
+            }
+
+
 class ThreadPool:
     def __init__(self, settings=None):
         from .common.settings import Settings
 
         settings = settings or Settings.EMPTY
-        self._pools: dict[str, ThreadPoolExecutor] = {}
-        self._sizes: dict[str, int] = {}
-        self._stats = {name: {"completed": 0, "rejected": 0} for name in Names}
+        self._pools: dict[str, _BoundedPool] = {}
         for name in Names:
             if name == "same":
                 continue
             size = settings.get_int(f"threadpool.{name}.size", _DEFAULT_SIZES.get(name, 2))
-            self._sizes[name] = size
-            self._pools[name] = ThreadPoolExecutor(max_workers=size, thread_name_prefix=f"estpu[{name}]")
+            queue_size = settings.get_int(
+                f"threadpool.{name}.queue_size",
+                _DEFAULT_QUEUES.get(name, _DEFAULT_QUEUE_SIZE))
+            self._pools[name] = _BoundedPool(name, size, queue_size)
         self._scheduler_tasks: list[_ScheduledTask] = []
+        # one-shot schedule() timers, tracked so shutdown can cancel them —
+        # a timer surviving the node fires its callback into dead services
+        self._timers: set[threading.Timer] = set()
+        self._timers_lock = threading.Lock()
         self._scheduler_thread = threading.Thread(target=self._scheduler_loop, daemon=True, name="estpu[scheduler]")
         self._shutdown = threading.Event()
         self._scheduler_thread.start()
 
     # execution --------------------------------------------------------------
     def executor(self, name: str) -> ThreadPoolExecutor:
-        return self._pools[name if name != "same" else "generic"]
+        return self._pools[name if name != "same" else "generic"].executor
 
     def submit(self, name: str, fn, *args, **kwargs) -> Future:
         """Run fn on the named pool. "same" runs inline (caller thread), like the
-        reference's ThreadPool.Names.SAME."""
+        reference's ThreadPool.Names.SAME. Raises RejectedExecutionError when
+        the pool's bounded queue is full or the pool is shut down."""
         if name == "same":
             f: Future = Future()
             try:
@@ -104,14 +193,37 @@ class ThreadPool:
             except BaseException as e:  # noqa: BLE001 - mirror executor behavior
                 f.set_exception(e)
             return f
-        self._stats[name]["completed"] += 1
         return self._pools[name].submit(fn, *args, **kwargs)
 
     # scheduling -------------------------------------------------------------
     def schedule(self, delay_s: float, name: str, fn) -> threading.Timer:
-        t = threading.Timer(delay_s, lambda: self.submit(name, fn))
+        def fire():
+            with self._timers_lock:
+                self._timers.discard(t)
+            if self._shutdown.is_set():
+                return
+            try:
+                self.submit(name, fn)
+            except RejectedExecutionError:
+                pass  # timer work is droppable when the node is saturated/closed
+
+        t = threading.Timer(delay_s, fire)
         t.daemon = True
-        t.start()
+        with self._timers_lock:
+            if self._shutdown.is_set():
+                t.cancel()
+                return t
+            # prune finished/cancelled timers so heavy schedule() users
+            # (per-attempt query timers) don't grow the set unboundedly.
+            # NOT bare is_alive(): a concurrently-added timer between its
+            # Timer() and start() reads not-alive and would be pruned
+            # untracked — `finished` is only set by cancel() or completion,
+            # so not-started timers survive the prune (start() is under the
+            # same lock anyway, closing the window entirely)
+            self._timers = {x for x in self._timers
+                            if x.is_alive() or not x.finished.is_set()}
+            self._timers.add(t)
+            t.start()
         return t
 
     def schedule_with_fixed_delay(self, interval_s: float, fn, name: str = "generic") -> _ScheduledTask:
@@ -131,20 +243,26 @@ class ThreadPool:
                     task._next = now + task.interval  # type: ignore[attr-defined]
                     try:
                         task._submit(task.fn)
-                    except RuntimeError:
-                        return  # pool shut down
+                    except (RuntimeError, RejectedExecutionError):
+                        if self._shutdown.is_set():
+                            return  # pool shut down
+                        # saturated pool: skip this tick, keep the schedule
 
     # lifecycle --------------------------------------------------------------
     def shutdown(self):
         self._shutdown.set()
         for task in self._scheduler_tasks:
             task.cancel()
+        # cancel outstanding one-shot timers BEFORE closing the pools: a timer
+        # firing after shutdown would submit into a dead executor (harmless)
+        # or, worse, run a callback against torn-down services
+        with self._timers_lock:
+            timers, self._timers = list(self._timers), set()
+        for t in timers:
+            t.cancel()
+        self._scheduler_thread.join(timeout=1.0)
         for pool in self._pools.values():
-            pool.shutdown(wait=False, cancel_futures=True)
+            pool.executor.shutdown(wait=False, cancel_futures=True)
 
     def stats(self) -> dict:
-        return {
-            name: {"threads": self._sizes.get(name, 0), **self._stats[name]}
-            for name in Names
-            if name != "same"
-        }
+        return {name: pool.stats() for name, pool in self._pools.items()}

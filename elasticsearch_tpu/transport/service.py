@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from concurrent.futures import Future, InvalidStateError
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Any, Callable
@@ -79,11 +80,15 @@ class TransportChannel:
             self._respond(None, error)
 
 
-def _roundtrip(payload: Any) -> Any:
-    """Serialize + deserialize through the wire codec (asserts wire-compatibility)."""
+def _encode(payload: Any) -> bytes:
     out = StreamOutput()
     out.write_value(payload)
-    return StreamInput(out.bytes()).read_value()
+    return out.bytes()
+
+
+def _roundtrip(payload: Any) -> Any:
+    """Serialize + deserialize through the wire codec (asserts wire-compatibility)."""
+    return StreamInput(_encode(payload)).read_value()
 
 
 class TransportService:
@@ -99,6 +104,16 @@ class TransportService:
         # MockTransportService-style fault injection (transport/faults.py):
         # installed on live nodes by chaos tests, None in production
         self.fault_policy = None
+        # in-flight-requests circuit breaker (the node wires its
+        # CircuitBreakerService child here): every outbound message's encoded
+        # size is reserved until the response future resolves, so a flood of
+        # huge requests trips 429 instead of buffering the node to death
+        self.in_flight_breaker = None
+        # outstanding reservations (future -> expiry): blocking callers pass
+        # no future-level timeout, so a response that never comes would pin
+        # its bytes forever — the backstop sweep below fails such futures
+        self._inflight: dict = {}
+        self._inflight_lock = threading.Lock()
         backend.bind(self)
 
     # --- registry -----------------------------------------------------------
@@ -141,12 +156,56 @@ class TransportService:
             complete_fut(fut, error=TransportError(str(e), cause=e))
         return fut
 
+    INFLIGHT_BACKSTOP_S = 300.0
+
+    def _charge_in_flight(self, raw: bytes, action: str, fut: Future):
+        """Reserve the message's encoded size on the in-flight breaker; the
+        reservation rides the response future and releases exactly once when
+        it resolves. Raises CircuitBreakingError — callers convert it into a
+        failed future.
+
+        Blocking callers (submit_request / fut_result) never resolve the
+        future on THEIR timeout, so a hung handler or a dropped message with
+        no armed timer would pin its bytes forever. Each charge therefore
+        lazily sweeps reservations older than INFLIGHT_BACKSTOP_S, failing
+        those futures (ReceiveTimeoutError) — which triggers their release
+        callback exactly once. No timer thread per request; the sweep rides
+        the next send."""
+        br = self.in_flight_breaker
+        if br is None:
+            return
+        # sweep BEFORE charging: with the breaker wedged full of expired
+        # reservations, a charge-first order would trip and return without
+        # ever reaching the sweep — permanently 429ing every send
+        now = time.monotonic()
+        with self._inflight_lock:
+            expired = [f for f, expiry in self._inflight.items()
+                       if expiry <= now]
+        for f in expired:
+            # failing the future runs its done-callback → release + untrack
+            complete_fut(f, error=ReceiveTimeoutError(
+                "in-flight reservation expired with no response "
+                f"(> {self.INFLIGHT_BACKSTOP_S:.0f}s)"))
+        size = len(raw)
+        br.add_estimate_and_maybe_break(size, f"<transport_request>[{action}]")
+        with self._inflight_lock:
+            self._inflight[fut] = now + self.INFLIGHT_BACKSTOP_S
+
+        def on_done(_f):
+            br.release(size)
+            with self._inflight_lock:
+                self._inflight.pop(fut, None)
+
+        fut.add_done_callback(on_done)
+
     def _send_now(self, node, action: str, request: dict, fut: Future):
         # Self-addressed requests short-circuit past the backend (the reference
         # TransportService does the same for localNode): still codec-roundtripped
         # for wire-compat assertions, but no socket / simulated-network hop.
         if self._is_local(node):
-            payload = _roundtrip(request)
+            raw = _encode(request)
+            self._charge_in_flight(raw, action, fut)
+            payload = StreamInput(raw).read_value()
 
             def respond(response, error):
                 if error is not None:
@@ -161,10 +220,17 @@ class TransportService:
             else:
                 self.dispatch(action, payload, channel)
             return
-        # Backends that truly serialize (TCP) skip the assert-roundtrip — the
-        # payload already crosses the real codec exactly once on the wire.
-        payload = request if getattr(self.backend, "serializes", False) \
-            else _roundtrip(request)
+        # Backends that truly serialize (TCP) skip the assert-roundtrip AND
+        # this layer's breaker charge — double-encoding just for a size would
+        # defeat the point, so their wire framing charges the in-flight
+        # breaker from the actual frame bytes (tcp.py send); the in-process
+        # path charges here from the bytes it encodes anyway.
+        if getattr(self.backend, "serializes", False):
+            payload = request
+        else:
+            raw = _encode(request)
+            self._charge_in_flight(raw, action, fut)
+            payload = StreamInput(raw).read_value()
         self.backend.send(node, action, payload, fut)
 
     def _apply_send_fault(self, rule, fut: Future, node, action: str,
@@ -246,8 +312,14 @@ class TransportService:
 
         if handler.executor == "same" or self.threadpool is None:
             run()
-        else:
+            return
+        try:
             self.threadpool.submit(handler.executor, run)
+        except SearchEngineError as e:
+            # bounded-queue rejection (RejectedExecutionError): the typed 429
+            # travels back to the sender instead of the request silently
+            # vanishing into a saturated pool (which would read as a timeout)
+            channel.send_failure(e)
 
     def close(self):
         self.backend.close()

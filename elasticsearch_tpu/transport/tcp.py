@@ -310,6 +310,15 @@ class TcpTransport:
         fut.add_done_callback(lambda _f, rid=req_id: self._reap_pending(rid))
         frame = _encode({"id": req_id, "action": action, "body": request},
                         0, self.compress)
+        # in-flight-requests breaker: this backend owns the real encoded frame,
+        # so it charges the actual wire bytes through the ONE charge site
+        # (TransportService._charge_in_flight — which also owns the
+        # release-on-resolution and reservation-backstop protocol)
+        try:
+            self.service._charge_in_flight(frame, action, fut)
+        except SearchEngineError as e:
+            complete_fut(fut, error=e)
+            return
         try:
             conn.write_frame(frame)
         except OSError as e:

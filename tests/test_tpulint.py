@@ -27,7 +27,7 @@ from tools.tpulint.engine import diff_baseline, parse_file  # noqa: E402
 
 FIXDIR = os.path.join(REPO, "tests", "tpulint_fixtures")
 RULES = ["TPU001", "TPU002", "TPU003", "TPU004", "TPU005",
-         "TPU006", "TPU007", "TPU008", "TPU009"]
+         "TPU006", "TPU007", "TPU008", "TPU009", "TPU010"]
 
 
 def _marked_lines(path: str) -> set:

@@ -1,0 +1,29 @@
+"""tpulint fixture — FALSE positives for TPU010: host-side breaker accounting
+around a launch, and non-breaker .release() calls inside traced code, must all
+stay silent."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+_lock = threading.Lock()
+
+
+def host_charge_then_launch(x, breaker):
+    # the sanctioned pattern: estimate BEFORE the launch, release in finally —
+    # all outside the traced region
+    breaker.add_estimate_and_maybe_break(4096, "launch")
+    try:
+        return _compiled(x)
+    finally:
+        breaker.release(4096)
+
+
+def _traced_body(x):
+    # a lock's release inside traced code is not breaker accounting
+    _lock.release()
+    return jnp.sum(x)
+
+
+_compiled = jax.jit(_traced_body)

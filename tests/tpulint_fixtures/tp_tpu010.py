@@ -1,0 +1,26 @@
+"""tpulint fixture — TRUE positives for TPU010 (breaker accounting in traced code)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def traced_kernel(x, breaker):
+    breaker.add_estimate_and_maybe_break(1024, "kernel")  # TP: estimate during trace
+    y = jnp.sum(x * 2.0)
+    breaker.release(1024)  # TP: release during trace
+    return y
+
+
+kernel = jax.jit(traced_kernel)
+
+
+def _charge_helper(x, request_breaker):
+    request_breaker.add_without_breaking(16)  # TP: reached through the traced call graph
+    return x * 2
+
+
+def traced_root(x, request_breaker):
+    return _charge_helper(x, request_breaker)
+
+
+root = jax.jit(traced_root)

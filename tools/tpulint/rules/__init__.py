@@ -11,6 +11,7 @@ from . import (
     tpu007_shard_specs,
     tpu008_donate,
     tpu009_dtype_drift,
+    tpu010_breaker_traced,
 )
 
 ALL_RULES = [
@@ -23,6 +24,7 @@ ALL_RULES = [
     tpu007_shard_specs,
     tpu008_donate,
     tpu009_dtype_drift,
+    tpu010_breaker_traced,
 ]
 
 RULE_DOCS = {r.RULE_ID: r.DOC for r in ALL_RULES}

@@ -1,0 +1,70 @@
+"""TPU010 — circuit-breaker accounting inside a traced region.
+
+Breaker calls are host-side control flow: `add_estimate_and_maybe_break` /
+`add_without_breaking` / `breaker.release` inside a jit/shard_map-traced
+function either freeze the FIRST call's estimate into the compiled program
+(every later request re-uses a stale byte count and the budget silently rots)
+or force a retrace per request (TPU002 territory) — and the CircuitBreakingError
+control flow cannot cross the tracer at all. The engine's rule is
+estimate-before-allocate OUTSIDE the launch, release in the caller's finally
+(common/breaker.py); this rule pins it.
+
+Detection: within the project-wide traced closure (Project.traced — jit and
+shard_map roots plus transitive callees, across modules), flag
+
+  a. any `<x>.add_estimate_and_maybe_break(...)` / `<x>.add_without_breaking(...)`
+     call — the method names are unique to breakers in this codebase;
+  b. `<x>.release(...)` ONLY when the receiver's terminal name mentions
+     "breaker" (locks and semaphores release too — a bare `.release()` is not
+     evidence of breaker accounting).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, SourceFile
+
+RULE_ID = "TPU010"
+DOC = "circuit-breaker accounting (add_estimate/release) inside a traced region"
+
+_BREAKER_METHODS = {"add_estimate_and_maybe_break", "add_without_breaking"}
+
+
+def _receiver_name(node: ast.AST) -> str | None:
+    """Terminal identifier of the call receiver: `breaker` for breaker.f(),
+    `request_breaker` for self.request_breaker.f()."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def run(files: list[SourceFile], project=None) -> list[Finding]:
+    out: list[Finding] = []
+    if project is None:
+        return out
+    for sf in files:
+        for fi in project.traced_functions_in(sf):
+            for node in ast.walk(fi.node):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                method = node.func.attr
+                if method in _BREAKER_METHODS:
+                    out.append(Finding(
+                        sf.relpath, node.lineno, RULE_ID,
+                        f"{method}() inside traced function `{fi.qualname}` — "
+                        "breaker accounting must run on the host, outside "
+                        "jit/shard_map (estimate before the launch, release "
+                        "in the caller's finally)"))
+                elif method == "release":
+                    recv = _receiver_name(node.func.value)
+                    if recv is not None and "breaker" in recv.lower():
+                        out.append(Finding(
+                            sf.relpath, node.lineno, RULE_ID,
+                            f"`{recv}.release()` inside traced function "
+                            f"`{fi.qualname}` — breaker accounting must run "
+                            "on the host, outside jit/shard_map"))
+    return out
