@@ -2,6 +2,18 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
+Modes:
+- default: the kernel-level pipelined-batch number below, followed by a SHORT
+  serving-concurrency snapshot (stderr `# serving:` line + BENCH_SERVING.json —
+  stdout stays one line) so the perf trajectory shows whether wins come from
+  cross-request coalescing or kernel time.
+- BENCH_MODE=serving: the serving-concurrency run IS the headline —
+  N concurrent client threads (BENCH_SERVING_THREADS, default 32) against a
+  live single-shard node, batched (search/batcher.py micro-batching) vs
+  unbatched (one launch per request) on the same machine; the one JSON line
+  reports QPS + p50/p99 latency + mean batch occupancy, with
+  vs_baseline = batched QPS / unbatched QPS.
+
 - corpus: synthetic enwiki-like (zero-egress image): zipfian vocabulary, ~100k docs,
   avg ~60 terms/doc, packed into the device postings-block layout. The CSR corpus
   AND the packed device-layout arrays are cached in .bench_cache/ so a warm bench
@@ -402,8 +414,167 @@ class OrderingMismatch(Exception):
     pass
 
 
+# ---------------------------------------------------------------------------
+# serving-concurrency mode: concurrent clients against a live node
+# ---------------------------------------------------------------------------
+
+SERVING_THREADS = int(os.environ.get("BENCH_SERVING_THREADS", 32))
+SERVING_SECONDS = float(os.environ.get("BENCH_SERVING_SECONDS", 5.0))
+SERVING_DOCS = int(os.environ.get("BENCH_SERVING_DOCS", 20000))
+SERVING_VOCAB = 400  # mid-frequency searchable words
+
+
+def _serving_queries(rng, n=64):
+    """2-term match bodies over mid-frequency words — ONE clause/kernel shape
+    so a warmed loop stays at 0 recompiles (the serving invariant)."""
+    out = []
+    for _ in range(n):
+        a, b = rng.choice(SERVING_VOCAB // 4, size=2, replace=False)
+        out.append({"query": {"match": {
+            "body": f"w{int(a)} w{int(b)}"}}, "size": 10})
+    return out
+
+
+def _run_serving_pass(client, queries, threads, seconds, rng):
+    """Closed-loop load: each thread issues searches back-to-back for
+    `seconds`; returns (qps, p50_ms, p99_ms)."""
+    import threading
+
+    latencies: list = []
+    lock = threading.Lock()
+    start_gate = threading.Event()
+    stop_at = [0.0]
+
+    def worker(seed):
+        r = np.random.default_rng(seed)
+        local = []
+        start_gate.wait()
+        while time.perf_counter() < stop_at[0]:
+            q = queries[int(r.integers(len(queries)))]
+            t0 = time.perf_counter()
+            client.search("bench_serving", q)
+            local.append(time.perf_counter() - t0)
+        with lock:
+            latencies.extend(local)
+
+    ts = [threading.Thread(target=worker, args=(1000 + i,))
+          for i in range(threads)]
+    for t in ts:
+        t.start()
+    stop_at[0] = time.perf_counter() + seconds
+    start_gate.set()
+    for t in ts:
+        t.join(seconds + 60)
+    lat = np.asarray(latencies)
+    if not len(lat):
+        return 0.0, float("nan"), float("nan")
+    return (len(lat) / seconds, float(np.percentile(lat, 50) * 1000),
+            float(np.percentile(lat, 99) * 1000))
+
+
+def run_serving(threads=SERVING_THREADS, seconds=SERVING_SECONDS,
+                n_docs=SERVING_DOCS):
+    """Batched-vs-unbatched serving throughput on one live node; returns the
+    result dict (the serving-mode headline / the default mode's tail row)."""
+    import tempfile
+
+    import jax
+
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.node import Node
+
+    tmp = tempfile.mkdtemp(prefix="bench_serving_")
+    settings = Settings.from_flat({
+        "path.data": tmp,
+        # enough search workers that coalescing potential isn't capped by the
+        # pool (workers block on batcher futures while the drainer launches)
+        "threadpool.search.size": str(max(threads, 8)),
+        "search.batch.linger_ms": os.environ.get("BENCH_LINGER_MS", "1.5"),
+        "search.batch.max_batch": "64",
+    })
+    node = Node(name="bench_serving", settings=settings)
+    node.start()
+    try:
+        client = node.client()
+        client.create_index("bench_serving", {"settings": {
+            "number_of_shards": 1, "number_of_replicas": 0}})
+        rng = np.random.default_rng(5)
+        # zipf-ish doc bodies; ONE refresh so the corpus is a single segment
+        raw = rng.zipf(1.3, size=(n_docs, 8)).astype(np.int64)
+        terms = (raw - 1) % SERVING_VOCAB
+        bulk = []
+        for i in range(n_docs):
+            bulk.append({"action": {"index": {
+                "_index": "bench_serving", "_type": "doc", "_id": str(i)}},
+                "source": {"body": " ".join(f"w{int(t)}" for t in terms[i])}})
+            if len(bulk) >= 500:
+                client.bulk(bulk)
+                bulk = []
+        if bulk:
+            client.bulk(bulk)
+        client.refresh("bench_serving")
+        queries = _serving_queries(rng)
+        for q in queries[:16]:  # warm the single-launch (occupancy-1) shapes
+            client.search("bench_serving", q)
+        # warm the COALESCED shapes too: the batched pass produces Qb-bucket
+        # executables (sparse planner pads to pow-2 query counts) that a
+        # sequential warmup never compiles — without this the timed batched
+        # window pays the XLA compiles and the p99/QPS numbers lie
+        _run_serving_pass(client, queries, threads, 1.0, rng)
+        node.search_batcher.enabled = False
+        client.search("bench_serving", queries[0])
+        # unbatched baseline: one device launch per request (the pre-batcher
+        # serving path), same node, same corpus, same thread count
+        qps_u, p50_u, p99_u = _run_serving_pass(client, queries, threads,
+                                                seconds, rng)
+        node.search_batcher.enabled = True
+        st0 = node.search_batcher.stats()
+        qps_b, p50_b, p99_b = _run_serving_pass(client, queries, threads,
+                                                seconds, rng)
+        st1 = node.search_batcher.stats()
+        launches = st1["launches"] - st0["launches"]
+        coalesced = st1["coalesced"] - st0["coalesced"]
+        occupancy = (coalesced / launches) if launches else 0.0
+        platform = jax.devices()[0].platform
+        return {
+            "metric": f"serving QPS ({threads} threads, cross-request "
+                      f"micro-batching, {platform})",
+            "value": round(qps_b, 1),
+            "unit": "queries/sec",
+            # the acceptance ratio: coalesced serving vs launch-per-request
+            "vs_baseline": round(qps_b / qps_u, 2) if qps_u else 0.0,
+            "p50_ms": round(p50_b, 2),
+            "p99_ms": round(p99_b, 2),
+            "occupancy_mean": round(occupancy, 2),
+            "launches": launches,
+            "coalesced": coalesced,
+            "unbatched_qps": round(qps_u, 1),
+            "unbatched_p50_ms": round(p50_u, 2),
+            "unbatched_p99_ms": round(p99_u, 2),
+            "platform": platform,
+        }
+    finally:
+        node.close()
+
+
+def serving_main():
+    """BENCH_MODE=serving entry: the one stdout JSON line is the serving row
+    (occupancy + latency keys ride along for the BENCH json tail)."""
+    platform = BackendProbe().wait()
+    if platform.startswith("cpu"):
+        from elasticsearch_tpu.common.jaxenv import force_cpu_platform
+
+        force_cpu_platform()
+    result = run_serving()
+    print(json.dumps(result))
+    sys.stdout.flush()
+
+
 def main():
     global N_DOCS, VOCAB, BATCH, N_BATCHES
+    if os.environ.get("BENCH_MODE") == "serving":
+        serving_main()
+        return
     t_start = time.time()
     probe = BackendProbe()
     if probe.poll() is None:
@@ -445,6 +616,29 @@ def main():
     print(json.dumps({k: result[k] for k in
                       ("metric", "value", "unit", "vs_baseline")}))
     sys.stdout.flush()
+
+    # ---- serving snapshot: batch occupancy into the BENCH tail --------------
+    # a SHORT cross-request micro-batching run (stderr + BENCH_SERVING.json,
+    # stdout stays one line) so the trajectory shows whether throughput wins
+    # come from coalescing (occupancy) or kernel time (the headline above)
+    if os.environ.get("BENCH_SERVING", "1") != "0":
+        stale = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_SERVING.json")
+        if os.path.exists(stale):
+            os.remove(stale)
+        try:
+            srv = run_serving(
+                threads=min(SERVING_THREADS, 16), seconds=2.5,
+                n_docs=min(SERVING_DOCS, 3000))
+            with open(stale, "w") as f:
+                json.dump(srv, f, indent=1)
+            print(f"# serving: {srv['value']} qps batched vs "
+                  f"{srv['unbatched_qps']} unbatched ({srv['vs_baseline']}x), "
+                  f"occupancy {srv['occupancy_mean']}, p50 {srv['p50_ms']}ms "
+                  f"p99 {srv['p99_ms']}ms", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — the snapshot must never kill the bench
+            print(f"# serving snapshot failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
 
     # ---- scale row: enwiki-class corpus on one chip (TPU only) --------------
     if result["platform"] == "tpu" and os.environ.get("BENCH_SCALE", "1") != "0":

@@ -152,6 +152,9 @@ class ActionModule:
         self.mesh_serving = MeshServingService(node.indices, node.settings,
                                                node_name=node.name)
         self.mesh_serving.pin_context = self._pin_context
+        # plain mesh searches coalesce through the same cross-request queue as
+        # the transport path's single-shard launches (search/batcher.py)
+        self.mesh_serving.batcher = getattr(node, "search_batcher", None)
         # point-in-time contexts pinned between the query and fetch phases (the
         # reference's SearchService active-contexts map: a merge/refresh between
         # phases must not move local doc ids under the fetch — SearchContext
@@ -1513,7 +1516,8 @@ class ActionModule:
         mesh_results = self.mesh_serving.try_search(
             state, self.node.local_node.id, indices, alias_filters, shards, req,
             use_global_stats=search_type in ("dfs_query_then_fetch",
-                                             "dfs_query_and_fetch"))
+                                             "dfs_query_and_fetch"),
+            deadline=deadline)
         if mesh_results is not None:
             node_local = state.nodes.get(self.node.local_node.id)
             shard_meta = {o: (copy.index, copy.shard_id, node_local,
@@ -1904,7 +1908,8 @@ class ActionModule:
             }
         return ShardContext(shard.engine.acquire_searcher(), svc.mapper_service,
                             svc.similarity_service, global_stats,
-                            index_name=index, breakers=self.node.breakers)
+                            index_name=index, breakers=self.node.breakers,
+                            batcher=getattr(self.node, "search_batcher", None))
 
     def _s_query_phase(self, request, channel):
         index, shard_id = request["index"], request["shard"]

@@ -89,6 +89,14 @@ class Node:
         from .common.breaker import CircuitBreakerService
 
         self.breakers = CircuitBreakerService(self.settings)
+        # cross-request device micro-batching: concurrent query phases on one
+        # shard coalesce into one bucketed launch (search/batcher.py; wired
+        # into ShardContext by ActionModule._shard_ctx and into mesh serving)
+        from .search.batcher import DeviceBatcher
+
+        self.search_batcher = DeviceBatcher(self.settings,
+                                            threadpool=self.threadpool,
+                                            node_name=self.name)
         if backend is None:
             backend = LocalTransport(address, self.registry)
         self.transport = TransportService(backend, self.local_node, self.threadpool)
@@ -217,6 +225,9 @@ class Node:
         self.indices.close()
         self.cluster_service.close()
         self.transport.close()
+        # stop the batcher drainer BEFORE its pool closes so queued searches
+        # fail typed (RejectedExecutionError) instead of hanging on futures
+        self.search_batcher.shutdown()
         self.threadpool.shutdown()
 
     def _resolve_index_buffer_size(self) -> int:
@@ -791,6 +802,10 @@ class Client:
             # the operator's view of how close the node is to shedding load
             "breakers": self.node.breakers.stats(),
             "admission_control": self.node.actions.admission.stats(),
+            # cross-request device micro-batching: launches vs coalesced
+            # requests, mean occupancy, and which flush trigger fired —
+            # whether throughput wins come from coalescing or kernel time
+            "search": {"batcher": self.node.search_batcher.stats()},
             # which executor served each query phase (device kernel variants vs
             # host scorer; process-wide rollup)
             "search_serving": serving,

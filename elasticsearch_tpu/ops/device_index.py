@@ -77,6 +77,11 @@ class PackedSegment:
     agg_rows: dict = dc_field(default_factory=dict)  # field -> HOST f32 [5, Dpad] | None (not f32-exact)
     agg_stacks: dict = dc_field(default_factory=dict)  # fields-tuple -> device [F, 5, Dpad], FIFO-bounded
     bucket_cols: dict = dc_field(default_factory=dict)  # bucket-agg cache key -> device (pair_doc, pair_bucket, zeros[NB])
+    # reusable [Qb, TB] staging arrays for the sparse planner (scoring.
+    # SparseScratchPool, lazily created) — the per-bucket padding scratch lives
+    # WITH the segment cache so warmed repeat batches re-pad in place instead
+    # of re-materializing four arrays per bucket per launch
+    sparse_scratch: object = None
     # host copies for re-bakes (live-mask refresh / similarity-stats drift)
     host_docs: np.ndarray | None = None  # int32 [NBpad*B] RAW (unmasked) doc ids
     host_freqs: np.ndarray | None = None  # float32 [NBpad*B]

@@ -27,10 +27,12 @@ indices.query.bool.max_clause_count = 1024.)
 from __future__ import annotations
 
 import functools
+import threading
 from dataclasses import dataclass, field as dc_field
 
 import numpy as np
 
+from ..common.breaker import reserve
 from .device_index import BLOCK, PackedSegment, _pow2_bucket
 
 GROUP_SHOULD, GROUP_MUST, GROUP_MUST_NOT = 0, 1, 2
@@ -710,6 +712,66 @@ def finalize_score_result(scores: np.ndarray, docs: np.ndarray, total: np.ndarra
 # (TB > tb_max: match-everything terms) fall back to the dense kernel.
 
 
+class SparseScratchPool:
+    """Reusable per-bucket padded staging arrays for plan_sparse_buckets.
+
+    The sparse planner re-materialized four [Qb, TB] host arrays (qblk/qw/
+    qconst/qcnt) for every bucket of every launch, even when the shapes repeat
+    on every warmed batch — pure allocator churn on the serving hot path.
+    The pool hands out (and takes back) array SETS keyed by (Qb, TB): a warmed
+    repeat batch performs 0 new host allocations (`allocs` stays flat, pinned
+    by tests/test_batcher.py). Arrays are borrowed from take() until the
+    launch's results have been PULLED — device transfers are asynchronous (and
+    on CPU possibly zero-copy aliases of the numpy buffer), so giving an array
+    back while its launch is still in flight would let the next take() mutate
+    data the device is reading. launch_flat_sparse returns a release callback
+    its caller invokes after the batch's device_get. Check-out/check-in (not
+    shared mutation) keeps concurrent launches on the same segment race-free;
+    the free-list is bounded so a concurrency burst can't pin staging memory
+    forever."""
+
+    _MAX_FREE = 4  # sets kept per shape
+
+    def __init__(self):
+        self._free: dict[tuple, list] = {}
+        self._lock = threading.Lock()
+        self.allocs = 0  # fresh allocations (a warmed repeat adds none)
+        self.reuses = 0
+
+    @staticmethod
+    def staging_bytes(Qb: int, tb: int) -> int:
+        # qblk i32 + qw f32 + qconst bool + qcnt i32
+        return Qb * tb * (4 + 4 + 1 + 4)
+
+    def take(self, Qb: int, tb: int, sentinel_row: int):
+        with self._lock:
+            lst = self._free.get((Qb, tb))
+            arrs = lst.pop() if lst else None
+        if arrs is None:
+            with self._lock:
+                self.allocs += 1
+            return (np.full((Qb, tb), sentinel_row, np.int32),
+                    np.zeros((Qb, tb), np.float32),
+                    np.zeros((Qb, tb), bool),
+                    np.zeros((Qb, tb), np.int32))
+        with self._lock:
+            self.reuses += 1
+        qblk, qw, qconst, qcnt = arrs
+        qblk.fill(sentinel_row)
+        qw.fill(0.0)
+        qconst.fill(False)
+        qcnt.fill(0)
+        return arrs
+
+    def give(self, arrs):
+        qblk = arrs[0]
+        key = qblk.shape
+        with self._lock:
+            lst = self._free.setdefault(key, [])
+            if len(lst) < self._MAX_FREE:
+                lst.append(arrs)
+
+
 @dataclass
 class SparseBatch:
     """One bucket of queries sharing a [Qb, TB] block layout."""
@@ -845,12 +907,18 @@ def score_sparse_batch_async(packed: PackedSegment, sb: SparseBatch, k: int):
 
 def plan_sparse_buckets(clause_lists: list, n_must: np.ndarray, msm: np.ndarray,
                         coord: np.ndarray, sentinel_row: int, *, tb_max: int = 512,
-                        slot_budget: int = 32768, simple: bool = False):
+                        slot_budget: int = 32768, simple: bool = False,
+                        scratch: SparseScratchPool | None = None):
     """Bucket queries by block count and build SparseBatches.
 
     clause_lists: per query, list of (b0, b1, weight, group, is_const) block ranges.
     Returns (batches, overflow_qids): overflow queries (TB > tb_max) need the dense
-    fallback; queries with zero blocks appear in no batch (zero hits)."""
+    fallback; queries with zero blocks appear in no batch (zero hits).
+
+    `scratch` (the packed segment's SparseScratchPool) supplies the [Qb, TB]
+    staging arrays; callers that pass one MUST give the arrays back after the
+    device launch (launch_flat_sparse does) — None allocates fresh arrays the
+    caller owns outright (the bench keeps its batches alive across runs)."""
     Q = len(clause_lists)
     tb_q = np.array([sum(b1 - b0 for (b0, b1, _w, _g, _c) in cl)
                      for cl in clause_lists], dtype=np.int64)
@@ -871,10 +939,13 @@ def plan_sparse_buckets(clause_lists: list, n_must: np.ndarray, msm: np.ndarray,
             Qb = 8
             while Qb < len(chunk):
                 Qb *= 2
-            qblk = np.full((Qb, tb), sentinel_row, np.int32)
-            qw = np.zeros((Qb, tb), np.float32)
-            qconst = np.zeros((Qb, tb), bool)
-            qcnt = np.zeros((Qb, tb), np.int32)
+            if scratch is not None:
+                qblk, qw, qconst, qcnt = scratch.take(Qb, tb, sentinel_row)
+            else:
+                qblk = np.full((Qb, tb), sentinel_row, np.int32)
+                qw = np.zeros((Qb, tb), np.float32)
+                qconst = np.zeros((Qb, tb), bool)
+                qcnt = np.zeros((Qb, tb), np.int32)
             qids = np.full(Qb, -1, np.int32)
             bn_must = np.zeros(Qb, np.int32)
             bmsm = np.zeros(Qb, np.int32)
@@ -907,9 +978,65 @@ def plan_sparse_buckets(clause_lists: list, n_must: np.ndarray, msm: np.ndarray,
     return batches, overflow
 
 
+def launch_flat_sparse(packed: PackedSegment, clause_lists: list,
+                       n_must: np.ndarray, msm: np.ndarray, coord: np.ndarray,
+                       k: int, *, simple: bool = False, tb_max: int = 512,
+                       breaker=None):
+    """Plan + launch every sparse bucket of a flat-query batch WITHOUT syncing.
+
+    Returns (launches, overflow_qids, release) where launches =
+    [(SparseBatch, device result triple)] and `release` is a zero-arg
+    callback returning the borrowed staging arrays to the segment's scratch
+    pool — the caller MUST invoke it only after the batch's device_get
+    (transfers are async; see SparseScratchPool). collect_flat_sparse
+    scatters the pulled results into [Q, k] host arrays. The dispatch half of
+    the serving path's dispatch-then-merge split — it never calls
+    jax.device_get.
+
+    Staging accounting happens here, per BATCH: the padded [Qb, TB] staging
+    arrays for the whole coalesced launch are reserved on the request breaker
+    in one sum (the launch is the allocation, not the per-request share) and
+    released once the buckets are launched."""
+    sentinel_row = packed.blk_docs.shape[0] - 1
+    scratch = packed.sparse_scratch
+    if scratch is None:
+        scratch = packed.sparse_scratch = SparseScratchPool()
+    batches, overflow = plan_sparse_buckets(
+        clause_lists, n_must, msm, coord, sentinel_row, tb_max=tb_max,
+        simple=simple, scratch=scratch)
+    est = sum(SparseScratchPool.staging_bytes(*sb.qblk.shape) for sb in batches)
+    with reserve(breaker, est, "<sparse_staging>"):
+        launches = [(sb, score_sparse_batch_async(packed, sb, k))
+                    for sb in batches]
+
+    def release():
+        for sb in batches:
+            scratch.give((sb.qblk, sb.qw, sb.qconst, sb.qcnt))
+
+    return launches, overflow, release
+
+
+def collect_flat_sparse(launches: list, pulled: list, Q: int, k: int,
+                        doc_pad: int):
+    """Scatter pulled bucket results (host triples, same order as `launches`)
+    into [Q, k] host arrays — the merge half's pure-host counterpart of
+    launch_flat_sparse."""
+    scores = np.full((Q, k), -np.inf, np.float32)
+    docs = np.full((Q, k), doc_pad, np.int32)
+    totals = np.zeros(Q, np.int64)
+    for (sb, _r), (s, d, t) in zip(launches, pulled):
+        rows = sb.qids >= 0
+        qid = sb.qids[rows]
+        kk = s.shape[1]
+        scores[qid, :kk] = s[rows]
+        docs[qid, :kk] = d[rows]
+        totals[qid] = t[rows]
+    return scores, docs, totals
+
+
 def score_flat_sparse(packed: PackedSegment, clause_lists: list, n_must: np.ndarray,
                       msm: np.ndarray, coord: np.ndarray, k: int, *,
-                      simple: bool = False, tb_max: int = 512):
+                      simple: bool = False, tb_max: int = 512, breaker=None):
     """Score a whole flat-query batch through the sparse path: plan buckets, launch all
     (pipelined), collect into [Q, k] host arrays.
 
@@ -918,23 +1045,15 @@ def score_flat_sparse(packed: PackedSegment, clause_lists: list, n_must: np.ndar
     import jax
 
     Q = len(clause_lists)
-    sentinel_row = packed.blk_docs.shape[0] - 1
-    batches, overflow = plan_sparse_buckets(
-        clause_lists, n_must, msm, coord, sentinel_row, tb_max=tb_max, simple=simple)
-    scores = np.full((Q, k), -np.inf, np.float32)
-    docs = np.full((Q, k), packed.doc_pad, np.int32)
-    totals = np.zeros(Q, np.int64)
-    results = [(sb, score_sparse_batch_async(packed, sb, k)) for sb in batches]
+    launches, overflow, release = launch_flat_sparse(
+        packed, clause_lists, n_must, msm, coord, k, simple=simple,
+        tb_max=tb_max, breaker=breaker)
     # all buckets launched async above; ONE explicit device_get drains them
     # (it blocks until ready) instead of a per-bucket-per-array np.asarray pull
-    pulled = jax.device_get([r for (_sb, r) in results]) if results else []
-    for (sb, _r), (s, d, t) in zip(results, pulled):
-        rows = sb.qids >= 0
-        qid = sb.qids[rows]
-        kk = s.shape[1]
-        scores[qid, :kk] = s[rows]
-        docs[qid, :kk] = d[rows]
-        totals[qid] = t[rows]
+    pulled = jax.device_get([r for (_sb, r) in launches]) if launches else []
+    release()  # results are on the host — staging arrays are reusable now
+    scores, docs, totals = collect_flat_sparse(launches, pulled, Q, k,
+                                               packed.doc_pad)
     return scores, docs, totals, overflow
 
 

@@ -40,6 +40,10 @@ class MeshServingService:
         self.indices = indices_service
         self.enabled = bool(settings.get_bool("search.mesh.enabled", True))
         self.logger = get_logger("search.mesh", node=node_name)
+        # the node's cross-request DeviceBatcher (set by ActionModule): plain
+        # mesh searches coalesce into one SPMD launch through the same queue
+        # the transport path uses (search/batcher.py _MeshFamily)
+        self.batcher = None
         self.mesh_queries = 0  # served via the SPMD program (stats/test hook)
         self.mesh_fallbacks = 0  # eligible-looking but fell back mid-flight
         self._lock = threading.Lock()
@@ -93,9 +97,12 @@ class MeshServingService:
         return index, n_total
 
     def try_search(self, state, local_node_id: str, indices, alias_filters,
-                   shards, req: ParsedSearchRequest, use_global_stats: bool):
+                   shards, req: ParsedSearchRequest, use_global_stats: bool,
+                   deadline=None):
         """Returns per-ordinal ShardQueryResults (ordinal = position in `shards`)
-        when the mesh program served the query phase, else None (transport path)."""
+        when the mesh program served the query phase, else None (transport path).
+        `deadline` rides into the batcher's deadline-aware flush for plain
+        (coalescable) searches — a launched SPMD program still runs whole."""
         eligible = self._eligible(state, local_node_id, indices, alias_filters,
                                   shards, req)
         if eligible is None:
@@ -104,7 +111,7 @@ class MeshServingService:
         self._prune(state)
         try:
             results = self._search_mesh(index, n_total, shards, req,
-                                        use_global_stats)
+                                        use_global_stats, deadline=deadline)
         except CircuitBreakingError:
             # a tripped breaker means the NODE is out of budget — falling back
             # to the transport path would re-materialize the same request-sized
@@ -139,7 +146,8 @@ class MeshServingService:
 
     # ------------------------------------------------------------------
     def _search_mesh(self, index: str, n_total: int, shards,
-                     req: ParsedSearchRequest, use_global_stats: bool):
+                     req: ParsedSearchRequest, use_global_stats: bool,
+                     deadline=None):
         from ..common.errors import IndexShardMissingError
 
         svc = self.indices.index_service(index)
@@ -289,26 +297,44 @@ class MeshServingService:
                 active = np.zeros(S, bool)
                 active[selected] = True
 
-            out = executor.search(
-                [plan], k, filter_masks=filter_masks, agg_rows=agg_rows,
-                use_metric_aggs=bool(metric_fields), post_masks=post_masks,
-                min_score=(float(req.min_score)
-                           if req.min_score is not None else None),
-                sort_keys=sort_keys,
-                sort_desc=bool(sort_spec.reverse) if sort_spec is not None else False,
-                active=active, bucket_pairs=bucket_pairs or None)
+            plain = (filter_masks is None and agg_rows is None
+                     and post_masks is None and req.min_score is None
+                     and sort_keys is None and active is None
+                     and not bucket_pairs)
+            if plain and self.batcher is not None:
+                # plain searches carry no per-request program arguments, so
+                # concurrent ones coalesce into ONE SPMD launch through the
+                # node's cross-request queue (search/batcher.py _MeshFamily —
+                # same flush policy as the single-shard transport path); the
+                # fan-out hands back this query's host rows directly
+                out = None
+                (shard_row, score_row, doc_row, totals_col,
+                 qmax_col) = self.batcher.execute_mesh(
+                     plan, executor, k, deadline=deadline)
+            else:
+                out = executor.search(
+                    [plan], k, filter_masks=filter_masks, agg_rows=agg_rows,
+                    use_metric_aggs=bool(metric_fields), post_masks=post_masks,
+                    min_score=(float(req.min_score)
+                               if req.min_score is not None else None),
+                    sort_keys=sort_keys,
+                    sort_desc=bool(sort_spec.reverse) if sort_spec is not None
+                    else False,
+                    active=active, bucket_pairs=bucket_pairs or None)
             self.mesh_queries += 1
 
             track = bool(req.track_scores) if req.sort else True
-            # batch every host read ONCE: the executor already device_get the
-            # whole program output, so these are pure-host .tolist() conversions —
-            # the per-element float()/int() pulls this replaces were a scalar
-            # extraction per hit per shard (the grandfathered TPU001 block)
-            shard_row = out.shard[0].tolist()
-            score_row = out.scores[0].tolist()
-            doc_row = out.doc[0].tolist()
-            totals_col = out.shard_totals[:, 0].tolist()
-            qmax_col = out.qmax[:, 0].tolist()
+            if out is not None:
+                # batch every host read ONCE: the executor already device_get
+                # the whole program output, so these are pure-host .tolist()
+                # conversions — the per-element float()/int() pulls this
+                # replaces were a scalar extraction per hit per shard (the
+                # grandfathered TPU001 block)
+                shard_row = out.shard[0].tolist()
+                score_row = out.scores[0].tolist()
+                doc_row = out.doc[0].tolist()
+                totals_col = out.shard_totals[:, 0].tolist()
+                qmax_col = out.qmax[:, 0].tolist()
             results = []
             for ordinal, copy in enumerate(shards):
                 sid = copy.shard_id

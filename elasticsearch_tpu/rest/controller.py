@@ -1174,6 +1174,28 @@ def build_rest_controller(node) -> RestController:
             return _cat_table(req, columns, [row])
         return _cat_table(req, default, [row])
 
+    def cat_batcher(req):
+        """Cross-request micro-batching at a glance: launches vs coalesced
+        requests, mean occupancy, and which flush trigger is firing — the
+        operator's first read on whether concurrent load is actually
+        coalescing (search/batcher.py; full counters in /_nodes/stats)."""
+        host, ip = _node_host_ip()
+        st = node.search_batcher.stats()
+        columns = [
+            ("host", "h", "host name"), ("ip", "i", "ip address"),
+            ("launches", "l", "coalesced device launches"),
+            ("coalesced", "c", "requests served via coalesced launches"),
+            ("occupancy_mean", "o", "mean requests per launch"),
+            ("full_flushes", "ff", "flushes on batch-full"),
+            ("linger_flushes", "lf", "flushes on linger expiry"),
+            ("deadline_flushes", "df", "flushes on request deadline"),
+            ("queue", "q", "plans waiting to coalesce"),
+            ("bypassed", "by", "requests served outside the batcher"),
+        ]
+        row = {"host": host, "ip": ip}
+        row.update({name: st.get(name, 0) for (name, _a, _d) in columns[2:]})
+        return _cat_table(req, columns, [row])
+
     # --- percolate -----------------------------------------------------------
     def percolate(req):
         return node.percolator.percolate(
@@ -1288,10 +1310,11 @@ def build_rest_controller(node) -> RestController:
     rc.register("GET", "/_cat/pending_tasks", cat_pending_tasks)
     rc.register("GET", "/_cat/recovery", cat_recovery)
     rc.register("GET", "/_cat/thread_pool", cat_thread_pool)
+    rc.register("GET", "/_cat/batcher", cat_batcher)
     rc.register("GET", "/_cat", lambda r: RestResponse(
         200, "".join(f"/_cat/{n}\n" for n in (
             "health", "nodes", "indices", "shards", "master", "allocation", "count",
-            "aliases", "pending_tasks", "recovery", "thread_pool")),
+            "aliases", "pending_tasks", "recovery", "thread_pool", "batcher")),
         content_type="text/plain"))
 
     # plugin-contributed routes (ref: plugins contribute REST handlers)

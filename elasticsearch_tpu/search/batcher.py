@@ -1,0 +1,449 @@
+"""Cross-request device micro-batching — the serving-path throughput lever.
+
+The bench proves the device path is batch-hungry (BENCH_r05: 128 queries score
+in one ~17 ms pipelined launch) yet live serving dispatched ONE request per
+device launch, paying a full launch + host merge per query under concurrent
+load. DeviceBatcher coalesces concurrent `execute_query_phase` calls into one
+bucketed `execute_flat_batch` launch — the same continuous/micro-batching
+lever inference servers use (Orca-style iteration batching; the shape of the
+reference's per-shard search pooling):
+
+    search pool threads                drainer ("search_batcher" pool)
+    ───────────────────                ─────────────────────────────────
+    enqueue(plan, key)──►[bounded coalescing queue]
+    wait(future)                          │ collect same-key items
+         ▲                                ▼
+         │                        dispatch batch N+1 ──► device
+         └────── fan-out ◄─────── merge batch N     ◄── device
+
+Items coalesce only under an identical key: same segment point-in-time view +
+mapper/similarity services + k bucket (k rounds up to a power of two so mixed
+page sizes share executables — the kernel runs at the bucket, fan-out trims).
+DFS-stats requests bypass the queue entirely (their per-request global stats
+would poison the batch's shared weights).
+
+Flush policy — whichever fires first:
+  * batch-full  : `search.batch.max_batch` same-key plans are waiting
+  * linger      : the oldest item has waited `linger_eff`, where
+                  linger_eff = linger_ms * (1 - queued/max_batch), floored at
+                  `search.batch.min_linger_ms` — a hot queue shrinks the
+                  linger toward zero because latency is only spent when it
+                  buys occupancy; a lone request pays at most linger_ms
+  * deadline    : now >= tightest enqueued Deadline - EWMA(batch service
+                  time) — flushing early leaves budget for the device launch
+                  AND the host merge, so PR-3 timeout semantics survive
+                  coalescing
+
+Double buffering: the drainer dispatches batch N+1 BEFORE merging batch N, so
+batch N's host merge overlaps batch N+1's device compute. The dispatch half
+never calls jax.device_get; the merge half performs the batch's single batched
+pull (execute._merge_flat_plain) — the tpulint TPU001 baseline stays empty.
+
+Breaker rule: sparse staging buffers and merge canvases are reserved per
+BATCH on the request breaker (the coalesced launch is the allocation, not the
+per-request share — ops/scoring.launch_flat_sparse). When a coalesced launch
+trips a breaker (or fails any other way), the drainer replays each item
+individually so only the request that is actually oversized fails with the
+429; its neighbors keep their answers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+from ..common.deadline import NO_DEADLINE, Deadline
+from ..common.errors import RejectedExecutionError
+from ..common.logging import get_logger
+from ..ops.device_index import _pow2_bucket
+
+_K_MIN = 16  # smallest k bucket (top-10 pages and top-16 share executables)
+
+
+def _k_bucket(k: int) -> int:
+    return _pow2_bucket(k, _K_MIN)
+
+
+class _Item:
+    __slots__ = ("family", "key", "payload", "k", "kb", "deadline", "future",
+                 "t_enq")
+
+    def __init__(self, family, key, payload, k: int, kb: int,
+                 deadline: Deadline):
+        self.family = family
+        self.key = key
+        self.payload = payload
+        self.k = k  # the request's own k (fan-out trims to it)
+        self.kb = kb  # the bucketed launch k
+        self.deadline = deadline
+        self.future: Future = Future()
+        self.t_enq = time.monotonic()
+
+
+class _FlatFamily:
+    """Coalesces single-shard FlatPlans into execute_flat_batch launches.
+    payload = (plan, ShardContext); the batch runs with the LEADER item's
+    context — the key guarantees every member sees the identical segment
+    view and stats sources, so per-plan weights are identical either way."""
+
+    name = "flat"
+
+    @staticmethod
+    def key(ctx, kb: int):
+        s = ctx.searcher
+        return ("flat", id(ctx.mapper_service), id(ctx.similarity_service),
+                tuple(id(seg) for seg in s.segments), kb)
+
+    @staticmethod
+    def dispatch(items, kb: int):
+        from .execute import dispatch_flat_batch
+
+        ctx = items[0].payload[1]
+        return dispatch_flat_batch([it.payload[0] for it in items], ctx, kb)
+
+    @staticmethod
+    def fan_out(handle, items):
+        from .execute import TopDocs
+
+        merged = handle.merge()
+        return [TopDocs(total=td.total, hits=td.hits[: it.k],
+                        max_score=td.max_score, timed_out=td.timed_out)
+                for it, td in zip(items, merged)]
+
+    @staticmethod
+    def execute_single(item):
+        from .execute import execute_flat_batch
+
+        plan, ctx = item.payload
+        return execute_flat_batch([plan], ctx, item.k)[0]
+
+
+class _MeshFamily:
+    """Coalesces plain mesh searches into one SPMD program launch.
+    payload = (plan, MeshSearchExecutor); results fan out as per-query host
+    row tuples (shard_row, score_row, doc_row, shard_totals_col, qmax_col) —
+    exactly what mesh_serving's assembly consumes. The plan list pads to a
+    power-of-two Q with zero-clause plans (msm=1 matches nothing) so batch
+    sizes share compiled programs."""
+
+    name = "mesh"
+
+    @staticmethod
+    def key(executor, kb: int):
+        return ("mesh", id(executor), kb)
+
+    @staticmethod
+    def dispatch(items, kb: int):
+        from .execute import FlatPlan
+
+        executor = items[0].payload[1]
+        plans = [it.payload[0] for it in items]
+        # the k bucket may round past the program's doc space (the request's
+        # own k was validated against doc_pad by mesh_serving) — clamp it
+        kb = min(kb, executor.index.doc_pad)
+        qb = _pow2_bucket(len(plans), 1)
+        plans += [FlatPlan([], msm=1, n_must=0, coord_enabled=False, boost=1.0)
+                  for _ in range(qb - len(plans))]
+        # executor.search pulls its program output itself (one device_get for
+        # the whole result pytree) — the mesh family merges at dispatch time
+        return executor.search(plans, kb)
+
+    @staticmethod
+    def fan_out(out, items):
+        results = []
+        for qi, it in enumerate(items):
+            results.append((out.shard[qi].tolist(), out.scores[qi].tolist(),
+                            out.doc[qi].tolist(),
+                            out.shard_totals[:, qi].tolist(),
+                            out.qmax[:, qi].tolist()))
+        return results
+
+    @staticmethod
+    def execute_single(item):
+        plan, executor = item.payload
+        out = executor.search([plan], min(item.kb, executor.index.doc_pad))
+        return (out.shard[0].tolist(), out.scores[0].tolist(),
+                out.doc[0].tolist(), out.shard_totals[:, 0].tolist(),
+                out.qmax[:, 0].tolist())
+
+
+class DeviceBatcher:
+    """Per-node coalescing queue + drainer for cross-request device batching.
+
+    Grouping is per coalesce key — which embeds the shard's point-in-time
+    segment view — so this IS per-shard batching; one node-level queue simply
+    lets a single drainer double-buffer across shards too."""
+
+    def __init__(self, settings=None, threadpool=None, node_name: str = "node"):
+        from ..common.settings import Settings
+
+        settings = settings or Settings.EMPTY
+        self.enabled = bool(settings.get_bool("search.batch.enabled", True))
+        self.max_batch = max(1, settings.get_int("search.batch.max_batch", 64))
+        self.linger_s = max(
+            0.0, settings.get_float("search.batch.linger_ms", 1.5)) / 1000.0
+        self.min_linger_s = max(
+            0.0, settings.get_float("search.batch.min_linger_ms", 0.1)) / 1000.0
+        self.queue_cap = max(1, settings.get_int("search.batch.queue_size", 1024))
+        self.logger = get_logger("search.batcher", node=node_name)
+        self._threadpool = threadpool
+        self._queue: deque[_Item] = deque()
+        self._cv = threading.Condition()
+        self._shutdown = False
+        self._drainer_started = False
+        self._drainer_dead = False
+        # EWMA of batch service time (dispatch start -> fan-out done): what the
+        # deadline flush subtracts so launch + merge still fit in the budget
+        self._ewma_cost = 0.004
+        self._stats_lock = threading.Lock()
+        self._launches = 0
+        self._items_launched = 0  # total items served via coalesced launches
+        self._full_flushes = 0
+        self._linger_flushes = 0
+        self._deadline_flushes = 0
+        self._bypassed = 0  # queue full / disabled / drainer dead -> inline
+        self._splits = 0  # coalesced launch failed -> per-item replay
+        self._flat = _FlatFamily()
+        self._mesh = _MeshFamily()
+
+    # -- public entry points -------------------------------------------------
+    def execute(self, plan, ctx, k: int, deadline: Deadline = NO_DEADLINE):
+        """Coalesce one shard-local FlatPlan with concurrent callers; blocks
+        until the batch lands and returns this plan's TopDocs (hits trimmed
+        to k). Falls back to a direct single-plan launch when batching is
+        disabled, the queue is saturated, or the drainer has died."""
+        k = max(k, 1)
+        kb = _k_bucket(k)
+        item = _Item(self._flat, self._flat.key(ctx, kb), (plan, ctx), k, kb,
+                     deadline or NO_DEADLINE)
+        return self._submit(item)
+
+    def execute_mesh(self, plan, executor, k: int,
+                     deadline: Deadline = NO_DEADLINE):
+        """Coalesce one plain mesh search; returns the per-query host rows
+        (shard, score, doc, shard_totals, qmax) mesh_serving assembles from."""
+        k = max(k, 1)
+        kb = _k_bucket(k)
+        item = _Item(self._mesh, self._mesh.key(executor, kb),
+                     (plan, executor), k, kb, deadline or NO_DEADLINE)
+        return self._submit(item)
+
+    def _submit(self, item: _Item):
+        if not self.enabled:
+            with self._stats_lock:
+                self._bypassed += 1
+            return item.family.execute_single(item)
+        with self._cv:
+            # _drainer_dead is re-checked HERE, under the condition: the death
+            # path flips it and drains the queue under the same lock, so an
+            # item can never land in a queue nobody will ever service
+            if (self._shutdown or self._drainer_dead
+                    or len(self._queue) >= self.queue_cap):
+                inline = True
+            else:
+                self._queue.append(item)
+                self._cv.notify_all()
+                inline = False
+        if inline:
+            # a saturated coalescing queue must not become a second rejection
+            # layer on top of the search pool's — serve directly instead
+            with self._stats_lock:
+                self._bypassed += 1
+            return item.family.execute_single(item)
+        self._ensure_drainer()
+        remaining = item.deadline.remaining()
+        # generous slack past the deadline: the flush logic targets the
+        # deadline itself, this wait only guards against a wedged drainer
+        timeout = None if remaining is None else remaining + 30.0
+        return item.future.result(timeout=timeout)
+
+    # -- drainer -------------------------------------------------------------
+    def _ensure_drainer(self):
+        if self._drainer_started:
+            return
+        with self._cv:
+            if self._drainer_started or self._shutdown:
+                return
+            self._drainer_started = True
+        if self._threadpool is not None:
+            try:
+                # a named pool so the drainer shows in /_nodes/stats thread_pool
+                self._threadpool.submit("search_batcher", self._drain_loop)
+                return
+            except Exception:  # noqa: BLE001 — pool missing/closed: plain thread
+                pass
+        threading.Thread(target=self._drain_loop, daemon=True,
+                         name="estpu[search_batcher]").start()
+
+    def _drain_loop(self):
+        try:
+            self._drain()
+        except BaseException as e:  # noqa: BLE001 — a dead drainer must not
+            # strand waiters: flag it (under the condition, so no _submit can
+            # slip an item into the queue after the drain below) and fail
+            # anything already queued; later submits bypass to direct execution
+            with self._cv:
+                self._drainer_dead = True
+            self.logger.warning(f"batcher drainer died ({type(e).__name__}: "
+                                f"{e}); serving falls back to direct launches")
+            self._fail_queued(e)
+
+    def _drain(self):
+        pending = None  # (family, items, handle, t0) — dispatched, not merged
+        while True:
+            batch = None
+            with self._cv:
+                while not self._queue and not self._shutdown:
+                    if pending is not None:
+                        break  # merge the in-flight batch instead of idling
+                    self._cv.wait(0.1)
+                if self._queue and not self._shutdown:
+                    batch = self._collect_locked()
+            if batch is None:
+                if pending is not None:
+                    self._finish(*pending)
+                    pending = None
+                    continue
+                if self._shutdown:
+                    break
+                continue
+            items, reason = batch
+            t0 = time.monotonic()
+            family = items[0].family
+            try:
+                # dispatch-then-merge double buffering: batch N+1's device
+                # work is enqueued BEFORE batch N's host merge runs, so the
+                # merge overlaps device compute (no device_get in this half)
+                handle = family.dispatch(items, items[0].kb)
+            except Exception as e:  # noqa: BLE001 — replay decides per item
+                self._split(family, items, e)
+                continue
+            self._note_flush(reason)
+            if pending is not None:
+                self._finish(*pending)
+            pending = (family, items, handle, t0)
+            with self._cv:
+                queue_empty = not self._queue
+            if queue_empty:
+                self._finish(*pending)
+                pending = None
+        if pending is not None:
+            self._finish(*pending)
+        self._fail_queued(RejectedExecutionError(
+            "search batcher is shut down"))
+
+    def _collect_locked(self):
+        """Pick the oldest item's key and wait (under the condition) until a
+        flush trigger fires; pops and returns (items, reason). Called with
+        the condition held; may release it while waiting."""
+        head = self._queue[0]
+        key = head.key
+        while True:
+            same = [it for it in self._queue if it.key == key]
+            n = len(same)
+            if n >= self.max_batch:
+                reason = "full"
+                break
+            now = time.monotonic()
+            # adaptive linger: shrinks linearly as the queue fills — waiting
+            # longer only pays when it buys occupancy
+            linger_eff = max(self.min_linger_s,
+                             self.linger_s * (1.0 - n / float(self.max_batch)))
+            flush_at = head.t_enq + linger_eff
+            reason = "linger"
+            for it in same:
+                rem = it.deadline.remaining()
+                if rem is None:
+                    continue
+                # leave one expected batch service time (launch + merge) of
+                # budget so the flushed batch can still answer in time
+                dl_at = now + rem - self._ewma_cost
+                if dl_at < flush_at:
+                    flush_at = dl_at
+                    reason = "deadline"
+            if now >= flush_at or self._shutdown:
+                break
+            self._cv.wait(min(flush_at - now, 0.05))
+        taken: list[_Item] = []
+        rest: deque[_Item] = deque()
+        for it in self._queue:
+            if it.key == key and len(taken) < self.max_batch:
+                taken.append(it)
+            else:
+                rest.append(it)
+        self._queue.clear()
+        self._queue.extend(rest)
+        return taken, reason
+
+    def _finish(self, family, items, handle, t0: float):
+        """Merge a dispatched batch and fan results out to the item futures."""
+        try:
+            results = family.fan_out(handle, items)
+        except Exception as e:  # noqa: BLE001 — replay decides per item
+            self._split(family, items, e)
+            return
+        dt = time.monotonic() - t0
+        with self._stats_lock:
+            self._ewma_cost = 0.2 * dt + 0.8 * self._ewma_cost
+            self._launches += 1
+            self._items_launched += len(items)
+        for it, res in zip(items, results):
+            it.future.set_result(res)
+
+    def _split(self, family, items, err):
+        """A coalesced launch failed (breaker trip, device error): replay every
+        item individually so only the request that actually trips carries the
+        error — its neighbors must not inherit a 429 sized for the batch."""
+        if len(items) == 1:
+            items[0].future.set_exception(err)
+            return
+        with self._stats_lock:
+            self._splits += 1
+        for it in items:
+            try:
+                res = family.execute_single(it)
+            except Exception as e:  # noqa: BLE001 — per-item verdict
+                it.future.set_exception(e)
+            else:
+                it.future.set_result(res)
+
+    def _note_flush(self, reason: str):
+        with self._stats_lock:
+            if reason == "full":
+                self._full_flushes += 1
+            elif reason == "deadline":
+                self._deadline_flushes += 1
+            else:
+                self._linger_flushes += 1
+
+    def _fail_queued(self, err):
+        with self._cv:
+            items, self._queue = list(self._queue), deque()
+        for it in items:
+            if not it.future.done():
+                it.future.set_exception(err)
+
+    # -- lifecycle / observability -------------------------------------------
+    def shutdown(self):
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            launches = self._launches
+            items = self._items_launched
+            return {
+                "launches": launches,
+                "coalesced": items,
+                "occupancy_mean": round(items / launches, 3) if launches else 0.0,
+                "full_flushes": self._full_flushes,
+                "linger_flushes": self._linger_flushes,
+                "deadline_flushes": self._deadline_flushes,
+                "bypassed": self._bypassed,
+                "splits": self._splits,
+                "queue": len(self._queue),
+                "ewma_batch_ms": round(self._ewma_cost * 1000.0, 3),
+            }

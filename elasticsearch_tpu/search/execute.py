@@ -87,7 +87,7 @@ class ShardContext:
 
     def __init__(self, searcher: Searcher, mapper_service, similarity_service=None,
                  global_stats: dict | None = None, index_name: str | None = None,
-                 breakers=None):
+                 breakers=None, batcher=None):
         self.searcher = searcher
         self.mapper_service = mapper_service
         self.similarity_service = similarity_service or SimilarityService(
@@ -103,6 +103,10 @@ class ShardContext:
         # tests, standalone shard work): allocation hot spots reserve through
         # breaker(name) and every charge site tolerates the None no-op
         self.breakers = breakers
+        # the node's cross-request DeviceBatcher (search/batcher.py), or None
+        # in unwired contexts — single-plan device launches coalesce with
+        # concurrent searches when present (service._execute_flat_single)
+        self.batcher = batcher
 
     def breaker(self, name: str):
         """The named circuit breaker, or None when no service is wired."""
@@ -483,16 +487,66 @@ def _assemble_batch(plans: list[FlatPlan], finals: list):
     return all_fields, field_idx, cache_rows, caches_stack, coord_tbl, n_must, msm
 
 
-def _execute_flat_plain(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list[TopDocs]:
-    """Run a batch of flat plans through the device kernels, per-segment launches,
-    then merge per-segment top-k host-side (score desc, global doc asc — Lucene order).
+class _PendingFlat:
+    """Device work in flight for one plain-plan batch: every segment's sparse
+    bucket launches (+ dense-overflow launches) with NO host pull yet.
+    merge() performs the batch's ONE explicit jax.device_get and the host
+    top-k merge — the dispatch/merge split the cross-request batcher overlaps
+    (search/batcher.py: batch N+1 dispatches while batch N merges)."""
+
+    __slots__ = ("Q", "k", "breaker", "seg_work", "releases")
+
+    def __init__(self, Q: int, k: int, breaker, seg_work: list, releases: list):
+        self.Q = Q
+        self.k = k
+        self.breaker = breaker
+        # per segment: (seg, base, doc_pad, launches, dense)
+        self.seg_work = seg_work
+        # scratch-pool release callbacks — invoked by merge() AFTER the pull
+        # (staging arrays must stay untouched while transfers are in flight)
+        self.releases = releases
+
+    def merge(self) -> list[TopDocs]:
+        return _merge_flat_plain(self)
+
+
+class _PendingDone:
+    """Already-merged results behind the pending interface — the fs/filtered
+    plan families execute synchronously inside the dispatch half (they are
+    rare on the serving hot path and their kernels pull per launch)."""
+
+    __slots__ = ("results",)
+
+    def __init__(self, results: list):
+        self.results = results
+
+    def merge(self) -> list[TopDocs]:
+        return self.results
+
+
+def dispatch_flat_batch(plans: list[FlatPlan], ctx: ShardContext, k: int):
+    """Dispatch half of execute_flat_batch for the cross-request batcher:
+    returns a pending handle whose merge() yields the per-plan TopDocs.
+    Plain plans enqueue device work without syncing; batches carrying
+    function_score/filtered plans run whole (synchronously) here."""
+    if plans and all(p.fs is None and p.filt is None for p in plans):
+        return _dispatch_flat_plain(plans, ctx, k)
+    return _PendingDone(execute_flat_batch(plans, ctx, k))
+
+
+def _dispatch_flat_plain(plans: list[FlatPlan], ctx: ShardContext,
+                         k: int) -> _PendingFlat:
+    """Plan + launch a batch of plain flat plans across every segment WITHOUT
+    any host pull (the merge half does the batch's single device_get).
 
     The common case rides the sparse candidate-centric kernel (ops/scoring.py
-    score_flat_sparse — work scales with postings touched, not corpus size); queries
-    whose terms cover too many postings blocks (tb_max) fall back to the dense
-    scatter kernel, which is O(Q·doc_pad) but block-count-insensitive."""
+    launch_flat_sparse — work scales with postings touched, not corpus size);
+    queries whose terms cover too many postings blocks (tb_max) fall back to
+    the dense scatter kernel, which is O(Q·doc_pad) but block-count-insensitive.
+    Sparse staging buffers are pooled per segment and accounted per batch on
+    the request breaker (see launch_flat_sparse)."""
     from ..ops.device_index import TFN_BM25, TFN_TFIDF, ensure_tfn, packed_for
-    from ..ops.scoring import build_term_batch, score_flat_sparse, score_term_batch
+    from ..ops.scoring import launch_flat_sparse
 
     Q = len(plans)
     finals = [finalize_flat(p, ctx) for p in plans]
@@ -511,8 +565,8 @@ def _execute_flat_plain(plans: list[FlatPlan], ctx: ShardContext, k: int) -> lis
                 for (resolved, _f, _c, _coord) in finals
                 for (_f2, _t, w, _fi, g, mode, df) in resolved if df > 0))
 
-    totals = np.zeros(Q, dtype=np.int64)
-    seg_hits = []  # (scores [Q,k] f32, global_docs [Q,k] int64) per segment
+    seg_work = []  # (seg, base, doc_pad, launches, dense)
+    releases = []
     for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
         packed = packed_for(seg, breaker=ctx.breaker("fielddata"))
         ensure_tfn(seg, packed, tfn_tables)
@@ -526,19 +580,70 @@ def _execute_flat_plain(plans: list[FlatPlan], ctx: ShardContext, k: int) -> lis
                 b0, b1 = packed.blocks_for_term(tid)
                 cl.append((b0, b1, w, g, mode == MODE_CONST))
             clause_lists.append(cl)
-        scores, docs, tq, overflow = score_flat_sparse(
-            packed, clause_lists, n_must, msm, coord_tbl, k, simple=simple)
+        launches, overflow, release = launch_flat_sparse(
+            packed, clause_lists, n_must, msm, coord_tbl, k, simple=simple,
+            breaker=ctx.breaker("request"))
+        releases.append(release)
+        dense = None
         if overflow:
-            _dense_fallback(overflow, finals, field_idx, all_fields, caches_stack,
-                            n_must, msm, coord_tbl, packed, seg, k,
-                            scores, docs, tq, build_term_batch, score_term_batch)
+            dense = _launch_dense_fallback(
+                overflow, finals, field_idx, all_fields, caches_stack,
+                n_must, msm, coord_tbl, packed, seg, k)
+        seg_work.append((seg, base, packed.doc_pad, launches, dense))
+    return _PendingFlat(Q=Q, k=k, breaker=ctx.breaker("request"),
+                        seg_work=seg_work, releases=releases)
+
+
+def _merge_flat_plain(pending: _PendingFlat) -> list[TopDocs]:
+    """Merge half: ONE explicit device_get drains every launch of the batch
+    (sparse buckets + dense overflow across all segments), then the pure-host
+    cross-segment top-k merge. This is the only pull on the plain serving
+    path — per-bucket np.asarray pulls would be a transfer per array, which
+    the transfer_guard("disallow") sanitizer rejects."""
+    import jax
+
+    from ..ops.scoring import collect_flat_sparse, finalize_score_result
+
+    Q, k = pending.Q, pending.k
+    refs = []
+    for (_seg, _base, _doc_pad, launches, dense) in pending.seg_work:
+        refs.extend(r for (_sb, r) in launches)
+        if dense is not None:
+            refs.append(dense[1])
+    pulled = iter(jax.device_get(refs) if refs else [])
+    # results are on the host — the borrowed staging arrays are reusable now
+    for release in pending.releases:
+        release()
+    totals = np.zeros(Q, dtype=np.int64)
+    seg_hits = []  # (scores [Q,k] f32, global_docs [Q,k] int64) per segment
+    for (seg, base, doc_pad, launches, dense) in pending.seg_work:
+        sparse_pulled = [next(pulled) for _ in launches]
+        scores, docs, tq = collect_flat_sparse(launches, sparse_pulled, Q, k,
+                                               doc_pad)
+        if dense is not None:
+            sub, _ref = dense
+            # already host arrays — the batch's single device_get pulled them
+            ts, td, tt = next(pulled)
+            res = finalize_score_result(ts, td, tt, doc_pad)
+            kk = res.scores.shape[1]
+            scores[sub, :kk] = res.scores
+            docs[sub, :kk] = res.docs
+            scores[sub, kk:] = -np.inf
+            docs[sub, kk:] = doc_pad
+            tq[sub] = res.total_hits
         totals += tq
-        valid = (docs < min(packed.doc_pad, seg.doc_count)) & np.isfinite(scores)
+        valid = (docs < min(doc_pad, seg.doc_count)) & np.isfinite(scores)
         gdocs = np.where(valid, docs.astype(np.int64) + base, np.int64(2**62))
         seg_hits.append((np.where(valid, scores, -np.inf), gdocs))
+    return _merge_seg_hits(seg_hits, totals, Q, k, breaker=pending.breaker)
 
-    return _merge_seg_hits(seg_hits, totals, Q, k,
-                           breaker=ctx.breaker("request"))
+
+def _execute_flat_plain(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list[TopDocs]:
+    """Run a batch of flat plans through the device kernels: dispatch every
+    segment's launches, then merge per-segment top-k host-side (score desc,
+    global doc asc — Lucene order). Synchronous composition of the
+    dispatch/merge halves the batcher overlaps."""
+    return _dispatch_flat_plain(plans, ctx, k).merge()
 
 
 def _merge_seg_hits(seg_hits, totals, Q: int, k: int,
@@ -599,26 +704,22 @@ def _dense_entries(finals, seg, packed, field_idx) -> list:
     return entries
 
 
-def _dense_fallback(overflow, finals, field_idx, all_fields, caches_stack,
-                    n_must, msm, coord_tbl, packed, seg, k,
-                    scores, docs, tq, build_term_batch, score_term_batch):
-    """Score overflow queries (block count past the sparse planner's tb_max) with the
-    dense scatter kernel; writes results into the sparse output arrays in place."""
+def _launch_dense_fallback(overflow, finals, field_idx, all_fields, caches_stack,
+                           n_must, msm, coord_tbl, packed, seg, k):
+    """Launch overflow queries (block count past the sparse planner's tb_max)
+    on the dense scatter kernel WITHOUT syncing; returns (sub indices, device
+    result triple) for the merge half, or None when no entries resolved."""
+    from ..ops.scoring import build_term_batch, score_term_batch_async
+
     _ensure_norm_rows(packed, all_fields)
     entries = _dense_entries([finals[qi] for qi in overflow], seg, packed, field_idx)
     if not entries:
-        return
+        return None
     sub = np.asarray(overflow, dtype=np.int64)
     batch = build_term_batch(entries, len(overflow), n_must[sub], msm[sub],
                              coord_tbl[sub], list(all_fields), caches_stack,
                              nb_pad_row=packed.blk_docs.shape[0] - 1)
-    res = score_term_batch(packed, batch, k)
-    kk = res.scores.shape[1]
-    scores[sub, :kk] = res.scores
-    docs[sub, :kk] = res.docs
-    scores[sub, kk:] = -np.inf
-    docs[sub, kk:] = packed.doc_pad
-    tq[sub] = res.total_hits
+    return sub, score_term_batch_async(packed, batch, k)
 
 
 _FS_CHUNK = 256  # dense accumulator is O(Q·doc_pad) — bound the launch width

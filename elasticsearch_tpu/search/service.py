@@ -159,6 +159,18 @@ def _device_failed(e: BaseException):
             f"scorer (logged once per error type)")
 
 
+def _execute_flat_single(ctx: ShardContext, plan, k: int,
+                         deadline: Deadline) -> TopDocs:
+    """One plan's device execution — through the node's cross-request
+    DeviceBatcher when one is wired (coalescing with concurrent searches into
+    one bucketed launch; search/batcher.py), else a direct single-plan launch.
+    DFS-stats requests always launch directly: their per-request global stats
+    change clause weights, which a shared batch cannot express."""
+    if ctx.batcher is not None and not ctx.global_stats:
+        return ctx.batcher.execute(plan, ctx, k, deadline=deadline)
+    return execute_flat_batch([plan], ctx, k)[0]
+
+
 def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
                         use_device: bool = True, shard_id: int = 0,
                         deadline: Deadline | None = None) -> ShardQueryResult:
@@ -183,7 +195,7 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
         plan = lower_flat(req.query, ctx) if use_device else None
         if plan is not None:
             try:
-                td = execute_flat_batch([plan], ctx, max(k, 1))[0]
+                td = _execute_flat_single(ctx, plan, max(k, 1), deadline)
             except CircuitBreakingError as e:
                 if getattr(e, "breaker", None) != "fielddata":
                     raise  # request/parent trip: load-shed (429), not degradable
@@ -240,7 +252,7 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
         plan = lower_flat(wrapped, ctx)
         if plan is not None:
             try:
-                td = execute_flat_batch([plan], ctx, max(k, 1))[0]
+                td = _execute_flat_single(ctx, plan, max(k, 1), deadline)
             except CircuitBreakingError as e:
                 if getattr(e, "breaker", None) != "fielddata":
                     raise  # request/parent trip: load-shed (429), not degradable

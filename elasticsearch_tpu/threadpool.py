@@ -36,6 +36,10 @@ Names = (
     # A's primaries hold all index workers waiting on B's replicas and vice versa)
     "replica",
     "search",
+    # the cross-request micro-batching drainer (search/batcher.py) runs here:
+    # one long-lived loop that coalesces queued FlatPlans into bucketed device
+    # launches — a named pool so its liveness shows in /_nodes/stats
+    "search_batcher",
     "suggest",
     "percolate",
     "management",
@@ -54,6 +58,7 @@ _DEFAULT_SIZES = {
     "bulk": 4,
     "replica": 4,
     "search": 8,
+    "search_batcher": 1,
     "suggest": 2,
     "percolate": 2,
     "management": 2,
@@ -76,6 +81,10 @@ _DEFAULT_QUEUES = {
     "replica": 200,
     "search": 1000,
     "get": 1000,
+    # the batcher drainer is one long-lived task — bounding its queue would
+    # reject the drainer itself, never a request (requests queue in the
+    # batcher's own bounded coalescing queue)
+    "search_batcher": -1,
 }
 _DEFAULT_QUEUE_SIZE = 1000
 

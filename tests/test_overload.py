@@ -446,6 +446,10 @@ class TestOverloadChaos:
 class TestRestOverloadStats:
     def test_nodes_stats_exposes_breakers_and_queues(self, tmp_path):
         with _http_cluster(tmp_path, n_docs=5) as (cluster, node, base):
+            # one flat (device-lowerable) search so the batcher counters move
+            st, body, _h = _call(base, "POST", "/overload/_search",
+                                 {"query": {"term": {"tag": "t0"}}})
+            assert st == 200, body
             st, stats, _h = _call(base, "GET", "/_nodes/stats")
             assert st == 200
             node_stats = stats["nodes"][node.node_id]
@@ -466,3 +470,14 @@ class TestRestOverloadStats:
             assert set(node_stats["admission_control"]) == {
                 "observed", "mean_shard_phase_ms", "ewma_shard_phase_ms",
                 "rejected"}
+            # cross-request micro-batching counters (search/batcher.py)
+            batcher = node_stats["search"]["batcher"]
+            for key in ("launches", "coalesced", "occupancy_mean",
+                        "linger_flushes", "deadline_flushes"):
+                assert key in batcher, key
+            # this fixture's searches rode the batcher: the coordinator's
+            # flat query phases coalesce through it even at occupancy 1
+            assert batcher["launches"] >= 1
+            assert batcher["coalesced"] >= batcher["launches"]
+            # the drainer occupies its named pool (visible liveness signal)
+            assert "search_batcher" in node_stats["thread_pool"]

@@ -36,7 +36,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)
 # device hot path; TPU004 at the engine's locking core; TPU005 everywhere in
 # the package except the one sanctioned platform writer.
 HOT_PREFIXES = ("elasticsearch_tpu/ops/", "elasticsearch_tpu/parallel/")
-HOT_FILES = ("elasticsearch_tpu/search/execute.py",)
+HOT_FILES = ("elasticsearch_tpu/search/execute.py",
+             # the cross-request batcher's drainer sits between every serving
+             # request and the device — its dispatch half must stay pull-free
+             "elasticsearch_tpu/search/batcher.py")
 LOCK_PREFIXES = ("elasticsearch_tpu/transport/",)
 LOCK_FILES = ("elasticsearch_tpu/threadpool.py", "elasticsearch_tpu/cluster/service.py")
 PLATFORM_EXEMPT = ("elasticsearch_tpu/common/jaxenv.py",)
