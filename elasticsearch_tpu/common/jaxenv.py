@@ -63,6 +63,9 @@ class SanitizerReport:
 
     compiles: int = 0
     compile_events: list = field(default_factory=list)  # (event_key,) per compile
+    # lock-trace counters (common/locktrace.py) snapshotted on scope exit when
+    # ESTPU_LOCKTRACE=1 armed the tracer; None when the tracer is off
+    locks: dict | None = None
 
     def note(self, key: str) -> None:
         self.compiles += 1
@@ -168,6 +171,10 @@ def sanitize(max_compiles: int | None | object = _UNSET,
             yield report
     finally:
         _counter.unsubscribe(report)
+        from .locktrace import TRACER
+
+        if TRACER.enabled:
+            report.locks = TRACER.snapshot()
     if max_compiles is not None and report.compiles > max_compiles:
         raise CompileBudgetExceeded(
             f"compile budget exceeded: {report.compiles} backend compile(s) "

@@ -1,0 +1,310 @@
+"""Runtime lock-trace sanitizer — the dynamic twin of tpulint TPU004/TPU011.
+
+The static rules prove what the call graph CAN do; this module records what a
+real run actually DID, the lockdep/ThreadSanitizer pairing the repo already
+uses for transfers (tpulint TPU001 <-> transfer_guard) and retraces (TPU002
+<-> compile-budget). Under `ESTPU_LOCKTRACE=1`:
+
+- `threading.Lock` / `threading.RLock` construction is wrapped so every lock
+  CREATED IN THIS REPO (creation site under elasticsearch_tpu/ or tests/ —
+  jax/stdlib internals stay untraced and unperturbed) records per-thread
+  acquisition order. Locks are aggregated by CONSTRUCTION SITE, lockdep's
+  "lock class": every `MemoryCircuitBreaker._lock` is one node, which is also
+  why a child->parent acquisition inside one hierarchy is a self-edge and
+  ignored — instances of one class are layered by construction.
+  `threading.Condition()` is covered transitively (its internal RLock comes
+  from the patched factory).
+- the lock-order graph accumulates over the whole run; `TRACER.check()` (the
+  tests/conftest.py session gate) fails with a LockOrderViolation naming the
+  acquisition sites of every edge on the first cycle found — the ABBA hazard
+  is reported from any interleaving, deadlock never required.
+- `jax.device_get` is wrapped to time pulls performed WHILE HOLDING a traced
+  lock (`held_device_gets` / `held_device_get_max_ms` counters; sites longer
+  than `ESTPU_LOCKTRACE_HELD_MS` land in `TRACER.long_held`) — the runtime
+  form of TPU004's dispatch-under-lock rule.
+
+Overhead is exactly zero when the knob is off: `maybe_install()` returns
+without touching `threading`, and no wrapper exists anywhere on the lock path.
+Counters surface through the existing sanitizer report (jaxenv.sanitize()
+attaches a snapshot to SanitizerReport.locks).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+# saved BEFORE any patching; the tracer's own lock must never trace itself
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+_REPO_MARKERS = (f"{os.sep}elasticsearch_tpu{os.sep}", f"{os.sep}tests{os.sep}")
+_SELF_FILE = os.path.abspath(__file__)
+
+
+class LockOrderViolation(AssertionError):
+    """The runtime lock-order graph contains a cycle — an ABBA deadlock is one
+    unlucky interleaving away. The message names both acquisition sites."""
+
+
+_REL_CACHE: dict = {}
+
+
+def _rel(fn: str) -> str:
+    r = _REL_CACHE.get(fn)
+    if r is None:
+        r = _REL_CACHE[fn] = os.path.relpath(fn)
+    return r
+
+
+def _creation_site() -> str | None:
+    """file:line of the first frame outside this module and threading.py;
+    None (= do not trace) when the lock is created outside the repo."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if fn != _SELF_FILE and f"{os.sep}threading.py" not in fn:
+            if any(m in fn for m in _REPO_MARKERS) or \
+                    "tpulint_fixtures" in fn:
+                return f"{_rel(fn)}:{f.f_lineno}"
+            return None
+        f = f.f_back
+    return None
+
+
+def _acquire_site() -> tuple:
+    """RAW (filename, lineno) — formatting (relpath hits getcwd) is deferred
+    to first-edge-witness time; the per-acquisition cost is the frame walk
+    alone."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if fn != _SELF_FILE and f"{os.sep}threading.py" not in fn:
+            return (fn, f.f_lineno)
+        f = f.f_back
+    return ("<unknown>", 0)
+
+
+def _fmt_site(raw) -> str:
+    fn, line = raw
+    return f"{_rel(fn)}:{line}" if line else fn
+
+
+class LockTracer:
+    """Process-wide recorder: per-thread held stacks + the order graph."""
+
+    def __init__(self):
+        self._glock = _REAL_LOCK()
+        self._tls = threading.local()
+        self.enabled = False
+        self.held_ms_threshold = 0.0
+        # (site_a, site_b) -> (acquire_site_a, acquire_site_b): first witness
+        self.edges: dict = {}
+        self.counters = {
+            "locks_created": 0,
+            "acquisitions": 0,
+            "edges": 0,
+            "held_device_gets": 0,
+            "held_device_get_max_ms": 0.0,
+        }
+        self.long_held: list = []  # (lock_site, ms, what) above the threshold
+
+    # -- per-thread stack -----------------------------------------------------
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    # -- recording ------------------------------------------------------------
+    def on_created(self) -> None:
+        with self._glock:
+            self.counters["locks_created"] += 1
+
+    def on_acquired(self, lock_site: str, acq_raw: tuple) -> None:
+        st = self._stack()
+        with self._glock:
+            self.counters["acquisitions"] += 1
+            if st:
+                outer_site, outer_raw = st[-1]
+                if outer_site != lock_site:  # self-edge = layered instances/RLock
+                    key = (outer_site, lock_site)
+                    if key not in self.edges:
+                        # first witness of this edge: only now pay relpath
+                        self.edges[key] = (_fmt_site(outer_raw),
+                                           _fmt_site(acq_raw))
+                        self.counters["edges"] += 1
+        st.append((lock_site, acq_raw))
+
+    def on_released(self, lock_site: str) -> None:
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):  # out-of-order release tolerated
+            if st[i][0] == lock_site:
+                del st[i]
+                return
+
+    def held(self) -> list:
+        return [site for site, _acq in self._stack()]
+
+    def note_held_dispatch(self, duration_s: float, what: str) -> None:
+        st = self._stack()
+        if not st:
+            return
+        ms = duration_s * 1000.0
+        with self._glock:
+            self.counters["held_device_gets"] += 1
+            self.counters["held_device_get_max_ms"] = max(
+                self.counters["held_device_get_max_ms"], round(ms, 3))
+            if self.held_ms_threshold and ms > self.held_ms_threshold:
+                self.long_held.append((st[-1][0], round(ms, 3), what))
+
+    # -- the gate -------------------------------------------------------------
+    def find_cycle(self) -> list | None:
+        """A list of (a, b, acq_a, acq_b) edges forming a cycle, or None."""
+        with self._glock:
+            graph: dict = {}
+            for (a, b) in self.edges:
+                graph.setdefault(a, set()).add(b)
+            edges = dict(self.edges)
+        state: dict = {}  # 0 visiting, 1 done
+        path: list = []
+
+        def dfs(v):
+            state[v] = 0
+            path.append(v)
+            for w in sorted(graph.get(v, ())):
+                if state.get(w) == 0:
+                    cyc = path[path.index(w):] + [w]
+                    return [(a, b, *edges[(a, b)])
+                            for a, b in zip(cyc, cyc[1:])]
+                if w not in state:
+                    found = dfs(w)
+                    if found:
+                        return found
+            path.pop()
+            state[v] = 1
+            return None
+
+        for v in sorted(graph):
+            if v not in state:
+                found = dfs(v)
+                if found:
+                    return found
+        return None
+
+    def check(self) -> None:
+        cyc = self.find_cycle()
+        if cyc:
+            lines = [f"  `{a}` then `{b}`  (acquired at {acq_a} -> {acq_b})"
+                     for (a, b, acq_a, acq_b) in cyc]
+            raise LockOrderViolation(
+                "lock-order cycle observed at runtime — an ABBA deadlock is "
+                "one interleaving away:\n" + "\n".join(lines) +
+                "\npick one global acquisition order (tpulint TPU004 is the "
+                "static twin of this check)")
+
+    def snapshot(self) -> dict:
+        with self._glock:
+            return {**self.counters, "long_held": list(self.long_held)}
+
+
+TRACER = LockTracer()
+
+
+class _TracedLock:
+    """Delegating wrapper for Lock/RLock objects created in repo code."""
+
+    __slots__ = ("_inner", "_site")
+
+    def __init__(self, inner, site: str):
+        self._inner = inner
+        self._site = site
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            TRACER.on_acquired(self._site, _acquire_site())
+        return ok
+
+    def release(self):
+        TRACER.on_released(self._site)
+        self._inner.release()
+
+    def __enter__(self):
+        self._inner.acquire()
+        TRACER.on_acquired(self._site, _acquire_site())
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __getattr__(self, name):
+        # RLock internals Condition needs (_is_owned/_acquire_restore/
+        # _release_save) delegate straight to the real lock: the wait-side
+        # release/reacquire dance is internal to one condition and is not an
+        # ordering event
+        return getattr(self._inner, name)
+
+
+def _traced_lock_factory():
+    site = _creation_site()
+    if site is None:
+        return _REAL_LOCK()
+    TRACER.on_created()
+    return _TracedLock(_REAL_LOCK(), site)
+
+
+def _traced_rlock_factory():
+    site = _creation_site()
+    if site is None:
+        return _REAL_RLOCK()
+    TRACER.on_created()
+    return _TracedLock(_REAL_RLOCK(), site)
+
+
+def _wrap_device_get() -> None:
+    if "jax" not in sys.modules:
+        return
+    import jax
+
+    real = jax.device_get
+    if getattr(real, "_estpu_locktrace", False):
+        return
+
+    def device_get(x):
+        t0 = time.perf_counter()
+        try:
+            return real(x)
+        finally:
+            TRACER.note_held_dispatch(time.perf_counter() - t0,
+                                      "jax.device_get")
+
+    device_get._estpu_locktrace = True
+    jax.device_get = device_get
+
+
+def install(held_ms_threshold: float | None = None) -> LockTracer:
+    """Arm the tracer (idempotent). Prefer maybe_install() — the env knob."""
+    if not TRACER.enabled:
+        TRACER.enabled = True
+        threading.Lock = _traced_lock_factory
+        threading.RLock = _traced_rlock_factory
+    if held_ms_threshold is not None:
+        TRACER.held_ms_threshold = float(held_ms_threshold)
+    _wrap_device_get()
+    return TRACER
+
+
+def maybe_install() -> LockTracer | None:
+    """Install iff ESTPU_LOCKTRACE=1 (same env-knob conventions as
+    ESTPU_SANITIZE / ESTPU_COMPILE_BUDGET). Threshold for long-held dispatch
+    reporting: ESTPU_LOCKTRACE_HELD_MS (float ms; unset/0 = record only)."""
+    if os.environ.get("ESTPU_LOCKTRACE", "") not in ("1", "on", "true"):
+        return None
+    return install(float(os.environ.get("ESTPU_LOCKTRACE_HELD_MS", "0") or 0))

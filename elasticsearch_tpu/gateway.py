@@ -63,7 +63,13 @@ class LocalGateway:
 
     # recovery ---------------------------------------------------------------
     def maybe_recover(self):
-        """Master-side: restore persisted metadata once enough nodes joined."""
+        """Master-side: restore persisted metadata once enough nodes joined.
+
+        The lock covers only the recovered-check and the task SUBMISSION; the
+        wait on the cluster-state thread happens with no lock held (tpulint
+        TPU011) — blocking on the state thread while holding `_lock` couples
+        two executors, and any state task that re-entered the gateway (a
+        metadata-change listener calling back in) would deadlock."""
         with self._lock:
             if self._recovered:
                 return
@@ -101,11 +107,11 @@ class LocalGateway:
                 return new
 
             fut = self.cluster_service.submit_state_update_task("gateway-recovery", update)
-            fut.result(10)
-            # allocation of restored shards happens via the normal reroute path
-            self.cluster_service.submit_state_update_task(
-                "gateway-post-recovery-reroute",
-                lambda s: _reroute(s))
+        fut.result(10)
+        # allocation of restored shards happens via the normal reroute path
+        self.cluster_service.submit_state_update_task(
+            "gateway-post-recovery-reroute",
+            lambda s: _reroute(s))
 
 
 def _reroute(state: ClusterState) -> ClusterState:

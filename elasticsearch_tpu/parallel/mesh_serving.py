@@ -17,6 +17,7 @@ live version moves (NRT refresh / merges / deletes)."""
 from __future__ import annotations
 
 import threading
+from concurrent.futures import Future
 
 import numpy as np
 
@@ -49,21 +50,27 @@ class MeshServingService:
         self._lock = threading.Lock()
         self._meshes: dict[int, object] = {}
         self._executors: dict = {}  # index -> (freshness_key, executor dict)
+        # index -> (freshness_key, svc, Future) for a repack in flight: racers
+        # park on the future with NO lock held instead of serializing every
+        # search on the node behind a multi-second device_put (tpulint TPU004)
+        self._building: dict = {}
 
     # ------------------------------------------------------------------
     def _mesh_for(self, n_shards: int):
         import jax
 
-        mesh = self._meshes.get(n_shards)
-        if mesh is None:
-            devices = jax.devices()
-            if len(devices) < n_shards:
-                return None
-            from jax.sharding import Mesh
+        with self._lock:
+            mesh = self._meshes.get(n_shards)
+        if mesh is not None:
+            return mesh
+        devices = jax.devices()
+        if len(devices) < n_shards:
+            return None
+        from jax.sharding import Mesh
 
-            mesh = Mesh(np.array(devices[:n_shards]), ("shards",))
-            self._meshes[n_shards] = mesh
-        return mesh
+        mesh = Mesh(np.array(devices[:n_shards]), ("shards",))
+        with self._lock:
+            return self._meshes.setdefault(n_shards, mesh)
 
     def _eligible(self, state, local_node_id, indices, alias_filters, shards,
                   req: ParsedSearchRequest):
@@ -506,7 +513,13 @@ class MeshServingService:
     def _executor_for(self, index: str, svc, searchers, kind, default_sim,
                       use_global_stats: bool):
         """Build-or-reuse the ShardedIndex + executor; rebuilt when any shard's
-        segments or tombstones moved."""
+        segments or tombstones moved.
+
+        The multi-second device repack runs with NO lock held (tpulint TPU004:
+        device dispatch under `self._lock` would serialize every search on the
+        node — not just this index — behind the pack). Racing searches dedup
+        on an in-flight build future: exactly one thread packs, the rest park
+        on the future lock-free (tpulint TPU011)."""
         freshness = tuple(
             (tuple(seg.gen for seg in s.segments),
              tuple(seg.live_gen for seg in s.segments),
@@ -519,28 +532,65 @@ class MeshServingService:
                 execs = cached[2]
                 if execs is None:
                     return None  # negative cache: this generation failed to build
+                return execs[use_global_stats]
+            inflight = self._building.get(index)
+            if inflight is not None and inflight[0] == freshness \
+                    and inflight[1] is svc:
+                fut = inflight[2]
+                builder = False
             else:
-                mesh = self._mesh_for(len(searchers))
-                if mesh is None:
-                    return None
-                fields = sorted({f for s in searchers for seg in s.segments
-                                 for f in seg.norms})
-                if not fields:
-                    return None
-                try:
-                    sharded = build_sharded_index(searchers, fields, mesh=mesh)
-                    execs = {}
-                    for gs in (False, True):
-                        execs[gs] = MeshSearchExecutor(
-                            sharded, mesh, similarity=kind,
-                            k1=getattr(default_sim, "k1", 1.2),
-                            b=getattr(default_sim, "b", 0.75),
-                            use_global_stats=gs)
-                except Exception as e:  # noqa: BLE001 — e.g. device OOM on pack
-                    # negative-cache the failure so every search doesn't re-pay a
-                    # doomed multi-second repack under the lock
-                    self._executors[index] = (freshness, svc, None)
-                    self.logger.warning(f"mesh index build failed for [{index}]: {e}")
-                    return None
-                self._executors[index] = (freshness, svc, execs)
-            return execs[use_global_stats]
+                fut = Future()
+                self._building[index] = (freshness, svc, fut)
+                builder = True
+        if not builder:
+            try:
+                execs = fut.result(timeout=120.0)
+            except Exception as e:  # noqa: BLE001 — builder wedged/timed out
+                # loud, unlike an ineligible search: every deduped waiter is
+                # degrading to the transport path because the BUILDER is stuck
+                self.logger.warning(
+                    f"mesh executor build wait failed for [{index}] "
+                    f"({type(e).__name__}: {e}); serving via transport path")
+                return None
+            return None if execs is None else execs[use_global_stats]
+        execs = None
+        try:
+            execs = self._build_executors(searchers, kind, default_sim)
+        except Exception as e:  # noqa: BLE001 — e.g. device OOM on pack
+            # negative-cache the failure so every search doesn't re-pay a
+            # doomed multi-second repack
+            self.logger.warning(f"mesh index build failed for [{index}]: {e}")
+        finally:
+            # publish cache + clear the in-flight entry ONLY if this build is
+            # still the current one: a refresh mid-pack lets a NEWER freshness
+            # register its own build, and a stale finally must not clobber its
+            # cache entry or pop its in-flight dedup record
+            with self._lock:
+                inflight = self._building.get(index)
+                if inflight is not None and inflight[2] is fut:
+                    self._executors[index] = (freshness, svc, execs)
+                    self._building.pop(index, None)
+            # but ALWAYS resolve: this generation's waiters park on this
+            # future whether or not it is still the freshest
+            fut.set_result(execs)
+        return None if execs is None else execs[use_global_stats]
+
+    def _build_executors(self, searchers, kind, default_sim):
+        """The device-side pack: ShardedIndex + one executor per stats mode.
+        Called with no lock held; returns None when the mesh can't serve."""
+        mesh = self._mesh_for(len(searchers))
+        if mesh is None:
+            return None
+        fields = sorted({f for s in searchers for seg in s.segments
+                         for f in seg.norms})
+        if not fields:
+            return None
+        sharded = build_sharded_index(searchers, fields, mesh=mesh)
+        execs = {}
+        for gs in (False, True):
+            execs[gs] = MeshSearchExecutor(
+                sharded, mesh, similarity=kind,
+                k1=getattr(default_sim, "k1", 1.2),
+                b=getattr(default_sim, "b", 0.75),
+                use_global_stats=gs)
+        return execs

@@ -33,6 +33,10 @@ Flush policy — whichever fires first:
                   time) — flushing early leaves budget for the device launch
                   AND the host merge, so PR-3 timeout semantics survive
                   coalescing
+  * pending     : a dispatched batch is waiting to be merged — lingering
+                  would hold its answered futures hostage to the NEXT batch's
+                  linger window; with the device already busy, waiting buys
+                  no occupancy, so the queue flushes immediately
 
 Double buffering: the drainer dispatches batch N+1 BEFORE merging batch N, so
 batch N's host merge overlaps batch N+1's device compute. The dispatch half
@@ -203,6 +207,7 @@ class DeviceBatcher:
         self._full_flushes = 0
         self._linger_flushes = 0
         self._deadline_flushes = 0
+        self._pending_flushes = 0  # flushed early because a merge was waiting
         self._bypassed = 0  # queue full / disabled / drainer dead -> inline
         self._splits = 0  # coalesced launch failed -> per-item replay
         self._flat = _FlatFamily()
@@ -300,7 +305,7 @@ class DeviceBatcher:
                         break  # merge the in-flight batch instead of idling
                     self._cv.wait(0.1)
                 if self._queue and not self._shutdown:
-                    batch = self._collect_locked()
+                    batch = self._collect_locked(urgent=pending is not None)
             if batch is None:
                 if pending is not None:
                     self._finish(*pending)
@@ -334,10 +339,16 @@ class DeviceBatcher:
         self._fail_queued(RejectedExecutionError(
             "search batcher is shut down"))
 
-    def _collect_locked(self):
+    def _collect_locked(self, urgent: bool = False):
         """Pick the oldest item's key and wait (under the condition) until a
         flush trigger fires; pops and returns (items, reason). Called with
-        the condition held; may release it while waiting."""
+        the condition held; may release it while waiting.
+
+        `urgent` means a dispatched batch is waiting to be MERGED: lingering
+        here would hold batch N's answered futures hostage to batch N+1's
+        linger window (the drainer's merge-delay bug, PR 6). Take whatever is
+        queued immediately — the device is busy anyway, so the linger's
+        latency-for-occupancy trade buys nothing."""
         head = self._queue[0]
         key = head.key
         while True:
@@ -345,6 +356,9 @@ class DeviceBatcher:
             n = len(same)
             if n >= self.max_batch:
                 reason = "full"
+                break
+            if urgent:
+                reason = "pending"
                 break
             now = time.monotonic()
             # adaptive linger: shrinks linearly as the queue fills — waiting
@@ -415,6 +429,8 @@ class DeviceBatcher:
                 self._full_flushes += 1
             elif reason == "deadline":
                 self._deadline_flushes += 1
+            elif reason == "pending":
+                self._pending_flushes += 1
             else:
                 self._linger_flushes += 1
 
@@ -442,6 +458,7 @@ class DeviceBatcher:
                 "full_flushes": self._full_flushes,
                 "linger_flushes": self._linger_flushes,
                 "deadline_flushes": self._deadline_flushes,
+                "pending_flushes": self._pending_flushes,
                 "bypassed": self._bypassed,
                 "splits": self._splits,
                 "queue": len(self._queue),

@@ -5,11 +5,28 @@ in-JVM TestCluster — SURVEY.md §4.2; we test multi-chip sharding with virtual
 devices). Must be set before jax is imported anywhere.
 """
 
+# Lock-trace sanitizer (common/locktrace.py), the runtime twin of the tpulint
+# concurrency family: under ESTPU_LOCKTRACE=1 every repo-constructed
+# threading.Lock/RLock records per-thread acquisition order and device pulls
+# timed under a held lock; the session gate below fails the run on any
+# lock-order cycle. Off by default — maybe_install() is a no-op then, so the
+# recorder costs exactly nothing (same env-knob conventions as ESTPU_SANITIZE).
+# Installed FIRST — before jaxenv is imported — so even module-import-time
+# locks (jaxenv's _CompileCounter._lock) are constructed through the patched
+# factory and participate in the order graph.
+from elasticsearch_tpu.common.locktrace import TRACER, maybe_install
+
+maybe_install()
+
 from elasticsearch_tpu.common.jaxenv import force_cpu_platform
 
 # Hard-override: the container env pins a real-TPU JAX platform and jax is already
 # imported at interpreter startup by a sitecustomize hook — see jaxenv.py.
 force_cpu_platform(n_devices=8)
+
+# second call: now that jax is imported, the device_get timing wrapper can arm
+# (the first call ran pre-jax so the threading patch covered all repo locks)
+maybe_install()
 
 import numpy as np
 import pytest
@@ -35,6 +52,16 @@ _SANITIZED_MODULES = {
     "test_parallel_search",
     "test_mesh_serving",
 }
+
+
+@pytest.fixture(scope="session", autouse=True)
+def lock_order_gate():
+    """With ESTPU_LOCKTRACE=1, fail the run if the whole-session lock-order
+    graph ever grew a cycle (TRACER.check raises LockOrderViolation naming
+    both acquisition sites)."""
+    yield
+    if TRACER.enabled:
+        TRACER.check()
 
 
 @pytest.fixture(autouse=True)

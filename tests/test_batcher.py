@@ -376,3 +376,49 @@ class TestSanitized:
         findings = [f for f in lint_paths(None)
                     if f.path == "elasticsearch_tpu/search/batcher.py"]
         assert findings == [], [f.to_dict() for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# pending-merge flush (PR 6): batch N's merge must not wait out batch N+1's
+# linger window
+# ---------------------------------------------------------------------------
+
+
+class TestPendingMergeFlush:
+    def test_merge_not_delayed_by_next_batch_linger(self, shard_ctx):
+        """With batch N dispatched and awaiting its merge, the collector must
+        flush the queue IMMEDIATELY (reason `pending`) instead of lingering
+        for batch N+1 — before the fix, batch N's already-answered futures
+        waited out the full linger window behind the next batch's collect.
+
+        Giant linger (1.5 s floor 1.2 s) makes the two behaviors unambiguous:
+        old code cannot finish 3 requests under ~1.2 s, fixed code finishes in
+        launch time. The pending window depends on thread scheduling, so the
+        attempt retries; the old behavior can never pass any attempt (a lone
+        third item always pays the full linger)."""
+        from elasticsearch_tpu.search.execute import execute_flat_batch
+
+        b = make_batcher(**{"search.batch.linger_ms": 1500,
+                            "search.batch.min_linger_ms": 1200,
+                            "search.batch.max_batch": 2})
+        try:
+            texts = ["quick brown", "lazy dog", "red bear"]
+            plans = [plan_for(shard_ctx, t) for t in texts]
+            # warm BOTH drainer shapes (Q=2 batch, Q=1 batch) at the k bucket
+            # the batcher will use, so the timed runs measure flush policy,
+            # not XLA compiles
+            kb = _k_bucket(10)
+            execute_flat_batch(plans[:2], shard_ctx, kb)
+            execute_flat_batch(plans[2:], shard_ctx, kb)
+            ok = False
+            for _attempt in range(3):
+                t0 = time.monotonic()
+                out = run_concurrent(b, shard_ctx, texts)
+                elapsed = time.monotonic() - t0
+                assert all(td is not None for td in out)
+                if elapsed < 0.8 and b.stats()["pending_flushes"] >= 1:
+                    ok = True
+                    break
+            assert ok, (elapsed, b.stats())
+        finally:
+            b.shutdown()

@@ -403,3 +403,62 @@ class TestSidecars:
         finally:
             for n in nodes:
                 n.close()
+
+
+class TestGatewayLockDiscipline:
+    def test_recovery_waits_on_state_thread_outside_its_lock(self, tmp_path):
+        """PR-6 TPU011 fix: maybe_recover must submit the recovery task under
+        LocalGateway._lock but WAIT for it with the lock released — blocking
+        on the cluster-state thread while holding the lock couples the two
+        executors (any state task re-entering the gateway deadlocks), and
+        every other gateway caller convoys behind a 10 s result() wait."""
+        import json as _json
+        import threading
+        import time
+        from concurrent.futures import Future
+
+        from elasticsearch_tpu.cluster.state import (
+            ClusterState, DiscoveryNode, DiscoveryNodes, IndexMetaData,
+            MetaData)
+        from elasticsearch_tpu.gateway import LocalGateway
+
+        node = DiscoveryNode("n1", "n1", "local[gw]")
+        state = ClusterState(nodes=DiscoveryNodes(
+            nodes=(node,), master_id="n1", local_id="n1"))
+
+        class StubClusterService:
+            def __init__(self):
+                self.state = state
+                self.submissions = []
+
+            def add_listener(self, listener):
+                pass
+
+            def submit_state_update_task(self, source, fn, priority=2):
+                fut = Future()
+                self.submissions.append((source, fn, fut))
+                return fut
+
+        cs = StubClusterService()
+        gw = LocalGateway(str(tmp_path), cs, node_name="n1")
+        meta = MetaData(indices=(("idx", IndexMetaData("idx")),))
+        with open(gw.meta_path, "w") as fh:
+            _json.dump(meta.to_dict(), fh)
+
+        t = threading.Thread(target=gw.maybe_recover)
+        t.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while not cs.submissions and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert cs.submissions, "recovery task never submitted"
+            # the result() wait is in flight NOW — the lock must be free
+            acquired = gw._lock.acquire(timeout=2.0)
+            assert acquired, "maybe_recover blocks on the future holding _lock"
+            gw._lock.release()
+        finally:
+            cs.submissions[0][2].set_result(state)
+            t.join(5.0)
+        assert not t.is_alive()
+        assert [s for s, _fn, _fut in cs.submissions] == [
+            "gateway-recovery", "gateway-post-recovery-reroute"]

@@ -1,0 +1,184 @@
+"""Runtime lock-trace sanitizer (common/locktrace.py).
+
+The dynamic twin of the tpulint concurrency family: under ESTPU_LOCKTRACE=1,
+repo-constructed locks record per-thread acquisition order and device pulls
+timed under a held lock. Covered here:
+
+- the recorder costs exactly ZERO when the env knob is off (threading.Lock is
+  the pristine factory, no wrapper anywhere);
+- the ABBA deadlock fixture (tests/tpulint_fixtures/tp_abba_deadlock.py —
+  ALSO a TPU004 static tp fixture) fails under ESTPU_LOCKTRACE=1 with a cycle
+  report naming both acquisition sites, WITHOUT ever deadlocking, and passes
+  once the acquisition order is fixed;
+- a warmed serving loop through the DeviceBatcher records real lock traffic
+  but no lock held across jax.device_get longer than the configured
+  threshold, and no order cycle (the subprocess driver at the bottom of this
+  file).
+
+Subprocesses are used wherever the tracer must be armed: installing it
+patches threading.Lock process-wide, which must never leak into the rest of
+the suite.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "tpulint_fixtures", "tp_abba_deadlock.py")
+
+
+def _marked_lines(path):
+    with open(path, encoding="utf-8") as f:
+        return [i for i, ln in enumerate(f.read().splitlines(), 1)
+                if "# TP" in ln]
+
+
+def _run(args, env_extra=None, timeout=120):
+    env = {**os.environ, **(env_extra or {})}
+    env.pop("ESTPU_LOCKTRACE", None)
+    env.update(env_extra or {})
+    return subprocess.run([sys.executable, *args], capture_output=True,
+                          text=True, cwd=REPO, timeout=timeout, env=env)
+
+
+# ---------------------------------------------------------------------------
+# env knob off: zero overhead, nothing patched
+# ---------------------------------------------------------------------------
+
+
+def test_overhead_zero_when_knob_off():
+    """Importing locktrace must patch NOTHING by itself; with the knob unset,
+    maybe_install is a no-op and threading.Lock stays the pristine factory.
+    (When the suite itself runs under ESTPU_LOCKTRACE=1 — the acceptance mode
+    — the tracer is armed instead, and the session gate checks the graph.)"""
+    from elasticsearch_tpu.common import locktrace
+
+    if os.environ.get("ESTPU_LOCKTRACE", "") in ("1", "on", "true"):
+        assert locktrace.TRACER.enabled
+        assert threading.Lock is locktrace._traced_lock_factory
+        return
+    assert locktrace.maybe_install() is None
+    assert not locktrace.TRACER.enabled
+    assert threading.Lock is locktrace._REAL_LOCK
+    assert threading.RLock is locktrace._REAL_RLOCK
+    # a lock constructed now is the raw primitive, no delegation layer
+    assert type(threading.Lock()) is type(locktrace._REAL_LOCK())
+
+
+def test_fixture_runs_clean_without_the_knob():
+    res = _run([FIXTURE, "abba"])
+    assert res.returncode == 0, res.stderr
+
+
+# ---------------------------------------------------------------------------
+# the ABBA deadlock fixture under the tracer
+# ---------------------------------------------------------------------------
+
+
+def test_abba_fails_with_cycle_report_naming_both_sites():
+    """Two threads take (a then b) and (b then a) SEQUENTIALLY — no deadlock
+    ever happens, the order graph alone proves the hazard (lockdep's trick).
+    The report must name both inner acquisition sites by file:line."""
+    res = _run([FIXTURE, "abba"], {"ESTPU_LOCKTRACE": "1"})
+    assert res.returncode != 0
+    assert "LockOrderViolation" in res.stderr
+    assert "lock-order cycle" in res.stderr
+    for line_no in _marked_lines(FIXTURE):
+        assert f"tp_abba_deadlock.py:{line_no}" in res.stderr, \
+            (line_no, res.stderr)
+
+
+def test_fixed_order_passes_under_the_tracer():
+    res = _run([FIXTURE, "fixed"], {"ESTPU_LOCKTRACE": "1"})
+    assert res.returncode == 0, res.stderr
+
+
+# ---------------------------------------------------------------------------
+# warmed serving loop under the batcher: no lock held across device_get
+# ---------------------------------------------------------------------------
+
+
+def test_warmed_serving_loop_holds_no_lock_across_device_get():
+    """Drive concurrent searches through the DeviceBatcher with the tracer
+    armed and a 250 ms held-dispatch threshold: real lock traffic must be
+    recorded, the order graph must stay acyclic, and no traced lock may be
+    held across a jax.device_get longer than the threshold (PR-5's contract:
+    the drainer's dispatch half never pulls; the merge half pulls with no
+    lock held)."""
+    res = _run(["-m", "tests.test_locktrace"],
+               {"ESTPU_LOCKTRACE": "1", "ESTPU_LOCKTRACE_HELD_MS": "250"},
+               timeout=420)
+    assert res.returncode == 0, res.stdout + res.stderr
+    snap = json.loads(res.stdout.splitlines()[-1])
+    assert snap["locks_created"] > 0
+    assert snap["acquisitions"] > 0
+    assert snap["long_held"] == [], snap["long_held"]
+
+
+def _serving_driver() -> int:
+    from elasticsearch_tpu.common.jaxenv import force_cpu_platform
+
+    force_cpu_platform(n_devices=1)
+
+    from elasticsearch_tpu.common.locktrace import TRACER, maybe_install
+
+    maybe_install()
+    assert TRACER.enabled, "driver requires ESTPU_LOCKTRACE=1"
+
+    import tempfile
+
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.index import Engine
+    from elasticsearch_tpu.mapper import MapperService
+    from elasticsearch_tpu.search import ShardContext, parse_query
+    from elasticsearch_tpu.search.batcher import DeviceBatcher
+    from elasticsearch_tpu.search.execute import lower_flat
+    from elasticsearch_tpu.search.similarity import SimilarityService
+
+    words = ["quick", "brown", "fox", "lazy", "dog", "summer", "red", "bear"]
+    settings = Settings.from_flat({})
+    svc = MapperService(settings)
+    with tempfile.TemporaryDirectory() as td:
+        engine = Engine(os.path.join(td, "shard0"), svc)
+        for i in range(48):
+            engine.index("doc", str(i), {
+                "body": f"{words[i % 8]} {words[(i + 1) % 8]} {words[(i + 3) % 8]}"})
+        engine.refresh()
+        ctx = ShardContext(engine.acquire_searcher(), svc,
+                           SimilarityService(settings, mapper_service=svc))
+        batcher = DeviceBatcher(Settings.from_flat(
+            {"search.batch.linger_ms": "2", "search.batch.max_batch": "8"}))
+        try:
+            texts = [f"{a} {b}" for a in words[:4] for b in words[4:]]
+            plans = {t: lower_flat(parse_query({"match": {"body": t}}), ctx)
+                     for t in texts}
+            # warm both the lone-request and the coalesced shapes
+            batcher.execute(plans[texts[0]], ctx, 10)
+
+            def worker(t):
+                td_ = batcher.execute(plans[t], ctx, 10)
+                assert td_ is not None
+
+            for _round in range(3):
+                threads = [threading.Thread(target=worker, args=(t,))
+                           for t in texts[:8]]
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join(60)
+        finally:
+            batcher.shutdown()
+        engine.close()
+
+    TRACER.check()  # any runtime lock-order cycle fails the driver
+    snap = TRACER.snapshot()
+    assert snap["acquisitions"] > 0, snap
+    print(json.dumps(snap))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_serving_driver())

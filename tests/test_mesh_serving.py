@@ -341,3 +341,108 @@ class TestMeshServingRound5:
         assert mesh["hits"]["total"] == transport["hits"]["total"]
         assert [h["_id"] for h in mesh["hits"]["hits"]] == \
             [h["_id"] for h in transport["hits"]["hits"]]
+
+
+class TestRepackLockDiscipline:
+    def test_repack_runs_outside_the_service_lock_and_racers_dedup(self, node,
+                                                                   monkeypatch):
+        """PR-6 TPU004 fix: the device repack (build_sharded_index +
+        executor construction) must run with MeshServingService._lock
+        RELEASED — under the lock it serialized every search on the node
+        behind a multi-second pack — and concurrent searches racing the same
+        rebuild must dedup onto ONE in-flight build (the rest park on its
+        future, lock-free)."""
+        import threading
+        import time
+
+        from elasticsearch_tpu.parallel import mesh_serving as ms_mod
+
+        n, client = node
+        ms = n.actions.mesh_serving
+        real_build = ms_mod.build_sharded_index
+        calls = []
+        lock_free = []
+
+        def spy(*args, **kwargs):
+            calls.append(1)
+            # timed acquire, NOT a non-blocking probe: a racing search thread
+            # legitimately holds _lock for microseconds inside its own cache
+            # check, which a blocking=False probe conflates with the bug. The
+            # bug shape is the BUILDER thread holding the non-reentrant lock
+            # across this whole call — then this same-thread acquire times out.
+            got = ms._lock.acquire(timeout=2.0)
+            if got:
+                ms._lock.release()
+            lock_free.append(got)
+            time.sleep(0.3)  # widen the race window for the dedup half
+            return real_build(*args, **kwargs)
+
+        monkeypatch.setattr(ms_mod, "build_sharded_index", spy)
+        with ms._lock:
+            ms._executors.clear()  # force a rebuild on the next search
+            ms._building.clear()
+
+        body = {"query": {"match": {"body": "alpha"}}, "size": 5}
+        results = []
+
+        def run():
+            results.append(client.search("library", body))
+
+        threads = [threading.Thread(target=run) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60.0)
+        assert len(results) == 3
+        assert all(r["hits"]["total"] > 0 for r in results)
+        assert lock_free and all(lock_free), \
+            "repack ran while holding MeshServingService._lock"
+        assert len(calls) == 1, f"racers did not dedup: {len(calls)} builds"
+
+    def test_stale_builder_does_not_clobber_newer_build(self, monkeypatch):
+        """A refresh mid-pack lets a NEWER freshness register its own build;
+        the stale builder's cleanup must neither overwrite the newer cache
+        entry nor pop the newer in-flight record — but its own waiters still
+        get answered. (Code-review finding on the PR-6 fix.)"""
+        import threading
+
+        from elasticsearch_tpu.common.settings import Settings
+        from elasticsearch_tpu.parallel.mesh_serving import MeshServingService
+
+        class FakeSearcher:
+            def __init__(self, max_doc):
+                self.segments = []
+                self.max_doc = max_doc
+
+        ms = MeshServingService(None, Settings.from_flat({}))
+        svc = object()
+        builds = []
+        stale_started = threading.Event()
+        release_stale = threading.Event()
+
+        def fake_build(searchers, kind, default_sim):
+            builds.append(searchers[0].max_doc)
+            if searchers[0].max_doc == 1:  # the stale generation
+                stale_started.set()
+                assert release_stale.wait(10.0)
+                return {False: "OLD", True: "OLD"}
+            return {False: "NEW", True: "NEW"}
+
+        monkeypatch.setattr(ms, "_build_executors", fake_build)
+        out = {}
+        t = threading.Thread(target=lambda: out.__setitem__(
+            "stale", ms._executor_for("idx", svc, [FakeSearcher(1)],
+                                      "bm25", None, False)))
+        t.start()
+        assert stale_started.wait(10.0)
+        # a newer freshness registers AND completes while the stale pack runs
+        assert ms._executor_for("idx", svc, [FakeSearcher(2)],
+                                "bm25", None, False) == "NEW"
+        release_stale.set()
+        t.join(10.0)
+        assert out["stale"] == "OLD"  # stale waiters still answered
+        # the newer cache entry survived the stale finally: no third build
+        assert ms._executor_for("idx", svc, [FakeSearcher(2)],
+                                "bm25", None, False) == "NEW"
+        assert builds == [1, 2], builds
+        assert ms._building == {}

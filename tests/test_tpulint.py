@@ -27,7 +27,8 @@ from tools.tpulint.engine import diff_baseline, parse_file  # noqa: E402
 
 FIXDIR = os.path.join(REPO, "tests", "tpulint_fixtures")
 RULES = ["TPU001", "TPU002", "TPU003", "TPU004", "TPU005",
-         "TPU006", "TPU007", "TPU008", "TPU009", "TPU010"]
+         "TPU006", "TPU007", "TPU008", "TPU009", "TPU010",
+         "TPU011", "TPU012", "TPU013"]
 
 
 def _marked_lines(path: str) -> set:
@@ -121,6 +122,31 @@ def test_interproc_cross_module_tracer_leak():
     both = [f for f in lint_paths([helper, root]) if f.rule == "TPU003"]
     assert [(f.path.rsplit("/", 1)[-1], f.line) for f in both] == \
         [("tp_xmod_tpu003_helper.py", 17)], [f.to_dict() for f in both]
+
+
+def test_interproc_lock_order_cycle_cross_module():
+    """TPU004 across modules: the root holds a lock and calls a helper module
+    whose function dispatches to the device. The helper alone is silent (no
+    lock held there); linted together, the call site in the root is flagged
+    AND the helper's dispatch line (its meet-over-call-sites context is the
+    root's lock)."""
+    helper = os.path.join(FIXDIR, "tp_xmod_tpu004_helper.py")
+    root = os.path.join(FIXDIR, "tp_xmod_tpu004_root.py")
+    assert [f for f in lint_paths([helper]) if f.rule == "TPU004"] == []
+    both = [f for f in lint_paths([helper, root]) if f.rule == "TPU004"]
+    got = sorted((f.path.rsplit("/", 1)[-1], f.line) for f in both)
+    assert got == [("tp_xmod_tpu004_helper.py", 13),
+                   ("tp_xmod_tpu004_root.py", 20)], \
+        [f.to_dict() for f in both]
+
+
+def test_abba_fixture_is_a_tpu004_true_positive():
+    """The runnable ABBA deadlock fixture (tests/test_locktrace.py drives it
+    under ESTPU_LOCKTRACE=1) is ALSO flagged statically: both inner
+    acquisitions of the cycle, at their exact lines."""
+    path = os.path.join(FIXDIR, "tp_abba_deadlock.py")
+    flagged = {f.line for f in lint_paths([path]) if f.rule == "TPU004"}
+    assert flagged == _marked_lines(path), sorted(flagged)
 
 
 # ---------------------------------------------------------------------------
@@ -293,6 +319,24 @@ def test_cli_rules_table():
     assert res.returncode == 0
     for rule in RULES:
         assert rule in res.stdout
+
+
+def test_cli_explain_prints_doc_and_examples():
+    """--explain TPU0NN makes findings self-documenting at the terminal: the
+    rule's docstring plus one tp/fp example from the fixture corpus."""
+    for rule in ("TPU004", "TPU011", "TPU012", "TPU013"):
+        res = _run_cli("--explain", rule)
+        assert res.returncode == 0, res.stderr
+        assert rule in res.stdout
+        assert "TRUE POSITIVE" in res.stdout and "# TP" in res.stdout
+        assert "FALSE POSITIVE" in res.stdout
+        assert "tests/tpulint_fixtures/" in res.stdout
+
+
+def test_cli_explain_unknown_rule_exits_2():
+    res = _run_cli("--explain", "TPU999")
+    assert res.returncode == 2
+    assert "unknown rule" in res.stderr
 
 
 def test_cli_update_baseline_refuses_subset_scope():

@@ -34,3 +34,38 @@ class Ordered:
             def later(x):
                 return jnp.sum(x)  # runs later, NOT while the lock is held
         return later
+
+    def lambda_defined_under_lock(self):
+        with self._first:
+            later = lambda x: jnp.dot(x, x)  # noqa: E731 — same: defined, not run
+        return later
+
+    # helper that dispatches, called ONLY with no lock held: silent
+    def _pack(self, x):
+        return jnp.asarray(x)
+
+    def pack_unlocked(self, x):
+        with self._first:
+            n = len(x)
+        return self._pack(x[:n])
+
+
+class Hierarchy:
+    """The breaker shape: child -> parent on the SAME class attribute is
+    reentrancy on one lock class, not an order edge — instances are strictly
+    layered by construction."""
+
+    def __init__(self, parent: "Hierarchy | None" = None):
+        self._lock = threading.Lock()
+        self.parent = parent
+        self.used = 0
+
+    def add(self, n):
+        with self._lock:
+            if self.parent is not None:
+                self.parent._add_from_child(n)  # same lock class: no self-edge
+            self.used += n
+
+    def _add_from_child(self, n):
+        with self._lock:
+            self.used += n

@@ -1,0 +1,53 @@
+"""tpulint fixture — FALSE positives for TPU011: none of these may fire."""
+
+import os
+import threading
+
+
+class Service:
+    def __init__(self, transport):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+        self._stopped = threading.Event()
+        self.transport = transport
+        self.types = {}
+
+    def timed_waits_are_fine(self):
+        with self._cv:
+            self._cv.wait(0.1)  # timed condition wait: the drainer idiom
+        with self._lock:
+            ok = self._stopped.wait(timeout=0.5)  # timed event wait
+        return ok
+
+    def wait_outside_the_lock(self, fut):
+        with self._lock:
+            armed = True
+        return fut.result(10) if armed else None  # wait AFTER release
+
+    def dict_get_is_not_queue_get(self, key):
+        with self._lock:
+            return self.types.get(key)  # dict lookup, not a blocking pop
+
+    def string_and_path_joins(self, parts, d):
+        with self._lock:
+            line = " ".join(parts)  # str.join is not Thread.join
+            p = os.path.join(d, line)  # neither is os.path.join
+        return p
+
+    # helper that blocks, but is ALSO called with no lock held — the
+    # meet-over-call-sites context is empty, so its body stays silent
+    def _await(self, fut):
+        return fut.result(5)
+
+    def unlocked_path(self, fut):
+        return self._await(fut)
+
+    def send_outside(self, node):
+        with self._lock:
+            action = "ping"
+        return self.transport.send_request(node, action, {})
+
+    def lambda_defined_under_lock(self, fut):
+        with self._lock:
+            waiter = lambda: fut.result(5)  # noqa: E731 — defined, not run
+        return waiter

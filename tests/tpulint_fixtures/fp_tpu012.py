@@ -1,0 +1,49 @@
+"""tpulint fixture — FALSE positives for TPU012: none of these may fire."""
+
+import threading
+
+
+class Disciplined:
+    """Every write locked; __init__ is pre-publication; reads stay free."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.rate = 0.0  # single-writer-thread attr, never locked anywhere
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+
+    def reset(self):
+        with self._lock:
+            self.count = 0
+
+    def observe(self, dt):
+        self.rate = 0.2 * dt + 0.8 * self.rate  # one discipline: always bare
+
+    def snapshot(self):
+        return self.count  # lock-free READ is legal (stats snapshots)
+
+    # a helper only ever invoked under the lock: its bare write IS locked
+    # (meet-over-call-sites), like the engine's _merge_window
+    def _advance_locked(self):
+        self.count += 1
+
+    def bump_twice(self):
+        with self._lock:
+            self._advance_locked()
+            self._advance_locked()
+
+
+class NotConcurrent:
+    """No lock owned: TPU012 does not apply, whatever the write mix."""
+
+    def __init__(self):
+        self.x = 0
+
+    def a(self):
+        self.x += 1
+
+    def b(self):
+        self.x = 5

@@ -1,0 +1,45 @@
+"""tpulint fixture — FALSE positives for TPU013: none of these may fire."""
+
+import threading
+
+_mod_lock = threading.Lock()
+
+
+class Channel:
+    def __init__(self):
+        self._wlock = threading.Lock()
+        self.frames = 0
+
+    def send_with(self, frame):
+        with self._wlock:  # the sanctioned shape
+            self.with_frames = frame
+
+    def send_try_finally(self, frame):
+        self._wlock.acquire()
+        try:
+            self.frames += 1
+        finally:
+            self._wlock.release()
+
+    def send_conditional(self, frame):
+        if self._wlock.acquire(timeout=1.0):
+            try:
+                self.frames += 1
+            finally:
+                self._wlock.release()
+        return self.frames
+
+    def send_acquire_inside_try(self, frame):
+        try:
+            self._wlock.acquire()
+            self.frames += 1
+        finally:
+            self._wlock.release()
+
+
+def module_level_balanced():
+    _mod_lock.acquire()
+    try:
+        return 1
+    finally:
+        _mod_lock.release()

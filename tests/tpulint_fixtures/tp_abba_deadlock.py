@@ -1,0 +1,55 @@
+"""tpulint fixture AND runtime lock-trace fixture — the ABBA deadlock.
+
+Static: TPU004 flags both inner acquisitions (the a→b and b→a edges of the
+cycle). Runtime: run as a script under ESTPU_LOCKTRACE=1 and the lock-trace
+sanitizer (elasticsearch_tpu/common/locktrace.py) records the same cycle from
+the actual thread interleaving and FAILS with a report naming both
+acquisition sites — without ever hitting the deadlock (the threads run one
+after the other; the order graph, not the wall clock, proves the hazard —
+lockdep's trick).
+
+    python tests/tpulint_fixtures/tp_abba_deadlock.py abba    -> exit 1, cycle
+    python tests/tpulint_fixtures/tp_abba_deadlock.py fixed   -> exit 0
+"""
+
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+from elasticsearch_tpu.common.locktrace import TRACER, maybe_install  # noqa: E402
+
+maybe_install()
+
+# constructed AFTER install so the tracer wraps them
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def take_ab():
+    with lock_a:
+        with lock_b:  # TP: a→b edge of the cycle
+            pass
+
+
+def take_ba():
+    with lock_b:
+        with lock_a:  # TP: b→a edge of the cycle
+            pass
+
+
+def main(order: str) -> int:
+    first = threading.Thread(target=take_ab)
+    first.start()
+    first.join()
+    second = threading.Thread(target=take_ab if order == "fixed" else take_ba)
+    second.start()
+    second.join()
+    TRACER.check()  # raises LockOrderViolation on the abba interleaving
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "abba"))

@@ -1,4 +1,9 @@
-"""tpulint fixture — TRUE positives for TPU004 (lock hazards)."""
+"""tpulint fixture — TRUE positives for TPU004 (lock hazards).
+
+Since PR 6 the rule is interprocedural: cycles formed by edges that only exist
+through a call (holding one lock, calling a helper that takes another) and
+device dispatch buried one call away are flagged too.
+"""
 
 import threading
 
@@ -9,6 +14,8 @@ class Service:
     def __init__(self):
         self._a = threading.Lock()
         self._b = threading.Lock()
+        self._c = threading.Lock()
+        self._d = threading.Lock()
 
     def forward(self):
         with self._a:
@@ -25,3 +32,25 @@ class Service:
             y = jnp.sum(x)  # TP: device dispatch while holding a lock
             y.block_until_ready()  # TP: device sync while holding a lock
         return y
+
+    # -- interprocedural cycle: the c→d edge only exists through a call ------
+    def _takes_d(self):
+        with self._d:  # TP: acquired while every caller holds c (c→d edge)
+            return 1
+
+    def via_helper(self):
+        with self._c:
+            return self._takes_d()  # TP: call-propagated edge on the cycle
+
+    def reverse_pair(self):
+        with self._d:
+            with self._c:  # TP: d→c edge closing the cycle
+                pass
+
+    # -- interprocedural dispatch: the jnp call is one hop away --------------
+    def _score(self, x):
+        return jnp.dot(x, x)  # TP: bottoms out here (only ever called locked)
+
+    def score_under_lock(self, x):
+        with self._b:
+            return self._score(x)  # TP: dispatch reached via helper

@@ -1,0 +1,41 @@
+"""tpulint fixture — TRUE positives for TPU011 (blocking call under a lock)."""
+
+import queue
+import threading
+
+
+class Coordinator:
+    def __init__(self, transport):
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+        self._worker = threading.Thread(target=lambda: None)
+        self._queue = queue.Queue()
+        self.transport = transport
+
+    def wait_for_future(self, fut):
+        with self._lock:
+            return fut.result(10)  # TP: future wait while holding the lock
+
+    def wait_for_event(self):
+        with self._lock:
+            self._done.wait()  # TP: untimed Event.wait under the lock
+
+    def join_worker(self):
+        with self._lock:
+            self._worker.join()  # TP: thread join under the lock
+
+    def drain_one(self):
+        with self._lock:
+            return self._queue.get()  # TP: queue get under the lock
+
+    def ping(self, node):
+        with self._lock:
+            return self.transport.send_request(node, "ping", {})  # TP: rpc under the lock
+
+    # -- interprocedural: the wait is buried one call away -------------------
+    def _await_reply(self, fut):
+        return fut.result(30)  # TP: bottoms out here (only ever called locked)
+
+    def locked_rpc(self, fut):
+        with self._lock:
+            return self._await_reply(fut)  # TP: blocking wait reached via helper

@@ -1,0 +1,54 @@
+"""tpulint fixture — TRUE positives for TPU012 (unsynchronized shared state)."""
+
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.active = 0
+        self.completed = 0
+
+    def start_task(self):
+        with self._lock:
+            self.active += 1
+
+    def finish_task(self):
+        self.active -= 1  # TP: races the locked increment (lost update)
+        with self._lock:
+            self.completed += 1
+
+    def reset(self):
+        self.completed = 0  # TP: bare write to a lock-guarded counter
+
+
+class Registry:
+    def __init__(self):
+        self._mu = threading.RLock()
+        self.entries = {}
+
+    def put(self, k, v):
+        with self._mu:
+            self.entries = {**self.entries, k: v}
+
+    def clear(self):
+        self.entries = {}  # TP: replaces the map without the lock
+
+
+_unrelated = threading.Lock()
+
+
+class WrongLock:
+    """Holding SOME lock is not synchronization — only the class's own."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def good(self):
+        with self._lock:
+            self.n += 1
+
+    def bad(self):
+        with _unrelated:
+            self.n -= 1  # TP: an unrelated lock still races the guarded write

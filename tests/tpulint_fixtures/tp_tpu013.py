@@ -1,0 +1,30 @@
+"""tpulint fixture — TRUE positives for TPU013 (unbalanced acquire)."""
+
+import threading
+
+_mod_lock = threading.Lock()
+
+
+class Channel:
+    def __init__(self):
+        self._wlock = threading.Lock()
+        self.frames = 0
+
+    def send_leaky(self, frame):
+        self._wlock.acquire()  # TP: no release anywhere on this path
+        self.frames += 1
+
+    def send_exception_leaks(self, frame):
+        self._wlock.acquire()  # TP: release exists but no try/finally guards it
+        self.frames += 1
+        self._wlock.release()
+
+    def conditional_no_guard(self):
+        if self._wlock.acquire(timeout=1.0):  # TP: body has no try/finally release
+            self.frames += 1
+            self._wlock.release()
+
+
+def module_level_leak():
+    _mod_lock.acquire()  # TP: bare module-lock acquire
+    return 1

@@ -1,0 +1,13 @@
+"""tpulint fixture — cross-module TPU004, helper side.
+
+Alone this file is SILENT: pack_rows dispatches to the device but holds no
+lock here. The hazard only exists when a caller in another module invokes it
+while holding a lock (tp_xmod_tpu004_root.py) — the shape of a lock taken in
+search/batcher.py with the device work buried in ops/scoring.py.
+"""
+
+import jax.numpy as jnp
+
+
+def pack_rows(rows):
+    return jnp.asarray(rows)

@@ -1,6 +1,10 @@
 """CLI: python -m tools.tpulint [--check] [--format F] [--baseline P] [paths...]
 (also installed as the `tpulint` console script — see pyproject.toml).
 
+`--explain TPU0NN` prints one rule's docstring plus a true/false-positive
+example extracted from its fixture corpus (tests/tpulint_fixtures/), so a
+finding in CI is self-documenting at the terminal; unknown rule ids exit 2.
+
 Exit-code contract (stable; CI and the pre-push hook depend on it):
 
   0  clean — no findings outside the baseline (without --check, ALWAYS 0 so
@@ -26,16 +30,18 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from .engine import (
     DEFAULT_BASELINE,
+    REPO,
     diff_baseline,
     lint_paths,
     load_baseline,
     save_baseline,
 )
-from .rules import RULE_DOCS
+from .rules import RULE_DOCS, RULE_MODULES
 
 
 def _emit_text(findings, new_keys, baseline, stale):
@@ -72,10 +78,55 @@ def _emit_github(findings, new_fps):
               f"title=tpulint {f.rule}::{msg}")
 
 
+_FIXDIR = os.path.join(REPO, "tests", "tpulint_fixtures")
+
+
+def _fixture_snippet(path: str, kind: str) -> str | None:
+    """A short excerpt from the rule's seeded corpus: the first `# TP`-marked
+    hazard with its lead-in (tp), or the first legal-pattern def (fp)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return None
+    if kind == "tp":
+        for i, ln in enumerate(lines):
+            if "# TP" in ln:
+                lo = max(0, i - 4)
+                return "\n".join(lines[lo:i + 1])
+        return None
+    for i, ln in enumerate(lines):
+        if ln.lstrip().startswith("def ") and "__init__" not in ln:
+            return "\n".join(lines[i:i + 6])
+    return None
+
+
+def _explain(rule_id: str) -> int:
+    """--explain TPU0NN: the rule's docstring plus one tp/fp example from the
+    fixture corpus, so findings are self-documenting at the terminal."""
+    mod = RULE_MODULES.get(rule_id.upper())
+    if mod is None:
+        print(f"unknown rule [{rule_id}] — known rules: "
+              + ", ".join(sorted(RULE_MODULES)), file=sys.stderr)
+        return 2
+    print(f"{mod.RULE_ID}  {mod.DOC}")
+    print()
+    print((mod.__doc__ or "(no docstring)").strip())
+    for kind, title in (("tp", "example TRUE POSITIVE (flagged)"),
+                        ("fp", "example FALSE POSITIVE (stays silent)")):
+        name = f"{kind}_{mod.RULE_ID.lower()}.py"
+        snippet = _fixture_snippet(os.path.join(_FIXDIR, name), kind)
+        if snippet:
+            print(f"\n--- {title} — tests/tpulint_fixtures/{name} ---")
+            print(snippet)
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="tools.tpulint",
-        description="JAX/TPU hot-path static analyzer (TPU001-TPU009)",
+        description="JAX/TPU hot-path + concurrency static analyzer "
+                    "(TPU001-TPU013)",
         epilog="exit codes: 0 clean, 1 new findings (--check only), "
                "2 usage error")
     ap.add_argument("paths", nargs="*",
@@ -96,12 +147,18 @@ def main(argv: list[str] | None = None) -> int:
                     help="rewrite the baseline to the current findings")
     ap.add_argument("--rules", action="store_true",
                     help="print the rule table and exit")
+    ap.add_argument("--explain", metavar="TPU0NN", default=None,
+                    help="print one rule's docstring + a tp/fp example from "
+                         "the fixture corpus and exit")
     args = ap.parse_args(argv)
 
     if args.rules:
         for rid, doc in sorted(RULE_DOCS.items()):
             print(f"{rid}  {doc}")
         return 0
+
+    if args.explain:
+        return _explain(args.explain)
 
     if args.fmt and args.as_json and args.fmt != "json":
         print("--json conflicts with --format " + args.fmt, file=sys.stderr)

@@ -1,0 +1,593 @@
+"""tpulint pass 1.5: interprocedural lock analysis (the concurrency context).
+
+PRs 3-5 made the node genuinely concurrent — batcher drainer threads, breaker
+hierarchies, bounded pools, transport reader threads — and the file-local
+TPU004 only saw lexically nested `with` blocks inside one function. This pass
+gives the concurrency rule family (TPU004, TPU011-TPU013) the project-wide
+facts they need, the lockdep shape: propagate HELD-LOCK SETS through the call
+graph so a lock taken in search/batcher.py and a second lock (or a device
+dispatch) reached via a helper in ops/scoring.py still forms an edge.
+
+What it computes, once per lint run:
+
+- **lock universe** — every declared lock: `self._x = threading.Lock()` keys as
+  `Class._x` (instance-independent, like lockdep's lock classes — which is also
+  why a parent/child pair of the SAME class never forms a self-edge);
+  module/function-level `x = threading.Lock()` keys as `module:x` so same-named
+  locals in unrelated files don't alias; the `d.setdefault(k, threading.Lock())`
+  idiom (tcp.py dial locks) binds the assigned name.
+- **typed call resolution** — beyond project.resolve: `self.m()` to the
+  enclosing class's method (one level of base classes), `self.a.m()` through
+  inferred attribute types (ctor assignment `self.a = Translog(...)` or an
+  annotated ctor param `parent: "MemoryCircuitBreaker | None"`), and
+  `ClassName(...)` to the class's `__init__`. Anything dynamic stays
+  unresolved and never creates findings.
+- **per-function facts** — locks acquired, lexical (outer -> inner)
+  acquisition edges, every call made while holding a lock, direct device
+  dispatch and blocking-call sites, bare `.acquire()` balance, and self-attr
+  writes with their held-lock context (TPU012's input).
+- **fixpoints over the call graph** — `may_acquire` (lock keys a call may
+  take, transitively), `reach_device` / `reach_block` (a representative
+  device-dispatch / blocking site reachable from the function, with its
+  origin so findings can name the line they bottom out on).
+
+Blocking classification (TPU011's contract): `.result()` / `send_request` /
+`submit_request` / `fut_result` / `time.sleep` always block; `.wait()` blocks
+only with NO timeout argument (a timed `cv.wait(0.1)` drainer loop is the
+sanctioned idiom); `.join()` blocks unless the receiver is a string/path
+(`", ".join`, `os.path.join`); `.get()` blocks only on queue-shaped receivers.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from .engine import SourceFile
+from .project import Project, module_name
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_SYNC_ATTRS = {"block_until_ready", "device_get", "device_put"}
+_DEVICE_MODS = {"jnp", "lax"}
+
+_BLOCKING_ALWAYS = {"result", "send_request", "submit_request", "fut_result",
+                    "sleep"}
+_STR_JOIN_RECEIVERS = re.compile(r"(^|[._])(path|sep)$")
+
+_ANN_NAME = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
+
+def _dotted(node: ast.AST) -> tuple[str, ...] | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else \
+        f.id if isinstance(f, ast.Name) else None
+    return name in _LOCK_CTORS
+
+
+def _setdefault_lock(node: ast.AST) -> bool:
+    """d.setdefault(k, threading.Lock()) — the lazily-created per-key lock."""
+    if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "setdefault"):
+        return False
+    return any(_is_lock_ctor(a) for a in node.args)
+
+
+@dataclass
+class Site:
+    """One direct device-dispatch or blocking call site."""
+
+    what: str
+    line: int
+    held: tuple  # lock keys held at the site, outermost first
+
+
+@dataclass
+class CallSite:
+    """One call expression, with resolution + held-lock context."""
+
+    callees: tuple  # resolved fids (empty = unresolved, never a finding)
+    display: str  # source-ish rendering for messages
+    held: tuple
+    line: int
+
+
+@dataclass
+class AttrWrite:
+    """self.X assignment inside a method (TPU012's raw material)."""
+
+    attr: str
+    line: int
+    locked: bool  # any known lock lexically held at the write
+    method: str
+    held: tuple = ()  # WHICH lock keys were held (TPU012 matches the
+    # owning class's own locks — an unrelated lock is not synchronization)
+
+
+@dataclass
+class FuncConc:
+    """Concurrency facts for one function body (nested defs excluded)."""
+
+    fid: int
+    acquires: set = field(default_factory=set)
+    acquire_sites: list = field(default_factory=list)  # (key, line) every acquisition
+    with_edges: list = field(default_factory=list)  # (outer, inner, line)
+    calls: list = field(default_factory=list)  # [CallSite]
+    device_sites: list = field(default_factory=list)  # [Site]
+    blocking_sites: list = field(default_factory=list)  # [Site]
+    acquire_calls: list = field(default_factory=list)  # (key, line) bare .acquire()
+    release_keys: set = field(default_factory=set)  # keys .release()d anywhere
+    writes: list = field(default_factory=list)  # [AttrWrite]
+
+
+@dataclass
+class ClassInfo:
+    module: str
+    name: str
+    node: ast.ClassDef
+    sf: SourceFile
+    methods: dict = field(default_factory=dict)  # name -> fid
+    bases: list = field(default_factory=list)  # base class name strings
+    lock_attrs: set = field(default_factory=set)  # attr names holding locks
+    attr_types: dict = field(default_factory=dict)  # attr -> (module, Class)
+
+
+class LockAnalysis:
+    """The interprocedural lock context, built once per lint run."""
+
+    def __init__(self, files: list[SourceFile], project: Project):
+        self.files = files
+        self.project = project
+        self.classes: dict[tuple, ClassInfo] = {}  # (module, name) -> info
+        self.fid_class: dict[int, tuple] = {}  # method fid -> class key
+        self.lock_keys: set[str] = set()
+        self.func: dict[int, FuncConc] = {}
+        self.may_acquire: dict[int, frozenset] = {}
+        # fid -> (what, "path:line") of a reachable site, or None
+        self.reach_device: dict[int, tuple | None] = {}
+        self.reach_block: dict[int, tuple | None] = {}
+        # fid -> locks held at EVERY resolved call site (meet-over-call-sites,
+        # callers' own always-held included): how a helper only ever invoked
+        # under the engine RLock gets its writes/dispatches judged as locked
+        self.always_held: dict[int, frozenset] = {}
+
+        self._index_classes()
+        self._collect_locks()
+        self._infer_attr_types()
+        for fi in project.functions:
+            self.func[fi.fid] = self._walk_function(fi)
+        self._fixpoints()
+
+    # -- class / lock universe ----------------------------------------------
+    def _index_classes(self) -> None:
+        for sf in self.files:
+            mod = module_name(sf.relpath)
+            for node in sf.tree.body:
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                ci = ClassInfo(module=mod, name=node.name, node=node, sf=sf)
+                for b in node.bases:
+                    d = _dotted(b)
+                    if d:
+                        ci.bases.append(d[-1])
+                for child in node.body:
+                    if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        fi = self.project.func_at(child)
+                        if fi is not None:
+                            ci.methods[child.name] = fi.fid
+                            self.fid_class[fi.fid] = (mod, node.name)
+                self.classes[(mod, node.name)] = ci
+
+    def _collect_locks(self) -> None:
+        for sf in self.files:
+            mod = module_name(sf.relpath)
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                is_lock = _is_lock_ctor(node.value) or _setdefault_lock(node.value)
+                if not is_lock:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and t.value.id == "self":
+                        cls = self._enclosing_class(sf, node)
+                        if cls:
+                            self.lock_keys.add(f"{cls}.{t.attr}")
+                            ck = (mod, cls)
+                            if ck in self.classes:
+                                self.classes[ck].lock_attrs.add(t.attr)
+                    elif isinstance(t, ast.Name):
+                        self.lock_keys.add(f"{mod}:{t.id}")
+
+    def _enclosing_class(self, sf: SourceFile, target: ast.AST) -> str | None:
+        for node in sf.tree.body:
+            if isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    if sub is target:
+                        return node.name
+        return None
+
+    def _resolve_class_ref(self, mod: str, d: tuple) -> ClassInfo | None:
+        """Resolve a (possibly dotted) name to a project class."""
+        name = d[-1]
+        local = self.classes.get((mod, name))
+        if len(d) == 1:
+            if local is not None:
+                return local
+            target = self.project._imports.get(mod, {}).get(name)
+            if target and "." in target:
+                tmod, tname = target.rsplit(".", 1)
+                return self.classes.get((tmod, tname))
+            return None
+        target = self.project._imports.get(mod, {}).get(d[0])
+        if target:
+            return self.classes.get((target, name))
+        return None
+
+    def _infer_attr_types(self) -> None:
+        """self.a = ClassName(...) or self.a = <param annotated ClassName>."""
+        for (mod, cname), ci in self.classes.items():
+            for mname, fid in ci.methods.items():
+                fi = self.project.functions[fid]
+                anns = self._param_annotations(mod, fi.node)
+                for node in ast.walk(fi.node):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    for t in node.targets:
+                        if not (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            continue
+                        v = node.value
+                        if isinstance(v, ast.Call):
+                            d = _dotted(v.func)
+                            tc = self._resolve_class_ref(mod, d) if d else None
+                            if tc is not None:
+                                ci.attr_types[t.attr] = (tc.module, tc.name)
+                        elif isinstance(v, ast.Name) and v.id in anns:
+                            ci.attr_types.setdefault(t.attr, anns[v.id])
+
+    def _param_annotations(self, mod: str, fn: ast.AST) -> dict:
+        """param name -> (module, Class) for annotations naming project classes."""
+        out = {}
+        args = fn.args
+        for a in list(args.args) + list(args.kwonlyargs):
+            if a.annotation is None:
+                continue
+            for tok in self._ann_names(a.annotation):
+                tc = self._resolve_class_ref(mod, (tok,))
+                if tc is not None:
+                    out[a.arg] = (tc.module, tc.name)
+                    break
+        return out
+
+    @staticmethod
+    def _ann_names(ann: ast.AST) -> list[str]:
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            return _ANN_NAME.findall(ann.value)
+        names = []
+        for node in ast.walk(ann):
+            if isinstance(node, ast.Name):
+                names.append(node.id)
+        return names
+
+    # -- per-function walk ----------------------------------------------------
+    def _lock_key(self, expr: ast.AST, mod: str, cls: str | None) -> str | None:
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and cls:
+            key = f"{cls}.{expr.attr}"
+            return key if key in self.lock_keys else None
+        if isinstance(expr, ast.Name):
+            key = f"{mod}:{expr.id}"
+            return key if key in self.lock_keys else None
+        return None
+
+    def _walk_function(self, fi) -> FuncConc:
+        fc = FuncConc(fid=fi.fid)
+        mod = fi.module
+        ck = self.fid_class.get(fi.fid)
+        cls = ck[1] if ck else None
+        analysis = self
+
+        class W(ast.NodeVisitor):
+            def __init__(self):
+                self.held: list[str] = []
+
+            def visit_FunctionDef(self, node):
+                if node is not fi.node:
+                    return  # nested defs run later, not under these locks
+                self.generic_visit(node)
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Lambda(self, node):
+                return  # a callback DEFINED under a lock does not run under it
+
+            def visit_With(self, node: ast.With):
+                acquired = []
+                for item in node.items:
+                    key = analysis._lock_key(item.context_expr, mod, cls)
+                    if key:
+                        fc.acquires.add(key)
+                        fc.acquire_sites.append((key, node.lineno))
+                        for outer in self.held:
+                            if outer != key and key not in self.held:
+                                fc.with_edges.append((outer, key, node.lineno))
+                        acquired.append(key)
+                        self.held.append(key)
+                self.generic_visit(node)
+                for _ in acquired:
+                    self.held.pop()
+
+            def visit_Call(self, node: ast.Call):
+                analysis._note_call(fc, node, tuple(self.held), mod, cls, ck)
+                self.generic_visit(node)
+
+            def _note_write(self, target, line):
+                if isinstance(target, ast.Attribute) and \
+                        isinstance(target.value, ast.Name) and \
+                        target.value.id == "self":
+                    fc.writes.append(AttrWrite(
+                        attr=target.attr, line=line,
+                        locked=bool(self.held), method=fi.name,
+                        held=tuple(self.held)))
+
+            def visit_Assign(self, node: ast.Assign):
+                for t in node.targets:
+                    for el in (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                               else [t]):
+                        self._note_write(el, node.lineno)
+                self.generic_visit(node)
+
+            def visit_AugAssign(self, node: ast.AugAssign):
+                self._note_write(node.target, node.lineno)
+                self.generic_visit(node)
+
+        W().visit(fi.node)
+        return fc
+
+    def _note_call(self, fc: FuncConc, node: ast.Call, held: tuple,
+                   mod: str, cls: str | None, ck) -> None:
+        f = node.func
+        d = _dotted(f)
+        name = f.attr if isinstance(f, ast.Attribute) else \
+            f.id if isinstance(f, ast.Name) else None
+        if name is None:
+            return
+        # lock method calls: bare acquire/release (TPU013 + order edges)
+        if name in ("acquire", "release") and isinstance(f, ast.Attribute):
+            key = self._lock_key(f.value, mod, cls)
+            if key:
+                if name == "acquire":
+                    fc.acquires.add(key)
+                    fc.acquire_sites.append((key, node.lineno))
+                    fc.acquire_calls.append((key, node.lineno))
+                    for outer in held:
+                        if outer != key:
+                            fc.with_edges.append((outer, key, node.lineno))
+                else:
+                    fc.release_keys.add(key)
+                return
+        # device dispatch
+        is_jnp = isinstance(f, ast.Attribute) and \
+            isinstance(f.value, ast.Name) and f.value.id in _DEVICE_MODS
+        if name in _SYNC_ATTRS or is_jnp:
+            what = name if name in _SYNC_ATTRS else f"jnp.{f.attr}"
+            fc.device_sites.append(Site(what, node.lineno, held))
+        # blocking calls
+        blocking = self._blocking_what(node, name, f)
+        if blocking:
+            fc.blocking_sites.append(Site(blocking, node.lineno, held))
+        # resolution for the interprocedural fixpoints
+        callees: tuple = ()
+        if d is not None:
+            callees = tuple(self._resolve_callees(mod, cls, ck, d))
+        if callees or held:
+            fc.calls.append(CallSite(callees=callees, display=".".join(d or (name,)),
+                                     held=held, line=node.lineno))
+
+    @staticmethod
+    def _blocking_what(node: ast.Call, name: str, f: ast.AST) -> str | None:
+        if name in _BLOCKING_ALWAYS:
+            return f"{name}()"
+        if name == "wait":
+            has_timeout = bool(node.args) or \
+                any(kw.arg == "timeout" and not (isinstance(kw.value, ast.Constant)
+                                                 and kw.value.value is None)
+                    for kw in node.keywords)
+            if any(isinstance(a, ast.Constant) and a.value is None
+                   for a in node.args[:1]):
+                has_timeout = False
+            return None if has_timeout else "wait() with no timeout"
+        if name == "join" and isinstance(f, ast.Attribute):
+            recv = f.value
+            if isinstance(recv, ast.Constant):
+                return None  # ", ".join(...)
+            rd = _dotted(recv)
+            if rd and _STR_JOIN_RECEIVERS.search(".".join(rd)):
+                return None  # os.path.join / sep.join
+            if rd is None:
+                return None  # computed receiver: assume string-ish
+            return "join()"
+        if name == "get" and isinstance(f, ast.Attribute):
+            rd = _dotted(f.value)
+            if rd and "queue" in rd[-1].lower():
+                return "queue get()"
+        return None
+
+    def _resolve_callees(self, mod: str, cls: str | None, ck,
+                         d: tuple) -> list[int]:
+        if d[0] in ("self", "cls") and ck is not None:
+            ci = self.classes.get(ck)
+            if ci is None:
+                return []
+            if len(d) == 2:  # self.m()
+                fid = self._method_in(ci, d[1])
+                return [fid] if fid is not None else []
+            if len(d) == 3:  # self.a.m()
+                tkey = ci.attr_types.get(d[1])
+                if tkey:
+                    tci = self.classes.get(tkey)
+                    if tci:
+                        fid = self._method_in(tci, d[2])
+                        return [fid] if fid is not None else []
+            return []
+        fids = self.project.resolve(mod, d)
+        if fids:
+            return fids
+        tc = self._resolve_class_ref(mod, d)
+        if tc is not None:  # ClassName(...) -> __init__
+            fid = tc.methods.get("__init__")
+            return [fid] if fid is not None else []
+        return []
+
+    def _method_in(self, ci: ClassInfo, name: str) -> int | None:
+        if name in ci.methods:
+            return ci.methods[name]
+        for base in ci.bases:  # one level of project-local inheritance
+            bci = self.classes.get((ci.module, base))
+            if bci and name in bci.methods:
+                return bci.methods[name]
+        return None
+
+    # -- fixpoints ------------------------------------------------------------
+    def _fixpoints(self) -> None:
+        callees = {fid: {c for cs in fc.calls for c in cs.callees}
+                   for fid, fc in self.func.items()}
+        acq = {fid: set(fc.acquires) for fid, fc in self.func.items()}
+        changed = True
+        while changed:
+            changed = False
+            for fid, cs in callees.items():
+                cur = acq[fid]
+                for c in cs:
+                    extra = acq.get(c, ())
+                    if not cur.issuperset(extra):
+                        cur.update(extra)
+                        changed = True
+        self.may_acquire = {fid: frozenset(v) for fid, v in acq.items()}
+
+        def reach(site_attr: str) -> dict:
+            out: dict[int, tuple | None] = {}
+            for fid, fc in self.func.items():
+                sites = getattr(fc, site_attr)
+                fi = self.project.functions[fid]
+                out[fid] = (sites[0].what, f"{fi.sf.relpath}:{sites[0].line}") \
+                    if sites else None
+            changed2 = True
+            while changed2:
+                changed2 = False
+                for fid, cs in callees.items():
+                    if out[fid] is not None:
+                        continue
+                    for c in sorted(cs):
+                        if out.get(c) is not None:
+                            out[fid] = out[c]
+                            changed2 = True
+                            break
+            return out
+
+        self.reach_device = reach("device_sites")
+        self.reach_block = reach("blocking_sites")
+
+        # meet-over-call-sites: start optimistic (everything held) for
+        # functions with at least one resolved caller, intersect downward.
+        # Functions with no resolved caller, or whose reference ESCAPES as a
+        # value (callbacks, pool submissions — unknown invocation context),
+        # ground the lattice at the empty set.
+        callers: dict[int, list] = {}
+        for fid, fc in self.func.items():
+            for cs in fc.calls:
+                for c in cs.callees:
+                    callers.setdefault(c, []).append((fid, frozenset(cs.held)))
+        universe = frozenset(self.lock_keys)
+        grounded = {fid for fid in self.func
+                    if fid not in callers or self.project.functions[fid].escapes}
+        # a caller-graph cycle with NO grounded entry point (mutually recursive
+        # helpers only reachable dynamically) would keep the optimistic
+        # universe forever — every lock "always held" — so ground any function
+        # not anchored to a grounded caller chain
+        anchored = set(grounded)
+        changed = True
+        while changed:
+            changed = False
+            for fid, sites in callers.items():
+                if fid not in anchored and \
+                        any(c in anchored for (c, _held) in sites):
+                    anchored.add(fid)
+                    changed = True
+        ah = {}
+        for fid in self.func:
+            ah[fid] = frozenset() if (fid in grounded or fid not in anchored) \
+                else universe
+        changed = True
+        while changed:
+            changed = False
+            for fid, sites in callers.items():
+                if not ah[fid]:
+                    continue
+                new = None
+                for (caller, held) in sites:
+                    eff = held | ah.get(caller, frozenset())
+                    new = eff if new is None else (new & eff)
+                if new != ah[fid]:
+                    ah[fid] = new
+                    changed = True
+        self.always_held = ah
+
+    def effective_held(self, fid: int, held: tuple) -> tuple:
+        """Site-held locks plus the function's always-held context."""
+        extra = self.always_held.get(fid, frozenset()) - set(held)
+        return tuple(sorted(extra)) + tuple(held)
+
+    # -- queries --------------------------------------------------------------
+    def order_edges(self) -> dict:
+        """Every (outer -> inner) acquisition edge: lexical nesting plus
+        call-propagated (holding `outer`, a callee may acquire `inner`).
+        Returns {(a, b): [(path, line), ...]} — EVERY witnessing site, so a
+        cycle flags both the lexical nesting and the call that forms it."""
+        edges: dict = {}
+        for fid, fc in self.func.items():
+            sf = self.project.functions[fid].sf
+            ah = self.always_held.get(fid, frozenset())
+            for (a, b, line) in fc.with_edges:
+                edges.setdefault((a, b), []).append((sf.relpath, line))
+            for (key, line) in fc.acquire_sites:
+                for a in sorted(ah):  # acquired under the callers' held locks
+                    if a != key:
+                        edges.setdefault((a, key), []).append((sf.relpath, line))
+            for cs in fc.calls:
+                held = set(cs.held) | ah
+                if not held or not cs.callees:
+                    continue
+                inner = set()
+                for c in cs.callees:
+                    inner |= self.may_acquire.get(c, frozenset())
+                for b in sorted(inner):
+                    if b in held:
+                        continue  # reentrant on an already-held class: not an edge
+                    for a in sorted(held):
+                        edges.setdefault((a, b), []).append((sf.relpath, cs.line))
+        return edges
+
+
+def analysis(files: list[SourceFile], project: Project) -> LockAnalysis:
+    """Build (or reuse) the LockAnalysis for this lint run — rules share it."""
+    cached = getattr(project, "_lock_analysis", None)
+    if cached is None:
+        cached = LockAnalysis(files, project)
+        project._lock_analysis = cached
+    return cached

@@ -6,8 +6,10 @@ symbol table, call graph, jit/shard_map device-context propagation). The engine
 owns everything rule-independent so each rule stays a small AST walk:
 
 - which files are in scope and what ROLE they play (hot-path for TPU001/002/003,
-  lock-scope for TPU004, platform-exempt for TPU005; the SPMD family
-  TPU006-009 keys off the Project's traced/shard_map closures instead),
+  platform-exempt for TPU005; the SPMD family TPU006-009 keys off the
+  Project's traced/shard_map closures, and the concurrency family
+  TPU004/TPU011-TPU013 runs package-wide over the shared LockAnalysis in
+  tools/tpulint/concurrency.py),
 - `# tpulint: ignore[RULE]` line suppressions,
 - the baseline diff (new findings fail; fixed-but-still-listed entries are
   reported so the baseline gets burned down, never silently stale).
@@ -33,15 +35,18 @@ from dataclasses import dataclass, replace
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Role assignment (repo-relative, forward slashes). TPU001-003 look at the
-# device hot path; TPU004 at the engine's locking core; TPU005 everywhere in
-# the package except the one sanctioned platform writer.
+# device hot path; TPU005 everywhere in the package except the one sanctioned
+# platform writer. The concurrency family (TPU004/TPU011-TPU013) covers the
+# WHOLE package since PR 6: ~40 locks live in 25 files and the interprocedural
+# engine resolves lock identity precisely enough (class-keyed attrs,
+# module-qualified locals, conservative call resolution) that a path
+# allowlist would only hide tomorrow's hazard. The runtime sanitizer
+# (common/locktrace.py) is scoped the same way — repo-constructed locks only.
 HOT_PREFIXES = ("elasticsearch_tpu/ops/", "elasticsearch_tpu/parallel/")
 HOT_FILES = ("elasticsearch_tpu/search/execute.py",
              # the cross-request batcher's drainer sits between every serving
              # request and the device — its dispatch half must stay pull-free
              "elasticsearch_tpu/search/batcher.py")
-LOCK_PREFIXES = ("elasticsearch_tpu/transport/",)
-LOCK_FILES = ("elasticsearch_tpu/threadpool.py", "elasticsearch_tpu/cluster/service.py")
 PLATFORM_EXEMPT = ("elasticsearch_tpu/common/jaxenv.py",)
 
 _SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*ignore(?:\[([A-Z0-9, ]+)\])?")
@@ -103,9 +108,8 @@ def _roles(relpath: str, explicit: bool) -> tuple[bool, bool, bool]:
     if explicit and not relpath.startswith("elasticsearch_tpu/"):
         return True, True, True  # fixture / ad-hoc file: every rule applies
     hot = relpath.startswith(HOT_PREFIXES) or relpath in HOT_FILES
-    lock = relpath.startswith(LOCK_PREFIXES) or relpath in LOCK_FILES
     plat = relpath not in PLATFORM_EXEMPT
-    return hot, lock, plat
+    return hot, True, plat
 
 
 def parse_file(path: str, explicit: bool = False) -> SourceFile | None:

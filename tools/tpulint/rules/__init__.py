@@ -12,6 +12,9 @@ from . import (
     tpu008_donate,
     tpu009_dtype_drift,
     tpu010_breaker_traced,
+    tpu011_blocking_under_lock,
+    tpu012_unsync_state,
+    tpu013_unbalanced_acquire,
 )
 
 ALL_RULES = [
@@ -25,6 +28,10 @@ ALL_RULES = [
     tpu008_donate,
     tpu009_dtype_drift,
     tpu010_breaker_traced,
+    tpu011_blocking_under_lock,
+    tpu012_unsync_state,
+    tpu013_unbalanced_acquire,
 ]
 
 RULE_DOCS = {r.RULE_ID: r.DOC for r in ALL_RULES}
+RULE_MODULES = {r.RULE_ID: r for r in ALL_RULES}

@@ -1,152 +1,51 @@
-"""TPU004 — lock hazards in the engine's concurrency core.
+"""TPU004 — lock-order cycles and device dispatch under a lock, INTERPROCEDURAL.
 
-Two failure modes the threadpool/cluster/transport triangle can reintroduce:
+Two failure modes the threadpool/cluster/transport/batcher quadrangle can
+reintroduce (the shape Elasticsearch historically deadlocked on, and the
+cluster-state flavor of the VERDICT.md round-5 stall):
 
   a. acquisition-order cycles: `with self._a: with self._b:` in one place and
      `with self._b: with self._a:` in another is a deadlock waiting for load.
-     The rule builds the lock-order graph from lexically nested `with` blocks
-     (locks = names/attrs bound to threading.Lock/RLock/Condition/Semaphore)
-     and flags every edge that participates in a cycle.
+     Since PR 6 the lock-order graph is built over the PROJECT: a lexical
+     nesting edge AND any edge formed by calling — while holding a lock — a
+     function that (transitively, across modules) acquires another lock.
+     Every edge participating in a cycle is flagged at its witnessing site.
   b. device work under a lock: `block_until_ready`, `jax.device_get/put`, or
-     any `jnp.*` dispatch inside a `with <lock>:` body serializes every other
-     thread behind a device round trip — the cluster-state flavor of the
-     VERDICT.md round-5 stall.
+     any `jnp.*`/`lax.*` dispatch while a lock is held serializes every other
+     thread behind a device round trip. Also interprocedural: a lock taken in
+     search/batcher.py with the dispatch buried in a helper in ops/scoring.py
+     is flagged at the call site, naming where the dispatch bottoms out.
 
-Lock identity is (class, attribute) for `self._x` and the bare name for
-module/function locals, so same-named locks in unrelated classes don't create
-phantom edges; cross-FILE cycles on the same class attr are still caught
-because the key carries the class name, not the file.
+Lock identity is (class, attribute) for `self._x` — instance-independent, like
+lockdep's lock classes — and module-qualified names for locals, so same-named
+locks in unrelated modules never alias. Reentrant acquisition of the SAME key
+(a parent/child pair of one class, an RLock) is not an edge: hierarchies like
+the breaker's child -> parent are safe by construction and self-edges would
+flag them.
+
+True positive (two functions, opposite order — both inner `with` lines flag)::
+
+    def forward(self):            def backward(self):
+        with self._a:                 with self._b:
+            with self._b: ...             with self._a: ...
+
+False positive (stays silent): one global order everywhere; dispatch after the
+lock is released; a callback DEFINED (not called) under the lock; child ->
+parent on the same class attribute.
 """
 
 from __future__ import annotations
 
-import ast
-
+from ..concurrency import analysis
 from ..engine import Finding, SourceFile
 
 RULE_ID = "TPU004"
-DOC = "lock hazard: acquisition-order cycles / device dispatch while holding a lock"
-
-_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
-_SYNC_ATTRS = {"block_until_ready", "device_get", "device_put"}
+DOC = ("lock hazard: interprocedural acquisition-order cycles / device "
+       "dispatch while holding a lock")
 
 
-def _lock_ctor(node: ast.AST) -> bool:
-    if not isinstance(node, ast.Call):
-        return False
-    f = node.func
-    name = f.attr if isinstance(f, ast.Attribute) else \
-        f.id if isinstance(f, ast.Name) else None
-    return name in _LOCK_CTORS
-
-
-class _FileLocks(ast.NodeVisitor):
-    """Collect declared locks: {key: decl_line}; key = "Class.attr" | name."""
-
-    def __init__(self):
-        self.locks: set[str] = set()
-        self._class: list[str] = []
-
-    def visit_ClassDef(self, node: ast.ClassDef):
-        self._class.append(node.name)
-        self.generic_visit(node)
-        self._class.pop()
-
-    def visit_Assign(self, node: ast.Assign):
-        if _lock_ctor(node.value):
-            for t in node.targets:
-                key = self._key(t)
-                if key:
-                    self.locks.add(key)
-        # dict-of-locks idiom: d.setdefault(k, threading.Lock()) declares the
-        # dict itself as a lock source — too dynamic; skipped on purpose.
-        self.generic_visit(node)
-
-    def _key(self, t: ast.AST) -> str | None:
-        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
-                and t.value.id == "self" and self._class:
-            return f"{self._class[-1]}.{t.attr}"
-        if isinstance(t, ast.Name):
-            return t.id
-        return None
-
-
-def _with_lock_key(item: ast.withitem, locks: set[str],
-                   cls: str | None) -> str | None:
-    """The lock key a `with X:` item acquires, if X is a known lock."""
-    e = item.context_expr
-    if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name) \
-            and e.value.id == "self" and cls:
-        key = f"{cls}.{e.attr}"
-        return key if key in locks else None
-    if isinstance(e, ast.Name) and e.id in locks:
-        return e.id
-    return None
-
-
-class _OrderVisitor(ast.NodeVisitor):
-    """Walk one file recording (outer → inner) acquisition edges and device
-    dispatch under a held lock."""
-
-    def __init__(self, sf: SourceFile, locks: set[str],
-                 edges: dict[tuple[str, str], tuple[str, int]],
-                 out: list[Finding]):
-        self.sf = sf
-        self.locks = locks
-        self.edges = edges
-        self.out = out
-        self.held: list[str] = []
-        self._class: list[str] = []
-
-    def visit_ClassDef(self, node: ast.ClassDef):
-        self._class.append(node.name)
-        self.generic_visit(node)
-        self._class.pop()
-
-    def visit_With(self, node: ast.With):
-        acquired = []
-        for item in node.items:
-            key = _with_lock_key(item, self.locks,
-                                 self._class[-1] if self._class else None)
-            if key:
-                for outer in self.held:
-                    if outer != key:
-                        self.edges.setdefault((outer, key),
-                                              (self.sf.relpath, node.lineno))
-                acquired.append(key)
-                self.held.append(key)
-        self.generic_visit(node)
-        for _ in acquired:
-            self.held.pop()
-
-    def visit_Call(self, node: ast.Call):
-        if self.held:
-            f = node.func
-            name = f.attr if isinstance(f, ast.Attribute) else \
-                f.id if isinstance(f, ast.Name) else None
-            is_jnp = isinstance(f, ast.Attribute) and \
-                isinstance(f.value, ast.Name) and f.value.id in ("jnp", "lax")
-            if name in _SYNC_ATTRS or is_jnp:
-                what = name if name in _SYNC_ATTRS else f"jnp.{f.attr}"
-                self.out.append(Finding(
-                    self.sf.relpath, node.lineno, RULE_ID,
-                    f"{what}() while holding lock "
-                    f"`{self.held[-1]}` — device round trip serializes every "
-                    "waiter; move dispatch outside the critical section"))
-        self.generic_visit(node)
-
-    # a nested def inside a with-block does NOT run while the lock is held
-    def visit_FunctionDef(self, node):
-        held, self.held = self.held, []
-        self.generic_visit(node)
-        self.held = held
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-
-def _cycle_edges(edges: dict[tuple[str, str], tuple[str, int]]) -> list[tuple]:
-    """Edges that lie on a cycle (Tarjan SCC over the lock-order graph, plus
-    the trivial A→B→A two-cycles)."""
+def _cycle_edges(edges: dict) -> list[tuple]:
+    """Edges lying on a cycle (Tarjan SCC over the lock-order graph)."""
     graph: dict[str, set[str]] = {}
     for (a, b) in edges:
         graph.setdefault(a, set()).add(b)
@@ -186,31 +85,60 @@ def _cycle_edges(edges: dict[tuple[str, str], tuple[str, int]]) -> list[tuple]:
 
     cyclic = [s for s in sccs if len(s) > 1]
     out = []
-    for (a, b), (path, line) in sorted(edges.items()):
+    for (a, b), witnesses in sorted(edges.items()):
         if any(a in s and b in s for s in cyclic):
-            out.append((a, b, path, line))
+            for (path, line) in sorted(set(witnesses)):
+                out.append((a, b, path, line))
     return out
 
 
 def run(files: list[SourceFile], project=None) -> list[Finding]:
     out: list[Finding] = []
-    in_scope = [sf for sf in files if sf.lock_scope]
-    if not in_scope:
+    if not any(sf.lock_scope for sf in files):
         return out
-    # lock declarations are collected across the whole scope set, so a lock
-    # class defined in transport/ and ordered against one in threadpool.py
-    # still resolves
-    locks: set[str] = set()
-    for sf in in_scope:
-        fl = _FileLocks()
-        fl.visit(sf.tree)
-        locks |= fl.locks
-    edges: dict[tuple[str, str], tuple[str, int]] = {}
-    for sf in in_scope:
-        _OrderVisitor(sf, locks, edges, out).visit(sf.tree)
+    la = analysis(files, project)
+    in_scope = {sf.relpath for sf in files if sf.lock_scope}
+
+    for fid, fc in la.func.items():
+        sf = project.functions[fid].sf
+        if sf.relpath not in in_scope:
+            continue
+        # direct device dispatch under a held lock (the function's always-held
+        # call-site context counts: a helper only ever invoked under the
+        # engine RLock dispatches "under the lock" even with no local `with`)
+        seen_lines = set()
+        for site in fc.device_sites:
+            held = la.effective_held(fid, site.held)
+            if held:
+                out.append(Finding(
+                    sf.relpath, site.line, RULE_ID,
+                    f"{site.what}() while holding lock `{held[-1]}` — "
+                    "device round trip serializes every waiter; move dispatch "
+                    "outside the critical section"))
+                seen_lines.add(site.line)
+        # dispatch reached through the call graph while holding a lock
+        for cs in fc.calls:
+            held = la.effective_held(fid, cs.held)
+            if not held or not cs.callees or cs.line in seen_lines:
+                continue
+            for c in cs.callees:
+                dev = la.reach_device.get(c)
+                if dev is not None:
+                    what, origin = dev
+                    out.append(Finding(
+                        sf.relpath, cs.line, RULE_ID,
+                        f"device dispatch ({what} at {origin}) reached via "
+                        f"`{cs.display}()` while holding lock "
+                        f"`{held[-1]}` — move the device work outside the "
+                        "critical section"))
+                    seen_lines.add(cs.line)
+                    break
+
+    edges = la.order_edges()
     for (a, b, path, line) in _cycle_edges(edges):
-        out.append(Finding(path, line, RULE_ID,
-                           f"lock-order cycle: `{a}` acquired before `{b}` "
-                           "here, but the reverse order exists elsewhere — "
-                           "deadlock hazard; pick one global order"))
+        if path in in_scope:
+            out.append(Finding(path, line, RULE_ID,
+                               f"lock-order cycle: `{a}` acquired before `{b}` "
+                               "here, but the reverse order exists elsewhere — "
+                               "deadlock hazard; pick one global order"))
     return out

@@ -1,0 +1,83 @@
+"""TPU011 — blocking call while holding a lock (interprocedural).
+
+A thread that blocks while holding a lock turns every other acquirer into a
+convoy behind an unbounded wait — and when the thing it waits FOR needs the
+same lock (a future resolved by a pool worker that must log a stat, a
+cluster-state task that re-enters the service), the convoy is a deadlock.
+This is how the reference's pre-async recovery path used to wedge whole nodes.
+
+Blocking calls, per the lockdep-style contract in tools/tpulint/concurrency.py:
+
+  - `Future.result()` (any timeout — parking a lock on a future is the
+    gateway-recovery bug shape), `fut_result`, `send_request`,
+    `submit_request`, `time.sleep`
+  - `Event.wait()` / `Condition.wait()` with NO timeout — the timed
+    `cv.wait(0.1)` drainer idiom stays legal
+  - `Thread.join()` (string/path `.join` receivers are excluded)
+  - queue `get()` (receiver must be queue-shaped; dict `.get` stays legal)
+
+Interprocedural: the rule follows the call graph, so holding a lock in
+search/batcher.py while calling a helper in search/execute.py that parks on a
+future is flagged at the call site, naming the line the wait bottoms out on.
+
+True positive::
+
+    with self._lock:
+        fut.result(10)          # waits on another thread while others convoy
+
+False positive (stays silent)::
+
+    with self._lock:
+        queued = self._cv.wait(0.1)   # timed wait, releases the condition
+    fut.result(10)                    # the wait happens OUTSIDE the lock
+"""
+
+from __future__ import annotations
+
+from ..concurrency import analysis
+from ..engine import Finding, SourceFile
+
+RULE_ID = "TPU011"
+DOC = ("blocking call (Future.result / untimed wait / join / send_request / "
+       "queue get) while holding a lock")
+
+
+def run(files: list[SourceFile], project=None) -> list[Finding]:
+    out: list[Finding] = []
+    if not any(sf.lock_scope for sf in files):
+        return out
+    la = analysis(files, project)
+    in_scope = {sf.relpath for sf in files if sf.lock_scope}
+
+    for fid, fc in la.func.items():
+        sf = project.functions[fid].sf
+        if sf.relpath not in in_scope:
+            continue
+        seen_lines = set()
+        for site in fc.blocking_sites:
+            held = la.effective_held(fid, site.held)
+            if held:
+                out.append(Finding(
+                    sf.relpath, site.line, RULE_ID,
+                    f"blocking {site.what} while holding lock "
+                    f"`{held[-1]}` — every other acquirer convoys behind "
+                    "this wait (deadlock if the awaited work needs the lock); "
+                    "resolve the wait outside the critical section"))
+                seen_lines.add(site.line)
+        for cs in fc.calls:
+            held = la.effective_held(fid, cs.held)
+            if not held or not cs.callees or cs.line in seen_lines:
+                continue
+            for c in cs.callees:
+                blk = la.reach_block.get(c)
+                if blk is not None:
+                    what, origin = blk
+                    out.append(Finding(
+                        sf.relpath, cs.line, RULE_ID,
+                        f"blocking {what} (at {origin}) reached via "
+                        f"`{cs.display}()` while holding lock "
+                        f"`{held[-1]}` — resolve the wait outside the "
+                        "critical section"))
+                    seen_lines.add(cs.line)
+                    break
+    return out

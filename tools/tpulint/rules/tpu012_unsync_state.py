@@ -1,0 +1,98 @@
+"""TPU012 — unsynchronized shared state on a lock-owning class.
+
+A class that owns a lock has declared its instances cross-thread; an attribute
+that is written under the lock in one method and written with NO lock in
+another is a data race by the class's own standard — the unlocked write can
+interleave mid-read-modify-write with the locked one, and the lock buys
+nothing (the lost-update shape: `self.count += 1` under the lock in one path,
+bare in another).
+
+Seeded by the known-concurrent core — DeviceBatcher, the breaker hierarchy,
+`_BoundedPool`, TransportService — but applies to every lock-owning class in
+scope: a class grows a lock exactly when its state went concurrent.
+
+Contract (kept deliberately narrow so the repo gate stays zero-FP):
+
+  - only WRITES count (Assign/AugAssign to `self.attr`); reads stay legal —
+    intentional lock-free reads (double-checked `_drainer_started`, stats
+    snapshots) are pervasive and often correct;
+  - `__init__` writes are pre-publication (no other thread can hold a
+    reference yet) and never count as the unlocked side;
+  - the attribute must have at least one write under a held lock AND one
+    unlocked write outside `__init__` — single-discipline attributes
+    (always locked, or a single-writer-thread design that never locks) are
+    silent. Findings anchor at each unlocked write;
+  - "locked" means the CLASS'S OWN lock (`Class.attr` keys), lexically held
+    or via the meet-over-call-sites context — a write that merely sits under
+    some unrelated lock still races the properly-guarded writes and counts
+    as unlocked.
+
+True positive::
+
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.active = 0
+        def start(self):
+            with self._lock:
+                self.active += 1
+        def finish(self):
+            self.active -= 1      # racing the locked increment
+
+False positive (stays silent): all writes locked; `__init__` plus locked
+writes; an unlocked-only counter owned by one thread.
+"""
+
+from __future__ import annotations
+
+from ..concurrency import analysis
+from ..engine import Finding, SourceFile
+
+RULE_ID = "TPU012"
+DOC = ("unsynchronized shared state: attribute of a lock-owning class written "
+       "both inside and outside its lock regions")
+
+
+def run(files: list[SourceFile], project=None) -> list[Finding]:
+    out: list[Finding] = []
+    if not any(sf.lock_scope for sf in files):
+        return out
+    la = analysis(files, project)
+    in_scope = {sf.relpath for sf in files if sf.lock_scope}
+
+    for ckey, ci in la.classes.items():
+        if not ci.lock_attrs or ci.sf.relpath not in in_scope:
+            continue
+        # synchronization means the CLASS'S OWN lock: a write that happens to
+        # sit under some unrelated lock still races the properly-guarded one
+        own_keys = {f"{ci.name}.{a}" for a in ci.lock_attrs}
+        writes: dict[str, list] = {}
+        for mname, fid in ci.methods.items():
+            fc = la.func.get(fid)
+            if fc is None:
+                continue
+            always = la.always_held.get(fid, frozenset())
+            for w in fc.writes:
+                if w.attr in ci.lock_attrs:
+                    continue
+                locked = bool(own_keys & (set(w.held) | always))
+                if locked != w.locked:
+                    # meet-over-call-sites context (a helper only ever invoked
+                    # under the class lock IS synchronized), or lexically held
+                    # but under the WRONG lock (not synchronization at all)
+                    w = type(w)(attr=w.attr, line=w.line, locked=locked,
+                                method=w.method, held=w.held)
+                writes.setdefault(w.attr, []).append(w)
+        for attr, ws in sorted(writes.items()):
+            locked = [w for w in ws if w.locked]
+            unlocked = [w for w in ws if not w.locked and w.method != "__init__"]
+            if not locked or not unlocked:
+                continue
+            for w in unlocked:
+                out.append(Finding(
+                    ci.sf.relpath, w.line, RULE_ID,
+                    f"`{ci.name}.{attr}` is written under a lock elsewhere "
+                    f"(e.g. line {locked[0].line}) but written here with no "
+                    "lock held — a racing read-modify-write loses updates; "
+                    "hold the lock for every write"))
+    return out

@@ -1,0 +1,132 @@
+"""TPU013 — bare `.acquire()` without a try/finally release on all paths.
+
+`with lock:` releases on every exit; a bare `lock.acquire()` releases only on
+the paths someone remembered. One exception between acquire and release and
+the lock is held forever — every later acquirer hangs, which in this codebase
+means a wedged drainer, a frozen transport dial, or a cluster-state thread
+that never runs another task. The reference's netty transport grew exactly
+this bug class; `with` (or acquire-then-immediately-try/finally) is the only
+sanctioned shape.
+
+Balanced forms (silent):
+
+    lock.acquire()                  if lock.acquire(timeout=1.0):
+    try:                                try:
+        ...                                 ...
+    finally:                            finally:
+        lock.release()                      lock.release()
+
+plus any acquire already inside a `try` whose `finally` releases the same
+lock. Everything else — acquire with no release, release outside a finally
+(the exception path leaks), release in a different block — is flagged at the
+acquire line.
+
+True positive::
+
+    self._lock.acquire()
+    self.count += 1          # an exception here pins the lock forever
+    self._lock.release()
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..concurrency import analysis
+from ..engine import Finding, SourceFile
+
+RULE_ID = "TPU013"
+DOC = "unbalanced lock.acquire(): no try/finally release on all paths"
+
+
+def _acquire_keys(expr: ast.AST, la, mod: str, cls: str | None) -> list[tuple]:
+    """(lock_key, line) for every `<lock>.acquire(...)` call in `expr`."""
+    out = []
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "acquire":
+            key = la._lock_key(node.func.value, mod, cls)
+            if key:
+                out.append((key, node.lineno))
+    return out
+
+
+def _release_keys(stmts: list, la, mod: str, cls: str | None) -> set:
+    out = set()
+    for s in stmts:
+        for node in ast.walk(s):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "release":
+                key = la._lock_key(node.func.value, mod, cls)
+                if key:
+                    out.add(key)
+    return out
+
+
+def _try_releases(stmt: ast.AST, la, mod: str, cls: str | None) -> set:
+    if isinstance(stmt, ast.Try):
+        return _release_keys(stmt.finalbody, la, mod, cls)
+    return set()
+
+
+def run(files: list[SourceFile], project=None) -> list[Finding]:
+    out: list[Finding] = []
+    if not any(sf.lock_scope for sf in files):
+        return out
+    la = analysis(files, project)
+    in_scope = {sf.relpath for sf in files if sf.lock_scope}
+
+    for fid, fc in la.func.items():
+        if not fc.acquire_calls:
+            continue
+        fi = project.functions[fid]
+        sf = fi.sf
+        if sf.relpath not in in_scope:
+            continue
+        mod = fi.module
+        ck = la.fid_class.get(fid)
+        cls = ck[1] if ck else None
+
+        def walk(stmts: list, guarded: frozenset):
+            for i, stmt in enumerate(stmts):
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue  # nested defs have their own FuncConc
+                header: list = []
+                body_guard_ok = False
+                if isinstance(stmt, (ast.Expr, ast.Assign, ast.AugAssign)):
+                    header = _acquire_keys(stmt, la, mod, cls)
+                elif isinstance(stmt, (ast.If, ast.While)):
+                    header = _acquire_keys(stmt.test, la, mod, cls)
+                    body_guard_ok = True
+                for key, line in header:
+                    if key in guarded:
+                        continue  # already inside try/finally that releases it
+                    nxt = stmts[i + 1] if i + 1 < len(stmts) else None
+                    if nxt is not None and key in _try_releases(nxt, la, mod, cls):
+                        continue
+                    if body_guard_ok and any(
+                            key in _try_releases(s, la, mod, cls)
+                            for s in stmt.body):
+                        continue
+                    out.append(Finding(
+                        sf.relpath, line, RULE_ID,
+                        f"bare `{key}.acquire()` with no try/finally release "
+                        "on all paths — one exception pins the lock forever; "
+                        "use `with` or acquire-then-try/finally"))
+                # recurse into nested statement lists
+                if isinstance(stmt, ast.Try):
+                    g = guarded | frozenset(
+                        _release_keys(stmt.finalbody, la, mod, cls))
+                    walk(stmt.body, g)
+                    for h in stmt.handlers:
+                        walk(h.body, g)
+                    walk(stmt.orelse, g)
+                    walk(stmt.finalbody, guarded)
+                else:
+                    for attr in ("body", "orelse", "finalbody"):
+                        sub = getattr(stmt, attr, None)
+                        if sub:
+                            walk(sub, guarded)
+
+        walk(fi.node.body, frozenset())
+    return out
