@@ -68,33 +68,69 @@ _PROBE_SRC = "import jax; print(jax.devices()[0].platform)"
 
 
 class BackendProbe:
-    """Async backend probe: short attempts, spread across the setup window.
+    """Async backend probe: short attempts, spread across the setup window,
+    with the FALLBACK decision cached on disk.
 
     The container may pin JAX_PLATFORMS to a TPU plugin whose initialization can
-    fail or hang (tunnel down, chip busy). Round 4 lost its TPU number to two
-    back-to-back 240 s probe timeouts; this version launches the probe subprocess
-    immediately, lets corpus/layout build overlap the first attempt, and retries
-    with shorter deadlines + backoff so a tunnel that recovers mid-window is
-    still caught. A hung subprocess is killed — it can never take the bench down.
+    fail or hang (tunnel down, chip busy). Round 4 lost 481.6 s of setup to two
+    back-to-back 240 s probe timeouts; attempts are now capped at ~30 s (like
+    tpu_probe.py) with a 60 s final attempt, and a run that settles for the CPU
+    fallback writes the decision to .bench_cache/backend_probe.json — the next
+    bench run (within BENCH_PROBE_CACHE_TTL, default 1 h) starts on CPU
+    immediately instead of re-discovering there is no TPU. Successful TPU
+    probes are never cached (they are fast, and staleness would silently pin a
+    recovered tunnel to CPU — only the negative outcome is worth remembering).
+    A hung subprocess is killed — it can never take the bench down.
     """
 
     def __init__(self):
-        self.timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 60))
-        # the last attempt gets a long deadline: a healthy-but-cold backend can
-        # legitimately take >60s to init, and killing it repeatedly would turn a
-        # slow TPU into a CPU fallback — the exact regression this class prevents
-        self.final_timeout = float(os.environ.get("BENCH_PROBE_FINAL_TIMEOUT", 180))
-        self.retries = int(os.environ.get("BENCH_PROBE_RETRIES", 4))
-        self.backoff = float(os.environ.get("BENCH_PROBE_BACKOFF", 15))
+        self.timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 30))
+        # the last attempt gets a longer deadline: a healthy-but-cold backend
+        # can take a while to init, and killing it repeatedly would turn a
+        # slow TPU into a CPU fallback — the regression this class prevents
+        self.final_timeout = float(os.environ.get("BENCH_PROBE_FINAL_TIMEOUT", 120))
+        self.retries = int(os.environ.get("BENCH_PROBE_RETRIES", 3))
+        self.backoff = float(os.environ.get("BENCH_PROBE_BACKOFF", 10))
+        self.cache_ttl = float(os.environ.get("BENCH_PROBE_CACHE_TTL", 3600))
+        self.cache_path = os.path.join(CACHE, "backend_probe.json")
         self.attempt = 0
         self.result: str | None = None
         self.proc: subprocess.Popen | None = None
         self.deadline = 0.0
         self.resume_at = 0.0  # backoff gate for the next launch
+        self.timed_out = False  # any attempt killed on deadline (not definitive)
         if os.environ.get("JAX_PLATFORMS", "") == "cpu":
             self.result = "cpu"
+            return
+        cached = self._read_cache()
+        if cached is not None:
+            self.result = cached
+            print(f"# backend probe: cached fallback [{cached}] "
+                  f"({self.cache_path})", file=sys.stderr)
         else:
             self._launch()
+
+    def _read_cache(self) -> str | None:
+        """A fresh cached CPU-fallback decision for the same platform env."""
+        try:
+            with open(self.cache_path) as f:
+                d = json.load(f)
+            if (d.get("platform", "").startswith("cpu")
+                    and d.get("jax_platforms") == os.environ.get("JAX_PLATFORMS", "")
+                    and time.time() - float(d.get("ts", 0)) < self.cache_ttl):
+                return d["platform"]
+        except Exception:  # noqa: BLE001 — unreadable cache = no cache
+            pass
+        return None
+
+    def _write_cache(self, platform: str):
+        try:
+            os.makedirs(CACHE, exist_ok=True)
+            with open(self.cache_path, "w") as f:
+                json.dump({"platform": platform, "ts": time.time(),
+                           "jax_platforms": os.environ.get("JAX_PLATFORMS", "")}, f)
+        except Exception as e:  # noqa: BLE001 — caching is best-effort
+            print(f"# backend probe cache write failed: {e}", file=sys.stderr)
 
     def _launch(self):
         self.attempt += 1
@@ -111,6 +147,12 @@ class BackendProbe:
         self.proc = None
         if self.attempt >= self.retries:
             self.result = "cpu (fallback)"
+            # cache only DEFINITIVE no-TPU outcomes (probe exited with an
+            # error): a timeout-killed probe may just be a cold backend, and
+            # caching it would pin the next hour of bench runs to CPU while
+            # the TPU was reachable the whole time
+            if not self.timed_out:
+                self._write_cache(self.result)
         else:
             self.resume_at = time.time() + self.backoff
 
@@ -127,6 +169,7 @@ class BackendProbe:
             if time.time() >= self.deadline:
                 self.proc.kill()
                 self.proc.communicate()
+                self.timed_out = True
                 self._fail("timed out")
             return None
         out, err = self.proc.communicate()
@@ -188,22 +231,26 @@ def norm_cache_table(norm_bytes, sum_ttf, n_docs):
 
 def build_layout(n_docs, vocab, post_offsets, post_docs, post_freqs, norm_bytes,
                  cache_tbl):
-    """Host-side packed device layout (cached): flat block arrays + baked tfn.
+    """Host-side packed device layout (cached): flat block arrays in the
+    QUANTIZED serving layout — docs i32 + tf (narrowest exact int dtype, f32
+    escape) + per-posting norm byte. The tf→tfn normalization happens inside
+    the scan (ops/scoring.sparse_candidates), so no baked f32 plane exists
+    anymore and the resident postings drop to 6 B/posting (u8 ladder).
 
     Pure numpy apart from device_index helpers, which are import-safe after the
     platform decision. Cached uncompressed so a warm 1M-doc bench loads in
     seconds instead of re-packing ~50M postings.
     """
     from elasticsearch_tpu.ops.device_index import (
-        BLOCK, TFN_BM25, _pow2_bucket, expand_ranges, tfn_values)
+        _TF_DTYPE, BLOCK, _pow2_bucket, choose_tf_layout, expand_ranges)
 
-    # v1 tags the baked-tfn formula (TFN_BM25 + K1/B + smallfloat decode); bump
-    # it when the scoring math changes or the cached flat_tfn would go stale
-    path = os.path.join(CACHE, f"layout_v1_{n_docs}_{vocab}_b{BLOCK}.npz")
+    # v2: quantized planes (flat_tf + flat_nb) replaced the baked-tfn plane;
+    # bump when the resident layout or the norm encoding changes
+    path = os.path.join(CACHE, f"layout_v2_{n_docs}_{vocab}_b{BLOCK}.npz")
     if os.path.exists(path):
         d = np.load(path)
-        return (d["flat_docs"], d["flat_freqs"], d["flat_tfn"], d["blk_start"],
-                int(d["NBpad"]), int(d["Dpad"]))
+        return (d["flat_docs"], d["flat_tf"], d["flat_nb"], d["blk_start"],
+                int(d["NBpad"]), int(d["Dpad"]), str(d["tf_layout"]))
     counts = np.diff(post_offsets)
     nblks = (counts + BLOCK - 1) // BLOCK
     blk_start = np.zeros(vocab + 1, dtype=np.int64)
@@ -216,14 +263,14 @@ def build_layout(n_docs, vocab, post_offsets, post_docs, post_freqs, norm_bytes,
     slots = expand_ranges(blk_start[:-1] * BLOCK, counts)
     flat_docs[slots] = post_docs
     flat_freqs[slots] = post_freqs
-    # pack-time tfn bake via the serving path's shared formula (device_index.tfn_values)
-    flat_tfn = np.zeros(NBpad * BLOCK, dtype=np.float32)
+    tf_layout = choose_tf_layout(post_freqs)
+    flat_tf = flat_freqs.astype(_TF_DTYPE[tf_layout])
+    flat_nb = np.zeros(NBpad * BLOCK, dtype=np.uint8)
     real = flat_docs < n_docs
-    flat_tfn[real] = tfn_values(flat_freqs[real], norm_bytes[flat_docs[real]],
-                                cache_tbl, TFN_BM25)
-    np.savez(path, flat_docs=flat_docs, flat_freqs=flat_freqs, flat_tfn=flat_tfn,
-             blk_start=blk_start, NBpad=NBpad, Dpad=Dpad)
-    return flat_docs, flat_freqs, flat_tfn, blk_start, NBpad, Dpad
+    flat_nb[real] = norm_bytes[flat_docs[real]]
+    np.savez(path, flat_docs=flat_docs, flat_tf=flat_tf, flat_nb=flat_nb,
+             blk_start=blk_start, NBpad=NBpad, Dpad=Dpad, tf_layout=tf_layout)
+    return flat_docs, flat_tf, flat_nb, blk_start, NBpad, Dpad, tf_layout
 
 
 def gen_queries(df, rng, batch):
@@ -258,6 +305,78 @@ def cpu_reference(post_offsets, post_docs, post_freqs, cache_tbl, norm_bytes, df
     return out_scores, out_docs
 
 
+def kernel_microbench(packed, sim, batches, k, iters=None):
+    """Kernel-only microbench: per-launch ms for the composed-jnp sparse scan
+    vs the fused Pallas `sparse_score` kernel on the SAME bucket shapes, plus
+    the resident-layout numbers — so a perf trajectory can attribute wins to
+    kernel time separately from end-to-end serving QPS. The fused leg runs
+    compiled on a real TPU; on the CPU fallback it is skipped by default
+    (interpret-mode timing is orders of magnitude off and would be noise, not
+    signal) unless BENCH_KERNEL_FUSED=1 forces the interpret leg."""
+    import jax
+
+    from elasticsearch_tpu.ops.device_index import (
+        bytes_per_posting, packed_resident_bytes)
+    from elasticsearch_tpu.ops.scoring import score_sparse_batch_async
+
+    iters = iters or int(os.environ.get("BENCH_KERNEL_ITERS", 16))
+
+    def time_launches(n_iters):
+        jax.block_until_ready(
+            [score_sparse_batch_async(packed, sb, k, sim=sim)
+             for sb in batches])  # warm (compiles under the current flag)
+        results = []
+        t0 = time.perf_counter()
+        for _ in range(n_iters):
+            results.extend(score_sparse_batch_async(packed, sb, k, sim=sim)
+                           for sb in batches)
+        jax.block_until_ready(results)
+        return (time.perf_counter() - t0) * 1000.0 / n_iters
+
+    platform = jax.devices()[0].platform
+    old = os.environ.get("ESTPU_PALLAS")
+    try:
+        os.environ["ESTPU_PALLAS"] = "0"
+        composed_ms = time_launches(iters)
+        fused_ms = None
+        fused_mode = "skipped"
+        # the fused leg must never kill the bench: Mosaic lowering of the
+        # in-kernel reduction is unvalidated on silicon (ROADMAP item 2), and
+        # losing the already-measured composed row to a compile error would be
+        # the same lost-round failure class the probe cache prevents
+        try:
+            if platform == "tpu":
+                os.environ["ESTPU_PALLAS"] = "1"
+                fused_mode = "tpu"
+                fused_ms = time_launches(iters)
+            elif os.environ.get("BENCH_KERNEL_FUSED"):
+                os.environ["ESTPU_PALLAS"] = "interpret"
+                fused_mode = "interpret"
+                fused_ms = time_launches(1)
+        except Exception as e:  # noqa: BLE001
+            fused_ms = None
+            fused_mode = f"failed: {type(e).__name__}: {e}"[:200]
+            print(f"# kernel fused leg failed: {fused_mode}", file=sys.stderr)
+    finally:
+        if old is None:
+            os.environ.pop("ESTPU_PALLAS", None)
+        else:
+            os.environ["ESTPU_PALLAS"] = old
+    shapes: dict = {}
+    for sb in batches:
+        key = f"{sb.qblk.shape[0]}x{sb.qblk.shape[1]}"
+        shapes[key] = shapes.get(key, 0) + 1
+    return {
+        "composed_ms": round(composed_ms, 3),
+        "fused_ms": round(fused_ms, 3) if fused_ms is not None else None,
+        "fused_mode": fused_mode,
+        "tf_layout": packed.tf_layout,
+        "bytes_per_posting": bytes_per_posting(packed.tf_layout),
+        "resident_postings_bytes": packed_resident_bytes(packed),
+        "bucket_shapes": shapes,
+    }
+
+
 def _device_hbm_bytes():
     """Resident device bytes, when the backend exposes them (TPU does)."""
     import jax
@@ -274,7 +393,8 @@ def run_config(n_docs, vocab, batch, n_batches, k, cpu_n=64, gate_n=8):
     import jax
     import jax.numpy as jnp
 
-    from elasticsearch_tpu.ops.device_index import BLOCK, PackedSegment
+    from elasticsearch_tpu.ops.device_index import (
+        BLOCK, TFN_BM25, PackedSegment, ensure_sim_tables)
     from elasticsearch_tpu.ops.scoring import (
         GROUP_SHOULD, plan_sparse_buckets, score_sparse_batch_async)
 
@@ -282,7 +402,7 @@ def run_config(n_docs, vocab, batch, n_batches, k, cpu_n=64, gate_n=8):
     post_offsets, post_docs, post_freqs, norm_bytes, sum_ttf, df = build_corpus(
         n_docs, vocab)
     cache_tbl = norm_cache_table(norm_bytes, sum_ttf, n_docs)
-    flat_docs, flat_freqs, flat_tfn, blk_start, NBpad, Dpad = build_layout(
+    flat_docs, flat_tf, flat_nb, blk_start, NBpad, Dpad, tf_layout = build_layout(
         n_docs, vocab, post_offsets, post_docs, post_freqs, norm_bytes, cache_tbl)
     max_doc = n_docs
 
@@ -295,13 +415,15 @@ def run_config(n_docs, vocab, batch, n_batches, k, cpu_n=64, gate_n=8):
     packed = PackedSegment(
         gen=1, doc_count=max_doc, doc_pad=Dpad,
         blk_docs=jnp.asarray(flat_docs.reshape(NBpad, BLOCK)),
-        blk_freqs=jnp.asarray(flat_freqs.reshape(NBpad, BLOCK)),
+        blk_tf=jnp.asarray(flat_tf.reshape(NBpad, BLOCK)),
+        blk_nb=jnp.asarray(flat_nb.reshape(NBpad, BLOCK)),
+        tf_layout=tf_layout,
         term_blk_start=blk_start,
         live_parent=jnp.asarray(live),
         norm_bytes={"body": jnp.asarray(np.pad(norm_bytes, (0, Dpad - max_doc)))},
-        blk_tfn=jnp.asarray(flat_tfn.reshape(NBpad, BLOCK)),
     )
-    jax.block_until_ready(packed.blk_tfn)
+    sim = ensure_sim_tables(packed, {"body": (TFN_BM25, cache_tbl)})
+    jax.block_until_ready(packed.blk_tf)
     hbm_after = _device_hbm_bytes()
     hbm_resident = (hbm_after - hbm_before) if (hbm_before is not None
                                                and hbm_after is not None) else None
@@ -309,13 +431,14 @@ def run_config(n_docs, vocab, batch, n_batches, k, cpu_n=64, gate_n=8):
 
     def make_plan(qterms):
         """Per-query clause lists → bucketed SparseBatches (the serving planner)."""
+        fid_body = sim.fid["body"]
         clause_lists = []
         for terms in qterms:
             cl = []
             for t in terms:
                 b0, b1 = int(blk_start[t]), int(blk_start[t + 1])
                 w = np.float32(idf_all[t] * (K1 + 1.0))
-                cl.append((b0, b1, float(w), GROUP_SHOULD, False))
+                cl.append((b0, b1, float(w), GROUP_SHOULD, False, fid_body))
             clause_lists.append(cl)
         Q = len(qterms)
         # tb_max=4096 keeps even 1M-doc zipf pool terms on the sparse path (the
@@ -332,7 +455,8 @@ def run_config(n_docs, vocab, batch, n_batches, k, cpu_n=64, gate_n=8):
         # device-resident batch arrays: serving uploads per batch; the bench reuses
         # one batch, so upload once and time pure device execution
         for sb in batches:
-            for fld in ("qblk", "qw", "qconst", "qcnt", "n_must", "msm", "coord"):
+            for fld in ("qblk", "qw", "qconst", "qcnt", "qfid", "n_must", "msm",
+                        "coord"):
                 setattr(sb, fld, jnp.asarray(getattr(sb, fld)))
         return batches
 
@@ -392,12 +516,21 @@ def run_config(n_docs, vocab, batch, n_batches, k, cpu_n=64, gate_n=8):
     cpu_s_per_query = (time.perf_counter() - t0) / cpu_n
     cpu_qps = 1.0 / cpu_s_per_query
 
+    # kernel-only row: same bucket shapes, composed vs fused, layout bytes
+    kernel_row = kernel_microbench(packed, sim, batches, k)
+    print(f"# kernel: composed {kernel_row['composed_ms']}ms/launch-set, fused "
+          f"{kernel_row['fused_ms']} ({kernel_row['fused_mode']}), "
+          f"{kernel_row['bytes_per_posting']} B/posting "
+          f"[{kernel_row['tf_layout']}], resident "
+          f"{kernel_row['resident_postings_bytes']}", file=sys.stderr)
+
     platform = jax.devices()[0].platform
     print(f"# [{n_docs} docs] setup {time.time()-t_setup:.1f}s  device batch "
           f"{device_s*1000:.1f}ms pipelined ({batch} queries)  sync-latency "
           f"{latency_s*1000:.1f}ms  cpu {cpu_qps:.1f} qps  hbm "
           f"{hbm_resident if hbm_resident is not None else 'n/a'}", file=sys.stderr)
     return {
+        "kernel": kernel_row,
         "metric": f"batched BM25 top-{k} queries/sec ({n_docs} docs, "
                   f"{TERMS_PER_QUERY}-term bool, batch {batch}, {platform})",
         "value": round(device_qps, 1),
@@ -613,8 +746,19 @@ def main():
         print(json.dumps({"metric": "ORDERING MISMATCH", "value": 0,
                           "unit": "error", "vs_baseline": 0}))
         sys.exit(1)
-    print(json.dumps({k: result[k] for k in
-                      ("metric", "value", "unit", "vs_baseline")}))
+    # the one stdout line grows a `kernel` stanza so per-launch kernel wins are
+    # attributable separately from end-to-end QPS; persisted alongside
+    # BENCH_SERVING.json for the trajectory
+    out_line = {k: result[k] for k in ("metric", "value", "unit", "vs_baseline")}
+    if "kernel" in result:
+        out_line["kernel"] = result["kernel"]
+        try:
+            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "BENCH_KERNEL.json"), "w") as f:
+                json.dump(result["kernel"], f, indent=1)
+        except Exception as e:  # noqa: BLE001 — persistence is best-effort
+            print(f"# kernel row persist failed: {e}", file=sys.stderr)
+    print(json.dumps(out_line))
     sys.stdout.flush()
 
     # ---- serving snapshot: batch occupancy into the BENCH tail --------------

@@ -58,6 +58,39 @@ def encode_norm(num_terms: np.ndarray | int, boost: float = 1.0) -> np.ndarray:
     return float_to_byte315(f)
 
 
+def jnp_norm_table():
+    """Device-side byte315 decode table: jnp float32 [256], the device twin of
+    NORM_TABLE. Built fresh per call (it is a 1 KB constant — callers that trace
+    it into a jitted program get it folded as a compile-time constant; eager
+    callers pay one explicit 1 KB upload). Kept out of module import so merely
+    importing the codec never touches a device."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(NORM_TABLE.astype(np.float32))
+
+
+def jnp_byte315_to_float(b):
+    """Device byte315 decode: uint8/int array → float32 via the 256-entry
+    table gather, bitwise-identical to host byte315_to_float. The reference
+    form of the decode the kernels inline themselves — the sparse scan gathers
+    jnp_norm_table-derived SimTables rows, the mesh program uses
+    jnp_norm_table directly — pinned against the host codec by
+    tests/test_quantized_postings.py. jnp.take, not fancy indexing: this may
+    run eagerly, where fancy indexing routes a scalar through an implicit
+    transfer the sanitizer rejects."""
+    import jax.numpy as jnp
+
+    return jnp.take(jnp_norm_table(), jnp.asarray(b).astype(jnp.int32))
+
+
+def jnp_doclen_table():
+    """Device-side BM25 doc-length table: jnp float32 [256], the device twin of
+    decode_norm_doclen over all bytes (dl = 1/f², byte 0 → length 0)."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(decode_norm_doclen(np.arange(256, dtype=np.uint8)))
+
+
 def decode_norm_tfidf(norm_byte: np.ndarray) -> np.ndarray:
     """TF-IDF: decoded norm multiplies the score directly."""
     return NORM_TABLE[np.asarray(norm_byte, dtype=np.uint8)]

@@ -1,22 +1,36 @@
-"""Device-resident packed postings.
+"""Device-resident packed postings — quantized layout.
 
 This is the TPU replacement for Lucene's on-heap postings traversal (SURVEY.md §2.8:
-"device-resident packed postings blocks, vmapped BM25 scoring, lax.top_k"). A frozen
-segment's CSR postings are re-blocked into fixed-shape device tensors:
+"device-resident packed postings blocks, vmapped BM25 scoring, lax.top_k"), playing the
+role of Lucene's packed postings codecs (PAPER.md §0): the resident form is quantized,
+not raw floats. A frozen segment's CSR postings are re-blocked into fixed-shape device
+tensors:
 
-    blk_docs  : int32 [NB, B]   — local doc ids, padded with `doc_pad` (out of range)
-    blk_freqs : float32 [NB, B] — term frequencies, padded with 0
+    blk_docs : int32 [NB, B]      — local doc ids, padded with `doc_pad` (out of range)
+    blk_tf   : uint8/int16 [NB, B] — term frequencies, quantized (raw tf is a
+               small integer; segments whose tf overflows the int ladder take the
+               float32 escape hatch — see choose_tf_layout)
+    blk_nb   : uint8 [NB, B]      — the posting's doc norm byte for the block's
+               owning field (Lucene's byte315 encoding, decoded IN the scan via a
+               256-entry similarity LUT — common/smallfloat.py)
+
+6 B/posting resident in the common uint8 layout (docs 4 + tf 1 + nb 1), down from the
+12 B/posting of the former f32 (freqs + baked-tfn) planes. The dense-fallback kernels
+still want an f32 freqs plane; it is NOT packed — `ensure_blk_freqs` uploads it lazily
+from the host copy the first time a segment actually feeds the dense path
+(ARCHITECTURE.md "HBM budget": the `blk_freqs`-drop rule).
 
 Each term owns a contiguous run of blocks (`term_blk_start[t] .. term_blk_start[t+1]`),
 so a query term's postings are a static-shape slice of block indices — the host builds
-flat (query, block, weight) triples and the scoring kernel is pure gather + FMA +
-scatter-add, no data-dependent shapes (XLA-friendly by construction).
+flat (query, block, weight) triples and the scoring kernel is pure gather + decode +
+FMA + scatter-add, no data-dependent shapes (XLA-friendly by construction).
 
 Shapes are padded to power-of-two buckets (NB rows, D docs) so recompilation stops once
 the shape buckets stabilize — segment churn from NRT refresh reuses cached executables.
 
-Norm bytes stay uint8 on device; similarity-specific 256-entry decode tables are gathered
-at score time, preserving Lucene's exact 1-byte quantization.
+Norm bytes stay uint8 on device; similarity-specific 256-entry decode tables
+(ensure_sim_tables) are gathered at score time, preserving Lucene's exact 1-byte
+quantization.
 """
 
 from __future__ import annotations
@@ -50,6 +64,54 @@ def expand_ranges(starts: np.ndarray, counts: np.ndarray) -> np.ndarray:
     return np.repeat(np.asarray(starts, dtype=np.int64), counts) + within
 
 
+# tf-plane layout ladder: uint8 covers real-text term frequencies (tf ≤ 255 for
+# essentially every (term, doc)); int16 is the overflow rung; float32 the escape
+# hatch for non-integral or >2^15-1 frequencies (synthetic corpora, index-time
+# boost folding). One dtype per segment plane — the decode in the scan is a
+# plain astype either way.
+TF_U8, TF_I16, TF_F32 = "u8", "i16", "f32"
+_TF_DTYPE = {TF_U8: np.uint8, TF_I16: np.int16, TF_F32: np.float32}
+
+
+def choose_tf_layout(post_freqs: np.ndarray) -> str:
+    """Pick the narrowest exact tf-plane dtype for a segment's raw frequencies.
+
+    Allocation-light on purpose — this runs inside pack_estimate_bytes, i.e.
+    BEFORE the breaker reservation: max() allocates nothing, and the
+    integrality scan works in bounded chunks (≤ 4 MB of temporaries) instead
+    of materializing floor/compare arrays over all postings at once."""
+    if len(post_freqs) == 0:
+        return TF_U8
+    mx = float(post_freqs.max())
+    if mx > 32767:
+        return TF_F32
+    if post_freqs.dtype.kind not in "iu":
+        chunk = 1 << 20
+        for i in range(0, len(post_freqs), chunk):
+            c = post_freqs[i: i + chunk]
+            if not np.all(c == np.floor(c)):
+                return TF_F32
+    return TF_U8 if mx <= 255 else TF_I16
+
+
+def tf_plane_itemsize(layout: str) -> int:
+    return np.dtype(_TF_DTYPE[layout]).itemsize
+
+
+@dataclass
+class SimTables:
+    """Stacked per-field similarity decode state for the quantized sparse scan:
+    one 256-entry f32 cache row + TFN_* mode per field. Replaces the old
+    per-posting baked-tfn plane — a table swap on avgdl drift costs 1 KB/field
+    instead of a full postings re-bake + HBM upload."""
+
+    fields: list  # field order = fid
+    fid: dict  # field -> row index
+    modes: object  # jnp int32 [F]
+    caches: object  # jnp float32 [F, 256]
+    key: dict  # field -> (mode, cache bytes) — staleness fingerprint
+
+
 @dataclass
 class PackedSegment:
     """Device tensors + host lookup tables for one frozen segment."""
@@ -58,18 +120,21 @@ class PackedSegment:
     doc_count: int  # real docs
     doc_pad: int  # padded D (bucketed)
     blk_docs: object  # jnp int32 [NBpad, B] — dead/non-parent docs masked to doc_pad
-    blk_freqs: object  # jnp float32 [NBpad, B]
     term_blk_start: np.ndarray  # host int64 [T+1]
     live_parent: object  # jnp bool [Dpad] — live & parent (searchable docs)
     norm_bytes: dict  # field -> jnp uint8 [Dpad]
     dv_single: dict = dc_field(default_factory=dict)  # field -> jnp float32/float64 [Dpad] single-valued fast path (NaN missing)
     live_version: int = 0
-    # sparse-path state (see ops/scoring.py score_sparse_batch): tfn = the
-    # weight-independent per-posting term-frequency factor, baked at pack time so the
-    # kernel needs NO per-posting norm gathers (the [M·B] random uint8 gather was the
-    # measured throughput ceiling: ~70 ms/batch vs ~5 ms for the row gather)
-    blk_tfn: object = None  # jnp float32 [NBpad, B] or None until first bake
-    tfn_tables: dict = dc_field(default_factory=dict)  # field -> (mode, cache bytes-hash)
+    # quantized sparse-path planes (the resident layout — see module docstring):
+    # tf decoded + normalized INSIDE the scan via the SimTables LUT, so no
+    # second f32 plane and no per-(field, similarity) re-bake
+    blk_tf: object = None  # jnp uint8/int16/float32 [NBpad, B]
+    blk_nb: object = None  # jnp uint8 [NBpad, B] — per-posting norm byte
+    tf_layout: str = TF_U8  # TF_U8 | TF_I16 | TF_F32
+    sim: SimTables | None = None  # ensure_sim_tables state
+    # dense-fallback plane, uploaded LAZILY (ensure_blk_freqs): most segments
+    # only ever serve the sparse path and never pay these 4 B/posting
+    blk_freqs: object = None  # jnp float32 [NBpad, B] or None until dense use
     # device metric-agg state: per-doc (count, sum, min, max, sumsq) rows per
     # numeric field, exact for MULTI-valued columns because the per-doc folds
     # happen host-side at build time (ops/scoring.score_agg_batch reduces them
@@ -92,21 +157,73 @@ class PackedSegment:
         return int(self.term_blk_start[tid]), int(self.term_blk_start[tid + 1])
 
 
-def pack_estimate_bytes(seg: FrozenSegment) -> int:
-    """Host-staging + device-upload bytes pack_segment will allocate — the
-    estimate the fielddata breaker checks BEFORE the first np.full. Derived
-    from the same shape math as the pack itself (docs+freqs staged host-side
-    AND uploaded, plus the Dpad-wide masks/columns)."""
+def pack_shape_math(seg: FrozenSegment) -> tuple[int, int, str]:
+    """(NBpad, Dpad, tf_layout) — the one shape+layout derivation shared by
+    pack_estimate_bytes and pack_segment, so the breaker estimate can never
+    drift from what the pack actually allocates. Memoized on the segment's
+    device cache: the estimate→pack sequence (packed_for) derives it once,
+    not once per caller (the layout scan is O(postings))."""
+    cache = getattr(seg, "_device_cache", None)
+    if cache is not None:
+        sm = cache.get("shape_math")
+        if sm is not None:
+            return sm
     counts = np.diff(seg.post_offsets)
     nblks = (counts + BLOCK - 1) // BLOCK
     NBpad = _pow2_bucket(int(nblks.sum()) + 1, 64)
     Dpad = _pow2_bucket(max(seg.doc_count, 1), 128)
+    sm = (NBpad, Dpad, choose_tf_layout(seg.post_freqs))
+    if cache is not None:
+        cache["shape_math"] = sm
+    return sm
+
+
+# pack-time host transients per slot, beyond the retained/uploaded planes:
+# the live-masked doc-id np.where result (4 B) plus the fid_per_slot ordinal
+# expansion (4 B) and the boolean gather/select masks (~4 B across real/sel
+# temps) — freed by the end of the pack but live at its allocation peak,
+# which is what the breaker reservation must cover
+PACK_TRANSIENT_SLOT_BYTES = 12
+
+
+def pack_estimate_bytes(seg: FrozenSegment) -> int:
+    """Host-staging + device-upload bytes pack_segment will allocate — the
+    estimate the fielddata breaker checks BEFORE the first np.full. Derived
+    from the same shape+layout math as the pack itself (pack_shape_math):
+    docs i32 and freqs f32 are staged host-side (kept for live-mask re-masks
+    and the lazy dense plane); the DEVICE copy is the quantized layout —
+    docs i32 + tf (u8/i16/f32 per choose_tf_layout) + norm byte u8 — plus the
+    quantize/nb staging, a PACK_TRANSIENT_SLOT_BYTES allowance for the
+    masking/ordinal temps live at the pack's peak, and the Dpad-wide
+    masks/columns. The lazy dense plane is NOT in here — ensure_blk_freqs
+    reserves it at its own allocation site."""
+    NBpad, Dpad, layout = pack_shape_math(seg)
+    tf_b = tf_plane_itemsize(layout)
     n_norm_fields = len(seg.norms)
     n_dv = len(seg.dv_num)
-    # (docs i32 + freqs f32) × (host staging + device copy) + live mask +
-    # norms u8 + single-valued dv f64 columns
-    return (NBpad * BLOCK * 8 * 2 + Dpad * 2
+    # host staging: docs i32 + freqs f32 + tf + nb;  device: docs i32 + tf + nb
+    per_slot = (4 + 4 + tf_b + 1) + (4 + tf_b + 1) + PACK_TRANSIENT_SLOT_BYTES
+    # + live mask (host + device) + norms u8 + single-valued dv f64 columns
+    return (NBpad * BLOCK * per_slot + Dpad * 2
             + Dpad * n_norm_fields + Dpad * 8 * n_dv)
+
+
+def packed_resident_bytes(packed: PackedSegment) -> int:
+    """Actual device-RESIDENT postings-plane bytes of a packed segment (docs +
+    tf + nb, plus the dense f32 plane if it has been faulted in) — what the
+    bench `kernel` row and the breaker-estimate test compare against."""
+    total = 0
+    for plane in (packed.blk_docs, packed.blk_tf, packed.blk_nb,
+                  packed.blk_freqs):
+        if plane is not None:
+            total += int(np.prod(plane.shape)) * np.dtype(plane.dtype).itemsize
+    return total
+
+
+def bytes_per_posting(layout: str, dense_resident: bool = False) -> int:
+    """Resident bytes per posting slot for a tf layout: docs i32 + tf + nb
+    (+ the lazy dense f32 plane when faulted in)."""
+    return 4 + tf_plane_itemsize(layout) + 1 + (4 if dense_resident else 0)
 
 
 def pack_segment(seg: FrozenSegment, fields: list[str] | None = None,
@@ -128,8 +245,8 @@ def pack_segment(seg: FrozenSegment, fields: list[str] | None = None,
     NB = int(blk_start[-1])
     # +1 guarantees at least one all-sentinel row past the real blocks — the scoring
     # batch points its padding triples at row NBpad-1, which must never hold postings
-    NBpad = _pow2_bucket(NB + 1, 64)
-    Dpad = _pow2_bucket(max(seg.doc_count, 1), 128)
+    # (shape+layout math shared with pack_estimate_bytes via pack_shape_math)
+    NBpad, Dpad, tf_layout = pack_shape_math(seg)
 
     flat_docs = np.full(NBpad * BLOCK, Dpad, dtype=np.int32)  # pad → out-of-range slot
     flat_freqs = np.zeros(NBpad * BLOCK, dtype=np.float32)
@@ -174,14 +291,32 @@ def pack_segment(seg: FrozenSegment, fields: list[str] | None = None,
     # scoring path needs a per-posting live gather; host_docs keeps the raw ids for
     # re-masking when tombstones change
     masked_docs = np.where(live_parent[np.minimum(flat_docs, Dpad - 1)]
-                           & (flat_docs < Dpad), flat_docs, Dpad).astype(np.int32)
+                           & (flat_docs < Dpad), flat_docs,
+                           Dpad).astype(np.int32, copy=False)
+
+    # quantized tf plane (exact by layout choice: u8/i16 for small-int tf,
+    # f32 escape otherwise) + per-posting norm byte of the block's owning
+    # field — the two 1-byte planes the sparse scan decodes on device
+    flat_tf = flat_freqs.astype(_TF_DTYPE[tf_layout])
+    flat_nb = np.zeros(NBpad * BLOCK, dtype=np.uint8)
+    fid_per_slot = np.repeat(blk_field, BLOCK)
+    real = flat_docs < seg.doc_count
+    for fo, fname in enumerate(field_names):
+        norms = seg.norms.get(fname)
+        if norms is None:
+            continue  # norm-less field (meta fields): byte stays 0
+        sel = (fid_per_slot == fo) & real
+        if sel.any():
+            flat_nb[sel] = norms[flat_docs[sel]]
 
     return PackedSegment(
         gen=seg.gen,
         doc_count=seg.doc_count,
         doc_pad=Dpad,
         blk_docs=put(masked_docs.reshape(NBpad, BLOCK)),
-        blk_freqs=put(flat_freqs.reshape(NBpad, BLOCK)),
+        blk_tf=put(flat_tf.reshape(NBpad, BLOCK)),
+        blk_nb=put(flat_nb.reshape(NBpad, BLOCK)),
+        tf_layout=tf_layout,
         term_blk_start=blk_start,
         live_parent=put(live_parent),
         norm_bytes=norm_bytes,
@@ -191,6 +326,27 @@ def pack_segment(seg: FrozenSegment, fields: list[str] | None = None,
         blk_field=blk_field,
         field_names=field_names,
     )
+
+
+def ensure_blk_freqs(packed: PackedSegment, breaker=None):
+    """Lazily fault in the dense-fallback f32 freqs plane (the `blk_freqs`-drop
+    rule: pack_segment no longer uploads it, so sparse-only segments stay at
+    the quantized 6 B/posting). Idempotent; a concurrent double-upload is
+    benign (same values, last assignment wins).
+
+    `breaker` (fielddata) reserves the plane's bytes around the upload — the
+    same transient estimate-before-allocate contract as packed_for, and the
+    same graceful degradation: a trip raises CircuitBreakingError and serving
+    falls back to the host scorer. The dense call sites in search/execute.py
+    pass it; the unaccounted default exists only for the direct-kernel tests
+    and for segments whose plane is already resident."""
+    if packed.blk_freqs is None:
+        import jax.numpy as jnp
+
+        with reserve(breaker, packed.host_freqs.nbytes, "<dense_freqs>"):
+            packed.blk_freqs = jnp.asarray(
+                packed.host_freqs.reshape(-1, BLOCK))
+    return packed.blk_freqs
 
 
 def agg_doc_rows(seg: FrozenSegment, field: str) -> np.ndarray | None:
@@ -289,58 +445,57 @@ TFN_TFIDF = 1  # tfn = sqrt(f) * cache[norm_byte]
 
 def tfn_values(freqs: np.ndarray, nb: np.ndarray, cache: np.ndarray,
                mode: int) -> np.ndarray:
-    """The per-posting tfn formula — the single definition shared by ensure_tfn and
-    bench packing, so the bench provably measures the serving bake."""
+    """The per-posting tfn formula — the single HOST definition of what the
+    quantized scan computes on device (ops/scoring.sparse_candidates decodes
+    blk_tf/blk_nb and applies exactly this, f32 op order included). Kept as
+    the reference the parity tests and the bench check against."""
     cv = cache[nb]
     if mode == TFN_BM25:
         return (freqs / (freqs + cv)).astype(np.float32)
     return np.sqrt(freqs, dtype=np.float32) * cv
 
 
-def ensure_tfn(seg: FrozenSegment, packed: PackedSegment,
-               tables: dict[str, tuple[int, np.ndarray]]) -> None:
-    """Bake (or re-bake) the per-posting tfn tensor for the given per-field similarity
-    tables ({field: (TFN_* mode, float32[256] cache)}).
+def ensure_sim_tables(packed: PackedSegment,
+                      tables: dict[str, tuple[int, np.ndarray]]) -> SimTables:
+    """Ensure the stacked per-field similarity LUTs for the given tables
+    ({field: (TFN_* mode, float32[256] cache)}) and return the SimTables whose
+    `fid` maps fields to cache rows for this launch.
 
-    The bake folds the norm-byte lookup into the stored postings, which is what makes
-    the sparse kernel gather-free. It must re-run when a field's cache table changes —
-    for BM25 that is whenever avgdl (sum_ttf/max_doc) moves, i.e. after indexing
-    activity; Lucene recomputes the same table per query (BM25Similarity's norm cache),
-    we recompute per stats-change and reuse across queries. Cost: one numpy pass over
-    the segment's postings + one HBM upload, amortized over every batch until the next
-    stats change."""
-    current = packed.tfn_tables
-    if packed.blk_tfn is not None and all(
-        f in current and current[f][0] == mode and current[f][1] == cache.tobytes()
+    This replaced the per-posting tfn bake: the tf→tfn normalization now
+    happens INSIDE the sparse scan (quantized tf + norm byte + this LUT), so a
+    cache-table change — for BM25 whenever avgdl (sum_ttf/max_doc) moves, i.e.
+    after indexing activity — costs a 1 KB/field table swap instead of a numpy
+    pass over every posting plus a full-plane HBM upload. Fields accumulate
+    across calls (stable fid rows per merged set); callers must use the
+    RETURNED object's fid/caches for the launch they plan — a concurrent
+    re-ensure swaps packed.sim but never mutates an existing SimTables."""
+    cur = packed.sim
+    if cur is not None and all(
+        f in cur.key and cur.key[f] == (mode, cache.tobytes())
         for f, (mode, cache) in tables.items()
     ):
-        return
+        return cur
     import jax.numpy as jnp
 
-    merged = dict(current)
+    merged = dict(cur.key) if cur is not None else {}
     for f, (mode, cache) in tables.items():
         merged[f] = (mode, cache.tobytes())
-    NBpad, B = packed.host_docs.shape[0] // BLOCK, BLOCK
-    flat_docs = packed.host_docs
-    flat_freqs = packed.host_freqs
-    flat_tfn = np.zeros(NBpad * B, dtype=np.float32)
-    fid_per_slot = np.repeat(packed.blk_field, B)
-    for fo, fname in enumerate(packed.field_names):
-        entry = merged.get(fname)
-        if entry is None:
-            continue
-        mode, cache_bytes = entry
-        cache = np.frombuffer(cache_bytes, dtype=np.float32)
-        sel = (fid_per_slot == fo) & (flat_docs < seg.doc_count)
-        if not sel.any():
-            continue
-        d = flat_docs[sel]
-        f32 = flat_freqs[sel]
-        norms = seg.norms.get(fname)
-        nb = norms[d] if norms is not None else np.zeros(len(d), np.uint8)
-        flat_tfn[sel] = tfn_values(f32, nb, cache, mode)
-    packed.blk_tfn = jnp.asarray(flat_tfn.reshape(NBpad, B))
-    packed.tfn_tables = merged
+    fields = list(merged.keys())
+    if fields:
+        modes = np.array([merged[f][0] for f in fields], dtype=np.int32)
+        caches = np.stack([np.frombuffer(merged[f][1], dtype=np.float32)
+                           for f in fields])
+    else:
+        # fieldless batch (e.g. empty analyzed query): one neutral row so the
+        # kernel ABI keeps its [F, 256] shape — only padding slots (zeroed by
+        # the valid mask) ever read it
+        modes = np.zeros(1, dtype=np.int32)
+        caches = np.ones((1, 256), dtype=np.float32)
+    sim = SimTables(fields=fields, fid={f: i for i, f in enumerate(fields)},
+                    modes=jnp.asarray(modes), caches=jnp.asarray(caches),
+                    key=merged)
+    packed.sim = sim
+    return sim
 
 
 def packed_for(seg: FrozenSegment, breaker=None) -> PackedSegment:
@@ -368,7 +523,8 @@ def packed_for(seg: FrozenSegment, breaker=None) -> PackedSegment:
         # live gather) — re-mask from the raw host copy
         masked = np.where(live_parent[np.minimum(packed.host_docs, packed.doc_pad - 1)]
                           & (packed.host_docs < packed.doc_pad),
-                          packed.host_docs, packed.doc_pad).astype(np.int32)
+                          packed.host_docs,
+                          packed.doc_pad).astype(np.int32, copy=False)
         packed.blk_docs = jnp.asarray(masked.reshape(-1, BLOCK))
         cache["live"] = True
     return packed

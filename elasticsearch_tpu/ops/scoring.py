@@ -33,7 +33,13 @@ from dataclasses import dataclass, field as dc_field
 import numpy as np
 
 from ..common.breaker import reserve
-from .device_index import BLOCK, PackedSegment, _pow2_bucket
+from .device_index import (
+    BLOCK,
+    TFN_BM25,
+    PackedSegment,
+    _pow2_bucket,
+    ensure_blk_freqs,
+)
 
 GROUP_SHOULD, GROUP_MUST, GROUP_MUST_NOT = 0, 1, 2
 _MUST_SHIFT, _NOT_SHIFT = 10, 20
@@ -125,7 +131,7 @@ def _dense_accumulate(blk_docs, blk_freqs, norms_stack, caches,
     cache_vals = caches[fidx[:, None], nb.astype(jnp.int32)]  # [M, B]
 
     # float op ORDER matters for bit-parity with the host scorer and the sparse
-    # kernel's baked tfn (device_index.ensure_tfn): the tf factor is computed FIRST,
+    # kernel's in-scan tfn (sparse_candidates): the tf factor is computed FIRST,
     # then multiplied by the weight — Lucene's weight·tfNorm order
     # (BM25Similarity.BM25DocScorer / TFIDFSimilarity.ExactSimScorer)
     mode = tfmode[:, None]
@@ -363,7 +369,8 @@ def score_fs_rows_batch(packed: PackedSegment, batch: TermBatch, k: int,
         "rows", batch.n_queries, min(k, packed.doc_pad), packed.doc_pad,
         bmode=bmode, use_min_score=min_score is not None, no_functions=no_functions)
     out = fn(
-        packed.blk_docs, packed.blk_freqs, packed.live_parent, norms_stack, caches,
+        packed.blk_docs, ensure_blk_freqs(packed), packed.live_parent,
+        norms_stack, caches,
         jnp.asarray(batch.qidx), jnp.asarray(batch.blk), jnp.asarray(batch.weight),
         jnp.asarray(batch.fidx), jnp.asarray(batch.group), jnp.asarray(batch.tfmode),
         jnp.asarray(batch.n_must), jnp.asarray(batch.msm), jnp.asarray(batch.coord),
@@ -389,7 +396,8 @@ def score_fs_script_batch(packed: PackedSegment, batch: TermBatch, k: int,
         use_min_score=min_score is not None, has_filter=has_filter,
         has_weight=weight is not None)
     top_scores, top_docs, total, bad = fn(
-        packed.blk_docs, packed.blk_freqs, packed.live_parent, norms_stack, caches,
+        packed.blk_docs, ensure_blk_freqs(packed), packed.live_parent,
+        norms_stack, caches,
         jnp.asarray(batch.qidx), jnp.asarray(batch.blk), jnp.asarray(batch.weight),
         jnp.asarray(batch.fidx), jnp.asarray(batch.group), jnp.asarray(batch.tfmode),
         jnp.asarray(batch.n_must), jnp.asarray(batch.msm), jnp.asarray(batch.coord),
@@ -485,7 +493,8 @@ def score_sorted_batch(packed: PackedSegment, batch: TermBatch, k: int,
     if fmask is None:
         fmask = np.ones((1, 1), dtype=bool)
     top_keys, top_docs, top_scores, qmax, total = fn(
-        packed.blk_docs, packed.blk_freqs, packed.live_parent, norms_stack, caches,
+        packed.blk_docs, ensure_blk_freqs(packed), packed.live_parent,
+        norms_stack, caches,
         jnp.asarray(batch.qidx), jnp.asarray(batch.blk), jnp.asarray(batch.weight),
         jnp.asarray(batch.fidx), jnp.asarray(batch.group), jnp.asarray(batch.tfmode),
         jnp.asarray(batch.n_must), jnp.asarray(batch.msm), jnp.asarray(batch.coord),
@@ -608,7 +617,8 @@ def score_agg_batch(packed: PackedSegment, batch: TermBatch, k: int,
         # transferring a full all-true mask on the unfiltered aggs hot path
         fmask = np.ones((1, 1), dtype=bool)
     out = fn(
-        packed.blk_docs, packed.blk_freqs, packed.live_parent, norms_stack, caches,
+        packed.blk_docs, ensure_blk_freqs(packed), packed.live_parent,
+        norms_stack, caches,
         jnp.asarray(batch.qidx), jnp.asarray(batch.blk), jnp.asarray(batch.weight),
         jnp.asarray(batch.fidx), jnp.asarray(batch.group), jnp.asarray(batch.tfmode),
         jnp.asarray(batch.n_must), jnp.asarray(batch.msm), jnp.asarray(batch.coord),
@@ -650,7 +660,8 @@ def score_term_batch_async(packed: PackedSegment, batch: TermBatch, k: int):
     fn = _get_compiled(Q, min(k, packed.doc_pad), packed.doc_pad,
                        _detect_simple(batch))
     return fn(
-        packed.blk_docs, packed.blk_freqs, packed.live_parent, norms_stack, caches,
+        packed.blk_docs, ensure_blk_freqs(packed), packed.live_parent,
+        norms_stack, caches,
         jnp.asarray(batch.qidx), jnp.asarray(batch.blk), jnp.asarray(batch.weight),
         jnp.asarray(batch.fidx), jnp.asarray(batch.group), jnp.asarray(batch.tfmode),
         jnp.asarray(batch.n_must), jnp.asarray(batch.msm), jnp.asarray(batch.coord),
@@ -667,7 +678,8 @@ def score_term_batch(packed: PackedSegment, batch: TermBatch, k: int) -> ScoreRe
     fn = _get_compiled(Q, min(k, packed.doc_pad), packed.doc_pad,
                        _detect_simple(batch))
     top_scores, top_docs, total = fn(
-        packed.blk_docs, packed.blk_freqs, packed.live_parent, norms_stack, caches,
+        packed.blk_docs, ensure_blk_freqs(packed), packed.live_parent,
+        norms_stack, caches,
         jnp.asarray(batch.qidx), jnp.asarray(batch.blk), jnp.asarray(batch.weight),
         jnp.asarray(batch.fidx), jnp.asarray(batch.group), jnp.asarray(batch.tfmode),
         jnp.asarray(batch.n_must), jnp.asarray(batch.msm), jnp.asarray(batch.coord),
@@ -697,9 +709,14 @@ def finalize_score_result(scores: np.ndarray, docs: np.ndarray, total: np.ndarra
 # (search/query/QueryPhase.java:95-137 walks a merged postings enum; we materialize the
 # merged candidate list per query and reduce it in parallel):
 #
-#   1. row-gather each query's postings blocks            [Qb, TB, B]   (~5 ms DMA)
-#   2. contribution = weight · baked tfn                  (no norm gathers — see
-#      device_index.ensure_tfn; the [M·B] random uint8 gather was ~70 ms)
+#   1. row-gather each query's QUANTIZED postings blocks  [Qb, TB, B]   (~5 ms DMA;
+#      6 B/posting resident — docs i32 + tf u8 + norm byte u8, see
+#      device_index module docstring)
+#   2. contribution = weight · tfn, decoded IN the scan: tf widened from the
+#      int plane, norm byte through the per-field 256-entry similarity LUT
+#      (SimTables — replaces the pack-time baked-tfn f32 plane; the per-doc
+#      [M·B] random uint8 gather the bake used to avoid stays avoided because
+#      the norm byte is stored per POSTING, a streaming row access)
 #   3. sort candidates by doc id per query                [Qb, P] pairs (~6 ms)
 #   4. doubling-pass segment-sum merges duplicate docs (run length ≤ clause count)
 #   5. bool semantics on the summed match counters at run ends
@@ -740,8 +757,8 @@ class SparseScratchPool:
 
     @staticmethod
     def staging_bytes(Qb: int, tb: int) -> int:
-        # qblk i32 + qw f32 + qconst bool + qcnt i32
-        return Qb * tb * (4 + 4 + 1 + 4)
+        # qblk i32 + qw f32 + qconst bool + qcnt i32 + qfid i32
+        return Qb * tb * (4 + 4 + 1 + 4 + 4)
 
     def take(self, Qb: int, tb: int, sentinel_row: int):
         with self._lock:
@@ -753,14 +770,16 @@ class SparseScratchPool:
             return (np.full((Qb, tb), sentinel_row, np.int32),
                     np.zeros((Qb, tb), np.float32),
                     np.zeros((Qb, tb), bool),
+                    np.zeros((Qb, tb), np.int32),
                     np.zeros((Qb, tb), np.int32))
         with self._lock:
             self.reuses += 1
-        qblk, qw, qconst, qcnt = arrs
+        qblk, qw, qconst, qcnt, qfid = arrs
         qblk.fill(sentinel_row)
         qw.fill(0.0)
         qconst.fill(False)
         qcnt.fill(0)
+        qfid.fill(0)
         return arrs
 
     def give(self, arrs):
@@ -782,6 +801,7 @@ class SparseBatch:
     qw: np.ndarray  # float32 [Qb, TB] — clause weight (0 for must_not/padding)
     qconst: np.ndarray  # bool [Qb, TB] — constant-score clause (contribution = w)
     qcnt: np.ndarray  # int32 [Qb, TB] — packed group counter (should/must/must_not bit)
+    qfid: np.ndarray  # int32 [Qb, TB] — SimTables cache row of the clause's field
     n_must: np.ndarray  # int32 [Qb]
     msm: np.ndarray  # int32 [Qb]
     coord: np.ndarray  # float32 [Qb, C+1]
@@ -789,29 +809,49 @@ class SparseBatch:
     simple: bool  # pure-should all-BM25 msm<=1 no-coord (match ≡ score>0)
 
 
-def _sparse_impl(blk_docs, blk_tfn, qblk, qw, qconst, qcnt, n_must, msm, coord,
-                 *, k: int, doc_pad: int, passes: int, simple: bool,
-                 use_coord: bool, use_pallas: bool = False):
+def sparse_candidates(blk_docs, blk_tf, blk_nb, caches, modes,
+                      qblk, qw, qconst, qfid, *, doc_pad: int):
+    """The decode half of the quantized sparse scan: row-gather each query's
+    postings blocks and compute per-posting contributions IN the scan —
+    quantized tf widened to f32, norm byte through the per-field 256-entry
+    similarity LUT (device_index.SimTables; the byte315 quantization survives
+    all the way into the kernel), tf→tfn in the same f32 op order as the host
+    reference (device_index.tfn_values), weight last.
+
+    Returns (docs [Qb, TB, B] i32, contrib [Qb, TB, B] f32 — zeroed on invalid
+    slots, valid [Qb, TB, B] bool)."""
+    import jax.numpy as jnp
+
+    docs = blk_docs[qblk]  # [Qb, TB, B]
+    tf = blk_tf[qblk].astype(jnp.float32)  # u8/i16 widen; f32 escape = no-op
+    nb = blk_nb[qblk].astype(jnp.int32)
+    # per-field LUT decode as ONE flat gather (row*256 + byte) — XLA lowers a
+    # single-index gather better than the 2-axis advanced-indexing form
+    cv = caches.reshape(-1)[qfid[:, :, None] * 256 + nb]  # [Qb, TB, B]
+    mode = modes[qfid][:, :, None]
+    # tf factor first, then weight — Lucene's weight·tfNorm rounding order
+    # (shared with the dense kernel and HostScorer)
+    tfn = jnp.where(mode == TFN_BM25, tf / (tf + cv), jnp.sqrt(tf) * cv)
+    contrib = qw[:, :, None] * jnp.where(qconst[:, :, None], 1.0, tfn)
+    valid = docs < doc_pad
+    return docs, jnp.where(valid, contrib, 0.0), valid
+
+
+def sparse_reduce(docs, contrib, cnt, n_must, msm, coord,
+                  *, k: int, doc_pad: int, passes: int, simple: bool,
+                  use_coord: bool):
+    """The reduction half: sort candidates by doc id, segment-sum duplicate
+    docs (log2 doubling), bool semantics on the folded counters, top-k.
+    [Qb, P] in → ([Qb, k] scores, [Qb, k] docs, [Qb] totals).
+
+    ONE definition executed by BOTH the composed-jnp path and the fused Pallas
+    kernel's final grid step (pallas_kernels.sparse_score runs it on the VMEM
+    accumulator with Qb=1) — bitwise parity between the two paths is by
+    construction, not by test tolerance. `cnt` may be None when simple."""
     import jax
     import jax.numpy as jnp
 
-    Qb, TB = qblk.shape
-    P = TB * BLOCK
-    if use_pallas:
-        # scalar-prefetch DMA gather fused with the weight multiply
-        # (ops/pallas_kernels.py; parity-tested against the XLA formulation)
-        from .pallas_kernels import gather_scale
-
-        docs, contrib = gather_scale(qblk, qw, qconst, blk_docs, blk_tfn)
-        valid = docs < doc_pad
-    else:
-        docs = blk_docs[qblk]  # [Qb, TB, B]
-        tfn = blk_tfn[qblk]
-        valid = docs < doc_pad
-        contrib = qw[:, :, None] * jnp.where(qconst[:, :, None], 1.0, tfn)
-    contrib = jnp.where(valid, contrib, 0.0)
-    docs = docs.reshape(Qb, P)
-    contrib = contrib.reshape(Qb, P)
+    Qb = docs.shape[0]
 
     def segsum(docs_s, vals_list):
         # duplicate docs form runs of length <= clause count after the sort;
@@ -840,7 +880,6 @@ def _sparse_impl(blk_docs, blk_tfn, qblk, qw, qconst, qcnt, n_must, msm, coord,
         top_docs = jnp.take_along_axis(docs_s, idx, axis=1)
         return top_scores, top_docs, match.sum(axis=1, dtype=jnp.int32)
 
-    cnt = jnp.where(valid, qcnt[:, :, None], 0).reshape(Qb, P)
     docs_s, c_s, n_s = jax.lax.sort((docs, contrib, cnt), num_keys=1)
     c_s, n_s = segsum(docs_s, [c_s, n_s])
     is_last = jnp.concatenate(
@@ -865,6 +904,41 @@ def _sparse_impl(blk_docs, blk_tfn, qblk, qw, qconst, qcnt, n_must, msm, coord,
     return top_scores, top_docs, match.sum(axis=1, dtype=jnp.int32)
 
 
+def _sparse_impl(blk_docs, blk_tf, blk_nb, caches, modes,
+                 qblk, qw, qconst, qcnt, qfid, n_must, msm, coord,
+                 *, k: int, doc_pad: int, passes: int, simple: bool,
+                 use_coord: bool, use_pallas: bool = False):
+    import jax.numpy as jnp
+
+    Qb, TB = qblk.shape
+    P = TB * BLOCK
+    if use_pallas:
+        # fully-fused Pallas kernel: scalar-prefetch streaming of the quantized
+        # block rows, in-scan decode, counter fold and per-query VMEM candidate
+        # accumulator — the [Qb, P] matrix never round-trips HBM
+        # (ops/pallas_kernels.py sparse_score; parity by shared sparse_reduce)
+        from .pallas_kernels import sparse_score
+
+        # jnp.take (not advanced indexing): this may run EAGERLY in tests, and
+        # eager fancy indexing routes a scalar through an implicit transfer
+        # the transfer_guard("disallow") sanitizer rejects
+        return sparse_score(
+            qblk, qw, qconst, qcnt, qfid, jnp.take(modes, qfid), n_must, msm,
+            coord, blk_docs, blk_tf, blk_nb, caches,
+            k=k, doc_pad=doc_pad, passes=passes, simple=simple,
+            use_coord=use_coord)
+    docs, contrib, valid = sparse_candidates(
+        blk_docs, blk_tf, blk_nb, caches, modes, qblk, qw, qconst, qfid,
+        doc_pad=doc_pad)
+    docs = docs.reshape(Qb, P)
+    contrib = contrib.reshape(Qb, P)
+    cnt = (None if simple
+           else jnp.where(valid, qcnt[:, :, None], 0).reshape(Qb, P))
+    return sparse_reduce(docs, contrib, cnt, n_must, msm, coord,
+                         k=k, doc_pad=doc_pad, passes=passes, simple=simple,
+                         use_coord=use_coord)
+
+
 def _get_sparse_compiled(Qb: int, TB: int, k: int, doc_pad: int, passes: int,
                          simple: bool, use_coord: bool, coord_w: int):
     import jax
@@ -886,11 +960,14 @@ def _get_sparse_compiled(Qb: int, TB: int, k: int, doc_pad: int, passes: int,
     return fn
 
 
-def score_sparse_batch_async(packed: PackedSegment, sb: SparseBatch, k: int):
-    """Launch one sparse bucket; returns device arrays (scores, docs, totals) without
-    syncing. Requires packed.blk_tfn (device_index.ensure_tfn)."""
+def score_sparse_batch_async(packed: PackedSegment, sb: SparseBatch, k: int,
+                             sim=None):
+    """Launch one sparse bucket; returns device arrays (scores, docs, totals)
+    without syncing. `sim` is the SimTables the planner resolved fids against
+    (device_index.ensure_sim_tables); defaults to the segment's current one."""
     import jax.numpy as jnp
 
+    sim = sim if sim is not None else packed.sim
     Qb, TB = sb.qblk.shape
     P = TB * BLOCK
     k_eff = min(k, P)
@@ -898,10 +975,10 @@ def score_sparse_batch_async(packed: PackedSegment, sb: SparseBatch, k: int):
     fn = _get_sparse_compiled(Qb, TB, k_eff, packed.doc_pad, sb.passes, sb.simple,
                               use_coord, sb.coord.shape[1])
     return fn(
-        packed.blk_docs, packed.blk_tfn,
+        packed.blk_docs, packed.blk_tf, packed.blk_nb, sim.caches, sim.modes,
         jnp.asarray(sb.qblk), jnp.asarray(sb.qw), jnp.asarray(sb.qconst),
-        jnp.asarray(sb.qcnt), jnp.asarray(sb.n_must), jnp.asarray(sb.msm),
-        jnp.asarray(sb.coord),
+        jnp.asarray(sb.qcnt), jnp.asarray(sb.qfid), jnp.asarray(sb.n_must),
+        jnp.asarray(sb.msm), jnp.asarray(sb.coord),
     )
 
 
@@ -911,7 +988,9 @@ def plan_sparse_buckets(clause_lists: list, n_must: np.ndarray, msm: np.ndarray,
                         scratch: SparseScratchPool | None = None):
     """Bucket queries by block count and build SparseBatches.
 
-    clause_lists: per query, list of (b0, b1, weight, group, is_const) block ranges.
+    clause_lists: per query, list of (b0, b1, weight, group, is_const, fid)
+    block ranges — `fid` is the clause field's SimTables cache row
+    (device_index.ensure_sim_tables), the in-scan decode's LUT index.
     Returns (batches, overflow_qids): overflow queries (TB > tb_max) need the dense
     fallback; queries with zero blocks appear in no batch (zero hits).
 
@@ -920,7 +999,7 @@ def plan_sparse_buckets(clause_lists: list, n_must: np.ndarray, msm: np.ndarray,
     device launch (launch_flat_sparse does) — None allocates fresh arrays the
     caller owns outright (the bench keeps its batches alive across runs)."""
     Q = len(clause_lists)
-    tb_q = np.array([sum(b1 - b0 for (b0, b1, _w, _g, _c) in cl)
+    tb_q = np.array([sum(b1 - b0 for (b0, b1, _w, _g, _c, _fi) in cl)
                      for cl in clause_lists], dtype=np.int64)
     overflow = [qi for qi in range(Q) if tb_q[qi] > tb_max]
     buckets: dict[int, list[int]] = {}
@@ -940,12 +1019,13 @@ def plan_sparse_buckets(clause_lists: list, n_must: np.ndarray, msm: np.ndarray,
             while Qb < len(chunk):
                 Qb *= 2
             if scratch is not None:
-                qblk, qw, qconst, qcnt = scratch.take(Qb, tb, sentinel_row)
+                qblk, qw, qconst, qcnt, qfid = scratch.take(Qb, tb, sentinel_row)
             else:
                 qblk = np.full((Qb, tb), sentinel_row, np.int32)
                 qw = np.zeros((Qb, tb), np.float32)
                 qconst = np.zeros((Qb, tb), bool)
                 qcnt = np.zeros((Qb, tb), np.int32)
+                qfid = np.zeros((Qb, tb), np.int32)
             qids = np.full(Qb, -1, np.int32)
             bn_must = np.zeros(Qb, np.int32)
             bmsm = np.zeros(Qb, np.int32)
@@ -958,7 +1038,7 @@ def plan_sparse_buckets(clause_lists: list, n_must: np.ndarray, msm: np.ndarray,
                 bcoord[row] = coord[qi]
                 maxc = max(maxc, len(clause_lists[qi]))
                 off = 0
-                for (b0, b1, w, g, is_const) in clause_lists[qi]:
+                for (b0, b1, w, g, is_const, fid) in clause_lists[qi]:
                     nb = b1 - b0
                     if nb <= 0:
                         continue
@@ -969,19 +1049,20 @@ def plan_sparse_buckets(clause_lists: list, n_must: np.ndarray, msm: np.ndarray,
                         1 if g == GROUP_SHOULD
                         else (1 << _MUST_SHIFT) if g == GROUP_MUST
                         else (1 << _NOT_SHIFT))
+                    qfid[row, off: off + nb] = fid
                     off += nb
             passes = max(0, (maxc - 1).bit_length())
             batches.append(SparseBatch(
                 n_queries=len(chunk), qids=qids, qblk=qblk, qw=qw, qconst=qconst,
-                qcnt=qcnt, n_must=bn_must, msm=bmsm, coord=bcoord, passes=passes,
-                simple=simple))
+                qcnt=qcnt, qfid=qfid, n_must=bn_must, msm=bmsm, coord=bcoord,
+                passes=passes, simple=simple))
     return batches, overflow
 
 
 def launch_flat_sparse(packed: PackedSegment, clause_lists: list,
                        n_must: np.ndarray, msm: np.ndarray, coord: np.ndarray,
                        k: int, *, simple: bool = False, tb_max: int = 512,
-                       breaker=None):
+                       breaker=None, sim=None):
     """Plan + launch every sparse bucket of a flat-query batch WITHOUT syncing.
 
     Returns (launches, overflow_qids, release) where launches =
@@ -1006,12 +1087,12 @@ def launch_flat_sparse(packed: PackedSegment, clause_lists: list,
         simple=simple, scratch=scratch)
     est = sum(SparseScratchPool.staging_bytes(*sb.qblk.shape) for sb in batches)
     with reserve(breaker, est, "<sparse_staging>"):
-        launches = [(sb, score_sparse_batch_async(packed, sb, k))
+        launches = [(sb, score_sparse_batch_async(packed, sb, k, sim=sim))
                     for sb in batches]
 
     def release():
         for sb in batches:
-            scratch.give((sb.qblk, sb.qw, sb.qconst, sb.qcnt))
+            scratch.give((sb.qblk, sb.qw, sb.qconst, sb.qcnt, sb.qfid))
 
     return launches, overflow, release
 
@@ -1036,7 +1117,8 @@ def collect_flat_sparse(launches: list, pulled: list, Q: int, k: int,
 
 def score_flat_sparse(packed: PackedSegment, clause_lists: list, n_must: np.ndarray,
                       msm: np.ndarray, coord: np.ndarray, k: int, *,
-                      simple: bool = False, tb_max: int = 512, breaker=None):
+                      simple: bool = False, tb_max: int = 512, breaker=None,
+                      sim=None):
     """Score a whole flat-query batch through the sparse path: plan buckets, launch all
     (pipelined), collect into [Q, k] host arrays.
 
@@ -1047,7 +1129,7 @@ def score_flat_sparse(packed: PackedSegment, clause_lists: list, n_must: np.ndar
     Q = len(clause_lists)
     launches, overflow, release = launch_flat_sparse(
         packed, clause_lists, n_must, msm, coord, k, simple=simple,
-        tb_max=tb_max, breaker=breaker)
+        tb_max=tb_max, breaker=breaker, sim=sim)
     # all buckets launched async above; ONE explicit device_get drains them
     # (it blocks until ready) instead of a per-bucket-per-array np.asarray pull
     pulled = jax.device_get([r for (_sb, r) in launches]) if launches else []

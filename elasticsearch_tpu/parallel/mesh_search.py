@@ -32,9 +32,16 @@ from dataclasses import dataclass, field as dc_field
 
 import numpy as np
 
-from ..common.smallfloat import decode_norm_doclen, NORM_TABLE
+from ..common.smallfloat import jnp_doclen_table, jnp_norm_table
 from ..index.engine import Searcher
-from ..ops.device_index import BLOCK, _pow2_bucket, expand_ranges
+from ..ops.device_index import (
+    _TF_DTYPE,
+    BLOCK,
+    _pow2_bucket,
+    choose_tf_layout,
+    expand_ranges,
+    tf_plane_itemsize,
+)
 from ..search.execute import (
     GROUP_MUST_NOT,
     MODE_BM25,
@@ -133,7 +140,11 @@ class ShardedIndex:
     nb_pad: int
     fields: list  # norm field order (fidx)
     blk_docs: object  # [S, NB, B] int32 (device, sharded)
-    blk_freqs: object  # [S, NB, B] f32
+    blk_tf: object  # [S, NB, B] quantized term freqs (u8/i16; f32 escape) —
+    # widened to f32 INSIDE the SPMD program; norms stay a separate per-doc
+    # byte plane (below), so mesh-resident postings are 5 B/posting in the
+    # common uint8 layout
+    tf_layout: str  # device_index.TF_* ladder, chosen over ALL shards
     norms: object  # [S, F, Dpad] uint8
     live: object  # [S, Dpad] bool
     shard_term_blocks: list  # per shard: (field, term) -> (blk_start, blk_end)
@@ -150,6 +161,13 @@ class ShardedIndex:
 
     def global_max_doc(self) -> int:
         return int(self.max_doc.sum())
+
+    def resident_postings_bytes(self) -> int:
+        """Device-resident postings-plane bytes across all shards (docs i32 +
+        quantized tf) — surfaced by mesh_serving's repack log/stats so the
+        quantized-layout win shows up in capacity planning."""
+        slots = self.n_shards * self.nb_pad * BLOCK
+        return slots * (4 + tf_plane_itemsize(self.tf_layout))
 
 
 def build_sharded_index(searchers: list[Searcher], fields: list[str],
@@ -169,7 +187,7 @@ def build_sharded_index(searchers: list[Searcher], fields: list[str],
     nb_pad = _pow2_bucket(max(nb_needed) + 1, 64)
 
     blk_docs = np.full((S, nb_pad, BLOCK), doc_pad, dtype=np.int32)
-    blk_freqs = np.zeros((S, nb_pad, BLOCK), dtype=np.float32)
+    blk_freqs = np.zeros((S, nb_pad, BLOCK), dtype=np.float32)  # f32 staging
     norms = np.zeros((S, len(fields), doc_pad), dtype=np.uint8)
     live = np.zeros((S, doc_pad), dtype=bool)
     shard_term_blocks = []
@@ -214,10 +232,15 @@ def build_sharded_index(searchers: list[Searcher], fields: list[str],
     from jax.sharding import PartitionSpec as P
 
     spec = P("shards") if mesh is not None else None
+    # quantize the stacked tf plane with the narrowest exact dtype over ALL
+    # shards (one dtype per stacked array; the SPMD program widens in-scan)
+    tf_layout = choose_tf_layout(blk_freqs.reshape(-1))
+    blk_tf = blk_freqs.astype(_TF_DTYPE[tf_layout])
     return ShardedIndex(
         n_shards=S, doc_pad=doc_pad, nb_pad=nb_pad, fields=list(fields),
         blk_docs=put(blk_docs, spec),
-        blk_freqs=put(blk_freqs, spec),
+        blk_tf=put(blk_tf, spec),
+        tf_layout=tf_layout,
         norms=put(norms, spec),
         live=put(live, spec),
         shard_term_blocks=shard_term_blocks,
@@ -320,10 +343,12 @@ def _mesh_score_program(k: int, n_queries: int, doc_pad: int, similarity_kind: i
     import jax
     import jax.numpy as jnp
 
-    DL_TABLE = jnp.asarray(decode_norm_doclen(np.arange(256, dtype=np.uint8)))
-    NORM_DECODE = jnp.asarray(NORM_TABLE.astype(np.float32))
+    # device-side byte315 decode (common/smallfloat.py): norms stay 1 B/doc
+    # into the program; these 1 KB tables fold as compile-time constants
+    DL_TABLE = jnp_doclen_table()
+    NORM_DECODE = jnp_norm_table()
 
-    def program(blk_docs, blk_freqs, norms, live,  # local shard slices [1, ...]
+    def program(blk_docs, blk_tf, norms, live,  # local shard slices [1, ...]
                 qidx, blk, clause_id, fidx, group, tfmode,  # entries [1, M]
                 df_local, boost, clause_qidx, clause_scoring,  # clauses [1?, C]
                 max_doc_local, sum_ttf_local,  # [1], [1, F]
@@ -350,7 +375,7 @@ def _mesh_score_program(k: int, n_queries: int, doc_pad: int, similarity_kind: i
             bucket_pairs.append((extra[ei], extra[ei + 1]))
             ei += 2
         blk_docs = blk_docs[0]
-        blk_freqs = blk_freqs[0]
+        blk_tf = blk_tf[0]
         norms_l = norms[0]
         live_l = live[0]
         qidx, blk, clause_id = qidx[0], blk[0], clause_id[0]
@@ -386,7 +411,7 @@ def _mesh_score_program(k: int, n_queries: int, doc_pad: int, similarity_kind: i
 
         # ---- query phase: fused scoring (same pipeline as ops/scoring.py) ----
         docs = blk_docs[blk]  # [M, B]
-        freqs = blk_freqs[blk]
+        freqs = blk_tf[blk].astype(jnp.float32)  # quantized plane, widened in-scan
         valid = docs < doc_pad
         docs_safe = jnp.where(valid, docs, 0)
         nb = norms_l[fidx[:, None], docs_safe].astype(jnp.int32)
@@ -747,7 +772,7 @@ class MeshSearchExecutor:
             fn = jax.jit(fn)
             self._compiled[key] = fn
         raw = [
-            idx.blk_docs, idx.blk_freqs, idx.norms, idx.live,
+            idx.blk_docs, idx.blk_tf, idx.norms, idx.live,
             qidx, blk, clause_id, fidx, group, tfmode,
             df_local, boost, clause_qidx, clause_scoring,
             idx.max_doc, idx.sum_ttf, n_must, msm, coord,

@@ -586,6 +586,12 @@ class MeshServingService:
         if not fields:
             return None
         sharded = build_sharded_index(searchers, fields, mesh=mesh)
+        # capacity-planning breadcrumb: the quantized tf plane halves-or-better
+        # the mesh-resident postings footprint vs the old f32 layout
+        self.logger.debug(
+            f"mesh repack: {sharded.n_shards} shards, tf layout "
+            f"[{sharded.tf_layout}], resident postings "
+            f"~{sharded.resident_postings_bytes() // 1024} KiB")
         execs = {}
         for gs in (False, True):
             execs[gs] = MeshSearchExecutor(

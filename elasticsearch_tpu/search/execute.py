@@ -545,14 +545,15 @@ def _dispatch_flat_plain(plans: list[FlatPlan], ctx: ShardContext,
     the dense scatter kernel, which is O(Q·doc_pad) but block-count-insensitive.
     Sparse staging buffers are pooled per segment and accounted per batch on
     the request breaker (see launch_flat_sparse)."""
-    from ..ops.device_index import TFN_BM25, TFN_TFIDF, ensure_tfn, packed_for
+    from ..ops.device_index import (
+        TFN_BM25, TFN_TFIDF, ensure_sim_tables, packed_for)
     from ..ops.scoring import launch_flat_sparse
 
     Q = len(plans)
     finals = [finalize_flat(p, ctx) for p in plans]
     (all_fields, field_idx, cache_rows, caches_stack,
      coord_tbl, n_must, msm) = _assemble_batch(plans, finals)
-    tfn_tables = {
+    sim_tables = {
         f: (TFN_BM25 if isinstance(ctx.similarity_for(f), BM25Similarity)
             else TFN_TFIDF, cache_rows[field_idx[f]])
         for f in all_fields
@@ -569,7 +570,9 @@ def _dispatch_flat_plain(plans: list[FlatPlan], ctx: ShardContext,
     releases = []
     for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
         packed = packed_for(seg, breaker=ctx.breaker("fielddata"))
-        ensure_tfn(seg, packed, tfn_tables)
+        # cheap LUT swap (1 KB/field), not a postings re-bake: the quantized
+        # scan decodes tf→tfn in-kernel against these stacked cache rows
+        sim = ensure_sim_tables(packed, sim_tables)
         clause_lists = []
         for (resolved, _f, _c, _coord) in finals:
             cl = []
@@ -578,17 +581,18 @@ def _dispatch_flat_plain(plans: list[FlatPlan], ctx: ShardContext,
                 if tid is None:
                     continue
                 b0, b1 = packed.blocks_for_term(tid)
-                cl.append((b0, b1, w, g, mode == MODE_CONST))
+                cl.append((b0, b1, w, g, mode == MODE_CONST, sim.fid[f]))
             clause_lists.append(cl)
         launches, overflow, release = launch_flat_sparse(
             packed, clause_lists, n_must, msm, coord_tbl, k, simple=simple,
-            breaker=ctx.breaker("request"))
+            breaker=ctx.breaker("request"), sim=sim)
         releases.append(release)
         dense = None
         if overflow:
             dense = _launch_dense_fallback(
                 overflow, finals, field_idx, all_fields, caches_stack,
-                n_must, msm, coord_tbl, packed, seg, k)
+                n_must, msm, coord_tbl, packed, seg, k,
+                breaker=ctx.breaker("fielddata"))
         seg_work.append((seg, base, packed.doc_pad, launches, dense))
     return _PendingFlat(Q=Q, k=k, breaker=ctx.breaker("request"),
                         seg_work=seg_work, releases=releases)
@@ -679,11 +683,16 @@ def _merge_seg_hits(seg_hits, totals, Q: int, k: int,
     return out
 
 
-def _ensure_norm_rows(packed, all_fields):
-    """The dense kernel's norms_stack gathers a row per queried field — zero-fill
-    rows for fields this segment never indexed."""
+def _ensure_norm_rows(packed, all_fields, breaker=None):
+    """Dense-launch prologue (every dense path funnels through here): fault in
+    the lazy f32 freqs plane under the fielddata `breaker` (the blk_freqs-drop
+    rule — sparse-only segments never allocated it), and zero-fill norms_stack
+    rows for queried fields this segment never indexed."""
     import jax.numpy as jnp
 
+    from ..ops.device_index import ensure_blk_freqs
+
+    ensure_blk_freqs(packed, breaker=breaker)
     for f in all_fields:
         if f not in packed.norm_bytes:
             packed.norm_bytes[f] = jnp.zeros(packed.doc_pad, dtype=jnp.uint8)
@@ -705,13 +714,14 @@ def _dense_entries(finals, seg, packed, field_idx) -> list:
 
 
 def _launch_dense_fallback(overflow, finals, field_idx, all_fields, caches_stack,
-                           n_must, msm, coord_tbl, packed, seg, k):
+                           n_must, msm, coord_tbl, packed, seg, k,
+                           breaker=None):
     """Launch overflow queries (block count past the sparse planner's tb_max)
     on the dense scatter kernel WITHOUT syncing; returns (sub indices, device
     result triple) for the merge half, or None when no entries resolved."""
     from ..ops.scoring import build_term_batch, score_term_batch_async
 
-    _ensure_norm_rows(packed, all_fields)
+    _ensure_norm_rows(packed, all_fields, breaker=breaker)
     entries = _dense_entries([finals[qi] for qi in overflow], seg, packed, field_idx)
     if not entries:
         return None
@@ -767,7 +777,8 @@ def _execute_flat_fs(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list[T
     try:
         for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
             packed = packed_for(seg, breaker=ctx.breaker("fielddata"))
-            _ensure_norm_rows(packed, all_fields)
+            _ensure_norm_rows(packed, all_fields,
+                              breaker=ctx.breaker("fielddata"))
             entries = _dense_entries(finals, seg, packed, field_idx)
             batch = build_term_batch(entries, Q, n_must, msm, coord_tbl,
                                      list(all_fields), caches_stack,
@@ -855,7 +866,8 @@ def _execute_flat_filtered(plans: list[FlatPlan], ctx: ShardContext,
     seg_hits = []
     for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
         packed = packed_for(seg, breaker=ctx.breaker("fielddata"))
-        _ensure_norm_rows(packed, all_fields)
+        _ensure_norm_rows(packed, all_fields,
+                          breaker=ctx.breaker("fielddata"))
         fmask = np.zeros((Q, packed.doc_pad), dtype=bool)
         for qi, plan in enumerate(plans):
             fmask[qi, : seg.doc_count] = segment_mask(seg, plan.filt, ctx)
@@ -901,7 +913,8 @@ def execute_flat_sorted(plan: FlatPlan, ctx: ShardContext, k: int, spec):
     cand = []  # (key, gdoc, seg_idx, local, score)
     for si, (seg, base, packed, key_row) in enumerate(zip(
             ctx.searcher.segments, ctx.searcher.bases, packeds, key_rows)):
-        _ensure_norm_rows(packed, all_fields)
+        _ensure_norm_rows(packed, all_fields,
+                          breaker=ctx.breaker("fielddata"))
         fmask = None
         if plan.filt is not None:
             fmask = np.zeros((1, packed.doc_pad), dtype=bool)
@@ -955,7 +968,8 @@ def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
     seg_stats = []
     for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
         packed = packed_for(seg, breaker=ctx.breaker("fielddata"))
-        _ensure_norm_rows(packed, all_fields)
+        _ensure_norm_rows(packed, all_fields,
+                          breaker=ctx.breaker("fielddata"))
         stack = ensure_agg_rows(seg, packed, fields,
                                 breaker=ctx.breaker("fielddata"))
         if stack is None:
@@ -1098,7 +1112,7 @@ class HostScorer:
         if isinstance(sim, BM25Similarity):
             w = np.float32(sim.idf(df, ctx.max_doc) * boost * (sim.k1 + 1.0))
             # tf factor first, then weight — bit-parity with the device kernels'
-            # baked tfn (ops/device_index.ensure_tfn)
+            # in-scan tfn (ops/scoring.sparse_candidates)
             vals = w * (freqs / (freqs + cache[nb]))
         elif isinstance(sim, FreqNormSimilarity):
             # generic freq/doc-len similarities (DFR, IB, LM*) — host-only path

@@ -47,6 +47,7 @@ def rng():
 # a hard per-test ceiling — the runtime twin of tpulint TPU001/TPU002.
 _SANITIZED_MODULES = {
     "test_pallas_kernels",
+    "test_quantized_postings",
     "test_device_aggs",
     "test_device_sort",
     "test_parallel_search",

@@ -41,6 +41,94 @@ from .harness import TestCluster
 # ---------------------------------------------------------------------------
 
 
+class TestPackEstimateMatchesLayout:
+    """The fielddata breaker's segment-pack estimate must track the QUANTIZED
+    layout (ops/device_index.pack_shape_math) — the old 8 B × 2 all-f32 math
+    overstated every u8 segment by ~40%, inflating breaker pressure."""
+
+    def _packed_actual_bytes(self, seg, packed):
+        import numpy as np
+
+        return (
+            packed.host_docs.nbytes + packed.host_freqs.nbytes  # retained host
+            + np.asarray(packed.blk_docs).nbytes  # device planes
+            + np.asarray(packed.blk_tf).nbytes
+            + np.asarray(packed.blk_nb).nbytes
+            + np.asarray(packed.blk_tf).nbytes  # quantize staging (host)
+            + np.asarray(packed.blk_nb).nbytes
+            + 2 * packed.doc_pad  # live mask, host + device
+            + sum(np.asarray(a).nbytes for a in packed.norm_bytes.values())
+        )
+
+    def test_estimate_matches_actual_packed_bytes(self, tmp_path):
+        import numpy as np
+
+        from elasticsearch_tpu.common.settings import Settings as S
+        from elasticsearch_tpu.index.engine import Engine
+        from elasticsearch_tpu.mapper.core import MapperService
+        from elasticsearch_tpu.ops.device_index import (
+            bytes_per_posting, pack_estimate_bytes, pack_segment,
+            packed_resident_bytes)
+
+        rng = np.random.default_rng(23)
+        svc = MapperService(S.from_flat({}))
+        eng = Engine(str(tmp_path / "est"), svc)
+        words = [f"w{i}" for i in range(60)]
+        for i in range(200):
+            eng.index("doc", str(i), {"b": " ".join(rng.choice(words, size=12))})
+        eng.refresh()
+        seg = eng.acquire_searcher().segments[0]
+        from elasticsearch_tpu.ops.device_index import PACK_TRANSIENT_SLOT_BYTES
+
+        est = pack_estimate_bytes(seg)
+        packed = pack_segment(seg)
+        # text-only segment: estimate == retained/uploaded planes plus the
+        # documented per-slot transient allowance, exactly (shared shape
+        # math); any drift between estimate and pack is a regression
+        NBpad = np.asarray(packed.blk_docs).shape[0]
+        assert est == (self._packed_actual_bytes(seg, packed)
+                       + NBpad * 128 * PACK_TRANSIENT_SLOT_BYTES)
+        # device-resident postings are the quantized 6 B/posting (u8 ladder,
+        # no dense plane until a fallback faults it in)
+        assert packed.blk_freqs is None
+        assert packed_resident_bytes(packed) == NBpad * 128 * bytes_per_posting(
+            packed.tf_layout)
+        assert bytes_per_posting(packed.tf_layout) <= 6
+        eng.close()
+
+    def test_estimate_never_under_reserves_with_dv_columns(self, tmp_path):
+        import numpy as np
+
+        from elasticsearch_tpu.common.settings import Settings as S
+        from elasticsearch_tpu.index.engine import Engine
+        from elasticsearch_tpu.mapper.core import MapperService
+        from elasticsearch_tpu.ops.device_index import (
+            pack_estimate_bytes, pack_segment)
+
+        svc = MapperService(S.from_flat({}))
+        eng = Engine(str(tmp_path / "estdv"), svc)
+        rng = np.random.default_rng(29)
+        for i in range(120):
+            eng.index("doc", str(i), {"b": f"w{int(rng.integers(20))} text",
+                                      "n": int(i), "price": float(i) * 1.5})
+        eng.refresh()
+        seg = eng.acquire_searcher().segments[0]
+        est = pack_estimate_bytes(seg)
+        packed = pack_segment(seg)
+        actual = self._packed_actual_bytes(seg, packed) + sum(
+            np.asarray(c).nbytes for c in packed.dv_single.values())
+        # dv columns are estimated at the f64 upper bound (multi-valued
+        # columns never upload) — estimate must bound actual from above,
+        # within the padded-column + pack-transient slack
+        from elasticsearch_tpu.ops.device_index import PACK_TRANSIENT_SLOT_BYTES
+
+        NBpad = np.asarray(packed.blk_docs).shape[0]
+        assert actual <= est
+        assert est - actual <= (8 * packed.doc_pad * len(seg.dv_num)
+                                + NBpad * 128 * PACK_TRANSIENT_SLOT_BYTES)
+        eng.close()
+
+
 class TestBreakerHierarchy:
     def test_child_trips_under_own_limit(self):
         svc = CircuitBreakerService(total_budget_bytes=1000)
