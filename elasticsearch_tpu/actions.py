@@ -28,7 +28,9 @@ import uuid
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FutureTimeoutError
 
+from .common import tracing
 from .common.deadline import NO_DEADLINE, Deadline
+from .common.metrics import HistogramMetric
 from .common.retry import RetryPolicy
 from .common.errors import (
     ActionNotFoundError,
@@ -172,6 +174,10 @@ class ActionModule:
         from .search.service import SearchAdmissionController
 
         self.admission = SearchAdmissionController()
+        # end-to-end coordinator search latency (accept -> response assembled):
+        # the histogram behind /_nodes/stats search.latency percentiles and
+        # the Prometheus estpu_search_latency_seconds series
+        self.search_latency = HistogramMetric()
         t = self.transport
         # master-node actions
         for action, fn in [
@@ -1486,6 +1492,32 @@ class ActionModule:
     # ================= scatter-gather search =================
     def search(self, index_expr, body: dict | None = None, search_type="query_then_fetch",
                routing=None, preference=None, deadline: Deadline | None = None) -> dict:
+        """Tracing + latency-histogram wrapper around the scatter-gather body.
+
+        When the calling thread already carries a sampled span (REST ingress
+        started the trace), the coordinator span nests under it; a direct
+        client call roots a new trace here (subject to the sampling rate).
+        Unsampled requests pay one thread-local read + one clock pair."""
+        t0 = time.monotonic()
+        parent = tracing.current_span()
+        tracer = getattr(self.node, "tracer", None)
+        if parent is not None:
+            span = parent.child("coordinator")
+        elif tracer is not None:
+            span = tracer.start_trace("coordinator").root
+        else:
+            span = tracing.NOOP_SPAN
+        try:
+            with tracing.activate(span):
+                return self._search_inner(index_expr, body, search_type,
+                                          routing, preference, deadline)
+        finally:
+            span.end()
+            self.search_latency.observe(time.monotonic() - t0)
+
+    def _search_inner(self, index_expr, body: dict | None = None,
+                      search_type="query_then_fetch", routing=None,
+                      preference=None, deadline: Deadline | None = None) -> dict:
         t0 = time.monotonic()
         state = self.cluster_service.state
         indices = state.metadata.resolve_indices(index_expr)
@@ -1767,6 +1799,12 @@ class ActionModule:
         # the whole wait (first callback → runs at resolution)
         done.add_done_callback(
             lambda f: setattr(f, "completed_at", time.monotonic()))
+        # sampled trace of the calling coordinator (None when untraced): shard
+        # responses carry their span lists back inline; stitching them here —
+        # not in the collection loop — keeps the spans even for chains the
+        # backstop later abandons
+        cur_span = tracing.current_span()
+        trace_ref = cur_span.trace if cur_span else None
         group = state.routing_table.index(copy.index).shard(copy.shard_id)
         candidates = [copy] + [s for s in group.active_shards()
                                if s.node_id != copy.node_id]
@@ -1799,15 +1837,21 @@ class ActionModule:
                 return
             candidate = candidates[i]
             node = state.nodes.get(candidate.node_id)
-            fut = self.transport.send_request(node, A_QUERY_PHASE, {
-                "index": candidate.index, "shard": candidate.shard_id,
-                "body": body or {},
-                "alias_filter": alias_filters.get(candidate.index),
-                "dfs": dfs_stats,
-                # remaining budget as a DURATION (monotonic clocks don't cross
-                # processes); the shard restarts its own clock from it
-                "deadline_s": deadline.remaining(),
-            })
+            # re-activate the coordinator's span around the send: retry
+            # attempts run on timer / transport-callback threads whose
+            # thread-local is empty, and an un-activated send would strip the
+            # trace context from exactly the failover attempts most worth
+            # tracing (the transport injects context from current_span())
+            with tracing.activate(cur_span):
+                fut = self.transport.send_request(node, A_QUERY_PHASE, {
+                    "index": candidate.index, "shard": candidate.shard_id,
+                    "body": body or {},
+                    "alias_filter": alias_filters.get(candidate.index),
+                    "dfs": dfs_stats,
+                    # remaining budget as a DURATION (monotonic clocks don't
+                    # cross processes); the shard restarts its own clock from it
+                    "deadline_s": deadline.remaining(),
+                })
             # exactly one of {response callback, attempt timer} consumes the attempt
             consumed_lock = threading.Lock()
             consumed = [False]
@@ -1845,6 +1889,8 @@ class ActionModule:
                         attempt(i + 1, err)
                         return
                     r = f.result()
+                    if trace_ref is not None and isinstance(r, dict):
+                        trace_ref.add_remote(r.get("spans"))
                     result = ShardQueryResult(
                         total=r["total"],
                         docs=[tuple(d) for d in r["docs"]],
@@ -1926,10 +1972,24 @@ class ActionModule:
         if req.timeout_s is not None:
             budget = req.timeout_s if budget is None else min(budget, req.timeout_s)
         deadline = Deadline.after(budget) if budget is not None else NO_DEADLINE
+        # continue the coordinator's trace from the wire context (the sender
+        # only injects one for sampled traces); the shard span is the parent
+        # every batcher span of this request attaches to
+        tracer = getattr(self.node, "tracer", None)
+        trace = tracer.continue_trace(request.get(tracing.TRACE_WIRE_KEY),
+                                      "shard") if tracer is not None \
+            else tracing.NOOP_TRACE
+        shard_span = trace.root.tag(index=index, shard=shard_id)
         t_q = time.monotonic()
-        result = execute_query_phase(ctx, req, shard_id=shard_id, deadline=deadline)
-        self._maybe_slowlog(index, shard_id, body, (time.monotonic() - t_q))
-        return {
+        try:
+            with tracing.activate(shard_span):
+                result = execute_query_phase(ctx, req, shard_id=shard_id,
+                                             deadline=deadline)
+        finally:
+            shard_span.end()
+        self._maybe_slowlog(index, shard_id, body, (time.monotonic() - t_q),
+                            trace=trace)
+        out = {
             "total": result.total,
             "docs": [[s, d, sv] for (s, d, sv) in result.docs],
             "max_score": None if result.max_score != result.max_score else result.max_score,
@@ -1941,11 +2001,21 @@ class ActionModule:
             # come from (a merge between phases moves local ids)
             "ctx_id": self._pin_context(index, shard_id, ctx),
         }
+        if trace:
+            # the shard's span list rides the response so the coordinator can
+            # stitch the cross-node tree inline (the `?trace=true` contract);
+            # the shard node ALSO keeps its own copy in its /_traces ring
+            out["spans"] = trace.span_dicts()
+        return out
 
-    def _maybe_slowlog(self, index: str, shard_id: int, body: dict, took_s: float):
+    def _maybe_slowlog(self, index: str, shard_id: int, body: dict, took_s: float,
+                       trace=None):
         """Per-shard query slowlog (ref: index/search/slowlog/
         ShardSlowLogSearchService.java:41,60-63 — warn/info/debug/trace thresholds from
-        dynamic index settings)."""
+        dynamic index settings). Each line carries the trace id and the
+        queue/device/merge phase breakdown so a slow entry is directly
+        joinable to `GET /_traces` (zeros + trace[-] when the request was
+        unsampled)."""
         meta = self.cluster_service.state.metadata.index(index)
         if meta is None:
             return
@@ -1955,8 +2025,16 @@ class ActionModule:
             threshold = settings.get_time(
                 f"index.search.slowlog.threshold.query.{level}", None)
             if threshold is not None and threshold >= 0 and took_s >= threshold:
-                log("slowlog [%s][%d] took[%.1fms] source[%s]",
-                    index, shard_id, took_s * 1000, str(body)[:500])
+                # breakdown only on a threshold hit: phase_breakdown copies
+                # the span list under the trace lock — with thresholds unset
+                # (the default) a sampled query must not pay that per call
+                phases = tracing.phase_breakdown(trace)
+                trace_id = trace.trace_id if trace else "-"
+                log("slowlog [%s][%d] took[%.1fms] trace[%s] queue[%.1fms] "
+                    "device[%.1fms] merge[%.1fms] source[%s]",
+                    index, shard_id, took_s * 1000, trace_id,
+                    phases["queue_ms"], phases["device_ms"],
+                    phases["merge_ms"], str(body)[:500])
                 return
 
     def _s_fetch_phase(self, request, channel):

@@ -84,6 +84,9 @@ class _CompileCounter:
         self._lock = threading.Lock()
         self._installed = False
         self._active: list[SanitizerReport] = []
+        # process-lifetime compile-event count (since the listener was first
+        # installed) — the Prometheus estpu_jax_compile_events_total series
+        self.total = 0
 
     def _listener(self, key: str, duration: float, **_kw) -> None:
         if _COMPILE_EVENT_SUBSTR not in key:
@@ -91,16 +94,21 @@ class _CompileCounter:
         # note() under the lock: concurrent pool-thread compiles must not lose
         # increments, or a blown budget could pass silently
         with self._lock:
+            self.total += 1
             for r in self._active:
                 r.note(key)
 
-    def subscribe(self, report: SanitizerReport) -> None:
+    def ensure_installed(self) -> None:
         import jax.monitoring
 
         with self._lock:
             if not self._installed:
                 jax.monitoring.register_event_duration_secs_listener(self._listener)
                 self._installed = True
+
+    def subscribe(self, report: SanitizerReport) -> None:
+        self.ensure_installed()
+        with self._lock:
             self._active.append(report)
 
     def unsubscribe(self, report: SanitizerReport) -> None:
@@ -110,6 +118,18 @@ class _CompileCounter:
 
 
 _counter = _CompileCounter()
+
+
+def compile_events_total() -> int:
+    """Process-lifetime backend-compile count for telemetry (Prometheus /
+    /_nodes/stats). Installs the process-wide listener on first call; counts
+    start from then — a warmed node therefore reads ~0, and any growth IS a
+    retrace signal worth alerting on."""
+    try:
+        _counter.ensure_installed()
+    except Exception:  # noqa: BLE001 — no jax in this process: count stays 0
+        pass
+    return _counter.total
 
 
 class CompileBudgetExceeded(AssertionError):

@@ -16,6 +16,7 @@ import zlib
 from typing import Any
 
 from .errors import SearchEngineError
+from .tracing import TraceContext
 
 _NULL = 0xFF
 
@@ -113,6 +114,13 @@ class StreamOutput:
             for k, item in v.items():
                 self.write_string(str(k))
                 self.write_value(item)
+        elif isinstance(v, TraceContext):
+            # trace context rides request payloads as a typed value, so span
+            # stitching crosses BOTH transports through this one codec
+            # (common/tracing.py; in-process roundtrip and tcp.py frames)
+            self.write_byte(7)
+            self.write_string(v.trace_id)
+            self.write_vlong(v.span_id)
         else:
             raise SearchEngineError(f"cannot serialize value of type {type(v)}")
 
@@ -214,6 +222,8 @@ class StreamInput:
             return [self.read_value() for _ in range(self.read_vint())]
         if tag == 6:
             return {self.read_string(): self.read_value() for _ in range(self.read_vint())}
+        if tag == 7:
+            return TraceContext(self.read_string(), self.read_vlong())
         raise SearchEngineError(f"unknown value tag {tag}")
 
     def read_map(self) -> dict:

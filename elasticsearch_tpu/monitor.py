@@ -15,7 +15,9 @@ import resource
 import time
 
 
-def os_stats() -> dict:
+def os_stats(proc: str = "/proc") -> dict:
+    """`proc` overrides the procfs root so tests can feed canned fixtures
+    (tests/test_monitor.py) — production always reads the real /proc."""
     out: dict = {"timestamp": int(time.time() * 1000)}
     try:
         load = os.getloadavg()
@@ -23,7 +25,7 @@ def os_stats() -> dict:
     except OSError:
         pass
     try:
-        with open("/proc/meminfo") as fh:
+        with open(os.path.join(proc, "meminfo")) as fh:
             mem = {}
             for line in fh:
                 parts = line.split()
@@ -45,7 +47,8 @@ def os_stats() -> dict:
     return out
 
 
-def process_stats() -> dict:
+def process_stats(proc: str = "/proc") -> dict:
+    """`proc` overrides the procfs root (canned fixtures in tests)."""
     ru = resource.getrusage(resource.RUSAGE_SELF)
     out = {
         "timestamp": int(time.time() * 1000),
@@ -58,7 +61,7 @@ def process_stats() -> dict:
         },
     }
     try:
-        with open("/proc/self/status") as fh:
+        with open(os.path.join(proc, "self", "status")) as fh:
             for line in fh:
                 if line.startswith("Threads:"):
                     out["threads"] = int(line.split()[1])
@@ -67,7 +70,8 @@ def process_stats() -> dict:
     except OSError:
         pass
     try:
-        out["open_file_descriptors"] = len(os.listdir("/proc/self/fd"))
+        out["open_file_descriptors"] = len(os.listdir(
+            os.path.join(proc, "self", "fd")))
         out["max_file_descriptors"] = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
     except OSError:
         pass
@@ -129,10 +133,15 @@ class MonitorService:
     def __init__(self, node):
         self.node = node
 
-    def full_stats(self) -> dict:
+    def sections(self) -> dict:
+        """Monitor stats as name -> thunk, so `/_nodes/stats/{metric}` can
+        build ONLY the requested sections (each is its own procfs read)."""
         return {
-            "os": os_stats(),
-            "process": process_stats(),
-            "fs": fs_stats([self.node.data_path]),
-            "runtime": runtime_stats(),
+            "os": os_stats,
+            "process": process_stats,
+            "fs": lambda: fs_stats([self.node.data_path]),
+            "runtime": runtime_stats,
         }
+
+    def full_stats(self) -> dict:
+        return {name: build() for name, build in self.sections().items()}

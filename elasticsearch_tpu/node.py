@@ -89,6 +89,13 @@ class Node:
         from .common.breaker import CircuitBreakerService
 
         self.breakers = CircuitBreakerService(self.settings)
+        # request-scoped tracing: sampling knobs ESTPU_TRACE /
+        # search.trace.sample_rate, bounded ring of finished traces
+        # (GET /_traces), in-flight registry (GET /_tasks) — the span
+        # substrate the REST/coordinator/shard/batcher path records into
+        from .common.tracing import Tracer
+
+        self.tracer = Tracer(self.settings, node_name=self.name)
         # cross-request device micro-batching: concurrent query phases on one
         # shard coalesce into one bucketed launch (search/batcher.py; wired
         # into ShardContext by ActionModule._shard_ctx and into mesh serving)
@@ -785,32 +792,58 @@ class Client:
             nodes[n.id] = d
         return {"cluster_name": state.cluster_name, "nodes": nodes}
 
-    def nodes_stats(self):
+    def nodes_stats(self, metric=None):
+        """Per-node stats; `metric` (comma list of section names, the
+        `/_nodes/stats/{metric}` path param) filters the response to those
+        sections — an unknown metric is a 400, not a silent full dump."""
         from .search.service import SERVING_COUNTERS
 
-        ms = getattr(self.node.actions, "mesh_serving", None)
-        serving = dict(SERVING_COUNTERS)
-        if ms is not None:
-            serving["mesh_spmd"] = ms.mesh_queries
-            serving["mesh_fallbacks"] = ms.mesh_fallbacks
-        return {"cluster_name": self.node.cluster_service.state.cluster_name,
-                "nodes": {self.node.node_id: {
-            "indices": self.node.indices.stats(),
-            "transport": self.node.transport.stats,
-            "thread_pool": self.node.threadpool.stats(),
+        def serving_stats():
+            # which executor served each query phase (device kernel variants
+            # vs host scorer; process-wide rollup)
+            ms = getattr(self.node.actions, "mesh_serving", None)
+            serving = dict(SERVING_COUNTERS)
+            if ms is not None:
+                serving["mesh_spmd"] = ms.mesh_queries
+                serving["mesh_fallbacks"] = ms.mesh_fallbacks
+            return serving
+
+        # section -> thunk: a narrow `/_nodes/stats/{metric}` request only
+        # pays for the sections it asked for (the monitor sections alone are
+        # several procfs reads — a scraper polling one cheap section every
+        # few seconds must not do the full-dump work each time)
+        sections = {
+            "indices": lambda: self.node.indices.stats(),
+            "transport": lambda: self.node.transport.stats,
+            "thread_pool": lambda: self.node.threadpool.stats(),
             # overload protection: breaker hierarchy + admission control —
             # the operator's view of how close the node is to shedding load
-            "breakers": self.node.breakers.stats(),
-            "admission_control": self.node.actions.admission.stats(),
-            # cross-request device micro-batching: launches vs coalesced
-            # requests, mean occupancy, and which flush trigger fired —
-            # whether throughput wins come from coalescing or kernel time
-            "search": {"batcher": self.node.search_batcher.stats()},
-            # which executor served each query phase (device kernel variants vs
-            # host scorer; process-wide rollup)
-            "search_serving": serving,
-            **self.node.monitor.full_stats(),
-        }}}
+            "breakers": lambda: self.node.breakers.stats(),
+            "admission_control": lambda: self.node.actions.admission.stats(),
+            # cross-request device micro-batching + end-to-end coordinator
+            # latency percentiles (HistogramMetric — means hide the tail)
+            "search": lambda: {
+                "batcher": self.node.search_batcher.stats(),
+                "latency": self.node.actions.search_latency.stats()},
+            "search_serving": serving_stats,
+            # request-scoped tracing: sample rate, ring occupancy, in-flight
+            "tracing": lambda: self.node.tracer.stats(),
+            **self.node.monitor.sections(),
+        }
+        if metric and metric not in ("_all",):
+            wanted = [m.strip() for m in str(metric).split(",") if m.strip()]
+            unknown = [m for m in wanted if m not in sections and m != "_all"]
+            if unknown:
+                from .common.errors import IllegalArgumentError
+
+                raise IllegalArgumentError(
+                    f"unknown metric {unknown} for [/_nodes/stats]; known "
+                    f"metrics are {sorted(sections)}")
+            if "_all" not in wanted:
+                sections = {k: sections[k] for k in sections if k in wanted}
+        return {"cluster_name": self.node.cluster_service.state.cluster_name,
+                "nodes": {self.node.node_id:
+                          {k: build() for k, build in sections.items()}}}
 
     def cluster_stats(self):
         """ref: action/admin/cluster/stats/TransportClusterStatsAction — the

@@ -21,6 +21,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from ..common import tracing
 from ..common.breaker import reserve as breaker_reserve
 from ..common.errors import CircuitBreakingError
 from ..common.logging import get_logger
@@ -319,15 +320,28 @@ class MeshServingService:
                  qmax_col) = self.batcher.execute_mesh(
                      plan, executor, k, deadline=deadline)
             else:
-                out = executor.search(
-                    [plan], k, filter_masks=filter_masks, agg_rows=agg_rows,
-                    use_metric_aggs=bool(metric_fields), post_masks=post_masks,
-                    min_score=(float(req.min_score)
-                               if req.min_score is not None else None),
-                    sort_keys=sort_keys,
-                    sort_desc=bool(sort_spec.reverse) if sort_spec is not None
-                    else False,
-                    active=active, bucket_pairs=bucket_pairs or None)
+                # the SPMD launch + its program-output pull, timed as one
+                # mesh span on the request's trace (no extra sync: the span
+                # end rides the pull executor.search performs anyway); the
+                # batcher path above records its own queue/dispatch/merge
+                # spans per coalesced member instead
+                cur = tracing.current_span()
+                mesh_span = cur.child("mesh.launch").tag(
+                    index=index, shards=S) if cur is not None else None
+                try:
+                    out = executor.search(
+                        [plan], k, filter_masks=filter_masks, agg_rows=agg_rows,
+                        use_metric_aggs=bool(metric_fields),
+                        post_masks=post_masks,
+                        min_score=(float(req.min_score)
+                                   if req.min_score is not None else None),
+                        sort_keys=sort_keys,
+                        sort_desc=bool(sort_spec.reverse)
+                        if sort_spec is not None else False,
+                        active=active, bucket_pairs=bucket_pairs or None)
+                finally:
+                    if mesh_span is not None:
+                        mesh_span.end()
             self.mesh_queries += 1
 
             track = bool(req.track_scores) if req.sort else True

@@ -18,6 +18,7 @@ import time
 from dataclasses import dataclass, field as dc_field
 from typing import Callable
 
+from ..common import tracing
 from ..common.errors import SearchEngineError
 
 
@@ -171,6 +172,139 @@ def _lenient_to_strict_json(text: str) -> str:
                 out.append(c)
                 i += 1
     return "".join(out)
+
+
+def _prom_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _prom_num(v) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+class _PromWriter:
+    """Prometheus text exposition v0.0.4 assembler: one # TYPE header per
+    family (emitted lazily on first sample), histogram families rendered from
+    HistogramMetric.cumulative()."""
+
+    def __init__(self):
+        self.lines: list[str] = []
+        self._typed: set[str] = set()
+
+    def _type(self, name: str, typ: str):
+        if name not in self._typed:
+            self._typed.add(name)
+            self.lines.append(f"# TYPE {name} {typ}")
+
+    def sample(self, name: str, typ: str, value, **labels):
+        self._type(name, typ)
+        self.lines.append(f"{name}{_prom_labels(labels)} {_prom_num(value)}")
+
+    def gauge(self, name: str, value, **labels):
+        self.sample(name, "gauge", value, **labels)
+
+    def counter(self, name: str, value, **labels):
+        self.sample(name, "counter", value, **labels)
+
+    def histogram(self, name: str, hist, **labels):
+        self._type(name, "histogram")
+        buckets, total, vsum = hist.cumulative()
+        for bound, cum in buckets:
+            self.lines.append(
+                f"{name}_bucket{_prom_labels({**labels, 'le': _prom_num(bound)})}"
+                f" {cum}")
+        self.lines.append(f"{name}_sum{_prom_labels(labels)} {_prom_num(vsum)}")
+        self.lines.append(f"{name}_count{_prom_labels(labels)} {total}")
+
+    def text(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def _hbm_resident_bytes(node) -> int:
+    """Device-resident packed-postings bytes across the node's live shard
+    searchers (ops/device_index.packed_resident_bytes over the per-segment
+    device caches) — pure host arithmetic over already-known shapes, no
+    device sync."""
+    from ..ops.device_index import packed_resident_bytes
+
+    total = 0
+    for svc in list(node.indices.indices.values()):
+        for shard in list(svc.shards.values()):
+            try:
+                searcher = shard.engine.acquire_searcher()
+            except SearchEngineError:
+                continue
+            for seg in searcher.segments:
+                packed = getattr(seg, "_device_cache", {}).get("packed")
+                if packed is not None:
+                    total += packed_resident_bytes(packed)
+    return total
+
+
+def _prometheus_text(node) -> str:
+    """GET /_prometheus/metrics: the node's serving telemetry in Prometheus
+    text format — breakers, thread pools (+queue-wait histograms), batcher,
+    admission control, search latency, compile events (common/jaxenv), HBM
+    resident bytes (ops/device_index), tracer counters."""
+    from ..common.jaxenv import compile_events_total
+
+    w = _PromWriter()
+    # one loop PER FAMILY, not per breaker/pool: the text exposition requires
+    # all samples of a metric name to form one contiguous group — interleaved
+    # families pass the classic scraper but fail promtool / OpenMetrics-strict
+    # ingesters, which drop the whole scrape
+    breakers = node.breakers.stats()
+    for bname, b in breakers.items():
+        w.gauge("estpu_breaker_limit_bytes", b["limit"], breaker=bname)
+    for bname, b in breakers.items():
+        w.gauge("estpu_breaker_estimated_bytes", b["estimated"], breaker=bname)
+    for bname, b in breakers.items():
+        w.counter("estpu_breaker_tripped_total", b["tripped"], breaker=bname)
+    for bname, b in breakers.items():
+        w.counter("estpu_breaker_leaks_total", b.get("leak_detected", 0),
+                  breaker=bname)
+    pools = node.threadpool.stats()
+    for pool, s in pools.items():
+        w.gauge("estpu_threadpool_threads", s["threads"], pool=pool)
+    for pool, s in pools.items():
+        w.gauge("estpu_threadpool_active", s["active"], pool=pool)
+    for pool, s in pools.items():
+        w.gauge("estpu_threadpool_queue", s["queue"], pool=pool)
+    for pool, s in pools.items():
+        w.counter("estpu_threadpool_rejected_total", s["rejected"], pool=pool)
+    for pool, s in pools.items():
+        w.counter("estpu_threadpool_completed_total", s["completed"], pool=pool)
+    for pool, hist in node.threadpool.pool_histograms().items():
+        w.histogram("estpu_threadpool_queue_wait_seconds", hist, pool=pool)
+    bs = node.search_batcher.stats()
+    w.counter("estpu_batcher_launches_total", bs["launches"])
+    w.counter("estpu_batcher_coalesced_total", bs["coalesced"])
+    w.counter("estpu_batcher_bypassed_total", bs["bypassed"])
+    w.counter("estpu_batcher_splits_total", bs["splits"])
+    for reason in ("full", "linger", "deadline", "pending"):
+        w.counter("estpu_batcher_flushes_total", bs[f"{reason}_flushes"],
+                  reason=reason)
+    w.gauge("estpu_batcher_queue", bs["queue"])
+    w.histogram("estpu_batcher_batch_seconds", node.search_batcher.service_hist)
+    w.histogram("estpu_search_latency_seconds", node.actions.search_latency)
+    w.histogram("estpu_admission_shard_phase_seconds",
+                node.actions.admission.histogram)
+    w.counter("estpu_admission_rejected_total",
+              node.actions.admission.rejected.count)
+    w.counter("estpu_jax_compile_events_total", compile_events_total())
+    w.gauge("estpu_hbm_resident_bytes", _hbm_resident_bytes(node))
+    ts = node.tracer.stats()
+    w.counter("estpu_traces_sampled_total", ts["sampled"])
+    w.counter("estpu_traces_finished_total", ts["finished"])
+    w.gauge("estpu_traces_in_flight", ts["in_flight"])
+    return w.text()
 
 
 def build_rest_controller(node) -> RestController:
@@ -447,12 +581,32 @@ def build_rest_controller(node) -> RestController:
         index = req.path_params.get("index", "_all")
         search_type = req.param("search_type", "query_then_fetch")
         scroll = req.param("scroll")
-        if scroll:
-            return _scrolled_search(index, body, scroll, scan=search_type == "scan")
-        return client.search(index, body,
-                             search_type=search_type,
-                             routing=req.param("routing"),
-                             preference=req.param("preference"))
+        # REST ingress roots the request's trace: `?trace=true` force-samples
+        # and returns the stitched span tree inline (the `profile` API shape);
+        # otherwise the tracer's sampling rate decides and the trace only
+        # lands in the /_traces ring. The scroll branch roots here too — the
+        # initial scan/scroll search is a normal fan-out, only pagination of
+        # the buffered hits (the /_search/scroll handler) is untraced.
+        want_trace = req.bool_param("trace")
+        trace = node.tracer.start_trace("rest", force=want_trace)
+        root = trace.root.tag(path=req.path, index=index)
+        try:
+            with tracing.activate(root):
+                if scroll:
+                    r = _scrolled_search(index, body, scroll,
+                                         scan=search_type == "scan")
+                else:
+                    r = client.search(index, body,
+                                      search_type=search_type,
+                                      routing=req.param("routing"),
+                                      preference=req.param("preference"))
+        finally:
+            root.end()
+        if want_trace and trace:
+            r = dict(r)
+            r["trace"] = {"trace_id": trace.trace_id,
+                          "tree": tracing.span_tree(trace.span_dicts())}
+        return r
 
     def _scrolled_search(index, body, keep_alive, scan=False):
         import uuid as _uuid
@@ -853,12 +1007,49 @@ def build_rest_controller(node) -> RestController:
     rc.register("POST", "/_cluster/reroute",
                 lambda r: client.cluster_reroute(_parse_body(r)))
     rc.register("GET", "/_nodes", lambda r: client.nodes_info())
+    # `{metric}` REALLY filters now (comma list of stats sections; unknown
+    # metric → 400) — it used to share the unfiltered handler and silently
+    # return everything
     rc.register("GET", "/_nodes/stats", lambda r: client.nodes_stats())
-    rc.register("GET", "/_nodes/stats/{metric}", lambda r: client.nodes_stats())
+    rc.register("GET", "/_nodes/stats/{metric}",
+                lambda r: client.nodes_stats(metric=r.path_params["metric"]))
     rc.register("GET", "/_nodes/{node_id}/stats", lambda r: client.nodes_stats())
-    rc.register("GET", "/_nodes/{node_id}/stats/{metric}", lambda r: client.nodes_stats())
+    rc.register("GET", "/_nodes/{node_id}/stats/{metric}",
+                lambda r: client.nodes_stats(metric=r.path_params["metric"]))
     rc.register("GET", "/_cluster/nodes/hot_threads", lambda r: _hot_threads())
     rc.register("GET", "/_nodes/hot_threads", lambda r: _hot_threads())
+
+    # --- tracing / telemetry (common/tracing.py) ----------------------------
+    def get_traces(req):
+        """Ring buffer of finished traces on THIS node, newest first."""
+        from ..common.errors import IllegalArgumentError
+
+        raw = req.param("size")
+        limit = None
+        if raw is not None:
+            try:
+                limit = int(raw)
+            except (TypeError, ValueError):
+                raise IllegalArgumentError(
+                    f"invalid size [{raw}] for [/_traces]") from None
+            if limit < 0:
+                raise IllegalArgumentError(
+                    f"size must be >= 0 for [/_traces], got [{limit}]")
+        traces = node.tracer.traces(limit)
+        return {"node": node.node_id, "total": len(traces),
+                "tracing": node.tracer.stats(), "traces": traces}
+
+    def get_tasks(req):
+        """Live in-flight traced tasks (current span, elapsed;
+        cancellable=false until a cancellation PR wires the flag up)."""
+        return {"nodes": {node.node_id: {"name": node.name,
+                                         "tasks": node.tracer.tasks()}}}
+
+    rc.register("GET", "/_traces", get_traces)
+    rc.register("GET", "/_tasks", get_tasks)
+    rc.register("GET", "/_prometheus/metrics",
+                lambda r: RestResponse(200, _prometheus_text(node),
+                                       content_type="text/plain; version=0.0.4"))
 
     # device-side tracing (SURVEY §5.1 TPU mapping: the profiler role hot_threads
     # plays for host threads, jax.profiler plays for the XLA programs — captures

@@ -53,14 +53,17 @@ individually so only the request that is actually oversized fails with the
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import deque
 from concurrent.futures import Future
 
+from ..common import tracing
 from ..common.deadline import NO_DEADLINE, Deadline
 from ..common.errors import RejectedExecutionError
 from ..common.logging import get_logger
+from ..common.metrics import HistogramMetric
 from ..ops.device_index import _pow2_bucket
 
 _K_MIN = 16  # smallest k bucket (top-10 pages and top-16 share executables)
@@ -72,7 +75,7 @@ def _k_bucket(k: int) -> int:
 
 class _Item:
     __slots__ = ("family", "key", "payload", "k", "kb", "deadline", "future",
-                 "t_enq")
+                 "t_enq", "span")
 
     def __init__(self, family, key, payload, k: int, kb: int,
                  deadline: Deadline):
@@ -84,6 +87,10 @@ class _Item:
         self.deadline = deadline
         self.future: Future = Future()
         self.t_enq = time.monotonic()
+        # the enqueuing request's active span (None when untraced): the
+        # drainer attributes the shared batch's queue/dispatch/merge/pull
+        # timings back to EVERY member's trace through this handle
+        self.span = tracing.current_span()
 
 
 class _FlatFamily:
@@ -210,6 +217,10 @@ class DeviceBatcher:
         self._pending_flushes = 0  # flushed early because a merge was waiting
         self._bypassed = 0  # queue full / disabled / drainer dead -> inline
         self._splits = 0  # coalesced launch failed -> per-item replay
+        # batch service-time tail (dispatch start -> fan-out done): percentile
+        # twin of _ewma_cost, exported in /_nodes/stats + Prometheus
+        self.service_hist = HistogramMetric()
+        self._batch_ids = itertools.count(1)  # trace tag joining members
         self._flat = _FlatFamily()
         self._mesh = _MeshFamily()
 
@@ -315,7 +326,15 @@ class DeviceBatcher:
                     break
                 continue
             items, reason = batch
+            batch_id = next(self._batch_ids)
+            traced = [it for it in items if it.span]
             t0 = time.monotonic()
+            # enqueue-wait: t_enq -> the drainer taking the batch (span
+            # recording happens OUTSIDE the condition/stats locks — trace
+            # locks are leaves, and record() never blocks or dispatches)
+            for it in traced:
+                it.span.record("batcher.queue", it.t_enq, t0, batch=batch_id,
+                               reason=reason, occupancy=len(items))
             family = items[0].family
             try:
                 # dispatch-then-merge double buffering: batch N+1's device
@@ -325,10 +344,22 @@ class DeviceBatcher:
             except Exception as e:  # noqa: BLE001 — replay decides per item
                 self._split(family, items, e)
                 continue
+            if traced and tracing.sync_armed():
+                # ESTPU_TRACE_SYNC=1 precise mode (bench/debug ONLY): wait for
+                # the dispatched launches so the dispatch span measures true
+                # device time — this deliberately forfeits the double-buffer
+                # overlap, which is why it is never the default
+                sync = getattr(handle, "sync", None)
+                if sync is not None:
+                    sync()
+            t_disp = time.monotonic()
+            for it in traced:
+                it.span.record("batcher.dispatch", t0, t_disp, batch=batch_id,
+                               occupancy=len(items), family=family.name)
             self._note_flush(reason)
             if pending is not None:
                 self._finish(*pending)
-            pending = (family, items, handle, t0)
+            pending = (family, items, handle, t0, batch_id)
             with self._cv:
                 queue_empty = not self._queue
             if queue_empty:
@@ -391,14 +422,31 @@ class DeviceBatcher:
         self._queue.extend(rest)
         return taken, reason
 
-    def _finish(self, family, items, handle, t0: float):
+    def _finish(self, family, items, handle, t0: float, batch_id: int = 0):
         """Merge a dispatched batch and fan results out to the item futures."""
+        t_m0 = time.monotonic()
         try:
             results = family.fan_out(handle, items)
         except Exception as e:  # noqa: BLE001 — replay decides per item
             self._split(family, items, e)
             return
-        dt = time.monotonic() - t0
+        t_m1 = time.monotonic()
+        dt = t_m1 - t0
+        # merge span + the batch's ONE device pull, attributed to EVERY
+        # coalesced member (the pull timestamps were stamped by
+        # execute._merge_flat_plain on the pending handle — span end-times
+        # ride the existing batched device_get, no extra sync)
+        pull_t0 = getattr(handle, "pull_t0", None)
+        pull_t1 = getattr(handle, "pull_t1", None)
+        for it in items:
+            if not it.span:
+                continue
+            merge_span = it.span.record("batcher.merge", t_m0, t_m1,
+                                        batch=batch_id)
+            if pull_t0 is not None and pull_t1 is not None:
+                merge_span.record("device_pull", pull_t0, pull_t1,
+                                  batch=batch_id)
+        self.service_hist.observe(dt)  # own stripe locks — outside _stats_lock
         with self._stats_lock:
             self._ewma_cost = 0.2 * dt + 0.8 * self._ewma_cost
             self._launches += 1
@@ -451,7 +499,7 @@ class DeviceBatcher:
         with self._stats_lock:
             launches = self._launches
             items = self._items_launched
-            return {
+            out = {
                 "launches": launches,
                 "coalesced": items,
                 "occupancy_mean": round(items / launches, 3) if launches else 0.0,
@@ -464,3 +512,7 @@ class DeviceBatcher:
                 "queue": len(self._queue),
                 "ewma_batch_ms": round(self._ewma_cost * 1000.0, 3),
             }
+        # batch service-time percentiles (HistogramMetric — the tail the EWMA
+        # can't show); stripe locks are leaves, summed outside _stats_lock
+        out["batch"] = self.service_hist.stats()
+        return out

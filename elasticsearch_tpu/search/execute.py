@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import math
 import re
+import time
 from dataclasses import dataclass, field as dc_field
 
 import numpy as np
@@ -494,7 +495,8 @@ class _PendingFlat:
     top-k merge — the dispatch/merge split the cross-request batcher overlaps
     (search/batcher.py: batch N+1 dispatches while batch N merges)."""
 
-    __slots__ = ("Q", "k", "breaker", "seg_work", "releases")
+    __slots__ = ("Q", "k", "breaker", "seg_work", "releases",
+                 "pull_t0", "pull_t1")
 
     def __init__(self, Q: int, k: int, breaker, seg_work: list, releases: list):
         self.Q = Q
@@ -505,9 +507,26 @@ class _PendingFlat:
         # scratch-pool release callbacks — invoked by merge() AFTER the pull
         # (staging arrays must stay untouched while transfers are in flight)
         self.releases = releases
+        # host-monotonic endpoints of the batch's single device_get, stamped
+        # by merge(): the tracing layer's device span rides THIS existing
+        # pull instead of adding any sync of its own (common/tracing.py)
+        self.pull_t0: float | None = None
+        self.pull_t1: float | None = None
 
     def merge(self) -> list[TopDocs]:
         return _merge_flat_plain(self)
+
+    def sync(self):
+        """Block until every dispatched launch completes — ESTPU_TRACE_SYNC=1
+        precise device timing ONLY (bench/debug); the serving path never calls
+        this, its one sync is the batched pull in merge()."""
+        import jax
+
+        for (_seg, _base, _doc_pad, launches, dense) in self.seg_work:
+            for (_sb, r) in launches:
+                jax.block_until_ready(r)
+            if dense is not None:
+                jax.block_until_ready(dense[1])
 
 
 class _PendingDone:
@@ -614,7 +633,11 @@ def _merge_flat_plain(pending: _PendingFlat) -> list[TopDocs]:
         refs.extend(r for (_sb, r) in launches)
         if dense is not None:
             refs.append(dense[1])
+    # stamp the pull window for tracing (host clocks around the pull the
+    # serving path performs anyway — the device span's end rides this)
+    pending.pull_t0 = time.monotonic()
     pulled = iter(jax.device_get(refs) if refs else [])
+    pending.pull_t1 = time.monotonic()
     # results are on the host — the borrowed staging arrays are reusable now
     for release in pending.releases:
         release()

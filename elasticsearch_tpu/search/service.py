@@ -777,10 +777,14 @@ class SearchAdmissionController:
     EWMA_ALPHA = 0.2  # ~5-sample memory
 
     def __init__(self, min_samples: int = 10):
-        from ..common.metrics import CounterMetric, MeanMetric
+        from ..common.metrics import CounterMetric, HistogramMetric, MeanMetric
 
         self.min_samples = min_samples
         self.latency = MeanMetric()  # lifetime rollup (stats/observability)
+        # tail view of the same signal: the EWMA decides admission, the
+        # histogram answers "what does p99 shard-phase latency look like"
+        # (p50/p95/p99 in /_nodes/stats + the Prometheus exposition)
+        self.histogram = HistogramMetric()
         self.rejected = CounterMetric()
         self._ewma = 0.0  # the decaying signal admit() compares against
         self._ewma_lock = threading.Lock()
@@ -788,6 +792,7 @@ class SearchAdmissionController:
     def observe(self, seconds: float):
         s = max(0.0, float(seconds))
         self.latency.inc(s)
+        self.histogram.observe(s)
         with self._ewma_lock:
             self._ewma = s if self.latency.count <= 1 else \
                 self.EWMA_ALPHA * s + (1.0 - self.EWMA_ALPHA) * self._ewma
@@ -815,4 +820,6 @@ class SearchAdmissionController:
             "mean_shard_phase_ms": round(self.latency.mean * 1000.0, 3),
             "ewma_shard_phase_ms": round(self._ewma * 1000.0, 3),
             "rejected": self.rejected.count,
+            # tail percentiles of the same observations (HistogramMetric)
+            "shard_phase": self.histogram.stats(),
         }

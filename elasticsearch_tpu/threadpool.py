@@ -21,6 +21,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 
 from .common.errors import RejectedExecutionError
 from .common.logging import get_logger
+from .common.metrics import HistogramMetric
 
 logger = get_logger("threadpool")
 
@@ -118,6 +119,10 @@ class _BoundedPool:
         self.active = 0
         self.rejected = 0
         self.completed = 0
+        # queue-wait (submit → a worker picks the task up) per task: the
+        # histogram that separates "slow because queued" from "slow because
+        # device" in /_nodes/stats (lock-striped, own leaf locks)
+        self.queue_wait = HistogramMetric()
 
     def submit(self, fn, *args, **kwargs) -> Future:
         with self._lock:
@@ -131,7 +136,8 @@ class _BoundedPool:
                         f"(queued [{self.queued}], active [{self.active}])")
             self.queued += 1
         try:
-            return self.executor.submit(self._run, fn, args, kwargs)
+            return self.executor.submit(self._run, fn, args, kwargs,
+                                        time.monotonic())
         except RuntimeError:
             # executor shut down — still a rejection, just a terminal one
             with self._lock:
@@ -141,7 +147,8 @@ class _BoundedPool:
                 f"rejected execution on [{self.name}]: pool is shut down") \
                 from None
 
-    def _run(self, fn, args, kwargs):
+    def _run(self, fn, args, kwargs, t_submit: float):
+        self.queue_wait.observe(time.monotonic() - t_submit)
         with self._lock:
             self.queued -= 1
             self.active += 1
@@ -154,7 +161,7 @@ class _BoundedPool:
 
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "threads": self.size,
                 "queue": self.queued,
                 "queue_size": self.queue_size,
@@ -162,6 +169,9 @@ class _BoundedPool:
                 "rejected": self.rejected,
                 "completed": self.completed,
             }
+        # histogram has its own stripe locks — summarize OUTSIDE _lock
+        out["queue_wait"] = self.queue_wait.stats()
+        return out
 
 
 class ThreadPool:
@@ -275,3 +285,8 @@ class ThreadPool:
 
     def stats(self) -> dict:
         return {name: pool.stats() for name, pool in self._pools.items()}
+
+    def pool_histograms(self) -> dict:
+        """name → queue-wait HistogramMetric (the Prometheus exposition reads
+        the full bucket vectors; /_nodes/stats only carries the summary)."""
+        return {name: pool.queue_wait for name, pool in self._pools.items()}

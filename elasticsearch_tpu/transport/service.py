@@ -17,6 +17,7 @@ from concurrent.futures import Future, InvalidStateError
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Any, Callable
 
+from ..common import tracing
 from ..common.errors import (
     ActionNotFoundError,
     NodeNotConnectedError,
@@ -139,6 +140,21 @@ class TransportService:
         timed-out future are discarded (complete_fut)."""
         fut: Future = Future()
         self.stats["tx_count"] += 1
+        # distributed tracing: when the calling thread carries a sampled span,
+        # wrap the round-trip in a transport span and ship the trace context
+        # INSIDE the request payload (common/stream.py serializes TraceContext
+        # as a typed wire value, so it crosses both the in-process roundtrip
+        # and the TCP frames) — handlers pick it up via request["_trace"].
+        # Unsampled requests pay one thread-local read and nothing else.
+        parent_span = tracing.current_span()
+        if parent_span:  # truthy = sampled (the NOOP span means decided-off)
+            tspan = parent_span.child(f"transport[{action}]")
+            request = {**request,
+                       tracing.TRACE_WIRE_KEY: tracing.wire_context(tspan)}
+            # end at response resolution, whichever path resolves it first —
+            # Span.end is idempotent and only appends under the trace's leaf
+            # lock, so the callback is safe from any resolving thread
+            fut.add_done_callback(lambda _f: tspan.end())
         if timeout is not None:
             self._arm_response_timeout(fut, action, timeout)
         try:

@@ -1,0 +1,112 @@
+"""monitor.py /proc parsing (os/process/fs stats) against canned fixtures.
+
+The monitor service feeds the os/process/fs sections of `/_nodes/stats`; its
+parsing was previously untested — a /proc format drift would silently zero
+operator dashboards. Fixtures here pin the exact dict shape the stats API
+serves (the `proc=` override added for this test reads a fake procfs root)."""
+
+import os
+import types
+
+from elasticsearch_tpu.monitor import (
+    MonitorService,
+    fs_stats,
+    os_stats,
+    process_stats,
+    runtime_stats,
+)
+
+MEMINFO = """MemTotal:       16265540 kB
+MemFree:         1543732 kB
+MemAvailable:    9853212 kB
+Buffers:          734372 kB
+Cached:          6754120 kB
+SwapTotal:       2097148 kB
+SwapFree:        2097000 kB
+"""
+
+SELF_STATUS = """Name:\tpython
+Umask:\t0022
+State:\tR (running)
+Threads:\t17
+VmPeak:\t  902340 kB
+VmRSS:\t  345678 kB
+voluntary_ctxt_switches:\t100
+"""
+
+
+def _fake_proc(tmp_path):
+    proc = tmp_path / "proc"
+    (proc / "self" / "fd").mkdir(parents=True)
+    (proc / "meminfo").write_text(MEMINFO)
+    (proc / "self" / "status").write_text(SELF_STATUS)
+    for i in range(5):
+        (proc / "self" / "fd" / str(i)).write_text("")
+    return str(proc)
+
+
+class TestOsStats:
+    def test_meminfo_parsed_to_bytes(self, tmp_path):
+        out = os_stats(proc=_fake_proc(tmp_path))
+        assert out["mem"]["total_in_bytes"] == 16265540 * 1024
+        assert out["mem"]["free_in_bytes"] == 1543732 * 1024
+        assert out["mem"]["available_in_bytes"] == 9853212 * 1024
+        assert out["swap"]["total_in_bytes"] == 2097148 * 1024
+        assert out["swap"]["free_in_bytes"] == 2097000 * 1024
+        assert out["cpu"]["count"] == os.cpu_count()
+        assert isinstance(out["timestamp"], int)
+
+    def test_missing_meminfo_degrades_gracefully(self, tmp_path):
+        # an empty proc root (no meminfo at all) must not raise — the stats
+        # dict just omits the mem/swap sections
+        out = os_stats(proc=str(tmp_path))
+        assert "mem" not in out
+        assert "cpu" in out
+
+
+class TestProcessStats:
+    def test_status_threads_rss_and_fds(self, tmp_path):
+        out = process_stats(proc=_fake_proc(tmp_path))
+        assert out["threads"] == 17
+        assert out["mem"]["resident_in_bytes"] == 345678 * 1024
+        assert out["open_file_descriptors"] == 5
+        assert out["max_file_descriptors"] >= 5
+        assert out["id"] == os.getpid()
+        cpu = out["cpu"]
+        # total is computed from the float sum; per-part values truncate, so
+        # allow the 1ms-per-part rounding skew
+        assert abs(cpu["total_in_millis"]
+                   - (cpu["user_in_millis"] + cpu["sys_in_millis"])) <= 2
+
+    def test_missing_status_keeps_rusage_fallback(self, tmp_path):
+        out = process_stats(proc=str(tmp_path))
+        # no /proc/self/status fixture: VmRSS fallback is getrusage maxrss
+        assert out["mem"]["resident_in_bytes"] > 0
+        assert "threads" not in out
+
+
+class TestFsStats:
+    def test_statvfs_shape(self, tmp_path):
+        out = fs_stats([str(tmp_path)])
+        assert len(out["data"]) == 1
+        entry = out["data"][0]
+        assert entry["path"] == str(tmp_path)
+        assert entry["total_in_bytes"] >= entry["free_in_bytes"] >= 0
+        assert entry["free_in_bytes"] >= entry["available_in_bytes"] >= 0
+
+    def test_bad_path_skipped(self, tmp_path):
+        out = fs_stats([str(tmp_path / "definitely-not-there")])
+        assert out["data"] == []
+
+
+class TestFullStats:
+    def test_nodes_stats_sections_shape(self, tmp_path):
+        """The exact section set /_nodes/stats spreads into the node dict."""
+        svc = MonitorService(types.SimpleNamespace(data_path=str(tmp_path)))
+        out = svc.full_stats()
+        assert set(out) == {"os", "process", "fs", "runtime"}
+        assert "cpu" in out["os"]
+        assert out["process"]["id"] == os.getpid()
+        rt = runtime_stats()
+        assert rt["runtime"] == "python"
+        assert isinstance(rt["devices"], list)

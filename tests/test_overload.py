@@ -557,7 +557,10 @@ class TestRestOverloadStats:
             assert "admission_control" in node_stats
             assert set(node_stats["admission_control"]) == {
                 "observed", "mean_shard_phase_ms", "ewma_shard_phase_ms",
-                "rejected"}
+                "rejected", "shard_phase"}
+            # the histogram twin of the EWMA (PR 8): tail percentiles ride along
+            assert {"p50_ms", "p95_ms", "p99_ms"} <= set(
+                node_stats["admission_control"]["shard_phase"])
             # cross-request micro-batching counters (search/batcher.py)
             batcher = node_stats["search"]["batcher"]
             for key in ("launches", "coalesced", "occupancy_mean",

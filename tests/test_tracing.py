@@ -1,0 +1,706 @@
+"""End-to-end search tracing + node telemetry (common/tracing.py, PR 8).
+
+Covers: HistogramMetric units (log-spaced buckets, stripes, percentiles,
+Prometheus cumulative view), tracer/span units (sampling, ring bound, wire
+context through the binary codec, in-flight tasks), the live-cluster
+acceptance path — `_search?trace=true` through the batcher yields a
+rest → coordinator → shard → batcher{queue,dispatch,merge} → device-pull
+span tree with the batch's device span attributed to every coalesced member
+and child durations summing to ≤ each parent — plus `/_nodes/stats/{metric}`
+filtering, the Prometheus exposition (parsed with a minimal text-format
+parser), the slowlog trace join, the zero-new-syncs sanitizer invariant
+(warmed traced loop = 0 recompiles under transfer_guard("disallow")), and a
+tpulint-clean scan over every instrumented file."""
+
+import json
+import logging
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.common import tracing
+from elasticsearch_tpu.common.metrics import HistogramMetric
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.common.stream import StreamInput, StreamOutput
+from elasticsearch_tpu.common.tracing import (
+    NOOP_SPAN,
+    TraceContext,
+    Tracer,
+    phase_breakdown,
+    span_tree,
+)
+from elasticsearch_tpu.rest.controller import RestRequest, build_rest_controller
+
+from .harness import TestCluster
+
+WORDS = ["quick", "brown", "fox", "lazy", "dog", "summer", "red", "bear"]
+
+
+# ---------------------------------------------------------------------------
+# HistogramMetric
+# ---------------------------------------------------------------------------
+
+
+class TestHistogramMetric:
+    def test_bucketing_and_percentiles(self):
+        h = HistogramMetric()
+        for _ in range(90):
+            h.observe(0.001)  # 1ms
+        for _ in range(10):
+            h.observe(0.1)  # 100ms
+        assert h.count == 100
+        assert abs(h.sum - (90 * 0.001 + 10 * 0.1)) < 1e-9
+        p50 = h.percentile(0.50)
+        p99 = h.percentile(0.99)
+        # p50 lands in the ~1ms bucket, p99 in the ~100ms bucket; log-spaced
+        # buckets bound the relative error by the bucket ratio (2x)
+        assert 0.0004 < p50 < 0.004, p50
+        assert 0.04 < p99 < 0.3, p99
+        assert p50 <= h.percentile(0.95) <= p99
+
+    def test_empty_and_overflow(self):
+        h = HistogramMetric()
+        assert h.percentile(0.99) == 0.0
+        assert h.stats()["count"] == 0
+        h.observe(10_000.0)  # beyond the last bound -> overflow bucket
+        buckets, total, _ = h.cumulative()
+        assert total == 1
+        assert buckets[-1] == (float("inf"), 1)
+        assert buckets[-2][1] == 0  # nothing below the last finite bound
+
+    def test_concurrent_observes_lose_nothing(self):
+        h = HistogramMetric()
+
+        def worker(seed):
+            for i in range(500):
+                h.observe(0.0001 * ((seed + i) % 7 + 1))
+
+        threads = [threading.Thread(target=worker, args=(s,)) for s in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert h.count == 8 * 500
+
+    def test_cumulative_monotone(self):
+        h = HistogramMetric()
+        for v in (0.0002, 0.003, 0.04, 0.5, 6.0):
+            h.observe(v)
+        buckets, total, _ = h.cumulative()
+        cums = [c for (_b, c) in buckets]
+        assert cums == sorted(cums)
+        assert cums[-1] == total == 5
+
+    def test_stats_shape(self):
+        h = HistogramMetric()
+        h.observe(0.01)
+        st = h.stats()
+        assert set(st) == {"count", "mean_ms", "p50_ms", "p95_ms", "p99_ms"}
+        assert st["count"] == 1 and st["mean_ms"] > 0
+
+
+# ---------------------------------------------------------------------------
+# tracer / span units
+# ---------------------------------------------------------------------------
+
+
+def _tracer(rate="0", ring=None):
+    flat = {"search.trace.sample_rate": rate}
+    if ring is not None:
+        flat["search.trace.ring_size"] = str(ring)
+    t = Tracer(Settings.from_flat(flat), node_name="test")
+    # the unit tests pin explicit rates — neutralize the CI leg's ESTPU_TRACE
+    # override so sampled/unsampled behavior is deterministic here
+    t.sample_rate = float(rate)
+    return t
+
+
+class TestTracerUnits:
+    def test_unsampled_is_noop(self):
+        tr = _tracer("0")
+        trace = tr.start_trace("rest")
+        assert not trace
+        assert trace.root is NOOP_SPAN
+        assert trace.span("x") is NOOP_SPAN
+        trace.root.end()
+        assert tr.traces() == []
+        # activating a noop span keeps tracing off for the scope (falsy
+        # current span) but MARKS the sampling decision as made: a
+        # downstream layer (the coordinator under REST ingress) must see the
+        # noop — not None — so it does not roll the sampling dice again
+        with tracing.activate(trace.root):
+            cur = tracing.current_span()
+            assert cur is NOOP_SPAN and not cur
+            assert cur.child("coordinator") is NOOP_SPAN
+        assert tracing.current_span() is None
+
+    def test_rest_decline_suppresses_coordinator_roll(self, monkeypatch):
+        # the double-roll bug: REST ingress loses its sampling roll, the
+        # coordinator cannot tell "decided unsampled" from "no decision" and
+        # rolls AGAIN — inflating the effective rate (1-(1-r)^2) and rooting
+        # the extra traces at "coordinator" with no rest span. The first
+        # roll fails (0.99 >= rate), a second roll WOULD succeed (0.0)
+        rolls = iter([0.99, 0.0, 0.0])
+        monkeypatch.setattr(tracing.random, "random", lambda: next(rolls))
+        tr = _tracer("0")
+        tr.sample_rate = 0.5
+        trace = tr.start_trace("rest")  # roll 1: declined
+        assert not trace
+        with tracing.activate(trace.root):
+            # actions.search's exact pattern: a present (noop) parent means
+            # the decision is made — child, never start_trace
+            parent = tracing.current_span()
+            assert parent is not None
+            span = parent.child("coordinator")
+            assert span is NOOP_SPAN
+        assert tr.stats()["sampled"] == 0
+        assert next(rolls) == 0.0  # the second roll was never consumed
+
+    def test_late_span_close_refreshes_ring(self):
+        # a timed-out shard attempt's transport span ends only when the late
+        # response (or transport error / in-flight backstop) resolves its
+        # future — possibly AFTER the root closed. The close must refresh
+        # the ring snapshot like a late add_remote does
+        tr = _tracer("0")
+        trace = tr.start_trace("rest", force=True)
+        child = trace.root.child("transport[q]")
+        trace.root.end()
+        assert {s["name"] for s in tr.traces()[0]["spans"]} == {"rest"}
+        child.end()
+        assert {s["name"] for s in tr.traces()[0]["spans"]} == \
+            {"rest", "transport[q]"}
+
+    def test_late_remote_stitch_refreshes_ring(self):
+        # a shard chain the coordinator backstop abandoned resolves AFTER
+        # the root span ended: add_remote must refresh the ring snapshot so
+        # the stitched spans still reach /_traces (and only grow it)
+        tr = _tracer("0", ring=4)
+        trace = tr.start_trace("rest", force=True)
+        root_id = trace.root.span_id
+        trace.root.end()
+        assert len(tr.traces()[0]["spans"]) == 1
+        trace.add_remote([{"id": 99, "parent": root_id, "name": "shard",
+                           "t0": 0.0, "t1": 0.5, "duration_ms": 500.0,
+                           "tags": {}}])
+        (snap,) = tr.traces()
+        assert {s["name"] for s in snap["spans"]} == {"rest", "shard"}
+        assert tr.stats()["finished"] == 1  # refreshed in place, not re-added
+        # an entry the bounded ring already evicted stays evicted
+        for _ in range(4):
+            t2 = tr.start_trace("rest", force=True)
+            t2.root.end()
+        trace.add_remote([{"id": 100, "parent": root_id, "name": "late",
+                           "t0": 0.0, "t1": 0.1, "duration_ms": 100.0,
+                           "tags": {}}])
+        assert all(s["trace_id"] != trace.trace_id for s in tr.traces())
+
+    def test_forced_trace_records_and_rings(self):
+        tr = _tracer("0", ring=4)
+        ids = []
+        for _ in range(7):
+            trace = tr.start_trace("rest", force=True)
+            with trace.root.child("coordinator"):
+                pass
+            trace.root.end()
+            ids.append(trace.trace_id)
+        got = tr.traces()
+        assert len(got) == 4  # bounded ring keeps the newest
+        assert [t["trace_id"] for t in got] == ids[-1:-5:-1]  # newest first
+        names = {s["name"] for s in got[0]["spans"]}
+        assert names == {"rest", "coordinator"}
+
+    def test_tasks_shows_in_flight(self):
+        tr = _tracer("0")
+        trace = tr.start_trace("rest", force=True)
+        child = trace.root.child("coordinator")
+        tasks = tr.tasks()
+        assert len(tasks) == 1
+        assert tasks[0]["trace_id"] == trace.trace_id
+        assert tasks[0]["current_span"] == "coordinator"
+        assert tasks[0]["cancellable"] is False
+        assert tasks[0]["running_time_ms"] >= 0
+        child.end()
+        trace.root.end()
+        assert tr.tasks() == []
+        assert tr.stats()["in_flight"] == 0
+
+    def test_wire_context_roundtrips_binary_codec(self):
+        ctx = TraceContext("abcd1234abcd1234", 1234567890123)
+        out = StreamOutput()
+        out.write_value({"body": {"q": 1}, "_trace": ctx})
+        back = StreamInput(out.bytes()).read_value()
+        assert back["_trace"] == ctx
+        assert back["body"] == {"q": 1}
+
+    def test_continue_trace_stitches_parent(self):
+        tr = _tracer("0")
+        root_trace = tr.start_trace("rest", force=True)
+        wire = tr.wire_context(root_trace.root)
+        shard_trace = tr.continue_trace(wire, "shard")
+        assert shard_trace.trace_id == root_trace.trace_id
+        assert shard_trace.root.parent_id == root_trace.root.span_id
+        shard_trace.root.end()
+        root_trace.add_remote(shard_trace.span_dicts())
+        root_trace.root.end()
+        tree = span_tree(root_trace.span_dicts())
+        assert tree["name"] == "rest"
+        assert [c["name"] for c in tree["children"]] == ["shard"]
+        # continuing nothing is a noop trace
+        assert not tr.continue_trace(None, "shard")
+
+    def test_record_explicit_times_and_phase_breakdown(self):
+        tr = _tracer("0")
+        trace = tr.start_trace("shard", force=True)
+        t0 = time.monotonic()
+        q = trace.root.record("batcher.queue", t0, t0 + 0.010)
+        m = trace.root.record("batcher.merge", t0 + 0.012, t0 + 0.030)
+        m.record("device_pull", t0 + 0.012, t0 + 0.020)
+        assert q.t1 - q.t0 == pytest.approx(0.010)
+        trace.root.end()
+        phases = phase_breakdown(trace)
+        assert phases["queue_ms"] == pytest.approx(10.0, abs=0.1)
+        assert phases["device_ms"] == pytest.approx(8.0, abs=0.1)
+        # merge phase is the host-side remainder (merge minus the pull)
+        assert phases["merge_ms"] == pytest.approx(10.0, abs=0.1)
+        # an unsampled request reads zeros + joins on "-"
+        assert phase_breakdown(None) == {"queue_ms": 0.0, "device_ms": 0.0,
+                                         "merge_ms": 0.0}
+
+
+# ---------------------------------------------------------------------------
+# live cluster: the ?trace=true contract through the batcher
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def live(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("tracing")
+    with TestCluster(n_nodes=1, data_root=tmp, seed=3, settings={
+        # a visible linger window so two concurrent requests coalesce
+        "search.batch.linger_ms": "40",
+        "search.batch.max_batch": "8",
+    }) as cluster:
+        node = next(iter(cluster.nodes.values()))
+        client = node.client()
+        client.create_index("traced", {"settings": {
+            "number_of_shards": 1, "number_of_replicas": 0}})
+        cluster.ensure_green("traced")
+        for i in range(40):
+            client.index("traced", "doc",
+                         {"body": f"{WORDS[i % 8]} {WORDS[(i + 1) % 8]}"},
+                         id=str(i))
+        client.refresh("traced")
+        rc = build_rest_controller(node)
+        # warm occupancy-1 and occupancy-2 executables so traced passes below
+        # measure bookkeeping, not XLA compiles
+        _concurrent_searches(rc, 2, trace=False)
+        yield cluster, node, rc
+
+
+SEARCH_BODY = {"query": {"match": {"body": "quick brown"}}, "size": 5}
+
+
+def _concurrent_searches(rc, n, trace=True):
+    barrier = threading.Barrier(n)
+    out = [None] * n
+
+    def worker(i):
+        barrier.wait()
+        params = {"trace": "true"} if trace else {}
+        out[i] = rc.dispatch(RestRequest(
+            method="POST", path="/traced/_search", params=params,
+            body=dict(SEARCH_BODY)))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    return out
+
+
+def _flatten(node, out=None):
+    out = [] if out is None else out
+    out.append(node)
+    for c in node["children"]:
+        _flatten(c, out)
+    return out
+
+
+def _find(node, name):
+    return [n for n in _flatten(node) if n["name"] == name]
+
+
+class TestLiveTraceTree:
+    def test_trace_true_span_tree_through_batcher(self, live):
+        _cluster, node, rc = live
+        # retry the race: two requests must land in the SAME linger window for
+        # coalesced attribution; each attempt is two fresh traced searches
+        coalesced = None
+        for _attempt in range(8):
+            results = _concurrent_searches(rc, 2)
+            assert all(r.status == 200 for r in results), \
+                [r.body for r in results]
+            trees = [r.body["trace"]["tree"] for r in results]
+            dispatches = [
+                _find(t, "batcher.dispatch") for t in trees]
+            if all(len(d) == 1 for d in dispatches):
+                tags = [d[0]["tags"] for d in dispatches]
+                if (tags[0].get("occupancy", 0) >= 2
+                        and tags[0].get("batch") == tags[1].get("batch")):
+                    coalesced = (results, trees, tags)
+                    break
+        assert coalesced is not None, "requests never coalesced in 8 attempts"
+        results, trees, tags = coalesced
+        for resp, tree in zip(results, trees):
+            # the acceptance chain: rest → coordinator → (transport) → shard →
+            # batcher{queue,dispatch,merge} → device_pull
+            assert tree["name"] == "rest"
+            names = {n["name"] for n in _flatten(tree)}
+            assert {"rest", "coordinator", "shard", "batcher.queue",
+                    "batcher.dispatch", "batcher.merge",
+                    "device_pull"} <= names, names
+            (coord,) = _find(tree, "coordinator")
+            (shard,) = _find(tree, "shard")
+            # the shard span nests (via the transport span) under coordinator
+            assert any(n["name"].startswith("transport[")
+                       for n in _flatten(coord))
+            batcher_names = {c["name"] for c in shard["children"]}
+            assert {"batcher.queue", "batcher.dispatch",
+                    "batcher.merge"} <= batcher_names
+            (merge,) = _find(shard, "batcher.merge")
+            assert [c["name"] for c in merge["children"]] == ["device_pull"]
+            # every coalesced member carries the shared batch's device span
+            (pull,) = _find(tree, "device_pull")
+            assert pull["tags"]["batch"] == tags[0]["batch"]
+            assert pull["duration_ms"] >= 0
+            # child durations sum to ≤ the parent, all the way down
+            self._assert_child_sums(tree)
+            # the response trace id is findable in the node's /_traces ring
+            tid = resp.body["trace"]["trace_id"]
+            ring_ids = {t["trace_id"] for t in node.tracer.traces()}
+            assert tid in ring_ids
+
+    def _assert_child_sums(self, n):
+        child_sum = sum(c["duration_ms"] for c in n["children"])
+        assert child_sum <= n["duration_ms"] + 1.0, \
+            (n["name"], child_sum, n["duration_ms"])
+        for c in n["children"]:
+            self._assert_child_sums(c)
+
+    def test_scrolled_search_honors_trace_param(self, live):
+        # the scroll branch returns early from the REST handler — it must
+        # still root the trace: the initial scan/scroll search is a normal
+        # fan-out and ?trace=true promises an inline tree
+        _cluster, node, rc = live
+        resp = rc.dispatch(RestRequest(
+            method="POST", path="/traced/_search",
+            params={"scroll": "1m", "trace": "true"},
+            body=dict(SEARCH_BODY)))
+        assert resp.status == 200
+        assert "_scroll_id" in resp.body
+        tree = resp.body["trace"]["tree"]
+        assert tree["name"] == "rest"
+        names = {n["name"] for n in _flatten(tree)}
+        assert {"rest", "coordinator", "shard"} <= names, names
+        ring_ids = {t["trace_id"] for t in node.tracer.traces()}
+        assert resp.body["trace"]["trace_id"] in ring_ids
+
+    def test_untraced_response_has_no_trace_section(self, live):
+        _cluster, _node, rc = live
+        (resp,) = _concurrent_searches(rc, 1, trace=False)
+        assert resp.status == 200
+        assert "trace" not in resp.body
+
+    def test_traces_and_tasks_endpoints(self, live):
+        _cluster, node, rc = live
+        r = rc.dispatch(RestRequest(method="GET", path="/_traces", params={}))
+        assert r.status == 200
+        assert r.body["total"] == len(r.body["traces"])
+        assert r.body["tracing"]["ring_size"] >= r.body["total"]
+        for entry in r.body["traces"]:
+            assert {"trace_id", "node", "name", "duration_ms",
+                    "spans"} <= set(entry)
+        t = rc.dispatch(RestRequest(method="GET", path="/_tasks", params={}))
+        assert t.status == 200
+        (node_entry,) = t.body["nodes"].values()
+        assert isinstance(node_entry["tasks"], list)
+
+    def test_slowlog_line_joins_the_trace(self, live):
+        _cluster, node, rc = live
+        client = node.client()
+        client.update_settings("traced", {
+            "index.search.slowlog.threshold.query.warn": "0ms"})
+        records = []
+
+        class _Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        handler = _Capture()
+        logging.getLogger("estpu.action").addHandler(handler)
+        try:
+            (resp,) = _concurrent_searches(rc, 1)
+        finally:
+            logging.getLogger("estpu.action").removeHandler(handler)
+            client.update_settings("traced", {
+                "index.search.slowlog.threshold.query.warn": "-1"})
+        assert resp.status == 200
+        tid = resp.body["trace"]["trace_id"]
+        slow = [m for m in records if "slowlog" in m]
+        assert slow, records
+        joined = [m for m in slow if f"trace[{tid}]" in m]
+        assert joined, slow
+        # the per-phase breakdown is on the line (joinable to /_traces)
+        assert "queue[" in joined[0] and "device[" in joined[0] \
+            and "merge[" in joined[0]
+
+
+# ---------------------------------------------------------------------------
+# /_nodes/stats/{metric} + Prometheus exposition
+# ---------------------------------------------------------------------------
+
+
+def _parse_prometheus(text):
+    """Minimal text-format parser: {series_key: value}, {family: type}."""
+    types, series = {}, {}
+    for line in text.splitlines():
+        if not line or line.startswith("# HELP"):
+            continue
+        if line.startswith("# TYPE"):
+            _h, _t, name, typ = line.split()
+            types[name] = typ
+            continue
+        key, val = line.rsplit(" ", 1)
+        series[key] = float(val)
+    return types, series
+
+
+class TestStatsSurfaces:
+    def test_nodes_stats_metric_filtering(self, live):
+        _cluster, node, rc = live
+        r = rc.dispatch(RestRequest(
+            method="GET", path="/_nodes/stats/thread_pool,breakers", params={}))
+        assert r.status == 200
+        (sections,) = r.body["nodes"].values()
+        assert sorted(sections) == ["breakers", "thread_pool"]
+        # every section in the unfiltered response is addressable by name
+        full = rc.dispatch(RestRequest(method="GET", path="/_nodes/stats",
+                                       params={}))
+        (all_sections,) = full.body["nodes"].values()
+        for metric in all_sections:
+            one = rc.dispatch(RestRequest(
+                method="GET", path=f"/_nodes/stats/{metric}", params={}))
+            assert one.status == 200, metric
+            (s,) = one.body["nodes"].values()
+            assert list(s) == [metric]
+
+    def test_unknown_metric_is_400(self, live):
+        _cluster, _node, rc = live
+        r = rc.dispatch(RestRequest(method="GET", path="/_nodes/stats/bogus",
+                                    params={}))
+        assert r.status == 400
+        assert "bogus" in json.dumps(r.body)
+
+    def test_stats_carry_histogram_percentiles(self, live):
+        _cluster, node, _rc = live
+        stats = node.client().nodes_stats()["nodes"][node.node_id]
+        lat = stats["search"]["latency"]
+        assert lat["count"] >= 1
+        assert lat["p99_ms"] >= lat["p50_ms"] >= 0
+        assert "queue_wait" in stats["thread_pool"]["search"]
+        assert "shard_phase" in stats["admission_control"]
+        assert "batch" in stats["search"]["batcher"]
+        assert stats["tracing"]["ring_size"] >= 1
+
+    def test_prometheus_exposition_parses(self, live):
+        _cluster, node, rc = live
+        r = rc.dispatch(RestRequest(method="GET", path="/_prometheus/metrics",
+                                    params={}))
+        assert r.status == 200 and r.content_type.startswith("text/plain")
+        types, series = _parse_prometheus(r.body)
+        # the required families: breakers, pools, batcher, compile events,
+        # search-latency histogram (+ HBM gauge)
+        assert types["estpu_breaker_estimated_bytes"] == "gauge"
+        assert types["estpu_threadpool_queue_wait_seconds"] == "histogram"
+        assert types["estpu_batcher_launches_total"] == "counter"
+        assert types["estpu_jax_compile_events_total"] == "counter"
+        assert types["estpu_search_latency_seconds"] == "histogram"
+        assert types["estpu_hbm_resident_bytes"] == "gauge"
+        assert types["estpu_admission_shard_phase_seconds"] == "histogram"
+        assert series['estpu_breaker_estimated_bytes{breaker="request"}'] == 0
+        # histogram contract: +Inf bucket equals _count
+        count = series["estpu_search_latency_seconds_count"]
+        assert count >= 1
+        assert series['estpu_search_latency_seconds_bucket{le="+Inf"}'] == count
+        # packed device postings are resident after the searches above
+        assert series["estpu_hbm_resident_bytes"] > 0
+        launches = series["estpu_batcher_launches_total"]
+        assert launches >= 1
+        # exposition grouping: every family's samples must be CONTIGUOUS —
+        # interleaved families (pool A's gauges, pool B's gauges re-opening
+        # the first family) pass the classic scraper but are rejected whole
+        # by promtool / OpenMetrics-strict ingesters
+        seen, current = set(), None
+        for line in r.body.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            name = line.split("{", 1)[0].split(" ", 1)[0]
+            for suffix in ("_bucket", "_sum", "_count"):
+                base = name[:-len(suffix)]
+                if name.endswith(suffix) and f"# TYPE {base} histogram" in r.body:
+                    name = base
+                    break
+            if name != current:
+                assert name not in seen, f"family {name} interleaved"
+                seen.add(name)
+                current = name
+
+
+# ---------------------------------------------------------------------------
+# sanitizer: tracing adds zero device syncs / zero recompiles
+# ---------------------------------------------------------------------------
+
+
+class TestTracedSanitized:
+    def test_warmed_traced_loop_zero_recompiles(self, tmp_path):
+        """The serving invariant, with tracing fully armed: a warmed traced
+        concurrent loop through the batcher performs no implicit transfers
+        (hard transfer_guard) and 0 backend compiles — span end-times ride
+        the batch's existing pull, so arming tracing adds NO device work."""
+        import jax
+
+        from elasticsearch_tpu.common.jaxenv import sanitize
+        from elasticsearch_tpu.index import Engine
+        from elasticsearch_tpu.mapper import MapperService
+        from elasticsearch_tpu.search import ShardContext, parse_query
+        from elasticsearch_tpu.search.batcher import DeviceBatcher
+        from elasticsearch_tpu.search.execute import lower_flat
+        from elasticsearch_tpu.search.similarity import SimilarityService
+
+        settings = Settings.from_flat({})
+        svc = MapperService(settings)
+        e = Engine(str(tmp_path / "shard0"), svc)
+        for i in range(50):
+            e.index("doc", str(i),
+                    {"body": f"{WORDS[i % 8]} {WORDS[(i + 2) % 8]}"})
+        e.refresh()
+        ctx = ShardContext(e.acquire_searcher(), svc,
+                           SimilarityService(settings, mapper_service=svc))
+        batcher = DeviceBatcher(Settings.from_flat(
+            {"search.batch.linger_ms": "25", "search.batch.max_batch": "8"}))
+        tracer = _tracer("0")
+        texts = ["quick brown", "lazy dog", "red bear", "fox dog"]
+        plans = [lower_flat(parse_query({"match": {"body": t}}), ctx)
+                 for t in texts]
+
+        def traced_round():
+            out = [None] * len(plans)
+            errs = [None] * len(plans)
+
+            def worker(i):
+                trace = tracer.start_trace("search", force=True)
+                try:
+                    with tracing.activate(trace.root):
+                        out[i] = batcher.execute(plans[i], ctx, 10)
+                except Exception as err:  # noqa: BLE001 — assert below
+                    errs[i] = err
+                finally:
+                    trace.root.end()
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(len(plans))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(30)
+            assert all(e2 is None for e2 in errs), errs
+            return out
+
+        try:
+            warm = traced_round()
+            jax.config.update("jax_transfer_guard", "disallow")
+            try:
+                with sanitize(max_compiles=0, transfers="disallow") as rep:
+                    again = traced_round()
+            finally:
+                jax.config.update("jax_transfer_guard", "allow")
+            assert rep.compiles == 0, rep.compile_events
+            for w, a in zip(warm, again):
+                assert a.hits == w.hits and a.total == w.total
+            # every traced request got the batcher spans + the device pull
+            for entry in tracer.traces()[:4]:
+                names = {s["name"] for s in entry["spans"]}
+                assert {"batcher.queue", "batcher.dispatch",
+                        "batcher.merge", "device_pull"} <= names, names
+        finally:
+            batcher.shutdown()
+
+    def test_trace_sync_mode_is_opt_in_and_correct(self, tmp_path,
+                                                   monkeypatch):
+        """ESTPU_TRACE_SYNC=1 (precise device timing for bench/debug) still
+        returns identical results — it only moves the dispatch span's end to
+        launch completion."""
+        from elasticsearch_tpu.index import Engine
+        from elasticsearch_tpu.mapper import MapperService
+        from elasticsearch_tpu.search import ShardContext, parse_query
+        from elasticsearch_tpu.search.batcher import DeviceBatcher
+        from elasticsearch_tpu.search.execute import (execute_flat_batch,
+                                                      lower_flat)
+        from elasticsearch_tpu.search.similarity import SimilarityService
+
+        assert not tracing.sync_armed()
+        monkeypatch.setenv("ESTPU_TRACE_SYNC", "1")
+        assert tracing.sync_armed()
+        settings = Settings.from_flat({})
+        svc = MapperService(settings)
+        e = Engine(str(tmp_path / "shard0"), svc)
+        for i in range(30):
+            e.index("doc", str(i), {"body": f"{WORDS[i % 8]} {WORDS[(i + 1) % 8]}"})
+        e.refresh()
+        ctx = ShardContext(e.acquire_searcher(), svc,
+                           SimilarityService(settings, mapper_service=svc))
+        plan = lower_flat(parse_query({"match": {"body": "quick"}}), ctx)
+        expected = execute_flat_batch([plan], ctx, 10)[0]
+        batcher = DeviceBatcher(Settings.from_flat({}))
+        tracer = _tracer("0")
+        trace = tracer.start_trace("search", force=True)
+        try:
+            with tracing.activate(trace.root):
+                got = batcher.execute(plan, ctx, 10)
+        finally:
+            trace.root.end()
+            batcher.shutdown()
+        assert got.hits == expected.hits and got.total == expected.total
+        names = {s["name"] for s in trace.span_dicts()}
+        assert "batcher.dispatch" in names
+
+
+# ---------------------------------------------------------------------------
+# tpulint: the instrumented files stay clean
+# ---------------------------------------------------------------------------
+
+
+def test_observability_files_tpulint_clean():
+    """Tracing touches the device hot path (batcher, execute, mesh serving):
+    every instrumented file must stay free of findings so the empty baseline
+    holds."""
+    from tools.tpulint import lint_paths
+
+    wanted = {
+        "elasticsearch_tpu/common/tracing.py",
+        "elasticsearch_tpu/common/metrics.py",
+        "elasticsearch_tpu/common/stream.py",
+        "elasticsearch_tpu/search/batcher.py",
+        "elasticsearch_tpu/search/execute.py",
+        "elasticsearch_tpu/search/service.py",
+        "elasticsearch_tpu/transport/service.py",
+        "elasticsearch_tpu/actions.py",
+        "elasticsearch_tpu/rest/controller.py",
+        "elasticsearch_tpu/threadpool.py",
+        "elasticsearch_tpu/parallel/mesh_serving.py",
+        "elasticsearch_tpu/monitor.py",
+    }
+    findings = [f for f in lint_paths(None) if f.path in wanted]
+    assert findings == [], [f.to_dict() for f in findings]
