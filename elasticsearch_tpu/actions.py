@@ -28,6 +28,7 @@ import uuid
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FutureTimeoutError
 
+from .common import profile as profiling
 from .common import tracing
 from .common.deadline import NO_DEADLINE, Deadline
 from .common.metrics import HistogramMetric
@@ -1901,6 +1902,7 @@ class ActionModule:
                         context_id=r.get("ctx_id"),
                         shard_id=candidate.shard_id,
                         timed_out=bool(r.get("timed_out")),
+                        profile=r.get("profile"),
                     )
                     result.index_name = candidate.index  # type: ignore[attr-defined]
                     done.set_result((result, node, None))
@@ -1964,7 +1966,21 @@ class ActionModule:
         if alias_filter:
             query = body.get("query") or {"match_all": {}}
             body["query"] = {"filtered": {"query": query, "filter": alias_filter}}
+        # `"profile": true` (peeked BEFORE parsing, so the unprofiled path
+        # pays no clock read — profile.py design rule): arm the white-box
+        # execution profiler for THIS shard's query phase. The collector is
+        # created ahead of parse_search_body so its t0 — and therefore
+        # phases_ms.total — covers the parse phase it times; it is activated
+        # thread-locally around the phase (profiled requests bypass the
+        # batcher, so execution never leaves this thread), and its result
+        # rides the response next to the span list.
+        prof = None
+        if isinstance(body, dict) and bool(body.get("profile")):
+            prof = profiling.ProfileCollector(node=self.node.name,
+                                              index=index, shard=shard_id)
         req = parse_search_body(body)
+        if prof is not None:
+            prof.phase_s("parse", time.monotonic() - prof.t0)
         ctx = self._shard_ctx(index, shard_id, request.get("dfs"))
         # shard-side budget: the tighter of the coordinator's remaining budget
         # (shipped as a duration in `deadline_s`) and the body's own `timeout`
@@ -1983,8 +1999,14 @@ class ActionModule:
         t_q = time.monotonic()
         try:
             with tracing.activate(shard_span):
-                result = execute_query_phase(ctx, req, shard_id=shard_id,
-                                             deadline=deadline)
+                if prof is None:
+                    result = execute_query_phase(ctx, req, shard_id=shard_id,
+                                                 deadline=deadline)
+                else:
+                    with profiling.activate(prof):
+                        result = execute_query_phase(ctx, req,
+                                                     shard_id=shard_id,
+                                                     deadline=deadline)
         finally:
             shard_span.end()
         self._maybe_slowlog(index, shard_id, body, (time.monotonic() - t_q),
@@ -2006,6 +2028,11 @@ class ActionModule:
             # stitch the cross-node tree inline (the `?trace=true` contract);
             # the shard node ALSO keeps its own copy in its /_traces ring
             out["spans"] = trace.span_dicts()
+        if prof is not None:
+            # the shard profile crosses the wire the same way the span list
+            # does — plain scalars through the binary codec, stitched by the
+            # coordinator into the top-level `profile` section
+            out["profile"] = prof.to_dict()
         return out
 
     def _maybe_slowlog(self, index: str, shard_id: int, body: dict, took_s: float,

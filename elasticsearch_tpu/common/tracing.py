@@ -431,6 +431,12 @@ class Tracer:
         self._inflight_lock = threading.Lock()
         self._sampled_total = 0
         self._finished_total = 0
+        # ring-pressure counters (guarded by _ring_lock): a bounded ring that
+        # silently forgets traces is an observability hole — surface how many
+        # finished traces were evicted, and how many late remote stitches
+        # arrived after their entry was already gone
+        self._ring_evicted = 0
+        self._stitch_dropped = 0
 
     # -- starting / continuing ----------------------------------------------
     def _sampled(self) -> bool:
@@ -477,6 +483,8 @@ class Tracer:
             # stitch that lands after this flag re-snapshots via _restitch
         snap = trace.to_dict()
         with self._ring_lock:
+            if len(self._ring) == self._ring.maxlen:
+                self._ring_evicted += 1  # the append below pushes one out
             self._ring.append((trace._seq, snap))
             trace._in_ring = True
             self._finished_total += 1
@@ -500,6 +508,10 @@ class Tracer:
                     if len(old["spans"]) < len(snap["spans"]):
                         self._ring[i] = (seq, snap)
                     return
+            # the bounded ring already evicted this trace: the late stitch's
+            # spans are dropped by design (replace-only) — count the drop so
+            # /_traces pressure is visible instead of silent
+            self._stitch_dropped += 1
 
     # -- observability surfaces ---------------------------------------------
     def traces(self, limit: int | None = None) -> list[dict]:
@@ -529,6 +541,8 @@ class Tracer:
         with self._ring_lock:
             ring_len = len(self._ring)
             finished = self._finished_total
+            ring_evicted = self._ring_evicted
+            stitch_dropped = self._stitch_dropped
         with self._inflight_lock:
             sampled = self._sampled_total
             in_flight = len(self._inflight)
@@ -539,4 +553,6 @@ class Tracer:
             "in_flight": in_flight,
             "ring": ring_len,
             "ring_size": self._ring.maxlen,
+            "ring_evicted": ring_evicted,
+            "late_stitch_dropped": stitch_dropped,
         }

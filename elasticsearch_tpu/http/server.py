@@ -11,7 +11,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import parse_qsl, urlparse
+from urllib.parse import parse_qsl, unquote_plus, urlparse
 
 from ..common import xcontent
 from ..common.logging import get_logger
@@ -71,9 +71,15 @@ class HttpServer:
                     self.end_headers()
                     self.wfile.write(payload)
                     return
+                # the _cat flag idiom is a BARE `?v` / `?help` with no value:
+                # surface those as "" (truthy flags) — but keep dropping
+                # explicit blanks (`?from=`), whose handlers expect absence
+                params = dict(parse_qsl(parsed.query))
+                for seg in parsed.query.split("&"):
+                    if seg and "=" not in seg:
+                        params.setdefault(unquote_plus(seg), "")
                 request = RestRequest(
-                    method=method, path=parsed.path,
-                    params=dict(parse_qsl(parsed.query)), body=body)
+                    method=method, path=parsed.path, params=params, body=body)
                 response = rest.dispatch(request)
                 # response rides the request's format, or an explicit ?format=
                 out_fmt = xcontent.from_content_type(

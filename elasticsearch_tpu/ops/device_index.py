@@ -35,10 +35,12 @@ quantization.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field as dc_field
 
 import numpy as np
 
+from ..common import profile as _profile
 from ..common.breaker import reserve
 from ..index.segment import FrozenSegment
 
@@ -340,12 +342,18 @@ def ensure_blk_freqs(packed: PackedSegment, breaker=None):
     falls back to the host scorer. The dense call sites in search/execute.py
     pass it; the unaccounted default exists only for the direct-kernel tests
     and for segments whose plane is already resident."""
+    prof = _profile.current()
     if packed.blk_freqs is None:
         import jax.numpy as jnp
 
         with reserve(breaker, packed.host_freqs.nbytes, "<dense_freqs>"):
             packed.blk_freqs = jnp.asarray(
                 packed.host_freqs.reshape(-1, BLOCK))
+        if prof is not None:
+            prof.event("blk_freqs", cache="fault",
+                       bytes=int(packed.host_freqs.nbytes))
+    elif prof is not None:
+        prof.event("blk_freqs", cache="resident")
     return packed.blk_freqs
 
 
@@ -469,11 +477,14 @@ def ensure_sim_tables(packed: PackedSegment,
     across calls (stable fid rows per merged set); callers must use the
     RETURNED object's fid/caches for the launch they plan — a concurrent
     re-ensure swaps packed.sim but never mutates an existing SimTables."""
+    prof = _profile.current()
     cur = packed.sim
     if cur is not None and all(
         f in cur.key and cur.key[f] == (mode, cache.tobytes())
         for f, (mode, cache) in tables.items()
     ):
+        if prof is not None:
+            prof.event("sim_tables", cache="hit", fields=len(cur.fields))
         return cur
     import jax.numpy as jnp
 
@@ -495,6 +506,8 @@ def ensure_sim_tables(packed: PackedSegment,
                     modes=jnp.asarray(modes), caches=jnp.asarray(caches),
                     key=merged)
     packed.sim = sim
+    if prof is not None:
+        prof.event("sim_tables", cache="swap", fields=len(fields))
     return sim
 
 
@@ -508,11 +521,18 @@ def packed_for(seg: FrozenSegment, breaker=None) -> PackedSegment:
     (the one graceful-degradation edge the reference lacks)."""
     cache = seg._device_cache
     packed: PackedSegment | None = cache.get("packed")
+    prof = _profile.current()
     if packed is None:
+        t0 = time.monotonic() if prof is not None else 0.0
         with reserve(breaker, pack_estimate_bytes(seg), f"<segment_pack>[{seg.gen}]"):
             packed = pack_segment(seg)
         cache["packed"] = packed
         cache["live"] = True
+        if prof is not None:
+            prof.event("packed_segment", gen=int(seg.gen), cache="pack",
+                       ms=round((time.monotonic() - t0) * 1000.0, 4),
+                       resident_bytes=int(packed_resident_bytes(packed)),
+                       tf_layout=packed.tf_layout)
     elif cache.get("live") is None:
         import jax.numpy as jnp
 
@@ -527,4 +547,8 @@ def packed_for(seg: FrozenSegment, breaker=None) -> PackedSegment:
                           packed.doc_pad).astype(np.int32, copy=False)
         packed.blk_docs = jnp.asarray(masked.reshape(-1, BLOCK))
         cache["live"] = True
+        if prof is not None:
+            prof.event("packed_segment", gen=int(seg.gen), cache="live_remask")
+    elif prof is not None:
+        prof.event("packed_segment", gen=int(seg.gen), cache="hit")
     return packed

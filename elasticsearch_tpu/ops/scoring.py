@@ -32,6 +32,7 @@ from dataclasses import dataclass, field as dc_field
 
 import numpy as np
 
+from ..common import profile as _profile
 from ..common.breaker import reserve
 from .device_index import (
     BLOCK,
@@ -764,9 +765,15 @@ class SparseScratchPool:
         with self._lock:
             lst = self._free.get((Qb, tb))
             arrs = lst.pop() if lst else None
+        # profile attribution: whether this launch's staging came from the
+        # pool or a fresh allocation (recorded OUTSIDE the pool lock — the
+        # hook is record-only and must never run under another lock)
+        prof = _profile.current()
         if arrs is None:
             with self._lock:
                 self.allocs += 1
+            if prof is not None:
+                prof.event("scratch", cache="alloc", shape=[int(Qb), int(tb)])
             return (np.full((Qb, tb), sentinel_row, np.int32),
                     np.zeros((Qb, tb), np.float32),
                     np.zeros((Qb, tb), bool),
@@ -774,6 +781,8 @@ class SparseScratchPool:
                     np.zeros((Qb, tb), np.int32))
         with self._lock:
             self.reuses += 1
+        if prof is not None:
+            prof.event("scratch", cache="reuse", shape=[int(Qb), int(tb)])
         qblk, qw, qconst, qcnt, qfid = arrs
         qblk.fill(sentinel_row)
         qw.fill(0.0)

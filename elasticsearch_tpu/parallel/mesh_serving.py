@@ -17,10 +17,12 @@ live version moves (NRT refresh / merges / deletes)."""
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import Future
 
 import numpy as np
 
+from ..common import profile as _profile
 from ..common import tracing
 from ..common.breaker import reserve as breaker_reserve
 from ..common.errors import CircuitBreakingError
@@ -41,6 +43,7 @@ class MeshServingService:
     def __init__(self, indices_service, settings, node_name: str = "node"):
         self.indices = indices_service
         self.enabled = bool(settings.get_bool("search.mesh.enabled", True))
+        self.node_name = node_name  # profile attribution ("[node][index][shard]")
         self.logger = get_logger("search.mesh", node=node_name)
         # the node's cross-request DeviceBatcher (set by ActionModule): plain
         # mesh searches coalesce into one SPMD launch through the same queue
@@ -117,9 +120,22 @@ class MeshServingService:
             return None
         index, n_total = eligible
         self._prune(state)
+        # the mesh path runs ON the coordinator (no shard-side _s_query_phase
+        # to arm a collector), so a profiled request roots its collector here:
+        # one collector for the single SPMD launch, fanned out per ordinal
+        prof = None
+        if req.profile:
+            prof = _profile.ProfileCollector(node=self.node_name, index=index)
         try:
-            results = self._search_mesh(index, n_total, shards, req,
-                                        use_global_stats, deadline=deadline)
+            if prof is None:
+                results = self._search_mesh(index, n_total, shards, req,
+                                            use_global_stats,
+                                            deadline=deadline)
+            else:
+                with _profile.activate(prof):
+                    results = self._search_mesh(index, n_total, shards, req,
+                                                use_global_stats,
+                                                deadline=deadline, prof=prof)
         except CircuitBreakingError:
             # a tripped breaker means the NODE is out of budget — falling back
             # to the transport path would re-materialize the same request-sized
@@ -155,7 +171,7 @@ class MeshServingService:
     # ------------------------------------------------------------------
     def _search_mesh(self, index: str, n_total: int, shards,
                      req: ParsedSearchRequest, use_global_stats: bool,
-                     deadline=None):
+                     deadline=None, prof=None):
         from ..common.errors import IndexShardMissingError
 
         svc = self.indices.index_service(index)
@@ -234,6 +250,22 @@ class MeshServingService:
                                       use_global_stats)
         if executor is None:
             return None
+        if prof is not None:
+            from ..search.execute import plan_profile
+
+            prof.outcome("mesh_spmd")
+            # report the REQUEST's query shape: `query` was rebound to the
+            # inner query for FilteredQuery (the mesh applies the filter via
+            # mask rows, so plan.filt is always None here) — the profile must
+            # match what the transport path reports for the same body
+            shape = plan_profile(plan, req.query)
+            shape["filtered"] = filt is not None
+            prof.set_plan(shape)
+            prof.mesh_info(
+                shards=int(S), tf_layout=executor.index.tf_layout,
+                resident_postings_bytes=int(
+                    executor.index.resident_postings_bytes()),
+                global_stats=bool(use_global_stats))
         doc_pad = executor.index.doc_pad
         if k > doc_pad:
             return None
@@ -309,7 +341,13 @@ class MeshServingService:
                      and post_masks is None and req.min_score is None
                      and sort_keys is None and active is None
                      and not bucket_pairs)
-            if plain and self.batcher is not None:
+            if plain and self.batcher is not None and prof is not None:
+                # mirror of service._execute_flat_single: the coalescing
+                # queue WOULD have served this plain search — record and
+                # count the explicit profile bypass before launching directly
+                prof.batcher_bypass("profile")
+                self.batcher.note_profile_bypass()
+            if plain and self.batcher is not None and prof is None:
                 # plain searches carry no per-request program arguments, so
                 # concurrent ones coalesce into ONE SPMD launch through the
                 # node's cross-request queue (search/batcher.py _MeshFamily —
@@ -328,6 +366,7 @@ class MeshServingService:
                 cur = tracing.current_span()
                 mesh_span = cur.child("mesh.launch").tag(
                     index=index, shards=S) if cur is not None else None
+                t_launch = time.monotonic() if prof is not None else 0.0
                 try:
                     out = executor.search(
                         [plan], k, filter_masks=filter_masks, agg_rows=agg_rows,
@@ -342,6 +381,10 @@ class MeshServingService:
                 finally:
                     if mesh_span is not None:
                         mesh_span.end()
+                if prof is not None:
+                    # launch + the executor's own program-output pull, one
+                    # phase (the pull IS the sync — nothing extra added)
+                    prof.phase_s("mesh_launch", time.monotonic() - t_launch)
             self.mesh_queries += 1
 
             track = bool(req.track_scores) if req.sort else True
@@ -356,6 +399,10 @@ class MeshServingService:
                 doc_row = out.doc[0].tolist()
                 totals_col = out.shard_totals[:, 0].tolist()
                 qmax_col = out.qmax[:, 0].tolist()
+            # one collector covers the single SPMD launch; each ordinal's
+            # entry re-brands the shared attribution with its own shard id
+            # (the reference's per-shard `profile` entries, mesh-served)
+            mesh_prof = prof.to_dict() if prof is not None else None
             results = []
             for ordinal, copy in enumerate(shards):
                 sid = copy.shard_id
@@ -380,6 +427,10 @@ class MeshServingService:
                     agg_partials=agg_partials,
                     shard_id=ordinal,
                 )
+                if mesh_prof is not None:
+                    result.profile = {
+                        **mesh_prof, "shard": int(sid),
+                        "id": f"[{self.node_name}][{index}][{sid}]"}
                 # pin the query-time searcher for the fetch phase (a merge between
                 # phases must not move local doc ids under the fetch)
                 pin = getattr(self, "pin_context", None)
@@ -540,12 +591,17 @@ class MeshServingService:
              s.max_doc)
             for s in searchers
         )
+        prof = _profile.current()
         with self._lock:
             cached = self._executors.get(index)
             if cached is not None and cached[0] == freshness and cached[1] is svc:
                 execs = cached[2]
                 if execs is None:
                     return None  # negative cache: this generation failed to build
+                if prof is not None:
+                    # pure list append — event() takes no locks, blocks on
+                    # nothing, dispatches nothing (profile.py design rules)
+                    prof.event("mesh_executor", cache="hit")
                 return execs[use_global_stats]
             inflight = self._building.get(index)
             if inflight is not None and inflight[0] == freshness \
@@ -568,8 +624,12 @@ class MeshServingService:
                 return None
             return None if execs is None else execs[use_global_stats]
         execs = None
+        t_build = time.monotonic() if prof is not None else 0.0
         try:
             execs = self._build_executors(searchers, kind, default_sim)
+            if prof is not None and execs is not None:
+                prof.event("mesh_executor", cache="build",
+                           ms=round((time.monotonic() - t_build) * 1000.0, 4))
         except Exception as e:  # noqa: BLE001 — e.g. device OOM on pack
             # negative-cache the failure so every search doesn't re-pay a
             # doomed multi-second repack

@@ -31,12 +31,16 @@ class RestRequest:
     path_params: dict = dc_field(default_factory=dict)
 
     def param(self, name: str, default=None):
-        return self.path_params.get(name) or self.params.get(name, default)
+        # a blank value (a bare `?from` token surfaced by the http layer)
+        # reads as ABSENT for valued params — only flags may be bare, and
+        # they read presence via bool_param below
+        v = self.path_params.get(name) or self.params.get(name)
+        return default if v is None or v == "" else v
 
     def bool_param(self, name: str, default=False) -> bool:
-        v = self.param(name)
-        if v is None:
+        if name not in self.params and not self.path_params.get(name):
             return default
+        v = self.path_params.get(name) or self.params.get(name)
         return str(v).lower() in ("true", "1", "")
 
 
@@ -304,6 +308,12 @@ def _prometheus_text(node) -> str:
     w.counter("estpu_traces_sampled_total", ts["sampled"])
     w.counter("estpu_traces_finished_total", ts["finished"])
     w.gauge("estpu_traces_in_flight", ts["in_flight"])
+    # ring pressure: finished traces the bounded ring evicted, and late
+    # remote stitches that arrived after their entry was already gone — a
+    # scraper alerting on these knows /_traces is lossy before users do
+    w.counter("estpu_traces_ring_evicted_total", ts["ring_evicted"])
+    w.counter("estpu_traces_late_stitch_dropped_total",
+              ts["late_stitch_dropped"])
     return w.text()
 
 
@@ -574,6 +584,11 @@ def build_rest_controller(node) -> RestController:
             # RestSearchAction parsing timeout into the SearchSourceBuilder);
             # parse_search_body turns it into ParsedSearchRequest.timeout_s
             body["timeout"] = req.param("timeout")
+        if req.param("profile") is not None:
+            # `?profile=true` arms the white-box execution profiler — same
+            # knob as the body's `"profile": true` (common/profile.py); the
+            # per-shard collectors merge into a top-level `profile` section
+            body["profile"] = req.bool_param("profile")
         return body
 
     def search(req):
@@ -970,7 +985,11 @@ def build_rest_controller(node) -> RestController:
     rc.register("GET", "/_stats", lambda r: {"indices": client.stats()})
     rc.register("GET", "/{index}/_stats",
                 lambda r: {"indices": client.stats(r.path_params["index"])})
-    rc.register("GET", "/_segments", lambda r: {"indices": client.stats()})
+    # real segment introspection (no longer an alias of _stats): per-shard
+    # per-segment packed-layout report — see Client.segments
+    rc.register("GET", "/_segments", lambda r: client.segments())
+    rc.register("GET", "/{index}/_segments",
+                lambda r: client.segments(r.path_params["index"]))
 
     # --- cluster admin ------------------------------------------------------
     rc.register("GET", "/_cluster/health",
@@ -1016,8 +1035,8 @@ def build_rest_controller(node) -> RestController:
     rc.register("GET", "/_nodes/{node_id}/stats", lambda r: client.nodes_stats())
     rc.register("GET", "/_nodes/{node_id}/stats/{metric}",
                 lambda r: client.nodes_stats(metric=r.path_params["metric"]))
-    rc.register("GET", "/_cluster/nodes/hot_threads", lambda r: _hot_threads())
-    rc.register("GET", "/_nodes/hot_threads", lambda r: _hot_threads())
+    rc.register("GET", "/_cluster/nodes/hot_threads", lambda r: _hot_threads(r))
+    rc.register("GET", "/_nodes/hot_threads", lambda r: _hot_threads(r))
 
     # --- tracing / telemetry (common/tracing.py) ----------------------------
     def get_traces(req):
@@ -1086,20 +1105,110 @@ def build_rest_controller(node) -> RestController:
     rc.register("POST", "/_nodes/_local/profiler/start", _profiler_start)
     rc.register("POST", "/_nodes/_local/profiler/stop", _profiler_stop)
 
-    def _hot_threads():
-        """ref: monitor/jvm/HotThreads — stacks of the busiest threads."""
+    # top-of-stack functions that mean "parked, not working": a thread whose
+    # frame sits in one of these across BOTH snapshots with no CPU accrued is
+    # idle (pool workers waiting for tasks, the scheduler loop, acceptors)
+    _IDLE_FRAME_FUNCS = frozenset({
+        "wait", "_wait_for_tstate_lock", "select", "poll", "epoll", "accept",
+        "get", "sleep", "_recv_bytes", "recv", "recv_into", "readinto",
+        "read", "park", "acquire", "_eintr_retry", "kqueue",
+    })
+
+    def _thread_cpu_ticks():
+        """Per-native-thread (utime+stime) ticks from /proc/self/task/<tid>/stat
+        — the real busyness signal; {} when procfs is unavailable (non-Linux:
+        the frame-diff heuristic alone ranks)."""
+        ticks = {}
+        try:
+            for tid in os.listdir("/proc/self/task"):
+                try:
+                    with open(f"/proc/self/task/{tid}/stat") as fh:
+                        stat = fh.read()
+                    # comm may contain spaces — fields start after the ')'
+                    fields = stat.rsplit(")", 1)[1].split()
+                    ticks[int(tid)] = int(fields[11]) + int(fields[12])
+                except (OSError, ValueError, IndexError):
+                    continue
+        except OSError:
+            return {}
+        return ticks
+
+    def _hot_threads(req):
+        """ref: monitor/jvm/HotThreads — two-snapshot sampling over
+        `?interval=` (default 500ms): per-thread CPU ticks from procfs plus
+        stack frames at both endpoints, ranked by observed busyness; idle/
+        parked threads (no CPU, same wait-frame at both snapshots) are
+        skipped; `?threads=` bounds the report (default 3)."""
         import sys
         import traceback
 
-        out = []
-        frames = sys._current_frames()
         import threading as _th
 
-        names = {t.ident: t.name for t in _th.enumerate()}
-        for tid, frame in list(frames.items())[:10]:
-            stack = "".join(traceback.format_stack(frame, limit=8))
-            out.append(f"::: [{names.get(tid, tid)}]\n{stack}")
-        return RestResponse(200, "\n".join(out), content_type="text/plain")
+        from ..common.deadline import parse_timevalue
+
+        try:
+            interval_s = parse_timevalue(req.param("interval", "500ms"))
+            n_threads = int(req.param("threads", 3))
+        except (TypeError, ValueError) as e:
+            from ..common.errors import IllegalArgumentError
+
+            raise IllegalArgumentError(
+                f"bad hot_threads parameter: {e}") from None
+        if interval_s is None or interval_s < 0:
+            interval_s = 0.5
+        interval_s = min(interval_s, 30.0)  # a typo must not park the handler
+
+        me = _th.get_ident()
+        ticks0 = _thread_cpu_ticks()
+        frames0 = {tid: (id(f), f.f_lasti, f.f_lineno, f.f_code.co_name)
+                   for tid, f in sys._current_frames().items()}
+        time.sleep(interval_s)
+        ticks1 = _thread_cpu_ticks()
+        frames1 = dict(sys._current_frames())
+        threads = {t.ident: t for t in _th.enumerate()}
+        clk_tck = 100.0
+        try:
+            clk_tck = float(os.sysconf("SC_CLK_TCK")) or 100.0
+        except (OSError, ValueError, AttributeError):
+            pass
+
+        ranked = []
+        for tid, frame in frames1.items():
+            if tid == me:
+                continue  # the handler thread is busy by construction
+            t = threads.get(tid)
+            native = getattr(t, "native_id", None) if t is not None else None
+            dticks = (ticks1.get(native, 0) - ticks0.get(native, 0)) \
+                if native is not None and ticks0 else 0
+            cpu_pct = min(100.0, (dticks / clk_tck) / max(interval_s, 1e-6)
+                          * 100.0)
+            f0 = frames0.get(tid)
+            sig1 = (id(frame), frame.f_lasti, frame.f_lineno,
+                    frame.f_code.co_name)
+            advanced = f0 is None or f0[:3] != sig1[:3]
+            parked = (not advanced and dticks == 0
+                      and sig1[3] in _IDLE_FRAME_FUNCS)
+            if parked:
+                continue  # idle/parked threads never make the report
+            # busyness order: real CPU first, then frame advance as the
+            # tie-break signal procfs can't see (a thread may burn its ticks
+            # between the two reads)
+            ranked.append((cpu_pct, 1 if advanced else 0, tid, frame))
+        ranked.sort(key=lambda e: (-e[0], -e[1],
+                                   threads.get(e[2]).name
+                                   if threads.get(e[2]) else str(e[2])))
+
+        out = [f"::: [{node.name}] hot_threads: interval={interval_s * 1000:.0f}ms, "
+               f"busiest {min(n_threads, len(ranked))} of {len(frames1)} "
+               f"threads ({len(frames1) - 1 - len(ranked)} idle/parked skipped)"]
+        for cpu_pct, advanced, tid, frame in ranked[: max(n_threads, 0)]:
+            name = threads[tid].name if tid in threads else str(tid)
+            state = "running" if advanced else "stalled"
+            stack = "".join(traceback.format_stack(frame, limit=10))
+            out.append(f"   {cpu_pct:.1f}% cpu usage ({state}) by thread "
+                       f"'{name}'\n{stack}")
+        return RestResponse(200, "\n".join(out) + "\n",
+                            content_type="text/plain")
 
     # --- _cat APIs (plain text ops views — ref: rest/action/cat/) -----------
     # Shared table renderer (ref: rest/action/support/RestTable.java): ?help lists
@@ -1387,6 +1496,52 @@ def build_rest_controller(node) -> RestController:
         row.update({name: st.get(name, 0) for (name, _a, _d) in columns[2:]})
         return _cat_table(req, columns, [row])
 
+    def cat_segments(req):
+        """Per-segment table view of Client.segments: doc/postings counts +
+        the quantized device layout (tf rung, bytes/posting, resident bytes,
+        dense-plane state) — the operator's HBM-budget at-a-glance read."""
+        rows = []
+        for index, ispec in client.segments(
+                req.path_params.get("index")).get("indices", {}).items():
+            for sid, copies in sorted(ispec["shards"].items(),
+                                      key=lambda kv: int(kv[0])):
+                for copy in copies:
+                    prirep = "p" if copy["routing"]["primary"] else "r"
+                    for seg_name, seg in sorted(
+                            copy["segments"].items(),
+                            key=lambda kv: kv[1]["generation"]):
+                        dev = seg.get("device") or {}
+                        rows.append({
+                            "index": index, "shard": sid, "prirep": prirep,
+                            "segment": seg_name,
+                            "generation": seg["generation"],
+                            "docs.count": seg["num_docs"],
+                            "docs.deleted": seg["deleted_docs"],
+                            "postings": seg["postings"],
+                            "packed": str(bool(dev.get("packed"))).lower(),
+                            "tf.layout": dev.get("tf_layout", "-"),
+                            "bytes.posting": dev.get("bytes_per_posting", "-"),
+                            "size": (_fmt_bytes(dev["resident_bytes"])
+                                     if dev.get("packed") else "-"),
+                            "dense.plane": dev.get("dense_plane", "-"),
+                            "searchable": "true",
+                        })
+        return _cat_table(req, [
+            ("index", "i", "index name"), ("shard", "s", "shard id"),
+            ("prirep", "p", "primary or replica"),
+            ("segment", "seg", "segment name"),
+            ("generation", "g", "segment generation"),
+            ("docs.count", "dc", "number of live docs"),
+            ("docs.deleted", "dd", "number of deleted docs"),
+            ("postings", "po", "postings in the segment"),
+            ("packed", "pk", "device-packed"),
+            ("tf.layout", "tf", "quantized tf plane rung (u8/i16/f32)"),
+            ("bytes.posting", "bp", "resident bytes per posting"),
+            ("size", "sz", "device-resident postings bytes"),
+            ("dense.plane", "dp", "dense f32 plane resident or lazy"),
+            ("searchable", "se", "segment is searchable"),
+        ], rows)
+
     # --- percolate -----------------------------------------------------------
     def percolate(req):
         return node.percolator.percolate(
@@ -1502,10 +1657,13 @@ def build_rest_controller(node) -> RestController:
     rc.register("GET", "/_cat/recovery", cat_recovery)
     rc.register("GET", "/_cat/thread_pool", cat_thread_pool)
     rc.register("GET", "/_cat/batcher", cat_batcher)
+    rc.register("GET", "/_cat/segments", cat_segments)
+    rc.register("GET", "/_cat/segments/{index}", cat_segments)
     rc.register("GET", "/_cat", lambda r: RestResponse(
         200, "".join(f"/_cat/{n}\n" for n in (
             "health", "nodes", "indices", "shards", "master", "allocation", "count",
-            "aliases", "pending_tasks", "recovery", "thread_pool", "batcher")),
+            "aliases", "pending_tasks", "recovery", "thread_pool", "batcher",
+            "segments")),
         content_type="text/plain"))
 
     # plugin-contributed routes (ref: plugins contribute REST handlers)

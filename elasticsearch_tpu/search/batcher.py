@@ -216,6 +216,10 @@ class DeviceBatcher:
         self._deadline_flushes = 0
         self._pending_flushes = 0  # flushed early because a merge was waiting
         self._bypassed = 0  # queue full / disabled / drainer dead -> inline
+        # profiled requests bypass BEFORE enqueueing (service._execute_flat_
+        # single: their per-request sync must not serialize a shared batch) —
+        # counted separately so occupancy regressions aren't blamed on load
+        self._profile_bypassed = 0
         self._splits = 0  # coalesced launch failed -> per-item replay
         # batch service-time tail (dispatch start -> fan-out done): percentile
         # twin of _ewma_cost, exported in /_nodes/stats + Prometheus
@@ -489,6 +493,12 @@ class DeviceBatcher:
             if not it.future.done():
                 it.future.set_exception(err)
 
+    def note_profile_bypass(self):
+        """A profiled request served itself directly instead of coalescing
+        (search/service._execute_flat_single — the `reason: profile` bypass)."""
+        with self._stats_lock:
+            self._profile_bypassed += 1
+
     # -- lifecycle / observability -------------------------------------------
     def shutdown(self):
         with self._cv:
@@ -508,6 +518,7 @@ class DeviceBatcher:
                 "deadline_flushes": self._deadline_flushes,
                 "pending_flushes": self._pending_flushes,
                 "bypassed": self._bypassed,
+                "profile_bypassed": self._profile_bypassed,
                 "splits": self._splits,
                 "queue": len(self._queue),
                 "ewma_batch_ms": round(self._ewma_cost * 1000.0, 3),

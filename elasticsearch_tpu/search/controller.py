@@ -163,6 +163,16 @@ def merge_responses(req: ParsedSearchRequest, merged: MergedTopDocs,
     }
     if failures:
         resp["_shards"]["failures"] = failures
+    if req.profile:
+        # per-shard white-box execution profiles merged next to _shards —
+        # the reference's `profile` section shape: one entry per shard copy
+        # that answered, ordered by shard id (common/profile.py; shards that
+        # failed contribute no profile, exactly like their hits)
+        shard_profiles = [r.profile for r in shard_results
+                          if r.profile is not None]
+        shard_profiles.sort(key=lambda p: (str(p.get("index", "")),
+                                           int(p.get("shard", 0))))
+        resp["profile"] = {"shards": shard_profiles}
     if req.aggs:
         partials = [p for r in shard_results for p in r.agg_partials]
         resp["aggregations"] = reduce_aggs(req.aggs, partials)

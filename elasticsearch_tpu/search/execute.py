@@ -31,6 +31,7 @@ from dataclasses import dataclass, field as dc_field
 
 import numpy as np
 
+from ..common import profile as _profile
 from ..common.errors import QueryParsingError
 from ..index.engine import Searcher
 from ..index.segment import FrozenSegment
@@ -373,6 +374,66 @@ def _single_term(query: Query, ctx: ShardContext):
     return None
 
 
+# ---------------------------------------------------------------------------
+# profile API support: plan shape + fallback-reason classification
+# ---------------------------------------------------------------------------
+
+_GROUP_NAMES = {GROUP_SHOULD: "should", GROUP_MUST: "must",
+                GROUP_MUST_NOT: "must_not"}
+
+
+def plan_profile(plan: FlatPlan, query: Query) -> dict:
+    """The resolved plan shape a profiled request reports: per-clause
+    (field, term, boost, group), bool semantics, and the fused tail kind.
+    Plain scalars only — this dict crosses the wire through the binary codec
+    and renders as JSON unchanged."""
+    return {
+        "query_type": type(query).__name__,
+        "clauses": [{"field": c.field, "term": c.term,
+                     "boost": float(c.boost), "group": _GROUP_NAMES[c.group]}
+                    for c in plan.clauses],
+        "msm": int(plan.msm),
+        "n_must": int(plan.n_must),
+        "coord": bool(plan.coord_enabled),
+        "boost": float(plan.boost),
+        "function_score": plan.fs_kind,  # None | "rows" | "script"
+        "filtered": plan.filt is not None,
+    }
+
+
+def lower_fallback_reason(query: Query, ctx: ShardContext) -> str:
+    """Why lower_flat declined this query — the profile API's fallback-reason
+    vocabulary (common/profile.py docstring, ARCHITECTURE.md "Profile API").
+    Profiled-request only: it re-walks the query, which the hot path never
+    pays. The classification mirrors _lower_flat_inner's decline points; when
+    the inner lowering actually SUCCEEDS, the decline was lower_flat's
+    similarity gate (DFR/IB/LM fields score host-side)."""
+    if _lower_flat_inner(query, ctx) is not None:
+        return "similarity_not_fused"
+    if isinstance(query, TermQuery):
+        return "numeric_term"
+    if isinstance(query, MatchQuery):
+        # the only non-lowering match query: fuzzy (empty analysis still
+        # lowers — to an empty flat plan that scores nothing on-device)
+        return "fuzzy_match"
+    if isinstance(query, BoolQuery):
+        if query.filter:
+            return "bool_filter_clause"
+        subs = query.must + query.should + query.must_not
+        if any(_single_term(sub, ctx) is None for sub in subs):
+            return "non_term_subclause"
+        return "must_not_only"
+    if isinstance(query, FunctionScoreQuery):
+        if query.query is None:
+            return "function_score_no_query"
+        if _lower_flat_inner(query.query, ctx) is None:
+            return "non_flat_subquery"
+        return "function_score_ineligible"
+    if isinstance(query, FilteredQuery):
+        return "non_flat_subquery"
+    return f"unsupported_query:{type(query).__name__}"
+
+
 def finalize_flat(plan: FlatPlan, ctx: ShardContext):
     """Resolve clause weights against shard/global stats; returns per-clause arrays +
     per-field norm caches, exactly the kernel's inputs."""
@@ -585,14 +646,17 @@ def _dispatch_flat_plain(plans: list[FlatPlan], ctx: ShardContext,
                 for (resolved, _f, _c, _coord) in finals
                 for (_f2, _t, w, _fi, g, mode, df) in resolved if df > 0))
 
+    prof = _profile.current()
     seg_work = []  # (seg, base, doc_pad, launches, dense)
     releases = []
     for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
+        t_seg = time.monotonic() if prof is not None else 0.0
         packed = packed_for(seg, breaker=ctx.breaker("fielddata"))
         # cheap LUT swap (1 KB/field), not a postings re-bake: the quantized
         # scan decodes tf→tfn in-kernel against these stacked cache rows
         sim = ensure_sim_tables(packed, sim_tables)
         clause_lists = []
+        blocks_scanned = postings_scanned = 0
         for (resolved, _f, _c, _coord) in finals:
             cl = []
             for (f, t, w, _fi, g, mode, df) in resolved:
@@ -601,6 +665,10 @@ def _dispatch_flat_plain(plans: list[FlatPlan], ctx: ShardContext,
                     continue
                 b0, b1 = packed.blocks_for_term(tid)
                 cl.append((b0, b1, w, g, mode == MODE_CONST, sim.fid[f]))
+                if prof is not None:
+                    blocks_scanned += b1 - b0
+                    postings_scanned += int(seg.post_offsets[tid + 1]
+                                            - seg.post_offsets[tid])
             clause_lists.append(cl)
         launches, overflow, release = launch_flat_sparse(
             packed, clause_lists, n_must, msm, coord_tbl, k, simple=simple,
@@ -613,6 +681,23 @@ def _dispatch_flat_plain(plans: list[FlatPlan], ctx: ShardContext,
                 n_must, msm, coord_tbl, packed, seg, k,
                 breaker=ctx.breaker("fielddata"))
         seg_work.append((seg, base, packed.doc_pad, launches, dense))
+        if prof is not None:
+            from ..ops.pallas_kernels import estpu_pallas_enabled
+            from ..ops.scoring import SparseScratchPool
+
+            prof.segment(
+                seg.gen, docs=int(seg.doc_count),
+                path=("sparse_fused" if estpu_pallas_enabled()
+                      else "sparse_composed"),
+                tf_layout=packed.tf_layout,
+                blocks_scanned=int(blocks_scanned),
+                postings_scanned=int(postings_scanned),
+                staged_bytes=sum(
+                    SparseScratchPool.staging_bytes(*sb.qblk.shape)
+                    for (sb, _r) in launches),
+                buckets=len(launches),
+                dense_overflow=len(overflow),
+                ms=(time.monotonic() - t_seg) * 1000.0)
     return _PendingFlat(Q=Q, k=k, breaker=ctx.breaker("request"),
                         seg_work=seg_work, releases=releases)
 
@@ -669,8 +754,32 @@ def _execute_flat_plain(plans: list[FlatPlan], ctx: ShardContext, k: int) -> lis
     """Run a batch of flat plans through the device kernels: dispatch every
     segment's launches, then merge per-segment top-k host-side (score desc,
     global doc asc — Lucene order). Synchronous composition of the
-    dispatch/merge halves the batcher overlaps."""
-    return _dispatch_flat_plain(plans, ctx, k).merge()
+    dispatch/merge halves the batcher overlaps.
+
+    A PROFILED request (common/profile.py — it bypassed the batcher, so this
+    runs on the request thread) additionally syncs on the dispatched launches
+    between dispatch and merge: that per-request sync is the opt-in that buys
+    precise dispatch/device/pull/merge phase attribution; the unprofiled path
+    takes the early return and adds zero syncs."""
+    prof = _profile.current()
+    if prof is None:
+        return _dispatch_flat_plain(plans, ctx, k).merge()
+    t0 = time.monotonic()
+    pending = _dispatch_flat_plain(plans, ctx, k)
+    t1 = time.monotonic()
+    # the profiled request's explicit sync: device phase = dispatch end →
+    # every launch complete (legal ONLY here — the request opted in)
+    pending.sync()
+    t2 = time.monotonic()
+    out = pending.merge()
+    t3 = time.monotonic()
+    prof.phase_s("dispatch", t1 - t0)
+    prof.phase_s("device", t2 - t1)
+    pull_s = (pending.pull_t1 - pending.pull_t0) \
+        if pending.pull_t0 is not None else 0.0
+    prof.phase_s("pull", pull_s)
+    prof.phase_s("merge", max(t3 - t2 - pull_s, 0.0))
+    return out
 
 
 def _merge_seg_hits(seg_hits, totals, Q: int, k: int,
@@ -755,6 +864,17 @@ def _launch_dense_fallback(overflow, finals, field_idx, all_fields, caches_stack
     return sub, score_term_batch_async(packed, batch, k)
 
 
+def _prof_dense_segment(prof, seg, packed, entries, path: str, t_seg: float):
+    """Per-segment profile record for the dense kernel families (fs /
+    filtered / sorted / aggs) — entries are one (query, block) triple per
+    scanned block, so len(entries) IS the blocks-scanned count."""
+    if prof is None:
+        return
+    prof.segment(seg.gen, docs=int(seg.doc_count), path=path,
+                 tf_layout=packed.tf_layout, blocks_scanned=len(entries),
+                 launches=1, ms=(time.monotonic() - t_seg) * 1000.0)
+
+
 _FS_CHUNK = 256  # dense accumulator is O(Q·doc_pad) — bound the launch width
 
 
@@ -797,8 +917,10 @@ def _execute_flat_fs(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list[T
     host_idx: set[int] = set()
     totals = np.zeros(Q, dtype=np.int64)
     seg_hits = []
+    prof = _profile.current()
     try:
         for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
+            t_seg = time.monotonic() if prof is not None else 0.0
             packed = packed_for(seg, breaker=ctx.breaker("fielddata"))
             _ensure_norm_rows(packed, all_fields,
                               breaker=ctx.breaker("fielddata"))
@@ -849,6 +971,8 @@ def _execute_flat_fs(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list[T
             valid = (docs < min(doc_pad, D)) & np.isfinite(scores)
             gdocs = np.where(valid, docs.astype(np.int64) + base, np.int64(2**62))
             seg_hits.append((np.where(valid, scores, -np.inf), gdocs))
+            _prof_dense_segment(prof, seg, packed, entries,
+                                "dense_function_score", t_seg)
     except ScriptError:
         # a host-side per-doc evaluation raised while building rows — the host
         # path is authoritative for error semantics; rerun the whole group there
@@ -887,7 +1011,9 @@ def _execute_flat_filtered(plans: list[FlatPlan], ctx: ShardContext,
      coord_tbl, n_must, msm) = _assemble_batch(plans, finals)
     totals = np.zeros(Q, dtype=np.int64)
     seg_hits = []
+    prof = _profile.current()
     for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
+        t_seg = time.monotonic() if prof is not None else 0.0
         packed = packed_for(seg, breaker=ctx.breaker("fielddata"))
         _ensure_norm_rows(packed, all_fields,
                           breaker=ctx.breaker("fielddata"))
@@ -903,6 +1029,8 @@ def _execute_flat_filtered(plans: list[FlatPlan], ctx: ShardContext,
         valid = (docs < min(packed.doc_pad, seg.doc_count)) & np.isfinite(scores)
         gdocs = np.where(valid, docs.astype(np.int64) + base, np.int64(2**62))
         seg_hits.append((np.where(valid, scores, -np.inf), gdocs))
+        _prof_dense_segment(prof, seg, packed, entries, "dense_filtered",
+                            t_seg)
     return _merge_seg_hits(seg_hits, totals, Q, k,
                            breaker=ctx.breaker("request"))
 
@@ -934,8 +1062,10 @@ def execute_flat_sorted(plan: FlatPlan, ctx: ShardContext, k: int, spec):
     total = 0
     max_score = float("nan")
     cand = []  # (key, gdoc, seg_idx, local, score)
+    prof = _profile.current()
     for si, (seg, base, packed, key_row) in enumerate(zip(
             ctx.searcher.segments, ctx.searcher.bases, packeds, key_rows)):
+        t_seg = time.monotonic() if prof is not None else 0.0
         _ensure_norm_rows(packed, all_fields,
                           breaker=ctx.breaker("fielddata"))
         fmask = None
@@ -961,6 +1091,7 @@ def execute_flat_sorted(plan: FlatPlan, ctx: ShardContext, k: int, spec):
             (ki, base + di, si, di, sc)
             for ki, di, sc in zip(keys[0, :n].tolist(), docs[0, :n].tolist(),
                                   scores[0, :n].tolist()))
+        _prof_dense_segment(prof, seg, packed, entries, "dense_sorted", t_seg)
     cand.sort(key=lambda e: (-e[0] if spec.reverse else e[0], e[1]))
     return total, max_score, cand[: max(k, 0)]
 
@@ -989,7 +1120,9 @@ def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
     totals = np.zeros(1, dtype=np.int64)
     seg_hits = []
     seg_stats = []
+    prof = _profile.current()
     for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
+        t_seg = time.monotonic() if prof is not None else 0.0
         packed = packed_for(seg, breaker=ctx.breaker("fielddata"))
         _ensure_norm_rows(packed, all_fields,
                           breaker=ctx.breaker("fielddata"))
@@ -1043,6 +1176,7 @@ def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
              None if ss is None else ss[0])
             for keys, (bc, sc, ss) in zip(seg_keys, bcounts)
         ]))
+        _prof_dense_segment(prof, seg, packed, entries, "dense_aggs", t_seg)
     return _merge_seg_hits(seg_hits, totals, 1, k,
                            breaker=ctx.breaker("request"))[0], seg_stats
 
@@ -2086,17 +2220,22 @@ def _host_search(ctx: ShardContext, query: Query, k: int,
     total = 0
     timed_out = False
     join = _shard_join(ctx, query)
+    prof = _profile.current()
     for si, (seg, base) in enumerate(zip(ctx.searcher.segments, ctx.searcher.bases)):
         # host-side segment boundary: the one legal clamp point (never inside
         # a traced region) — expiry keeps the segments already scored
         if deadline is not None and deadline.expired():
             timed_out = True
             break
+        t_seg = time.monotonic() if prof is not None else 0.0
         if join is not None:
             scores, match = join[si]
         else:
             scorer = HostScorer(ctx, seg, qn)
             scores, match = scorer.eval(query)
+        if prof is not None:
+            prof.segment(seg.gen, docs=int(seg.doc_count), path="host",
+                         ms=(time.monotonic() - t_seg) * 1000.0)
         match = match & seg.live & seg.parent_mask
         if extra_filter is not None:
             match = match & segment_mask(seg, extra_filter, ctx)

@@ -16,6 +16,7 @@ from dataclasses import dataclass, field as dc_field
 
 import numpy as np
 
+from ..common import profile as _profile
 from ..common.breaker import reserve as breaker_reserve
 from ..common.deadline import NO_DEADLINE, Deadline, parse_timevalue
 from ..common.errors import (
@@ -67,6 +68,10 @@ class ParsedSearchRequest:
     track_scores: bool = False
     explain: bool = False
     timeout_s: float | None = None
+    # `"profile": true` / `?profile=true`: arm the white-box execution
+    # profiler for this request (common/profile.py — per-shard collectors,
+    # merged into a top-level `profile` response section by the coordinator)
+    profile: bool = False
 
 
 def parse_search_body(body: dict | None) -> ParsedSearchRequest:
@@ -100,6 +105,7 @@ def parse_search_body(body: dict | None) -> ParsedSearchRequest:
         # ref: the request-body `timeout` TimeValue ("50ms"/"2s"; bare ms) that
         # bounds the query phase — enforced at segment granularity on the host
         timeout_s=timeout_s,
+        profile=bool(body.get("profile", False)),
     )
 
 
@@ -120,6 +126,9 @@ class ShardQueryResult:
     # deadline expired mid-collection: docs/total/partials cover the segments
     # scored before expiry (the coordinator surfaces this as `timed_out: true`)
     timed_out: bool = False
+    # white-box execution profile of this shard's query phase (plain scalars —
+    # rides the wire like the span list does; None when unprofiled)
+    profile: dict | None = None
 
 
 # process-wide serving-path counters (which executor served the query phase —
@@ -142,6 +151,9 @@ _device_error_logged: set = set()
 
 def _count(path: str):
     SERVING_COUNTERS[path] += 1
+    prof = _profile.current()
+    if prof is not None:
+        prof.outcome(path)  # the resolved execution path, recorded once
 
 
 def _device_failed(e: BaseException):
@@ -151,6 +163,10 @@ def _device_failed(e: BaseException):
     from ..common.logging import get_logger
 
     SERVING_COUNTERS["device_errors"] += 1
+    prof = _profile.current()
+    if prof is not None:
+        prof.event("device_error", error=type(e).__name__)
+        prof.fallback(f"device_error:{type(e).__name__}")
     key = type(e).__name__
     if key not in _device_error_logged:
         _device_error_logged.add(key)
@@ -165,10 +181,52 @@ def _execute_flat_single(ctx: ShardContext, plan, k: int,
     DeviceBatcher when one is wired (coalescing with concurrent searches into
     one bucketed launch; search/batcher.py), else a direct single-plan launch.
     DFS-stats requests always launch directly: their per-request global stats
-    change clause weights, which a shared batch cannot express."""
+    change clause weights, which a shared batch cannot express.
+
+    PROFILED requests bypass the batcher explicitly (recorded as
+    `batcher: {bypassed, reason: "profile"}`): a coalesced batch's device
+    phases belong to the batch, not to one member, and the per-request sync
+    the profiler performs must never serialize innocent neighbors' launches.
+    The bypass also keeps the collector single-writer — execution never
+    leaves this thread."""
     if ctx.batcher is not None and not ctx.global_stats:
-        return ctx.batcher.execute(plan, ctx, k, deadline=deadline)
+        prof = _profile.current()
+        if prof is None:
+            return ctx.batcher.execute(plan, ctx, k, deadline=deadline)
+        # recorded ONLY when the batcher would actually have served this
+        # request — a DFS search or batcher-less node launches directly
+        # either way, and must not claim (or count) a profile bypass
+        prof.batcher_bypass("profile")
+        ctx.batcher.note_profile_bypass()
     return execute_flat_batch([plan], ctx, k)[0]
+
+
+def _prof_record_plan(prof, plan, req: ParsedSearchRequest, ctx: ShardContext,
+                      use_device: bool):
+    """Record the resolved plan shape (or the host-fallback reason when the
+    query would not lower flat) — profiled requests only."""
+    from .execute import lower_fallback_reason, plan_profile
+
+    if plan is not None:
+        prof.set_plan(plan_profile(plan, req.query))
+    else:
+        prof.set_plan({"query_type": type(req.query).__name__})
+        prof.fallback("device_disabled" if not use_device
+                      else lower_fallback_reason(req.query, ctx))
+
+
+def _prof_host_features(prof, req: ParsedSearchRequest):
+    """The general host path was taken because of mask-needing request
+    features — record which ones (set-if-unset: a lowering-level reason
+    already recorded wins)."""
+    feats = [name for name, present in (
+        ("aggs", bool(req.aggs)), ("facets", bool(req.facets)),
+        ("sort", bool(req.sort)), ("post_filter", req.post_filter is not None),
+        ("rescore", bool(req.rescore)),
+        ("min_score", req.min_score is not None), ("explain", req.explain),
+    ) if present]
+    if feats:
+        prof.fallback("features:" + ",".join(feats))
 
 
 def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
@@ -191,8 +249,17 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
                                 suggest=suggest_out, shard_id=shard_id,
                                 timed_out=True)
 
+    # profile hooks (one thread-local read when unprofiled): lowering wall
+    # time + the resolved plan shape, with the fallback reason whenever the
+    # fused path is declined (execute.lower_fallback_reason vocabulary)
+    prof = _profile.current()
+
     if not needs_masks:
+        t_low = time.monotonic() if prof is not None else 0.0
         plan = lower_flat(req.query, ctx) if use_device else None
+        if prof is not None:
+            prof.phase_s("lower", time.monotonic() - t_low)
+            _prof_record_plan(prof, plan, req, ctx, use_device)
         if plan is not None:
             try:
                 td = _execute_flat_single(ctx, plan, max(k, 1), deadline)
@@ -218,6 +285,15 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
         return ShardQueryResult(total=td.total, docs=[(s, d, None) for s, d in td.hits],
                                 max_score=td.max_score, suggest=suggest_out,
                                 shard_id=shard_id, timed_out=td.timed_out)
+
+    if prof is not None:
+        # profiled-only pre-lowering: the mask-needing branches below lower
+        # again internally; this records the plan shape (or the lowering
+        # fallback reason) once, before any branch runs
+        t_low = time.monotonic()
+        _prof_record_plan(prof, lower_flat(req.query, ctx) if use_device
+                          else None, req, ctx, use_device)
+        prof.phase_s("lower", time.monotonic() - t_low)
 
     # device metric-agg path: when the ONLY mask consumer is a set of
     # device-eligible metric aggs, the agg reduction fuses into the scoring
@@ -326,6 +402,8 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
         # consumed lazily so the deadline clamps BETWEEN segments: expiry keeps the
         # segments already scored as an honest partial (timed_out below)
         _count("host")
+        if prof is not None:
+            _prof_host_features(prof, req)
         timed_out = False
         seg_results = []
         masks_iter = iter_match_masks(ctx, req.query)
@@ -339,7 +417,11 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
             if si > 0 and deadline.expired():
                 timed_out = True
                 break
+            t_seg = time.monotonic() if prof is not None else 0.0
             scores, match = next(masks_iter)
+            if prof is not None:
+                prof.segment(seg.gen, docs=int(seg.doc_count), path="host",
+                             ms=(time.monotonic() - t_seg) * 1000.0)
             seg_results.append((scores, match))
             if req.min_score is not None:
                 match = match & (scores >= np.float32(req.min_score))

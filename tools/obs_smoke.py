@@ -1,0 +1,127 @@
+"""Live-node observability smoke (CI): boot one node in-process, exercise
+every read-only observability surface, fail loudly on any non-200 or parse
+error.
+
+Covers: `/_prometheus/metrics` (parsed with a strict minimal text-format
+parser), `/_traces`, `/_tasks`, `/_segments` (+ index-scoped), every
+`/_cat/*` endpoint the listing advertises, `hot_threads`, `/_nodes/stats`,
+and a `?profile=true` search whose merged `profile` section must carry every
+shard. Run as `python -m tools.obs_smoke` (CI pins JAX_PLATFORMS=cpu).
+"""
+
+from __future__ import annotations
+
+import sys
+import tempfile
+
+
+def _parse_prometheus(text: str) -> None:
+    """Every sample line must be `name[{labels}] <float>`; every family must
+    be # TYPE'd before its first sample and appear contiguously."""
+    typed: set[str] = set()
+    seen: set[str] = set()
+    current = None
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE"):
+            parts = line.split()
+            assert len(parts) == 4, f"bad TYPE line: {line!r}"
+            typed.add(parts[2])
+            continue
+        if line.startswith("#"):
+            continue
+        key, _, val = line.rpartition(" ")
+        assert key, f"unparseable sample: {line!r}"
+        float(val)  # raises on a malformed value
+        name = key.split("{", 1)[0]
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in typed:
+                base = name[: -len(suffix)]
+                break
+        assert base in typed, f"sample before # TYPE: {line!r}"
+        if base != current:
+            assert base not in seen, f"family {base} interleaved"
+            seen.add(base)
+            current = base
+
+
+def main() -> int:
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest.controller import (RestRequest,
+                                                   build_rest_controller)
+    from elasticsearch_tpu.transport.local import LocalTransportRegistry
+
+    tmp = tempfile.mkdtemp(prefix="obs_smoke_")
+    node = Node(name="smoke1", registry=LocalTransportRegistry(),
+                settings={}, data_path=tmp)
+    node.start([node.local_node.transport_address])
+    node.wait_for_master(15.0)
+    try:
+        client = node.client()
+        client.create_index("smoke", {"settings": {
+            "number_of_shards": 2, "number_of_replicas": 0}})
+        for i in range(40):
+            client.index("smoke", "doc",
+                         {"body": f"alpha{i % 5} alpha{(i + 1) % 5}", "n": i},
+                         id=str(i))
+        client.refresh("smoke")
+        rc = build_rest_controller(node)
+
+        def get(path, params=None, method="GET", body=None):
+            r = rc.dispatch(RestRequest(method=method, path=path,
+                                        params=params or {}, body=body))
+            assert r.status == 200, f"{method} {path} -> {r.status}: {r.body}"
+            print(f"ok {method} {path}")
+            return r
+
+        # profiled search: the merged profile section must cover every shard
+        r = get("/smoke/_search", params={"profile": "true"}, method="POST",
+                body={"query": {"match": {"body": "alpha1 alpha2"}},
+                      "size": 5})
+        prof = r.body.get("profile")
+        assert prof and len(prof["shards"]) == 2, prof
+        for shard in prof["shards"]:
+            assert shard["plan"]["outcome"] != "unknown", shard
+
+        # traced search (inline tree + the /_traces ring)
+        r = get("/smoke/_search", params={"trace": "true"}, method="POST",
+                body={"query": {"match": {"body": "alpha1"}}})
+        assert "trace" in r.body
+
+        r = get("/_prometheus/metrics")
+        _parse_prometheus(r.body)
+        assert "estpu_traces_ring_evicted_total" in r.body
+
+        r = get("/_traces")
+        assert r.body["total"] == len(r.body["traces"])
+        get("/_tasks")
+
+        r = get("/_segments")
+        assert "smoke" in r.body["indices"], r.body
+        get("/smoke/_segments")
+
+        r = get("/_nodes/stats")
+        (sections,) = r.body["nodes"].values()
+        assert "tracing" in sections and "search" in sections
+
+        r = get("/_cat")
+        cats = [line.rsplit("/", 1)[1] for line in r.body.split()
+                if line.startswith("/_cat/")]
+        assert "segments" in cats, cats
+        for cat in cats:
+            get(f"/_cat/{cat}", params={"v": ""})
+            get(f"/_cat/{cat}", params={"help": ""})
+
+        r = get("/_nodes/hot_threads",
+                params={"interval": "100ms", "threads": "3"})
+        assert r.body.startswith(":::"), r.body[:200]
+    finally:
+        node.close()
+    print("observability smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
