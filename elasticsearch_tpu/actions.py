@@ -73,6 +73,7 @@ from .search.controller import (
 )
 from .search.execute import ShardContext
 from .transport import fut_result
+from .transport.service import complete_fut
 from .search.queries import parse_query
 from .search.service import (
     ParsedSearchRequest,
@@ -1604,10 +1605,22 @@ class ActionModule:
         # max(shard) not sum(shard) and no coordinator thread parks per shard
         # (ref: TransportSearchTypeAction.java:135-216 async performFirstPhase)
         t_fanout = time.monotonic()
+        # hard copy pins disable HEDGING (a speculative answer from a node
+        # the caller explicitly pinned away from violates the preference's
+        # contract even on success); failover-on-failure keeps its
+        # pre-existing cross-copy semantics. Soft preferences (_prefer_node,
+        # _local, session keys) keep hedging — they name a starting point,
+        # not an exclusivity constraint. The pin comes from the SAME parser
+        # search_shards uses ("_shards:N;<pref>" carries the copy preference
+        # after the ";" — testing the raw string would miss a compound
+        # "_shards:0;_only_node:x" pin entirely).
+        _, pin = self.routing.split_preference(preference)
+        pin = pin or ""
+        allow_hedge = not pin.startswith("_only_node:") and pin != "_primary"
         query_futs = [
             None if ordinal in dfs_failed else
             self._query_shard_async(state, copy, body, alias_filters, dfs_stats,
-                                    deadline)
+                                    deadline, allow_hedge=allow_hedge)
             for ordinal, copy in enumerate(shards)]
         # shared backstop: chains resolve themselves (every attempt is
         # timer-bounded), so this only catches a wedged chain — scaled to the
@@ -1781,18 +1794,39 @@ class ActionModule:
         return None
 
     def _query_shard_async(self, state, copy: ShardRouting, body, alias_filters,
-                           dfs_stats, deadline: Deadline = NO_DEADLINE) -> Future:
-        """Per-shard query phase with failover to the next active copy, driven
-        entirely by future callbacks — the coordinator parks no thread per shard
-        (ref: performFirstPhase + onFirstPhaseResult failover,
-        TransportSearchTypeAction.java:135-216,292). Each attempt's timeout is
-        the flat attempt budget clamped to the request deadline's REMAINING
-        budget, and the chain itself gives up (instead of trying the next copy)
-        once the deadline expires — the failover-chain cap. The remaining
-        budget rides the request as `deadline_s` so the shard clamps its own
-        segment loop. Resolves to (ShardQueryResult | None, node | None,
-        error | None); every failed attempt is recorded on the returned
-        future's `attempt_errors` as (node_id, error)."""
+                           dfs_stats, deadline: Deadline = NO_DEADLINE,
+                           allow_hedge: bool = True) -> Future:
+        """Per-shard query phase with rank-ordered failover and hedged
+        attempts, driven entirely by future callbacks — the coordinator parks
+        no thread per shard (ref: performFirstPhase + onFirstPhaseResult
+        failover, TransportSearchTypeAction.java:135-216,292).
+
+        Failover: candidates are `routing.ranked_copies` — the chosen copy
+        first, then the remaining active copies best-first by the adaptive
+        health rank (cluster/stats.py), so the first fallback is the best
+        REMAINING copy. Each attempt's timeout is the flat attempt budget
+        clamped to the request deadline's REMAINING budget, and the chain
+        gives up once the deadline expires.
+
+        Hedging (The Tail at Scale): when a primary attempt outlives its
+        copy's own p99 (hedge_delay_s — warm copies only, clamped by the
+        remaining budget) and the token-bucket HedgeBudget grants a token,
+        the next-ranked unattempted copy is dispatched speculatively; the
+        FIRST successful response resolves the chain (complete-once via
+        complete_fut) and the loser's response is discarded by the existing
+        late-response path. `allow_hedge=False` (hard copy pins:
+        _only_node/_primary) suppresses hedging entirely — a speculative
+        answer from an un-pinned copy would violate the preference even on
+        success. Hedges ride the normal transport send — the
+        in-flight breaker charges them and the remote search pool's bounded
+        queue can 429 them, so overload protection governs hedges exactly
+        like primaries. Every attempt feeds the health tracker: latency +
+        piggybacked load on success (even when it lost the race), a decayed
+        failure count on error/timeout.
+
+        Resolves to (ShardQueryResult | None, node | None, error | None);
+        every failed attempt is recorded on the returned future's
+        `attempt_errors` as (node_id, error)."""
         done: Future = Future()
         # stamp resolution time for admission-control latency: the collection
         # loop drains futures in ordinal order, so "time until collected" of a
@@ -1807,8 +1841,12 @@ class ActionModule:
         cur_span = tracing.current_span()
         trace_ref = cur_span.trace if cur_span else None
         group = state.routing_table.index(copy.index).shard(copy.shard_id)
-        candidates = [copy] + [s for s in group.active_shards()
-                               if s.node_id != copy.node_id]
+        # ONE wiring point: the same selector that ranks the failover chain
+        # receives the observations and issues the hedge budget — reading it
+        # from a second place (a node attribute) could leave an embedding
+        # half-wired with no error
+        selector = self.routing.selector
+        candidates = self.routing.ranked_copies(group, copy)
         # the coordinator's backstop may abandon this chain; once it does, stop
         # scheduling further attempts (they'd leak requests + timers)
         cancelled = threading.Event()
@@ -1816,46 +1854,109 @@ class ActionModule:
         done.max_attempts = len(candidates)  # type: ignore[attr-defined]
         attempt_errors: list = []
         done.attempt_errors = attempt_errors  # type: ignore[attr-defined]
+        # chain state: which candidate indices have been attempted (hedges
+        # included — a failover never double-sends to a copy a hedge already
+        # covers) and how many attempts are in flight. The chain fails only
+        # when every candidate is consumed AND nothing is in flight.
+        chain_lock = threading.Lock()
+        launched: set[int] = set()
+        in_flight = [0]
 
-        def attempt(i: int, last_err):
-            if cancelled.is_set():
+        def resolve(result, node, err) -> bool:
+            return complete_fut(done, (result, node, err))
+
+        def attempt_failed(candidate, err, hedge: bool):
+            with chain_lock:
+                in_flight[0] -= 1
+                alive = in_flight[0]
+                attempt_errors.append((candidate.node_id, err))
+                # attempts actually SENT (launched also counts dead-node
+                # candidates the claim loop consumed without a send)
+                attempts = len(attempt_errors)
+            if cancelled.is_set() or done.done():
                 return
-            if i > 0 and last_err is not None and deadline.expired():
+            if deadline.expired():
                 # budget exhausted mid-chain: trying another copy could only
-                # answer after the caller stopped caring — report instead
-                done.set_result((None, None, ReceiveTimeoutError(
-                    f"search budget exhausted after {i} attempt(s) on "
-                    f"[{copy.index}][{copy.shard_id}]: {last_err}")))
+                # answer after the caller stopped caring. But an attempt
+                # STILL in flight keeps the chain open — its timer is
+                # deadline-clamped, and a late success is exactly the partial
+                # the coordinator's collection grace window exists to accept
+                if alive == 0:
+                    resolve(None, None, ReceiveTimeoutError(
+                        f"search budget exhausted after {attempts} "
+                        f"attempt(s) on [{copy.index}][{copy.shard_id}]: "
+                        f"{err}"))
                 return
-            while i < len(candidates) and state.nodes.get(candidates[i].node_id) is None:
-                i += 1
-            if i >= len(candidates):
-                if last_err is None:
-                    last_err = NoShardAvailableError(
-                        f"no active copy of [{copy.index}][{copy.shard_id}] on a "
-                        f"live node")
-                done.set_result((None, None, last_err))
-                return
-            candidate = candidates[i]
+            if hedge and alive > 0:
+                return  # a dead hedge never advances the chain while the
+                # primary attempt it shadowed is still in flight
+            try_next(err)
+
+        def try_next(last_err, hedge: bool = False) -> bool:
+            """Claim + launch the best not-yet-attempted copy on a live node.
+            False = no candidate left (the chain resolves its terminal error
+            iff nothing is in flight either)."""
+            with chain_lock:
+                j = None
+                for i in range(len(candidates)):
+                    if i in launched:
+                        continue
+                    if state.nodes.get(candidates[i].node_id) is None:
+                        launched.add(i)  # dead node: consumed, never retried
+                        continue
+                    j = i
+                    launched.add(j)
+                    in_flight[0] += 1
+                    break
+                alive = in_flight[0]
+            if j is None:
+                if alive == 0:
+                    resolve(None, None, last_err or NoShardAvailableError(
+                        f"no active copy of [{copy.index}][{copy.shard_id}] "
+                        f"on a live node"))
+                return False
+            launch(j, hedge)
+            return True
+
+        def launch(j: int, hedge: bool):
+            candidate = candidates[j]
+            # liveness was checked by try_next's claim loop against the SAME
+            # immutable ClusterState snapshot — node cannot be None here
             node = state.nodes.get(candidate.node_id)
+            payload = {
+                "index": candidate.index, "shard": candidate.shard_id,
+                "body": body or {},
+                "alias_filter": alias_filters.get(candidate.index),
+                "dfs": dfs_stats,
+                # remaining budget as a DURATION (monotonic clocks don't
+                # cross processes); the shard restarts its own clock from it
+                "deadline_s": deadline.remaining(),
+            }
+            if hedge:
+                # the shard tags its span hedge:true from this (sibling shard
+                # spans in ?trace=true); the winner annotation on the profile
+                # happens coordinator-side below
+                payload["hedge"] = True
             # re-activate the coordinator's span around the send: retry
             # attempts run on timer / transport-callback threads whose
             # thread-local is empty, and an un-activated send would strip the
             # trace context from exactly the failover attempts most worth
             # tracing (the transport injects context from current_span())
             with tracing.activate(cur_span):
-                fut = self.transport.send_request(node, A_QUERY_PHASE, {
-                    "index": candidate.index, "shard": candidate.shard_id,
-                    "body": body or {},
-                    "alias_filter": alias_filters.get(candidate.index),
-                    "dfs": dfs_stats,
-                    # remaining budget as a DURATION (monotonic clocks don't
-                    # cross processes); the shard restarts its own clock from it
-                    "deadline_s": deadline.remaining(),
-                })
-            # exactly one of {response callback, attempt timer} consumes the attempt
+                fut = self.transport.send_request(node, A_QUERY_PHASE, payload)
+            t_sent = time.monotonic()
+            if selector is not None:
+                selector.begin_attempt(candidate)
+                if hedge:
+                    selector.hedges.record_issued()
+                else:
+                    selector.hedges.note_request()  # accrue hedge budget
+            # exactly one of {response callback, attempt timer} consumes the
+            # attempt for CHAIN purposes; `settled` separately guarantees the
+            # selector's outstanding count drops exactly once
             consumed_lock = threading.Lock()
             consumed = [False]
+            settled = [False]
 
             def consume() -> bool:
                 with consumed_lock:
@@ -1864,34 +1965,100 @@ class ActionModule:
                     consumed[0] = True
                     return True
 
+            def settle() -> bool:
+                with consumed_lock:
+                    if settled[0]:
+                        return False
+                    settled[0] = True
+                    return True
+
             def on_timeout():
+                if selector is not None and settle():
+                    selector.end_attempt(candidate)
+                    selector.failure(candidate)
                 if consume():
                     err = ReceiveTimeoutError(
                         f"query phase attempt to [{candidate.node_id}] timed out")
-                    attempt_errors.append((candidate.node_id, err))
-                    attempt(i + 1, err)
+                    attempt_failed(candidate, err, hedge)
 
             timer = self.node.threadpool.schedule(
                 deadline.clamp(self.QUERY_ATTEMPT_TIMEOUT), "generic", on_timeout)
 
+            if allow_hedge and not hedge and selector is not None:
+                with chain_lock:
+                    alts = [candidates[i] for i in range(len(candidates))
+                            if i not in launched]
+                hd = selector.hedge_delay_s(candidate, deadline.remaining(),
+                                            others=alts)
+                if hd is not None:
+                    def on_hedge():
+                        if cancelled.is_set() or done.done():
+                            return
+                        with consumed_lock:
+                            if consumed[0]:
+                                return  # attempt already failed over: the
+                                # chain is advancing anyway, no hedge needed
+                        with chain_lock:
+                            has_next = any(
+                                i not in launched and
+                                state.nodes.get(candidates[i].node_id)
+                                is not None
+                                for i in range(len(candidates)))
+                        if not has_next:
+                            return  # nothing to hedge to
+                        if not selector.hedges.try_acquire():
+                            return  # budget exhausted (counted) — brown-out
+                            # protection: never amplify load on a sick group
+                        if not try_next(None, hedge=True):
+                            # lost the claim race (concurrent failover took
+                            # the last candidate / its node left): the token
+                            # bought nothing — put it back
+                            selector.hedges.refund()
+
+                    hedge_timer = self.node.threadpool.schedule(
+                        hd, "generic", on_hedge)
+                    done.add_done_callback(lambda _f: hedge_timer.cancel())
+
             def on_done(f):
+                err0 = f.exception()
+                lat = time.monotonic() - t_sent
+                if selector is not None and settle():
+                    selector.end_attempt(candidate)
                 if not consume():
-                    return  # timer already failed this attempt over
+                    # the timer already failed this attempt over; a late
+                    # response still teaches the health tracker — the copy's
+                    # TRUE latency is exactly what routing must learn
+                    if selector is not None and err0 is None:
+                        r0 = f.result()
+                        selector.observe(candidate, lat,
+                                         load=r0.get("load")
+                                         if isinstance(r0, dict) else None)
+                    return
                 timer.cancel()
+                if err0 is not None:
+                    # ANY per-attempt failure fails over to the next copy —
+                    # including transport errors to a node that died after
+                    # this state was read (ref: onFirstPhaseResult treats
+                    # every shard exception as failover, :292); terminal
+                    # only when the chain runs out of candidates
+                    if selector is not None:
+                        selector.failure(candidate)
+                    attempt_failed(candidate, err0, hedge)
+                    return
                 try:
-                    err = f.exception()
-                    if err is not None:
-                        # ANY per-attempt failure fails over to the next copy —
-                        # including transport errors to a node that died after
-                        # this state was read (ref: onFirstPhaseResult treats
-                        # every shard exception as failover, :292); terminal
-                        # only when the chain runs out of candidates
-                        attempt_errors.append((candidate.node_id, err))
-                        attempt(i + 1, err)
-                        return
                     r = f.result()
+                    if selector is not None:
+                        selector.observe(candidate, lat,
+                                         load=r.get("load")
+                                         if isinstance(r, dict) else None)
                     if trace_ref is not None and isinstance(r, dict):
                         trace_ref.add_remote(r.get("spans"))
+                    prof = r.get("profile")
+                    if isinstance(prof, dict):
+                        # ?profile=true: record whether this shard's profile
+                        # came from the winning primary attempt or a hedge
+                        prof = {**prof,
+                                "winner": "hedge" if hedge else "primary"}
                     result = ShardQueryResult(
                         total=r["total"],
                         docs=[tuple(d) for d in r["docs"]],
@@ -1902,17 +2069,28 @@ class ActionModule:
                         context_id=r.get("ctx_id"),
                         shard_id=candidate.shard_id,
                         timed_out=bool(r.get("timed_out")),
-                        profile=r.get("profile"),
+                        profile=prof,
                     )
                     result.index_name = candidate.index  # type: ignore[attr-defined]
-                    done.set_result((result, node, None))
-                except Exception as e:  # noqa: BLE001 — a swallowed callback error
-                    # would otherwise surface as a bogus coordinator timeout
-                    done.set_result((None, None, e))
+                except Exception as e:  # noqa: BLE001 — a malformed/corrupt
+                    # response is an attempt failure like any other: fail
+                    # over instead of terminally resolving (which would
+                    # discard a concurrently in-flight sibling's good answer)
+                    attempt_failed(candidate, e, hedge)
+                    return
+                # resolve BEFORE dropping the in-flight count: decrement-
+                # first opens a window where the last OTHER attempt's
+                # concurrent failure reads alive==0 and resolves the chain
+                # with its terminal error, discarding this good response
+                won = resolve(result, node, None)
+                with chain_lock:
+                    in_flight[0] -= 1
+                if won and hedge and selector is not None:
+                    selector.hedges.record_won()
 
             fut.add_done_callback(on_done)
 
-        attempt(0, None)
+        try_next(None)
         return done
 
     _PIN_KEEP_S = 60.0
@@ -1996,6 +2174,10 @@ class ActionModule:
                                       "shard") if tracer is not None \
             else tracing.NOOP_TRACE
         shard_span = trace.root.tag(index=index, shard=shard_id)
+        if request.get("hedge"):
+            # speculative (hedged) attempt: its shard span shows as a sibling
+            # of the primary attempt's in the stitched ?trace=true tree
+            shard_span.tag(hedge=True)
         t_q = time.monotonic()
         try:
             with tracing.activate(shard_span):
@@ -2022,6 +2204,11 @@ class ActionModule:
             # fetch must read the SAME point-in-time searcher these doc ids
             # come from (a merge between phases moves local ids)
             "ctx_id": self._pin_context(index, shard_id, ctx),
+            # response-piggybacked load signals for the coordinator's adaptive
+            # replica selection (cluster/stats.py): this node's search-pool
+            # queue depth + request-breaker headroom. Plain attribute reads —
+            # the serving path gains no locks, clocks, or device traffic
+            "load": self._load_signal(),
         }
         if trace:
             # the shard's span list rides the response so the coordinator can
@@ -2034,6 +2221,17 @@ class ActionModule:
             # coordinator into the top-level `profile` section
             out["profile"] = prof.to_dict()
         return out
+
+    def _load_signal(self) -> dict:
+        """The query-phase response's piggybacked load sample: search-pool
+        queue depth + request-breaker headroom fraction, read as plain
+        attributes (unlocked int/float reads are exact enough for a decayed
+        routing signal and keep the hot path free of new locks and clocks)."""
+        queue = self.node.threadpool.queue_depth("search")
+        br = self.node.breakers.breaker("request")
+        headroom = 1.0 if br.limit <= 0 else \
+            max(0.0, 1.0 - br.used / br.limit)
+        return {"queue": queue, "headroom": round(headroom, 4)}
 
     def _maybe_slowlog(self, index: str, shard_id: int, body: dict, took_s: float,
                        trace=None):

@@ -6,7 +6,18 @@ shard_id = djb2(routing ?: id) % num_shards — the exact DJB2 hash
 it and it is frozen at index creation (hash stability).
 
 searchShards picks ONE copy per replication group honoring `preference`
-(_primary/_local/_only_node:x/session key), default round-robin over active copies.
+(_primary/_local/_only_node:x/session key). Preference-free selection is
+ADAPTIVE when a `cluster.stats.AdaptiveReplicaSelector` is wired (the node
+does): copies are ranked by the C3-style health score (latency EWMA,
+piggybacked queue depth/breaker headroom, outstanding attempts, decayed
+failures) with round-robin rotation among the healthy set, falling back to
+plain round-robin until the group's stats warm up (min_samples per copy).
+
+`_local`/`_prefer_node` with NO matching copy fall back to that same
+selection — NOT to hashing the preference string itself, which would send
+every coordinator to the SAME deterministic copy index (the hotspot bug:
+djb2("_local") is a constant, so a 3-copy group with no local copy had all
+of its cluster-wide traffic pinned to one copy).
 """
 
 from __future__ import annotations
@@ -29,8 +40,10 @@ def djb2_hash(value: str) -> int:
 
 
 class OperationRouting:
-    def __init__(self):
+    def __init__(self, selector=None):
         self._rr = itertools.count()
+        # AdaptiveReplicaSelector (cluster/stats.py) or None = always RR
+        self.selector = selector
 
     @staticmethod
     def shard_id(state: ClusterState, index: str, doc_id: str,
@@ -53,19 +66,27 @@ class OperationRouting:
         group = self.index_shard(state, index, doc_id, routing)
         return self._select(group, state, preference)
 
+    @staticmethod
+    def split_preference(preference: str | None) \
+            -> tuple[set[int] | None, str | None]:
+        """Parse the preference grammar's compound form: "_shards:0,2[;pref]"
+        restricts the searched shard groups, with an optional ";" suffix
+        carrying the copy-selection preference (ref: Preference.SHARDS
+        handling in PlainOperationRouting). The ONE parser for this shape —
+        search_shards and the coordinator's hedge gate both route here, so
+        the grammar cannot drift between them."""
+        if not preference or not preference.startswith("_shards:"):
+            return None, preference or None
+        rest = preference[len("_shards:"):]
+        spec, _, copy_pref = rest.partition(";")
+        return ({int(s) for s in spec.split(",") if s.strip()},
+                copy_pref or None)
+
     def search_shards(self, state: ClusterState, indices: list[str],
                       routing: str | None = None,
                       preference: str | None = None) -> list[ShardRouting]:
         """One active copy of every relevant shard group (ref: searchShards:103-146)."""
-        # "_shards:0,2" restricts the searched shard groups; an optional ";"
-        # suffix carries a secondary copy-selection preference
-        # (ref: Preference.SHARDS handling in PlainOperationRouting)
-        only_shards = None
-        if preference and preference.startswith("_shards:"):
-            rest = preference[len("_shards:"):]
-            spec, _, preference = rest.partition(";")
-            preference = preference or None
-            only_shards = {int(s) for s in spec.split(",") if s.strip()}
+        only_shards, preference = self.split_preference(preference)
         out = []
         for index in indices:
             table = state.routing_table.index(index)
@@ -98,10 +119,15 @@ class OperationRouting:
                     if s.primary:
                         return s
                 raise NoShardAvailableError("primary not active")
-            if preference == "_local" and state.nodes.local_id:
-                for s in active:
-                    if s.node_id == state.nodes.local_id:
-                        return s
+            if preference == "_local":
+                if state.nodes.local_id:
+                    for s in active:
+                        if s.node_id == state.nodes.local_id:
+                            return s
+                # no local copy: fall back to adaptive/round-robin — hashing
+                # the literal "_local" would pin every coordinator without a
+                # copy to the SAME index (djb2 of a constant string)
+                return self._pick(active)
             if preference.startswith("_only_node:"):
                 node_id = preference.split(":", 1)[1]
                 for s in active:
@@ -113,7 +139,29 @@ class OperationRouting:
                 for s in active:
                     if s.node_id == node_id:
                         return s
+                return self._pick(active)  # same fall-through rule as _local
             # arbitrary session key → stable copy choice
             idx = abs(djb2_hash(preference)) % len(active)
             return active[idx]
+        return self._pick(active)
+
+    def _pick(self, active: list[ShardRouting]) -> ShardRouting:
+        """Preference-free copy choice: adaptive rank rotation when the
+        selector is wired AND warm for this group, else round-robin (which is
+        what warms it)."""
+        if self.selector is not None:
+            s = self.selector.select(active)
+            if s is not None:
+                return s
         return active[next(self._rr) % len(active)]
+
+    def ranked_copies(self, group: IndexShardRoutingTable,
+                      first: ShardRouting) -> list[ShardRouting]:
+        """Failover-chain order for one replication group: the already-chosen
+        `first` copy, then the remaining active copies best-first by the
+        adaptive rank (quarantined copies last) — the first fallback is the
+        best REMAINING copy, not the next array slot."""
+        rest = [s for s in group.active_shards() if s.node_id != first.node_id]
+        if self.selector is not None and rest:
+            rest = self.selector.ranked(rest)
+        return [first] + rest

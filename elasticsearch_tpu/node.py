@@ -110,7 +110,15 @@ class Node:
         self.transport.in_flight_breaker = self.breakers.breaker("in_flight_requests")
         self.cluster_service = ClusterService(self.name)
         self.allocation = AllocationService(self.settings)
-        self.operation_routing = OperationRouting()
+        # adaptive replica selection + hedging (cluster/stats.py): per-copy
+        # health records fed by the coordinator's query-phase attempts, the
+        # rank behind preference-free copy choice, failover-chain order, and
+        # the hedge delay/budget ("The Tail at Scale" / C3)
+        from .cluster.stats import AdaptiveReplicaSelector
+
+        self.adaptive_routing = AdaptiveReplicaSelector(self.settings)
+        self.operation_routing = OperationRouting(
+            selector=self.adaptive_routing)
         self.indices = IndicesService(self.node_id, self.name, self.data_path,
                                       self.transport, self.cluster_service)
         self.gateway = LocalGateway(self.data_path, self.cluster_service,
@@ -914,6 +922,10 @@ class Client:
             "search_serving": serving_stats,
             # request-scoped tracing: sample rate, ring occupancy, in-flight
             "tracing": lambda: self.node.tracer.stats(),
+            # adaptive replica selection: per-copy rank inputs (latency EWMA/
+            # p99, piggybacked queue + headroom, outstanding, decayed
+            # failures), selection/probe counters, hedge budget
+            "adaptive_routing": lambda: self.node.adaptive_routing.stats(),
             **self.node.monitor.sections(),
         }
         if metric and metric not in ("_all",):

@@ -302,6 +302,29 @@ def _prometheus_text(node) -> str:
                 node.actions.admission.histogram)
     w.counter("estpu_admission_rejected_total",
               node.actions.admission.rejected.count)
+    # adaptive replica selection + hedged shard requests (cluster/stats.py):
+    # the hedge counters answer "is tail-tolerance working / is the budget
+    # saturating", the per-copy rank gauges expose WHY routing prefers a
+    # copy. One loop per family keeps each family contiguous.
+    ar = node.adaptive_routing.stats()
+    hs = ar["hedges"]
+    w.counter("estpu_search_hedges_issued_total", hs["issued"])
+    w.counter("estpu_search_hedges_won_total", hs["won"])
+    w.counter("estpu_search_hedges_budget_exhausted_total",
+              hs["budget_exhausted"])
+    w.gauge("estpu_search_hedges_budget_tokens", hs["tokens"])
+    copies = ar["copies"]
+    for ckey, c in copies.items():
+        w.gauge("estpu_routing_rank_ewma_seconds", c["ewma_ms"] / 1000.0,
+                copy=ckey)
+    for ckey, c in copies.items():
+        w.gauge("estpu_routing_rank_queue", c["queue"], copy=ckey)
+    for ckey, c in copies.items():
+        w.gauge("estpu_routing_rank_outstanding", c["outstanding"], copy=ckey)
+    for ckey, c in copies.items():
+        w.gauge("estpu_routing_rank_failures", c["failures"], copy=ckey)
+    w.counter("estpu_routing_probes_total", ar["probes"])
+    w.gauge("estpu_routing_quarantined", ar["quarantined"])
     w.counter("estpu_jax_compile_events_total", compile_events_total())
     w.gauge("estpu_hbm_resident_bytes", _hbm_resident_bytes(node))
     ts = node.tracer.stats()

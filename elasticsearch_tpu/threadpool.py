@@ -286,6 +286,15 @@ class ThreadPool:
     def stats(self) -> dict:
         return {name: pool.stats() for name, pool in self._pools.items()}
 
+    def queue_depth(self, name: str) -> int:
+        """One pool's queued-task backlog as a plain unlocked int read — the
+        load signal query-phase responses piggyback for adaptive replica
+        selection (a torn read is at worst one task stale, which a decayed
+        routing signal absorbs; taking the pool lock per response would not
+        be)."""
+        pool = self._pools.get(name)
+        return 0 if pool is None else pool.queued
+
     def pool_histograms(self) -> dict:
         """name → queue-wait HistogramMetric (the Prometheus exposition reads
         the full bucket vectors; /_nodes/stats only carries the summary)."""

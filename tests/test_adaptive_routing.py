@@ -1,0 +1,686 @@
+"""Adaptive replica selection + hedged shard requests: tail-tolerant routing.
+
+Unit half: CopyHealth EWMA/failure decay, cold-start min_samples, rotation +
+quarantine + probe re-entry, the hedge token bucket and delay derivation, and
+the `_local`/`_prefer_node` fall-through regression (hashing the preference
+literal pinned every coordinator to the SAME copy index).
+
+Chaos half (deterministic, seeded FaultPolicy — never wall-clock handler
+sleeps): a delay-faulted replica loses its traffic share while hedged requests
+keep latency far below the injected delay; clearing the fault lets probe
+traffic restore it into the rotation; an error-faulted copy quarantines and
+probes back in; an ALL-copies-slow brown-out exhausts the hedge budget without
+load amplification. Trace/profile integration: hedged attempts show as sibling
+`shard` spans tagged hedge:true, and the winning profile entry records
+primary-vs-hedge.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster.routing import OperationRouting
+from elasticsearch_tpu.cluster.state import (
+    STARTED,
+    ClusterState,
+    DiscoveryNode,
+    DiscoveryNodes,
+    IndexShardRoutingTable,
+    ShardRouting,
+)
+from elasticsearch_tpu.cluster.stats import (
+    AdaptiveReplicaSelector,
+    CopyHealth,
+    HedgeBudget,
+)
+from elasticsearch_tpu.common.errors import NoShardAvailableError
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.rest.controller import RestRequest, build_rest_controller
+
+from .harness import TestCluster
+
+pytestmark = pytest.mark.adaptive
+
+
+def _copies(n=3, index="i", shard=0, first_node=1):
+    return [ShardRouting(index, shard, f"n{i + first_node}", i == 0, STARTED)
+            for i in range(n)]
+
+
+def _selector(**over):
+    flat = {"search.adaptive.min_samples": 3, **over}
+    return AdaptiveReplicaSelector(Settings.from_flat(flat))
+
+
+def _warm_all(sel, copies, seconds=0.01, n=None):
+    for _ in range(n if n is not None else sel.min_samples):
+        for c in copies:
+            sel.observe(c, seconds)
+
+
+# ---------------------------------------------------------------------------
+# CopyHealth units
+# ---------------------------------------------------------------------------
+
+
+class TestCopyHealth:
+    def test_ewma_tracks_recent_latency(self):
+        sel = _selector()
+        (c,) = _copies(1)
+        for _ in range(10):
+            sel.observe(c, 0.01)
+        e = sel._copy(sel.key(c))
+        assert e.ewma_s == pytest.approx(0.01, rel=0.01)
+        for _ in range(10):
+            sel.observe(c, 0.5)
+        # alpha=0.3: ten slow samples pull the EWMA almost all the way over
+        assert e.ewma_s > 0.4
+        assert e.samples == 20
+
+    def test_failure_penalty_raises_score_and_quarantines(self):
+        sel = _selector()
+        a, b = _copies(2)
+        _warm_all(sel, [a, b])
+        now = time.monotonic()
+        hl, qt = sel.failure_halflife_s, sel.quarantine_failures
+        ea, eb = sel._copy(sel.key(a)), sel._copy(sel.key(b))
+        assert ea.score(now, hl) == pytest.approx(eb.score(now, hl), rel=0.01)
+        for _ in range(4):
+            sel.failure(a)
+        assert ea.score(now, hl) > 5 * eb.score(now, hl)
+        assert ea.quarantined(now, hl, qt)
+        assert not eb.quarantined(now, hl, qt)
+
+    def test_success_halves_failures_deterministically(self):
+        sel = _selector()
+        (c,) = _copies(1)
+        for _ in range(4):
+            sel.failure(c)
+        e = sel._copy(sel.key(c))
+        now = time.monotonic()
+        assert e.quarantined(now, sel.failure_halflife_s,
+                             sel.quarantine_failures)
+        sel.observe(c, 0.01)  # 4 -> 2
+        assert not e.quarantined(now, sel.failure_halflife_s,
+                                 sel.quarantine_failures)
+
+    def test_failure_time_decay(self):
+        e = CopyHealth(("n1", "i", 0))
+        e.failure(now=100.0, halflife_s=1.0)
+        e.failure(now=100.0, halflife_s=1.0)
+        e.failure(now=100.0, halflife_s=1.0)
+        assert e.quarantined(100.0, 1.0, 3.0)
+        # three half-lives later the count decayed below the threshold
+        assert not e.quarantined(103.0, 1.0, 3.0)
+
+
+# ---------------------------------------------------------------------------
+# selection: cold start, rotation, quarantine + probe re-entry
+# ---------------------------------------------------------------------------
+
+
+class TestSelection:
+    def test_cold_start_returns_none_until_min_samples(self):
+        sel = _selector()
+        copies = _copies(2)
+        assert sel.select(copies) is None  # cold: caller round-robins
+        _warm_all(sel, copies[:1])  # one copy warm, the other cold
+        assert sel.select(copies) is None
+        _warm_all(sel, copies[1:])
+        assert sel.select(copies) is not None
+        assert sel.stats()["selections"]["round_robin"] >= 2
+
+    def test_rotation_balanced_when_healthy(self):
+        sel = _selector()
+        copies = _copies(3)
+        _warm_all(sel, copies)
+        picks = {c.node_id: 0 for c in copies}
+        for _ in range(30):
+            picks[sel.select(copies).node_id] += 1
+        # equal scores keep every copy in the rotation — no starvation
+        assert all(v >= 6 for v in picks.values()), picks
+
+    def test_slow_copy_leaves_rotation_but_gets_probes(self):
+        sel = _selector()
+        copies = _copies(3)
+        _warm_all(sel, copies[:2], seconds=0.01)
+        _warm_all(sel, copies[2:], seconds=1.0)  # 100x slower than the rest
+        picks = {c.node_id: 0 for c in copies}
+        for _ in range(32):
+            picks[sel.select(copies).node_id] += 1
+        # the slow copy only sees probe traffic (every probe_every-th pick)
+        assert picks["n3"] <= 32 // sel.probe_every + 1, picks
+        assert picks["n3"] >= 1, "no probe traffic — permanent blacklist"
+        assert sel.stats()["probes"] >= 1
+        assert picks["n1"] + picks["n2"] >= 32 - 32 // sel.probe_every - 1
+
+    def test_quarantine_probe_reentry(self):
+        sel = _selector()
+        copies = _copies(2)
+        _warm_all(sel, copies)
+        for _ in range(4):
+            sel.failure(copies[1])
+        assert sel.stats()["copies"]["n2/i/0"]["quarantined"]
+        # quarantined: only probe turns pick n2
+        picks = [sel.select(copies).node_id for _ in range(16)]
+        assert picks.count("n2") <= 16 // sel.probe_every + 1
+        # two probe successes halve 4 -> 1 (< threshold): back in rotation
+        sel.observe(copies[1], 0.01)
+        sel.observe(copies[1], 0.01)
+        assert not sel.stats()["copies"]["n2/i/0"]["quarantined"]
+        picks = [sel.select(copies).node_id for _ in range(16)]
+        assert picks.count("n2") >= 4, picks  # well above the probe rate
+
+    def test_failing_from_birth_copy_does_not_keep_group_cold(self):
+        """A copy that only ever FAILS has no latency samples — failures must
+        count as warmth, or the whole group stays round-robin forever and
+        keeps routing 1/N of traffic into the dead copy."""
+        sel = _selector()
+        copies = _copies(3)
+        _warm_all(sel, copies[:2])
+        for _ in range(4):
+            sel.failure(copies[2])  # zero successes, only failures
+        picks = [sel.select(copies) for _ in range(16)]
+        assert all(p is not None for p in picks)  # adaptive, not round-robin
+        n3 = sum(1 for p in picks if p.node_id == "n3")
+        assert n3 <= 16 // sel.probe_every + 1, n3  # probe traffic only
+        assert sel.stats()["copies"]["n3/i/0"]["quarantined"]
+
+    def test_registry_prunes_idle_copies(self):
+        """Records of deleted indices / departed nodes age out of the
+        registry (and therefore out of /_nodes/stats + the per-copy
+        Prometheus gauges) once creation pressure crosses the bound."""
+        sel = _selector()
+        sel.PRUNE_AT = 8
+        sel.PRUNE_IDLE_S = 0.0  # anything not re-touched is stale
+        for i in range(8):
+            sel._copy((f"n{i}", "old", 0))
+        live = sel._copy(("n0", "live", 0))
+        live.last_touch = time.monotonic() + 60.0  # still fresh at prune time
+        sel._copy(("n1", "live", 0))  # creation past the bound triggers prune
+        with sel._dict_lock:
+            keys = set(sel._copies)
+        assert ("n0", "live", 0) in keys and ("n1", "live", 0) in keys
+        assert not any(k[1] == "old" for k in keys), keys
+
+    def test_all_quarantined_group_still_serves(self):
+        sel = _selector()
+        copies = _copies(2)
+        _warm_all(sel, copies)
+        for c in copies:
+            for _ in range(4):
+                sel.failure(c)
+        assert sel.select(copies) is not None  # no blacklist: someone serves
+
+    def test_ranked_orders_by_health(self):
+        sel = _selector()
+        copies = _copies(3)
+        _warm_all(sel, copies[:1], seconds=0.2)
+        _warm_all(sel, copies[1:2], seconds=0.01)
+        _warm_all(sel, copies[2:], seconds=0.05)
+        assert [c.node_id for c in sel.ranked(copies)] == ["n2", "n3", "n1"]
+        for _ in range(4):
+            sel.failure(copies[1])  # quarantined sorts last despite speed
+        assert [c.node_id for c in sel.ranked(copies)] == ["n3", "n1", "n2"]
+
+
+# ---------------------------------------------------------------------------
+# hedge budget + delay derivation
+# ---------------------------------------------------------------------------
+
+
+class TestHedging:
+    def test_budget_token_bucket(self):
+        b = HedgeBudget(ratio=0.05, burst=2.0)
+        assert b.try_acquire() and b.try_acquire()
+        assert not b.try_acquire()  # burst drained
+        assert b.budget_exhausted == 1
+        for _ in range(20):  # 20 primaries accrue exactly one hedge token
+            b.note_request()
+        assert b.try_acquire()
+        assert not b.try_acquire()
+        for _ in range(1000):
+            b.note_request()
+        assert b.stats()["tokens"] == pytest.approx(2.0)  # capped at burst
+        # an acquired-but-unlaunched hedge refunds its token (capped)
+        assert b.try_acquire()
+        b.refund()
+        assert b.stats()["tokens"] == pytest.approx(2.0)
+        b.refund()
+        assert b.stats()["tokens"] == pytest.approx(2.0)  # never past burst
+
+    def test_hedge_delay_cold_copy_is_none(self):
+        sel = _selector()
+        (c,) = _copies(1)
+        assert sel.hedge_delay_s(c, None) is None
+
+    def test_hedge_delay_tracks_copy_p99(self):
+        sel = _selector()
+        (c,) = _copies(1)
+        _warm_all(sel, [c], seconds=0.05, n=20)
+        d = sel.hedge_delay_s(c, None)
+        assert d is not None and 0.03 <= d <= 0.15
+
+    def test_hedge_delay_clamped_by_best_alternative(self):
+        sel = _selector()
+        slow, fast = _copies(2)
+        _warm_all(sel, [slow], seconds=0.8, n=20)
+        _warm_all(sel, [fast], seconds=0.01, n=20)
+        # probing the slow copy hedges as soon as a healthy copy would have
+        # answered — not after the slow copy's own (useless) 0.8s p99
+        d = sel.hedge_delay_s(slow, None, others=[fast])
+        assert d is not None and d <= 0.05
+        # ...but an all-slow group derives an all-slow delay (no useless
+        # speculative traffic during a brown-out)
+        slow2 = _copies(3)[2]
+        _warm_all(sel, [slow2], seconds=0.8, n=20)
+        d2 = sel.hedge_delay_s(slow, None, others=[slow2])
+        assert d2 is not None and d2 >= 0.5
+
+    def test_hedge_delay_clamped_by_deadline(self):
+        sel = _selector()
+        (c,) = _copies(1)
+        _warm_all(sel, [c], seconds=0.2, n=20)
+        d = sel.hedge_delay_s(c, 0.1)
+        assert d is not None and d <= 0.05  # half the remaining budget
+        assert sel.hedge_delay_s(c, 0.001) is None  # no budget left
+
+
+# ---------------------------------------------------------------------------
+# _select preference fall-through regression
+# ---------------------------------------------------------------------------
+
+
+class TestPreferenceFallthrough:
+    def _state(self, local_id="n0", n_nodes=4):
+        nodes = DiscoveryNodes(local_id=local_id)
+        for i in range(n_nodes):
+            nodes = nodes.with_node(
+                DiscoveryNode(f"n{i}", f"n{i}", f"local://n{i}"))
+        return ClusterState(nodes=nodes)
+
+    def test_local_without_local_copy_distributes(self):
+        """REGRESSION: a 3-copy group with no copy on the coordinator used to
+        hash the literal "_local" — a constant — so EVERY coordinator
+        deterministically hotspotted the same copy index."""
+        state = self._state()  # local is n0; copies live on n1..n3
+        group = IndexShardRoutingTable(shards=tuple(_copies(3)))
+        r = OperationRouting()
+        picks = {r._select(group, state, "_local").node_id for _ in range(6)}
+        assert picks == {"n1", "n2", "n3"}
+
+    def test_local_with_local_copy_sticks(self):
+        state = self._state(local_id="n2")
+        group = IndexShardRoutingTable(shards=tuple(_copies(3)))
+        assert all(OperationRouting()._select(group, state, "_local").node_id
+                   == "n2" for _ in range(4))
+
+    def test_prefer_node_fallthrough_distributes(self):
+        state = self._state()
+        group = IndexShardRoutingTable(shards=tuple(_copies(3)))
+        r = OperationRouting()
+        picks = {r._select(group, state, "_prefer_node:missing").node_id
+                 for _ in range(6)}
+        assert picks == {"n1", "n2", "n3"}
+        assert r._select(group, state, "_prefer_node:n2").node_id == "n2"
+
+    def test_only_node_still_raises(self):
+        state = self._state()
+        group = IndexShardRoutingTable(shards=tuple(_copies(3)))
+        with pytest.raises(NoShardAvailableError):
+            OperationRouting()._select(group, state, "_only_node:missing")
+
+    def test_session_key_still_stable(self):
+        state = self._state()
+        group = IndexShardRoutingTable(shards=tuple(_copies(3)))
+        r = OperationRouting()
+        first = r._select(group, state, "session-abc").node_id
+        assert all(r._select(group, state, "session-abc").node_id == first
+                   for _ in range(5))
+
+    def test_adaptive_pick_avoids_slow_copy(self):
+        sel = _selector()
+        copies = _copies(3)
+        _warm_all(sel, copies[:2], seconds=0.01)
+        _warm_all(sel, copies[2:], seconds=1.0)
+        state = self._state()
+        group = IndexShardRoutingTable(shards=tuple(copies))
+        r = OperationRouting(selector=sel)
+        picks = [r._select(group, state, None).node_id for _ in range(16)]
+        assert picks.count("n3") <= 16 // sel.probe_every + 1
+
+
+# ---------------------------------------------------------------------------
+# live chaos: the full feedback loop under seeded faults
+# ---------------------------------------------------------------------------
+
+
+A_QUERY_GLOB = "*[phase/query]*"
+BODY = {"query": {"match": {"body": "alpha1 alpha2"}}, "size": 3}
+
+
+def _boot(tmp_path, seed):
+    """2-node cluster, one index with 1 shard + 1 replica (a copy on each
+    node), generous hedge burst so budget never masks routing assertions."""
+    cluster = TestCluster(n_nodes=2, data_root=tmp_path, seed=seed,
+                          settings={"search.hedge.burst": 24})
+    cluster.start()
+    names = sorted(cluster.nodes)
+    coord = cluster.nodes[names[0]]
+    client = coord.client()
+    client.create_index("hx", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 1}})
+    cluster.ensure_green("hx")
+    for i in range(30):
+        client.index("hx", "doc",
+                     {"body": f"alpha{i % 4} alpha{(i + 1) % 4}", "n": i},
+                     id=str(i))
+    client.refresh("hx")
+    return cluster, coord, names
+
+
+def _copy_key(node, index="hx", shard=0):
+    return f"{node.node_id}/{index}/{shard}"
+
+
+def _warm(coord, keys, max_iters=200):
+    """Warm until both copies carry min_samples observations AND their EWMAs
+    converge. Convergence matters: the process's ONE first-search XLA compile
+    lands in exactly one copy's stats as a multi-second outlier (which copy
+    depends on round-robin phase), and the chaos assertions below need a
+    symmetric healthy baseline — the outlier decays as warm traffic (rotation
+    or probes) reaches that copy."""
+    sel = coord.adaptive_routing
+    for _ in range(max_iters):
+        coord.actions.search("hx", BODY)
+        copies = sel.stats()["copies"]
+        if all(k in copies and copies[k]["samples"] >= sel.min_samples
+               for k in keys):
+            ew = [copies[k]["ewma_ms"] for k in keys]
+            if max(ew) <= max(3.0 * min(ew), 60.0):
+                return
+    raise AssertionError(f"warmup never converged: {sel.stats()['copies']}")
+
+
+def _drive(coord, n):
+    durs = []
+    for _ in range(n):
+        t0 = time.monotonic()
+        r = coord.actions.search("hx", BODY)
+        durs.append(time.monotonic() - t0)
+        assert r["hits"]["total"] > 0
+    return sorted(durs)
+
+
+class TestChaosAdaptiveRouting:
+    def test_slow_replica_shifts_traffic_hedges_bound_tail_then_recovers(
+            self, tmp_path):
+        """The full loop: FaultPolicy-slowed replica -> its traffic share
+        collapses and hedged requests keep latency far under the injected
+        delay -> fault cleared -> probe traffic restores the rotation."""
+        cluster, coord, names = _boot(tmp_path, seed=3)
+        try:
+            other = cluster.nodes[names[1]]
+            sel = coord.adaptive_routing
+            slow_key = _copy_key(other)
+            fast_key = _copy_key(coord)
+            _warm(coord, [slow_key, fast_key])
+            healthy = _drive(coord, 10)
+            healthy_p99 = healthy[-1]
+
+            # seeded, deterministic slowness: the replica's query phase
+            # handler runs 0.75s late (recv-side delay — a slow NODE, not a
+            # slow wire, so only its copy is affected)
+            pol = cluster.fault_policy(names[1], seed=11)
+            pol.delay(0.75, action=A_QUERY_GLOB, direction="recv")
+            before = sel.stats()
+            b_slow = before["copies"][slow_key]["selected"]
+            b_fast = before["copies"][fast_key]["selected"]
+            durs = _drive(coord, 40)
+            after = sel.stats()
+            slow_delta = after["copies"][slow_key]["selected"] - b_slow
+            fast_delta = after["copies"][fast_key]["selected"] - b_fast
+            # traffic share shifted away within the window (probes + the
+            # pre-detection picks are all the slow copy gets)
+            assert slow_delta <= 15, (slow_delta, fast_delta)
+            assert fast_delta >= 25, (slow_delta, fast_delta)
+            # hedges fired and won — that is what bounded the tail
+            assert after["hedges"]["issued"] > before["hedges"]["issued"]
+            assert after["hedges"]["won"] > before["hedges"]["won"]
+            # p95 stays strictly under the injected 0.75s (an unhedged pick
+            # of the slow copy would cost >= 0.75s) and within ~2x the
+            # healthy baseline — measured on the same box, so the relative
+            # bound self-calibrates under CI load; the absolute floor covers
+            # fast-baseline runs
+            p95 = durs[int(0.95 * len(durs)) - 1]
+            assert p95 < 0.7, (p95, durs[-3:])
+            assert p95 < max(2.0 * healthy_p99, 0.45), (p95, healthy_p99)
+
+            # clear the fault: probe traffic must decay the stale slow EWMA
+            # and restore the copy into the rotation — no permanent blacklist
+            cluster.clear_faults()
+            restored = False
+            for _chunk in range(15):
+                base = sel.stats()["copies"][slow_key]["selected"]
+                _drive(coord, 16)
+                got = sel.stats()["copies"][slow_key]["selected"] - base
+                if got >= 5:  # clearly above the probe rate (16/8 = 2)
+                    restored = True
+                    break
+            assert restored, sel.stats()["copies"]
+            assert sel.stats()["probes"] > 0
+        finally:
+            cluster.close()
+
+    def test_failing_copy_quarantines_and_probes_back(self, tmp_path):
+        """Error-faulted copy: failures decay-count it into quarantine (probe
+        traffic only), searches keep answering via the ranked failover chain,
+        and clearing the fault re-admits it after a couple of probe
+        successes."""
+        cluster, coord, names = _boot(tmp_path, seed=5)
+        try:
+            other = cluster.nodes[names[1]]
+            sel = coord.adaptive_routing
+            slow_key = _copy_key(other)
+            _warm(coord, [slow_key, _copy_key(coord)])
+
+            pol = cluster.fault_policy(names[0], seed=7)
+            pol.error(action=A_QUERY_GLOB, node=cluster.address(names[1]))
+            # every search still answers (failover chain); failures accumulate
+            # until quarantine. Chunked: when warmup ended asymmetric the
+            # copy is score-excluded from the start and only probe turns
+            # (every 8th) reach it, so the failure count grows probe-slow
+            quarantined = False
+            for _chunk in range(8):
+                _drive(coord, 8)
+                if sel.stats()["copies"][slow_key]["quarantined"]:
+                    quarantined = True
+                    break
+            st = sel.stats()
+            assert quarantined, st["copies"]
+            assert st["quarantined"] == 1
+
+            cluster.clear_faults()
+            readmitted = False
+            for _chunk in range(12):
+                _drive(coord, 8)
+                if not sel.stats()["copies"][slow_key]["quarantined"]:
+                    readmitted = True
+                    break
+            assert readmitted, sel.stats()["copies"]
+            assert sel.stats()["probes"] > 0
+            # and it actually receives rotation traffic again once the
+            # residual failure penalty decays (each probe success halves it)
+            restored = False
+            for _chunk in range(10):
+                base = sel.stats()["copies"][slow_key]["selected"]
+                _drive(coord, 16)
+                if sel.stats()["copies"][slow_key]["selected"] - base >= 5:
+                    restored = True
+                    break
+            assert restored, sel.stats()["copies"]
+        finally:
+            cluster.close()
+
+    def test_all_copies_slow_budget_bounds_hedges(self, tmp_path):
+        """Brown-out: EVERY copy is slow. The token bucket caps speculative
+        traffic (no retry-storm amplification) and exhaustion is counted —
+        hedging cannot help when there is no fast copy to hedge to."""
+        cluster, coord, names = _boot(tmp_path, seed=9)
+        try:
+            sel = coord.adaptive_routing
+            _warm(coord, [_copy_key(coord), _copy_key(cluster.nodes[names[1]])])
+            for name in names:
+                cluster.fault_policy(name, seed=13).delay(
+                    0.25, action=A_QUERY_GLOB, direction="recv")
+            # drain the bucket: in the early window the copies' p99s still
+            # read healthy, so hedge timers fire well before the 0.25s
+            # attempts complete — every fire must hit the empty bucket
+            with sel.hedges._lock:
+                sel.hedges.tokens = 0.0
+            b = sel.hedges.stats()
+            _drive(coord, 8)
+            mid = sel.hedges.stats()
+            assert mid["issued"] == b["issued"], mid  # cap held at zero
+            assert mid["budget_exhausted"] > b["budget_exhausted"], mid
+            # grant a small budget: issuance stays bounded by it (and by the
+            # caught-up p99s — an all-slow group derives an all-slow hedge
+            # delay, so speculative traffic never amplifies the brown-out)
+            with sel.hedges._lock:
+                sel.hedges.tokens = 3.0
+            durs = _drive(coord, 16)
+            a = sel.hedges.stats()
+            # <= the 3 granted tokens + the trickle 16 primaries accrue (<1)
+            assert a["issued"] - mid["issued"] <= 4, a
+            # no amplification pile-up: every search ~one injected delay, and
+            # the window's wall clock is bounded by sequential primaries
+            assert durs[-1] < 1.5, durs[-3:]
+        finally:
+            cluster.close()
+
+    def test_hedge_trace_and_profile_integration(self, tmp_path):
+        """?trace=true shows the hedged attempt as a sibling `shard` span
+        tagged hedge:true (the slow primary's span stitches into the ring
+        late); ?profile=true records whether the winning shard entry came
+        from the primary attempt or a hedge."""
+        cluster, coord, names = _boot(tmp_path, seed=21)
+        try:
+            other = cluster.nodes[names[1]]
+            sel = coord.adaptive_routing
+            _warm(coord, [_copy_key(coord), _copy_key(other)])
+            pol = cluster.fault_policy(names[1], seed=17)
+            pol.delay(1.5, action=A_QUERY_GLOB, direction="recv")
+            with sel.hedges._lock:  # a token per request below, determinism
+                sel.hedges.tokens = sel.hedges.burst
+            rc = build_rest_controller(coord)
+            # steer the PRIMARY attempt to the slow copy with the SOFT pin
+            # (_prefer_node keeps hedging; the hard _only_node pin disables
+            # it — covered below); the hedge, clamped to the healthy copy's
+            # EWMA, answers long before the 1.5s delay
+            pref = f"_prefer_node:{other.node_id}"
+
+            r = rc.dispatch(RestRequest(
+                method="POST", path="/hx/_search",
+                params={"trace": "true", "preference": pref}, body=BODY))
+            assert r.status == 200
+            tid = r.body["trace"]["trace_id"]
+
+            def flatten(node_, out):
+                out.append(node_)
+                for ch in node_.get("children", []):
+                    flatten(ch, out)
+                return out
+
+            inline = flatten(r.body["trace"]["tree"], [])
+            hedged = [s for s in inline if s["name"] == "shard"
+                      and s.get("tags", {}).get("hedge")]
+            assert hedged, [s["name"] for s in inline]
+
+            # the losing primary's spans arrive with its (discarded) response
+            # ~1.5s later and late-stitch into the ring snapshot: both shard
+            # spans — hedge:true and the primary — end up siblings there
+            shard_spans = []
+            deadline = time.monotonic() + 8.0
+            while time.monotonic() < deadline:
+                snaps = [t for t in coord.tracer.traces()
+                         if t["trace_id"] == tid]
+                if snaps:
+                    shard_spans = [s for s in snaps[0]["spans"]
+                                   if s["name"] == "shard"]
+                    if len(shard_spans) >= 2:
+                        break
+                time.sleep(0.05)
+            assert len(shard_spans) >= 2, shard_spans
+            assert any(s["tags"].get("hedge") for s in shard_spans)
+            assert any(not s["tags"].get("hedge") for s in shard_spans)
+
+            # retried: the hedge wins whenever its (EWMA-clamped) delay plus
+            # the fast copy's service time beats the 1.5s injected delay —
+            # a transient CI load spike can lose one race, not three
+            shards = None
+            for _attempt in range(3):
+                r = rc.dispatch(RestRequest(
+                    method="POST", path="/hx/_search",
+                    params={"profile": "true", "preference": pref},
+                    body=BODY))
+                assert r.status == 200
+                shards = r.body["profile"]["shards"]
+                assert shards and shards[0]["winner"] in ("primary", "hedge")
+                if shards[0]["winner"] == "hedge":
+                    break
+            assert shards[0]["winner"] == "hedge", shards
+
+            # the HARD pin must not hedge: an answer from a node the caller
+            # explicitly pinned away from violates _only_node even on
+            # success — the search waits out the full injected delay
+            b = sel.hedges.stats()
+            r = rc.dispatch(RestRequest(
+                method="POST", path="/hx/_search",
+                params={"profile": "true",
+                        "preference": f"_only_node:{other.node_id}"},
+                body=BODY))
+            assert r.status == 200
+            shards = r.body["profile"]["shards"]
+            assert shards[0]["winner"] == "primary", shards
+            assert shards[0]["node"] == other.node_id, shards
+            assert sel.hedges.stats()["issued"] == b["issued"]
+
+            # the compound "_shards:N;<pref>" form carries the pin after the
+            # ";" — it must be parsed out, not string-prefix-missed
+            r = rc.dispatch(RestRequest(
+                method="POST", path="/hx/_search",
+                params={"profile": "true",
+                        "preference": f"_shards:0;_only_node:{other.node_id}"},
+                body=BODY))
+            assert r.status == 200
+            assert r.body["profile"]["shards"][0]["winner"] == "primary"
+            assert sel.hedges.stats()["issued"] == b["issued"]
+        finally:
+            cluster.close()
+
+    def test_nodes_stats_surface(self, tmp_path):
+        """/_nodes/stats adaptive_routing: per-copy rank inputs + hedge
+        counters + quarantine/probe counts, via the REST path."""
+        cluster, coord, names = _boot(tmp_path, seed=31)
+        try:
+            _warm(coord, [_copy_key(coord), _copy_key(cluster.nodes[names[1]])])
+            rc = build_rest_controller(coord)
+            r = rc.dispatch(RestRequest(
+                method="GET", path="/_nodes/stats/adaptive_routing",
+                params={}))
+            assert r.status == 200
+            (sections,) = r.body["nodes"].values()
+            ar = sections["adaptive_routing"]
+            assert set(ar["hedges"]) >= {"issued", "won", "budget_exhausted",
+                                         "tokens"}
+            copy = ar["copies"][_copy_key(coord)]
+            for field in ("ewma_ms", "p99_ms", "queue", "headroom",
+                          "outstanding", "failures", "samples", "selected",
+                          "quarantined"):
+                assert field in copy, copy
+            assert copy["samples"] > 0
+            assert "probes" in ar and "quarantined" in ar
+        finally:
+            cluster.close()

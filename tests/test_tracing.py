@@ -529,6 +529,19 @@ class TestStatsSurfaces:
         assert types["estpu_search_latency_seconds"] == "histogram"
         assert types["estpu_hbm_resident_bytes"] == "gauge"
         assert types["estpu_admission_shard_phase_seconds"] == "histogram"
+        # adaptive routing + hedging families (PR 10) — per-copy rank gauges
+        # carry a copy="node/index/shard" label per observed copy, and the
+        # hedge counters are always present; family contiguity for all of
+        # them is pinned by the grouping walk below
+        assert types["estpu_search_hedges_issued_total"] == "counter"
+        assert types["estpu_search_hedges_won_total"] == "counter"
+        assert types["estpu_search_hedges_budget_exhausted_total"] == "counter"
+        assert types["estpu_search_hedges_budget_tokens"] == "gauge"
+        assert types["estpu_routing_probes_total"] == "counter"
+        assert types["estpu_routing_quarantined"] == "gauge"
+        assert types["estpu_routing_rank_ewma_seconds"] == "gauge"
+        assert any(k.startswith('estpu_routing_rank_ewma_seconds{copy="')
+                   for k in series), sorted(series)[:5]
         assert series['estpu_breaker_estimated_bytes{breaker="request"}'] == 0
         # histogram contract: +Inf bucket equals _count
         count = series["estpu_search_latency_seconds_count"]

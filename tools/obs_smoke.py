@@ -93,6 +93,13 @@ def main() -> int:
         r = get("/_prometheus/metrics")
         _parse_prometheus(r.body)
         assert "estpu_traces_ring_evicted_total" in r.body
+        # adaptive routing + hedging families (contiguity checked above)
+        for fam in ("estpu_search_hedges_issued_total",
+                    "estpu_search_hedges_won_total",
+                    "estpu_search_hedges_budget_exhausted_total",
+                    "estpu_routing_probes_total",
+                    "estpu_routing_quarantined"):
+            assert fam in r.body, fam
 
         r = get("/_traces")
         assert r.body["total"] == len(r.body["traces"])
@@ -105,6 +112,10 @@ def main() -> int:
         r = get("/_nodes/stats")
         (sections,) = r.body["nodes"].values()
         assert "tracing" in sections and "search" in sections
+        ar = sections.get("adaptive_routing")
+        assert ar is not None and "hedges" in ar and "copies" in ar, ar
+        for key in ("issued", "won", "budget_exhausted", "tokens"):
+            assert key in ar["hedges"], ar["hedges"]
 
         r = get("/_cat")
         cats = [line.rsplit("/", 1)[1] for line in r.body.split()

@@ -46,7 +46,12 @@ HOT_PREFIXES = ("elasticsearch_tpu/ops/", "elasticsearch_tpu/parallel/")
 HOT_FILES = ("elasticsearch_tpu/search/execute.py",
              # the cross-request batcher's drainer sits between every serving
              # request and the device — its dispatch half must stay pull-free
-             "elasticsearch_tpu/search/batcher.py")
+             "elasticsearch_tpu/search/batcher.py",
+             # adaptive routing sits on every fan-out: copy selection and the
+             # per-copy health tracker must never grow a device pull or an
+             # implicit transfer (they run per shard request, pre-dispatch)
+             "elasticsearch_tpu/cluster/routing.py",
+             "elasticsearch_tpu/cluster/stats.py")
 PLATFORM_EXEMPT = ("elasticsearch_tpu/common/jaxenv.py",)
 
 _SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*ignore(?:\[([A-Z0-9, ]+)\])?")
