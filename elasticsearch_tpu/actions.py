@@ -64,6 +64,7 @@ from .cluster.state import (
 from .index.translog import CREATE, DELETE, INDEX, TranslogOp
 from .indices_service import ACTION_SHARD_FAILED, ACTION_SHARD_STARTED
 from .search.queries import resolve_terms_lookups
+from .search.request_cache import cache_policy, request_fingerprint
 from .search.controller import (
     aggregate_dfs,
     collect_dfs,
@@ -1542,7 +1543,19 @@ class ActionModule:
         # phase is rejected up front (429 + Retry-After) — running it would
         # only burn workers on an answer the client already abandoned
         self.admission.admit(deadline)
-        shards = self.routing.search_shards(state, indices, routing, preference)
+        # cache-affinity routing: cache-ELIGIBLE requests (the same policy
+        # the shard consults — request_cache.cache_policy) carry their
+        # fingerprint into copy selection as a soft affinity, so the same
+        # hot query rendezvous-lands on the same healthy copy and N replica
+        # caches become N× effective capacity instead of N× redundancy.
+        # Health still dominates (affinity picks within the spread set);
+        # ineligible requests route exactly as before (affinity=None).
+        affinity = None
+        _rc = getattr(self.node, "request_cache", None)
+        if _rc is not None and _rc.enabled and cache_policy(body):
+            affinity = request_fingerprint(body)
+        shards = self.routing.search_shards(state, indices, routing,
+                                            preference, affinity=affinity)
 
         # co-located shards + flat query → one SPMD program over the device mesh
         # (DFS psum + all_gather top-k on ICI) instead of per-shard RPC scatter-gather;
@@ -2135,7 +2148,9 @@ class ActionModule:
         return ShardContext(shard.engine.acquire_searcher(), svc.mapper_service,
                             svc.similarity_service, global_stats,
                             index_name=index, breakers=self.node.breakers,
-                            batcher=getattr(self.node, "search_batcher", None))
+                            batcher=getattr(self.node, "search_batcher", None),
+                            filter_cache=getattr(self.node, "filter_cache",
+                                                 None))
 
     def _s_query_phase(self, request, channel):
         index, shard_id = request["index"], request["shard"]
@@ -2178,6 +2193,40 @@ class ActionModule:
             # speculative (hedged) attempt: its shard span shows as a sibling
             # of the primary attempt's in the stitched ?trace=true tree
             shard_span.tag(hedge=True)
+        # ---- shard request cache (search/request_cache.py) ----------------
+        # key = (index, shard, point-in-time view version, fingerprint of the
+        # normalized body). A hit returns the stored partial BEFORE
+        # execute_query_phase — zero device launches, zero device syncs. DFS
+        # requests never cache (per-request global stats change clause
+        # weights); profiled requests always execute (profiling is an
+        # explicit opt-in to re-execution) but record hit/miss/store
+        # attribution events. The uncached path pays one fingerprint
+        # serialization and nothing else.
+        rcache = getattr(self.node, "request_cache", None)
+        cache_key = None
+        peek_hit = False
+        if (rcache is not None and rcache.enabled
+                and request.get("dfs") is None and cache_policy(body)):
+            cache_key = (index, shard_id, ctx.searcher.version,
+                         request_fingerprint(body))
+        if cache_key is not None:
+            if prof is None:
+                data = rcache.get(cache_key)
+                if data is not None:
+                    try:
+                        shard_span.tag(request_cache="hit")
+                    finally:
+                        shard_span.end()
+                    out = _decode_cached_partial(data)
+                    out["ctx_id"] = self._pin_context(index, shard_id, ctx)
+                    out["load"] = self._load_signal()
+                    if trace:
+                        out["spans"] = trace.span_dicts()
+                    return out
+            else:
+                peek_hit = rcache.peek(cache_key)
+                prof.event("request_cache",
+                           cache="hit" if peek_hit else "miss")
         t_q = time.monotonic()
         try:
             with tracing.activate(shard_span):
@@ -2193,7 +2242,7 @@ class ActionModule:
             shard_span.end()
         self._maybe_slowlog(index, shard_id, body, (time.monotonic() - t_q),
                             trace=trace)
-        out = {
+        partial = {
             "total": result.total,
             "docs": [[s, d, sv] for (s, d, sv) in result.docs],
             "max_score": None if result.max_score != result.max_score else result.max_score,
@@ -2201,6 +2250,17 @@ class ActionModule:
             "facet_partials": _encode_partials(result.facet_partials),
             "suggest": result.suggest,
             "timed_out": result.timed_out,
+        }
+        # store the partial for the next sighting of this (body, view) —
+        # never a timed-out partial (an honest partial is not THE answer),
+        # and never re-store what a profiled run already found present
+        if cache_key is not None and not result.timed_out and not peek_hit:
+            data = _encode_cached_partial(partial)
+            if data is not None and rcache.put(cache_key, data) \
+                    and prof is not None:
+                prof.event("request_cache", cache="store")
+        out = {
+            **partial,
             # fetch must read the SAME point-in-time searcher these doc ids
             # come from (a merge between phases moves local ids)
             "ctx_id": self._pin_context(index, shard_id, ctx),
@@ -2231,7 +2291,17 @@ class ActionModule:
         br = self.node.breakers.breaker("request")
         headroom = 1.0 if br.limit <= 0 else \
             max(0.0, 1.0 - br.used / br.limit)
-        return {"queue": queue, "headroom": round(headroom, 4)}
+        out = {"queue": queue, "headroom": round(headroom, 4)}
+        # per-copy request-cache hit rate piggybacks alongside (also plain
+        # int reads): the adaptive selector records it per copy so operators
+        # can see WHERE the affinity routing is landing hits (reported in
+        # /_nodes/stats adaptive_routing; never a rank input — health ranks)
+        rc = getattr(self.node, "request_cache", None)
+        if rc is not None:
+            lookups = rc.hits + rc.misses
+            out["rc_hit_rate"] = round(rc.hits / lookups, 4) if lookups \
+                else 0.0
+        return out
 
     def _maybe_slowlog(self, index: str, shard_id: int, body: dict, took_s: float,
                        trace=None):
@@ -2321,8 +2391,10 @@ class ActionModule:
                 deleted[index] += r.get("deleted", 0)
         return {"_indices": {i: {"deleted": n} for i, n in deleted.items()}}
 
-    def broadcast(self, index_expr, op: str) -> dict:
-        """refresh / flush / optimize across all shard copies."""
+    def broadcast(self, index_expr, op: str, extra: dict | None = None) -> dict:
+        """refresh / flush / optimize / clear_cache across all shard copies.
+        `extra` rides the per-shard payload (e.g. the _cache/clear
+        request/filter tier selectors)."""
         state = self.cluster_service.state
         indices = state.metadata.resolve_indices(index_expr) if index_expr else \
             state.metadata.index_names()
@@ -2336,6 +2408,7 @@ class ActionModule:
                     node = state.nodes.get(copy.node_id)
                     futs.append(self.transport.send_request(node, A_SHARD_BROADCAST, {
                         "index": index, "shard": copy.shard_id, "op": op,
+                        **(extra or {}),
                     }))
         ok = 0
         for fut in futs:
@@ -2361,9 +2434,23 @@ class ActionModule:
             shard.engine.optimize()
             return {"ok": True}
         if op == "clear_cache":
-            for seg in shard.engine.acquire_searcher().segments:
-                seg._device_cache.pop("filters", None)
-            return {"ok": True}
+            # tier selectors (the `?request=&filter=` params of
+            # POST /_cache/clear): both default true, reference parity
+            clear_request = request.get("request", True) is not False
+            clear_filter = request.get("filter", True) is not False
+            cleared = {"request": 0, "filter": 0}
+            if clear_filter:
+                fcache = getattr(self.node, "filter_cache", None)
+                for seg in shard.engine.acquire_searcher().segments:
+                    seg._device_cache.pop("filters", None)  # host mask cache
+                    if fcache is not None:  # device-resident masks + breaker
+                        cleared["filter"] += fcache.clear_segment(seg)
+            if clear_request:
+                rcache = getattr(self.node, "request_cache", None)
+                if rcache is not None:
+                    cleared["request"] = rcache.invalidate_shard(
+                        request["index"], request["shard"], None)
+            return {"ok": True, "cleared": cleared}
         if op == "delete_by_query":
             ctx = self._shard_ctx(request["index"], request["shard"])
             from .search.execute import host_match_mask
@@ -2515,6 +2602,28 @@ def _fs_from(lst):
     from .index.segment import FieldStats
 
     return FieldStats(*lst)
+
+
+def _encode_cached_partial(partial: dict) -> bytes | None:
+    """Serialize a cacheable shard partial through the binary wire codec
+    (common/stream.py) — the SAME bytes that cross the transport, so breaker
+    accounting is honest and a cache hit hands back an isolated copy. A
+    value the codec refuses (an exotic plugin payload) skips caching rather
+    than failing the search."""
+    from .common.stream import StreamOutput
+
+    try:
+        out = StreamOutput()
+        out.write_map(partial)
+        return out.bytes()
+    except SearchEngineError:
+        return None
+
+
+def _decode_cached_partial(data: bytes) -> dict:
+    from .common.stream import StreamInput
+
+    return StreamInput(data).read_map()
 
 
 def _encode_partials(partials):

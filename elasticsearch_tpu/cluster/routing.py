@@ -84,8 +84,17 @@ class OperationRouting:
 
     def search_shards(self, state: ClusterState, indices: list[str],
                       routing: str | None = None,
-                      preference: str | None = None) -> list[ShardRouting]:
-        """One active copy of every relevant shard group (ref: searchShards:103-146)."""
+                      preference: str | None = None,
+                      affinity: str | None = None) -> list[ShardRouting]:
+        """One active copy of every relevant shard group (ref: searchShards:103-146).
+
+        `affinity` is the request-cache fingerprint of a cache-eligible
+        request (actions passes it; None otherwise): a SOFT rendezvous
+        affinity applied inside preference-free selection so the same hot
+        query lands on the same healthy copy and replica request caches
+        partition instead of duplicating. Health still dominates (the
+        affinity pick happens within the adaptive spread set), probes and
+        quarantine are unchanged, and every explicit preference wins."""
         only_shards, preference = self.split_preference(preference)
         out = []
         for index in indices:
@@ -102,11 +111,13 @@ class OperationRouting:
                 if only_shards is not None and sid not in only_shards:
                     continue
                 group = table.shard(sid)
-                out.append(self._select(group, state, preference))
+                out.append(self._select(group, state, preference,
+                                        affinity=affinity))
         return out
 
     def _select(self, group: IndexShardRoutingTable, state: ClusterState,
-                preference: str | None) -> ShardRouting:
+                preference: str | None,
+                affinity: str | None = None) -> ShardRouting:
         active = group.active_shards()
         if not active:
             raise NoShardAvailableError(
@@ -127,7 +138,7 @@ class OperationRouting:
                 # no local copy: fall back to adaptive/round-robin — hashing
                 # the literal "_local" would pin every coordinator without a
                 # copy to the SAME index (djb2 of a constant string)
-                return self._pick(active)
+                return self._pick(active, affinity)
             if preference.startswith("_only_node:"):
                 node_id = preference.split(":", 1)[1]
                 for s in active:
@@ -139,20 +150,47 @@ class OperationRouting:
                 for s in active:
                     if s.node_id == node_id:
                         return s
-                return self._pick(active)  # same fall-through rule as _local
+                return self._pick(active, affinity)  # _local fall-through rule
             # arbitrary session key → stable copy choice
             idx = abs(djb2_hash(preference)) % len(active)
             return active[idx]
-        return self._pick(active)
+        return self._pick(active, affinity)
 
-    def _pick(self, active: list[ShardRouting]) -> ShardRouting:
+    @staticmethod
+    def rendezvous(affinity: str, copies: list[ShardRouting]) -> ShardRouting:
+        """Highest-random-weight pick of `affinity` over `copies`: every
+        coordinator computes the same winner for the same fingerprint
+        (unkeyed blake2b — seed-stable across processes, unlike djb2 whose
+        weak avalanche lets the node-id's LAST byte dominate and pin every
+        fingerprint to one copy), and removing a copy only remaps the
+        fingerprints it owned — the property that makes N replica request
+        caches partition instead of duplicate."""
+        import hashlib
+
+        return max(copies, key=lambda s: (
+            hashlib.blake2b(f"{affinity}#{s.node_id}".encode("utf-8"),
+                            digest_size=8).digest(),
+            s.node_id))
+
+    def _pick(self, active: list[ShardRouting],
+              affinity: str | None = None) -> ShardRouting:
         """Preference-free copy choice: adaptive rank rotation when the
-        selector is wired AND warm for this group, else round-robin (which is
-        what warms it)."""
+        selector is wired AND warm for this group (the selector applies the
+        affinity inside its spread set), else round-robin (which is what
+        warms it) — except that a COLD group with an affinity fingerprint
+        still round-robins: warming every copy's stats outranks early cache
+        locality, and the affinity becomes effective the moment the group
+        warms."""
         if self.selector is not None:
-            s = self.selector.select(active)
+            s = self.selector.select(active, affinity=affinity)
             if s is not None:
                 return s
+            if self.selector.enabled and len(active) > 1:
+                return active[next(self._rr) % len(active)]
+        if affinity is not None and len(active) > 1:
+            # selector-less embedding: pure rendezvous affinity (no health
+            # signal exists to dominate it)
+            return self.rendezvous(affinity, active)
         return active[next(self._rr) % len(active)]
 
     def ranked_copies(self, group: IndexShardRoutingTable,

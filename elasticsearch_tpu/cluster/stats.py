@@ -62,7 +62,7 @@ class CopyHealth:
 
     __slots__ = ("key", "_lock", "ewma_s", "samples", "queue", "headroom",
                  "outstanding", "failures", "_fail_stamp", "selected", "hist",
-                 "last_touch")
+                 "last_touch", "rc_hit_rate")
 
     def __init__(self, key: tuple):
         self.key = key
@@ -72,6 +72,8 @@ class CopyHealth:
         self.samples = 0       # successful observations
         self.queue = 0         # remote search-pool queue depth (piggybacked)
         self.headroom = 1.0    # remote request-breaker headroom fraction
+        self.rc_hit_rate = 0.0  # remote request-cache hit rate (piggybacked;
+        # REPORTED in stats, never a rank input — health decides routing)
         self.outstanding = 0   # attempts in flight from THIS coordinator
         self.failures = 0.0    # decayed failure count
         self._fail_stamp = 0.0  # monotonic ts of the last failure decay
@@ -79,7 +81,8 @@ class CopyHealth:
         self.hist = HistogramMetric()  # per-copy latency (hedge delay = p99)
 
     # -- observations --------------------------------------------------------
-    def observe(self, seconds: float, alpha: float, queue=None, headroom=None):
+    def observe(self, seconds: float, alpha: float, queue=None, headroom=None,
+                rc_hit_rate=None):
         """A completed attempt's latency + piggybacked load. A success also
         halves the decayed failure count — deterministic re-entry from
         quarantine (time decay alone would make recovery wall-clock-bound,
@@ -95,6 +98,8 @@ class CopyHealth:
                 self.queue = max(0, int(queue))
             if headroom is not None:
                 self.headroom = min(1.0, max(0.0, float(headroom)))
+            if rc_hit_rate is not None:
+                self.rc_hit_rate = min(1.0, max(0.0, float(rc_hit_rate)))
 
     def failure(self, now: float, halflife_s: float):
         with self._lock:
@@ -143,6 +148,7 @@ class CopyHealth:
                 "failures": round(f, 3),
                 "selected": self.selected,
                 "quarantined": f >= threshold,
+                "rc_hit_rate": round(self.rc_hit_rate, 4),
             }
         d["p99_ms"] = round(self.hist.percentile(0.99) * 1000.0, 3)
         return d
@@ -235,7 +241,8 @@ class AdaptiveReplicaSelector:
         self._sel_lock = threading.Lock()
         self._groups: dict[tuple, dict] = {}  # (index, shard) -> {n, probe_i}
         self.probes = 0
-        self.selections = {"adaptive": 0, "round_robin": 0, "probe": 0}
+        self.selections = {"adaptive": 0, "round_robin": 0, "probe": 0,
+                           "affinity": 0}
 
     # -- registry ------------------------------------------------------------
     @staticmethod
@@ -278,12 +285,14 @@ class AdaptiveReplicaSelector:
 
     def observe(self, copy, seconds: float, load: dict | None = None):
         """Latency of a completed query-phase attempt + the response's
-        piggybacked load signals ({"queue", "headroom"})."""
-        q = hr = None
+        piggybacked load signals ({"queue", "headroom", "rc_hit_rate"})."""
+        q = hr = rc = None
         if isinstance(load, dict):
             q, hr = load.get("queue"), load.get("headroom")
+            rc = load.get("rc_hit_rate")
         self._copy(self.key(copy)).observe(seconds, self.alpha,
-                                           queue=q, headroom=hr)
+                                           queue=q, headroom=hr,
+                                           rc_hit_rate=rc)
 
     def failure(self, copy):
         self._copy(self.key(copy)).failure(time.monotonic(),
@@ -333,10 +342,17 @@ class AdaptiveReplicaSelector:
         return delay
 
     # -- selection -----------------------------------------------------------
-    def select(self, active: list):
+    def select(self, active: list, affinity: str | None = None):
         """Pick one copy of a replication group, or None to tell the caller
         to round-robin (disabled / cold group). See the module docstring for
-        the rotation + probe policy."""
+        the rotation + probe policy.
+
+        `affinity` (the request-cache fingerprint of a cache-eligible
+        request) replaces the ROTATION pick with a rendezvous hash over the
+        SAME within-spread eligible set: the hot query lands on the same
+        healthy copy every time (its cache), while a sick copy's exit from
+        the spread set moves the fingerprint to the next-ranked copy —
+        health dominates, and probe/quarantine turns are untouched."""
         if not self.enabled or len(active) < 2:
             return None
         entries = [(s, self._copy(self.key(s))) for s in active]
@@ -382,6 +398,18 @@ class AdaptiveReplicaSelector:
                 pick, entry = excluded[g["probe_i"] % len(excluded)]
                 self.probes += 1
                 self.selections["probe"] += 1
+            elif affinity is not None:
+                # rendezvous over the eligible set — which may be ONE copy
+                # when health has excluded the rest (a 2-node TCP cluster's
+                # remote copy often sits outside the spread): the request is
+                # still affinity-routed (deterministic landing spot), so the
+                # counter reflects it either way
+                from .routing import OperationRouting
+
+                pick = OperationRouting.rendezvous(
+                    affinity, [s for s, _e in eligible])
+                entry = next(e for s, e in eligible if s is pick)
+                self.selections["affinity"] += 1
             else:
                 pick, entry = eligible[g["n"] % len(eligible)]
                 self.selections["adaptive"] += 1

@@ -73,8 +73,14 @@ class Searcher:
     Doc addressing: global doc = segment base + local id, bases assigned in segment
     order — same scheme as Lucene's composite reader."""
 
-    def __init__(self, segments: list[FrozenSegment]):
+    def __init__(self, segments: list[FrozenSegment], version: int = 0):
         self.segments = segments
+        # point-in-time VIEW identity: monotonically bumped by the owning
+        # engine on every searcher install (refresh with changes, merge,
+        # optimize, recovery). The shard request cache keys on it — results
+        # cannot change without a new searcher, so view-keyed caching is
+        # sound by NRT construction (search/request_cache.py)
+        self.version = version
         self.bases: list[int] = []
         base = 0
         for seg in segments:
@@ -128,7 +134,16 @@ class Engine:
         from .merge_policy import TieredMergePolicy
 
         self.merge_policy = TieredMergePolicy(settings)
-        self._searcher: Searcher = Searcher([])
+        self._searcher_version = 0
+        self._searcher: Searcher = Searcher([], version=0)
+        # view listeners: called with (new_searcher | None, dropped_segments)
+        # on every searcher install and on close — the node-level caches hang
+        # invalidation off this (request cache: view advanced ⇒ drop stale
+        # entries; device filter cache: segment dropped ⇒ evict its masks).
+        # Listeners run under the engine lock and MUST be leaves: plain
+        # dict/counter/breaker work, never a blocking wait, never a device
+        # dispatch (the PR-6 lock discipline)
+        self.view_listeners: list = []
         self.created = time.time()
         self._last_write = 0.0
         self.stats = {
@@ -309,6 +324,28 @@ class Engine:
         # the clock's ms value hasn't ticked (in-process indexing is sub-ms)
         return max(0, (base + ttl) - int(time.time() * 1000) - 1)
 
+    def _install_searcher(self) -> Searcher:
+        """Install a new point-in-time view over the current segment list:
+        bump the view version and notify view listeners with the segment
+        objects the OLD view held that the new one does not (identity diff —
+        copy-on-write tombstoning shares the large arrays but produces new
+        segment objects; a merge drops its sources). Caller holds _lock;
+        listeners must be leaves (see __init__)."""
+        old = self._searcher
+        self._searcher_version += 1
+        new = Searcher(list(self._segments), version=self._searcher_version)
+        self._searcher = new
+        if self.view_listeners:
+            current = {id(s) for s in new.segments}
+            dropped = [s for s in old.segments if id(s) not in current]
+            for listener in list(self.view_listeners):
+                try:
+                    listener(new, dropped)
+                except Exception:  # noqa: BLE001 — cache invalidation must
+                    # never fail the refresh/merge that triggered it
+                    self.logger.warning("view listener failed", exc_info=True)
+        return new
+
     # ------------------------------------------------------------------ nrt
     def refresh(self) -> bool:
         """Make buffered ops searchable (ref: InternalEngine.refresh:711).
@@ -350,7 +387,7 @@ class Engine:
                 if entry.deleted:
                     self._uid_index.pop(uid, None)
                 del self._version_map[uid]
-            self._searcher = Searcher(list(self._segments))
+            self._install_searcher()
             self.stats["refresh_total"] += 1
             self.stats["refresh_time_ms"] += (time.monotonic() - t0) * 1000
             return True
@@ -511,7 +548,7 @@ class Engine:
                 self._persisted_gens.discard(g)
                 self._segment_files.pop(str(g), None)
                 self._delete_segment_files(g)
-            self._searcher = Searcher(list(self._segments))
+            self._install_searcher()
             self.stats["merge_total"] += 1
 
     def _merge_window(self, start: int, end: int):
@@ -551,7 +588,7 @@ class Engine:
             self._persisted_gens.discard(g)
             self._segment_files.pop(str(g), None)
             self._delete_segment_files(g)
-        self._searcher = Searcher(list(self._segments))
+        self._install_searcher()
         self.stats["merge_total"] += 1
 
     def maybe_merge(self, max_merges: int = 4):
@@ -610,7 +647,7 @@ class Engine:
             for op in self.translog.read_ops(self.translog.gen if commit else 1):
                 self._replay_op(op)
                 replayed += 1
-            self._searcher = Searcher(list(self._segments))
+            self._install_searcher()
             self.refresh()
             return replayed
 

@@ -213,6 +213,7 @@ class IndicesService:
         for name, svc in list(self.indices.items()):
             for sid in list(svc.shards):
                 if (name, sid) not in my_shards:
+                    self._drop_shard_caches(name, svc.shards.get(sid))
                     svc.remove_shard(sid)
                     self.logger.info("removed shard [%s][%d]", name, sid)
         for (index, sid), routing in my_shards.items():
@@ -234,12 +235,58 @@ class IndicesService:
             local = svc.shards.get(sid)
             if local is None and routing.state == INITIALIZING:
                 shard = svc.create_shard(sid, routing.primary)
+                self._wire_cache_listeners(index, sid, shard.engine)
                 threading.Thread(
                     target=self._recover_shard, args=(shard, routing, state),
                     daemon=True, name=f"estpu-recover[{index}][{sid}]",
                 ).start()
             elif local is not None:
                 local.primary = routing.primary
+
+    # ------------------------------------------------------------ caches
+    def _wire_cache_listeners(self, index: str, sid: int, engine: Engine):
+        """Hang the node-level cache tiers off the engine's view listeners:
+        a searcher install invalidates the shard's request-cache entries from
+        superseded views, and segments the new view dropped (merge sources,
+        pre-tombstone copies) evict their device-resident filter masks.
+        Listeners are leaves — dict/counter/breaker work only (the engine
+        calls them under its lock)."""
+        node = self.node
+        if node is None:
+            return  # unwired contexts (unit tests driving IndicesService raw)
+        rcache = getattr(node, "request_cache", None)
+        fcache = getattr(node, "filter_cache", None)
+        if rcache is None and fcache is None:
+            return
+
+        def on_view_change(searcher, dropped):
+            if rcache is not None:
+                rcache.invalidate_shard(
+                    index, sid,
+                    None if searcher is None else searcher.version)
+            if fcache is not None and dropped:
+                fcache.evict_dropped(
+                    dropped, () if searcher is None else searcher.segments)
+
+        engine.view_listeners.append(on_view_change)
+
+    def _drop_shard_caches(self, index: str, shard: "IndexShard | None"):
+        """A shard leaving this node releases every cache byte it holds —
+        request-cache entries for any view, and the filter masks of every
+        segment its live searcher still references."""
+        node = self.node
+        if node is None or shard is None:
+            return
+        rcache = getattr(node, "request_cache", None)
+        fcache = getattr(node, "filter_cache", None)
+        if rcache is not None:
+            rcache.invalidate_shard(index, shard.shard_id, None)
+        if fcache is not None:
+            try:
+                segs = shard.engine.acquire_searcher().segments
+            except SearchEngineError:
+                segs = []
+            fcache.evict_dropped(segs, ())
 
     # ------------------------------------------------------------ recovery
     def _recover_shard(self, shard: IndexShard, routing: ShardRouting,

@@ -89,6 +89,20 @@ class Node:
         from .common.breaker import CircuitBreakerService
 
         self.breakers = CircuitBreakerService(self.settings)
+        # multi-tier caching (ISSUE 11): the shard request cache (normalized
+        # request + point-in-time view → serialized partial, accounted on the
+        # request breaker) and the device-resident filter/bitset cache (hot
+        # filters' packed doc masks stay in HBM, accounted on the fielddata
+        # breaker next to the packed postings) — invalidation rides the
+        # engines' view listeners (indices_service._wire_cache_listeners)
+        from .ops.device_index import DeviceFilterCache
+        from .search.request_cache import ShardRequestCache
+
+        self.request_cache = ShardRequestCache(
+            self.settings, breaker=self.breakers.breaker("request"),
+            total_budget=self.breakers.total_budget)
+        self.filter_cache = DeviceFilterCache(
+            self.settings, breaker=self.breakers.breaker("fielddata"))
         # request-scoped tracing: sampling knobs ESTPU_TRACE /
         # search.trace.sample_rate, bounded ring of finished traces
         # (GET /_traces), in-flight registry (GET /_tasks) — the span
@@ -616,8 +630,13 @@ class Client:
     def optimize(self, index=None):
         return self.actions.broadcast(index, "optimize")
 
-    def clear_cache(self, index=None):
-        return self.actions.broadcast(index, "clear_cache")
+    def clear_cache(self, index=None, request=None, filter=None):  # noqa: A002
+        extra = {}
+        if request is not None:
+            extra["request"] = bool(request)
+        if filter is not None:
+            extra["filter"] = bool(filter)
+        return self.actions.broadcast(index, "clear_cache", extra=extra)
 
     def exists_index(self, index) -> bool:
         try:
@@ -906,8 +925,18 @@ class Client:
         # pays for the sections it asked for (the monitor sections alone are
         # several procfs reads — a scraper polling one cheap section every
         # few seconds must not do the full-dump work each time)
+        def indices_stats():
+            # per-index shard stats + the node's cache tiers (the reference
+            # nests request_cache/filter_cache under nodes.<id>.indices too);
+            # index names never collide with the tier keys (validate_index_name
+            # rejects leading underscores — tier keys are plain but reserved)
+            out = self.node.indices.stats()
+            out["request_cache"] = self.node.request_cache.stats()
+            out["filter_cache"] = self.node.filter_cache.stats()
+            return out
+
         sections = {
-            "indices": lambda: self.node.indices.stats(),
+            "indices": indices_stats,
             "transport": lambda: self.node.transport.stats,
             "thread_pool": lambda: self.node.threadpool.stats(),
             # overload protection: breaker hierarchy + admission control —

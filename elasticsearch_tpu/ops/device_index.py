@@ -35,6 +35,7 @@ quantization.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field as dc_field
 
@@ -42,6 +43,7 @@ import numpy as np
 
 from ..common import profile as _profile
 from ..common.breaker import reserve
+from ..common.errors import CircuitBreakingError
 from ..index.segment import FrozenSegment
 
 BLOCK = 128  # lane width
@@ -445,6 +447,239 @@ def ensure_agg_rows(seg: FrozenSegment, packed: PackedSegment, fields: list[str]
             packed.agg_stacks.pop(next(iter(packed.agg_stacks)))
         packed.agg_stacks[key] = stack
     return stack
+
+
+# ---------------------------------------------------------------------------
+# device-resident filter/bitset cache
+# ---------------------------------------------------------------------------
+
+
+class _SegmentFilterMasks:
+    """Per-segment holder of device-resident filter masks, living in
+    `seg._device_cache["filter_masks"]`. Copy-on-write tombstoning
+    (FrozenSegment.with_deletes) shallow-copies the device cache, so views of
+    one segment SHARE this holder — eviction therefore keys on the holder
+    object (is it still referenced by any live segment?), not on the segment
+    wrapper identity. Filter masks are live-mask independent (filters gate
+    MATCHING; liveness is the kernel's separate live_parent gate), so sharing
+    across tombstone views is exact."""
+
+    __slots__ = ("masks", "seen", "bytes", "dead")
+
+    def __init__(self):
+        self.masks: dict = {}  # filter key -> (device bool [Dpad], nbytes)
+        self.seen: dict = {}  # filter key -> sighting count
+        self.bytes = 0
+        self.dead = False  # evicted with its segment: never re-stores
+
+
+class DeviceFilterCache:
+    """Node-level accounting + policy for per-segment device filter masks.
+
+    Hot filters keep their packed per-segment doc masks resident in HBM,
+    keyed by (segment identity, filter fingerprint — `Filter.key()`), so a
+    cached filtered plan skips host mask construction AND the host→device
+    mask transfer entirely; the dense kernel consumes the resident row with
+    bitwise-identical scores (the mask VALUES are identical — filters gate
+    matching, never scoring). Population is sighting-based: the first
+    evaluation of a filter on a segment only counts it (the Profile API's
+    `bool_filter_clause` fallback counter motivated exactly this "which
+    filters are hot" signal); the `min_sightings`-th (default 2nd) builds the
+    padded row host-side OUTSIDE any lock, `jax.device_put`s it once under
+    the transfer guard, charges the fielddata breaker (next to
+    `packed_resident_bytes` — this is device-resident state), and publishes
+    under the leaf lock. Masks are evicted with their segment on
+    refresh/merge (the engine's view listeners) and by
+    `POST /_cache/clear?filter=true`, releasing the breaker bytes.
+
+    Lock discipline: `_lock` is a LEAF guarding dicts and counters only —
+    the mask build and the device_put always happen outside it (the
+    build-outside/publish-under idiom, pinned by the tpulint TPU004
+    fixtures)."""
+
+    def __init__(self, settings=None, breaker=None):
+        from ..common.settings import Settings
+
+        settings = settings or Settings.EMPTY
+        self.enabled = bool(
+            settings.get_bool("indices.filter_cache.enable", True))
+        self.min_sightings = max(1, int(
+            settings.get_int("indices.filter_cache.min_sightings", 2)))
+        self.breaker = breaker
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.builds = 0
+        self.evictions = 0
+        self.rejections = 0  # breaker-tripped stores
+        self._bytes = 0
+        self._masks = 0
+
+    @staticmethod
+    def _holder(seg) -> _SegmentFilterMasks:
+        holder = seg._device_cache.get("filter_masks")
+        if holder is None:
+            # benign setdefault race: both racers publish an empty holder,
+            # one wins, neither has accounted bytes yet
+            holder = seg._device_cache.setdefault("filter_masks",
+                                                  _SegmentFilterMasks())
+        return holder
+
+    def lookup(self, seg, key: str):
+        """The resident device row for (segment, filter key), or None. Counts
+        the sighting — the miss path's counter is what promotes a filter to
+        resident on its next appearance."""
+        holder = self._holder(seg)
+        prof = _profile.current()
+        with self._lock:
+            entry = holder.masks.get(key)
+            if entry is not None:
+                self.hits += 1
+            else:
+                self.misses += 1
+                holder.seen[key] = holder.seen.get(key, 0) + 1
+        if prof is not None:
+            prof.event("filter_cache", cache="hit" if entry else "miss",
+                       filter=key)
+        return entry[0] if entry is not None else None
+
+    def maybe_store(self, seg, key: str, padded_mask):
+        """Promote a freshly evaluated filter mask to device residency when
+        it has reached `min_sightings`. `padded_mask` is the host bool [Dpad]
+        row built OUTSIDE any lock; the device_put happens here, also outside
+        the leaf lock, and only the publish goes under it. Returns the device
+        row (freshly stored or a concurrent winner's), or None when the
+        filter is still cold / the tier is off / the breaker tripped."""
+        if not self.enabled:
+            return None
+        holder = self._holder(seg)
+        with self._lock:
+            if holder.dead:
+                return None  # segment already evicted: a stale searcher
+                # must not repopulate bytes nobody will ever release
+            entry = holder.masks.get(key)
+            if entry is not None:
+                return entry[0]
+            if holder.seen.get(key, 0) < self.min_sightings:
+                return None
+        import jax
+
+        nbytes = int(padded_mask.nbytes)
+        if self.breaker is not None:
+            try:
+                self.breaker.add_estimate_and_maybe_break(
+                    nbytes, "<filter_mask>")
+            except CircuitBreakingError:
+                self.rejections += 1  # out of fielddata budget: the host
+                return None           # mask still serves this request
+        row = jax.device_put(padded_mask)  # the ONE transfer, outside _lock
+        release = 0
+        with self._lock:
+            if holder.dead:
+                release = nbytes
+                row = None
+            else:
+                entry = holder.masks.get(key)
+                if entry is not None:
+                    release = nbytes  # concurrent winner: keep theirs
+                    row = entry[0]
+                else:
+                    holder.masks[key] = (row, nbytes)
+                    holder.bytes += nbytes
+                    self._bytes += nbytes
+                    self._masks += 1
+                    self.builds += 1
+        if release and self.breaker is not None:
+            self.breaker.release(release)
+        if row is not None and release == 0:
+            prof = _profile.current()
+            if prof is not None:
+                prof.event("filter_cache", cache="build", filter=key,
+                           bytes=nbytes)
+        return row
+
+    # -- eviction ------------------------------------------------------------
+    def evict_dropped(self, dropped, live) -> int:
+        """Evict the masks of segments a new view dropped. `live` is the new
+        view's segment list: a with_deletes view SHARES its predecessor's
+        holder, so a holder still referenced by any live segment is retained
+        (same filters, same postings — only tombstones changed)."""
+        live_holders = {id(s._device_cache.get("filter_masks"))
+                        for s in live
+                        if s._device_cache.get("filter_masks") is not None}
+        released = 0
+        evicted = 0
+        for seg in dropped:
+            holder = seg._device_cache.get("filter_masks")
+            if holder is None:
+                # plant a DEAD holder so a straggler request still holding
+                # the old searcher can't create a fresh one after this
+                # eviction ran (its stores would be unreleasable bytes)
+                dead = _SegmentFilterMasks()
+                dead.dead = True
+                holder = seg._device_cache.setdefault("filter_masks", dead)
+                if holder is dead:
+                    continue  # nothing was resident; the tombstone is planted
+            if id(holder) in live_holders:
+                continue
+            with self._lock:
+                if holder.dead:
+                    continue
+                holder.dead = True
+                n = len(holder.masks)
+                released += holder.bytes
+                self._bytes -= holder.bytes
+                self._masks -= n
+                self.evictions += n
+                evicted += n
+                holder.masks.clear()
+                holder.seen.clear()
+                holder.bytes = 0
+        if released and self.breaker is not None:
+            self.breaker.release(released)
+        return evicted
+
+    def clear_segment(self, seg) -> int:
+        """`POST /_cache/clear?filter=true` on a LIVE segment: drop its
+        resident masks and sighting counters (rebuildable — the holder stays
+        alive), returning the breaker bytes."""
+        holder = seg._device_cache.get("filter_masks")
+        if holder is None:
+            return 0
+        released = 0
+        evicted = 0
+        with self._lock:
+            n = len(holder.masks)
+            released = holder.bytes
+            self._bytes -= holder.bytes
+            self._masks -= n
+            self.evictions += n
+            evicted = n
+            holder.masks.clear()
+            holder.seen.clear()
+            holder.bytes = 0
+        if released and self.breaker is not None:
+            self.breaker.release(released)
+        return evicted
+
+    # -- observability -------------------------------------------------------
+    def hit_rate(self) -> float:
+        n = self.hits + self.misses
+        return (self.hits / n) if n else 0.0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "memory_size_in_bytes": self._bytes,
+                "masks": self._masks,
+                "hits": self.hits,
+                "misses": self.misses,
+                "builds": self.builds,
+                "evictions": self.evictions,
+                "rejections": self.rejections,
+                "hit_rate": round(self.hit_rate(), 4),
+            }
 
 
 TFN_BM25 = 0  # tfn = f / (f + cache[norm_byte])        — weight multiplies outside

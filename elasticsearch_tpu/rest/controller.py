@@ -325,6 +325,27 @@ def _prometheus_text(node) -> str:
         w.gauge("estpu_routing_rank_failures", c["failures"], copy=ckey)
     w.counter("estpu_routing_probes_total", ar["probes"])
     w.gauge("estpu_routing_quarantined", ar["quarantined"])
+    # multi-tier caching (ISSUE 11): per-tier hit/miss/store/evict counters +
+    # resident-byte gauges — `rate(hits)/rate(hits+misses)` is the live hit
+    # rate; the bytes gauges sit next to the breaker gauges they are
+    # accounted on (request_cache → request, filter_cache → fielddata). One
+    # emission per family keeps each contiguous (OpenMetrics-strict rule).
+    rcs = node.request_cache.stats()
+    w.counter("estpu_request_cache_hits_total", rcs["hits"])
+    w.counter("estpu_request_cache_misses_total", rcs["misses"])
+    w.counter("estpu_request_cache_stores_total", rcs["stores"])
+    w.counter("estpu_request_cache_evictions_total", rcs["evictions"])
+    w.counter("estpu_request_cache_invalidations_total",
+              rcs["invalidations"])
+    w.gauge("estpu_request_cache_bytes", rcs["memory_size_in_bytes"])
+    w.gauge("estpu_request_cache_entries", rcs["entries"])
+    fcs = node.filter_cache.stats()
+    w.counter("estpu_filter_cache_hits_total", fcs["hits"])
+    w.counter("estpu_filter_cache_misses_total", fcs["misses"])
+    w.counter("estpu_filter_cache_builds_total", fcs["builds"])
+    w.counter("estpu_filter_cache_evictions_total", fcs["evictions"])
+    w.gauge("estpu_filter_cache_bytes", fcs["memory_size_in_bytes"])
+    w.gauge("estpu_filter_cache_masks", fcs["masks"])
     w.counter("estpu_jax_compile_events_total", compile_events_total())
     w.gauge("estpu_hbm_resident_bytes", _hbm_resident_bytes(node))
     ts = node.tracer.stats()
@@ -612,6 +633,11 @@ def build_rest_controller(node) -> RestController:
             # knob as the body's `"profile": true` (common/profile.py); the
             # per-shard collectors merge into a top-level `profile` section
             body["profile"] = req.bool_param("profile")
+        if req.param("request_cache") is not None:
+            # `?request_cache=true|false` overrides the shard request cache's
+            # default size==0-only policy (search/request_cache.cache_policy);
+            # rides the body so the coordinator→shard hop carries it for free
+            body["request_cache"] = req.bool_param("request_cache")
         return body
 
     def search(req):
@@ -941,9 +967,19 @@ def build_rest_controller(node) -> RestController:
                     (lambda o: lambda r: getattr(client, o)(None))(op))
         rc.register("POST,GET", "/{index}/_" + op,
                     (lambda o: lambda r: getattr(client, o)(r.path_params["index"]))(op))
-    rc.register("POST", "/_cache/clear", lambda r: client.clear_cache())
-    rc.register("POST", "/{index}/_cache/clear",
-                lambda r: client.clear_cache(r.path_params["index"]))
+    def cache_clear(req):
+        """POST /_cache/clear (+ index-scoped): `?request=` / `?filter=`
+        select tiers (both default true — the reference's all-tiers form);
+        response is the broadcast `_shards` shape."""
+        kwargs = {}
+        if req.param("request") is not None:
+            kwargs["request"] = req.bool_param("request")
+        if req.param("filter") is not None:
+            kwargs["filter"] = req.bool_param("filter")
+        return client.clear_cache(req.path_params.get("index"), **kwargs)
+
+    rc.register("POST", "/_cache/clear", cache_clear)
+    rc.register("POST", "/{index}/_cache/clear", cache_clear)
 
     def analyze(req):
         """ref: RestAnalyzeAction — analyzer by name, ad-hoc tokenizer+filters chain,
@@ -1519,6 +1555,39 @@ def build_rest_controller(node) -> RestController:
         row.update({name: st.get(name, 0) for (name, _a, _d) in columns[2:]})
         return _cat_table(req, columns, [row])
 
+    def cat_caches(req):
+        """Per-tier cache occupancy at a glance (request cache + device
+        filter cache): entries/bytes against the configured bound, hit rate,
+        and eviction pressure — full counters in /_nodes/stats indices.*."""
+        host, ip = _node_host_ip()
+        columns = [
+            ("host", "h", "host name"), ("ip", "i", "ip address"),
+            ("tier", "t", "cache tier (request|filter)"),
+            ("entries", "e", "resident entries/masks"),
+            ("bytes", "b", "resident bytes"),
+            ("limit", "lb", "configured byte bound (- = breaker-bounded)"),
+            ("hits", "ht", "lookup hits"),
+            ("misses", "ms", "lookup misses"),
+            ("hit_rate", "hr", "lifetime hit rate"),
+            ("evictions", "ev", "evicted entries"),
+        ]
+        rcs = node.request_cache.stats()
+        fcs = node.filter_cache.stats()
+        rows = [
+            {"host": host, "ip": ip, "tier": "request",
+             "entries": rcs["entries"],
+             "bytes": rcs["memory_size_in_bytes"],
+             "limit": rcs["limit_size_in_bytes"],
+             "hits": rcs["hits"], "misses": rcs["misses"],
+             "hit_rate": rcs["hit_rate"], "evictions": rcs["evictions"]},
+            {"host": host, "ip": ip, "tier": "filter",
+             "entries": fcs["masks"],
+             "bytes": fcs["memory_size_in_bytes"], "limit": "-",
+             "hits": fcs["hits"], "misses": fcs["misses"],
+             "hit_rate": fcs["hit_rate"], "evictions": fcs["evictions"]},
+        ]
+        return _cat_table(req, columns, rows)
+
     def cat_segments(req):
         """Per-segment table view of Client.segments: doc/postings counts +
         the quantized device layout (tf rung, bytes/posting, resident bytes,
@@ -1680,13 +1749,14 @@ def build_rest_controller(node) -> RestController:
     rc.register("GET", "/_cat/recovery", cat_recovery)
     rc.register("GET", "/_cat/thread_pool", cat_thread_pool)
     rc.register("GET", "/_cat/batcher", cat_batcher)
+    rc.register("GET", "/_cat/caches", cat_caches)
     rc.register("GET", "/_cat/segments", cat_segments)
     rc.register("GET", "/_cat/segments/{index}", cat_segments)
     rc.register("GET", "/_cat", lambda r: RestResponse(
         200, "".join(f"/_cat/{n}\n" for n in (
             "health", "nodes", "indices", "shards", "master", "allocation", "count",
             "aliases", "pending_tasks", "recovery", "thread_pool", "batcher",
-            "segments")),
+            "caches", "segments")),
         content_type="text/plain"))
 
     # plugin-contributed routes (ref: plugins contribute REST handlers)

@@ -89,7 +89,7 @@ class ShardContext:
 
     def __init__(self, searcher: Searcher, mapper_service, similarity_service=None,
                  global_stats: dict | None = None, index_name: str | None = None,
-                 breakers=None, batcher=None):
+                 breakers=None, batcher=None, filter_cache=None):
         self.searcher = searcher
         self.mapper_service = mapper_service
         self.similarity_service = similarity_service or SimilarityService(
@@ -109,6 +109,11 @@ class ShardContext:
         # in unwired contexts — single-plan device launches coalesce with
         # concurrent searches when present (service._execute_flat_single)
         self.batcher = batcher
+        # the node's device-resident filter/bitset cache
+        # (ops/device_index.DeviceFilterCache), or None in unwired contexts —
+        # hot filters' packed doc masks stay in HBM so cached filtered plans
+        # skip mask construction + transfer (_filter_mask_matrix)
+        self.filter_cache = filter_cache
 
     def breaker(self, name: str):
         """The named circuit breaker, or None when no service is wired."""
@@ -988,6 +993,52 @@ def _execute_flat_fs(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list[T
     ]
 
 
+def _filter_mask_matrix(filters: list, seg, packed, ctx: ShardContext):
+    """The [Q, Dpad] FilteredQuery mask the dense kernels consume — the ONE
+    assembly site for the filtered/sorted paths.
+
+    Per query: a resident device row from the node's filter cache when the
+    (segment, filter-key) mask is already in HBM (zero host evaluation, zero
+    transfer), else host evaluation via the per-segment host filter cache
+    (`segment_mask`) with sighting-based promotion to device residency
+    (DeviceFilterCache.maybe_store — build outside locks, device_put once,
+    publish under the leaf lock). Mask VALUES are identical either way, so
+    cached filtered plans score bitwise-identically to the uncached path.
+
+    Returns a host bool [Q, Dpad] when every row stayed host-side (the
+    pre-cache behavior, one implicit-free jnp.asarray commit at dispatch) or
+    a device [Q, Dpad] stack when any row is resident (host stragglers are
+    device_put explicitly)."""
+    from .filters import segment_mask
+
+    fc = ctx.filter_cache
+    rows = []
+    any_dev = False
+    for f in filters:
+        row = None
+        key = None
+        if fc is not None and fc.enabled and f.cacheable():
+            key = f.key()
+            row = fc.lookup(seg, key)
+        if row is None:
+            m = np.zeros(packed.doc_pad, dtype=bool)
+            m[: seg.doc_count] = segment_mask(seg, f, ctx)
+            if key is not None:
+                row = fc.maybe_store(seg, key, m)
+            if row is None:
+                row = m
+        if not isinstance(row, np.ndarray):
+            any_dev = True
+        rows.append(row)
+    if not any_dev:
+        return np.stack(rows)
+    import jax
+    import jax.numpy as jnp
+
+    return jnp.stack([row if not isinstance(row, np.ndarray)
+                      else jax.device_put(row) for row in rows])
+
+
 def _execute_flat_filtered(plans: list[FlatPlan], ctx: ShardContext,
                            k: int) -> list[TopDocs]:
     """Filtered plans: per-query filter masks (host-evaluated via the per-segment
@@ -996,7 +1047,6 @@ def _execute_flat_filtered(plans: list[FlatPlan], ctx: ShardContext,
     inherited from the plain path."""
     from ..ops.device_index import packed_for
     from ..ops.scoring import build_term_batch, score_filtered_batch
-    from .filters import segment_mask
 
     if len(plans) > _FS_CHUNK:
         out: list[TopDocs] = []
@@ -1017,9 +1067,8 @@ def _execute_flat_filtered(plans: list[FlatPlan], ctx: ShardContext,
         packed = packed_for(seg, breaker=ctx.breaker("fielddata"))
         _ensure_norm_rows(packed, all_fields,
                           breaker=ctx.breaker("fielddata"))
-        fmask = np.zeros((Q, packed.doc_pad), dtype=bool)
-        for qi, plan in enumerate(plans):
-            fmask[qi, : seg.doc_count] = segment_mask(seg, plan.filt, ctx)
+        fmask = _filter_mask_matrix([plan.filt for plan in plans], seg,
+                                    packed, ctx)
         entries = _dense_entries(finals, seg, packed, field_idx)
         batch = build_term_batch(entries, Q, n_must, msm, coord_tbl,
                                  list(all_fields), caches_stack,
@@ -1045,7 +1094,6 @@ def execute_flat_sorted(plan: FlatPlan, ctx: ShardContext, k: int, spec):
 
     from ..ops.device_index import packed_for
     from ..ops.scoring import build_term_batch, score_sorted_batch
-    from .filters import segment_mask
     from .sorting import device_sort_key_row
 
     finals = [finalize_flat(plan, ctx)]
@@ -1070,8 +1118,7 @@ def execute_flat_sorted(plan: FlatPlan, ctx: ShardContext, k: int, spec):
                           breaker=ctx.breaker("fielddata"))
         fmask = None
         if plan.filt is not None:
-            fmask = np.zeros((1, packed.doc_pad), dtype=bool)
-            fmask[0, : seg.doc_count] = segment_mask(seg, plan.filt, ctx)
+            fmask = _filter_mask_matrix([plan.filt], seg, packed, ctx)
         entries = _dense_entries(finals, seg, packed, field_idx)
         batch = build_term_batch(entries, 1, n_must, msm, coord_tbl,
                                  list(all_fields), caches_stack,
@@ -1160,10 +1207,7 @@ def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
                                  nb_pad_row=packed.blk_docs.shape[0] - 1)
         fmask = None
         if plan.filt is not None:
-            from .filters import segment_mask
-
-            fmask = np.zeros((1, packed.doc_pad), dtype=bool)
-            fmask[0, : seg.doc_count] = segment_mask(seg, plan.filt, ctx)
+            fmask = _filter_mask_matrix([plan.filt], seg, packed, ctx)
         scores, docs, tq, counts, stats, bcounts = score_agg_batch(
             packed, batch, k, stack, tuple(pair_args), fmask=fmask)
         totals += tq

@@ -1,0 +1,260 @@
+"""Shard request cache: fingerprint of the normalized request + point-in-time
+view → serialized partial shard response.
+
+Analogue of the reference's shard request cache (indices/cache/query/
+IndicesQueryCache hung off the layer-1 recycled/paged-array + breaker
+substrate): at millions-of-users scale the hottest queries repeat, and the
+cheapest device launch is the one never dispatched. A hit in
+`actions._s_query_phase` returns the stored partial BEFORE
+`execute_query_phase` runs — zero device launches, zero device syncs, zero
+kernel work; only the fetch phase (hydrating the global winners) still runs.
+
+Semantics (reference parity):
+
+- **Key** = (index, shard, searcher view version, fingerprint). The view
+  version advances whenever the engine installs a new point-in-time Searcher
+  (refresh with changes / merge / optimize / recovery), so a cached partial
+  can never outlive the segment view it was computed against — the NRT
+  invariant "search results cannot change without a refresh" is exactly what
+  makes view-keyed caching sound. The fingerprint is a stable
+  re-serialization of the normalized request body (sorted keys, volatile
+  knobs stripped), covering query/filter/from/size/sort/aggs — the (k, from,
+  agg signature) of the partial.
+- **Default scope**: only `size == 0` requests (counts, agg-only dashboards)
+  are cached unless the request opts in with `?request_cache=true`;
+  `?request_cache=false` opts out entirely; `indices.requests.cache.enable`
+  kills the tier node-wide. This is the reference's rule — hit-bearing pages
+  are personal, count/agg rollups are shared.
+- **Value** = the partial shard response serialized through the binary wire
+  codec (common/stream.py) — the same bytes that would cross the transport,
+  so breaker accounting is honest and a hit hands back an isolated copy (no
+  shared mutable state between requests).
+- **Accounting**: every stored entry's bytes are charged on the node's
+  `request` breaker and held until the entry is evicted/invalidated/cleared
+  — `POST /_cache/clear?request=true` drains the tier back to 0. A breaker
+  trip at store time skips caching (counted), never fails the search.
+- **Bounds**: LRU over `indices.requests.cache.size` (ratio of the breaker
+  budget or absolute bytes; default 1%).
+- **Invalidation**: the engine's view listeners call `invalidate_shard` on
+  every searcher install, dropping entries from superseded views eagerly
+  (the view component of the key already makes them unreachable — eager
+  invalidation is what returns their bytes).
+
+Lock discipline (PR 6): `_lock` is a LEAF — only dict/counter mutation
+happens under it; serialization happens before `put` is called, breaker
+release happens after the lock is dropped, and nothing under the lock ever
+blocks or dispatches device work.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from collections import OrderedDict
+
+from ..common.errors import CircuitBreakingError
+from ..common.units import parse_ratio_or_bytes
+
+# request-body keys that must not change the cache identity: execution knobs
+# (profiling, tracing, the cache flag itself, the time budget) select HOW a
+# request runs, not WHAT it computes
+_VOLATILE_KEYS = ("profile", "request_cache", "timeout")
+
+
+def request_fingerprint(body: dict | None) -> str:
+    """Stable fingerprint of a normalized search body: canonical JSON
+    re-serialization (sorted keys, compact separators) of the body minus
+    volatile execution knobs, hashed. Two dicts that differ only in key
+    order — or in profile/timeout/request_cache flags — fingerprint
+    identically; any semantic difference (query, filter, from/size, sort,
+    aggs, suggest) changes it."""
+    core = {k: v for k, v in (body or {}).items() if k not in _VOLATILE_KEYS}
+    blob = json.dumps(core, sort_keys=True, separators=(",", ":"),
+                      default=str)
+    return hashlib.blake2b(blob.encode("utf-8"), digest_size=16).hexdigest()
+
+
+def cache_policy(body: dict | None) -> bool:
+    """Whether a request body is request-cache ELIGIBLE (the reference's
+    rule): explicit `request_cache: true` always, explicit false never,
+    otherwise only size == 0 requests (counts / agg-only). The ONE policy
+    shared by the shard serving path and the coordinator's cache-affinity
+    routing — drift between them would route for a cache the shard never
+    consults."""
+    body = body or {}
+    explicit = body.get("request_cache")
+    if explicit is not None:
+        return bool(explicit)
+    try:
+        return int(body.get("size", 10) or 0) == 0
+    except (TypeError, ValueError):
+        return False
+
+
+class ShardRequestCache:
+    """Node-level LRU of serialized partial shard responses.
+
+    Thread-safe; `_lock` is a leaf (see module docstring). Counter attributes
+    are plain ints read unlocked by the load-signal piggyback — exact enough
+    for a decayed routing signal, and the serving path gains no locks."""
+
+    # per-entry bookkeeping overhead charged beyond the value bytes (key
+    # tuple, OrderedDict node, breaker slack)
+    ENTRY_OVERHEAD = 256
+
+    def __init__(self, settings=None, breaker=None,
+                 total_budget: int = 8 << 30):
+        from ..common.settings import Settings
+
+        settings = settings or Settings.EMPTY
+        self.enabled = bool(
+            settings.get_bool("indices.requests.cache.enable", True))
+        self.size_bytes = int(parse_ratio_or_bytes(
+            settings.get("indices.requests.cache.size"), int(total_budget),
+            default="1%"))
+        self.breaker = breaker
+        self._lock = threading.Lock()
+        # key -> (data bytes, charged size); OrderedDict insertion order IS
+        # the LRU order (move_to_end on hit)
+        self._entries: "OrderedDict[tuple, tuple[bytes, int]]" = OrderedDict()
+        # secondary index (index, shard) -> {keys}: invalidation runs on
+        # EVERY searcher install of every shard, under the engine lock — it
+        # must touch only that shard's entries, not scan the node-wide LRU
+        # (150k+ entries at default sizing) while holding the serving lock
+        self._by_shard: dict[tuple, set] = {}
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.stores = 0
+        self.evictions = 0
+        self.invalidations = 0
+        self.rejections = 0  # stores skipped on breaker trip / oversize
+
+    # -- lookup --------------------------------------------------------------
+    def get(self, key: tuple) -> bytes | None:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return entry[0]
+
+    def peek(self, key: tuple) -> bool:
+        """Presence check WITHOUT hit/miss accounting or LRU touch — the
+        profiled path records what would have happened without perturbing
+        the stats the unprofiled traffic builds."""
+        with self._lock:
+            return key in self._entries
+
+    # -- store ---------------------------------------------------------------
+    def put(self, key: tuple, data: bytes) -> bool:
+        """Store one serialized partial. Charges the request breaker BEFORE
+        insertion (estimate-before-allocate); a trip or an oversized value
+        skips caching and counts a rejection. Returns True when stored."""
+        size = len(data) + self.ENTRY_OVERHEAD
+        if size > self.size_bytes:
+            self.rejections += 1
+            return False
+        if self.breaker is not None:
+            try:
+                self.breaker.add_estimate_and_maybe_break(
+                    size, "<request_cache>")
+            except CircuitBreakingError:
+                self.rejections += 1
+                return False
+        released = 0
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+                released += old[1]
+            self._entries[key] = (data, size)
+            self._by_shard.setdefault(key[:2], set()).add(key)
+            self._bytes += size
+            while self._bytes > self.size_bytes and len(self._entries) > 1:
+                k, (_d, sz) = self._entries.popitem(last=False)
+                self._drop_index_locked(k)
+                self._bytes -= sz
+                released += sz
+                self.evictions += 1
+            self.stores += 1
+        if released and self.breaker is not None:
+            self.breaker.release(released)  # outside the leaf lock
+        return True
+
+    # -- invalidation --------------------------------------------------------
+    def _drop_index_locked(self, key: tuple):
+        keys = self._by_shard.get(key[:2])
+        if keys is not None:
+            keys.discard(key)
+            if not keys:
+                del self._by_shard[key[:2]]
+
+    def invalidate_shard(self, index: str, shard_id: int,
+                         current_view: int | None) -> int:
+        """Drop every entry of (index, shard) whose view is not
+        `current_view` (None = drop all, the shard is going away). Called by
+        the engine's view listeners on every searcher install (UNDER the
+        engine lock) and by shard removal — the per-shard key index keeps
+        this proportional to the shard's own entries, never a scan of the
+        node-wide LRU while holding the serving leaf lock. Returns the
+        number of entries dropped."""
+        released = 0
+        dropped = 0
+        with self._lock:
+            shard_keys = self._by_shard.get((index, shard_id))
+            for k in [k for k in (shard_keys or ())
+                      if current_view is None or k[2] != current_view]:
+                _d, sz = self._entries.pop(k)
+                self._drop_index_locked(k)
+                self._bytes -= sz
+                released += sz
+                dropped += 1
+            self.invalidations += dropped
+        if released and self.breaker is not None:
+            self.breaker.release(released)
+        return dropped
+
+    def clear(self, index: str | None = None) -> int:
+        """`POST /_cache/clear?request=true`: drop all entries (or one
+        index's); the breaker drains by exactly the released bytes."""
+        released = 0
+        dropped = 0
+        with self._lock:
+            keys = [k for k in self._entries
+                    if index is None or k[0] == index]
+            for k in keys:
+                _d, sz = self._entries.pop(k)
+                self._drop_index_locked(k)
+                self._bytes -= sz
+                released += sz
+                dropped += 1
+        if released and self.breaker is not None:
+            self.breaker.release(released)
+        return dropped
+
+    # -- observability -------------------------------------------------------
+    def hit_rate(self) -> float:
+        """Lifetime hit rate from plain attribute reads (the load-signal
+        piggyback reads this unlocked on the serving path)."""
+        n = self.hits + self.misses
+        return (self.hits / n) if n else 0.0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "memory_size_in_bytes": self._bytes,
+                "limit_size_in_bytes": self.size_bytes,
+                "entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "stores": self.stores,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+                "rejections": self.rejections,
+                "hit_rate": round(self.hit_rate(), 4),
+            }

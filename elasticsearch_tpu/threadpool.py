@@ -15,6 +15,8 @@ periodic maintenance.
 
 from __future__ import annotations
 
+import heapq
+import itertools
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -88,6 +90,33 @@ _DEFAULT_QUEUES = {
     "search_batcher": -1,
 }
 _DEFAULT_QUEUE_SIZE = 1000
+
+
+class ScheduledTimer:
+    """Handle for one entry on the shared timer wheel — the
+    threading.Timer-compatible surface (cancel/finished/is_alive/join) the
+    serving path relies on, with no thread of its own. `finished` is set by
+    cancel() OR by the wheel at fire time, so `is_alive()` means "may still
+    fire", exactly like the stdlib Timer's contract."""
+
+    __slots__ = ("deadline", "pool", "fn", "finished")
+
+    def __init__(self, deadline: float, pool: str, fn):
+        self.deadline = deadline
+        self.pool = pool
+        self.fn = fn
+        self.finished = threading.Event()
+
+    def cancel(self):
+        self.finished.set()
+
+    def is_alive(self) -> bool:
+        return not self.finished.is_set()
+
+    def join(self, timeout=None):
+        """threading.Timer parity: wait until the timer can no longer fire
+        (there is no per-timer thread to join)."""
+        self.finished.wait(timeout)
 
 
 class _ScheduledTask:
@@ -189,13 +218,23 @@ class ThreadPool:
                 _DEFAULT_QUEUES.get(name, _DEFAULT_QUEUE_SIZE))
             self._pools[name] = _BoundedPool(name, size, queue_size)
         self._scheduler_tasks: list[_ScheduledTask] = []
-        # one-shot schedule() timers, tracked so shutdown can cancel them —
-        # a timer surviving the node fires its callback into dead services
-        self._timers: set[threading.Timer] = set()
-        self._timers_lock = threading.Lock()
+        # one-shot schedule() timers ride a shared TIMER WHEEL (one heap, one
+        # thread) instead of a threading.Timer per call: every search
+        # schedules 1-2 timers (attempt timeout, hedge delay) and a Timer is
+        # a whole OS thread — ~1ms of spawn per timer, which on the
+        # request-cache HIT path was the single largest remaining cost.
+        # Shutdown still cancels everything (a timer surviving the node
+        # would fire its callback into dead services).
+        self._timer_heap: list[tuple[float, int, ScheduledTimer]] = []
+        self._timer_seq = itertools.count()
+        self._timer_cv = threading.Condition()
         self._scheduler_thread = threading.Thread(target=self._scheduler_loop, daemon=True, name="estpu[scheduler]")
         self._shutdown = threading.Event()
         self._scheduler_thread.start()
+        self._timer_thread = threading.Thread(target=self._timer_loop,
+                                              daemon=True,
+                                              name="estpu[timers]")
+        self._timer_thread.start()
 
     # execution --------------------------------------------------------------
     def executor(self, name: str) -> ThreadPoolExecutor:
@@ -215,35 +254,61 @@ class ThreadPool:
         return self._pools[name].submit(fn, *args, **kwargs)
 
     # scheduling -------------------------------------------------------------
-    def schedule(self, delay_s: float, name: str, fn) -> threading.Timer:
-        def fire():
-            with self._timers_lock:
-                self._timers.discard(t)
-            if self._shutdown.is_set():
-                return
-            try:
-                self.submit(name, fn)
-            except RejectedExecutionError:
-                pass  # timer work is droppable when the node is saturated/closed
-
-        t = threading.Timer(delay_s, fire)
-        t.daemon = True
-        with self._timers_lock:
+    def schedule(self, delay_s: float, name: str, fn) -> "ScheduledTimer":
+        """One-shot timer on the shared wheel. Returns a handle with the
+        threading.Timer surface the callers use (cancel/finished/is_alive/
+        join) but NO thread of its own — cancellation is lazy (the wheel
+        drops cancelled heads when it reaches them), which bounds heap
+        growth to the outstanding-timer count."""
+        t = ScheduledTimer(time.monotonic() + max(0.0, float(delay_s)),
+                           name, fn)
+        with self._timer_cv:
             if self._shutdown.is_set():
                 t.cancel()
                 return t
-            # prune finished/cancelled timers so heavy schedule() users
-            # (per-attempt query timers) don't grow the set unboundedly.
-            # NOT bare is_alive(): a concurrently-added timer between its
-            # Timer() and start() reads not-alive and would be pruned
-            # untracked — `finished` is only set by cancel() or completion,
-            # so not-started timers survive the prune (start() is under the
-            # same lock anyway, closing the window entirely)
-            self._timers = {x for x in self._timers
-                            if x.is_alive() or not x.finished.is_set()}
-            self._timers.add(t)
-            t.start()
+            heapq.heappush(self._timer_heap,
+                           (t.deadline, next(self._timer_seq), t))
+            self._timer_cv.notify()
         return t
+
+    def _timer_loop(self):
+        """The wheel: sleep until the earliest live deadline, then fire it.
+        The submit happens OUTSIDE the condition (pool locks are the
+        submit's own; the cv stays a leaf); waits are always timed."""
+        while True:
+            with self._timer_cv:
+                while not self._shutdown.is_set():
+                    # lazily drop cancelled heads so they neither delay the
+                    # wakeup math nor accumulate
+                    while self._timer_heap and \
+                            self._timer_heap[0][2].finished.is_set():
+                        heapq.heappop(self._timer_heap)
+                    now = time.monotonic()
+                    if self._timer_heap and self._timer_heap[0][0] <= now:
+                        break
+                    self._timer_cv.wait(
+                        min(self._timer_heap[0][0] - now, 60.0)
+                        if self._timer_heap else 60.0)
+                if self._shutdown.is_set():
+                    return
+                _deadline, _seq, t = heapq.heappop(self._timer_heap)
+            if t.finished.is_set():
+                continue  # cancelled between pop and fire
+            t.finished.set()
+            if self._shutdown.is_set():
+                return
+            try:
+                self.submit(t.pool, t.fn)
+            except RejectedExecutionError:
+                pass  # timer work is droppable when the node is saturated/closed
+            except Exception:  # noqa: BLE001 — ONE bad timer (unknown pool
+                # name, a submit-time failure) must not kill the shared wheel
+                # thread: with the wheel dead, no attempt-timeout or hedge
+                # timer ever fires again node-wide. The per-timer
+                # threading.Timer design isolated such failures to one timer;
+                # the wheel keeps that property by containing them here.
+                logger.warning("timer fire failed (pool=%s)", t.pool,
+                               exc_info=True)
 
     def schedule_with_fixed_delay(self, interval_s: float, fn, name: str = "generic") -> _ScheduledTask:
         task = _ScheduledTask(interval_s, fn, lambda f: self.submit(name, f))
@@ -275,11 +340,13 @@ class ThreadPool:
         # cancel outstanding one-shot timers BEFORE closing the pools: a timer
         # firing after shutdown would submit into a dead executor (harmless)
         # or, worse, run a callback against torn-down services
-        with self._timers_lock:
-            timers, self._timers = list(self._timers), set()
-        for t in timers:
+        with self._timer_cv:
+            heap, self._timer_heap = self._timer_heap, []
+            self._timer_cv.notify_all()
+        for _deadline, _seq, t in heap:
             t.cancel()
         self._scheduler_thread.join(timeout=1.0)
+        self._timer_thread.join(timeout=1.0)
         for pool in self._pools.values():
             pool.executor.shutdown(wait=False, cancel_futures=True)
 

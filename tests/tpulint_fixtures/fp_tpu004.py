@@ -69,3 +69,28 @@ class Hierarchy:
     def _add_from_child(self, n):
         with self._lock:
             self.used += n
+
+
+class FilterMaskCacheRight:
+    """The build-outside/publish-under idiom (ISSUE 11 filter-mask cache):
+    the mask build and its device_put happen with NO lock held; only the
+    dict publish takes the leaf lock. Nothing here may fire."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._masks = {}
+
+    def store_mask(self, key, host_mask):
+        import jax
+
+        row = jax.device_put(host_mask)  # transfer outside any lock
+        with self._lock:
+            winner = self._masks.get(key)
+            if winner is None:
+                self._masks[key] = row
+                winner = row
+        return winner
+
+    def lookup(self, key):
+        with self._lock:
+            return self._masks.get(key)

@@ -54,3 +54,23 @@ class Service:
     def score_under_lock(self, x):
         with self._b:
             return self._score(x)  # TP: dispatch reached via helper
+
+
+class FilterMaskCacheWrong:
+    """The cache-publish anti-idiom (ISSUE 11): device_put of a freshly built
+    filter mask UNDER the publish lock — the transfer (and any dispatch it
+    implies) serializes every concurrent lookup behind HBM traffic. The
+    correct shape (build + device_put outside, publish under) is pinned
+    clean in fp_tpu004.py."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._masks = {}
+
+    def store_mask(self, key, host_mask):
+        with self._lock:
+            import jax
+
+            row = jax.device_put(host_mask)  # TP: device transfer under the publish lock
+            self._masks[key] = row
+        return row

@@ -90,6 +90,18 @@ def main() -> int:
                 body={"query": {"match": {"body": "alpha1"}}})
         assert "trace" in r.body
 
+        # multi-tier caching: drive one hot size==0 query (miss+store then
+        # hit) and one filtered query to its second sighting, then assert
+        # both tiers report everywhere they should
+        hot = {"query": {"match": {"body": "alpha1"}}, "size": 0}
+        filt = {"query": {"filtered": {
+            "query": {"match": {"body": "alpha1"}},
+            "filter": {"term": {"n": 3}}}}, "size": 3}
+        for body in (hot, hot, filt, filt, filt):
+            get("/smoke/_search", method="POST", body=body)
+        rc_stats = node.request_cache.stats()
+        assert rc_stats["hits"] >= 1 and rc_stats["stores"] >= 1, rc_stats
+
         r = get("/_prometheus/metrics")
         _parse_prometheus(r.body)
         assert "estpu_traces_ring_evicted_total" in r.body
@@ -99,6 +111,20 @@ def main() -> int:
                     "estpu_search_hedges_budget_exhausted_total",
                     "estpu_routing_probes_total",
                     "estpu_routing_quarantined"):
+            assert fam in r.body, fam
+        # cache tiers: both Prometheus families present (contiguity is
+        # enforced for every family by the parser above)
+        for fam in ("estpu_request_cache_hits_total",
+                    "estpu_request_cache_misses_total",
+                    "estpu_request_cache_stores_total",
+                    "estpu_request_cache_evictions_total",
+                    "estpu_request_cache_bytes",
+                    "estpu_request_cache_entries",
+                    "estpu_filter_cache_hits_total",
+                    "estpu_filter_cache_misses_total",
+                    "estpu_filter_cache_builds_total",
+                    "estpu_filter_cache_evictions_total",
+                    "estpu_filter_cache_bytes"):
             assert fam in r.body, fam
 
         r = get("/_traces")
@@ -116,6 +142,24 @@ def main() -> int:
         assert ar is not None and "hedges" in ar and "copies" in ar, ar
         for key in ("issued", "won", "budget_exhausted", "tokens"):
             assert key in ar["hedges"], ar["hedges"]
+        # cache tiers under the indices section (nodes.<id>.indices.*_cache)
+        for tier in ("request_cache", "filter_cache"):
+            t = sections["indices"].get(tier)
+            assert t is not None, sorted(sections["indices"])
+            for key in ("memory_size_in_bytes", "hits", "misses",
+                        "evictions", "hit_rate"):
+                assert key in t, (tier, key)
+        assert sections["indices"]["request_cache"]["hits"] >= 1
+
+        # POST /_cache/clear drains both tiers back to zero resident bytes
+        r = get("/_cache/clear", method="POST",
+                params={"request": "true", "filter": "true"})
+        assert r.body["_shards"]["successful"] >= 1, r.body
+        assert node.request_cache.stats()["memory_size_in_bytes"] == 0
+        assert node.filter_cache.stats()["memory_size_in_bytes"] == 0
+        # and the node still answers afterward
+        r = get("/smoke/_search", method="POST", body=hot)
+        assert r.body["hits"]["total"] > 0
 
         r = get("/_cat")
         cats = [line.rsplit("/", 1)[1] for line in r.body.split()

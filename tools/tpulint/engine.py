@@ -51,7 +51,12 @@ HOT_FILES = ("elasticsearch_tpu/search/execute.py",
              # per-copy health tracker must never grow a device pull or an
              # implicit transfer (they run per shard request, pre-dispatch)
              "elasticsearch_tpu/cluster/routing.py",
-             "elasticsearch_tpu/cluster/stats.py")
+             "elasticsearch_tpu/cluster/stats.py",
+             # the shard request cache sits BEFORE every query phase: its
+             # lookup/store must stay pure host dict work (no device traffic,
+             # no blocking under its leaf lock); the filter-mask tier lives in
+             # ops/device_index.py (already hot via the prefix)
+             "elasticsearch_tpu/search/request_cache.py")
 PLATFORM_EXEMPT = ("elasticsearch_tpu/common/jaxenv.py",)
 
 _SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*ignore(?:\[([A-Z0-9, ]+)\])?")
