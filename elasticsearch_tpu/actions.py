@@ -28,8 +28,10 @@ import uuid
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FutureTimeoutError
 
+from .common import insights as _insights
 from .common import profile as profiling
 from .common import tracing
+from .common.units import parse_time
 from .common.deadline import NO_DEADLINE, Deadline
 from .common.metrics import HistogramMetric
 from .common.retry import RetryPolicy
@@ -37,6 +39,7 @@ from .common.errors import (
     ActionNotFoundError,
     CircuitBreakingError,
     DocumentMissingError,
+    IllegalArgumentError,
     IndexAlreadyExistsError,
     IndexMissingError,
     MasterNotDiscoveredError,
@@ -111,6 +114,10 @@ A_FETCH_PHASE = "indices:data/read/search[phase/fetch]"
 A_FREE_CONTEXT = "indices:data/read/search[free-context]"
 A_DFS_PHASE = "indices:data/read/search[phase/dfs]"
 A_SHARD_BROADCAST = "indices:admin/broadcast[s]"
+# stall-watchdog event gossip (common/events.py): a node's warn events are
+# pushed best-effort to every peer's journal so any coordinator's /_events
+# shows the cluster-wide causal record
+A_EVENTS_PUBLISH = "internal:cluster/events/publish"
 
 
 def _normalize_alias_specs(aliases: dict) -> dict:
@@ -177,6 +184,11 @@ class ActionModule:
         from .search.service import SearchAdmissionController
 
         self.admission = SearchAdmissionController()
+        # parsed cluster-level slowlog thresholds, cached against the
+        # metadata version that produced them: the unset-thresholds default
+        # must not rebuild the flattened settings dict per query phase
+        # (plain attr, single value — a benign race rebuilds once)
+        self._slowlog_cluster: tuple | None = None
         # end-to-end coordinator search latency (accept -> response assembled):
         # the histogram behind /_nodes/stats search.latency percentiles and
         # the Prometheus estpu_search_latency_seconds series
@@ -227,6 +239,16 @@ class ActionModule:
         t.register_handler(A_CLIENT_EXEC, self._s_client_exec, executor="generic")
         t.register_handler(A_SHUTDOWN_NODE, self._s_shutdown_node,
                            executor="management")
+        t.register_handler(A_EVENTS_PUBLISH, self._s_event_publish,
+                           executor="management")
+
+    def _s_event_publish(self, request, channel):
+        """Gossip ingestion: a peer's watchdog event lands in this node's
+        journal, dedup'd by origin seq (common/events.EventJournal.ingest)."""
+        journal = getattr(self.node, "events", None)
+        stored = journal.ingest(request.get("event") or {}) \
+            if journal is not None else False
+        return {"stored": stored}
 
     # ================= node shutdown =================
     def nodes_shutdown(self, node_ids=None, delay_s: float = 0.2) -> dict:
@@ -1566,6 +1588,16 @@ class ActionModule:
                                              "dfs_query_and_fetch"),
             deadline=deadline)
         if mesh_results is not None:
+            # mesh-served searches never reach _s_query_phase, so the
+            # query-shape classification happens HERE instead (one record per
+            # search, outcome mesh_spmd, latency from the t0 this method
+            # already read) — "classify every search" includes the SPMD path
+            insights_reg = getattr(self.node, "insights", None)
+            if insights_reg is not None and insights_reg.enabled:
+                sid, shape = insights_reg.fingerprint(body)
+                obs = _insights.Observation()
+                obs.outcome = "mesh_spmd"
+                insights_reg.record(sid, shape, time.monotonic() - t0, obs)
             node_local = state.nodes.get(self.node.local_node.id)
             shard_meta = {o: (copy.index, copy.shard_id, node_local,
                               mesh_results[o].context_id)
@@ -2209,6 +2241,16 @@ class ActionModule:
                 and request.get("dfs") is None and cache_policy(body)):
             cache_key = (index, shard_id, ctx.searcher.version,
                          request_fingerprint(body))
+        # ---- always-on query-shape insights (common/insights.py) ----------
+        # EVERY search classifies into a bounded registry of normalized plan
+        # shapes — one canonicalization + hash per request (the same cost
+        # class as the request-cache fingerprint above), zero added clocks
+        # (latency reuses the slowlog's t_q pair below; the cache-hit path
+        # records count + hit attribution only, reading no clock at all)
+        insights_reg = getattr(self.node, "insights", None)
+        shape_id = shape = None
+        if insights_reg is not None and insights_reg.enabled:
+            shape_id, shape = insights_reg.fingerprint(body)
         if cache_key is not None:
             if prof is None:
                 data = rcache.get(cache_key)
@@ -2217,6 +2259,8 @@ class ActionModule:
                         shard_span.tag(request_cache="hit")
                     finally:
                         shard_span.end()
+                    if shape_id is not None:
+                        insights_reg.record(shape_id, shape, cache="hit")
                     out = _decode_cached_partial(data)
                     out["ctx_id"] = self._pin_context(index, shard_id, ctx)
                     out["load"] = self._load_signal()
@@ -2228,20 +2272,39 @@ class ActionModule:
                 prof.event("request_cache",
                            cache="hit" if peek_hit else "miss")
         t_q = time.monotonic()
+        obs = _insights.Observation() if shape_id is not None else None
         try:
             with tracing.activate(shard_span):
-                if prof is None:
-                    result = execute_query_phase(ctx, req, shard_id=shard_id,
-                                                 deadline=deadline)
+                if obs is not None:
+                    with _insights.activate(obs):
+                        result = self._execute_qp(ctx, req, shard_id,
+                                                  deadline, prof)
                 else:
-                    with profiling.activate(prof):
-                        result = execute_query_phase(ctx, req,
-                                                     shard_id=shard_id,
-                                                     deadline=deadline)
+                    result = self._execute_qp(ctx, req, shard_id, deadline,
+                                              prof)
+        except Exception:
+            # a failing shape still classifies (outcome "error"): a query
+            # shape storming a breaker/deadline must show in
+            # /_insights/queries precisely when the operator needs it
+            if shape_id is not None:
+                obs.outcome = "error"
+                insights_reg.record(
+                    shape_id, shape, time.monotonic() - t_q, obs,
+                    cache="miss" if cache_key is not None else None)
+            raise
         finally:
             shard_span.end()
-        self._maybe_slowlog(index, shard_id, body, (time.monotonic() - t_q),
-                            trace=trace)
+        took_s = time.monotonic() - t_q
+        if shape_id is not None:
+            # profiled runs that found the entry present (peek) attribute a
+            # hit even though profiling re-executed — same rule as the
+            # profile event above
+            insights_reg.record(
+                shape_id, shape, took_s, obs,
+                cache=("hit" if peek_hit else "miss")
+                if cache_key is not None else None)
+        self._maybe_slowlog(index, shard_id, body, took_s,
+                            trace=trace, shape_id=shape_id)
         partial = {
             "total": result.total,
             "docs": [[s, d, sv] for (s, d, sv) in result.docs],
@@ -2282,6 +2345,17 @@ class ActionModule:
             out["profile"] = prof.to_dict()
         return out
 
+    @staticmethod
+    def _execute_qp(ctx, req, shard_id: int, deadline, prof):
+        """One shard query phase, with the profiler activated only when the
+        request opted in (profile.py rule: activate(None) is never entered)."""
+        if prof is None:
+            return execute_query_phase(ctx, req, shard_id=shard_id,
+                                       deadline=deadline)
+        with profiling.activate(prof):
+            return execute_query_phase(ctx, req, shard_id=shard_id,
+                                       deadline=deadline)
+
     def _load_signal(self) -> dict:
         """The query-phase response's piggybacked load sample: search-pool
         queue depth + request-breaker headroom fraction, read as plain
@@ -2303,31 +2377,63 @@ class ActionModule:
                 else 0.0
         return out
 
+    def _cluster_slowlog_levels(self, md) -> dict:
+        """Parsed cluster-level slowlog thresholds {level: seconds|None},
+        rebuilt only when the metadata version moves — the shipped default
+        (no thresholds anywhere) costs one attr read + version compare per
+        query phase, never a settings-dict flatten."""
+        cached = self._slowlog_cluster
+        if cached is not None and cached[0] == md.version:
+            return cached[1]
+        flat = dict(md.persistent_settings)
+        flat.update(dict(md.transient_settings))
+        levels: dict = {}
+        for level in ("warn", "info", "debug"):
+            raw = flat.get(f"index.search.slowlog.threshold.query.{level}")
+            value = None
+            if raw is not None:
+                try:
+                    value = parse_time(raw)
+                except IllegalArgumentError:
+                    value = None
+            levels[level] = value
+        self._slowlog_cluster = (md.version, levels)
+        return levels
+
     def _maybe_slowlog(self, index: str, shard_id: int, body: dict, took_s: float,
-                       trace=None):
+                       trace=None, shape_id: str | None = None):
         """Per-shard query slowlog (ref: index/search/slowlog/
         ShardSlowLogSearchService.java:41,60-63 — warn/info/debug/trace thresholds from
-        dynamic index settings). Each line carries the trace id and the
-        queue/device/merge phase breakdown so a slow entry is directly
-        joinable to `GET /_traces` (zeros + trace[-] when the request was
-        unsampled)."""
-        meta = self.cluster_service.state.metadata.index(index)
+        dynamic index settings). Each line carries the trace id, the
+        query-shape fingerprint (joinable to `GET /_insights/queries` exactly
+        the way the trace id joins `/_traces`), and the queue/device/merge
+        phase breakdown (zeros + trace[-] when the request was unsampled).
+
+        Thresholds resolve index settings first, then the CLUSTER transient/
+        persistent settings — so `PUT /_cluster/settings` arms the slowlog
+        fleet-wide at runtime, no node restart (transient wins over
+        persistent, per-index settings win over both)."""
+        md = self.cluster_service.state.metadata
+        meta = md.index(index)
         if meta is None:
             return
         settings = meta.settings
+        cluster_levels = self._cluster_slowlog_levels(md)
         for level, log in (("warn", self.logger.warning), ("info", self.logger.info),
                            ("debug", self.logger.debug)):
-            threshold = settings.get_time(
-                f"index.search.slowlog.threshold.query.{level}", None)
+            key = f"index.search.slowlog.threshold.query.{level}"
+            threshold = settings.get_time(key, None)
+            if threshold is None:
+                threshold = cluster_levels.get(level)
             if threshold is not None and threshold >= 0 and took_s >= threshold:
                 # breakdown only on a threshold hit: phase_breakdown copies
                 # the span list under the trace lock — with thresholds unset
                 # (the default) a sampled query must not pay that per call
                 phases = tracing.phase_breakdown(trace)
                 trace_id = trace.trace_id if trace else "-"
-                log("slowlog [%s][%d] took[%.1fms] trace[%s] queue[%.1fms] "
-                    "device[%.1fms] merge[%.1fms] source[%s]",
-                    index, shard_id, took_s * 1000, trace_id,
+                log("slowlog [%s][%d] took[%.1fms] trace[%s] shape[%s] "
+                    "queue[%.1fms] device[%.1fms] merge[%.1fms] source[%s]",
+                    index, shard_id, took_s * 1000, trace_id, shape_id or "-",
                     phases["queue_ms"], phases["device_ms"],
                     phases["merge_ms"], str(body)[:500])
                 return

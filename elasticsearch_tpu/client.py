@@ -41,7 +41,7 @@ IDEMPOTENT_METHODS = frozenset({
     "get_alias", "get_template", "get_warmer", "cluster_health",
     "cluster_state", "cluster_get_settings", "pending_tasks", "nodes_info",
     "nodes_stats", "stats", "indices_status", "get_snapshots", "get_repository",
-    "snapshot_status", "cluster_stats",
+    "snapshot_status", "cluster_stats", "node_events", "cluster_events",
 })
 
 # the proxied API surface — one entry per transport-action proxy the reference's

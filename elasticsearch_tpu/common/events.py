@@ -1,0 +1,389 @@
+"""Cluster event journal + serving-path stall watchdog.
+
+The reference dedicates whole subsystems to "what just went wrong on this
+node" (pending-tasks, cluster health, the slowlog); what none of them give an
+operator is a *causal record* of serving-path stalls: the batcher's drainer
+wedged on a device pull, a pool's queue-wait p99 exploding, a breaker parked
+just under its trip line, a lock held across something slow. This module is
+that record:
+
+- **EventJournal** — a bounded, rate-limited ring of typed events on each
+  node. Every event carries (seq, epoch ts, node, type, severity, message,
+  attrs); per-(type, key) rate limiting keeps a sustained condition from
+  storming the ring (suppressed emissions are counted, never silently
+  dropped). Remote events gossiped from other nodes land in the same ring
+  (dedup'd by origin seq), so `GET /_events` on any node reads a
+  cluster-wide, human-readable causal record.
+- **StallWatchdog** — a management-pool periodic task comparing live
+  in-flight state against *adaptive* thresholds:
+
+    batch_stall       dispatched-unmerged batch age vs the batcher's own
+                      service-time EWMA (DeviceBatcher.inflight() — a plain
+                      unlocked read of drainer-written state)
+    queue_spike       per-pool queue-wait p99 over the ticks SINCE THE LAST
+                      CHECK (delta histograms — a lifetime p99 would take
+                      minutes to notice a brown-out) vs a decayed baseline
+    breaker_pressure  a breaker dwelling >= `dwell` consecutive ticks above
+                      `high_ratio` of its limit (near-trip dwell is the
+                      overload precursor a trip counter can't show)
+    lock_stall        locktrace long-held counters growing, when
+                      ESTPU_LOCKTRACE=1 armed the tracer (off = skipped)
+
+Event type vocabulary (bounded — it is a Prometheus label):
+  batch_stall | queue_spike | breaker_pressure | lock_stall | watchdog
+
+Hot-path contract: the watchdog runs ON the management pool and reads
+serving-side state as plain attributes or through existing leaf-locked
+stats() calls — the serving path itself gains zero locks, zero clocks, zero
+syncs from any of this. The journal lock is a leaf (dict/deque mutation
+only); gossip sends happen from the watchdog tick, never a serving thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+EVENT_TYPES = ("batch_stall", "queue_spike", "breaker_pressure",
+               "lock_stall", "watchdog")
+
+
+class EventJournal:
+    """Bounded per-node ring of typed cluster events (newest kept).
+
+    `_lock` is a LEAF: deque/dict/counter mutation only — nothing under it
+    blocks, dispatches, or calls out."""
+
+    def __init__(self, settings=None, node_name: str = "node",
+                 node_id: str = "node"):
+        from .settings import Settings
+
+        settings = settings or Settings.EMPTY
+        self.node_name = node_name
+        self.node_id = node_id
+        self.size = max(8, settings.get_int("node.events.size", 256))
+        # minimum seconds between two emissions of the same (type, key):
+        # a wedged drainer must not write a 256-deep ring of one stall
+        self.throttle_s = settings.get_time("node.events.throttle", 10.0)
+        self._lock = threading.Lock()
+        self._ring: deque[dict] = deque(maxlen=self.size)
+        self._seq = 0
+        self._last_emit: dict[tuple, float] = {}  # (type, key) -> monotonic
+        self._remote_seen: dict[str, int] = {}  # origin node -> max seq
+        self.emitted = 0
+        self.suppressed = 0
+        self.remote_ingested = 0
+        self.remote_duplicates = 0
+        self.by_type: dict[str, int] = {t: 0 for t in EVENT_TYPES}
+
+    # -- write ---------------------------------------------------------------
+    def publish(self, type_: str, message: str, severity: str = "warn",
+                key: str | None = None, **attrs) -> dict | None:
+        """Emit one local event; returns the event dict, or None when the
+        (type, key) pair is inside its rate-limit window (counted)."""
+        if type_ not in EVENT_TYPES:
+            type_ = "watchdog"
+        now = time.monotonic()
+        with self._lock:
+            rk = (type_, key)
+            last = self._last_emit.get(rk)
+            if last is not None and self.throttle_s \
+                    and now - last < self.throttle_s:
+                self.suppressed += 1
+                return None
+            self._last_emit[rk] = now
+            # the rate-limit map must not grow one entry per transient key
+            # forever (batch ids are unbounded) — drop expired windows
+            if len(self._last_emit) > 4 * self.size:
+                self._last_emit = {
+                    k: v for k, v in self._last_emit.items()
+                    if now - v < (self.throttle_s or 0.0)}
+            self._seq += 1
+            event = {
+                "seq": self._seq,
+                "ts": time.time(),
+                "node": self.node_id,
+                "node_name": self.node_name,
+                "type": type_,
+                "severity": severity,
+                "message": message,
+                "attrs": attrs,
+            }
+            self._ring.append(event)
+            self.emitted += 1
+            self.by_type[type_] = self.by_type.get(type_, 0) + 1
+        return event
+
+    def ingest(self, event: dict) -> bool:
+        """A gossiped remote event lands in this node's ring (dedup'd by the
+        origin's monotonically increasing seq). Returns True when stored."""
+        if not isinstance(event, dict) or "seq" not in event:
+            return False
+        origin = str(event.get("node", "?"))
+        if origin == self.node_id:
+            return False  # our own event bounced back through the ring
+        seq = int(event["seq"])
+        stored = dict(event)
+        try:
+            # a ts-less/malformed remote event must not poison every future
+            # events() sort for the ring's lifetime — stamp arrival time
+            stored["ts"] = float(stored.get("ts") or 0.0) or time.time()
+        except (TypeError, ValueError):
+            stored["ts"] = time.time()
+        with self._lock:
+            if seq <= self._remote_seen.get(origin, 0):
+                self.remote_duplicates += 1
+                return False
+            self._remote_seen[origin] = seq
+            self._ring.append(stored)
+            self.remote_ingested += 1
+        return True
+
+    # -- read ----------------------------------------------------------------
+    def events(self, limit: int | None = None) -> list[dict]:
+        """Newest first."""
+        with self._lock:
+            out = list(self._ring)
+        out.sort(key=lambda e: -float(e.get("ts", 0.0)))
+        return out if limit is None else out[: max(limit, 0)]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "size": self.size,
+                "entries": len(self._ring),
+                "emitted": self.emitted,
+                "suppressed": self.suppressed,
+                "remote_ingested": self.remote_ingested,
+                "remote_duplicates": self.remote_duplicates,
+                "by_type": dict(self.by_type),
+            }
+
+
+class StallWatchdog:
+    """Management-pool watchdog: detects serving-path stalls from live state
+    and journals typed, rate-limited events (gossiped to the other nodes so
+    any coordinator's `/_events` shows the cluster-wide record).
+
+    All thresholds are adaptive around signals the system already maintains
+    (the batcher's service-time EWMA, each pool's queue-wait histogram, the
+    breakers' own estimates) with settable floors — a cold node with no
+    baseline falls back to the floors."""
+
+    def __init__(self, node, settings=None):
+        from .settings import Settings
+
+        settings = settings or getattr(node, "settings", None) or Settings.EMPTY
+        self.node = node
+        self.enabled = bool(settings.get_bool("watchdog.enabled", True))
+        self.interval_s = max(0.05, settings.get_time(
+            "watchdog.interval", 1.0))
+        # batch stall: age > max(min, factor x the batcher's own EWMA)
+        self.batch_factor = settings.get_float("watchdog.batch_stall_factor",
+                                               16.0)
+        self.batch_min_s = settings.get_time("watchdog.batch_stall_min",
+                                             0.5)
+        # queue spike: delta-p99 > max(min, factor x decayed baseline),
+        # needing at least min_samples completions since the last tick
+        self.queue_factor = settings.get_float("watchdog.queue_p99_factor",
+                                               4.0)
+        self.queue_min_s = settings.get_time("watchdog.queue_p99_min", 0.25)
+        self.queue_min_samples = settings.get_int(
+            "watchdog.queue_min_samples", 8)
+        # breaker dwell: >= dwell consecutive ticks above high_ratio
+        self.breaker_high = settings.get_float("watchdog.breaker_high_ratio",
+                                               0.85)
+        self.breaker_dwell = max(1, settings.get_int(
+            "watchdog.breaker_dwell_ticks", 2))
+        self.ticks = 0
+        self._task = None
+        # per-pool delta-histogram state + decayed p99 baseline
+        self._pool_counts: dict[str, list[int]] = {}
+        self._pool_totals: dict[str, int] = {}
+        self._pool_baseline: dict[str, float] = {}
+        self._breaker_dwell: dict[str, int] = {}
+        # locktrace growth watermarks
+        self._held_gets = 0
+        self._long_held = 0
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        if not self.enabled or self._task is not None:
+            return self
+        self._task = self.node.threadpool.schedule_with_fixed_delay(
+            self.interval_s, self.tick, name="management")
+        return self
+
+    def stop(self):
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    # -- the tick ------------------------------------------------------------
+    def tick(self):
+        """One watchdog pass. Runs on the management pool; every read below
+        is a plain attribute read or an existing leaf-locked stats() call —
+        never a serving-path lock, clock, or device touch."""
+        self.ticks += 1
+        try:
+            self._check_batch_stall()
+            self._check_queue_waits()
+            self._check_breakers()
+            self._check_locktrace()
+        except Exception:  # noqa: BLE001 — a broken check must not kill the
+            # schedule; the next tick retries (and the scheduler survives)
+            from .logging import get_logger
+
+            get_logger("watchdog").warning("watchdog tick failed",
+                                           exc_info=True)
+
+    def _emit(self, type_: str, message: str, key: str | None = None,
+              **attrs):
+        journal = getattr(self.node, "events", None)
+        if journal is None:
+            return
+        event = journal.publish(type_, message, key=key, **attrs)
+        if event is not None:
+            self._gossip(event)
+
+    def _gossip(self, event: dict):
+        """Best-effort push of one event to every other cluster node (their
+        journals dedup by origin seq). Fire-and-forget sends from the
+        watchdog tick — the serving path is never involved."""
+        try:
+            from ..actions import A_EVENTS_PUBLISH
+
+            state = self.node.cluster_service.state
+            for n in state.nodes.nodes:
+                if n.id == self.node.node_id:
+                    continue
+                try:
+                    self.node.transport.send_request(
+                        n, A_EVENTS_PUBLISH, {"event": event})
+                except Exception:  # noqa: BLE001 — a dropping peer is the
+                    continue       # journal's business, not the watchdog's
+        except Exception:  # noqa: BLE001 — no cluster service / shutdown race
+            pass
+
+    # -- checks --------------------------------------------------------------
+    def _check_batch_stall(self):
+        batcher = getattr(self.node, "search_batcher", None)
+        if batcher is None:
+            return
+        snap = batcher.inflight()
+        if snap is None:
+            return
+        ewma = float(getattr(batcher, "_ewma_cost", 0.0))
+        threshold = max(self.batch_min_s, self.batch_factor * ewma)
+        if snap["age_s"] <= threshold:
+            return
+        self._emit(
+            "batch_stall",
+            f"batch [{snap['batch']}] on [{snap['shard']}] dispatched "
+            f"{snap['age_s'] * 1000:.0f}ms ago and not merged "
+            f"(EWMA {ewma * 1000:.1f}ms, occupancy {snap['occupancy']})",
+            key=f"batch:{snap['batch']}",
+            batch=snap["batch"], shard=snap["shard"],
+            family=snap["family"], occupancy=snap["occupancy"],
+            age_ms=round(snap["age_s"] * 1000.0, 1),
+            ewma_ms=round(ewma * 1000.0, 3))
+
+    def _check_queue_waits(self):
+        pools = self.node.threadpool.pool_histograms()
+        for name, hist in pools.items():
+            counts, total, _sum = hist.snapshot()
+            prev_counts = self._pool_counts.get(name)
+            prev_total = self._pool_totals.get(name, 0)
+            self._pool_counts[name] = counts
+            self._pool_totals[name] = total
+            if prev_counts is None:
+                continue
+            delta_total = total - prev_total
+            if delta_total < self.queue_min_samples:
+                continue
+            delta = [c - p for c, p in zip(counts, prev_counts)]
+            p99 = hist._percentile_from(delta, delta_total, 0.99)
+            baseline = self._pool_baseline.get(name)
+            threshold = self.queue_min_s if baseline is None else \
+                max(self.queue_min_s, self.queue_factor * baseline)
+            # decayed baseline learns AFTER the comparison, so a spike can't
+            # teach itself normal within one tick
+            self._pool_baseline[name] = p99 if baseline is None else \
+                0.2 * p99 + 0.8 * baseline
+            if p99 > threshold:
+                self._emit(
+                    "queue_spike",
+                    f"pool [{name}] queue-wait p99 {p99 * 1000:.1f}ms over "
+                    f"the last tick ({delta_total} tasks; baseline "
+                    f"{(baseline or 0.0) * 1000:.1f}ms)",
+                    key=f"pool:{name}", pool=name,
+                    p99_ms=round(p99 * 1000.0, 2),
+                    baseline_ms=round((baseline or 0.0) * 1000.0, 2),
+                    tasks=delta_total)
+
+    def _check_breakers(self):
+        breakers = getattr(self.node, "breakers", None)
+        if breakers is None:
+            return
+        for name, b in breakers.stats().items():
+            limit = b.get("limit", 0) or 0
+            ratio = (b.get("estimated", 0) / limit) if limit > 0 else 0.0
+            if ratio >= self.breaker_high:
+                dwell = self._breaker_dwell.get(name, 0) + 1
+                self._breaker_dwell[name] = dwell
+                if dwell >= self.breaker_dwell:
+                    self._emit(
+                        "breaker_pressure",
+                        f"breaker [{name}] at {ratio * 100:.0f}% of its "
+                        f"limit for {dwell} watchdog periods (near-trip "
+                        f"dwell)",
+                        key=f"breaker:{name}", breaker=name,
+                        ratio=round(ratio, 4), dwell_ticks=dwell,
+                        estimated=b.get("estimated", 0),
+                        limit=limit)
+            else:
+                self._breaker_dwell[name] = 0
+
+    def _check_locktrace(self):
+        from .locktrace import TRACER
+
+        if not TRACER.enabled:
+            return
+        snap = TRACER.snapshot()
+        held = int(snap.get("held_device_gets", 0))
+        long_held = len(snap.get("long_held", ()))
+        grew_held = held - self._held_gets
+        grew_long = long_held - self._long_held
+        self._held_gets = held
+        self._long_held = long_held
+        if grew_held > 0 or grew_long > 0:
+            worst = snap.get("long_held", [])[-1] if grew_long > 0 else None
+            self._emit(
+                "lock_stall",
+                f"{grew_held} device pull(s) timed under a held lock, "
+                f"{grew_long} above the long-held threshold"
+                + (f" (worst: {worst[0]} {worst[1]}ms)" if worst else ""),
+                key="locktrace",
+                held_device_gets=held, long_held=long_held,
+                max_ms=snap.get("held_device_get_max_ms", 0.0))
+
+    def stats(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "interval_s": self.interval_s,
+            "ticks": self.ticks,
+            "thresholds": {
+                "batch_stall_factor": self.batch_factor,
+                "batch_stall_min_ms": round(self.batch_min_s * 1000.0, 1),
+                "queue_p99_factor": self.queue_factor,
+                "queue_p99_min_ms": round(self.queue_min_s * 1000.0, 1),
+                "breaker_high_ratio": self.breaker_high,
+                "breaker_dwell_ticks": self.breaker_dwell,
+            },
+            "baselines": {
+                "queue_p99_ms": {
+                    name: round(v * 1000.0, 3)
+                    for name, v in sorted(self._pool_baseline.items())},
+            },
+        }

@@ -72,6 +72,38 @@ class SanitizerReport:
         self.compile_events.append(key)
 
 
+# thread-local plan-family tag for compile attribution: the launch sites in
+# search/execute.py (and the mesh dispatch) wrap their kernel calls in
+# compile_tag("sparse"|"dense"|...), and since XLA compiles synchronously on
+# the triggering thread, the listener below can bucket every compile event by
+# the plan family that caused it — the device capacity ledger's "who is
+# eating my compile budget" signal. Fixed vocabulary, so the per-family
+# counter dict (and its Prometheus label set) is bounded by construction.
+_tag_local = threading.local()
+
+COMPILE_FAMILIES = ("sparse", "dense", "function_score", "filtered",
+                    "sorted", "aggs", "percolate", "mesh", "untagged")
+_FAMILY_SET = frozenset(COMPILE_FAMILIES)
+
+
+@contextlib.contextmanager
+def compile_tag(tag: str):
+    """Attribute backend compiles triggered inside the scope to `tag` (one
+    thread-local write per batch launch — never per posting, never per doc).
+    OUTERMOST scope wins: the workload that triggered the launch owns its
+    compiles — a percolation's inner sparse-kernel launch stays "percolate",
+    not "sparse"."""
+    prev = getattr(_tag_local, "tag", None)
+    if prev is not None:
+        yield
+        return
+    _tag_local.tag = tag if tag in _FAMILY_SET else "untagged"
+    try:
+        yield
+    finally:
+        _tag_local.tag = None
+
+
 class _CompileCounter:
     """Process-wide compile-event listener fanning out to active scopes.
 
@@ -87,14 +119,18 @@ class _CompileCounter:
         # process-lifetime compile-event count (since the listener was first
         # installed) — the Prometheus estpu_jax_compile_events_total series
         self.total = 0
+        # plan-family attribution (compile_tag): family -> count
+        self.by_family: dict = {}
 
     def _listener(self, key: str, duration: float, **_kw) -> None:
         if _COMPILE_EVENT_SUBSTR not in key:
             return
+        family = getattr(_tag_local, "tag", None) or "untagged"
         # note() under the lock: concurrent pool-thread compiles must not lose
         # increments, or a blown budget could pass silently
         with self._lock:
             self.total += 1
+            self.by_family[family] = self.by_family.get(family, 0) + 1
             for r in self._active:
                 r.note(key)
 
@@ -130,6 +166,19 @@ def compile_events_total() -> int:
     except Exception:  # noqa: BLE001 — no jax in this process: count stays 0
         pass
     return _counter.total
+
+
+def compile_events_by_family() -> dict:
+    """Process-lifetime backend-compile counts bucketed by the plan family
+    that triggered them (compile_tag scopes at the kernel launch sites) —
+    the device capacity ledger's compile attribution. Keys are drawn from
+    COMPILE_FAMILIES, so the dict (and its Prometheus label set) is bounded."""
+    try:
+        _counter.ensure_installed()
+    except Exception:  # noqa: BLE001 — no jax in this process: empty
+        pass
+    with _counter._lock:
+        return dict(_counter.by_family)
 
 
 class CompileBudgetExceeded(AssertionError):

@@ -200,6 +200,11 @@ class IndicesService:
                 # registered percolator queries die with the index
                 if self.node is not None and getattr(self.node, "percolator", None):
                     self.node.percolator.registries.pop(name, None)
+                # capacity-ledger pack history dies with the index too —
+                # per-index Prometheus label cardinality tracks LIVE indices
+                from .ops.device_index import PACK_LEDGER
+
+                PACK_LEDGER.forget(name)
                 self.logger.info("removed index [%s]", name)
         # 2. per assigned shard on this node: create + recover
         my_shards: dict[tuple, ShardRouting] = {}

@@ -110,6 +110,24 @@ class Node:
         from .common.tracing import Tracer
 
         self.tracer = Tracer(self.settings, node_name=self.name)
+        # always-on fleet telemetry (ISSUE 13): every search classifies into
+        # a bounded registry of normalized plan shapes (count/latency/queue/
+        # device histograms, outcome mix, cache hit rates — common/insights),
+        # and a bounded journal of typed stall/pressure events fed by the
+        # management-pool watchdog (common/events; started below, after the
+        # services it reads exist)
+        from .common.events import EventJournal
+        from .common.insights import QueryShapeInsights
+
+        self.insights = QueryShapeInsights(self.settings)
+        self.events = EventJournal(self.settings, node_name=self.name,
+                                   node_id=self.node_id)
+        # install the process compile listener NOW so the capacity ledger's
+        # per-family attribution covers this node's first searches (counts
+        # start at install — jaxenv._CompileCounter)
+        from .common.jaxenv import compile_events_total
+
+        compile_events_total()
         # cross-request device micro-batching: concurrent query phases on one
         # shard coalesce into one bucketed launch (search/batcher.py; wired
         # into ShardContext by ActionModule._shard_ctx and into mesh serving)
@@ -146,6 +164,14 @@ class Node:
         self.percolator = PercolatorService(self)
         self.indices.node = self
         self.monitor = MonitorService(self)
+        # stall watchdog: management-pool periodic comparing live in-flight
+        # state (dispatched-unmerged batch age, per-pool queue-wait p99,
+        # breaker near-trip dwell, locktrace long-held counters) against
+        # adaptive thresholds; typed events land in self.events and gossip
+        # to the other nodes (common/events.StallWatchdog)
+        from .common.events import StallWatchdog
+
+        self.watchdog = StallWatchdog(self, self.settings).start()
         # IndicesTTLService analogue: periodic purge of _ttl-expired docs
         self._ttl_task = self.threadpool.schedule_with_fixed_delay(
             self.settings.get_time("indices.ttl.interval", 60.0), self._purge_expired,
@@ -242,6 +268,7 @@ class Node:
             return
         self._closed = True
         self.plugins.on_node_closed(self)
+        self.watchdog.stop()
         self.rivers.stop()
         self.tribe.stop()
         self.bulk_udp.stop()
@@ -645,7 +672,24 @@ class Client:
             return False
 
     def stats(self, index=None):
-        return self.node.indices.stats()
+        """Index stats; `/{index}/_stats` REALLY filters to the resolved
+        indices now and carries each index's device capacity stanza (HBM
+        residency by tier + pack timings — ops/device_index.capacity_report)."""
+        out = self.node.indices.stats()
+        if index is not None:
+            names = set(self.node.cluster_service.state.metadata
+                        .resolve_indices(index))
+            out = {n: v for n, v in out.items() if n in names}
+        from .ops.device_index import capacity_report
+
+        # scope the segment walk to the indices this call returns — an
+        # index-scoped stats request must not walk the whole node
+        device = capacity_report(self.node.indices,
+                                 index=set(out))["indices"]
+        for name, entry in out.items():
+            if name in device:
+                entry["device"] = device[name]
+        return out
 
     def segments(self, index=None):
         """Real per-shard segment introspection (ref: indices.segments spec /
@@ -895,6 +939,70 @@ class Client:
     def pending_tasks(self):
         return {"tasks": self.node.cluster_service.pending_tasks()}
 
+    def node_events(self, size=None):
+        """THIS node's event journal (common/events.py), newest first —
+        the per-node leg `cluster_events` fans out through the proxy."""
+        return {"node": self.node.node_id, "name": self.node.name,
+                "events": self.node.events.events(size),
+                "stats": self.node.events.stats()}
+
+    def cluster_events(self, size=None, local=False):
+        """GET /_events: the cluster-wide causal event record. Each node's
+        journal already holds gossiped copies of remote warn events, but the
+        default view pulls every journal through the client-exec proxy
+        (dropping nodes skipped) and merges newest-first with origin-seq
+        dedup — lossless even when gossip was. `local=true` reads only this
+        node's ring."""
+        state = self.node.cluster_service.state
+        if local:
+            mine = self.node_events(size)
+            return {"cluster_name": state.cluster_name,
+                    "total": len(mine["events"]),
+                    "events": mine["events"],
+                    "nodes": {self.node.node_id: mine["stats"]}}
+        from .client import A_CLIENT_EXEC
+        from .transport import fut_result
+
+        merged = []
+        node_stats = {}
+        # concurrent fan-out with ONE shared deadline: /_events is read
+        # during cluster distress, so k unreachable nodes must cost one
+        # timeout, not k sequential ones (a dropping node is skipped)
+        futs = []
+        for n in state.nodes.nodes:
+            if n.id == self.node.node_id:
+                continue
+            try:
+                futs.append((n, self.node.transport.send_request(
+                    n, A_CLIENT_EXEC,
+                    {"method": "node_events", "kwargs": {"size": size}})))
+            except SearchEngineError:
+                continue
+        mine = self.node_events(size)
+        node_stats[self.node.node_id] = mine["stats"]
+        merged.extend(mine["events"])
+        collect_by = time.monotonic() + 5.0
+        for n, fut in futs:
+            try:
+                r = fut_result(fut, timeout=max(
+                    0.0, collect_by - time.monotonic()))["r"]
+            except SearchEngineError:
+                continue
+            node_stats[n.id] = r["stats"]
+            merged.extend(r["events"])
+        seen = set()
+        events = []
+        for e in sorted(merged, key=lambda ev: -float(ev.get("ts", 0.0))):
+            k = (e.get("node"), e.get("seq"))
+            if k in seen:
+                continue  # a gossiped copy of an event we pulled directly
+            seen.add(k)
+            events.append(e)
+        if size is not None:
+            events = events[: max(int(size), 0)]
+        return {"cluster_name": state.cluster_name, "total": len(events),
+                "events": events, "nodes": node_stats}
+
     def nodes_info(self):
         state = self.node.cluster_service.state
         nodes = {}
@@ -944,10 +1052,21 @@ class Client:
             "breakers": lambda: self.node.breakers.stats(),
             "admission_control": lambda: self.node.actions.admission.stats(),
             # cross-request device micro-batching + end-to-end coordinator
-            # latency percentiles (HistogramMetric — means hide the tail)
+            # latency percentiles (HistogramMetric — means hide the tail) +
+            # the always-on query-shape insights registry (search.shapes:
+            # occupancy, demotions, top shapes by cost — full entries at
+            # GET /_insights/queries)
             "search": lambda: {
                 "batcher": self.node.search_batcher.stats(),
-                "latency": self.node.actions.search_latency.stats()},
+                "latency": self.node.actions.search_latency.stats(),
+                "shapes": self.node.insights.stats()},
+            # device capacity ledger: per-index/per-segment HBM residency by
+            # tier + pack/repack timings + compile events by plan family
+            "device": self._device_section,
+            # stall watchdog + event journal occupancy
+            "events": lambda: {
+                "journal": self.node.events.stats(),
+                "watchdog": self.node.watchdog.stats()},
             "search_serving": serving_stats,
             # request-scoped tracing: sample rate, ring occupancy, in-flight
             "tracing": lambda: self.node.tracer.stats(),
@@ -972,17 +1091,65 @@ class Client:
                 "nodes": {self.node.node_id:
                           {k: build() for k, build in sections.items()}}}
 
-    def cluster_stats(self):
+    def _device_section(self):
+        """The `/_nodes/stats` `device` section: the capacity ledger walk
+        over this node's live shard searchers + the process compile rollup."""
+        from .common.jaxenv import (compile_events_by_family,
+                                    compile_events_total)
+        from .ops.device_index import capacity_report
+
+        out = capacity_report(self.node.indices)
+        out["compile"] = {"total": compile_events_total(),
+                          "by_family": compile_events_by_family()}
+        return out
+
+    def _resolve_node_ids(self, node_id):
+        """Resolve a comma list of node ids/names (`_all`/None = every node)
+        against cluster state; an unknown id is a 404 (NodeMissingError)."""
+        from .common.errors import NodeMissingError
+
+        state = self.node.cluster_service.state
+        if node_id in (None, "", "_all"):
+            return list(state.nodes.nodes)
+        out = []
+        for w in [s.strip() for s in str(node_id).split(",") if s.strip()]:
+            if w == "_local":
+                n = state.nodes.get(self.node.node_id)
+                matched = [n] if n is not None else []
+            elif w == "_master":
+                matched = [state.nodes.master] if state.nodes.master else []
+            else:
+                matched = [n for n in state.nodes.nodes
+                           if n.id == w or n.name == w]
+            if not matched:
+                raise NodeMissingError(w)
+            out.extend(matched)
+        # stable dedup (an id and its name may both appear in the list)
+        seen = set()
+        return [n for n in out if n.id not in seen and not seen.add(n.id)]
+
+    def cluster_stats(self, node_id=None):
         """ref: action/admin/cluster/stats/TransportClusterStatsAction — the
         cluster-wide rollup: index/shard/doc counts aggregated by fanning the
-        per-node stats through the client-exec proxy, node counts from state."""
+        per-node stats through the client-exec proxy, node counts from state.
+
+        `node_id` (the `/_cluster/stats/nodes/{node_id}` path param — comma
+        list of ids or names, `_all` for everything) restricts the rollup to
+        the named nodes; an unknown id is a 404, never a silent full dump."""
         from .client import A_CLIENT_EXEC
 
         state = self.node.cluster_service.state
-        shards = list(state.routing_table.all_shards())
+        wanted = self._resolve_node_ids(node_id)
+        wanted_ids = {n.id for n in wanted}
+        # unassigned shards (node_id None) belong to every "whole cluster"
+        # spelling — /_cluster/stats and /_cluster/stats/nodes/_all must
+        # agree; only a NAMED-nodes view narrows to those nodes' shards
+        all_nodes = node_id in (None, "", "_all")
+        shards = [s for s in state.routing_table.all_shards()
+                  if all_nodes or s.node_id in wanted_ids]
         doc_count = deleted = segments = 0
         per_node = {}
-        for n in state.nodes.nodes:
+        for n in wanted:
             try:
                 if n.id == self.node.node_id:
                     per_node[n.id] = self.nodes_stats()["nodes"][n.id]
@@ -1001,7 +1168,7 @@ class Client:
                     doc_count += shard.get("docs", {}).get("count", 0)
                     deleted += shard.get("docs", {}).get("deleted", 0)
                     segments += shard.get("segments", 0)
-        nodes = state.nodes.nodes
+        nodes = wanted
         count = {
             "total": len(nodes),
             "master_only": sum(1 for n in nodes if n.master_eligible and not n.data),

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field as dc_field
 
 import numpy as np
@@ -222,6 +223,183 @@ def packed_resident_bytes(packed: PackedSegment) -> int:
         if plane is not None:
             total += int(np.prod(plane.shape)) * np.dtype(plane.dtype).itemsize
     return total
+
+
+def _plane_bytes(plane) -> int:
+    return 0 if plane is None else \
+        int(np.prod(plane.shape)) * np.dtype(plane.dtype).itemsize
+
+
+def packed_tier_bytes(packed: PackedSegment) -> dict:
+    """Device-resident bytes of one packed segment broken down by TIER — the
+    device capacity ledger's taxonomy (ARCHITECTURE.md "Observability"):
+
+      postings     the quantized sparse planes (blk_docs i32 + blk_tf + blk_nb)
+      dense_plane  the lazily-faulted f32 freqs plane (0 until dense use)
+      sim_tables   the stacked per-field similarity LUTs (modes + caches)
+      agg_rows     FIFO-bounded device metric-agg stacks
+      norms        per-field norm-byte columns + live mask + dv columns
+
+    Pure host arithmetic over already-known shapes — no device sync, no
+    packing side effects. `filter_masks` is accounted separately (the holder
+    lives on the segment, not the PackedSegment — see capacity walk callers)."""
+    postings = (_plane_bytes(packed.blk_docs) + _plane_bytes(packed.blk_tf)
+                + _plane_bytes(packed.blk_nb))
+    sim = 0
+    if packed.sim is not None:
+        sim = _plane_bytes(packed.sim.caches) + _plane_bytes(packed.sim.modes)
+    agg = sum(_plane_bytes(stack) for stack in packed.agg_stacks.values())
+    norms = _plane_bytes(packed.live_parent)
+    for col in packed.norm_bytes.values():
+        norms += _plane_bytes(col)
+    for col in packed.dv_single.values():
+        norms += _plane_bytes(col)
+    return {
+        "postings": postings,
+        "dense_plane": _plane_bytes(packed.blk_freqs),
+        "sim_tables": sim,
+        "agg_rows": agg,
+        "norms": norms,
+    }
+
+
+class PackLedger:
+    """Process-wide pack/repack timing ledger, keyed by index.
+
+    `packed_for` records every segment pack (and live-mask remask) here with
+    its wall time, resident bytes, and tf layout; the capacity report joins
+    these against the live per-segment tier walk. Process-wide like
+    search/service.SERVING_COUNTERS (in-process test clusters share it);
+    bounded: at most MAX_INDICES index entries (LRU) each holding cumulative
+    counters + a RING of recent events. `_lock` is a LEAF (dict mutation
+    only) and recording happens on the already-cold pack path — the warmed
+    serving loop never touches it."""
+
+    MAX_INDICES = 256
+    RING = 16
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_index: "OrderedDict[str, dict]" = OrderedDict()
+
+    def record(self, index: str | None, gen: int, ms: float, nbytes: int,
+               layout: str, kind: str = "pack") -> None:
+        index = index or "_unattributed"
+        with self._lock:
+            entry = self._by_index.get(index)
+            if entry is None:
+                entry = {"packs": 0, "remasks": 0, "pack_ms_total": 0.0,
+                         "recent": []}
+                self._by_index[index] = entry
+                while len(self._by_index) > self.MAX_INDICES:
+                    self._by_index.popitem(last=False)
+            else:
+                self._by_index.move_to_end(index)
+            entry["packs" if kind == "pack" else "remasks"] += 1
+            entry["pack_ms_total"] += ms
+            recent = entry["recent"]
+            recent.append({"kind": kind, "generation": int(gen),
+                           "ms": round(ms, 3), "bytes": int(nbytes),
+                           "tf_layout": layout})
+            if len(recent) > self.RING:
+                del recent[: len(recent) - self.RING]
+
+    def forget(self, index: str) -> None:
+        """An index deleted from the cluster releases its ledger entry —
+        label cardinality tracks LIVE indices, not history."""
+        with self._lock:
+            self._by_index.pop(index, None)
+
+    def stats(self, index: str | None = None) -> dict:
+        with self._lock:
+            if index is not None:
+                e = self._by_index.get(index)
+                return {} if e is None else {
+                    "packs": e["packs"], "remasks": e["remasks"],
+                    "pack_ms_total": round(e["pack_ms_total"], 3),
+                    "recent": list(e["recent"])}
+            return {
+                idx: {"packs": e["packs"], "remasks": e["remasks"],
+                      "pack_ms_total": round(e["pack_ms_total"], 3),
+                      "recent": list(e["recent"])}
+                for idx, e in self._by_index.items()}
+
+
+PACK_LEDGER = PackLedger()
+
+
+def segment_capacity(seg: FrozenSegment) -> dict | None:
+    """The ledger row for one live segment: tier bytes + filter-mask holder
+    bytes, or None when the segment never packed (nothing resident). Pure
+    host reads — safe from any stats/scrape path."""
+    packed = getattr(seg, "_device_cache", {}).get("packed")
+    holder = getattr(seg, "_device_cache", {}).get("filter_masks")
+    mask_bytes = int(holder.bytes) if holder is not None else 0
+    if packed is None and mask_bytes == 0:
+        return None
+    tiers = packed_tier_bytes(packed) if packed is not None else {
+        "postings": 0, "dense_plane": 0, "sim_tables": 0, "agg_rows": 0,
+        "norms": 0}
+    tiers["filter_masks"] = mask_bytes
+    return {
+        "generation": int(seg.gen),
+        "tf_layout": packed.tf_layout if packed is not None else None,
+        "tiers": tiers,
+        "total_bytes": int(sum(tiers.values())),
+    }
+
+
+def capacity_report(indices_service, index=None) -> dict:
+    """The device capacity ledger: per-index, per-segment HBM residency by
+    tier + the pack/repack timing rollup — `/_nodes/stats` `device` section
+    and the `/{index}/_stats` device stanza. Walks this NODE's live shard
+    searchers (host arithmetic only; acquire_searcher on a closed engine is
+    skipped, same as the Prometheus HBM gauge). `index` narrows the walk to
+    one name or a collection of names — an index-scoped stats call must not
+    pay the whole node's segment walk."""
+    from ..common.errors import SearchEngineError
+
+    wanted = None
+    if index is not None:
+        wanted = (set(index) if isinstance(index, (set, frozenset, list,
+                                                   tuple))
+                  else {index})
+    indices_out = {}
+    node_totals: dict[str, int] = {}
+    for name, svc in list(indices_service.indices.items()):
+        if wanted is not None and name not in wanted:
+            continue
+        shards_out = {}
+        idx_totals: dict[str, int] = {}
+        for sid, shard in sorted(svc.shards.items()):
+            try:
+                searcher = shard.engine.acquire_searcher()
+            except SearchEngineError:
+                continue
+            segs = []
+            for seg in searcher.segments:
+                row = segment_capacity(seg)
+                if row is None:
+                    continue
+                segs.append(row)
+                for tier, b in row["tiers"].items():
+                    idx_totals[tier] = idx_totals.get(tier, 0) + b
+            if segs:
+                shards_out[str(sid)] = segs
+        entry = {
+            "shards": shards_out,
+            "totals": dict(idx_totals),
+            "total_bytes": int(sum(idx_totals.values())),
+            "pack": PACK_LEDGER.stats(name),
+        }
+        indices_out[name] = entry
+        for tier, b in idx_totals.items():
+            node_totals[tier] = node_totals.get(tier, 0) + b
+    return {
+        "indices": indices_out,
+        "totals": dict(node_totals),
+        "total_bytes": int(sum(node_totals.values())),
+    }
 
 
 def bytes_per_posting(layout: str, dense_resident: bool = False) -> int:
@@ -746,31 +924,42 @@ def ensure_sim_tables(packed: PackedSegment,
     return sim
 
 
-def packed_for(seg: FrozenSegment, breaker=None) -> PackedSegment:
+def packed_for(seg: FrozenSegment, breaker=None,
+               owner: str | None = None) -> PackedSegment:
     """Per-segment cached packing; refreshes the live mask when tombstones changed.
 
     `breaker` (the node's fielddata child) is consulted ONLY on a cache miss:
     the estimate covers the pack's host staging + device upload and is released
     once the pack lands — transient accounting, so a drained node reads 0.
     A trip raises CircuitBreakingError; serving falls back to the host scorer
-    (the one graceful-degradation edge the reference lacks)."""
+    (the one graceful-degradation edge the reference lacks).
+
+    `owner` (the index name, from ShardContext) attributes the pack's wall
+    time to the capacity ledger (PACK_LEDGER). The pack/remask paths are cold
+    by construction (once per segment per view), so timing them always is
+    within the zero-added-clocks contract — the cache-HIT path stays
+    clock-free."""
     cache = seg._device_cache
     packed: PackedSegment | None = cache.get("packed")
     prof = _profile.current()
     if packed is None:
-        t0 = time.monotonic() if prof is not None else 0.0
+        t0 = time.monotonic()
         with reserve(breaker, pack_estimate_bytes(seg), f"<segment_pack>[{seg.gen}]"):
             packed = pack_segment(seg)
         cache["packed"] = packed
         cache["live"] = True
+        ms = (time.monotonic() - t0) * 1000.0
+        PACK_LEDGER.record(owner, seg.gen, ms,
+                           packed_resident_bytes(packed), packed.tf_layout)
         if prof is not None:
             prof.event("packed_segment", gen=int(seg.gen), cache="pack",
-                       ms=round((time.monotonic() - t0) * 1000.0, 4),
+                       ms=round(ms, 4),
                        resident_bytes=int(packed_resident_bytes(packed)),
                        tf_layout=packed.tf_layout)
     elif cache.get("live") is None:
         import jax.numpy as jnp
 
+        t0 = time.monotonic()
         live_parent = np.zeros(packed.doc_pad, dtype=bool)
         live_parent[: seg.doc_count] = seg.live & seg.parent_mask
         packed.live_parent = jnp.asarray(live_parent)
@@ -782,6 +971,9 @@ def packed_for(seg: FrozenSegment, breaker=None) -> PackedSegment:
                           packed.doc_pad).astype(np.int32, copy=False)
         packed.blk_docs = jnp.asarray(masked.reshape(-1, BLOCK))
         cache["live"] = True
+        PACK_LEDGER.record(owner, seg.gen, (time.monotonic() - t0) * 1000.0,
+                           packed_resident_bytes(packed), packed.tf_layout,
+                           kind="remask")
         if prof is not None:
             prof.event("packed_segment", gen=int(seg.gen), cache="live_remask")
     elif prof is not None:

@@ -111,7 +111,13 @@ class PercolatorRegistry:
             # count amortizes dispatch (a mostly-non-flat registry stays host)
             if len(flat_plans) >= self.DEVICE_BATCH_MIN:
                 try:
-                    tds = execute_flat_batch(flat_plans, ctx, 1)
+                    from .common.jaxenv import compile_tag
+
+                    # capacity-ledger attribution: compiles triggered by the
+                    # batched percolation launch land under "percolate", not
+                    # the inner kernels' own families
+                    with compile_tag("percolate"):
+                        tds = execute_flat_batch(flat_plans, ctx, 1)
                     matches.extend(qid for qid, td in zip(flat_qids, tds)
                                    if td.total > 0)
                     host_items = rest

@@ -231,33 +231,18 @@ class _PromWriter:
         return "\n".join(self.lines) + "\n"
 
 
-def _hbm_resident_bytes(node) -> int:
-    """Device-resident packed-postings bytes across the node's live shard
-    searchers (ops/device_index.packed_resident_bytes over the per-segment
-    device caches) — pure host arithmetic over already-known shapes, no
-    device sync."""
-    from ..ops.device_index import packed_resident_bytes
-
-    total = 0
-    for svc in list(node.indices.indices.values()):
-        for shard in list(svc.shards.values()):
-            try:
-                searcher = shard.engine.acquire_searcher()
-            except SearchEngineError:
-                continue
-            for seg in searcher.segments:
-                packed = getattr(seg, "_device_cache", {}).get("packed")
-                if packed is not None:
-                    total += packed_resident_bytes(packed)
-    return total
-
-
 def _prometheus_text(node) -> str:
     """GET /_prometheus/metrics: the node's serving telemetry in Prometheus
     text format — breakers, thread pools (+queue-wait histograms), batcher,
-    admission control, search latency, compile events (common/jaxenv), HBM
-    resident bytes (ops/device_index), tracer counters."""
-    from ..common.jaxenv import compile_events_total
+    admission control, search latency, query-shape insights
+    (common/insights — label sets bounded by the registry's LRU demotion),
+    the device capacity ledger (per-index tier gauges + pack counters,
+    capped at telemetry.device.max_label_indices), compile events total +
+    by triggering plan family (common/jaxenv), HBM resident bytes
+    (ops/device_index), tracer counters, and the event journal / watchdog
+    counters (common/events — fixed type vocabulary)."""
+    from ..common.jaxenv import compile_events_by_family, compile_events_total
+    from ..ops.device_index import capacity_report
 
     w = _PromWriter()
     # one loop PER FAMILY, not per breaker/pool: the text exposition requires
@@ -346,8 +331,73 @@ def _prometheus_text(node) -> str:
     w.counter("estpu_filter_cache_evictions_total", fcs["evictions"])
     w.gauge("estpu_filter_cache_bytes", fcs["memory_size_in_bytes"])
     w.gauge("estpu_filter_cache_masks", fcs["masks"])
+    # always-on query-shape insights (common/insights.py): label cardinality
+    # is bounded by the registry's LRU demotion (≤ search.insights.max_shapes
+    # shape ids per family — the demotion counter shows when churn exceeds
+    # residency). One loop per family: contiguity is the strict-parser rule.
+    shapes = node.insights.prom_series()
+    for sid, st in shapes:
+        w.counter("estpu_query_shape_count_total", st.count, shape=sid)
+    for sid, st in shapes:
+        w.counter("estpu_query_shape_cost_seconds_total",
+                  round(st.cost_ms / 1000.0, 6), shape=sid)
+    for sid, st in shapes:
+        w.counter("estpu_query_shape_device_seconds_total",
+                  round(st.device.sum, 6), shape=sid)
+    for sid, st in shapes:
+        w.counter("estpu_query_shape_cache_hits_total", st.cache_hits,
+                  shape=sid)
+    w.counter("estpu_query_shape_demotions_total", node.insights.demotions)
+    # device capacity ledger (ops/device_index.capacity_report): per-index
+    # HBM residency by tier + pack rollups. Cardinality is bounded twice:
+    # labels exist only for LIVE indices (deleted indices vanish from the
+    # walk and the pack ledger forgets them), and the emission caps at
+    # `telemetry.device.max_label_indices` (top residents win; the overflow
+    # is counted, never silently dropped).
+    cap = max(1, node.settings.get_int("telemetry.device.max_label_indices",
+                                       64))
+    report = capacity_report(node.indices)
+    ranked = sorted(report["indices"].items(),
+                    key=lambda kv: -kv[1]["total_bytes"])
+    emitted, omitted = ranked[:cap], ranked[cap:]
+    for iname, entry in emitted:
+        for tier in ("postings", "dense_plane", "sim_tables", "agg_rows",
+                     "norms", "filter_masks"):
+            w.gauge("estpu_device_index_bytes",
+                    entry["totals"].get(tier, 0), index=iname, tier=tier)
+    for iname, entry in emitted:
+        w.counter("estpu_device_pack_total",
+                  entry["pack"].get("packs", 0), index=iname)
+    for iname, entry in emitted:
+        w.counter("estpu_device_pack_seconds_total",
+                  round(entry["pack"].get("pack_ms_total", 0.0) / 1000.0, 6),
+                  index=iname)
+    w.gauge("estpu_device_ledger_omitted_indices", len(omitted))
     w.counter("estpu_jax_compile_events_total", compile_events_total())
-    w.gauge("estpu_hbm_resident_bytes", _hbm_resident_bytes(node))
+    # compile events by triggering plan family (jaxenv.compile_tag at the
+    # kernel launch sites) — the FULL fixed vocabulary is emitted (zeros
+    # included) so the label set is stable and bounded by construction
+    from ..common.jaxenv import COMPILE_FAMILIES
+
+    by_family = compile_events_by_family()
+    for family in COMPILE_FAMILIES:
+        w.counter("estpu_jax_compile_family_total",
+                  by_family.get(family, 0), family=family)
+    # HBM postings gauge derived from the capacity report computed above —
+    # postings + dense_plane tiers ARE packed_resident_bytes over the live
+    # packed segments (one engine/segment walk per scrape, not two)
+    w.gauge("estpu_hbm_resident_bytes",
+            sum(e["totals"].get("postings", 0)
+                + e["totals"].get("dense_plane", 0)
+                for e in report["indices"].values()))
+    # stall watchdog + event journal (common/events.py): per-type emission
+    # counters (fixed EVENT_TYPES vocabulary) + suppression/ring pressure
+    es = node.events.stats()
+    for etype, n in sorted(es["by_type"].items()):
+        w.counter("estpu_events_emitted_total", n, type=etype)
+    w.counter("estpu_events_suppressed_total", es["suppressed"])
+    w.gauge("estpu_events_ring_entries", es["entries"])
+    w.counter("estpu_watchdog_ticks_total", node.watchdog.ticks)
     ts = node.tracer.stats()
     w.counter("estpu_traces_sampled_total", ts["sampled"])
     w.counter("estpu_traces_finished_total", ts["finished"])
@@ -359,6 +409,25 @@ def _prometheus_text(node) -> str:
     w.counter("estpu_traces_late_stitch_dropped_total",
               ts["late_stitch_dropped"])
     return w.text()
+
+
+def _size_param(req: RestRequest, endpoint: str, default=None):
+    """Shared `?size=` parsing for the telemetry read surfaces
+    (/_traces, /_insights/queries, /_events): non-int or negative → 400."""
+    from ..common.errors import IllegalArgumentError
+
+    raw = req.param("size")
+    if raw is None:
+        return default
+    try:
+        size = int(raw)
+    except (TypeError, ValueError):
+        raise IllegalArgumentError(
+            f"invalid size [{raw}] for [{endpoint}]") from None
+    if size < 0:
+        raise IllegalArgumentError(
+            f"size must be >= 0 for [{endpoint}], got [{size}]")
+    return size
 
 
 def build_rest_controller(node) -> RestController:
@@ -1068,8 +1137,12 @@ def build_rest_controller(node) -> RestController:
                                                index_templates=r.param("index_templates")))
     rc.register("GET", "/_cluster/pending_tasks", lambda r: client.pending_tasks())
     rc.register("GET", "/_cluster/stats", lambda r: client.cluster_stats())
+    # `{node_id}` REALLY filters now (comma list of ids or names, unknown id
+    # → 404 NodeMissingError) — it used to share the unfiltered handler and
+    # silently return the whole-cluster rollup
     rc.register("GET", "/_cluster/stats/nodes/{node_id}",
-                lambda r: client.cluster_stats())
+                lambda r: client.cluster_stats(
+                    node_id=r.path_params["node_id"]))
     # node shutdown (ref: cluster.nodes.shutdown spec + RestNodesShutdownAction)
     rc.register("POST", "/_shutdown",
                 lambda r: client.nodes_shutdown(None))
@@ -1100,20 +1173,7 @@ def build_rest_controller(node) -> RestController:
     # --- tracing / telemetry (common/tracing.py) ----------------------------
     def get_traces(req):
         """Ring buffer of finished traces on THIS node, newest first."""
-        from ..common.errors import IllegalArgumentError
-
-        raw = req.param("size")
-        limit = None
-        if raw is not None:
-            try:
-                limit = int(raw)
-            except (TypeError, ValueError):
-                raise IllegalArgumentError(
-                    f"invalid size [{raw}] for [/_traces]") from None
-            if limit < 0:
-                raise IllegalArgumentError(
-                    f"size must be >= 0 for [/_traces], got [{limit}]")
-        traces = node.tracer.traces(limit)
+        traces = node.tracer.traces(_size_param(req, "/_traces"))
         return {"node": node.node_id, "total": len(traces),
                 "tracing": node.tracer.stats(), "traces": traces}
 
@@ -1123,6 +1183,25 @@ def build_rest_controller(node) -> RestController:
         return {"nodes": {node.node_id: {"name": node.name,
                                          "tasks": node.tracer.tasks()}}}
 
+    def get_insights(req):
+        """Always-on query-shape insights (common/insights.py): the top-N
+        shapes by accumulated cost, full histograms included — the operator's
+        'which queries are eating the cluster' view, joinable to the slowlog
+        via the shape id."""
+        limit = _size_param(req, "/_insights/queries", default=10)
+        return {"node": node.node_id,
+                "insights": node.insights.stats(),
+                "shapes": node.insights.top(limit)}
+
+    def get_events(req):
+        """The cluster event journal (common/events.py): typed, rate-limited
+        stall/pressure events, cluster-wide by default (`?local=true` reads
+        only this node's ring)."""
+        return client.cluster_events(size=_size_param(req, "/_events"),
+                                     local=req.bool_param("local"))
+
+    rc.register("GET", "/_insights/queries", get_insights)
+    rc.register("GET", "/_events", get_events)
     rc.register("GET", "/_traces", get_traces)
     rc.register("GET", "/_tasks", get_tasks)
     rc.register("GET", "/_prometheus/metrics",
@@ -1634,6 +1713,35 @@ def build_rest_controller(node) -> RestController:
             ("searchable", "se", "segment is searchable"),
         ], rows)
 
+    def cat_events(req):
+        """Cluster event journal at a glance (common/events.py): one row per
+        typed watchdog event, newest first — the human-readable causal
+        record behind adaptive routing's health signals."""
+        import time as _time
+
+        rows = []
+        for e in client.cluster_events(local=req.bool_param("local"))["events"]:
+            attrs = e.get("attrs") or {}
+            rows.append({
+                "timestamp": _time.strftime(
+                    "%H:%M:%S", _time.localtime(float(e.get("ts", 0.0)))),
+                "node": e.get("node_name") or e.get("node", "-"),
+                "type": e.get("type", "-"),
+                "severity": e.get("severity", "-"),
+                "shard": attrs.get("shard", attrs.get("pool",
+                                                      attrs.get("breaker",
+                                                                "-"))),
+                "message": e.get("message", ""),
+            })
+        return _cat_table(req, [
+            ("timestamp", "ts", "event time (HH:MM:SS)"),
+            ("node", "n", "originating node"),
+            ("type", "t", "event type"),
+            ("severity", "sev", "info or warn"),
+            ("shard", "s", "subject (shard/pool/breaker)"),
+            ("message", "m", "human-readable event message"),
+        ], rows)
+
     # --- percolate -----------------------------------------------------------
     def percolate(req):
         return node.percolator.percolate(
@@ -1752,11 +1860,12 @@ def build_rest_controller(node) -> RestController:
     rc.register("GET", "/_cat/caches", cat_caches)
     rc.register("GET", "/_cat/segments", cat_segments)
     rc.register("GET", "/_cat/segments/{index}", cat_segments)
+    rc.register("GET", "/_cat/events", cat_events)
     rc.register("GET", "/_cat", lambda r: RestResponse(
         200, "".join(f"/_cat/{n}\n" for n in (
             "health", "nodes", "indices", "shards", "master", "allocation", "count",
             "aliases", "pending_tasks", "recovery", "thread_pool", "batcher",
-            "caches", "segments")),
+            "caches", "segments", "events")),
         content_type="text/plain"))
 
     # plugin-contributed routes (ref: plugins contribute REST handlers)

@@ -59,6 +59,7 @@ import time
 from collections import deque
 from concurrent.futures import Future
 
+from ..common import insights as _insights
 from ..common import tracing
 from ..common.deadline import NO_DEADLINE, Deadline
 from ..common.errors import RejectedExecutionError
@@ -75,7 +76,7 @@ def _k_bucket(k: int) -> int:
 
 class _Item:
     __slots__ = ("family", "key", "payload", "k", "kb", "deadline", "future",
-                 "t_enq", "span")
+                 "t_enq", "span", "obs")
 
     def __init__(self, family, key, payload, k: int, kb: int,
                  deadline: Deadline):
@@ -91,6 +92,12 @@ class _Item:
         # drainer attributes the shared batch's queue/dispatch/merge/pull
         # timings back to EVERY member's trace through this handle
         self.span = tracing.current_span()
+        # the request's always-on insights observation (common/insights.py;
+        # None when insights are off): the drainer writes the batch's queue
+        # wait + the existing pull window into it with clocks it already
+        # reads — the item's Future resolution is the happens-before edge
+        # back to the reader
+        self.obs = _insights.current()
 
 
 class _FlatFamily:
@@ -159,7 +166,10 @@ class _MeshFamily:
                   for _ in range(qb - len(plans))]
         # executor.search pulls its program output itself (one device_get for
         # the whole result pytree) — the mesh family merges at dispatch time
-        return executor.search(plans, kb)
+        from ..common.jaxenv import compile_tag
+
+        with compile_tag("mesh"):
+            return executor.search(plans, kb)
 
     @staticmethod
     def fan_out(out, items):
@@ -225,6 +235,15 @@ class DeviceBatcher:
         # twin of _ewma_cost, exported in /_nodes/stats + Prometheus
         self.service_hist = HistogramMetric()
         self._batch_ids = itertools.count(1)  # trace tag joining members
+        # in-flight (dispatching-or-unmerged) batches, OLDEST FIRST, written
+        # ONLY by the drainer and read unlocked by the stall watchdog:
+        # (batch_id, t_dispatch, family name, occupancy, shard label).
+        # Appended BEFORE family.dispatch so a hang INSIDE dispatch (the
+        # mesh family executes + pulls there) is visible too; the head is
+        # the oldest unresolved batch, so double-buffering (N merging while
+        # N+1 is dispatched) still ages N, not N+1. Deque ops under the GIL;
+        # a torn watchdog read is at worst one batch stale.
+        self._inflight_q: deque[tuple] = deque()
         self._flat = _FlatFamily()
         self._mesh = _MeshFamily()
 
@@ -339,13 +358,33 @@ class DeviceBatcher:
             for it in traced:
                 it.span.record("batcher.queue", it.t_enq, t0, batch=batch_id,
                                reason=reason, occupancy=len(items))
+            # always-on insights: the coalescing-queue wait, from the SAME
+            # t_enq/t0 clock pair the trace spans above use (plain attribute
+            # writes; the item futures resolve after these, so readers see
+            # them without locks)
+            for it in items:
+                if it.obs is not None:
+                    it.obs.queue_s = t0 - it.t_enq
             family = items[0].family
+            # publish the in-flight marker BEFORE dispatching: a hang inside
+            # dispatch itself (the mesh family's whole execution + pull live
+            # there) must age for the watchdog exactly like a wedged merge.
+            # Label extraction must never throw — a drainer death strands
+            # every queued future (payload shape is per-family: (plan, ctx)
+            # for flat, (plan, executor) for mesh, opaque in unit fakes)
+            payload = items[0].payload
+            ctx0 = payload[1] if isinstance(payload, tuple) \
+                and len(payload) > 1 else None
+            self._inflight_q.append(
+                (batch_id, t0, family.name, len(items),
+                 getattr(ctx0, "index_name", None) or family.name))
             try:
                 # dispatch-then-merge double buffering: batch N+1's device
                 # work is enqueued BEFORE batch N's host merge runs, so the
                 # merge overlaps device compute (no device_get in this half)
                 handle = family.dispatch(items, items[0].kb)
             except Exception as e:  # noqa: BLE001 — replay decides per item
+                self._retire_inflight(batch_id)
                 self._split(family, items, e)
                 continue
             if traced and tracing.sync_armed():
@@ -432,9 +471,11 @@ class DeviceBatcher:
         try:
             results = family.fan_out(handle, items)
         except Exception as e:  # noqa: BLE001 — replay decides per item
+            self._retire_inflight(batch_id)
             self._split(family, items, e)
             return
         t_m1 = time.monotonic()
+        self._retire_inflight(batch_id)  # merged: the stall marker retires
         dt = t_m1 - t0
         # merge span + the batch's ONE device pull, attributed to EVERY
         # coalesced member (the pull timestamps were stamped by
@@ -443,6 +484,12 @@ class DeviceBatcher:
         pull_t0 = getattr(handle, "pull_t0", None)
         pull_t1 = getattr(handle, "pull_t1", None)
         for it in items:
+            if it.obs is not None:
+                # device time rides the batch's existing single pull window
+                # (zero added clocks/syncs — the insights contract)
+                if pull_t0 is not None and pull_t1 is not None:
+                    it.obs.device_s = pull_t1 - pull_t0
+                it.obs.occupancy = len(items)
             if not it.span:
                 continue
             merge_span = it.span.record("batcher.merge", t_m0, t_m1,
@@ -492,6 +539,37 @@ class DeviceBatcher:
         for it in items:
             if not it.future.done():
                 it.future.set_exception(err)
+
+    def _retire_inflight(self, batch_id: int):
+        """Drop one batch's in-flight marker (drainer thread only). The
+        retiring batch is almost always the head; the fallback filter covers
+        the dispatch-failed-while-older-batch-pending interleaving."""
+        q = self._inflight_q
+        try:
+            if q and q[0][0] == batch_id:
+                q.popleft()
+                return
+        except IndexError:
+            return
+        for entry in list(q):
+            if entry[0] == batch_id:
+                try:
+                    q.remove(entry)
+                except ValueError:
+                    pass
+                return
+
+    def inflight(self) -> dict | None:
+        """The OLDEST in-flight (dispatching-or-unmerged) batch as the stall
+        watchdog sees it: {batch, age_s, family, occupancy, shard}, or None.
+        One unlocked deque head read of drainer-written state — the
+        watchdog's clock, never a serving thread's."""
+        try:
+            batch_id, t0, family, occupancy, label = self._inflight_q[0]
+        except IndexError:
+            return None
+        return {"batch": batch_id, "age_s": time.monotonic() - t0,
+                "family": family, "occupancy": occupancy, "shard": label}
 
     def note_profile_bypass(self):
         """A profiled request served itself directly instead of coalescing

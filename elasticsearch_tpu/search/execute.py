@@ -73,6 +73,8 @@ from .queries import (
     WildcardQuery,
 )
 from ..common.breaker import reserve
+from ..common.jaxenv import compile_tag
+from ..transport.faults import DEVICE_PULL as _DEVICE_PULL
 from .similarity import (
     BM25Similarity,
     FreqNormSimilarity,
@@ -562,12 +564,16 @@ class _PendingFlat:
     (search/batcher.py: batch N+1 dispatches while batch N merges)."""
 
     __slots__ = ("Q", "k", "breaker", "seg_work", "releases",
-                 "pull_t0", "pull_t1")
+                 "pull_t0", "pull_t1", "index")
 
-    def __init__(self, Q: int, k: int, breaker, seg_work: list, releases: list):
+    def __init__(self, Q: int, k: int, breaker, seg_work: list, releases: list,
+                 index: str | None = None):
         self.Q = Q
         self.k = k
         self.breaker = breaker
+        # owning index (ShardContext.index_name) — stall-injection matching
+        # and capacity-ledger attribution; None in unwired contexts
+        self.index = index
         # per segment: (seg, base, doc_pad, launches, dense)
         self.seg_work = seg_work
         # scratch-pool release callbacks — invoked by merge() AFTER the pull
@@ -656,7 +662,8 @@ def _dispatch_flat_plain(plans: list[FlatPlan], ctx: ShardContext,
     releases = []
     for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
         t_seg = time.monotonic() if prof is not None else 0.0
-        packed = packed_for(seg, breaker=ctx.breaker("fielddata"))
+        packed = packed_for(seg, breaker=ctx.breaker("fielddata"),
+                            owner=ctx.index_name)
         # cheap LUT swap (1 KB/field), not a postings re-bake: the quantized
         # scan decodes tf→tfn in-kernel against these stacked cache rows
         sim = ensure_sim_tables(packed, sim_tables)
@@ -675,16 +682,20 @@ def _dispatch_flat_plain(plans: list[FlatPlan], ctx: ShardContext,
                     postings_scanned += int(seg.post_offsets[tid + 1]
                                             - seg.post_offsets[tid])
             clause_lists.append(cl)
-        launches, overflow, release = launch_flat_sparse(
-            packed, clause_lists, n_must, msm, coord_tbl, k, simple=simple,
-            breaker=ctx.breaker("request"), sim=sim)
+        # compile_tag: backend compiles triggered by these launches land in
+        # the capacity ledger's per-family attribution (common/jaxenv)
+        with compile_tag("sparse"):
+            launches, overflow, release = launch_flat_sparse(
+                packed, clause_lists, n_must, msm, coord_tbl, k, simple=simple,
+                breaker=ctx.breaker("request"), sim=sim)
         releases.append(release)
         dense = None
         if overflow:
-            dense = _launch_dense_fallback(
-                overflow, finals, field_idx, all_fields, caches_stack,
-                n_must, msm, coord_tbl, packed, seg, k,
-                breaker=ctx.breaker("fielddata"))
+            with compile_tag("dense"):
+                dense = _launch_dense_fallback(
+                    overflow, finals, field_idx, all_fields, caches_stack,
+                    n_must, msm, coord_tbl, packed, seg, k,
+                    breaker=ctx.breaker("fielddata"))
         seg_work.append((seg, base, packed.doc_pad, launches, dense))
         if prof is not None:
             from ..ops.pallas_kernels import estpu_pallas_enabled
@@ -704,7 +715,8 @@ def _dispatch_flat_plain(plans: list[FlatPlan], ctx: ShardContext,
                 dense_overflow=len(overflow),
                 ms=(time.monotonic() - t_seg) * 1000.0)
     return _PendingFlat(Q=Q, k=k, breaker=ctx.breaker("request"),
-                        seg_work=seg_work, releases=releases)
+                        seg_work=seg_work, releases=releases,
+                        index=ctx.index_name)
 
 
 def _merge_flat_plain(pending: _PendingFlat) -> list[TopDocs]:
@@ -723,6 +735,13 @@ def _merge_flat_plain(pending: _PendingFlat) -> list[TopDocs]:
         refs.extend(r for (_sb, r) in launches)
         if dense is not None:
             refs.append(dense[1])
+    # chaos hook (transport/faults.DEVICE_PULL): one plain attribute read
+    # when disarmed; armed, the stall-watchdog tests wedge THIS pull the way
+    # a hung runtime would (the sleep happens before the guard-legal pull)
+    if _DEVICE_PULL.active:
+        stall = _DEVICE_PULL.delay_for(pending.index)
+        if stall > 0.0:
+            time.sleep(stall)
     # stamp the pull window for tracing (host clocks around the pull the
     # serving path performs anyway — the device span's end rides this)
     pending.pull_t0 = time.monotonic()
@@ -926,7 +945,8 @@ def _execute_flat_fs(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list[T
     try:
         for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
             t_seg = time.monotonic() if prof is not None else 0.0
-            packed = packed_for(seg, breaker=ctx.breaker("fielddata"))
+            packed = packed_for(seg, breaker=ctx.breaker("fielddata"),
+                                owner=ctx.index_name)
             _ensure_norm_rows(packed, all_fields,
                               breaker=ctx.breaker("fielddata"))
             entries = _dense_entries(finals, seg, packed, field_idx)
@@ -945,10 +965,11 @@ def _execute_flat_fs(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list[T
                 g_row[:D] = g_seg
                 applies_row = np.zeros(doc_pad, bool)
                 applies_row[:D] = applies_seg
-                scores, docs, tq = score_fs_rows_batch(
-                    packed, batch, k, g_row, applies_row, fsq.max_boost, fsq.boost,
-                    fsq.min_score, fsq.boost_mode,
-                    no_functions=not fsq.functions)
+                with compile_tag("function_score"):
+                    scores, docs, tq = score_fs_rows_batch(
+                        packed, batch, k, g_row, applies_row, fsq.max_boost,
+                        fsq.boost, fsq.min_score, fsq.boost_mode,
+                        no_functions=not fsq.functions)
             else:
                 col_rows = []
                 colmiss = np.zeros(D, bool)
@@ -967,10 +988,12 @@ def _execute_flat_fs(plans: list[FlatPlan], ctx: ShardContext, k: int) -> list[T
                     fmask_row[:D] = segment_mask(seg, sf.filter, ctx)
                 else:
                     fmask_row = np.zeros(doc_pad, bool)
-                scores, docs, tq, bad = score_fs_script_batch(
-                    packed, batch, k, script, used_fields, col_rows, fmask_row,
-                    bad_row, parent_row, sf.weight, fsq.max_boost, fsq.boost,
-                    fsq.min_score, fsq.boost_mode, has_filter=sf.filter is not None)
+                with compile_tag("function_score"):
+                    scores, docs, tq, bad = score_fs_script_batch(
+                        packed, batch, k, script, used_fields, col_rows,
+                        fmask_row, bad_row, parent_row, sf.weight,
+                        fsq.max_boost, fsq.boost, fsq.min_score,
+                        fsq.boost_mode, has_filter=sf.filter is not None)
                 host_idx.update(int(qi) for qi in np.nonzero(bad)[0])
             totals += tq
             valid = (docs < min(doc_pad, D)) & np.isfinite(scores)
@@ -1064,7 +1087,8 @@ def _execute_flat_filtered(plans: list[FlatPlan], ctx: ShardContext,
     prof = _profile.current()
     for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
         t_seg = time.monotonic() if prof is not None else 0.0
-        packed = packed_for(seg, breaker=ctx.breaker("fielddata"))
+        packed = packed_for(seg, breaker=ctx.breaker("fielddata"),
+                            owner=ctx.index_name)
         _ensure_norm_rows(packed, all_fields,
                           breaker=ctx.breaker("fielddata"))
         fmask = _filter_mask_matrix([plan.filt for plan in plans], seg,
@@ -1073,7 +1097,8 @@ def _execute_flat_filtered(plans: list[FlatPlan], ctx: ShardContext,
         batch = build_term_batch(entries, Q, n_must, msm, coord_tbl,
                                  list(all_fields), caches_stack,
                                  nb_pad_row=packed.blk_docs.shape[0] - 1)
-        scores, docs, tq = score_filtered_batch(packed, batch, k, fmask)
+        with compile_tag("filtered"):
+            scores, docs, tq = score_filtered_batch(packed, batch, k, fmask)
         totals += tq
         valid = (docs < min(packed.doc_pad, seg.doc_count)) & np.isfinite(scores)
         gdocs = np.where(valid, docs.astype(np.int64) + base, np.int64(2**62))
@@ -1101,7 +1126,8 @@ def execute_flat_sorted(plan: FlatPlan, ctx: ShardContext, k: int, spec):
      coord_tbl, n_must, msm) = _assemble_batch([plan], finals)
     # validate EVERY segment's eligibility before the first launch — a
     # late-segment refusal must not waste completed kernel work
-    packeds = [packed_for(seg, breaker=ctx.breaker("fielddata"))
+    packeds = [packed_for(seg, breaker=ctx.breaker("fielddata"),
+                          owner=ctx.index_name)
                for seg in ctx.searcher.segments]
     key_rows = [device_sort_key_row(spec, seg, p.doc_pad)
                 for seg, p in zip(ctx.searcher.segments, packeds)]
@@ -1123,9 +1149,10 @@ def execute_flat_sorted(plan: FlatPlan, ctx: ShardContext, k: int, spec):
         batch = build_term_batch(entries, 1, n_must, msm, coord_tbl,
                                  list(all_fields), caches_stack,
                                  nb_pad_row=packed.blk_docs.shape[0] - 1)
-        keys, docs, scores, qmax, tq = score_sorted_batch(
-            packed, batch, max(k, 1), jnp.asarray(key_row), spec.reverse,
-            fmask=fmask)
+        with compile_tag("sorted"):
+            keys, docs, scores, qmax, tq = score_sorted_batch(
+                packed, batch, max(k, 1), jnp.asarray(key_row), spec.reverse,
+                fmask=fmask)
         # batched host pulls: one .tolist() per row instead of a float()/int()
         # scalar conversion per hit (tpulint TPU001)
         (seg_total,) = tq.tolist()
@@ -1170,7 +1197,8 @@ def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
     prof = _profile.current()
     for seg, base in zip(ctx.searcher.segments, ctx.searcher.bases):
         t_seg = time.monotonic() if prof is not None else 0.0
-        packed = packed_for(seg, breaker=ctx.breaker("fielddata"))
+        packed = packed_for(seg, breaker=ctx.breaker("fielddata"),
+                            owner=ctx.index_name)
         _ensure_norm_rows(packed, all_fields,
                           breaker=ctx.breaker("fielddata"))
         stack = ensure_agg_rows(seg, packed, fields,
@@ -1208,8 +1236,9 @@ def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
         fmask = None
         if plan.filt is not None:
             fmask = _filter_mask_matrix([plan.filt], seg, packed, ctx)
-        scores, docs, tq, counts, stats, bcounts = score_agg_batch(
-            packed, batch, k, stack, tuple(pair_args), fmask=fmask)
+        with compile_tag("aggs"):
+            scores, docs, tq, counts, stats, bcounts = score_agg_batch(
+                packed, batch, k, stack, tuple(pair_args), fmask=fmask)
         totals += tq
         valid = (docs < min(packed.doc_pad, seg.doc_count)) & np.isfinite(scores)
         gdocs = np.where(valid, docs.astype(np.int64) + base, np.int64(2**62))

@@ -16,6 +16,7 @@ from dataclasses import dataclass, field as dc_field
 
 import numpy as np
 
+from ..common import insights as _insights
 from ..common import profile as _profile
 from ..common.breaker import reserve as breaker_reserve
 from ..common.deadline import NO_DEADLINE, Deadline, parse_timevalue
@@ -154,6 +155,10 @@ def _count(path: str):
     prof = _profile.current()
     if prof is not None:
         prof.outcome(path)  # the resolved execution path, recorded once
+    obs = _insights.current()
+    if obs is not None and obs.outcome is None:
+        obs.outcome = path  # always-on query-shape outcome mix (one
+        # thread-local read + attribute write — the insights hook contract)
 
 
 def _device_failed(e: BaseException):

@@ -169,3 +169,61 @@ class FaultPolicy:
     @staticmethod
     def uninstall(transport_service):
         transport_service.fault_policy = None
+
+
+# ---------------------------------------------------------------------------
+# device-side fault injection: the serving path's ONE device pull
+# ---------------------------------------------------------------------------
+
+
+class DevicePullFaults:
+    """Deterministic stall injection for the serving path's single batched
+    device pull (execute._merge_flat_plain) — the device-side sibling of the
+    transport rules above, built for the stall-watchdog chaos tests: a
+    transport rule can wedge a wire, but only this can wedge the drainer's
+    merge half the way a hung runtime / preempted device would.
+
+    The hot-path gate is one module attribute read (`active` is a plain
+    bool): disarmed — the shipped default — costs exactly that. Armed, a pull
+    whose owning index matches `index` sleeps `delay_s` before the
+    device_get, at most `times` total injections (then auto-disarms).
+    `delay()`/`maybe_stall()` never touch a lock on the disarmed path and
+    take only the leaf `_lock` for the countdown when armed."""
+
+    def __init__(self):
+        self.active = False  # the one hot-path read
+        self._lock = threading.Lock()
+        self._delay_s = 0.0
+        self._index = "*"
+        self._remaining = 0
+        self.injected = 0
+
+    def arm(self, delay_s: float, index: str = "*", times: int = 1):
+        with self._lock:
+            self._delay_s = float(delay_s)
+            self._index = index
+            self._remaining = int(times)
+            self.active = True
+        return self
+
+    def disarm(self):
+        with self._lock:
+            self.active = False
+            self._remaining = 0
+
+    def delay_for(self, index: str | None) -> float:
+        """The stall to apply to one pull (0.0 = none). Decrements the
+        injection budget under the leaf lock; the caller sleeps OUTSIDE it."""
+        with self._lock:
+            if not self.active or self._remaining <= 0:
+                return 0.0
+            if not _glob_match(str(index), self._index):
+                return 0.0
+            self._remaining -= 1
+            if self._remaining <= 0:
+                self.active = False
+            self.injected += 1
+            return self._delay_s
+
+
+DEVICE_PULL = DevicePullFaults()

@@ -1,0 +1,458 @@
+"""Always-on query-shape insights (common/insights.py) — ISSUE 13 tentpole (a).
+
+Covers: shape normalization (literal erasure, structural preservation,
+volatile-key stripping, clause-count bucketing), the bounded LRU registry
+(demotion past max_shapes with honest fold-into-other), the thread-local
+observation handoff, live classification of EVERY search with outcome mix /
+cache attribution / batcher queue+device phases, the REST + nodes-stats
+surfaces, the slowlog shape join + runtime cluster-settings thresholds, the
+fuzzed Prometheus label-cardinality bound, and the hot-path invariant: a
+warmed serving loop with insights + ledger + watchdog all armed adds zero
+compiles, zero device pulls, and zero syncs over the disabled baseline under
+hard transfer_guard("disallow").
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.insights import (
+    Observation, QueryShapeInsights, activate, current, normalize_shape,
+    shape_fingerprint)
+from elasticsearch_tpu.common.settings import Settings
+
+from .harness import TestCluster
+
+
+# ---------------------------------------------------------------------------
+# shape normalization
+# ---------------------------------------------------------------------------
+
+
+class TestShapeNormalization:
+    def test_literals_erased_structure_kept(self):
+        a, _ = shape_fingerprint({"query": {"match": {"body": "alpha"}}})
+        b, _ = shape_fingerprint({"query": {"match": {"body": "zebra zw"}}})
+        c, _ = shape_fingerprint({"query": {"term": {"body": "alpha"}}})
+        assert a == b
+        assert a != c
+
+    def test_field_names_are_structural(self):
+        a, _ = shape_fingerprint({"query": {"match": {"body": "x"}}})
+        b, _ = shape_fingerprint({"query": {"match": {"title": "x"}}})
+        assert a != b
+
+    def test_key_order_and_volatile_knobs_do_not_matter(self):
+        a, _ = shape_fingerprint({"size": 5, "query": {"match": {"b": "x"}}})
+        b, _ = shape_fingerprint({"query": {"match": {"b": "y"}}, "size": 5,
+                                  "timeout": "100ms", "profile": True,
+                                  "request_cache": False, "trace": True})
+        assert a == b
+
+    def test_size_zero_is_a_distinct_shape(self):
+        q = {"query": {"match": {"b": "x"}}}
+        a, _ = shape_fingerprint({**q, "size": 0})
+        b, _ = shape_fingerprint({**q, "size": 10})
+        c, _ = shape_fingerprint({**q, "size": 3})
+        assert a != b
+        assert b == c  # both paged; the literal page size is erased
+
+    def test_clause_lists_bucket_by_pow2(self):
+        def body(n):
+            return {"query": {"bool": {"should": [
+                {"term": {"b": f"t{i}"}} for i in range(n)]}}}
+
+        s5, _ = shape_fingerprint(body(5))
+        s7, _ = shape_fingerprint(body(7))
+        s2, _ = shape_fingerprint(body(2))
+        s40, _ = shape_fingerprint(body(40))
+        assert s5 == s7  # both bucket to x8
+        assert s2 != s40
+
+    def test_list_valued_structural_keys_survive(self):
+        """multi_match over different field SETS must be different shapes —
+        list elements inherit the parent key's structural status."""
+        a, _ = shape_fingerprint({"query": {"multi_match": {
+            "query": "x", "fields": ["title", "body"]}}})
+        b, _ = shape_fingerprint({"query": {"multi_match": {
+            "query": "y", "fields": ["tag", "other"]}}})
+        c, _ = shape_fingerprint({"query": {"multi_match": {
+            "query": "z", "fields": ["title", "body"]}}})
+        assert a != b
+        assert a == c  # the query literal still erases
+
+    def test_structural_values_survive(self):
+        shape = normalize_shape({"sort": [{"n": {"order": "desc"}}],
+                                 "query": {"match": {"b": "x"}}})
+        assert "desc" in str(shape)
+        a, _ = shape_fingerprint({"sort": [{"n": {"order": "desc"}}]})
+        b, _ = shape_fingerprint({"sort": [{"n": {"order": "asc"}}]})
+        assert a != b
+
+
+# ---------------------------------------------------------------------------
+# bounded registry
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def _reg(self, max_shapes=4):
+        return QueryShapeInsights(Settings.from_flat(
+            {"search.insights.max_shapes": max_shapes}))
+
+    def test_record_accumulates(self):
+        reg = self._reg()
+        sid, shape = reg.fingerprint({"query": {"match": {"b": "x"}}})
+        obs = Observation()
+        obs.outcome = "device_sparse"
+        obs.queue_s = 0.001
+        obs.device_s = 0.002
+        obs.occupancy = 3
+        reg.record(sid, shape, 0.01, obs, cache="miss")
+        reg.record(sid, shape, cache="hit")
+        (entry,) = reg.top(5)
+        assert entry["count"] == 2
+        assert entry["cache"] == {"hits": 1, "misses": 1, "hit_rate": 0.5}
+        assert entry["outcomes"] == {"device_sparse": 1, "cache_hit": 1}
+        assert entry["coalesced"] == 1
+        assert entry["latency"]["count"] == 1
+        assert entry["queue"]["count"] == 1
+        assert entry["device"]["count"] == 1
+
+    def test_lru_demotion_is_bounded_and_honest(self):
+        reg = self._reg(max_shapes=4)
+        for i in range(10):
+            sid, shape = reg.fingerprint(
+                {"query": {"match": {f"f{i}": "x"}}})
+            reg.record(sid, shape, 0.01)
+        st = reg.stats()
+        assert st["shapes"] == 4
+        assert st["demotions"] == 6
+        assert st["other"]["count"] == 6
+        assert st["other"]["cost_ms"] > 0
+        assert len(reg.prom_series()) == 4
+
+    def test_resighting_moves_to_end(self):
+        reg = self._reg(max_shapes=2)
+        ids = []
+        for i in range(2):
+            sid, shape = reg.fingerprint({"query": {"match": {f"f{i}": "x"}}})
+            ids.append(sid)
+            reg.record(sid, shape, 0.01)
+        # touch the oldest, then insert a third: the UNtouched one demotes
+        sid0, shape0 = reg.fingerprint({"query": {"match": {"f0": "x"}}})
+        reg.record(sid0, shape0, 0.01)
+        sid2, shape2 = reg.fingerprint({"query": {"match": {"f2": "x"}}})
+        reg.record(sid2, shape2, 0.01)
+        resident = {sid for sid, _ in reg.prom_series()}
+        assert ids[0] in resident and sid2 in resident
+        assert ids[1] not in resident
+
+    def test_unknown_outcome_folds_to_unknown(self):
+        reg = self._reg()
+        sid, shape = reg.fingerprint({})
+        obs = Observation()
+        obs.outcome = "weird_new_path"
+        reg.record(sid, shape, 0.01, obs)
+        (entry,) = reg.top(1)
+        assert entry["outcomes"] == {"unknown": 1}
+
+    def test_observation_thread_local(self):
+        assert current() is None
+        obs = Observation()
+        with activate(obs):
+            assert current() is obs
+            seen = []
+            t = threading.Thread(target=lambda: seen.append(current()))
+            t.start()
+            t.join()
+            assert seen == [None]  # thread-local, not global
+        assert current() is None
+
+
+# ---------------------------------------------------------------------------
+# live cluster
+# ---------------------------------------------------------------------------
+
+
+def _boot(tmp_path, settings=None, shards=2):
+    # mesh SPMD off by default here: these tests pin SHARD-path semantics
+    # (per-shard counts, slowlog, request-cache attribution) — the mesh
+    # path's coordinator-side recording has its own test below
+    cluster = TestCluster(n_nodes=1, data_root=tmp_path, seed=5,
+                          settings={"search.mesh.enabled": False,
+                                    **(settings or {})})
+    cluster.start()
+    c = cluster.client()
+    c.create_index("ins", {"settings": {"number_of_shards": shards,
+                                        "number_of_replicas": 0}})
+    cluster.ensure_green("ins")
+    for i in range(40):
+        c.index("ins", "doc", {"body": f"alpha{i % 4} beta", "n": i},
+                id=str(i))
+    c.refresh("ins")
+    return cluster, c
+
+
+MATCH = {"query": {"match": {"body": "alpha1"}}, "size": 3}
+COUNT = {"query": {"match": {"body": "alpha2"}}, "size": 0}
+
+
+@pytest.mark.insights
+class TestLiveInsights:
+    def test_every_search_classified_no_opt_in(self, tmp_path):
+        cluster, c = _boot(tmp_path)
+        node = next(iter(cluster.nodes.values()))
+        try:
+            for i in range(4):
+                c.search("ins", {"query": {"match": {"body": f"alpha{i}"}},
+                                 "size": 3})
+            c.search("ins", COUNT)  # miss + store
+            c.search("ins", COUNT)  # cache hit
+            c.search("ins", {"query": {"fuzzy": {"body": "alphaa"}},
+                             "size": 2})  # host path
+            entries = node.insights.top(10)
+            assert len(entries) >= 3
+            by_count = {e["shape_id"]: e for e in entries}
+            # the 4 match searches share ONE shape (literals erased) + every
+            # shard phase counted (2 shards per search)
+            match_entry = max(entries, key=lambda e: e["count"])
+            assert match_entry["count"] == 8
+            assert match_entry["outcomes"].get("device_sparse", 0) >= 1
+            # the cached count query carries hit + miss attribution
+            cached = [e for e in entries if e["cache"]["hits"] >= 1]
+            assert cached, [e["cache"] for e in entries]
+            assert cached[0]["outcomes"].get("cache_hit", 0) >= 1
+            # the fuzzy query fell off the fused path -> host outcome
+            assert any(e["outcomes"].get("host", 0) >= 1 for e in entries), \
+                [e["outcomes"] for e in entries]
+            # batcher-phase attribution: queue + device histograms populated
+            # from the drainer's existing clocks
+            assert match_entry["queue"]["count"] >= 1
+            assert match_entry["device"]["count"] >= 1
+            assert by_count  # keep the var (readability of failures above)
+        finally:
+            cluster.close()
+
+    def test_mesh_served_searches_classify_too(self, tmp_path):
+        """A mesh-SPMD-served search never reaches _s_query_phase — the
+        coordinator records it instead, outcome mesh_spmd (once per search,
+        not per shard)."""
+        cluster = TestCluster(n_nodes=1, data_root=tmp_path, seed=5)
+        cluster.start()
+        c = cluster.client()
+        node = next(iter(cluster.nodes.values()))
+        try:
+            c.create_index("mesh", {"settings": {"number_of_shards": 2,
+                                                 "number_of_replicas": 0}})
+            cluster.ensure_green("mesh")
+            for i in range(20):
+                c.index("mesh", "doc", {"body": f"alpha{i % 3}"}, id=str(i))
+            c.refresh("mesh")
+            c.search("mesh", MATCH)
+            entries = node.insights.top(5)
+            assert entries, "mesh-served search was not classified"
+            outcomes = {}
+            for e in entries:
+                for o, n in e["outcomes"].items():
+                    outcomes[o] = outcomes.get(o, 0) + n
+            # conftest pins an 8-device CPU mesh, so the 2-shard co-located
+            # flat search rides the SPMD program (test_mesh_serving pins it)
+            assert outcomes.get("mesh_spmd", 0) >= 1, outcomes
+        finally:
+            cluster.close()
+
+    def test_rest_surfaces(self, tmp_path):
+        from elasticsearch_tpu.rest.controller import (RestRequest,
+                                                       build_rest_controller)
+
+        cluster, c = _boot(tmp_path)
+        node = next(iter(cluster.nodes.values()))
+        try:
+            for _ in range(3):
+                c.search("ins", MATCH)
+            rc = build_rest_controller(node)
+            r = rc.dispatch(RestRequest(method="GET",
+                                        path="/_insights/queries",
+                                        params={}))
+            assert r.status == 200
+            assert r.body["insights"]["shapes"] >= 1
+            assert r.body["shapes"][0]["cost_ms"] >= \
+                r.body["shapes"][-1]["cost_ms"]  # top-N by cost
+            r1 = rc.dispatch(RestRequest(method="GET",
+                                         path="/_insights/queries",
+                                         params={"size": "1"}))
+            assert len(r1.body["shapes"]) == 1
+            bad = rc.dispatch(RestRequest(method="GET",
+                                          path="/_insights/queries",
+                                          params={"size": "-2"}))
+            assert bad.status == 400
+            # /_nodes/stats search.shapes section
+            st = node.client().nodes_stats(metric="search")
+            (sections,) = st["nodes"].values()
+            shapes = sections["search"]["shapes"]
+            assert shapes["shapes"] >= 1 and shapes["top"]
+            assert shapes["max_shapes"] == 128
+        finally:
+            cluster.close()
+
+    def test_slowlog_carries_shape_id_and_cluster_runtime_thresholds(
+            self, tmp_path):
+        """The satellite pair: slowlog lines join /_insights/queries via
+        shape[<id>], and PUT /_cluster/settings arms the threshold at
+        runtime with NO index-level setting and no restart."""
+        cluster, c = _boot(tmp_path)
+        node = next(iter(cluster.nodes.values()))
+        try:
+            records = []
+
+            class _Capture(logging.Handler):
+                def emit(self, record):
+                    records.append(record.getMessage())
+
+            # cluster-level transient threshold — no index setting at all
+            c.cluster_update_settings({"transient": {
+                "index.search.slowlog.threshold.query.warn": "0ms"}})
+            handler = _Capture()
+            logging.getLogger("estpu.action").addHandler(handler)
+            try:
+                c.search("ins", MATCH)
+            finally:
+                logging.getLogger("estpu.action").removeHandler(handler)
+                c.cluster_update_settings({"transient": {
+                    "index.search.slowlog.threshold.query.warn": "-1"}})
+            slow = [m for m in records if "slowlog" in m]
+            assert slow, records
+            sid, _ = node.insights.fingerprint(MATCH)
+            assert f"shape[{sid}]" in slow[0], slow[0]
+
+            # after disarming (-1), no further lines
+            records.clear()
+            logging.getLogger("estpu.action").addHandler(handler)
+            try:
+                c.search("ins", MATCH)
+            finally:
+                logging.getLogger("estpu.action").removeHandler(handler)
+            assert not [m for m in records if "slowlog" in m], records
+        finally:
+            cluster.close()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus label-cardinality bound (satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.insights
+class TestPrometheusCardinality:
+    def test_fuzzed_shape_burst_stays_at_max_shapes(self, tmp_path, rng):
+        from elasticsearch_tpu.rest.controller import _prometheus_text
+        from tools.obs_smoke import _parse_prometheus
+
+        cluster, c = _boot(
+            tmp_path, settings={"search.insights.max_shapes": 12}, shards=1)
+        node = next(iter(cluster.nodes.values()))
+        try:
+            # a burst of far more distinct shapes than the registry holds:
+            # random field names + random clause structures
+            for i in range(40):
+                field = f"f{int(rng.integers(0, 1000))}_{i}"
+                if i % 3 == 0:
+                    body = {"query": {"bool": {"should": [
+                        {"term": {field: "x"}}
+                        for _ in range(int(rng.integers(1, 6)))]}},
+                        "size": int(rng.integers(0, 2))}
+                else:
+                    body = {"query": {"match": {field: "x"}},
+                            "size": int(rng.integers(0, 3))}
+                c.search("ins", body)
+            assert node.insights.stats()["demotions"] > 0
+            text = _prometheus_text(node)
+            _parse_prometheus(text)  # contiguity + well-formedness pinned
+            for fam in ("estpu_query_shape_count_total",
+                        "estpu_query_shape_cost_seconds_total",
+                        "estpu_query_shape_device_seconds_total",
+                        "estpu_query_shape_cache_hits_total"):
+                labels = {ln.split("{", 1)[1] for ln in text.splitlines()
+                          if ln.startswith(fam + "{")}
+                assert 0 < len(labels) <= 12, (fam, len(labels))
+            assert "estpu_query_shape_demotions_total" in text
+        finally:
+            cluster.close()
+
+
+# ---------------------------------------------------------------------------
+# the hot-path invariant (acceptance pin)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.insights
+class TestHotPathInvariant:
+    def test_armed_trio_adds_no_compiles_pulls_or_syncs(self, tmp_path,
+                                                        monkeypatch):
+        """Acceptance: a warmed serving loop with insights + capacity ledger
+        + watchdog ALL armed shows 0 recompiles and 0 added device_get/sync
+        calls under hard transfer_guard("disallow") — the armed loop performs
+        exactly as many pulls as the same loop with insights disabled."""
+        import jax
+
+        from elasticsearch_tpu.common.jaxenv import sanitize
+        from elasticsearch_tpu.search import execute as execute_mod
+
+        cluster, c = _boot(tmp_path, settings={"watchdog.interval": "50ms"})
+        node = next(iter(cluster.nodes.values()))
+        try:
+            assert node.insights.enabled and node.watchdog.enabled
+            # warm every executable this loop will need (both shapes)
+            for _ in range(3):
+                c.search("ins", MATCH)
+                c.search("ins", COUNT)
+
+            pulls = []
+            orig_get = jax.device_get
+            monkeypatch.setattr(jax, "device_get",
+                                lambda *a, **k: (pulls.append(1),
+                                                 orig_get(*a, **k))[1])
+            syncs = []
+            orig_sync = execute_mod._PendingFlat.sync
+            monkeypatch.setattr(execute_mod._PendingFlat, "sync",
+                                lambda self: (syncs.append(1),
+                                              orig_sync(self))[1])
+
+            def loop(n=8):
+                pulls.clear()
+                for _ in range(n):
+                    c.search("ins", MATCH)
+                    c.search("ins", COUNT)  # request-cache hit: 0 pulls
+                return len(pulls)
+
+            ticks_before = node.watchdog.ticks
+            jax.config.update("jax_transfer_guard", "disallow")
+            try:
+                with sanitize(max_compiles=0, transfers="disallow") as rep:
+                    armed_pulls = loop()
+            finally:
+                jax.config.update("jax_transfer_guard", "allow")
+            assert rep.compiles == 0, rep.compile_events
+            assert syncs == [], "telemetry must never sync"
+            # the watchdog really ran during the loop (always-on, not idle)
+            time.sleep(0.15)
+            assert node.watchdog.ticks > ticks_before
+
+            # identical loop with insights disabled: pull count must match
+            node.insights.enabled = False
+            try:
+                baseline_pulls = loop()
+            finally:
+                node.insights.enabled = True
+            assert armed_pulls == baseline_pulls, \
+                (armed_pulls, baseline_pulls)
+            # one batched pull per (uncached search x shard); cached searches
+            # pull nothing
+            assert armed_pulls == 8 * 2
+        finally:
+            cluster.close()

@@ -1,0 +1,361 @@
+"""Stall watchdog + cluster event journal (common/events.py) — ISSUE 13
+tentpole (c).
+
+Covers: journal units (bounded ring, per-(type,key) rate limiting, typed
+vocabulary, remote ingest dedup), watchdog check units against stub serving
+state (batch stall vs the batcher EWMA, queue-wait delta-p99 spikes, breaker
+near-trip dwell), the REST surfaces (/_events, /_cat/events, nodes-stats
+section, Prometheus counters), cross-node gossip, and the acceptance chaos:
+a FaultPolicy-injected device-pull stall is detected within 2 watchdog
+periods, producing a typed event naming the shard and batch while healthy
+traffic keeps serving.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from elasticsearch_tpu.common.events import (EVENT_TYPES, EventJournal,
+                                             StallWatchdog)
+from elasticsearch_tpu.common.metrics import HistogramMetric
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.transport.faults import DEVICE_PULL
+
+from .harness import TestCluster
+
+
+# ---------------------------------------------------------------------------
+# journal units
+# ---------------------------------------------------------------------------
+
+
+class TestEventJournal:
+    def _journal(self, **flat):
+        return EventJournal(Settings.from_flat(flat), node_name="n1",
+                            node_id="n1")
+
+    def test_publish_shape_and_ring_bound(self):
+        j = self._journal(**{"node.events.size": 8,
+                             "node.events.throttle": "0ms"})
+        for i in range(20):
+            ev = j.publish("queue_spike", f"m{i}", key=f"k{i}", pool="search")
+            assert ev is not None
+            assert ev["type"] == "queue_spike" and ev["node"] == "n1"
+            assert ev["attrs"] == {"pool": "search"}
+        assert len(j.events()) == 8  # ring bound
+        assert j.events()[0]["message"] == "m19"  # newest first
+        assert j.stats()["emitted"] == 20
+
+    def test_rate_limit_per_type_key(self):
+        j = self._journal(**{"node.events.throttle": "10s"})
+        assert j.publish("batch_stall", "x", key="b:1") is not None
+        assert j.publish("batch_stall", "x again", key="b:1") is None
+        assert j.publish("batch_stall", "other batch", key="b:2") is not None
+        assert j.publish("queue_spike", "other type", key="b:1") is not None
+        assert j.stats()["suppressed"] == 1
+
+    def test_unknown_type_folds_to_watchdog(self):
+        j = self._journal()
+        ev = j.publish("totally-new", "m")
+        assert ev["type"] == "watchdog"
+        assert set(j.stats()["by_type"]) == set(EVENT_TYPES)
+
+    def test_ingest_stamps_missing_ts(self):
+        """A ts-less gossiped event must not poison every future events()
+        sort for the ring's lifetime — arrival time is stamped."""
+        j = self._journal()
+        assert j.ingest({"seq": 1, "node": "n2", "type": "batch_stall"})
+        assert j.ingest({"seq": 2, "node": "n2", "type": "batch_stall",
+                         "ts": "bogus"})
+        evs = j.events()  # must not raise
+        assert all(isinstance(e["ts"], float) and e["ts"] > 0 for e in evs)
+
+    def test_remote_ingest_dedup(self):
+        j = self._journal()
+        ev = {"seq": 3, "ts": time.time(), "node": "n2", "type": "batch_stall",
+              "severity": "warn", "message": "remote", "attrs": {}}
+        assert j.ingest(ev) is True
+        assert j.ingest(dict(ev)) is False  # same origin seq
+        assert j.ingest({**ev, "seq": 2}) is False  # older than watermark
+        assert j.ingest({**ev, "seq": 4}) is True
+        assert j.ingest({**ev, "node": "n1", "seq": 99}) is False  # our own
+        st = j.stats()
+        assert st["remote_ingested"] == 2 and st["remote_duplicates"] == 2
+
+
+# ---------------------------------------------------------------------------
+# watchdog check units (stub serving state)
+# ---------------------------------------------------------------------------
+
+
+def _stub_node(**over):
+    node = SimpleNamespace(
+        node_id="n1",
+        settings=Settings.EMPTY,
+        events=EventJournal(Settings.from_flat(
+            {"node.events.throttle": "0ms"}), node_id="n1"),
+        search_batcher=SimpleNamespace(inflight=lambda: None,
+                                       _ewma_cost=0.004),
+        threadpool=SimpleNamespace(pool_histograms=lambda: {}),
+        breakers=SimpleNamespace(stats=lambda: {}),
+        cluster_service=SimpleNamespace(
+            state=SimpleNamespace(nodes=SimpleNamespace(nodes=[]))),
+    )
+    for k, v in over.items():
+        setattr(node, k, v)
+    return node
+
+
+def _dog(node, **flat):
+    return StallWatchdog(node, Settings.from_flat(flat))
+
+
+class TestWatchdogChecks:
+    def test_batch_stall_adaptive_threshold(self):
+        node = _stub_node()
+        snap = {"batch": 7, "age_s": 0.3, "family": "flat",
+                "occupancy": 4, "shard": "idx"}
+        node.search_batcher = SimpleNamespace(inflight=lambda: snap,
+                                              _ewma_cost=0.01)
+        dog = _dog(node, **{"watchdog.batch_stall_min": "100ms",
+                            "watchdog.batch_stall_factor": 8.0})
+        dog.tick()
+        (ev,) = [e for e in node.events.events()
+                 if e["type"] == "batch_stall"]
+        assert ev["attrs"]["batch"] == 7 and ev["attrs"]["shard"] == "idx"
+        assert "idx" in ev["message"] and "[7]" in ev["message"]
+        # a batch younger than factor x EWMA stays quiet
+        node2 = _stub_node()
+        node2.search_batcher = SimpleNamespace(
+            inflight=lambda: {**snap, "age_s": 0.05}, _ewma_cost=0.01)
+        dog2 = _dog(node2, **{"watchdog.batch_stall_min": "100ms",
+                              "watchdog.batch_stall_factor": 8.0})
+        dog2.tick()
+        assert node2.events.events() == []
+
+    def test_queue_spike_on_delta_p99(self):
+        hist = HistogramMetric()
+        node = _stub_node(threadpool=SimpleNamespace(
+            pool_histograms=lambda: {"search": hist}))
+        dog = _dog(node, **{"watchdog.queue_p99_min": "50ms",
+                            "watchdog.queue_min_samples": 4})
+        dog.tick()  # primes the delta baseline
+        for _ in range(10):
+            hist.observe(0.001)
+        dog.tick()  # healthy tick, learns ~1ms baseline
+        assert node.events.events() == []
+        for _ in range(10):
+            hist.observe(0.8)  # the brown-out
+        dog.tick()
+        (ev,) = [e for e in node.events.events()
+                 if e["type"] == "queue_spike"]
+        assert ev["attrs"]["pool"] == "search"
+        assert ev["attrs"]["p99_ms"] > 500
+
+    def test_breaker_dwell_needs_consecutive_ticks(self):
+        stats = {"request": {"limit": 100, "estimated": 95, "tripped": 0}}
+        node = _stub_node(breakers=SimpleNamespace(stats=lambda: stats))
+        dog = _dog(node, **{"watchdog.breaker_dwell_ticks": 2})
+        dog.tick()
+        assert node.events.events() == []  # dwell 1 of 2
+        dog.tick()
+        (ev,) = [e for e in node.events.events()
+                 if e["type"] == "breaker_pressure"]
+        assert ev["attrs"]["breaker"] == "request"
+        assert ev["attrs"]["dwell_ticks"] == 2
+        # dropping below the line resets the dwell
+        stats["request"]["estimated"] = 10
+        dog.tick()
+        stats["request"]["estimated"] = 95
+        dog.tick()
+        assert len([e for e in node.events.events()
+                    if e["type"] == "breaker_pressure"]) == 1
+
+    def test_broken_check_does_not_kill_the_tick(self):
+        node = _stub_node(breakers=SimpleNamespace(
+            stats=lambda: (_ for _ in ()).throw(RuntimeError("boom"))))
+        dog = _dog(node)
+        dog.tick()  # must not raise
+        assert dog.ticks == 1
+
+
+# ---------------------------------------------------------------------------
+# live: the acceptance chaos + surfaces + gossip
+# ---------------------------------------------------------------------------
+
+
+WATCHDOG_SETTINGS = {
+    "watchdog.interval": "100ms",
+    "watchdog.batch_stall_min": "200ms",
+    "watchdog.batch_stall_factor": 2.0,
+    "node.events.throttle": "0ms",
+    # a tiny coalescing queue so healthy traffic bypasses to direct launches
+    # while the drainer is wedged on the injected stall
+    "search.batch.queue_size": 1,
+    "search.mesh.enabled": False,
+}
+
+
+def _boot(tmp_path, nodes=1, settings=None):
+    cluster = TestCluster(n_nodes=nodes, data_root=tmp_path, seed=3,
+                          settings={**WATCHDOG_SETTINGS, **(settings or {})})
+    cluster.start()
+    c = cluster.client()
+    for name in ("stall", "healthy"):
+        c.create_index(name, {"settings": {"number_of_shards": 1,
+                                           "number_of_replicas": 0}})
+        cluster.ensure_green(name)
+        for i in range(15):
+            c.index(name, "doc", {"body": f"alpha{i % 3}"}, id=str(i))
+        c.refresh(name)
+    return cluster, c
+
+
+@pytest.mark.insights
+class TestLiveWatchdog:
+    def test_device_pull_stall_detected_within_two_periods(self, tmp_path):
+        """The acceptance pin: a FaultPolicy-injected device-pull stall is
+        detected by the watchdog within 2 watchdog periods of crossing the
+        threshold, producing a typed /_events entry naming the shard and
+        batch, while healthy traffic keeps serving."""
+        cluster, c = _boot(tmp_path)
+        node = next(iter(cluster.nodes.values()))
+        interval = node.watchdog.interval_s
+        threshold = node.watchdog.batch_min_s
+        try:
+            # warm both indices (compiles + request-cache store for healthy)
+            c.search("stall", {"query": {"match": {"body": "alpha1"}}})
+            c.search("healthy", {"query": {"match": {"body": "alpha1"}},
+                                 "size": 0})
+            DEVICE_PULL.arm(2.0, index="stall", times=1)
+            out = {}
+
+            def stalled():
+                t0 = time.monotonic()
+                out["r"] = c.search("stall",
+                                    {"query": {"match": {"body": "alpha2"}}})
+                out["dt"] = time.monotonic() - t0
+
+            th = threading.Thread(target=stalled)
+            t_start = time.monotonic()
+            th.start()
+            # poll for the typed event; it must land within threshold + 2
+            # watchdog periods (+ scheduler slack) of the dispatch
+            deadline = threshold + 2 * interval + 0.35
+            ev = None
+            while time.monotonic() - t_start < 2.0 and ev is None:
+                evs = [e for e in node.events.events()
+                       if e["type"] == "batch_stall"]
+                ev = evs[0] if evs else None
+                if ev is None:
+                    time.sleep(0.02)
+            detected_at = time.monotonic() - t_start
+            assert ev is not None, "stall never detected"
+            assert detected_at <= deadline, (detected_at, deadline)
+            # the event names the shard and the batch
+            assert ev["attrs"]["shard"] == "stall"
+            assert isinstance(ev["attrs"]["batch"], int)
+            assert "stall" in ev["message"]
+            assert ev["severity"] == "warn"
+
+            # healthy traffic keeps serving DURING the stall: the cached
+            # query answers instantly (zero batcher), and a direct query
+            # bypasses the wedged drainer through the full queue
+            t0 = time.monotonic()
+            r = c.search("healthy", {"query": {"match": {"body": "alpha1"}},
+                                     "size": 0})
+            assert r["hits"]["total"] > 0
+            assert time.monotonic() - t0 < 1.0
+            assert time.monotonic() - t_start < 2.0, \
+                "healthy check ran after the stall already cleared"
+
+            th.join(10.0)
+            assert out["r"]["hits"]["total"] > 0  # the stalled search lands
+            assert out["dt"] >= 2.0
+        finally:
+            DEVICE_PULL.disarm()
+            cluster.close()
+
+    def test_events_surfaces(self, tmp_path):
+        from elasticsearch_tpu.rest.controller import (RestRequest,
+                                                       build_rest_controller)
+
+        cluster, c = _boot(tmp_path)
+        node = next(iter(cluster.nodes.values()))
+        try:
+            node.events.publish("queue_spike", "pool [search] p99 spiked",
+                                key="pool:search", pool="search", p99_ms=900)
+            rc = build_rest_controller(node)
+            r = rc.dispatch(RestRequest(method="GET", path="/_events",
+                                        params={}))
+            assert r.status == 200 and r.body["total"] >= 1
+            types = {e["type"] for e in r.body["events"]}
+            assert "queue_spike" in types
+            r = rc.dispatch(RestRequest(method="GET", path="/_events",
+                                        params={"local": "true",
+                                                "size": "1"}))
+            assert len(r.body["events"]) == 1
+            bad = rc.dispatch(RestRequest(method="GET", path="/_events",
+                                          params={"size": "bogus"}))
+            assert bad.status == 400
+            r = rc.dispatch(RestRequest(method="GET", path="/_cat/events",
+                                        params={"v": ""}))
+            assert r.status == 200 and "queue_spike" in r.body
+            # nodes-stats section + Prometheus counters
+            st = c.nodes_stats(metric="events")
+            (sections,) = st["nodes"].values()
+            assert sections["events"]["journal"]["emitted"] >= 1
+            assert sections["events"]["watchdog"]["ticks"] >= 0
+            from elasticsearch_tpu.rest.controller import _prometheus_text
+            from tools.obs_smoke import _parse_prometheus
+
+            text = _prometheus_text(node)
+            _parse_prometheus(text)
+            assert 'estpu_events_emitted_total{type="queue_spike"}' in text
+            assert "estpu_watchdog_ticks_total" in text
+        finally:
+            cluster.close()
+
+    def test_gossip_reaches_peer_journals_and_events_dedup(self, tmp_path):
+        cluster, c = _boot(tmp_path, nodes=2)
+        nodes = list(cluster.nodes.values())
+        origin, peer = nodes[0], nodes[1]
+        try:
+            ev = origin.events.publish("breaker_pressure",
+                                       "breaker [request] dwelling",
+                                       key="breaker:request",
+                                       breaker="request")
+            origin.watchdog._gossip(ev)
+            for _ in range(100):
+                if peer.events.stats()["remote_ingested"] >= 1:
+                    break
+                time.sleep(0.02)
+            remote = [e for e in peer.events.events()
+                      if e["type"] == "breaker_pressure"]
+            assert remote and remote[0]["node"] == origin.node_id
+            # the cluster view dedups the gossiped copy against the origin's
+            total = peer.client().cluster_events()
+            matching = [e for e in total["events"]
+                        if e["type"] == "breaker_pressure"]
+            assert len(matching) == 1, matching
+        finally:
+            cluster.close()
+
+
+class TestDevicePullFaults:
+    def test_arm_times_and_index_matching(self):
+        DEVICE_PULL.disarm()
+        DEVICE_PULL.arm(0.5, index="only-this", times=2)
+        try:
+            assert DEVICE_PULL.delay_for("other") == 0.0
+            assert DEVICE_PULL.delay_for("only-this") == 0.5
+            assert DEVICE_PULL.delay_for("only-this") == 0.5
+            # budget exhausted -> auto-disarm
+            assert DEVICE_PULL.delay_for("only-this") == 0.0
+            assert DEVICE_PULL.active is False
+        finally:
+            DEVICE_PULL.disarm()

@@ -5,8 +5,12 @@ error.
 Covers: `/_prometheus/metrics` (parsed with a strict minimal text-format
 parser), `/_traces`, `/_tasks`, `/_segments` (+ index-scoped), every
 `/_cat/*` endpoint the listing advertises, `hot_threads`, `/_nodes/stats`,
-and a `?profile=true` search whose merged `profile` section must carry every
-shard. Run as `python -m tools.obs_smoke` (CI pins JAX_PLATFORMS=cpu).
+a `?profile=true` search whose merged `profile` section must carry every
+shard, and the always-on telemetry trio (ISSUE 13): `/_insights/queries`
+(every search classified), `/_events` + `/_cat/events` (the watchdog's
+journal), the `/_nodes/stats` `device` section + `/{index}/_stats` device
+stanza, and the bounded `estpu_query_shape_*` / device-ledger Prometheus
+families. Run as `python -m tools.obs_smoke` (CI pins JAX_PLATFORMS=cpu).
 """
 
 from __future__ import annotations
@@ -102,9 +106,45 @@ def main() -> int:
         rc_stats = node.request_cache.stats()
         assert rc_stats["hits"] >= 1 and rc_stats["stores"] >= 1, rc_stats
 
+        # always-on query-shape insights: every search above classified into
+        # the bounded registry with zero opt-in
+        r = get("/_insights/queries")
+        assert r.body["insights"]["shapes"] >= 2, r.body["insights"]
+        assert r.body["shapes"], r.body
+        for entry in r.body["shapes"]:
+            for key in ("shape_id", "shape", "count", "cost_ms", "outcomes",
+                        "cache", "latency", "queue", "device"):
+                assert key in entry, (key, entry)
+        assert any(e["cache"]["hits"] >= 1 for e in r.body["shapes"]), \
+            [e["cache"] for e in r.body["shapes"]]
+
+        # event journal (cluster-wide + local + _cat view)
+        r = get("/_events")
+        assert "events" in r.body and "total" in r.body, r.body
+        r = get("/_events", params={"local": "true"})
+        assert "events" in r.body, r.body
+
         r = get("/_prometheus/metrics")
         _parse_prometheus(r.body)
         assert "estpu_traces_ring_evicted_total" in r.body
+        # always-on telemetry families (contiguity enforced by the parser):
+        # bounded query-shape labels, per-index device ledger, compile
+        # family attribution, event/watchdog counters
+        for fam in ("estpu_query_shape_count_total",
+                    "estpu_query_shape_cost_seconds_total",
+                    "estpu_query_shape_device_seconds_total",
+                    "estpu_query_shape_cache_hits_total",
+                    "estpu_query_shape_demotions_total",
+                    "estpu_device_index_bytes",
+                    "estpu_device_pack_total",
+                    "estpu_device_pack_seconds_total",
+                    "estpu_device_ledger_omitted_indices",
+                    "estpu_jax_compile_family_total",
+                    "estpu_events_suppressed_total",
+                    "estpu_watchdog_ticks_total"):
+            assert fam in r.body, fam
+        assert 'estpu_device_index_bytes{index="smoke",tier="postings"}' \
+            in r.body, "per-index device tier gauge missing"
         # adaptive routing + hedging families (contiguity checked above)
         for fam in ("estpu_search_hedges_issued_total",
                     "estpu_search_hedges_won_total",
@@ -138,6 +178,24 @@ def main() -> int:
         r = get("/_nodes/stats")
         (sections,) = r.body["nodes"].values()
         assert "tracing" in sections and "search" in sections
+        # search.shapes (insights registry) + the device capacity ledger +
+        # the event journal/watchdog sections
+        sh = sections["search"].get("shapes")
+        assert sh is not None and sh["shapes"] >= 2 and sh["top"], sh
+        dev = sections.get("device")
+        assert dev is not None and dev["total_bytes"] > 0, dev
+        assert "smoke" in dev["indices"], sorted(dev["indices"])
+        smoke_dev = dev["indices"]["smoke"]
+        assert smoke_dev["totals"].get("postings", 0) > 0, smoke_dev
+        assert smoke_dev["pack"].get("packs", 0) >= 1, smoke_dev["pack"]
+        assert "by_family" in dev["compile"], dev["compile"]
+        ev = sections.get("events")
+        assert ev is not None and "journal" in ev and "watchdog" in ev, ev
+
+        # /{index}/_stats carries the per-index device stanza
+        r = get("/smoke/_stats")
+        idx = r.body["indices"]["smoke"]
+        assert idx.get("device") and idx["device"]["total_bytes"] > 0, idx
         ar = sections.get("adaptive_routing")
         assert ar is not None and "hedges" in ar and "copies" in ar, ar
         for key in ("issued", "won", "budget_exhausted", "tokens"):

@@ -56,7 +56,13 @@ HOT_FILES = ("elasticsearch_tpu/search/execute.py",
              # lookup/store must stay pure host dict work (no device traffic,
              # no blocking under its leaf lock); the filter-mask tier lives in
              # ops/device_index.py (already hot via the prefix)
-             "elasticsearch_tpu/search/request_cache.py")
+             "elasticsearch_tpu/search/request_cache.py",
+             # always-on telemetry sits ON every query phase (shape
+             # classification + registry record) and inside the watchdog's
+             # periodic reads of serving state — both must stay pure host
+             # work: no device traffic, no blocking under their leaf locks
+             "elasticsearch_tpu/common/insights.py",
+             "elasticsearch_tpu/common/events.py")
 PLATFORM_EXEMPT = ("elasticsearch_tpu/common/jaxenv.py",)
 
 _SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*ignore(?:\[([A-Z0-9, ]+)\])?")
