@@ -815,10 +815,211 @@ def serving_main():
     sys.stdout.flush()
 
 
+# ---------------------------------------------------------------------------
+# writes mode: continuous indexing + concurrent search (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+WRITES_DOCS = int(os.environ.get("BENCH_WRITES_DOCS", 8000))
+WRITES_ROUNDS = int(os.environ.get("BENCH_WRITES_ROUNDS", 20))
+WRITES_BATCH = int(os.environ.get("BENCH_WRITES_BATCH", 50))
+WRITES_SEARCHERS = int(os.environ.get("BENCH_WRITES_SEARCHERS", 8))
+
+
+def run_writes():
+    """The heavy-write serving slice: a continuously-indexing shard under
+    concurrent search load. Reports (a) first-search-after-refresh p99 — the
+    cost the OFF-QUERY-PATH delta packing is supposed to erase, (b) pack
+    bytes per refresh (should scale with the DELTA, not the index — the
+    ledger's delta_pack events vs the base pack), and (c) search p99 during
+    an active background merge (maybe_merge no longer computes under the
+    engine lock)."""
+    import tempfile
+    import threading
+
+    import jax
+
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.ops.device_index import PACK_LEDGER
+
+    tmp = tempfile.mkdtemp(prefix="bench_writes_")
+    settings = Settings.from_flat({
+        "path.data": tmp,
+        "threadpool.search.size": str(max(WRITES_SEARCHERS, 8)),
+    })
+    node = Node(name="bench_writes", settings=settings)
+    node.start()
+    try:
+        client = node.client()
+        client.create_index("bench_writes", {"settings": {
+            "number_of_shards": 1, "number_of_replicas": 0,
+            # tests drive refresh explicitly; merges are phase C
+            "index.refresh_interval": -1,
+            "index.merge.policy.segments_per_tier": 4}})
+        rng = np.random.default_rng(7)
+        raw = rng.zipf(1.3, size=(WRITES_DOCS, 8)).astype(np.int64)
+        terms = (raw - 1) % SERVING_VOCAB
+        bulk = []
+        for i in range(WRITES_DOCS):
+            bulk.append({"action": {"index": {
+                "_index": "bench_writes", "_type": "doc", "_id": str(i)}},
+                "source": {"body": " ".join(f"w{int(t)}" for t in terms[i])}})
+            if len(bulk) >= 500:
+                client.bulk(bulk)
+                bulk = []
+        if bulk:
+            client.bulk(bulk)
+        client.refresh("bench_writes")
+        queries = [{"query": {"match": {
+            "body": f"w{int(a)} w{int(b)}"}}, "size": 10}
+            for a, b in (rng.choice(SERVING_VOCAB // 4, size=2,
+                                    replace=False) for _ in range(32))]
+        for q in queries[:8]:
+            client.search("bench_writes", q)
+        # warm the delta shapes (one increment + search, outside the timings)
+        for i in range(WRITES_BATCH):
+            client.index("bench_writes", "doc",
+                         {"body": " ".join(
+                             f"w{int(t)}" for t in terms[i % WRITES_DOCS])},
+                         id=f"warm-{i}")
+        client.refresh("bench_writes")
+        client.search("bench_writes", queries[0])
+
+        # --- phase A: continuous indexing + concurrent search -------------
+        stop = threading.Event()
+        lat_lock = threading.Lock()
+        steady_lat: list = []
+
+        def searcher(seed):
+            r = np.random.default_rng(seed)
+            local = []
+            while not stop.is_set():
+                q = queries[int(r.integers(len(queries)))]
+                t0 = time.perf_counter()
+                client.search("bench_writes", q)
+                local.append(time.perf_counter() - t0)
+            with lat_lock:
+                steady_lat.extend(local)
+
+        threads = [threading.Thread(target=searcher, args=(2000 + i,))
+                   for i in range(WRITES_SEARCHERS)]
+        for t in threads:
+            t.start()
+        PACK_LEDGER.forget("bench_writes")
+        first_after_refresh = []
+        doc_id = 0
+        for _round in range(WRITES_ROUNDS):
+            for _ in range(WRITES_BATCH):
+                client.index(
+                    "bench_writes", "doc",
+                    {"body": " ".join(
+                        f"w{int(t)}" for t in terms[doc_id % WRITES_DOCS])},
+                    id=f"live-{doc_id}")
+                doc_id += 1
+            client.refresh("bench_writes")
+            t0 = time.perf_counter()
+            client.search("bench_writes",
+                          queries[_round % len(queries)])
+            first_after_refresh.append(time.perf_counter() - t0)
+        stop.set()
+        for t in threads:
+            t.join(30)
+        led = PACK_LEDGER.stats("bench_writes")
+        delta_events = [e for e in led.get("recent", ())
+                        if e["kind"] == "delta_pack"]
+        delta_bytes = (sum(e["bytes"] for e in delta_events)
+                       / len(delta_events)) if delta_events else 0
+        # the base segment's resident pack bytes — what a from-scratch
+        # repack-per-refresh design would pay every round
+        eng = node.indices.indices["bench_writes"].shards[0].engine
+        from elasticsearch_tpu.ops.device_index import packed_resident_bytes
+
+        base_bytes = max(
+            (packed_resident_bytes(s._device_cache["packed"])
+             for s in eng.acquire_searcher().segments
+             if s._device_cache.get("packed") is not None), default=0)
+
+        # --- phase C: search p99 during an active background merge --------
+        merge_lat: list = []
+        merge_done = threading.Event()
+
+        def merger():
+            try:
+                eng.maybe_merge(max_merges=8)
+            finally:
+                merge_done.set()
+
+        mt = threading.Thread(target=merger)
+        mt.start()
+        r = np.random.default_rng(4242)
+        while not merge_done.is_set() and len(merge_lat) < 2000:
+            q = queries[int(r.integers(len(queries)))]
+            t0 = time.perf_counter()
+            client.search("bench_writes", q)
+            merge_lat.append(time.perf_counter() - t0)
+        mt.join(120)
+
+        def p(arr, q):
+            return float(np.percentile(np.asarray(arr) * 1000, q)) \
+                if len(arr) else float("nan")
+
+        platform = jax.devices()[0].platform
+        return {
+            "metric": "first-search-after-refresh p99 (continuous indexing, "
+                      f"{WRITES_SEARCHERS} concurrent searchers, {platform})",
+            "value": round(p(first_after_refresh, 99), 2),
+            "unit": "ms",
+            "rounds": WRITES_ROUNDS,
+            "docs_per_refresh": WRITES_BATCH,
+            "first_search_p50_ms": round(p(first_after_refresh, 50), 2),
+            "steady_search_p50_ms": round(p(steady_lat, 50), 2),
+            "steady_search_p99_ms": round(p(steady_lat, 99), 2),
+            "searches_during_writes": len(steady_lat),
+            # the delta-proportionality acceptance: pack bytes per refresh
+            # track the increment, not the index
+            "delta_pack_bytes_mean": int(delta_bytes),
+            "base_pack_bytes": int(base_bytes),
+            "delta_vs_base": round(delta_bytes / base_bytes, 4)
+            if base_bytes else 0.0,
+            "delta_packs": led.get("delta_packs", 0),
+            "compacts": led.get("compacts", 0),
+            "pack_pools": led.get("pools", {}),
+            # lock-free merge compute: searches keep answering during it
+            "merge_search_p50_ms": round(p(merge_lat, 50), 2),
+            "merge_search_p99_ms": round(p(merge_lat, 99), 2),
+            "searches_during_merge": len(merge_lat),
+            "platform": platform,
+        }
+    finally:
+        node.close()
+
+
+def writes_main():
+    """BENCH_MODE=writes entry: one stdout JSON line, persisted to
+    BENCH_WRITES.json."""
+    platform = BackendProbe().wait()
+    if platform.startswith("cpu"):
+        from elasticsearch_tpu.common.jaxenv import force_cpu_platform
+
+        force_cpu_platform()
+    result = run_writes()
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_WRITES.json"), "w") as f:
+            json.dump(result, f, indent=1)
+    except Exception as e:  # noqa: BLE001 — persistence is best-effort
+        print(f"# writes row persist failed: {e}", file=sys.stderr)
+    print(json.dumps(result))
+    sys.stdout.flush()
+
+
 def main():
     global N_DOCS, VOCAB, BATCH, N_BATCHES
     if os.environ.get("BENCH_MODE") == "serving":
         serving_main()
+        return
+    if os.environ.get("BENCH_MODE") == "writes":
+        writes_main()
         return
     t_start = time.time()
     probe = BackendProbe()

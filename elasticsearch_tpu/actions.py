@@ -2170,6 +2170,11 @@ class ActionModule:
     def _shard_ctx(self, index: str, shard_id: int, dfs: dict | None = None) -> ShardContext:
         svc = self.indices.index_service(index)
         shard = svc.shard(shard_id)
+        # opens the warmer's pack-scheduling gate (warmer.py): refreshes of a
+        # shard that has never served a search stay device-free; after the
+        # first search, every new view's packs/remasks move off the query
+        # path onto the warmer/merge pools. Plain attr write, idempotent
+        shard.engine.search_active = True
         global_stats = None
         if dfs:
             global_stats = {
@@ -2295,6 +2300,7 @@ class ActionModule:
         finally:
             shard_span.end()
         took_s = time.monotonic() - t_q
+        partial = _shard_partial_dict(result)
         if shape_id is not None:
             # profiled runs that found the entry present (peek) attribute a
             # hit even though profiling re-executed — same rule as the
@@ -2305,21 +2311,14 @@ class ActionModule:
                 if cache_key is not None else None)
         self._maybe_slowlog(index, shard_id, body, took_s,
                             trace=trace, shape_id=shape_id)
-        partial = {
-            "total": result.total,
-            "docs": [[s, d, sv] for (s, d, sv) in result.docs],
-            "max_score": None if result.max_score != result.max_score else result.max_score,
-            "agg_partials": _encode_partials(result.agg_partials),
-            "facet_partials": _encode_partials(result.facet_partials),
-            "suggest": result.suggest,
-            "timed_out": result.timed_out,
-        }
         # store the partial for the next sighting of this (body, view) —
         # never a timed-out partial (an honest partial is not THE answer),
         # and never re-store what a profiled run already found present
         if cache_key is not None and not result.timed_out and not peek_hit:
             data = _encode_cached_partial(partial)
-            if data is not None and rcache.put(cache_key, data) \
+            # `body` registers the fingerprint in the shard's hot-key memory
+            # (hit counts drive the warmer's post-refresh top-N replay)
+            if data is not None and rcache.put(cache_key, data, body=body) \
                     and prof is not None:
                 prof.event("request_cache", cache="store")
         out = {
@@ -2355,6 +2354,45 @@ class ActionModule:
         with profiling.activate(prof):
             return execute_query_phase(ctx, req, shard_id=shard_id,
                                        deadline=deadline)
+
+    def warm_shard_queries(self, index: str, shard_id: int,
+                           bodies: list[dict],
+                           budget_s: float = 5.0) -> tuple[int, int]:
+        """Warmer re-prime (warmer.py, on the `warmer` pool): execute the
+        shard's hottest cached bodies against its CURRENT view and store the
+        partials, so the first post-refresh sighting of a hot query is a
+        request-cache hit. Mirrors _s_query_phase's execute→encode→store
+        path minus the spans/insights/slowlog (a warm execution is not a
+        request); already-warmed keys are skipped via peek (no hit/miss
+        accounting perturbed). Returns (warmed, failed)."""
+        rcache = getattr(self.node, "request_cache", None)
+        if rcache is None or not rcache.enabled:
+            return 0, 0
+        warmed = failed = 0
+        for body in bodies:
+            try:
+                ctx = self._shard_ctx(index, shard_id)
+                key = (index, shard_id, ctx.searcher.version,
+                       request_fingerprint(body))
+                if rcache.peek(key):
+                    continue  # a live request (or earlier warm) beat us
+                req = parse_search_body(dict(body))
+                result = execute_query_phase(
+                    ctx, req, shard_id=shard_id,
+                    deadline=Deadline.after(budget_s))
+                if result.timed_out:
+                    continue  # honest partials are never cached
+                data = _encode_cached_partial(_shard_partial_dict(result))
+                # body=None: the warm store must not touch the hot-key
+                # ranking the live traffic builds
+                if data is not None and rcache.put(key, data):
+                    warmed += 1
+            except SearchEngineError:
+                failed += 1  # shard gone / parse drift: skip this body
+            except Exception:  # noqa: BLE001 — warming must never throw into
+                # the warmer pool; a single bad body just doesn't warm
+                failed += 1
+        return warmed, failed
 
     def _load_signal(self) -> dict:
         """The query-phase response's piggybacked load sample: search-pool
@@ -2708,6 +2746,24 @@ def _fs_from(lst):
     from .index.segment import FieldStats
 
     return FieldStats(*lst)
+
+
+def _shard_partial_dict(result) -> dict:
+    """The wire/cache shape of one shard's query-phase partial — the ONE
+    construction site shared by the live query phase (_s_query_phase) and
+    the warmer's re-prime (warm_shard_queries): warm-stored and live-stored
+    request-cache entries must decode identically or a post-refresh hit on
+    a warmed entry fails where the live entry worked."""
+    return {
+        "total": result.total,
+        "docs": [[s, d, sv] for (s, d, sv) in result.docs],
+        "max_score": None if result.max_score != result.max_score
+        else result.max_score,
+        "agg_partials": _encode_partials(result.agg_partials),
+        "facet_partials": _encode_partials(result.facet_partials),
+        "suggest": result.suggest,
+        "timed_out": result.timed_out,
+    }
 
 
 def _encode_cached_partial(partial: dict) -> bytes | None:

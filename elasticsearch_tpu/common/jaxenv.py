@@ -82,7 +82,8 @@ class SanitizerReport:
 _tag_local = threading.local()
 
 COMPILE_FAMILIES = ("sparse", "dense", "function_score", "filtered",
-                    "sorted", "aggs", "percolate", "mesh", "untagged")
+                    "sorted", "aggs", "percolate", "mesh", "compact",
+                    "untagged")
 _FAMILY_SET = frozenset(COMPILE_FAMILIES)
 
 

@@ -134,6 +134,12 @@ class Engine:
         from .merge_policy import TieredMergePolicy
 
         self.merge_policy = TieredMergePolicy(settings)
+        # serializes merge COMPUTE (one merge_segments at a time per engine)
+        # without holding _lock across it: maybe_merge plans + publishes
+        # under _lock but rebuilds the merged segment outside it, so
+        # searches/writes never block on a running merge. Non-blocking
+        # acquire — a second maybe_merge caller returns instead of queueing
+        self._merge_mutex = threading.Lock()
         self._searcher_version = 0
         self._searcher: Searcher = Searcher([], version=0)
         # view listeners: called with (new_searcher | None, dropped_segments)
@@ -358,6 +364,11 @@ class Engine:
             new_seg: FrozenSegment | None = None
             if self._buffer.doc_count > 0:
                 new_seg = self._buffer.freeze()
+                # pack-kind hint for the capacity ledger / warmer scheduling:
+                # a refresh-frozen increment beside existing resident packs is
+                # a DELTA pack — bounded by the buffer, not the index
+                new_seg._device_cache["pack_hint"] = {
+                    "kind": "delta_pack" if self._segments else "pack"}
                 self._segments.append(new_seg)
                 self._next_gen += 1
                 self._buffer = SegmentBuilder(self._next_gen)
@@ -521,6 +532,15 @@ class Engine:
             if len(self._segments) <= max_num_segments:
                 return
             merged = merge_segments(self._segments, self._next_gen)
+            if merged.doc_count:
+                # same compaction pack hint as maybe_merge's publish: the
+                # force-merged segment's device planes concat from resident
+                # sources when eligible (refs only when all are resident)
+                hint = {"kind": "compact"}
+                if all(s._device_cache.get("packed") is not None
+                       for s in self._segments):
+                    hint["sources"] = tuple(self._segments)
+                merged._device_cache["pack_hint"] = hint
             self._next_gen += 1
             self._buffer = SegmentBuilder(self._next_gen)
             old_gens = [seg.gen for seg in self._segments]
@@ -551,29 +571,71 @@ class Engine:
             self._install_searcher()
             self.stats["merge_total"] += 1
 
-    def _merge_window(self, start: int, end: int):
-        """Merge self._segments[start:end] into one new-generation segment, preserving
-        list order (contiguous window ⇒ doc order and nested blocks survive). Same
-        commit-before-delete discipline as optimize()."""
-        to_merge = self._segments[start:end]
-        merged = merge_segments(to_merge, self._next_gen)
-        self._next_gen += 1
-        # keep the invariant buffer.gen == _next_gen (the buffer may hold unsearchable
-        # docs mid-merge; re-keying its gen is safe pre-freeze)
-        self._buffer.gen = self._next_gen
-        old_gens = [seg.gen for seg in to_merge]
-        any_persisted = any(g in self._persisted_gens for g in old_gens)
-        new_list = self._segments[:start] + \
-            ([merged] if merged.doc_count else []) + self._segments[end:]
-        self._segments = new_list
-        self._uid_index = {}
-        for seg in self._segments:
+    def _update_uid_index_for_merge(self, sources: list[FrozenSegment],
+                                    merged: FrozenSegment):
+        """Incremental _uid_index maintenance for one merge: only entries
+        OWNED by the merged-away generations change, so the update walks the
+        merge window's docs, never the whole index (the previous full-dict
+        rebuild was O(total docs) under _lock on every merge). A uid whose
+        entry already points at a newer generation (re-indexed since) is
+        left alone; dead source copies whose entry still points into the
+        window are pruned."""
+        source_gens = {seg.gen for seg in sources}
+        for seg in sources:
             for local in range(seg.doc_count):
-                if seg.parent_mask[local] and seg.live[local]:
-                    self._uid_index[f"{seg.types[local]}#{seg.ids[local]}"] = (seg.gen, local)
+                if not seg.parent_mask[local]:
+                    continue
+                uid = f"{seg.types[local]}#{seg.ids[local]}"
+                cur = self._uid_index.get(uid)
+                if cur is not None and cur[0] in source_gens:
+                    del self._uid_index[uid]
+        for local in range(merged.doc_count):
+            if merged.parent_mask[local] and merged.live[local]:
+                uid = f"{merged.types[local]}#{merged.ids[local]}"
+                self._uid_index[uid] = (merged.gen, local)
+
+    def _publish_merge(self, sources: list[FrozenSegment],
+                       merged: FrozenSegment) -> bool:
+        """Publish-under-lock half of a merge computed OUTSIDE the engine
+        lock: splice `merged` over the source window copy-on-write, keeping
+        the commit-before-delete discipline of optimize(). The sources must
+        still be the live list's objects (identity, contiguous) — a
+        concurrent refresh that tombstoned a source replaced it with a new
+        copy-on-write view, and publishing the merge would resurrect those
+        deletes, so the merge aborts instead (the policy re-plans on the
+        next tick). Caller holds _lock; returns False on abort."""
+        try:
+            start = next(i for i, s in enumerate(self._segments)
+                         if s is sources[0])
+        except StopIteration:
+            return False
+        end = start + len(sources)
+        if end > len(self._segments) or any(
+                a is not b for a, b in zip(self._segments[start:end], sources)):
+            return False
+        old_gens = [seg.gen for seg in sources]
+        any_persisted = any(g in self._persisted_gens for g in old_gens)
+        # compaction hint: the warmer/merge-pool pack assembles the merged
+        # segment's device planes from the sources' resident planes
+        # (ops/device_index.pack_segment_concat) instead of re-staging from
+        # host; the hint's source refs are dropped once the pack runs.
+        # Source refs are planted ONLY when every source is resident —
+        # otherwise the concat is ineligible anyway, and on a write-only
+        # shard (search_active unset, pack may never run) the hint would
+        # pin the merged-away window's arrays indefinitely
+        if merged.doc_count:
+            hint = {"kind": "compact"}
+            if all(s._device_cache.get("packed") is not None
+                   for s in sources):
+                hint["sources"] = tuple(sources)
+            merged._device_cache["pack_hint"] = hint
+        self._segments = self._segments[:start] + \
+            ([merged] if merged.doc_count else []) + self._segments[end:]
+        self._update_uid_index_for_merge(sources, merged)
         if any_persisted:
-            # commit point references old files: persist merged + write a new commit
-            # BEFORE deleting, or a crash makes the last commit unreadable
+            # commit point references old files: persist merged + write a new
+            # commit BEFORE deleting, or a crash makes the last commit
+            # unreadable
             for seg in self._segments:
                 if seg.gen not in self._persisted_gens:
                     self._segment_files[str(seg.gen)] = self.store.write_segment(seg)
@@ -590,17 +652,44 @@ class Engine:
             self._delete_segment_files(g)
         self._install_searcher()
         self.stats["merge_total"] += 1
+        return True
 
     def maybe_merge(self, max_merges: int = 4):
         """Run the tiered merge policy to convergence (bounded per call).
-        ref: InternalEngine.maybeMerge:942 + TieredMergePolicy selection."""
-        with self._lock:
-            self._check_open()
+        ref: InternalEngine.maybeMerge:942 + TieredMergePolicy selection.
+
+        The merge COMPUTE (merge_segments — O(window docs), the expensive
+        half) runs outside _lock so searches (`acquire_searcher`) and writes
+        proceed during a large merge; only planning and the copy-on-write
+        publish (_publish_merge, with its identity re-validation) hold the
+        lock. _merge_mutex keeps at most one merge computing per engine —
+        concurrent callers return immediately."""
+        if not self._merge_mutex.acquire(blocking=False):
+            return
+        try:
             for _ in range(max_merges):
-                spec = self.merge_policy.find_merge(self._segments)
-                if spec is None:
-                    return
-                self._merge_window(spec.start, spec.end)
+                with self._lock:
+                    self._check_open()
+                    spec = self.merge_policy.find_merge(self._segments)
+                    if spec is None:
+                        return
+                    sources = self._segments[spec.start:spec.end]
+                    gen = self._next_gen
+                    self._next_gen += 1
+                    # keep the invariant buffer.gen == _next_gen (the buffer
+                    # may hold unsearchable docs mid-merge; re-keying its gen
+                    # is safe pre-freeze)
+                    self._buffer.gen = self._next_gen
+                # the expensive rebuild — NO engine lock held
+                merged = merge_segments(sources, gen)
+                with self._lock:
+                    self._check_open()
+                    if not self._publish_merge(sources, merged):
+                        # a concurrent refresh invalidated the window; the
+                        # next maybe_merge re-plans against the live list
+                        return
+        finally:
+            self._merge_mutex.release()
 
     # ------------------------------------------------------------------ recovery
     def recover_from_store(self) -> int:

@@ -344,7 +344,18 @@ class FrozenSegment:
 
     # --- doc access ---------------------------------------------------------
     def live_count(self) -> int:
-        return int((self.live & self.parent_mask).sum())
+        # memoized on the tombstone generation: the merge policy's live-
+        # prorated sizing calls this for EVERY segment on every 0.5 s
+        # periodic tick, and the raw count is an O(doc_count) numpy pass.
+        # delete_doc/with_deletes bump live_gen, invalidating the memo; the
+        # one direct `live` replacement (store recovery's tombstone load)
+        # happens on a fresh segment before any count is taken
+        cached = self._device_cache.get("live_count")
+        if cached is not None and cached[0] == self.live_gen:
+            return cached[1]
+        n = int((self.live & self.parent_mask).sum())
+        self._device_cache["live_count"] = (self.live_gen, n)
+        return n
 
     def delete_doc(self, local: int):
         """Tombstone a doc and its nested children block (in place — use with_deletes
@@ -369,6 +380,12 @@ class FrozenSegment:
 
         new = dataclasses.replace(self, live=self.live.copy(),
                                   _device_cache=dict(self._device_cache))
+        # pack coordination state is PER VIEW: a copied in-flight future would
+        # resolve against the OLD view's cache dict and strand this view's
+        # waiters in a done-future loop (ops/device_index.packed_for); the
+        # new view re-coordinates its own pack/remask
+        new._device_cache.pop("pack_future", None)
+        new._device_cache.pop("pack_hint", None)
         for local in locals_to_delete:
             new.delete_doc(local)
         # share the packed postings but give the new view its own live mask
@@ -393,9 +410,18 @@ class FrozenSegment:
         return [uniq[o] for o in ords[off[local] : off[local + 1]]]
 
     def estimated_bytes(self) -> int:
+        # memoized: the merge policy sizes every segment on every
+        # periodic_refresh tick (2 Hz × shards × segments on the write-heavy
+        # path); the underlying arrays are immutable post-freeze, so the sum
+        # never changes. Copy-on-write views share the arrays AND the cached
+        # value (with_deletes shallow-copies the device cache)
+        n = self._device_cache.get("est_bytes")
+        if n is not None:
+            return n
         n = self.post_docs.nbytes + self.post_freqs.nbytes + self.positions.nbytes
         n += sum(a.nbytes for a in self.norms.values())
         n += sum(o.nbytes + v.nbytes for o, v in self.dv_num.values())
+        self._device_cache["est_bytes"] = n
         return n
 
 
@@ -441,7 +467,12 @@ def merge_segments(segments: list[FrozenSegment], gen: int) -> FrozenSegment:
                 f: sorted(terms, key=lambda tp: tp[1])
                 for f, terms in per_doc_postings[parent].items()
             }
-            doc.field_lengths = {f: len(t) for f, t in doc.postings.items()}
+            # norm-bearing fields only: the mapper never records lengths for
+            # meta fields (_uid/_id/_type), so a merged segment must not
+            # manufacture norms the sources lacked — scores (and the
+            # compaction concat pack) stay identical across a merge
+            doc.field_lengths = {f: len(t) for f, t in doc.postings.items()
+                                 if f in seg.norms}
             for f, (off, vals) in seg.dv_num.items():
                 v = vals[off[parent] : off[parent + 1]]
                 if len(v):
@@ -459,7 +490,8 @@ def merge_segments(segments: list[FrozenSegment], gen: int) -> FrozenSegment:
                     f: sorted(terms, key=lambda tp: tp[1])
                     for f, terms in per_doc_postings[child].items()
                 }
-                sub.field_lengths = {f: len(t) for f, t in sub.postings.items()}
+                sub.field_lengths = {f: len(t) for f, t in sub.postings.items()
+                                     if f in seg.norms}
                 doc.nested_docs.append((seg.nested_paths[child] or "", sub))
             builder.add(doc, version=int(seg.versions[parent]))
     return builder.freeze()

@@ -160,9 +160,36 @@ class IndicesService:
                 shard.last_scheduled_refresh = now
                 try:
                     shard.engine.refresh()
-                    shard.engine.maybe_merge()
+                    self._schedule_merge(shard.engine)
                 except SearchEngineError:
                     pass
+
+    def _schedule_merge(self, engine: Engine):
+        """Run the tiered-policy check on the `merge` pool (the reference's
+        ConcurrentMergeScheduler executor) instead of the refresh tick's
+        thread: merge COMPUTE already runs outside the engine lock
+        (Engine.maybe_merge), this keeps it off the refresh cadence too.
+        Duplicate submissions are cheap — maybe_merge's merge mutex makes
+        extras immediate no-ops. Falls back inline when no node/threadpool
+        is wired (unit tests driving IndicesService raw)."""
+        tp = getattr(self.node, "threadpool", None) if self.node else None
+        if tp is None:
+            try:
+                engine.maybe_merge()
+            except SearchEngineError:
+                pass
+            return
+        try:
+            tp.submit("merge", self._checked_merge, engine)
+        except Exception:  # noqa: BLE001 — rejected/shut-down pool: the next
+            pass           # refresh tick re-schedules
+
+    @staticmethod
+    def _checked_merge(engine: Engine):
+        try:
+            engine.maybe_merge()
+        except SearchEngineError:
+            pass
 
     # ------------------------------------------------------------ access
     def index_service(self, name: str) -> IndexService:
@@ -261,7 +288,8 @@ class IndicesService:
             return  # unwired contexts (unit tests driving IndicesService raw)
         rcache = getattr(node, "request_cache", None)
         fcache = getattr(node, "filter_cache", None)
-        if rcache is None and fcache is None:
+        if rcache is None and fcache is None \
+                and getattr(node, "warmer", None) is None:
             return
 
         def on_view_change(searcher, dropped):
@@ -274,6 +302,12 @@ class IndicesService:
                     dropped, () if searcher is None else searcher.segments)
 
         engine.view_listeners.append(on_view_change)
+        # the warmer's listener is appended AFTER cache invalidation so a
+        # re-prime never races the eviction of its own view's entries
+        # (listeners run in order, under the engine lock, as leaves)
+        warmer = getattr(node, "warmer", None)
+        if warmer is not None:
+            warmer.wire(index, sid, engine)
 
     def _drop_shard_caches(self, index: str, shard: "IndexShard | None"):
         """A shard leaving this node releases every cache byte it holds —

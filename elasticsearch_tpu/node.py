@@ -162,6 +162,14 @@ class Node:
 
         self.snapshots = SnapshotsService(self)
         self.percolator = PercolatorService(self)
+        # index warmer (ISSUE 14): every searcher install schedules the new
+        # view's device packs/remasks on the warmer/merge pools (so the
+        # query path stops paying them) and replays the shard's hottest
+        # request-cache bodies against the new view
+        # (`indices.warmer.enabled` gates the re-prime half)
+        from .warmer import IndexWarmerService
+
+        self.warmer = IndexWarmerService(self)
         self.indices.node = self
         self.monitor = MonitorService(self)
         # stall watchdog: management-pool periodic comparing live in-flight
@@ -1063,6 +1071,9 @@ class Client:
             # device capacity ledger: per-index/per-segment HBM residency by
             # tier + pack/repack timings + compile events by plan family
             "device": self._device_section,
+            # index warmer: off-query-path pack scheduling + post-refresh
+            # cache re-prime counters (warmer.py)
+            "warmer": lambda: self.node.warmer.stats(),
             # stall watchdog + event journal occupancy
             "events": lambda: {
                 "journal": self.node.events.stats(),

@@ -103,6 +103,23 @@ def tf_plane_itemsize(layout: str) -> int:
     return np.dtype(_TF_DTYPE[layout]).itemsize
 
 
+def tf_plane_integral(post_freqs: np.ndarray, layout: str) -> bool:
+    """Whether a segment's raw tf values are all integers. u8/i16 rungs are
+    integral by construction; the f32 escape covers both huge-but-integral
+    tf (> 2^15-1) and genuinely fractional tf (index-time boost folding) —
+    only the former is exactly reconstructible from positions, which is
+    what gates the compaction concat path (merge_segments rebuilds freq as
+    the position count). Chunked like choose_tf_layout's scan."""
+    if layout != TF_F32:
+        return True
+    chunk = 1 << 20
+    for i in range(0, len(post_freqs), chunk):
+        c = post_freqs[i: i + chunk]
+        if not np.all(c == np.floor(c)):
+            return False
+    return True
+
+
 @dataclass
 class SimTables:
     """Stacked per-field similarity decode state for the quantized sparse scan:
@@ -136,6 +153,11 @@ class PackedSegment:
     blk_tf: object = None  # jnp uint8/int16/float32 [NBpad, B]
     blk_nb: object = None  # jnp uint8 [NBpad, B] — per-posting norm byte
     tf_layout: str = TF_U8  # TF_U8 | TF_I16 | TF_F32
+    # raw tf values are all integers (always true for the u8/i16 rungs; the
+    # f32 escape scans once at pack time). Merge compaction may device-concat
+    # source planes ONLY when every source is integral — merge_segments
+    # rebuilds freq as the position count, so fractional tf would diverge
+    tf_integral: bool = True
     sim: SimTables | None = None  # ensure_sim_tables state
     # dense-fallback plane, uploaded LAZILY (ensure_blk_freqs): most segments
     # only ever serve the sparse path and never pay these 4 B/posting
@@ -263,17 +285,43 @@ def packed_tier_bytes(packed: PackedSegment) -> dict:
     }
 
 
+def _pool_label() -> str:
+    """Which named threadpool is running the current thread — the ledger's
+    pack attribution. Pool workers are named "estpu[<pool>]_N"
+    (threadpool._BoundedPool's thread_name_prefix); anything else (a test's
+    main thread, a raw Thread) reads as "other". One string parse on the
+    already-cold pack path."""
+    name = threading.current_thread().name
+    if name.startswith("estpu[") and "]" in name:
+        return name[len("estpu["): name.index("]")]
+    return "other"
+
+
+# ledger kind= vocabulary: "pack" (initial/full pack), "delta_pack" (a
+# refresh-frozen increment — bounded by the buffer, not the index),
+# "remask" (tombstone-driven live-mask refresh), "compact" (a merged
+# segment's pack, device-concat from the sources' resident planes when
+# eligible, host-staged otherwise — the event's method= field says which)
+PACK_KINDS = ("pack", "delta_pack", "remask", "compact")
+_KIND_COUNTER = {"pack": "packs", "delta_pack": "delta_packs",
+                 "remask": "remasks", "compact": "compacts"}
+
+
 class PackLedger:
     """Process-wide pack/repack timing ledger, keyed by index.
 
-    `packed_for` records every segment pack (and live-mask remask) here with
-    its wall time, resident bytes, and tf layout; the capacity report joins
-    these against the live per-segment tier walk. Process-wide like
-    search/service.SERVING_COUNTERS (in-process test clusters share it);
-    bounded: at most MAX_INDICES index entries (LRU) each holding cumulative
-    counters + a RING of recent events. `_lock` is a LEAF (dict mutation
-    only) and recording happens on the already-cold pack path — the warmed
-    serving loop never touches it."""
+    `packed_for` records every segment pack (delta pack, compaction pack,
+    and live-mask remask) here with its wall time, resident bytes, tf
+    layout, the PACK_KINDS kind, the threadpool that did the work (pool=
+    "warmer"/"merge"/"search"/"other" — the query-path-vs-background
+    attribution the writes acceptance pins), and for compaction packs the
+    method ("concat" = device-side plane concat, "staged" = host re-stage).
+    The capacity report joins these against the live per-segment tier walk.
+    Process-wide like search/service.SERVING_COUNTERS (in-process test
+    clusters share it); bounded: at most MAX_INDICES index entries (LRU)
+    each holding cumulative counters + a RING of recent events. `_lock` is
+    a LEAF (dict mutation only) and recording happens on the already-cold
+    pack path — the warmed serving loop never touches it."""
 
     MAX_INDICES = 256
     RING = 16
@@ -283,24 +331,32 @@ class PackLedger:
         self._by_index: "OrderedDict[str, dict]" = OrderedDict()
 
     def record(self, index: str | None, gen: int, ms: float, nbytes: int,
-               layout: str, kind: str = "pack") -> None:
+               layout: str, kind: str = "pack", pool: str | None = None,
+               method: str | None = None) -> None:
         index = index or "_unattributed"
+        pool = pool or _pool_label()
         with self._lock:
             entry = self._by_index.get(index)
             if entry is None:
-                entry = {"packs": 0, "remasks": 0, "pack_ms_total": 0.0,
-                         "recent": []}
+                entry = {"packs": 0, "delta_packs": 0, "remasks": 0,
+                         "compacts": 0, "pack_ms_total": 0.0,
+                         "pools": {}, "recent": []}
                 self._by_index[index] = entry
                 while len(self._by_index) > self.MAX_INDICES:
                     self._by_index.popitem(last=False)
             else:
                 self._by_index.move_to_end(index)
-            entry["packs" if kind == "pack" else "remasks"] += 1
+            entry[_KIND_COUNTER.get(kind, "packs")] += 1
             entry["pack_ms_total"] += ms
+            pools = entry["pools"]
+            pools[pool] = pools.get(pool, 0) + 1
             recent = entry["recent"]
-            recent.append({"kind": kind, "generation": int(gen),
-                           "ms": round(ms, 3), "bytes": int(nbytes),
-                           "tf_layout": layout})
+            event = {"kind": kind, "generation": int(gen),
+                     "ms": round(ms, 3), "bytes": int(nbytes),
+                     "tf_layout": layout, "pool": pool}
+            if method is not None:
+                event["method"] = method
+            recent.append(event)
             if len(recent) > self.RING:
                 del recent[: len(recent) - self.RING]
 
@@ -310,19 +366,20 @@ class PackLedger:
         with self._lock:
             self._by_index.pop(index, None)
 
+    @staticmethod
+    def _row(e: dict) -> dict:
+        return {"packs": e["packs"], "delta_packs": e["delta_packs"],
+                "remasks": e["remasks"], "compacts": e["compacts"],
+                "pack_ms_total": round(e["pack_ms_total"], 3),
+                "pools": dict(e["pools"]),
+                "recent": list(e["recent"])}
+
     def stats(self, index: str | None = None) -> dict:
         with self._lock:
             if index is not None:
                 e = self._by_index.get(index)
-                return {} if e is None else {
-                    "packs": e["packs"], "remasks": e["remasks"],
-                    "pack_ms_total": round(e["pack_ms_total"], 3),
-                    "recent": list(e["recent"])}
-            return {
-                idx: {"packs": e["packs"], "remasks": e["remasks"],
-                      "pack_ms_total": round(e["pack_ms_total"], 3),
-                      "recent": list(e["recent"])}
-                for idx, e in self._by_index.items()}
+                return {} if e is None else self._row(e)
+            return {idx: self._row(e) for idx, e in self._by_index.items()}
 
 
 PACK_LEDGER = PackLedger()
@@ -408,6 +465,34 @@ def bytes_per_posting(layout: str, dense_resident: bool = False) -> int:
     return 4 + tf_plane_itemsize(layout) + 1 + (4 if dense_resident else 0)
 
 
+def _host_columns(seg: FrozenSegment, Dpad: int, put,
+                  fields: list[str] | None = None):
+    """The O(D) per-doc columns every pack uploads — live mask, per-field
+    norm bytes, single-valued numeric dv — shared by pack_segment and the
+    compaction concat pack (which re-stages ONLY these small columns from
+    host; the O(P) postings planes concat device-side)."""
+    live_parent = np.zeros(Dpad, dtype=bool)
+    live_parent[: seg.doc_count] = seg.live & seg.parent_mask
+
+    norm_bytes = {}
+    for f, arr in seg.norms.items():
+        if fields is not None and f not in fields:
+            continue
+        padded = np.zeros(Dpad, dtype=np.uint8)
+        padded[: seg.doc_count] = arr
+        norm_bytes[f] = put(padded)
+
+    dv_single = {}
+    for f, (off, vals) in seg.dv_num.items():
+        counts_dv = np.diff(off)
+        if counts_dv.max(initial=0) <= 1:
+            col = np.full(Dpad, np.nan, dtype=np.float64)
+            has = counts_dv == 1
+            col[: seg.doc_count][has] = vals
+            dv_single[f] = put(col)
+    return live_parent, norm_bytes, dv_single
+
+
 def pack_segment(seg: FrozenSegment, fields: list[str] | None = None,
                  device_put=None) -> PackedSegment:
     """Pack a frozen segment's postings + norms + single-valued numeric columns for
@@ -449,25 +534,8 @@ def pack_segment(seg: FrozenSegment, fields: list[str] | None = None,
     if NB:
         blk_field[:NB] = np.repeat(fid_of_tid, nblks)
 
-    live_parent = np.zeros(Dpad, dtype=bool)
-    live_parent[: seg.doc_count] = seg.live & seg.parent_mask
-
-    norm_bytes = {}
-    for f, arr in seg.norms.items():
-        if fields is not None and f not in fields:
-            continue
-        padded = np.zeros(Dpad, dtype=np.uint8)
-        padded[: seg.doc_count] = arr
-        norm_bytes[f] = put(padded)
-
-    dv_single = {}
-    for f, (off, vals) in seg.dv_num.items():
-        counts_dv = np.diff(off)
-        if counts_dv.max(initial=0) <= 1:
-            col = np.full(Dpad, np.nan, dtype=np.float64)
-            has = counts_dv == 1
-            col[: seg.doc_count][has] = vals
-            dv_single[f] = put(col)
+    live_parent, norm_bytes, dv_single = _host_columns(seg, Dpad, put,
+                                                       fields=fields)
 
     # dead/non-parent docs are masked to the sentinel IN the uploaded postings, so no
     # scoring path needs a per-posting live gather; host_docs keeps the raw ids for
@@ -499,6 +567,182 @@ def pack_segment(seg: FrozenSegment, fields: list[str] | None = None,
         blk_tf=put(flat_tf.reshape(NBpad, BLOCK)),
         blk_nb=put(flat_nb.reshape(NBpad, BLOCK)),
         tf_layout=tf_layout,
+        tf_integral=tf_plane_integral(seg.post_freqs, tf_layout),
+        term_blk_start=blk_start,
+        live_parent=put(live_parent),
+        norm_bytes=norm_bytes,
+        dv_single=dv_single,
+        host_docs=flat_docs,
+        host_freqs=flat_freqs,
+        blk_field=blk_field,
+        field_names=field_names,
+    )
+
+
+# ---------------------------------------------------------------------------
+# compaction concat pack: a merged segment's planes from its sources' planes
+# ---------------------------------------------------------------------------
+
+# per-slot transient allowance for the concat program's live gather/select
+# buffers on device (a handful of [NB, B] i32/f32 temporaries alive at the
+# fused program's peak, amortized per output slot)
+CONCAT_TRANSIENT_SLOT_BYTES = 8
+
+_TF_RANK = {TF_U8: 0, TF_I16: 1, TF_F32: 2}
+
+
+def concat_source_packs(sources) -> list[PackedSegment] | None:
+    """The sources' resident packs when the compaction concat path is legal,
+    else None (callers fall back to the host-staged pack_segment):
+
+    - every source must be fully live (a tombstoned source's postings are
+      dropped by merge_segments, so j-th-posting alignment breaks) and its
+      pack resident + current (no pending remask);
+    - every source's tf plane must be integral (merge rebuilds freq as the
+      position count — fractional f32 tf would diverge bitwise).
+    """
+    packs = []
+    for src in sources:
+        cache = getattr(src, "_device_cache", None)
+        packed = cache.get("packed") if cache is not None else None
+        if packed is None or cache.get("live") is None:
+            return None
+        if not bool(src.live.all()):
+            return None
+        if not packed.tf_integral:
+            return None
+        packs.append(packed)
+    return packs
+
+
+def concat_estimate_bytes(merged: FrozenSegment, sources) -> int:
+    """Host-staging + device-allocation bytes pack_segment_concat will use —
+    the fielddata-breaker estimate for the compaction pack, exact for the
+    concat layout the way pack_estimate_bytes is for the staged one. The
+    O(P) postings planes are DEVICE outputs plus retained host copies (for
+    future remasks / the lazy dense plane) — the host→device upload is only
+    the O(NB + W·T + D) tables and columns, which is the whole point."""
+    NBpad, Dpad, layout = pack_shape_math(merged)
+    tf_b = tf_plane_itemsize(layout)
+    W = len(sources)
+    T = len(merged.post_offsets) - 1
+    n_norm_fields = len(merged.norms)
+    n_dv = len(merged.dv_num)
+    # retained host planes + device output planes + fused-program transients
+    per_slot = (4 + 4) + (4 + tf_b + 1) + CONCAT_TRANSIENT_SLOT_BYTES
+    # + blk_term/blk_j0 rows, the [W+1,T] cum + [W,T] start tables (host
+    # build + device copy), and the Dpad-wide masks/columns
+    return (NBpad * BLOCK * per_slot + NBpad * 4 * 2
+            + (2 * W + 1) * T * 4 * 2 + Dpad * 2
+            + Dpad * n_norm_fields + Dpad * 8 * n_dv)
+
+
+def pack_segment_concat(merged: FrozenSegment,
+                        sources) -> PackedSegment | None:
+    """Assemble a merged segment's pack by CONCATENATING its sources'
+    already-resident device planes — re-blocked to the merged term layout by
+    one fused gather/select program (ops/scoring.concat_pack_planes), tf
+    rungs widened per the choose_tf_layout ladder — instead of re-staging
+    O(postings) bytes from host. Legal exactly when merge preserved every
+    posting in source order (concat_source_packs); the result is bitwise
+    identical to pack_segment(merged) by construction, pinned by the writes
+    parity tests. Returns None when ineligible or when the layout cross-check
+    fails (callers fall back to the staged pack)."""
+    import jax.numpy as jnp
+
+    from ..common.jaxenv import compile_tag
+    from .scoring import concat_pack_planes
+
+    packs = concat_source_packs(sources)
+    if packs is None or not len(merged.post_docs):
+        return None
+    if merged.doc_count != sum(s.doc_count for s in sources):
+        return None  # docs were dropped: source order ≠ merged order
+    NBpad, Dpad, layout = pack_shape_math(merged)
+    widest = max((p.tf_layout for p in packs), key=lambda l: _TF_RANK[l])
+    if layout != widest:
+        return None  # freq drift between sources and merged CSR: re-stage
+
+    T = len(merged.post_offsets) - 1
+    counts_m = np.diff(merged.post_offsets)
+    W = len(sources)
+    # per (source, merged-term): posting count + the source's block start.
+    # Terms resolve by name through each source's term dict (O(T·W) dict
+    # lookups — proportional to vocabulary, not postings)
+    cnt = np.zeros((W, T), dtype=np.int32)
+    starts = np.zeros((W, T), dtype=np.int32)
+    for s, (src, packed_s) in enumerate(zip(sources, packs)):
+        src_counts = np.diff(src.post_offsets)
+        for f, td_m in merged.term_dict.items():
+            td_s = src.term_dict.get(f)
+            if not td_s:
+                continue
+            for term, tid_m in td_m.items():
+                tid_s = td_s.get(term)
+                if tid_s is not None:
+                    cnt[s, tid_m] = src_counts[tid_s]
+                    starts[s, tid_m] = packed_s.term_blk_start[tid_s]
+    cum = np.zeros((W + 1, T), dtype=np.int32)
+    np.cumsum(cnt, axis=0, out=cum[1:])
+    if not np.array_equal(cum[-1], counts_m):
+        return None  # per-term counts disagree with the merged CSR
+
+    nblks = (counts_m + BLOCK - 1) // BLOCK
+    blk_start = np.zeros(T + 1, dtype=np.int64)
+    np.cumsum(nblks, out=blk_start[1:])
+    NB = int(blk_start[-1])
+    blk_term = np.zeros(NBpad, dtype=np.int32)
+    # pad rows: a huge within-term offset makes every select miss, so the
+    # outputs keep their sentinel/zero initializers — same bytes the staged
+    # pack writes there
+    blk_j0 = np.full(NBpad, 1 << 30, dtype=np.int32)
+    if NB:
+        blk_term[:NB] = np.repeat(np.arange(T, dtype=np.int32), nblks)
+        blk_j0[:NB] = ((np.arange(NB, dtype=np.int64)
+                        - np.repeat(blk_start[:-1], nblks))
+                       * BLOCK).astype(np.int32)
+    bases = np.asarray(
+        np.cumsum([0] + [s.doc_count for s in sources[:-1]]), dtype=np.int32)
+    doc_pads = np.asarray([p.doc_pad for p in packs], dtype=np.int32)
+
+    with compile_tag("compact"):
+        out_docs, out_tf, out_nb = concat_pack_planes(
+            jnp.asarray(blk_term), jnp.asarray(blk_j0), jnp.asarray(cum),
+            jnp.asarray(starts), jnp.asarray(bases), jnp.asarray(doc_pads),
+            tuple(p.blk_docs for p in packs),
+            tuple(p.blk_tf for p in packs),
+            tuple(p.blk_nb for p in packs),
+            doc_pad_new=Dpad, tf_layout=layout)
+
+    # retained host copies (live-mask remasks, the lazy dense plane) — host
+    # numpy only, never uploaded here
+    flat_docs = np.full(NBpad * BLOCK, Dpad, dtype=np.int32)
+    flat_freqs = np.zeros(NBpad * BLOCK, dtype=np.float32)
+    slots = expand_ranges(blk_start[:-1] * BLOCK, counts_m)
+    flat_docs[slots] = merged.post_docs
+    flat_freqs[slots] = merged.post_freqs
+
+    field_names = list(merged.term_dict.keys())
+    fid_of_tid = np.full(T, -1, dtype=np.int32)
+    for fo, f in enumerate(field_names):
+        tids = np.fromiter(merged.term_dict[f].values(), dtype=np.int64,
+                           count=len(merged.term_dict[f]))
+        fid_of_tid[tids] = fo
+    blk_field = np.full(NBpad, -1, dtype=np.int32)
+    if NB:
+        blk_field[:NB] = np.repeat(fid_of_tid, nblks)
+
+    put = jnp.asarray
+    live_parent, norm_bytes, dv_single = _host_columns(merged, Dpad, put)
+    return PackedSegment(
+        gen=merged.gen,
+        doc_count=merged.doc_count,
+        doc_pad=Dpad,
+        blk_docs=out_docs,
+        blk_tf=out_tf,
+        blk_nb=out_nb,
+        tf_layout=layout,
+        tf_integral=True,  # gated on every source being integral
         term_blk_start=blk_start,
         live_parent=put(live_parent),
         norm_bytes=norm_bytes,
@@ -840,6 +1084,42 @@ class DeviceFilterCache:
             self.breaker.release(released)
         return evicted
 
+    # -- warmer integration --------------------------------------------------
+    def hot_keys(self, segs) -> set:
+        """The filter keys that earned residency (or at least the sighting
+        threshold) on any of `segs` — what the warmer carries from a dropped
+        view's holders onto the new view's segments."""
+        keys: set = set()
+        with self._lock:
+            for seg in segs:
+                holder = seg._device_cache.get("filter_masks")
+                if holder is None:
+                    continue
+                keys.update(holder.masks.keys())
+                keys.update(k for k, c in holder.seen.items()
+                            if c >= self.min_sightings)
+        return keys
+
+    def seed(self, seg, keys) -> int:
+        """Warmer pre-seeding: mark `keys` as already-seen on a segment so
+        the NEXT evaluation (the warm replay, or the first live sighting)
+        promotes the mask to device residency immediately instead of paying
+        the min_sightings ramp on every fresh delta segment. Counter work
+        only — no masks are built or uploaded here."""
+        if not self.enabled or not keys:
+            return 0
+        holder = self._holder(seg)
+        seeded = 0
+        with self._lock:
+            if holder.dead:
+                return 0
+            for k in keys:
+                if k not in holder.masks \
+                        and holder.seen.get(k, 0) < self.min_sightings:
+                    holder.seen[k] = self.min_sightings
+                    seeded += 1
+        return seeded
+
     # -- observability -------------------------------------------------------
     def hit_rate(self) -> float:
         n = self.hits + self.misses
@@ -924,58 +1204,226 @@ def ensure_sim_tables(packed: PackedSegment,
     return sim
 
 
-def packed_for(seg: FrozenSegment, breaker=None,
-               owner: str | None = None) -> PackedSegment:
-    """Per-segment cached packing; refreshes the live mask when tombstones changed.
+# coordinates the per-segment pack/remask futures: a LEAF lock guarding only
+# _device_cache dict reads/writes — the pack compute, every device_put, and
+# every Future wait happen OUTSIDE it (the PR-6 discipline). One module-level
+# lock instead of a per-segment dial lock: it is only ever taken on the cold
+# miss/publish paths, never on the warmed packed-and-live fast path
+_PACK_LOCK = threading.Lock()
 
-    `breaker` (the node's fielddata child) is consulted ONLY on a cache miss:
-    the estimate covers the pack's host staging + device upload and is released
-    once the pack lands — transient accounting, so a drained node reads 0.
-    A trip raises CircuitBreakingError; serving falls back to the host scorer
-    (the one graceful-degradation edge the reference lacks).
 
-    `owner` (the index name, from ShardContext) attributes the pack's wall
-    time to the capacity ledger (PACK_LEDGER). The pack/remask paths are cold
-    by construction (once per segment per view), so timing them always is
-    within the zero-added-clocks contract — the cache-HIT path stays
-    clock-free."""
+def begin_warm(seg: FrozenSegment):
+    """Install the in-flight marker for a segment whose pack (or remask) is
+    about to be scheduled off the query path. Returns the Future a racing
+    search will wait on, or None when the segment is already fully live or
+    another pack is in flight. Dict work only — safe to call from an engine
+    view listener (which runs under the engine lock).
+
+    The marker is CLAIMABLE: the pack is performed by whoever claims it
+    first — normally the scheduled warmer/merge task, but a search (or a
+    warm query) that arrives before the task starts STEALS the work and
+    packs inline, resolving the same future. Waiting therefore only ever
+    happens on a pack that is actively RUNNING on some thread, which
+    completes without needing any pool slot — a waiter can never deadlock
+    behind pack work queued on its own pool."""
+    from concurrent.futures import Future
+
     cache = seg._device_cache
-    packed: PackedSegment | None = cache.get("packed")
-    prof = _profile.current()
-    if packed is None:
-        t0 = time.monotonic()
-        with reserve(breaker, pack_estimate_bytes(seg), f"<segment_pack>[{seg.gen}]"):
-            packed = pack_segment(seg)
-        cache["packed"] = packed
-        cache["live"] = True
-        ms = (time.monotonic() - t0) * 1000.0
-        PACK_LEDGER.record(owner, seg.gen, ms,
-                           packed_resident_bytes(packed), packed.tf_layout)
-        if prof is not None:
-            prof.event("packed_segment", gen=int(seg.gen), cache="pack",
-                       ms=round(ms, 4),
-                       resident_bytes=int(packed_resident_bytes(packed)),
-                       tf_layout=packed.tf_layout)
-    elif cache.get("live") is None:
-        import jax.numpy as jnp
+    with _PACK_LOCK:
+        if cache.get("packed") is not None and cache.get("live") is not None:
+            return None
+        if cache.get("pack_future") is not None:
+            return None
+        fut: Future = Future()
+        cache["pack_future"] = fut
+        cache["pack_claimed"] = False
+        return fut
 
+
+def cancel_warm(seg: FrozenSegment, fut) -> None:
+    """Withdraw a begin_warm future whose pool submission was rejected
+    (node shutting down / saturated): clear the marker and resolve the
+    future with None so any racer that started waiting re-enters the
+    packed_for loop and packs inline with its own budget. A no-op when a
+    racer already claimed the work — the claimant owns the future now."""
+    cache = seg._device_cache
+    with _PACK_LOCK:
+        if cache.get("pack_future") is not fut or cache.get("pack_claimed"):
+            return
+        cache.pop("pack_future", None)
+        cache.pop("pack_claimed", None)
+    if not fut.done():
+        fut.set_result(None)
+
+
+def run_warm(seg: FrozenSegment, fut, breaker=None,
+             owner: str | None = None):
+    """Execute the pack/remask a `begin_warm` future stands for — the
+    warmer/merge pool worker body. Returns immediately (None) when a racing
+    search already claimed the work: the claimant resolves the future on
+    its own thread, so parking this pool slot to wait would buy nothing.
+    Exceptions (a fielddata breaker trip, a device error) resolve the
+    future so query-path waiters degrade exactly as an inline pack failure
+    would, and the marker is cleared so a later query retries with its own
+    budget."""
+    cache = seg._device_cache
+    with _PACK_LOCK:
+        if cache.get("pack_future") is not fut or cache.get("pack_claimed"):
+            return None
+        cache["pack_claimed"] = True
+    return _perform_pack(seg, fut, breaker, owner)
+
+
+def _perform_pack(seg: FrozenSegment, fut, breaker,
+                  owner: str | None) -> PackedSegment:
+    """Pack (full, delta, or compaction-concat) or remask one segment and
+    publish under the leaf lock. The caller owns `fut` (installed in
+    seg._device_cache["pack_future"]); every waiter observes the publish
+    through it."""
+    import jax.numpy as jnp
+
+    cache = seg._device_cache
+    prof = _profile.current()
+    try:
+        packed: PackedSegment | None = cache.get("packed")
+        if packed is None:
+            hint = cache.get("pack_hint") or {}
+            kind = hint.get("kind", "pack")
+            sources = hint.get("sources")
+            t0 = time.monotonic()
+            new_packed = None
+            method = "staged" if kind == "compact" else None
+            if kind == "compact" and sources:
+                with reserve(breaker, concat_estimate_bytes(seg, sources),
+                             f"<segment_compact>[{seg.gen}]"):
+                    new_packed = pack_segment_concat(seg, sources)
+                if new_packed is not None:
+                    method = "concat"
+            if new_packed is None:
+                with reserve(breaker, pack_estimate_bytes(seg),
+                             f"<segment_pack>[{seg.gen}]"):
+                    new_packed = pack_segment(seg)
+            with _PACK_LOCK:
+                cache["packed"] = new_packed
+                cache["live"] = True
+                cache.pop("pack_future", None)
+                cache.pop("pack_claimed", None)
+                cache.pop("pack_hint", None)  # drops the source refs
+            ms = (time.monotonic() - t0) * 1000.0
+            PACK_LEDGER.record(owner, seg.gen, ms,
+                               packed_resident_bytes(new_packed),
+                               new_packed.tf_layout, kind=kind, method=method)
+            if prof is not None:
+                prof.event("packed_segment", gen=int(seg.gen), cache=kind,
+                           ms=round(ms, 4),
+                           resident_bytes=int(
+                               packed_resident_bytes(new_packed)),
+                           tf_layout=new_packed.tf_layout)
+            fut.set_result(new_packed)
+            return new_packed
+        # remask: the pack is resident but the view's tombstones moved
         t0 = time.monotonic()
         live_parent = np.zeros(packed.doc_pad, dtype=bool)
         live_parent[: seg.doc_count] = seg.live & seg.parent_mask
-        packed.live_parent = jnp.asarray(live_parent)
+        lp_dev = jnp.asarray(live_parent)
         # postings carry the live mask inline (sparse path has no per-posting
         # live gather) — re-mask from the raw host copy
-        masked = np.where(live_parent[np.minimum(packed.host_docs, packed.doc_pad - 1)]
+        masked = np.where(live_parent[np.minimum(packed.host_docs,
+                                                 packed.doc_pad - 1)]
                           & (packed.host_docs < packed.doc_pad),
                           packed.host_docs,
                           packed.doc_pad).astype(np.int32, copy=False)
-        packed.blk_docs = jnp.asarray(masked.reshape(-1, BLOCK))
-        cache["live"] = True
+        docs_dev = jnp.asarray(masked.reshape(-1, BLOCK))
+        with _PACK_LOCK:
+            packed.live_parent = lp_dev
+            packed.blk_docs = docs_dev
+            cache["live"] = True
+            cache.pop("pack_future", None)
+            cache.pop("pack_claimed", None)
         PACK_LEDGER.record(owner, seg.gen, (time.monotonic() - t0) * 1000.0,
                            packed_resident_bytes(packed), packed.tf_layout,
                            kind="remask")
         if prof is not None:
-            prof.event("packed_segment", gen=int(seg.gen), cache="live_remask")
-    elif prof is not None:
-        prof.event("packed_segment", gen=int(seg.gen), cache="hit")
-    return packed
+            prof.event("packed_segment", gen=int(seg.gen),
+                       cache="live_remask")
+        fut.set_result(packed)
+        return packed
+    except BaseException as e:  # noqa: BLE001 — waiters must never hang
+        with _PACK_LOCK:
+            if cache.get("pack_future") is fut:
+                cache.pop("pack_future", None)
+                cache.pop("pack_claimed", None)
+        fut.set_exception(e)
+        raise
+
+
+def packed_for(seg: FrozenSegment, breaker=None,
+               owner: str | None = None) -> PackedSegment:
+    """Per-segment cached packing; refreshes the live mask when tombstones
+    changed. The warmed fast path is one unlocked dict read; the cold path
+    coordinates through a per-segment in-flight Future so a search racing a
+    scheduled warmer/merge pack WAITS for it (the PR-6 mesh `_executor_for`
+    idiom) instead of duplicating the work — in the warmed continuous-
+    indexing loop, every query-path call is a cache hit and all pack work
+    lands on the warmer/merge pools (PACK_LEDGER pool attribution).
+
+    `breaker` (the node's fielddata child) is consulted ONLY when this call
+    ends up owning the pack: the estimate covers the pack's host staging +
+    device upload and is released once the pack lands — transient
+    accounting, so a drained node reads 0. A trip raises
+    CircuitBreakingError; serving falls back to the host scorer (the one
+    graceful-degradation edge the reference lacks). A failed WARM pack
+    propagates the same way to any waiter, and later calls retry inline.
+
+    `owner` (the index name, from ShardContext) attributes the pack's wall
+    time to the capacity ledger (PACK_LEDGER). The pack/remask paths are
+    cold by construction (once per segment per view), so timing them always
+    is within the zero-added-clocks contract — the cache-HIT path stays
+    clock-free."""
+    cache = seg._device_cache
+    packed: PackedSegment | None = cache.get("packed")
+    if packed is not None and cache.get("live") is not None:
+        prof = _profile.current()
+        if prof is not None:
+            prof.event("packed_segment", gen=int(seg.gen), cache="hit")
+        return packed
+    while True:
+        with _PACK_LOCK:
+            packed = cache.get("packed")
+            if packed is not None and cache.get("live") is not None:
+                return packed
+            fut = cache.get("pack_future")
+            if fut is None:
+                from concurrent.futures import Future
+
+                fut = Future()
+                cache["pack_future"] = fut
+                cache["pack_claimed"] = True
+                own = True
+            elif not cache.get("pack_claimed"):
+                # a scheduled warm pack that hasn't STARTED yet: steal it —
+                # this call performs the pack inline and resolves the shared
+                # future (run_warm sees the claim and returns). Waiting is
+                # therefore reserved for packs actively running on another
+                # thread, which finish without needing any pool slot — a
+                # warm query on the warmer pool can never deadlock behind
+                # pack tasks queued on that same pool
+                cache["pack_claimed"] = True
+                own = True
+            else:
+                own = False
+        if own:
+            return _perform_pack(seg, fut, breaker, owner)
+        # another thread is actively packing: wait OUTSIDE every lock; its
+        # failure (e.g. breaker trip) propagates here and degrades to the
+        # host scorer exactly like an inline trip
+        prof = _profile.current()
+        if prof is not None:
+            prof.event("packed_segment", gen=int(seg.gen), cache="pack_wait")
+        fut.result()
+        with _PACK_LOCK:
+            # defensive: a resolved future must never be waited on twice
+            # (guarantees loop progress even if a publish went missing)
+            if cache.get("pack_future") is fut:
+                cache.pop("pack_future", None)
+                cache.pop("pack_claimed", None)

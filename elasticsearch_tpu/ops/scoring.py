@@ -1169,3 +1169,77 @@ def build_term_batch(entries: list, n_queries: int, n_must: np.ndarray, msm: np.
         tfmode=tfmode, n_must=n_must.astype(np.int32), msm=msm.astype(np.int32),
         coord=coord.astype(np.float32), norm_fields=norm_fields, caches=caches,
     )
+
+
+# ---------------------------------------------------------------------------
+# compaction concat: re-block merged postings planes from resident sources
+# ---------------------------------------------------------------------------
+
+
+def _concat_impl(blk_term, blk_j0, cum, starts, bases, doc_pads,
+                 src_docs, src_tf, src_nb, *, doc_pad_new: int,
+                 tf_layout: str):
+    """One fused gather/select program assembling a merged segment's
+    quantized postings planes from its sources' RESIDENT planes — the
+    device half of ops/device_index.pack_segment_concat (HBM → HBM, no host
+    staging of the O(postings) data).
+
+    Per output slot (block row nb, lane): the owning merged term is
+    `blk_term[nb]` (blocks never span terms), the within-term flat offset is
+    `blk_j0[nb] + lane`, and the per-term cumulative source counts `cum`
+    pick WHICH source holds that posting; the gather index into that
+    source's flat plane is its own block start plus the within-source
+    offset. Source slots masked to the source's doc_pad sentinel (dead /
+    non-parent docs) map to the NEW sentinel; everything else shifts by the
+    source's doc base. tf widens along the choose_tf_layout ladder
+    (u8 → i16 → f32) with a plain astype — exact for the integral rungs the
+    eligibility gate admits. Pad rows carry a huge `blk_j0`, so every select
+    misses and the sentinel/zero initializers survive — bitwise identical to
+    what pack_segment writes there."""
+    import jax.numpy as jnp
+
+    from .device_index import _TF_DTYPE
+
+    W = len(src_docs)
+    NB = blk_term.shape[0]
+    B = src_docs[0].shape[1]
+    j = blk_j0[:, None] + jnp.arange(B, dtype=jnp.int32)[None, :]
+    out_docs = jnp.full((NB, B), doc_pad_new, dtype=jnp.int32)
+    out_tf = jnp.zeros((NB, B), dtype=_TF_DTYPE[tf_layout])
+    out_nb = jnp.zeros((NB, B), dtype=jnp.uint8)
+    for s in range(W):
+        lo = cum[s][blk_term][:, None]
+        hi = cum[s + 1][blk_term][:, None]
+        sel = (j >= lo) & (j < hi)
+        slot = starts[s][blk_term][:, None] * B + (j - lo)
+        slot = jnp.clip(slot, 0, src_docs[s].size - 1)
+        d = jnp.take(src_docs[s].reshape(-1), slot)
+        d = jnp.where(d >= doc_pads[s], jnp.int32(doc_pad_new), d + bases[s])
+        out_docs = jnp.where(sel, d, out_docs)
+        out_tf = jnp.where(
+            sel, jnp.take(src_tf[s].reshape(-1), slot).astype(out_tf.dtype),
+            out_tf)
+        out_nb = jnp.where(sel, jnp.take(src_nb[s].reshape(-1), slot),
+                           out_nb)
+    return out_docs, out_tf, out_nb
+
+
+@functools.lru_cache(maxsize=None)
+def _get_concat_compiled(doc_pad_new: int, tf_layout: str):
+    import jax
+
+    return jax.jit(
+        functools.partial(_concat_impl, doc_pad_new=doc_pad_new,
+                          tf_layout=tf_layout))
+
+
+def concat_pack_planes(blk_term, blk_j0, cum, starts, bases, doc_pads,
+                       src_docs, src_tf, src_nb, *, doc_pad_new: int,
+                       tf_layout: str):
+    """Launch the concat program (executables cached per sentinel/layout;
+    jit re-specializes per source-shape set, which the pow-2 shape buckets
+    keep bounded). Inputs stay on device; outputs are the merged segment's
+    resident planes — no pull here."""
+    fn = _get_concat_compiled(int(doc_pad_new), tf_layout)
+    return fn(blk_term, blk_j0, cum, starts, bases, doc_pads,
+              tuple(src_docs), tuple(src_tf), tuple(src_nb))

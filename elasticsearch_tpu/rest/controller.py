@@ -366,8 +366,13 @@ def _prometheus_text(node) -> str:
             w.gauge("estpu_device_index_bytes",
                     entry["totals"].get(tier, 0), index=iname, tier=tier)
     for iname, entry in emitted:
+        # every ledger kind counts as pack work (full + delta + remask +
+        # compaction — ISSUE 14 grew the vocabulary; this counter keeps its
+        # "total pack events" meaning)
         w.counter("estpu_device_pack_total",
-                  entry["pack"].get("packs", 0), index=iname)
+                  sum(entry["pack"].get(k, 0)
+                      for k in ("packs", "delta_packs", "remasks",
+                                "compacts")), index=iname)
     for iname, entry in emitted:
         w.counter("estpu_device_pack_seconds_total",
                   round(entry["pack"].get("pack_ms_total", 0.0) / 1000.0, 6),

@@ -62,6 +62,16 @@ from ..common.units import parse_ratio_or_bytes
 _VOLATILE_KEYS = ("profile", "request_cache", "timeout")
 
 
+def canonical_body(body: dict | None) -> bytes:
+    """The canonical serialized form fingerprints hash: sorted-keys compact
+    JSON of the body minus volatile execution knobs. Also what the warmer
+    replays — the stored blob re-parses to a body that fingerprints
+    identically to the live request it warmed for."""
+    core = {k: v for k, v in (body or {}).items() if k not in _VOLATILE_KEYS}
+    return json.dumps(core, sort_keys=True, separators=(",", ":"),
+                      default=str).encode("utf-8")
+
+
 def request_fingerprint(body: dict | None) -> str:
     """Stable fingerprint of a normalized search body: canonical JSON
     re-serialization (sorted keys, compact separators) of the body minus
@@ -69,10 +79,7 @@ def request_fingerprint(body: dict | None) -> str:
     order — or in profile/timeout/request_cache flags — fingerprint
     identically; any semantic difference (query, filter, from/size, sort,
     aggs, suggest) changes it."""
-    core = {k: v for k, v in (body or {}).items() if k not in _VOLATILE_KEYS}
-    blob = json.dumps(core, sort_keys=True, separators=(",", ":"),
-                      default=str)
-    return hashlib.blake2b(blob.encode("utf-8"), digest_size=16).hexdigest()
+    return hashlib.blake2b(canonical_body(body), digest_size=16).hexdigest()
 
 
 def cache_policy(body: dict | None) -> bool:
@@ -102,6 +109,12 @@ class ShardRequestCache:
     # per-entry bookkeeping overhead charged beyond the value bytes (key
     # tuple, OrderedDict node, breaker slack)
     ENTRY_OVERHEAD = 256
+    # hot-key memory per shard (warmer follow-on): fingerprint → [hit count,
+    # canonical body blob], LRU-bounded. Hit counts SURVIVE view-advance
+    # invalidation — that is the whole point: the warmer replays the
+    # previous view's hottest bodies against the new view so the first
+    # post-refresh sighting is a hit, not a miss
+    HOT_PER_SHARD = 32
 
     def __init__(self, settings=None, breaker=None,
                  total_budget: int = 8 << 30):
@@ -123,6 +136,10 @@ class ShardRequestCache:
         # must touch only that shard's entries, not scan the node-wide LRU
         # (150k+ entries at default sizing) while holding the serving lock
         self._by_shard: dict[tuple, set] = {}
+        # (index, shard) -> OrderedDict[fingerprint -> [hits, body blob]]
+        # (see HOT_PER_SHARD); bodies are the canonical fingerprint blobs, a
+        # few hundred bytes each, bounded — not breaker-accounted
+        self._hot: dict[tuple, "OrderedDict[str, list]"] = {}
         self._bytes = 0
         self.hits = 0
         self.misses = 0
@@ -140,6 +157,14 @@ class ShardRequestCache:
                 return None
             self._entries.move_to_end(key)
             self.hits += 1
+            # hot-key accounting: the fingerprint's hit count drives the
+            # warmer's top-N replay on the next refresh
+            hot = self._hot.get(key[:2])
+            if hot is not None:
+                h = hot.get(key[3])
+                if h is not None:
+                    h[0] += 1
+                    hot.move_to_end(key[3])
             return entry[0]
 
     def peek(self, key: tuple) -> bool:
@@ -150,10 +175,27 @@ class ShardRequestCache:
             return key in self._entries
 
     # -- store ---------------------------------------------------------------
-    def put(self, key: tuple, data: bytes) -> bool:
+    def put(self, key: tuple, data: bytes, body: dict | None = None) -> bool:
         """Store one serialized partial. Charges the request breaker BEFORE
         insertion (estimate-before-allocate); a trip or an oversized value
-        skips caching and counts a rejection. Returns True when stored."""
+        skips caching and counts a rejection. Returns True when stored.
+
+        `body` (the normalized request dict, passed by the live query phase
+        but NOT by the warmer's re-prime) registers the fingerprint in the
+        shard's hot-key memory so future hits can be counted and the body
+        replayed after a refresh."""
+        if body is not None:
+            blob = canonical_body(body)
+            with self._lock:
+                hot = self._hot.setdefault(key[:2], OrderedDict())
+                h = hot.get(key[3])
+                if h is None:
+                    hot[key[3]] = [0, blob]
+                    while len(hot) > self.HOT_PER_SHARD:
+                        hot.popitem(last=False)
+                else:
+                    h[1] = blob
+                    hot.move_to_end(key[3])
         size = len(data) + self.ENTRY_OVERHEAD
         if size > self.size_bytes:
             self.rejections += 1
@@ -185,6 +227,35 @@ class ShardRequestCache:
             self.breaker.release(released)  # outside the leaf lock
         return True
 
+    # -- warmer hot keys -----------------------------------------------------
+    def has_hot(self, index: str, shard_id: int) -> bool:
+        """Whether this shard has any HIT-bearing hot entry — the cheap
+        pre-check the warmer listener makes (under the engine lock) before
+        scheduling a re-prime task at all."""
+        with self._lock:
+            hot = self._hot.get((index, shard_id))
+            return hot is not None and any(h[0] > 0 for h in hot.values())
+
+    def hot_bodies(self, index: str, shard_id: int, n: int = 8) -> list[dict]:
+        """The shard's top-`n` cached request bodies by hit count (hits > 0
+        only — a body stored once and never re-seen is not worth a warm
+        execution), decoded from their canonical blobs. The warmer replays
+        these against a freshly installed view."""
+        with self._lock:
+            hot = self._hot.get((index, shard_id))
+            if not hot:
+                return []
+            ranked = sorted((h for h in hot.values() if h[0] > 0),
+                            key=lambda h: -h[0])[:max(0, n)]
+            blobs = [h[1] for h in ranked]
+        out = []
+        for blob in blobs:
+            try:
+                out.append(json.loads(blob.decode("utf-8")))
+            except (ValueError, UnicodeDecodeError):  # pragma: no cover
+                continue
+        return out
+
     # -- invalidation --------------------------------------------------------
     def _drop_index_locked(self, key: tuple):
         keys = self._by_shard.get(key[:2])
@@ -205,6 +276,10 @@ class ShardRequestCache:
         released = 0
         dropped = 0
         with self._lock:
+            if current_view is None:
+                # the shard is leaving this node: its hot-key memory goes
+                # too (view advances keep it — that drives the warmer)
+                self._hot.pop((index, shard_id), None)
             shard_keys = self._by_shard.get((index, shard_id))
             for k in [k for k in (shard_keys or ())
                       if current_view is None or k[2] != current_view]:

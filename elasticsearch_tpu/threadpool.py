@@ -88,6 +88,12 @@ _DEFAULT_QUEUES = {
     # reject the drainer itself, never a request (requests queue in the
     # batcher's own bounded coalescing queue)
     "search_batcher": -1,
+    # off-query-path device packing (ISSUE 14): a rejected warmer/merge task
+    # silently degrades the serving path back to query-path packing, and the
+    # task count is already bounded by the live segment count (pack futures
+    # dedupe per segment) — so these queues stay unbounded
+    "warmer": -1,
+    "merge": -1,
 }
 _DEFAULT_QUEUE_SIZE = 1000
 

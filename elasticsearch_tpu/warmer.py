@@ -1,0 +1,205 @@
+"""Index warmer: off-query-path device packing + post-refresh cache re-prime.
+
+The reference dedicates a named executor to warming new searchers before they
+serve (PAPER.md's threadpool model — `warmer`; IndicesWarmer runs registered
+warmers on every refresh). Here the warmer is what makes the WRITE path a
+first-class perf surface: before this service, a refresh/merge produced a
+fresh FrozenSegment whose device pack was built lazily ON the first search
+that touched it — the query path paid host staging + HBM upload. Now every
+searcher install (refresh, merge publish, optimize, recovery) schedules the
+cold device work off the query path:
+
+  * **delta packs / full packs** — unpacked segments of the new view get an
+    in-flight pack Future (ops/device_index.begin_warm) installed UNDER the
+    engine lock (dict work only), and the pack itself runs on the `warmer`
+    pool; a search racing the pack waits on the future instead of
+    duplicating the work, so the steady state is `packed_for` = cache hit
+    with ZERO query-path packs (PACK_LEDGER pool attribution pins it).
+  * **compaction packs** — a merged segment published by `maybe_merge`
+    carries a `pack_hint` naming its sources; its pack runs on the `merge`
+    pool and concatenates the sources' already-resident device planes
+    (pack_segment_concat) instead of re-staging O(postings) from host.
+  * **remasks** — a copy-on-write tombstone view re-masks on the warmer
+    pool too, so the first post-delete search doesn't pay it.
+  * **cache re-prime** (`indices.warmer.enabled` kill switch) — the shard's
+    hottest request-cache bodies (top-N by hit count, tracked by
+    search/request_cache) replay against the NEW view so the first
+    post-refresh sighting is a hit, not a miss; hot filter keys from the
+    previous view's holders are pre-seeded on the new segments
+    (DeviceFilterCache.seed) so the warm replay promotes their masks to
+    residency immediately.
+
+Lock discipline (PR 6): the view listener runs under the engine lock and is
+a LEAF — begin_warm is dict work, threadpool.submit never blocks; all pack
+compute, device transfers, and query execution happen on pool threads with
+no engine lock held. Pack warming only arms once a shard has actually served
+a search (`engine.search_active`, set by the action layer): an index that is
+written but never read keeps its refreshes device-free.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .common.errors import SearchEngineError
+from .common.logging import get_logger
+
+
+class IndexWarmerService:
+    """Node-level scheduler hanging pack/re-prime work off engine view
+    listeners (wired per shard by indices_service alongside the cache
+    invalidation listeners)."""
+
+    def __init__(self, node):
+        self.node = node
+        settings = node.settings
+        self.enabled = bool(
+            settings.get_bool("indices.warmer.enabled", True))
+        self.top_n = max(0, settings.get_int("indices.warmer.top_n", 8))
+        # per-warm-query time budget: a wedged warm execution must not pin a
+        # warmer pool thread indefinitely
+        self.query_budget_s = settings.get_float(
+            "indices.warmer.query_timeout", 5.0)
+        self.logger = get_logger("indices.warmer", node=node.name)
+        self._lock = threading.Lock()  # leaf: counters only
+        self.packs_scheduled = 0
+        self.packs_done = 0
+        self.packs_stolen = 0  # claimed by a racing search before we ran
+        self.pack_failures = 0
+        self.reprimes = 0
+        self.queries_warmed = 0
+        self.query_failures = 0
+        self.filters_seeded = 0
+        self.rejected = 0  # pool rejections (shutdown/saturation)
+
+    # -- wiring ---------------------------------------------------------------
+    def wire(self, index: str, shard_id: int, engine) -> None:
+        """Append this shard's warm listener to the engine's view listeners
+        (runs under the engine lock on every searcher install — leaf work
+        only; see module docstring)."""
+
+        def on_view_change(searcher, dropped):
+            if searcher is not None:
+                self.on_view_installed(index, shard_id, engine, searcher,
+                                       dropped)
+
+        engine.view_listeners.append(on_view_change)
+
+    # -- listener (under the engine lock: leaves only) ------------------------
+    def on_view_installed(self, index: str, shard_id: int, engine, searcher,
+                          dropped) -> None:
+        from .ops.device_index import begin_warm, cancel_warm
+
+        node = self.node
+        tp = getattr(node, "threadpool", None)
+        if tp is None:
+            return
+        # pack warming arms only once the shard has served a search: a
+        # write-only index's refreshes stay device-free, and the first
+        # search's inline pack (query path, by design) opens the gate
+        if getattr(engine, "search_active", False):
+            breakers = getattr(node, "breakers", None)
+            breaker = (breakers.breaker("fielddata")
+                       if breakers is not None else None)
+            for seg in searcher.segments:
+                fut = begin_warm(seg)
+                if fut is None:
+                    continue  # already live, or a pack is in flight
+                hint = seg._device_cache.get("pack_hint") or {}
+                pool = "merge" if hint.get("kind") == "compact" else "warmer"
+                try:
+                    tp.submit(pool, self._run_pack, seg, fut, breaker, index)
+                    with self._lock:
+                        self.packs_scheduled += 1
+                except Exception:  # noqa: BLE001 — rejected/shut-down pool:
+                    # clear the marker so the query path packs inline instead
+                    # of waiting on work nobody will do
+                    cancel_warm(seg, fut)
+                    with self._lock:
+                        self.rejected += 1
+        # cache re-prime (the warmer satellite): replay the hottest cached
+        # bodies against the new view. Gated on the kill switch AND on hit-
+        # bearing hot keys actually existing for this shard
+        if not self.enabled:
+            return
+        rcache = getattr(node, "request_cache", None)
+        if (rcache is None or not rcache.enabled
+                or not rcache.has_hot(index, shard_id)):
+            return
+        try:
+            tp.submit("warmer", self._re_prime, index, shard_id, engine,
+                      list(dropped or ()))
+        except Exception:  # noqa: BLE001
+            with self._lock:
+                self.rejected += 1
+
+    # -- pool workers ---------------------------------------------------------
+    def _run_pack(self, seg, fut, breaker, index: str) -> None:
+        from .ops.device_index import run_warm
+
+        try:
+            res = run_warm(seg, fut, breaker=breaker, owner=index)
+            with self._lock:
+                # res None = a racing search CLAIMED the work first and packs
+                # it inline (device_index's claimable-future protocol) — the
+                # scheduled work is complete either way, just not by us
+                self.packs_done += 1
+                if res is None:
+                    self.packs_stolen += 1
+        except Exception as e:  # noqa: BLE001 — a warm pack failure (breaker
+            # trip, device trouble) is survivable: waiters saw the exception
+            # through the future and degraded; later searches retry inline
+            with self._lock:
+                self.packs_done += 1
+                self.pack_failures += 1
+            self.logger.debug("warm pack failed [%s][gen %s]: %s", index,
+                              getattr(seg, "gen", "?"), e)
+
+    def _re_prime(self, index: str, shard_id: int, engine, dropped) -> None:
+        node = self.node
+        try:
+            searcher = engine.acquire_searcher()
+        except SearchEngineError:
+            return  # engine closed under us
+        # seed the previous view's hot filter keys onto the new segments so
+        # the warm replay (or the first live sighting) promotes their masks
+        # to device residency without the min_sightings ramp
+        fcache = getattr(node, "filter_cache", None)
+        if fcache is not None and fcache.enabled:
+            keys = fcache.hot_keys(list(dropped) + list(searcher.segments))
+            if keys:
+                seeded = 0
+                for seg in searcher.segments:
+                    seeded += fcache.seed(seg, keys)
+                if seeded:
+                    with self._lock:
+                        self.filters_seeded += seeded
+        rcache = getattr(node, "request_cache", None)
+        actions = getattr(node, "actions", None)
+        if rcache is None or actions is None or self.top_n <= 0:
+            return
+        bodies = rcache.hot_bodies(index, shard_id, self.top_n)
+        if not bodies:
+            return
+        warmed, failed = actions.warm_shard_queries(
+            index, shard_id, bodies, budget_s=self.query_budget_s)
+        with self._lock:
+            self.reprimes += 1
+            self.queries_warmed += warmed
+            self.query_failures += failed
+
+    # -- observability --------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "packs_scheduled": self.packs_scheduled,
+                "packs_done": self.packs_done,
+                "packs_stolen": self.packs_stolen,
+                "pack_failures": self.pack_failures,
+                "reprimes": self.reprimes,
+                "queries_warmed": self.queries_warmed,
+                "query_failures": self.query_failures,
+                "filters_seeded": self.filters_seeded,
+                "rejected": self.rejected,
+            }

@@ -62,7 +62,13 @@ HOT_FILES = ("elasticsearch_tpu/search/execute.py",
              # periodic reads of serving state — both must stay pure host
              # work: no device traffic, no blocking under their leaf locks
              "elasticsearch_tpu/common/insights.py",
-             "elasticsearch_tpu/common/events.py")
+             "elasticsearch_tpu/common/events.py",
+             # the index warmer's view listener runs UNDER the engine lock on
+             # every refresh/merge publish: it must stay leaf work (dict ops
+             # + pool submits), with all pack compute/device transfers on the
+             # pool workers — and its workers drive the same packed-segment
+             # coordination the query path waits on
+             "elasticsearch_tpu/warmer.py")
 PLATFORM_EXEMPT = ("elasticsearch_tpu/common/jaxenv.py",)
 
 _SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*ignore(?:\[([A-Z0-9, ]+)\])?")
