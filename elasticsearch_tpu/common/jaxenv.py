@@ -66,6 +66,9 @@ class SanitizerReport:
     # lock-trace counters (common/locktrace.py) snapshotted on scope exit when
     # ESTPU_LOCKTRACE=1 armed the tracer; None when the tracer is off
     locks: dict | None = None
+    # collective-trace counters (common/meshtrace.py) snapshotted on scope
+    # exit when ESTPU_MESHTRACE=1 armed the tracer; None when the tracer is off
+    mesh: dict | None = None
 
     def note(self, key: str) -> None:
         self.compiles += 1
@@ -242,9 +245,12 @@ def sanitize(max_compiles: int | None | object = _UNSET,
     finally:
         _counter.unsubscribe(report)
         from .locktrace import TRACER
+        from .meshtrace import TRACER as MESH_TRACER
 
         if TRACER.enabled:
             report.locks = TRACER.snapshot()
+        if MESH_TRACER.enabled:
+            report.mesh = MESH_TRACER.snapshot()
     if max_compiles is not None and report.compiles > max_compiles:
         raise CompileBudgetExceeded(
             f"compile budget exceeded: {report.compiles} backend compile(s) "

@@ -28,6 +28,16 @@ force_cpu_platform(n_devices=8)
 # (the first call ran pre-jax so the threading patch covered all repo locks)
 maybe_install()
 
+# Collective-trace sanitizer (common/meshtrace.py), the runtime twin of the
+# tpulint SPMD family (TPU014-016): under ESTPU_MESHTRACE=1 every shard_map
+# trace records its collective launch sequence per program; the session gate
+# below replays each program and fails the run on any sequence mismatch —
+# the single-process rehearsal of the multi-host trace-divergence deadlock.
+# Installed AFTER jax is up (it patches jax.lax collectives + shard_map).
+from elasticsearch_tpu.common import meshtrace
+
+meshtrace.maybe_install()
+
 import numpy as np
 import pytest
 
@@ -63,6 +73,18 @@ def lock_order_gate():
     yield
     if TRACER.enabled:
         TRACER.check()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def collective_trace_gate():
+    """With ESTPU_MESHTRACE=1, replay every mesh program the session traced
+    and fail the run on any collective-sequence divergence
+    (meshtrace.TRACER.check raises CollectiveTraceMismatch naming the first
+    differing collective site in both traces)."""
+    yield
+    if meshtrace.TRACER.enabled:
+        meshtrace.TRACER.replay_all()
+        meshtrace.TRACER.check()
 
 
 @pytest.fixture(autouse=True)

@@ -11,6 +11,8 @@ import pytest
 from elasticsearch_tpu.node import Node
 from elasticsearch_tpu.transport.local import LocalTransportRegistry
 
+pytestmark = pytest.mark.mesh
+
 N_SHARDS = 4
 VOCAB = ("alpha beta gamma delta epsilon zeta eta theta iota kappa lamda mu nu xi "
          "omicron pi rho sigma tau upsilon phi chi psi omega").split()

@@ -15,6 +15,8 @@ from elasticsearch_tpu.actions import A_QUERY_PHASE
 from elasticsearch_tpu.node import Node
 from elasticsearch_tpu.transport.local import LocalTransportRegistry
 
+pytestmark = pytest.mark.mesh
+
 SHARDS = 6
 DELAY = 0.25
 

@@ -137,19 +137,27 @@ def _rand_query(rng):
     return {"function_score": fs}
 
 
-def _tie_tolerant_equal(dev, host, rel=1e-5):
-    """Identical ordering, except adjacent swaps among near-equal scores (the
-    in-kernel f32 script evaluation vs host f64-then-cast)."""
-    if [d for _, d in dev.hits] == [d for _, d in host.hits]:
-        return all(ds == pytest.approx(hs, rel=rel)
-                   for (ds, _), (hs, _) in zip(dev.hits, host.hits))
+def _tie_tolerant_equal(dev, host, rel=1e-5, abs_tol=1e-9):
+    """Same doc set, per-doc score parity, and identical ordering except among
+    near-equal scores (the in-kernel f32 script evaluation vs host
+    f64-then-cast; decay-function tails land in sub-denormal territory on one
+    path and flush to zero on the other, hence the absolute floor): any
+    permutation inside an approx-equal tie group is fine, an inversion across
+    a real score gap is not."""
     if sorted(d for _, d in dev.hits) != sorted(d for _, d in host.hits):
         return False
-    pos = {d: i for i, d in enumerate(d for _, d in host.hits)}
     hs_by = {d: s for s, d in host.hits}
-    return all(abs(pos[d] - i) <= 1
-               and s == pytest.approx(hs_by[d], rel=rel)
-               for i, (s, d) in enumerate(dev.hits))
+    if not all(s == pytest.approx(hs_by[d], rel=rel, abs=abs_tol)
+               for s, d in dev.hits):
+        return False
+    dev_pos = {d: i for i, (_, d) in enumerate(dev.hits)}
+    for i, (sa, a) in enumerate(host.hits):
+        for sb, b in host.hits[i + 1:]:
+            if sa == pytest.approx(sb, rel=rel, abs=abs_tol):
+                continue  # near-tie: order is path-dependent, let it float
+            if dev_pos[a] > dev_pos[b]:
+                return False
+    return True
 
 
 @pytest.mark.parametrize("similarity", ["BM25", "default"])

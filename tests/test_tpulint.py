@@ -28,7 +28,8 @@ from tools.tpulint.engine import diff_baseline, parse_file  # noqa: E402
 FIXDIR = os.path.join(REPO, "tests", "tpulint_fixtures")
 RULES = ["TPU001", "TPU002", "TPU003", "TPU004", "TPU005",
          "TPU006", "TPU007", "TPU008", "TPU009", "TPU010",
-         "TPU011", "TPU012", "TPU013"]
+         "TPU011", "TPU012", "TPU013", "TPU014", "TPU015",
+         "TPU016", "TPU017"]
 
 
 def _marked_lines(path: str) -> set:
@@ -140,6 +141,20 @@ def test_interproc_lock_order_cycle_cross_module():
         [f.to_dict() for f in both]
 
 
+def test_interproc_collective_divergence_cross_module():
+    """TPU014 across modules: the host-dependent branch lives in the root,
+    the collective in the helper. The helper alone is silent (no branch
+    there); linted together, the spmd reach fixpoint flags the CALL SITE in
+    the root and names the helper's psum line as the origin."""
+    helper = os.path.join(FIXDIR, "tp_xmod_tpu014_helper.py")
+    root = os.path.join(FIXDIR, "tp_xmod_tpu014_root.py")
+    assert [f for f in lint_paths([helper]) if f.rule == "TPU014"] == []
+    both = [f for f in lint_paths([root, helper]) if f.rule == "TPU014"]
+    assert [(f.path.rsplit("/", 1)[-1], f.line) for f in both] == \
+        [("tp_xmod_tpu014_root.py", 25)], [f.to_dict() for f in both]
+    assert "tp_xmod_tpu014_helper.py:13" in both[0].message, both[0].message
+
+
 def test_abba_fixture_is_a_tpu004_true_positive():
     """The runnable ABBA deadlock fixture (tests/test_locktrace.py drives it
     under ESTPU_LOCKTRACE=1) is ALSO flagged statically: both inner
@@ -240,6 +255,34 @@ def test_fingerprint_duplicate_lines_occurrence_indexed(tmp_path):
     assert sum(1 for fp in fps if "#" in fp) == 1  # the repeated line
 
 
+def test_parse_cache_hits_on_unchanged_file(tmp_path):
+    """Re-linting an unchanged file must hit the mtime-keyed parse cache
+    (no re-read, no re-parse) — the suite re-lints the fixture corpus dozens
+    of times per run."""
+    from tools.tpulint.engine import PARSE_CACHE_STATS
+
+    src = tmp_path / "cached.py"
+    src.write_text(_VIOLATION)
+    lint_paths([str(src)])
+    before = dict(PARSE_CACHE_STATS)
+    lint_paths([str(src)])
+    assert PARSE_CACHE_STATS["hits"] == before["hits"] + 1
+    assert PARSE_CACHE_STATS["misses"] == before["misses"]
+
+
+def test_parse_cache_invalidates_on_edit(tmp_path):
+    """Editing a file must invalidate its cache entry: after inserting lines
+    above the violation, the finding MOVES with the edit (a stale tree would
+    keep reporting the old line)."""
+    src = tmp_path / "edited.py"
+    src.write_text(_VIOLATION)
+    first = [f.line for f in lint_paths([str(src)]) if f.rule == "TPU001"]
+    assert first == [3]
+    src.write_text("# pad\nX = 1\n" + _VIOLATION)
+    moved = [f.line for f in lint_paths([str(src)]) if f.rule == "TPU001"]
+    assert moved == [5], moved
+
+
 def test_old_format_baseline_migrates_on_load(tmp_path):
     """PR-1 path:line:rule baselines load as fingerprints (one-shot) so the
     gate never breaks mid-upgrade."""
@@ -324,7 +367,8 @@ def test_cli_rules_table():
 def test_cli_explain_prints_doc_and_examples():
     """--explain TPU0NN makes findings self-documenting at the terminal: the
     rule's docstring plus one tp/fp example from the fixture corpus."""
-    for rule in ("TPU004", "TPU011", "TPU012", "TPU013"):
+    for rule in ("TPU004", "TPU011", "TPU012", "TPU013",
+                 "TPU014", "TPU015", "TPU016", "TPU017"):
         res = _run_cli("--explain", rule)
         assert res.returncode == 0, res.stderr
         assert rule in res.stdout

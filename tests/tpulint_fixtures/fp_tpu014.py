@@ -1,0 +1,67 @@
+"""tpulint fixture — FALSE positives for TPU014: everything here must stay
+silent. Mirrors the real mesh-serving idioms: MESH-UNIFORM control flow
+(branches on mesh.shape, static config, plain parameters — every process
+computes the same answer, so the collective sequence cannot diverge) and
+host-side wall-clock reads AROUND the mesh call, never inside it
+(mesh_serving's took_ms latency measurement).
+"""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+# read once at import: static config, identical on every process of a fleet
+N_LANES = int(os.environ.get("ESTPU_FIXTURE_LANES", "2"))
+
+mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("replicas", "shards"))
+
+
+def mesh_uniform_shape(x):
+    # branching on mesh geometry: every process computes the same answer
+    if mesh.shape["shards"] > 1:
+        x = jax.lax.psum(x, "shards")
+    return jax.lax.all_gather(x, "replicas")
+
+
+def mesh_uniform_config(x, use_global_stats):
+    # a plain parameter is not provably host-divergent — the factory pattern
+    # (mesh_search._mesh_score_program closes over static config) stays legal
+    if use_global_stats:
+        x = jax.lax.psum(x, "shards")
+    for _ in range(N_LANES):
+        x = jax.lax.pmax(x, "shards")
+    return x
+
+
+def unconditional_collectives(x):
+    total = jax.lax.psum(jnp.sum(x), "shards")
+    return jax.lax.all_gather(total, "replicas")
+
+
+def host_side_timing(x):
+    # wall clock AROUND the mesh call, never inside the program — the serving
+    # loop's latency measurement; this function is never shard_map'd
+    f = shard_map(unconditional_collectives, mesh=mesh, in_specs=None,
+                  out_specs=None)
+    t0 = time.monotonic()
+    out = f(x)
+    if time.monotonic() - t0 > 1.0:
+        return None
+    return out
+
+
+def run(x):
+    g = shard_map(mesh_uniform_shape, mesh=mesh, in_specs=None,
+                  out_specs=None)
+    h = shard_map(mesh_uniform_config, mesh=mesh, in_specs=None,
+                  out_specs=None)
+    return g(x), h(x, True), host_side_timing(x)

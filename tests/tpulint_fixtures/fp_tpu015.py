@@ -1,0 +1,55 @@
+"""tpulint fixture — FALSE positives for TPU015: everything here must stay
+silent. Placements that MATCH the shard_map signature, the sanctioned
+explicit-reshard idiom (re-device_put to the expected spec before dispatch),
+dynamically built in_specs (unknowable — mesh_search builds its specs
+imperatively), and arrays from unknown producers.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("replicas", "shards"))
+
+
+def program(x):
+    return jax.lax.psum(x, "shards")
+
+
+def matching_spec(arr):
+    f = shard_map(program, mesh=mesh, in_specs=(P("shards"),), out_specs=P())
+    x = jax.device_put(arr, NamedSharding(mesh, P("shards")))
+    return f(x)  # placement agrees with in_specs — silent
+
+
+def explicit_reshard(arr):
+    f = shard_map(program, mesh=mesh, in_specs=(P("shards"),), out_specs=P())
+    x = jax.device_put(arr, NamedSharding(mesh, P("replicas")))
+    x = jax.device_put(x, NamedSharding(mesh, P("shards")))  # sanctioned fix
+    return f(x)  # rebind updated the tracked placement — silent
+
+
+def dynamic_specs(arr, extra):
+    specs = [P("shards")]
+    if extra:
+        specs.append(P())
+    f = shard_map(program, mesh=mesh, in_specs=tuple(specs), out_specs=P())
+    x = jax.device_put(arr, NamedSharding(mesh, P("replicas")))
+    return f(x)  # in_specs built dynamically: unknowable — silent
+
+
+def unknown_producer(arr, make_input):
+    f = shard_map(program, mesh=mesh, in_specs=(P("shards"),), out_specs=P())
+    x = make_input(arr)
+    return f(x)  # producer's placement unknown — silent
+
+
+def run(arr):
+    return (matching_spec(arr), explicit_reshard(arr),
+            dynamic_specs(arr, None), unknown_producer(arr, jnp.asarray))

@@ -1,0 +1,46 @@
+"""tpulint fixture — FALSE positives for TPU017: everything here must stay
+silent. The sanctioned geometry idioms: device sets sized from config,
+capability checks as inequalities, `jax.devices()[0]` for "any one device",
+grid factors derived from len(devices), and the `axis_index == 0` leader
+election.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+# geometry from config, not a literal baked into code paths
+N_SHARDS = int(os.environ.get("ESTPU_FIXTURE_SHARDS", "4"))
+
+devices = jax.devices()
+if len(devices) < N_SHARDS:  # capability check (inequality) — silent
+    devices = devices * N_SHARDS
+pool = devices[:N_SHARDS]  # dynamic slice from config — silent
+first = jax.devices()[0]  # sanctioned "any one device" idiom — silent
+
+R = max(1, len(pool) // 2)  # grid factors derived from the device count
+mesh = Mesh(np.array(pool[:R * 2]).reshape(R, 2), ("replicas", "shards"))
+
+
+def capability_check():
+    return len(jax.devices()) >= N_SHARDS  # inequality — silent
+
+
+def leader_only(x):
+    i = jax.lax.axis_index("shards")
+    is_leader = i == 0  # leader-election idiom — silent
+    return jnp.where(is_leader, x, 0.0)
+
+
+def run(x):
+    f = shard_map(leader_only, mesh=mesh, in_specs=(P("shards"),),
+                  out_specs=P("shards"))
+    return f(x), capability_check()

@@ -1,0 +1,77 @@
+"""tpulint fixture — TRUE positives for TPU014 (collective-order divergence).
+
+Never imported: parsed by tests/test_tpulint.py. Every `TP`-marked line must
+be flagged with TPU014; exact line agreement is asserted, so this file is the
+rule's behavioral spec. Each function is shard_map'd by name in run(), and
+each branches on a provably host-divergent value around a collective — the
+multi-host launch-order divergence that deadlocks the mesh.
+"""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+mesh = Mesh(np.array(jax.devices()[:4]), ("shards",))
+
+
+def read_flag():
+    # divergent-RETURNING helper: as host-dependent as the env read itself
+    return os.environ.get("ESTPU_FAST_PATH")
+
+
+def _reduce(x):
+    return jax.lax.psum(x, "shards")
+
+
+def branch_on_clock(x):
+    if time.time() % 2.0 > 1.0:
+        x = jax.lax.psum(x, "shards")  # TP: collective under wall-clock branch
+    return jax.lax.all_gather(x, "shards")
+
+
+def branch_on_env_name(x):
+    fast = os.environ.get("ESTPU_FAST") == "1"
+    if fast:
+        g = jax.lax.all_gather(x, "shards")  # TP: env decides launch order
+    else:
+        g = jax.lax.psum(x, "shards")  # TP: env decides launch order
+    return g
+
+
+def branch_on_helper(x):
+    mode = read_flag()
+    if mode:
+        x = jax.lax.pmax(x, "shards")  # TP: divergent helper decides branch
+    return x
+
+
+def loop_on_deadline(x):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 0.1:
+        x = jax.lax.psum(x, "shards")  # TP: collective count rides the clock
+    return x
+
+
+def helper_reached_under_branch(x):
+    if os.environ["ESTPU_MODE"] == "wide":
+        x = _reduce(x)  # TP: reaches lax.psum under a host-dependent branch
+    return x
+
+
+def run(x):
+    a = shard_map(branch_on_clock, mesh=mesh, in_specs=None, out_specs=None)
+    b = shard_map(branch_on_env_name, mesh=mesh, in_specs=None, out_specs=None)
+    c = shard_map(branch_on_helper, mesh=mesh, in_specs=None, out_specs=None)
+    d = shard_map(loop_on_deadline, mesh=mesh, in_specs=None, out_specs=None)
+    e = shard_map(helper_reached_under_branch, mesh=mesh, in_specs=None,
+                  out_specs=None)
+    return a(x), b(x), c(x), d(x), e(x)

@@ -1,0 +1,53 @@
+"""tpulint fixture — TRUE positives for TPU015 (sharding drift).
+
+Never imported: parsed by tests/test_tpulint.py. Every `TP`-marked line must
+be flagged with TPU015. Each function places an array under one
+NamedSharding/PartitionSpec and then hands it to a shard_map whose literal
+in_specs expect a different spec — jit will silently insert an all-gather /
+device-to-device reshard on the hot path instead of failing.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("replicas", "shards"))
+
+
+def program(x):
+    return jax.lax.psum(x, "shards")
+
+
+def replicated_helper(arr):
+    # spec-returning helper: callers inherit the P("replicas") placement
+    return jax.device_put(arr, NamedSharding(mesh, P("replicas")))
+
+
+def drift_direct(arr):
+    f = shard_map(program, mesh=mesh, in_specs=(P("shards"),), out_specs=P())
+    x = jax.device_put(arr, NamedSharding(mesh, P("replicas")))
+    return f(x)  # TP: placed P("replicas"), in_specs[0] expects P("shards")
+
+
+def drift_via_sharding_name(arr):
+    f = shard_map(program, mesh=mesh, in_specs=(P("shards"),), out_specs=P())
+    s = NamedSharding(mesh, P())
+    x = jax.device_put(arr, s)
+    return f(x)  # TP: replicated placement vs sharded in_specs[0]
+
+
+def drift_via_helper(arr):
+    f = shard_map(program, mesh=mesh, in_specs=(P("shards"),), out_specs=P())
+    x = replicated_helper(arr)
+    return f(x)  # TP: helper-returned placement disagrees with in_specs[0]
+
+
+def run(arr):
+    return (drift_direct(arr), drift_via_sharding_name(arr),
+            drift_via_helper(arr))

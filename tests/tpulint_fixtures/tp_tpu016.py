@@ -1,0 +1,57 @@
+"""tpulint fixture — TRUE positives for TPU016 (host-divergent inputs).
+
+Never imported: parsed by tests/test_tpulint.py. Every `TP`-marked line must
+be flagged with TPU016. Wall-clock reads, per-process env reads, and
+process-local identities either read INSIDE a mesh program or fed INTO one as
+arguments: each process traces a different constant into the same SPMD
+program, so device results diverge across hosts.
+"""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+mesh = Mesh(np.array(jax.devices()[:4]), ("shards",))
+
+
+def program(x, scale):
+    return jax.lax.psum(x * scale, "shards")
+
+
+def program_reads_clock(x):
+    t = time.time()  # TP: wall-clock read inside the mesh program
+    return jax.lax.psum(x + t, "shards")
+
+
+def feed_wall_clock(x):
+    f = shard_map(program, mesh=mesh, in_specs=(P("shards"), P()),
+                  out_specs=P())
+    now = time.time()
+    return f(x, now)  # TP: wall clock flows into the mesh program
+
+
+def feed_env(x):
+    f = shard_map(program, mesh=mesh, in_specs=(P("shards"), P()),
+                  out_specs=P())
+    boost = float(os.environ.get("ESTPU_BOOST", "1"))
+    return f(x, boost)  # TP: per-process env read flows into the program
+
+
+def feed_identity(x, obj):
+    f = shard_map(program, mesh=mesh, in_specs=(P("shards"), P()),
+                  out_specs=P())
+    return f(x, id(obj) % 7)  # TP: id() is process-local
+
+def run(x):
+    g = shard_map(program_reads_clock, mesh=mesh, in_specs=(P("shards"),),
+                  out_specs=P())
+    return g(x), feed_wall_clock(x), feed_env(x), feed_identity(x, mesh)

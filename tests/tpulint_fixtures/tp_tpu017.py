@@ -1,0 +1,43 @@
+"""tpulint fixture — TRUE positives for TPU017 (hard-coded mesh geometry).
+
+Never imported: parsed by tests/test_tpulint.py. Every `TP`-marked line must
+be flagged with TPU017. Literal device counts, pinned grid shapes, and
+equality checks against topology constants all detonate the moment the fleet
+moves off the 8-device dev mesh — geometry must come from mesh.shape[axis] or
+config.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+DEVS = jax.devices()[:8]  # TP: literal device-count slice
+mesh = Mesh(np.array(DEVS).reshape(2, 4), ("replicas", "shards"))  # TP: grid
+
+
+def assumes_eight():
+    if len(jax.devices()) == 8:  # TP: equality pins the topology
+        return True
+    return jax.device_count() != 4  # TP: inequality against a literal count
+
+
+def picks_third_device(arr):
+    return jax.device_put(arr, jax.devices()[2])  # TP: literal index > 0
+
+
+def assumes_axis_size(x):
+    i = jax.lax.axis_index("shards")
+    mask = i == 3  # TP: axis_index vs literal > 0 assumes the axis size
+    return jnp.where(mask, x, 0.0)
+
+
+def run(x):
+    f = shard_map(assumes_axis_size, mesh=mesh, in_specs=(P("shards"),),
+                  out_specs=P("shards"))
+    return f(x), assumes_eight(), picks_third_device(x)

@@ -1,0 +1,13 @@
+"""tpulint fixture — cross-module half of the TPU014 pair: the collective.
+
+Linted ALONE this file has no TPU014 findings (no host-dependent branch
+here). Linted together with tp_xmod_tpu014_root.py, the root's env-dependent
+call into reduce_all is flagged AT THE CALL SITE in the root, naming the
+psum below as the collective it bottoms out on.
+"""
+
+import jax
+
+
+def reduce_all(x):
+    return jax.lax.psum(x, "xshards")

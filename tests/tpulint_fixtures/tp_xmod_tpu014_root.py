@@ -1,0 +1,31 @@
+"""tpulint fixture — cross-module TRUE positive for TPU014: the host-dependent
+branch lives HERE, the collective lives in tp_xmod_tpu014_helper.py. The
+spmd.py reach fixpoint follows the call graph across the module boundary and
+flags the call site below, naming the helper's psum line as the origin.
+"""
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+from tp_xmod_tpu014_helper import reduce_all
+
+mesh = Mesh(np.array(jax.devices()[:4]), ("xshards",))
+
+
+def program(x):
+    if os.environ.get("ESTPU_WIDE") == "1":
+        x = reduce_all(x)  # TP: reaches lax.psum in the helper module
+    return x
+
+
+def run(x):
+    f = shard_map(program, mesh=mesh, in_specs=None, out_specs=None)
+    return f(x)

@@ -126,7 +126,7 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="tools.tpulint",
         description="JAX/TPU hot-path + concurrency static analyzer "
-                    "(TPU001-TPU013)",
+                    "(TPU001-TPU017)",
         epilog="exit codes: 0 clean, 1 new findings (--check only), "
                "2 usage error")
     ap.add_argument("paths", nargs="*",

@@ -134,17 +134,49 @@ def _roles(relpath: str, explicit: bool) -> tuple[bool, bool, bool]:
     return hot, True, plat
 
 
+# mtime-keyed parse cache: repeated lints of the same interpreter (the test
+# suite parses the fixture corpus dozens of times; --explain re-lints every
+# fixture) skip re-reading and re-parsing unchanged files. Keyed by
+# (mtime_ns, size) so an edited file — even one rewritten within the same
+# second — re-parses and its findings move with the edit. The cached value is
+# the parsed tree + source lines only; SourceFile (whose roles depend on how
+# the file was reached) is rebuilt per call. SyntaxErrors cache as None so a
+# broken file isn't re-parsed per rule pass either.
+_PARSE_CACHE: dict[str, tuple[tuple[int, int], tuple[ast.Module, list[str]] | None]] = {}
+PARSE_CACHE_STATS = {"hits": 0, "misses": 0}
+
+
+def clear_parse_cache() -> None:
+    _PARSE_CACHE.clear()
+    PARSE_CACHE_STATS["hits"] = PARSE_CACHE_STATS["misses"] = 0
+
+
 def parse_file(path: str, explicit: bool = False) -> SourceFile | None:
     abspath = os.path.abspath(path)
     relpath = os.path.relpath(abspath, REPO).replace(os.sep, "/")
     try:
-        with open(abspath, encoding="utf-8") as f:
-            src = f.read()
-        tree = ast.parse(src, filename=relpath)
-    except (OSError, SyntaxError):
-        return None  # unreadable/unparseable files are not lint findings
+        st = os.stat(abspath)
+    except OSError:
+        return None  # unreadable files are not lint findings
+    stamp = (st.st_mtime_ns, st.st_size)
+    cached = _PARSE_CACHE.get(abspath)
+    if cached is not None and cached[0] == stamp:
+        PARSE_CACHE_STATS["hits"] += 1
+        parsed = cached[1]
+    else:
+        PARSE_CACHE_STATS["misses"] += 1
+        try:
+            with open(abspath, encoding="utf-8") as f:
+                src = f.read()
+            parsed = (ast.parse(src, filename=relpath), src.splitlines())
+        except (OSError, SyntaxError):
+            parsed = None  # unparseable files are not lint findings
+        _PARSE_CACHE[abspath] = (stamp, parsed)
+    if parsed is None:
+        return None
+    tree, lines = parsed
     hot, lock, plat = _roles(relpath, explicit)
-    return SourceFile(relpath=relpath, tree=tree, lines=src.splitlines(),
+    return SourceFile(relpath=relpath, tree=tree, lines=lines,
                       hot=hot, lock_scope=lock, platform_checked=plat)
 
 

@@ -15,6 +15,10 @@ from . import (
     tpu011_blocking_under_lock,
     tpu012_unsync_state,
     tpu013_unbalanced_acquire,
+    tpu014_collective_divergence,
+    tpu015_sharding_drift,
+    tpu016_host_divergent,
+    tpu017_mesh_geometry,
 )
 
 ALL_RULES = [
@@ -31,6 +35,10 @@ ALL_RULES = [
     tpu011_blocking_under_lock,
     tpu012_unsync_state,
     tpu013_unbalanced_acquire,
+    tpu014_collective_divergence,
+    tpu015_sharding_drift,
+    tpu016_host_divergent,
+    tpu017_mesh_geometry,
 ]
 
 RULE_DOCS = {r.RULE_ID: r.DOC for r in ALL_RULES}

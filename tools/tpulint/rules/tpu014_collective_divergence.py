@@ -1,0 +1,134 @@
+"""TPU014 — collective under host-dependent control flow in a shard_map region.
+
+Every process participating in a mesh program must launch the SAME collective
+sequence: XLA's collectives rendezvous by program order, so when host A takes
+the branch that psums and host B takes the branch that all_gathers (a branch
+decided by wall clock, an env var, an unseeded RNG draw, process identity...),
+the fleet deadlocks inside the runtime with no Python stack to blame. This is
+THE classic multi-host SPMD failure mode, and the one ROADMAP item 1
+(multi-host allocation + collective top-k merge) must never be able to ship.
+
+Within `project.shard_map_covered` functions this rule flags:
+
+  a. a collective (`lax.psum`/`all_gather`/`ppermute`/`axis_index`/...)
+     lexically under an `if`/`while`/`for` whose condition is provably
+     host-divergent — a divergent call (tools/tpulint/spmd.py's vocabulary:
+     time/datetime, unseeded random, os.environ, id()/hash(), process
+     identity), an `os.environ[...]` read, or a name assigned from one
+     (single-assignment dataflow, the TPU001 idiom — including helpers that
+     RETURN a divergent value, via the spmd pass fixpoint).
+  b. a call under such a branch that transitively REACHES a collective through
+     the call graph, across modules — flagged at the call site, naming the
+     collective's origin line (the TPU011 reach idiom).
+
+Mesh-uniform control flow stays silent: branches on `mesh.shape[...]`, static
+config, or plain function arguments are the sanctioned way to vary a program,
+because every process computes the same answer. The dynamic twin of this rule
+is common/meshtrace.py (`ESTPU_MESHTRACE=1`), which records and compares the
+launch sequences a real run actually produced.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import spmd
+from ..engine import Finding, SourceFile
+from ..project import module_name
+
+RULE_ID = "TPU014"
+DOC = ("collective under host-dependent control flow inside a shard_map "
+       "region (cross-process launch-order divergence / deadlock)")
+
+
+class _V(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile, out: list, mod: str, div_fns: set,
+                 sa: spmd.SpmdAnalysis, project):
+        self.sf = sf
+        self.out = out
+        self.mod = mod
+        self.div_fns = div_fns
+        self.sa = sa
+        self.project = project
+        self.names: set[str] = set()
+        self.reasons: list[str] = []  # divergent-branch context stack
+
+    # -- divergent-name dataflow --------------------------------------------
+    def visit_Assign(self, node: ast.Assign):
+        if spmd.divergent_expr(node.value, self.names, self.div_fns):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.names.add(t.id)
+        self.generic_visit(node)
+
+    # -- divergent-branch tracking ------------------------------------------
+    def _branch(self, node, test: ast.AST):
+        desc = spmd.divergent_expr(test, self.names, self.div_fns)
+        if desc is None:
+            self.generic_visit(node)
+            return
+        self.reasons.append(desc)
+        self.generic_visit(node)
+        self.reasons.pop()
+
+    def visit_If(self, node: ast.If):
+        self._branch(node, node.test)
+
+    def visit_While(self, node: ast.While):
+        self._branch(node, node.test)
+
+    def visit_For(self, node: ast.For):
+        self._branch(node, node.iter)
+
+    # -- the flagged patterns ------------------------------------------------
+    def visit_Call(self, node: ast.Call):
+        if self.reasons:
+            d = spmd._dotted(node.func)
+            prim = spmd.is_collective(d)
+            if prim:
+                self.out.append(Finding(
+                    self.sf.relpath, node.lineno, RULE_ID,
+                    f"lax.{prim}(...) under host-dependent control flow "
+                    f"(branch on {self.reasons[-1]}) inside a shard_map "
+                    "region — processes can disagree on the collective "
+                    "launch sequence and deadlock the mesh; hoist the branch "
+                    "off the device program or derive it from mesh-uniform "
+                    "state (mesh.shape / static config)"))
+            elif d:
+                for fid in self.project.resolve(self.mod, d):
+                    hit = self.sa.reach_collective.get(fid)
+                    if hit is not None:
+                        what, origin = hit
+                        self.out.append(Finding(
+                            self.sf.relpath, node.lineno, RULE_ID,
+                            f"`{'.'.join(d)}()` reaches {what} (at {origin}) "
+                            "under host-dependent control flow (branch on "
+                            f"{self.reasons[-1]}) inside a shard_map region "
+                            "— processes can disagree on the collective "
+                            "launch sequence and deadlock the mesh; make the "
+                            "branch mesh-uniform"))
+                        break
+        self.generic_visit(node)
+
+    # nested defs are separate scopes with their own FuncInfo coverage
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def run(files: list[SourceFile], project=None) -> list[Finding]:
+    out: list[Finding] = []
+    if project is None:
+        return out
+    sa = spmd.analysis(files, project)
+    for sf in files:
+        mod = module_name(sf.relpath)
+        div_fns = sa.divergent_fn_names(sf)
+        for fi in project.functions:
+            if fi.sf is not sf or fi.fid not in project.shard_map_covered:
+                continue
+            v = _V(sf, out, mod, div_fns, sa, project)
+            for stmt in fi.node.body:
+                v.visit(stmt)
+    return out

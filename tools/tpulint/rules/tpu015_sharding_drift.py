@@ -1,0 +1,120 @@
+"""TPU015 — sharding drift: producer placement vs consumer in_specs.
+
+`jax.jit` dispatch never fails on a mismatched input sharding — it silently
+inserts a reshard (an all-gather or device-to-device copy) in front of the
+program. On the query hot path that is a per-call collective the author never
+wrote, invisible until the profile shows the mesh idling behind transfers
+(mesh_search.py's dispatch device_puts every argument with the program's
+EXACT specs for precisely this reason). This rule catches the drift when both
+sides are statically literal:
+
+  a. a name placed via `x = jax.device_put(arr, NamedSharding(mesh, P(...)))`
+     (inline, through a local `s = NamedSharding(...)` binding, or returned by
+     a helper — the spmd.py spec-returning fixpoint follows helper returns
+     interprocedurally, the TPU001 device-returning idiom) that is later
+     passed to a callable bound from `shard_map(...)` whose literal
+     `in_specs[i]` names a DIFFERENT spec.
+
+Everything non-literal stays unknown and silent: specs built imperatively
+(the mesh_search executor's list-append), dynamic placement variables, helper
+returns with conflicting placements. Rebinding the name — including an
+explicit re-`device_put` to the expected sharding — clears or replaces the
+tracked placement, so the sanctioned "reshard explicitly before dispatch"
+idiom never flags.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import spmd
+from ..engine import Finding, SourceFile
+
+RULE_ID = "TPU015"
+DOC = ("device value placed under one PartitionSpec consumed by a shard_map "
+       "expecting another — implicit reshard on the hot path")
+
+
+class _V(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile, out: list, spec_fns: dict):
+        self.sf = sf
+        self.out = out
+        self.spec_fns = spec_fns
+        self.ns_names: dict = {}   # name -> spec, from s = NamedSharding(...)
+        self.placed: dict = {}     # name -> spec it was device_put under
+        self.sm_sigs: dict = {}    # name -> per-arg spec list from shard_map
+
+    def visit_Assign(self, node: ast.Assign):
+        targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if targets:
+            self._track(targets, node.value)
+        self.generic_visit(node)
+
+    def _track(self, targets: list, value: ast.AST):
+        # any rebind first forgets the old placement — `x = f(x)` is unknown
+        for t in targets:
+            self.placed.pop(t, None)
+            self.sm_sigs.pop(t, None)
+            self.ns_names.pop(t, None)
+        if not isinstance(value, ast.Call):
+            return
+        spec = spmd.named_sharding_spec(value)
+        if spec is not None:
+            for t in targets:
+                self.ns_names[t] = spec
+            return
+        spec = spmd.device_put_spec(value, self.ns_names)
+        if spec is None and isinstance(value.func, ast.Name) \
+                and not value.keywords:
+            spec = self.spec_fns.get(value.func.id)
+        if spec is not None:
+            for t in targets:
+                self.placed[t] = spec
+            return
+        sig = spmd.sm_in_specs(value)
+        if sig is not None:
+            for t in targets:
+                self.sm_sigs[t] = sig
+
+    def visit_Call(self, node: ast.Call):
+        if isinstance(node.func, ast.Name):
+            sig = self.sm_sigs.get(node.func.id)
+            if sig is not None:
+                for i, a in enumerate(node.args):
+                    if not isinstance(a, ast.Name) or i >= len(sig):
+                        continue
+                    got = self.placed.get(a.id)
+                    want = sig[i]
+                    if got is not None and want is not None and got != want:
+                        self.out.append(Finding(
+                            self.sf.relpath, node.lineno, RULE_ID,
+                            f"sharding drift: `{a.id}` is placed with "
+                            f"{spmd.fmt_spec(got)} but `{node.func.id}`'s "
+                            f"in_specs[{i}] expects {spmd.fmt_spec(want)} — "
+                            "dispatch silently inserts a reshard "
+                            "(all-gather/device-to-device copy) on the hot "
+                            "path; device_put to the expected sharding "
+                            "explicitly"))
+        self.generic_visit(node)
+
+    # nested defs get their own scope pass from run()
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def run(files: list[SourceFile], project=None) -> list[Finding]:
+    out: list[Finding] = []
+    if project is None:
+        return out
+    sa = spmd.analysis(files, project)
+    for sf in files:
+        spec_fns = sa.spec_fn_names(sf)
+        scopes: list = [sf.tree]
+        scopes.extend(fi.node for fi in project.functions if fi.sf is sf)
+        for scope in scopes:
+            v = _V(sf, out, spec_fns)
+            for stmt in scope.body:
+                v.visit(stmt)
+    return out

@@ -1,0 +1,142 @@
+"""TPU016 — host-divergent values flowing into traced mesh code.
+
+A mesh program is ONE logical computation traced once per process; any
+per-process input — wall clock, unseeded RNG, `os.environ` reads, `id()` /
+PYTHONHASHSEED-salted `hash()` — bakes a DIFFERENT constant (or different
+trace) into each host's copy. The programs still run, the collectives still
+rendezvous, and every host quietly computes different numbers: the worst SPMD
+failure mode because nothing crashes. Two shapes:
+
+  a. a divergent read INSIDE the mesh region itself — `time.time()` in the
+     shard_map'd program (or a helper it calls). The region here is the
+     STRICT one from tools/tpulint/spmd.py: actual shard_map roots plus only
+     escaping closures that reach a collective — NOT project.shard_map_covered,
+     whose benefit-of-the-doubt for factory closures would flag every pool
+     callback that legitimately reads the clock on the host.
+  b. a divergent value passed as an ARGUMENT to a shard_map-bound callable —
+     `f = shard_map(program, ...); f(x, time.time())`. Tracked through the
+     single-assignment dataflow (names assigned from divergent calls, env
+     reads, or divergent-RETURNING helpers via the spmd fixpoint).
+
+Mesh-uniform inputs stay silent: seeded RNG (`np.random.default_rng(42)`,
+`jax.random` keys), static config, `mesh.shape` reads, and host-side timing
+AROUND the mesh call (latency measurement never enters the program).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import spmd
+from ..engine import Finding, SourceFile
+from ..project import module_name
+
+RULE_ID = "TPU016"
+DOC = ("host-divergent value (wall clock / unseeded RNG / env read / id()) "
+       "flows into traced mesh code — cross-host numeric divergence")
+
+
+def _scan_region_fn(sf: SourceFile, fi, div_fns: set, out: list) -> None:
+    """Shape a: divergent reads lexically inside a mesh-region function."""
+    nested_ids: set[int] = set()
+    for n in ast.walk(fi.node):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and n is not fi.node:
+            nested_ids.update(id(x) for x in ast.walk(n))
+    for node in ast.walk(fi.node):
+        if node is fi.node or id(node) in nested_ids:
+            continue
+        desc = None
+        if isinstance(node, ast.Call):
+            desc = spmd.divergent_call(node, div_fns)
+        elif isinstance(node, ast.Subscript):
+            d = spmd._dotted(node.value)
+            if d and d[-1] == "environ":
+                desc = "os.environ[...]"
+        if desc:
+            out.append(Finding(
+                sf.relpath, node.lineno, RULE_ID,
+                f"host-divergent {desc} inside mesh program "
+                f"`{fi.qualname}` — each process traces a different value "
+                "into the SPMD program (cross-host numeric divergence); "
+                "thread it in as a mesh-uniform argument or derive it from "
+                "seeded/config state"))
+
+
+class _ArgV(ast.NodeVisitor):
+    """Shape b: divergent values as arguments to shard_map-bound callables."""
+
+    def __init__(self, sf: SourceFile, out: list, mod: str, div_fns: set,
+                 sa: spmd.SpmdAnalysis, project):
+        self.sf = sf
+        self.out = out
+        self.mod = mod
+        self.div_fns = div_fns
+        self.sa = sa
+        self.project = project
+        self.names: set[str] = set()
+        self.sm_names: set[str] = set()
+
+    def visit_Assign(self, node: ast.Assign):
+        if isinstance(node.value, ast.Call):
+            if spmd.sm_in_specs(node.value) is not None or \
+                    spmd._last_name(node.value.func) in spmd._SM_NAMES:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.sm_names.add(t.id)
+                self.generic_visit(node)
+                return
+        if spmd.divergent_expr(node.value, self.names, self.div_fns):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.names.add(t.id)
+        self.generic_visit(node)
+
+    def _is_mesh_entry(self, func: ast.AST) -> str | None:
+        if not isinstance(func, ast.Name):
+            return None
+        if func.id in self.sm_names:
+            return func.id
+        for fid in self.project.resolve(self.mod, (func.id,)):
+            if fid in self.sa.sm_roots:
+                return func.id
+        return None
+
+    def visit_Call(self, node: ast.Call):
+        entry = self._is_mesh_entry(node.func)
+        if entry is not None:
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                desc = spmd.divergent_expr(a, self.names, self.div_fns)
+                if desc:
+                    self.out.append(Finding(
+                        self.sf.relpath, node.lineno, RULE_ID,
+                        f"host-divergent value {desc} flows into mesh "
+                        f"program `{entry}` — each process feeds the SPMD "
+                        "program a different input (cross-host numeric "
+                        "divergence); pass seeded/config state instead"))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def run(files: list[SourceFile], project=None) -> list[Finding]:
+    out: list[Finding] = []
+    if project is None:
+        return out
+    sa = spmd.analysis(files, project)
+    for sf in files:
+        mod = module_name(sf.relpath)
+        div_fns = sa.divergent_fn_names(sf)
+        for fi in project.functions:
+            if fi.sf is sf and fi.fid in sa.mesh_region:
+                _scan_region_fn(sf, fi, div_fns, out)
+        scopes: list = [sf.tree]
+        scopes.extend(fi.node for fi in project.functions if fi.sf is sf)
+        for scope in scopes:
+            v = _ArgV(sf, out, mod, div_fns, sa, project)
+            for stmt in scope.body:
+                v.visit(stmt)
+    return out

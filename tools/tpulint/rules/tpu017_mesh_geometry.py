@@ -1,0 +1,181 @@
+"""TPU017 — hard-coded mesh-geometry assumptions.
+
+The moment allocation spans hosts (ROADMAP item 1), every literal device
+count, axis size, or grid shape baked into code becomes a landmine: the code
+ran for months on the 8-device dev mesh and detonates on the first 16-device
+fleet. The sanctioned source of geometry is the mesh itself —
+`mesh.shape[axis]`, `len(devices)` computed from config — so this rule flags
+the literal forms:
+
+  a. `jax.devices()[...literal...]` / `jax.local_devices()[...literal...]` —
+     an index > 0 or a slice bound > 1 assumes the device count. (`[0]` is
+     the sanctioned "any one device" idiom and stays silent; dynamic slices
+     like `devices[:n_shards]` — mesh_serving's form — are unknowable and
+     silent.)
+  b. `len(jax.devices()) == <literal>` / `jax.device_count() != <literal>` —
+     equality pins the topology; capability checks (`<`, `>=`) are the legal
+     form and stay silent.
+  c. `lax.axis_index(axis) == <literal N>` with N > 0 (directly or through a
+     name assigned from axis_index) — assumes the axis holds more than N
+     devices. The `== 0` leader-election idiom stays silent.
+  d. `Mesh(....reshape(<all-int-literals>), ...)` — a hard-coded device grid;
+     derive the factors from config / `len(devices)` instead.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import spmd
+from ..engine import Finding, SourceFile
+
+RULE_ID = "TPU017"
+DOC = ("hard-coded mesh geometry (literal device counts / axis sizes / grid "
+       "shapes) where mesh.shape[axis] is required")
+
+_DEVICE_LISTS = {"devices", "local_devices"}
+_DEVICE_COUNTS = {"device_count", "local_device_count"}
+
+
+def _is_device_list_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = spmd._dotted(node.func)
+    return bool(d and d[-1] in _DEVICE_LISTS and d[0] == "jax")
+
+
+def _geometry_desc(node: ast.AST) -> str | None:
+    """`len(jax.devices())` / `jax.device_count()` — a device-count read."""
+    if not isinstance(node, ast.Call):
+        return None
+    if isinstance(node.func, ast.Name) and node.func.id == "len" \
+            and len(node.args) == 1 and _is_device_list_call(node.args[0]):
+        return "len(jax.devices())"
+    d = spmd._dotted(node.func)
+    if d and d[-1] in _DEVICE_COUNTS and d[0] == "jax":
+        return f"jax.{d[-1]}()"
+    return None
+
+
+def _is_axis_index_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = spmd._dotted(node.func)
+    return bool(d and len(d) >= 2 and d[-2] == "lax"
+                and d[-1] in ("axis_index",))
+
+
+def _literal_reshape_dims(node: ast.AST) -> tuple | None:
+    """x.reshape(2, 4) / x.reshape((2, 4)) with every dim a literal int."""
+    if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "reshape" and node.args):
+        return None
+    dims = node.args
+    if len(dims) == 1 and isinstance(dims[0], (ast.Tuple, ast.List)):
+        dims = dims[0].elts
+    vals = []
+    for a in dims:
+        if isinstance(a, ast.Constant) and isinstance(a.value, int):
+            vals.append(a.value)
+        else:
+            return None
+    return tuple(vals) if vals else None
+
+
+class _V(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile, out: list):
+        self.sf = sf
+        self.out = out
+        self.axis_idx_names: set[str] = set()
+
+    def _flag(self, node: ast.AST, msg: str):
+        self.out.append(Finding(self.sf.relpath, node.lineno, RULE_ID, msg))
+
+    def visit_Assign(self, node: ast.Assign):
+        if isinstance(node.value, ast.Call) \
+                and _is_axis_index_call(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.axis_idx_names.add(t.id)
+        self.generic_visit(node)
+
+    # a. literal index/slice into the device list
+    def visit_Subscript(self, node: ast.Subscript):
+        if _is_device_list_call(node.value):
+            s = node.slice
+            bad = False
+            if isinstance(s, ast.Constant) and isinstance(s.value, int):
+                bad = s.value > 0
+            elif isinstance(s, ast.Slice):
+                for b in (s.lower, s.upper):
+                    if isinstance(b, ast.Constant) \
+                            and isinstance(b.value, int) and b.value > 1:
+                        bad = True
+            if bad:
+                self._flag(node, "hard-coded device count: jax.devices() "
+                                 "indexed/sliced with a literal — derive the "
+                                 "device set from config/mesh.shape so "
+                                 "allocation survives topology changes")
+        self.generic_visit(node)
+
+    # b/c. equality comparisons against literals
+    def visit_Compare(self, node: ast.Compare):
+        if len(node.ops) == 1 and isinstance(node.ops[0], (ast.Eq, ast.NotEq)):
+            left, right = node.left, node.comparators[0]
+            for expr, lit in ((left, right), (right, left)):
+                if not (isinstance(lit, ast.Constant)
+                        and isinstance(lit.value, int)):
+                    continue
+                geo = _geometry_desc(expr)
+                if geo:
+                    self._flag(node, f"hard-coded mesh geometry: {geo} "
+                                     f"compared to literal {lit.value} — "
+                                     "read mesh.shape[axis] (or keep "
+                                     "capability checks as inequalities) so "
+                                     "the code survives topology changes")
+                    break
+                is_axis = _is_axis_index_call(expr) or (
+                    isinstance(expr, ast.Name)
+                    and expr.id in self.axis_idx_names)
+                if is_axis and lit.value > 0:
+                    self._flag(node, "hard-coded axis position: "
+                                     "lax.axis_index(...) compared to "
+                                     f"literal {lit.value} assumes a fixed "
+                                     "axis size — compute roles from "
+                                     "mesh.shape[axis] (the == 0 leader "
+                                     "idiom is exempt)")
+                    break
+        self.generic_visit(node)
+
+    # d. literal grid reshape feeding a Mesh(...) construction
+    def visit_Call(self, node: ast.Call):
+        if spmd._last_name(node.func) == "Mesh":
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(a):
+                    dims = _literal_reshape_dims(sub)
+                    if dims is not None:
+                        self._flag(node, "hard-coded mesh geometry: "
+                                         f"reshape{dims} inside Mesh(...) "
+                                         "pins the device grid — derive the "
+                                         "factors from config/len(devices)")
+                        break
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def run(files: list[SourceFile], project=None) -> list[Finding]:
+    out: list[Finding] = []
+    for sf in files:
+        scopes: list = [sf.tree]
+        scopes.extend(n for n in ast.walk(sf.tree)
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)))
+        for scope in scopes:
+            v = _V(sf, out)
+            for stmt in scope.body:
+                v.visit(stmt)
+    return out

@@ -1,0 +1,360 @@
+"""tpulint pass 1.6: shared SPMD mesh/collective analysis (TPU014-TPU017).
+
+ROADMAP item 1 (multi-host topology-aware allocation) turns every mesh program
+into a distributed protocol: all participating processes must trace the SAME
+program and launch the SAME collective sequence, or the fleet deadlocks inside
+XLA with no stack to blame. The rule family that guards that contract shares
+one pass over project.py's call graph, built here once per lint run (the
+concurrency.py `analysis()` idiom):
+
+- **collective sites + reach fixpoint** — which functions lexically contain a
+  `lax.psum`/`all_gather`/... call, and which functions transitively REACH one
+  through the call graph (TPU014 flags a helper call under a host-dependent
+  branch by naming the collective it bottoms out on, like TPU011 names the
+  blocking site behind a lock).
+- **host-divergent expression detection** — the vocabulary of per-process
+  values (wall clock, unseeded RNG, env reads, `id()`/`hash()` under
+  PYTHONHASHSEED, process identity) plus a divergent-RETURNING helper fixpoint
+  so `t = read_deadline()` is as divergent as `t = time.time()` (the TPU001
+  device-returning idiom).
+- **strict mesh region** — `project.shard_map_covered` gives escaping nested
+  closures the benefit of the doubt (right for collective-gated rules: a
+  collective outside shard_map is already broken), but TPU016 flags ordinary
+  host reads, so its region is rebuilt strictly: actual shard_map roots plus
+  only those escaping closures that themselves reach a collective. A pool
+  callback that reads `time.monotonic()` stays legal; a mesh program factory's
+  closure does not.
+- **literal PartitionSpec extraction + spec-returning fixpoint** — TPU015
+  compares producer placements (`jax.device_put(x, NamedSharding(mesh, P(..)))`,
+  directly or through helper returns) against consumer `in_specs`; everything
+  non-literal stays unknown and silent.
+
+Like pass 1/1.5, resolution is conservative: dynamic constructs never create
+findings by themselves.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import SourceFile
+from .project import Project, module_name
+
+# same vocabulary as TPU006 — the ops whose LAUNCH ORDER is the cross-process
+# contract (axis_index/axis_size are mesh queries but still trace-ordered)
+_COLLECTIVES = {"psum", "pmax", "pmin", "pmean", "all_gather", "all_to_all",
+                "ppermute", "pshuffle", "psum_scatter", "axis_index",
+                "axis_size"}
+
+_SM_NAMES = {"shard_map", "pjit", "xmap"}
+_PSPEC_NAMES = {"P", "PartitionSpec"}
+
+# (second-to-last, last) dotted pairs whose CALL yields a per-process value
+_DIV_PAIRS = {
+    ("time", "time"), ("time", "time_ns"), ("time", "monotonic"),
+    ("time", "monotonic_ns"), ("time", "perf_counter"),
+    ("time", "perf_counter_ns"),
+    ("datetime", "now"), ("datetime", "utcnow"), ("datetime", "today"),
+    ("environ", "get"),            # os.environ.get(...)
+    ("os", "getenv"), ("os", "urandom"), ("os", "getpid"),
+    ("uuid", "uuid1"), ("uuid", "uuid4"),
+    ("socket", "gethostname"),
+    ("jax", "process_index"),
+    ("secrets", "token_bytes"), ("secrets", "token_hex"),
+    ("secrets", "randbits"),
+}
+# unseeded module-global RNG draws (random.*, np.random.*); jax.random is
+# key-seeded and deterministic, so it is explicitly NOT in this set
+_DIV_RANDOM = {"random", "randint", "randrange", "uniform", "gauss", "choice",
+               "choices", "shuffle", "sample", "getrandbits", "rand", "randn",
+               "normal", "permutation"}
+# builtins whose value is process-local (CPython object identity /
+# PYTHONHASHSEED-salted string hashing — the classic dict-order divergence)
+_DIV_BARE = {"id", "hash"}
+
+
+def _dotted(node: ast.AST) -> tuple[str, ...] | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _last_name(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def is_collective(d: tuple[str, ...] | None) -> str | None:
+    """lax.psum / jax.lax.psum -> "psum"; anything else -> None."""
+    if d and len(d) >= 2 and d[-2] == "lax" and d[-1] in _COLLECTIVES:
+        return d[-1]
+    return None
+
+
+def divergent_call(call: ast.Call,
+                   div_fns: frozenset | set = frozenset()) -> str | None:
+    """Human-readable description when `call` yields a per-process value."""
+    d = _dotted(call.func)
+    if d is None:
+        return None
+    if len(d) == 1:
+        if d[0] in _DIV_BARE and call.args:
+            return f"{d[0]}()"
+        if d[0] in div_fns:
+            return f"{d[0]}() (host-divergent helper)"
+        return None
+    pair = (d[-2], d[-1])
+    if pair in _DIV_PAIRS:
+        return ".".join(d) + "()"
+    if d[-2] == "random" and d[0] != "jax" and d[-1] in _DIV_RANDOM:
+        return ".".join(d) + "()"
+    return None
+
+
+def divergent_expr(node: ast.AST, names: set,
+                   div_fns: frozenset | set = frozenset()) -> str | None:
+    """Description of the first host-divergent source inside `node`:
+    a divergent call, an `os.environ[...]` read, or a name previously
+    assigned from one (single-assignment dataflow, the TPU001 idiom)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in names:
+            return f"`{sub.id}`"
+        if isinstance(sub, ast.Call):
+            desc = divergent_call(sub, div_fns)
+            if desc:
+                return desc
+        if isinstance(sub, ast.Subscript):
+            d = _dotted(sub.value)
+            if d and d[-1] == "environ":
+                return "os.environ[...]"
+    return None
+
+
+# -- literal PartitionSpec / placement extraction (TPU015) -------------------
+
+
+def pspec_literal(node: ast.AST) -> tuple | None:
+    """P("a", None) -> ("a", None); dynamic/keyword args -> None (unknown)."""
+    if not isinstance(node, ast.Call) or node.keywords:
+        return None
+    if _last_name(node.func) not in _PSPEC_NAMES:
+        return None
+    vals: list = []
+    for a in node.args:
+        if isinstance(a, ast.Constant) and (a.value is None
+                                            or isinstance(a.value, str)):
+            vals.append(a.value)
+        else:
+            return None
+    return tuple(vals)
+
+
+def fmt_spec(spec: tuple) -> str:
+    return "P(" + ", ".join(repr(v) for v in spec) + ")"
+
+
+def named_sharding_spec(node: ast.AST) -> tuple | None:
+    """NamedSharding(mesh, P(...)) -> the literal spec."""
+    if isinstance(node, ast.Call) and _last_name(node.func) == "NamedSharding" \
+            and len(node.args) >= 2:
+        return pspec_literal(node.args[1])
+    return None
+
+
+def device_put_spec(call: ast.Call, ns_names: dict) -> tuple | None:
+    """jax.device_put(x, <placement>) -> literal spec, following a local
+    `s = NamedSharding(...)` binding through `ns_names`."""
+    d = _dotted(call.func)
+    if not d or d[-1] != "device_put":
+        return None
+    sharding = call.args[1] if len(call.args) >= 2 else next(
+        (kw.value for kw in call.keywords if kw.arg == "device"), None)
+    if sharding is None:
+        return None
+    if isinstance(sharding, ast.Name):
+        return ns_names.get(sharding.id)
+    return named_sharding_spec(sharding)
+
+
+def sm_in_specs(call: ast.Call) -> list | None:
+    """shard_map(f, ..., in_specs=(P(..), ...)) -> per-arg literal specs
+    (None entries = unknown). Unwraps jax.jit(shard_map(...)). Returns None
+    when the call isn't a shard_map or its in_specs aren't a literal tuple."""
+    if _last_name(call.func) == "jit" and call.args \
+            and isinstance(call.args[0], ast.Call):
+        call = call.args[0]
+    if _last_name(call.func) not in _SM_NAMES:
+        return None
+    in_specs = next((kw.value for kw in call.keywords
+                     if kw.arg == "in_specs"), None)
+    if not isinstance(in_specs, (ast.Tuple, ast.List)):
+        return None
+    return [pspec_literal(el) for el in in_specs.elts]
+
+
+# -- the shared pass ---------------------------------------------------------
+
+
+class SpmdAnalysis:
+    """Per-lint-run SPMD context: collective reach, divergent returns,
+    spec-returning helpers, and the strict mesh region."""
+
+    def __init__(self, files: list[SourceFile], project: Project):
+        self.project = project
+        # fid -> ("lax.psum", "path:line") for the first collective lexically
+        # in that function's own body (nested defs excluded, like pass 1)
+        self.collective_site: dict[int, tuple[str, str]] = {}
+        # fid -> same tuple, via the call-graph fixpoint (TPU011's reach_block)
+        self.reach_collective: dict[int, tuple[str, str]] = {}
+        self.divergent_returning: set[int] = set()
+        self.spec_returning: dict[int, tuple] = {}
+        self.sm_roots: set[int] = set()
+        self.mesh_region: set[int] = set()
+        self._collect_direct()
+        self._fix_reach()
+        self._fix_divergent_returns()
+        self._fix_spec_returns()
+        self._build_region()
+
+    # -- direct per-function facts ------------------------------------------
+    def _collect_direct(self) -> None:
+        self._div_direct: set[int] = set()
+        self._spec_direct: dict[int, set] = {}
+        for fi in self.project.functions:
+            nested_ids: set[int] = set()
+            for n in ast.walk(fi.node):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and n is not fi.node:
+                    nested_ids.update(id(x) for x in ast.walk(n))
+            ns_names: dict = {}
+            for node in ast.walk(fi.node):
+                if node is fi.node or id(node) in nested_ids:
+                    continue
+                if isinstance(node, ast.Call):
+                    prim = is_collective(_dotted(node.func))
+                    if prim and fi.fid not in self.collective_site:
+                        self.collective_site[fi.fid] = (
+                            f"lax.{prim}", f"{fi.sf.relpath}:{node.lineno}")
+                elif isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call):
+                    spec = named_sharding_spec(node.value)
+                    if spec is not None:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                ns_names[t.id] = spec
+                elif isinstance(node, ast.Return) and node.value is not None:
+                    if divergent_expr(node.value, set()):
+                        self._div_direct.add(fi.fid)
+                    for sub in ast.walk(node.value):
+                        if isinstance(sub, ast.Call):
+                            spec = device_put_spec(sub, ns_names)
+                            if spec is not None:
+                                self._spec_direct.setdefault(
+                                    fi.fid, set()).add(spec)
+
+    # -- fixpoints -----------------------------------------------------------
+    def _fix_reach(self) -> None:
+        self.reach_collective = dict(self.collective_site)
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.project.functions:
+                if fi.fid in self.reach_collective:
+                    continue
+                for c in fi.calls:
+                    hit = self.reach_collective.get(c)
+                    if hit is not None:
+                        self.reach_collective[fi.fid] = hit
+                        changed = True
+                        break
+
+    def _fix_divergent_returns(self) -> None:
+        self.divergent_returning = set(self._div_direct)
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.project.functions:
+                if fi.fid in self.divergent_returning:
+                    continue
+                if fi.return_calls & self.divergent_returning:
+                    self.divergent_returning.add(fi.fid)
+                    changed = True
+
+    def _fix_spec_returns(self) -> None:
+        # a helper with ONE consistent literal placement across its returns;
+        # conflicting placements stay unknown (never a finding by themselves)
+        self.spec_returning = {fid: next(iter(specs))
+                               for fid, specs in self._spec_direct.items()
+                               if len(specs) == 1}
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.project.functions:
+                if fi.fid in self.spec_returning or not fi.return_calls:
+                    continue
+                specs = {self.spec_returning[c] for c in fi.return_calls
+                         if c in self.spec_returning}
+                if len(specs) == 1 and fi.return_calls <= \
+                        set(self.spec_returning):
+                    self.spec_returning[fi.fid] = next(iter(specs))
+                    changed = True
+
+    def _build_region(self) -> None:
+        """TPU016's strict region: actual shard_map roots (+callees) plus only
+        the escaping nested closures that themselves reach a collective —
+        NOT every escaping closure (shard_map_covered's benefit-of-the-doubt
+        would flag pool callbacks that legitimately read the clock)."""
+        _jit_roots, sm_roots = self.project._traced_roots()
+        self.sm_roots = sm_roots
+        doubt = {fi.fid for fi in self.project.functions
+                 if fi.nested and fi.escapes
+                 and fi.fid in self.reach_collective}
+        self.mesh_region = self.project._closure(sm_roots | doubt)
+
+    # -- per-file name maps (the device_returning_names idiom) ---------------
+    def divergent_fn_names(self, sf: SourceFile) -> set[str]:
+        """Names in sf's module that resolve to divergent-returning helpers."""
+        return self._names_for(sf, lambda fid: fid in self.divergent_returning)
+
+    def spec_fn_names(self, sf: SourceFile) -> dict[str, tuple]:
+        """name -> literal spec for spec-returning helpers visible in sf."""
+        out: dict[str, tuple] = {}
+        mod = module_name(sf.relpath)
+        for fi in self.project.functions:
+            if fi.fid in self.spec_returning and fi.module == mod:
+                out[fi.name] = self.spec_returning[fi.fid]
+        for alias, target in self.project._imports.get(mod, {}).items():
+            if "." in target:
+                tmod, tname = target.rsplit(".", 1)
+                for fid in self.project._lookup(tmod, tname):
+                    if fid in self.spec_returning:
+                        out[alias] = self.spec_returning[fid]
+        return out
+
+    def _names_for(self, sf: SourceFile, pred) -> set[str]:
+        mod = module_name(sf.relpath)
+        out = {fi.name for fi in self.project.functions
+               if pred(fi.fid) and fi.module == mod}
+        for alias, target in self.project._imports.get(mod, {}).items():
+            if "." in target:
+                tmod, tname = target.rsplit(".", 1)
+                if any(pred(fid) for fid in self.project._lookup(tmod, tname)):
+                    out.add(alias)
+        return out
+
+
+def analysis(files: list[SourceFile], project: Project) -> SpmdAnalysis:
+    """Build (or reuse) the SpmdAnalysis for this lint run — rules share it."""
+    cached = getattr(project, "_spmd_analysis", None)
+    if cached is None:
+        cached = SpmdAnalysis(files, project)
+        project._spmd_analysis = cached
+    return cached
