@@ -1046,6 +1046,12 @@ def main():
 
     import jax
 
+    from elasticsearch_tpu.common.jaxenv import compile_events_by_family
+
+    # install the compile listener BEFORE any launch: counts start at first
+    # call, and the BENCH tail reads the per-family ledger
+    compile_events_by_family()
+
     try:  # persistent XLA compilation cache: warm benches skip the ~30s compiles
         jax.config.update("jax_compilation_cache_dir", os.path.join(CACHE, "xla"))
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
@@ -1071,6 +1077,12 @@ def main():
                 json.dump(result["kernel"], f, indent=1)
         except Exception as e:  # noqa: BLE001 — persistence is best-effort
             print(f"# kernel row persist failed: {e}", file=sys.stderr)
+    # per-family backend-compile counts (the jaxenv compile_tag ledger) ride
+    # the one stdout line, so the trajectory shows WHERE a regression's
+    # compile bill landed (tools/compile_surface.json names the entry points)
+    fams = {k: v for k, v in sorted(compile_events_by_family().items()) if v}
+    if fams:
+        out_line["compile_families"] = fams
     print(json.dumps(out_line))
     sys.stdout.flush()
 
@@ -1084,9 +1096,14 @@ def main():
         if os.path.exists(stale):
             os.remove(stale)
         try:
+            pre = compile_events_by_family()
             srv = run_serving(
                 threads=min(SERVING_THREADS, 16), seconds=2.5,
                 n_docs=min(SERVING_DOCS, 3000))
+            srv["compile_families"] = {
+                k: v - pre.get(k, 0)
+                for k, v in sorted(compile_events_by_family().items())
+                if v - pre.get(k, 0)}
             with open(stale, "w") as f:
                 json.dump(srv, f, indent=1)
             print(f"# serving: {srv['value']} qps batched vs "

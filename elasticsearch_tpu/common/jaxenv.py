@@ -21,6 +21,7 @@ from __future__ import annotations
 import contextlib
 import os
 import re
+import sys
 import threading
 from dataclasses import dataclass, field
 
@@ -86,7 +87,7 @@ _tag_local = threading.local()
 
 COMPILE_FAMILIES = ("sparse", "dense", "function_score", "filtered",
                     "sorted", "aggs", "percolate", "mesh", "compact",
-                    "untagged")
+                    "pack", "untagged")
 _FAMILY_SET = frozenset(COMPILE_FAMILIES)
 
 
@@ -108,6 +109,28 @@ def compile_tag(tag: str):
         _tag_local.tag = None
 
 
+# untagged-origin capture: bounded — a runaway untagged site can't grow the
+# dict past this many distinct call sites
+_ORIGIN_CAP = 64
+
+
+def _package_origin() -> str | None:
+    """First stack frame inside elasticsearch_tpu/ (this module excluded) on
+    the thread that triggered an untagged compile — names the launch site that
+    compiled outside every compile_tag scope. Test-local eager jnp compiles
+    have no package frame and return None: the conftest compile_surface_gate
+    only fails on PACKAGE-originated untagged compiles."""
+    marker = os.sep + "elasticsearch_tpu" + os.sep
+    f = sys._getframe(1)
+    while f is not None:
+        fn = f.f_code.co_filename
+        i = fn.find(marker)
+        if i >= 0 and not fn.endswith("jaxenv.py"):
+            return f"{fn[i + 1:]}:{f.f_lineno}"
+        f = f.f_back
+    return None
+
+
 class _CompileCounter:
     """Process-wide compile-event listener fanning out to active scopes.
 
@@ -125,16 +148,30 @@ class _CompileCounter:
         self.total = 0
         # plan-family attribution (compile_tag): family -> count
         self.by_family: dict = {}
+        # untagged-compile origin sites ("path:line" -> count), recorded only
+        # when record_untagged_origins() armed it — the runtime twin of the
+        # compile-surface manifest's families cross-check
+        self.untagged_origins: dict = {}
+        self._record_origins = False
 
     def _listener(self, key: str, duration: float, **_kw) -> None:
         if _COMPILE_EVENT_SUBSTR not in key:
             return
         family = getattr(_tag_local, "tag", None) or "untagged"
+        # stack walk OUTSIDE the lock — frame inspection is slow-path work and
+        # must not extend the critical section other compiling threads share
+        origin = _package_origin() \
+            if family == "untagged" and self._record_origins else None
         # note() under the lock: concurrent pool-thread compiles must not lose
         # increments, or a blown budget could pass silently
         with self._lock:
             self.total += 1
             self.by_family[family] = self.by_family.get(family, 0) + 1
+            if origin is not None and (origin in self.untagged_origins
+                                       or len(self.untagged_origins)
+                                       < _ORIGIN_CAP):
+                self.untagged_origins[origin] = \
+                    self.untagged_origins.get(origin, 0) + 1
             for r in self._active:
                 r.note(key)
 
@@ -183,6 +220,28 @@ def compile_events_by_family() -> dict:
         pass
     with _counter._lock:
         return dict(_counter.by_family)
+
+
+def record_untagged_origins(enable: bool = True) -> None:
+    """Arm (or disarm) package-origin capture for untagged compile events: the
+    listener walks the triggering thread's stack and records the first
+    elasticsearch_tpu/ frame per event. Used by the conftest
+    compile_surface_gate — a tier-1 run must end with zero package-originated
+    untagged compiles, i.e. every package launch site sits under a
+    compile_tag scope registered in tools/compile_surface.json."""
+    try:
+        _counter.ensure_installed()
+    except Exception:  # noqa: BLE001 — no jax in this process: nothing to arm
+        pass
+    _counter._record_origins = enable
+
+
+def untagged_package_origins() -> dict:
+    """{"path:line": count} for untagged compiles whose stack crossed the
+    package, since record_untagged_origins() armed capture. Empty when every
+    package-originated compile carried a compile_tag family."""
+    with _counter._lock:
+        return dict(_counter.untagged_origins)
 
 
 class CompileBudgetExceeded(AssertionError):

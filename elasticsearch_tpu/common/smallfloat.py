@@ -66,7 +66,12 @@ def jnp_norm_table():
     importing the codec never touches a device."""
     import jax.numpy as jnp
 
-    return jnp.asarray(NORM_TABLE.astype(np.float32))
+    from .jaxenv import compile_tag
+
+    # compile_tag: eager table uploads are codec/packing work — outermost
+    # scope wins, so a traced caller (the mesh program) keeps its own family.
+    with compile_tag("pack"):
+        return jnp.asarray(NORM_TABLE.astype(np.float32))
 
 
 def jnp_byte315_to_float(b):
@@ -80,7 +85,10 @@ def jnp_byte315_to_float(b):
     transfer the sanitizer rejects."""
     import jax.numpy as jnp
 
-    return jnp.take(jnp_norm_table(), jnp.asarray(b).astype(jnp.int32))
+    from .jaxenv import compile_tag
+
+    with compile_tag("pack"):
+        return jnp.take(jnp_norm_table(), jnp.asarray(b).astype(jnp.int32))
 
 
 def jnp_doclen_table():
@@ -88,7 +96,10 @@ def jnp_doclen_table():
     decode_norm_doclen over all bytes (dl = 1/f², byte 0 → length 0)."""
     import jax.numpy as jnp
 
-    return jnp.asarray(decode_norm_doclen(np.arange(256, dtype=np.uint8)))
+    from .jaxenv import compile_tag
+
+    with compile_tag("pack"):
+        return jnp.asarray(decode_norm_doclen(np.arange(256, dtype=np.uint8)))
 
 
 def decode_norm_tfidf(norm_byte: np.ndarray) -> np.ndarray:

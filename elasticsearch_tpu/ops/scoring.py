@@ -602,8 +602,11 @@ def score_agg_batch(packed: PackedSegment, batch: TermBatch, k: int,
     import jax.numpy as jnp
 
     norms_stack, caches = _stack_args(packed, batch)
+    # bucket-agg count rides the pow-2 ladder: the wrapper is generic over the
+    # pairs pytree (jit retraces per structure under ONE cache entry), so a
+    # raw len() here would admit one executable per distinct agg count
     key = ("aggstats", batch.n_queries, min(k, packed.doc_pad), packed.doc_pad,
-           len(bucket_pairs))
+           _pow2_bucket(len(bucket_pairs), 1) if bucket_pairs else 0)
     fn = _compiled_cache.get(key)
     if fn is None:
         def wrapper(*args):

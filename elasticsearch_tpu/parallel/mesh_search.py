@@ -32,6 +32,7 @@ from dataclasses import dataclass, field as dc_field
 
 import numpy as np
 
+from ..common.jaxenv import compile_tag
 from ..common.smallfloat import jnp_doclen_table, jnp_norm_table
 from ..index.engine import Searcher
 from ..ops.device_index import (
@@ -650,14 +651,19 @@ class MeshSearchExecutor:
             n = len(p[0])
             qidx[si, :n], blk[si, :n], clause_id[si, :n] = p[0], p[1], p[2]
             fidx[si, :n], group[si, :n], tfmode[si, :n] = p[3], p[4], p[5]
-        # per-query bool semantics
+        # per-query bool semantics — padded to the pow-2 query bucket so the
+        # executable cache in search() keys on the bucket ladder, not raw
+        # len(plans) (one compiled program per QUERY-COUNT BUCKET, not per
+        # distinct batch size). Padding queries have zero clauses and zero
+        # must/msm; their output rows are sliced off before MeshTopDocs.
         Q = len(plans)
+        Qp = _pow2_bucket(Q, 1)
         n_scoring_max = max(
             (sum(1 for c in p.clauses if c.group != GROUP_MUST_NOT) for p in plans),
             default=1) or 1
-        n_must = np.zeros(Q, np.int32)
-        msm = np.zeros(Q, np.int32)
-        coord = np.ones((Q, n_scoring_max + 1), np.float32)
+        n_must = np.zeros(Qp, np.int32)
+        msm = np.zeros(Qp, np.int32)
+        coord = np.ones((Qp, n_scoring_max + 1), np.float32)
         for qi, p in enumerate(plans):
             n_must[qi] = p.n_must
             msm[qi] = p.msm
@@ -710,6 +716,15 @@ class MeshSearchExecutor:
         Q = len(plans)
         (qidx, blk, clause_id, fidx, group, tfmode, df_local, boost, clause_qidx,
          clause_scoring, n_must, msm, coord) = self._assemble(plans)
+        # the pow-2 query bucket _assemble padded to — the program and its
+        # cache key are shaped by Qp, outputs slice back to the real Q below
+        Qp = n_must.shape[0]
+        if filter_masks is not None and filter_masks.shape[1] != Qp:
+            filter_masks = np.pad(
+                filter_masks, ((0, 0), (0, Qp - filter_masks.shape[1]), (0, 0)))
+        if post_masks is not None and post_masks.shape[1] != Qp:
+            post_masks = np.pad(
+                post_masks, ((0, 0), (0, Qp - post_masks.shape[1]), (0, 0)))
 
         bucket_pairs = bucket_pairs or []
         has_filter = filter_masks is not None
@@ -725,7 +740,7 @@ class MeshSearchExecutor:
         has_active = active is not None
         bucket_specs = tuple((int(nb), tuple(sub) if sub else None)
                              for (_pd, _pb, nb, sub) in bucket_pairs)
-        key = (Q, k, qidx.shape[1], coord.shape[1], has_filter, has_stack,
+        key = (Qp, k, qidx.shape[1], coord.shape[1], has_filter, has_stack,
                has_aggs, has_post, has_min, has_sort, sort_desc, has_active,
                bucket_specs)
         in_specs = [
@@ -751,7 +766,7 @@ class MeshSearchExecutor:
             in_specs.extend([P("shards"), P("shards")])
         fn = self._compiled.get(key)
         if fn is None:
-            program = _mesh_score_program(k, Q, idx.doc_pad, self.similarity_kind,
+            program = _mesh_score_program(k, Qp, idx.doc_pad, self.similarity_kind,
                                           self.k1, self.b, self.use_global_stats,
                                           use_filter=has_filter,
                                           use_aggs=has_aggs,
@@ -800,28 +815,34 @@ class MeshSearchExecutor:
         # is a no-op.
         from jax.sharding import NamedSharding
 
-        args = [jax.device_put(a, NamedSharding(self.mesh, s))
-                for a, s in zip(raw, in_specs)]
+        # compile_tag: first sightings of a (Qp, shapes, feature-set) key trace
+        # and compile HERE — attribute them to the "mesh" ledger family (the
+        # same family the batcher's mesh launches carry)
+        with compile_tag("mesh"):
+            args = [jax.device_put(a, NamedSharding(self.mesh, s))
+                    for a, s in zip(raw, in_specs)]
 
-        # ONE explicit pull for every program output — per-output np.asarray was
-        # an implicit transfer each, which transfer_guard("disallow") rejects
-        outs = list(jax.device_get(fn(*args)))
-        top_scores = outs.pop(0)[0]
-        top_ids = outs.pop(0)[0]
-        shard_totals = outs.pop(0)[0]  # [S, Q]
-        qmax = outs.pop(0)[0]  # [S, Q]
-        out_sort_keys = outs.pop(0)[0] if has_sort else None
+            # ONE explicit pull for every program output — per-output
+            # np.asarray was an implicit transfer each, which
+            # transfer_guard("disallow") rejects
+            outs = list(jax.device_get(fn(*args)))
+        # every per-query axis slices from the padded Qp back to the real Q
+        top_scores = outs.pop(0)[0][:Q]
+        top_ids = outs.pop(0)[0][:Q]
+        shard_totals = outs.pop(0)[0][:, :Q]  # [S, Q]
+        qmax = outs.pop(0)[0][:, :Q]  # [S, Q]
+        out_sort_keys = outs.pop(0)[0][:Q] if has_sort else None
         agg_counts = agg_stats = None
         if has_aggs:
-            agg_counts = outs.pop(0)[0]  # [S, Q, F]
-            agg_stats = outs.pop(0)[0]  # [S, Q, F, 4]
+            agg_counts = outs.pop(0)[0][:, :Q]  # [S, Q, F]
+            agg_stats = outs.pop(0)[0][:, :Q]  # [S, Q, F, 4]
         bucket_results = []
         for (_nb, sub) in bucket_specs:
-            cnts = outs.pop(0)[0]  # [S, Q, NB]
+            cnts = outs.pop(0)[0][:, :Q]  # [S, Q, NB]
             sc = ss = None
             if sub:
-                sc = outs.pop(0)[0]  # [S, Q, Fs, NB]
-                ss = outs.pop(0)[0]  # [S, Q, Fs, NB, 4]
+                sc = outs.pop(0)[0][:, :Q]  # [S, Q, Fs, NB]
+                ss = outs.pop(0)[0][:, :Q]  # [S, Q, Fs, NB, 4]
             bucket_results.append((cnts, sc, ss))
         valid_rank = np.isfinite(out_sort_keys if has_sort else top_scores)
         shard = np.where((top_ids >= 0) & valid_rank, top_ids // idx.doc_pad, -1)

@@ -1058,8 +1058,12 @@ def _filter_mask_matrix(filters: list, seg, packed, ctx: ShardContext):
     import jax
     import jax.numpy as jnp
 
-    return jnp.stack([row if not isinstance(row, np.ndarray)
-                      else jax.device_put(row) for row in rows])
+    # compile_tag: the eager stack fuses cached device rows with fresh host
+    # masks for the filtered kernels — outermost scope wins, so launches from
+    # inside dense/sorted paths keep their own family.
+    with compile_tag("filtered"):
+        return jnp.stack([row if not isinstance(row, np.ndarray)
+                          else jax.device_put(row) for row in rows])
 
 
 def _execute_flat_filtered(plans: list[FlatPlan], ctx: ShardContext,
@@ -1184,7 +1188,7 @@ def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
     import jax
     import jax.numpy as jnp
 
-    from ..ops.device_index import ensure_agg_rows, packed_for
+    from ..ops.device_index import _pow2_bucket, ensure_agg_rows, packed_for
     from ..ops.scoring import build_term_batch, score_agg_batch
     from .aggregations import bucket_cache_key, bucket_cols_for
 
@@ -1216,11 +1220,16 @@ def execute_flat_aggs(plan: FlatPlan, ctx: ShardContext, k: int,
 
                 # explicit device_put: eager jnp.zeros builds its fill scalar
                 # through an implicit host→device transfer, which the
-                # transfer_guard("disallow") sanitizer rejects
+                # transfer_guard("disallow") sanitizer rejects. The NB dim
+                # rides the pow-2 ladder — it shapes the scatter outputs
+                # inside the jit, so a raw len(keys) would compile one
+                # executable per distinct bucket-key count; every consumer
+                # zips counts against `keys` and ignores the padding.
                 dev = _bucket_cache_put(
                     packed.bucket_cols, ck,
                     (jnp.asarray(pdoc), jnp.asarray(pbucket),
-                     jax.device_put(np.zeros(len(keys), np.int32))))
+                     jax.device_put(np.zeros(_pow2_bucket(len(keys), 1),
+                                             np.int32))))
             sub_stack = None
             if sub_order:
                 sub_stack = ensure_agg_rows(seg, packed, sub_order,

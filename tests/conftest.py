@@ -87,6 +87,32 @@ def collective_trace_gate():
         meshtrace.TRACER.check()
 
 
+@pytest.fixture(scope="session", autouse=True)
+def compile_surface_gate():
+    """Runtime twin of the compile-surface manifest (tools/compile_surface.json,
+    tpulint TPU018-TPU021): arm jaxenv's untagged-origin capture for the whole
+    session, then assert (a) zero PACKAGE-originated untagged compile events —
+    every elasticsearch_tpu/ launch site that compiled sat under a compile_tag
+    scope the manifest knows — and (b) every observed family is in the
+    COMPILE_FAMILIES vocabulary. Test-local eager jnp compiles have no package
+    frame and are out of scope by construction (they are the tests' own, not
+    serving-path, compiles)."""
+    from elasticsearch_tpu.common import jaxenv
+
+    jaxenv.record_untagged_origins(True)
+    yield
+    origins = jaxenv.untagged_package_origins()
+    assert not origins, (
+        "package-originated compile events outside every compile_tag scope "
+        f"(site -> count): {origins} — wrap each launch in "
+        "jaxenv.compile_tag(<family>) and regenerate the manifest with "
+        "`python -m tools.tpulint --compile-surface --write`")
+    observed = set(jaxenv.compile_events_by_family())
+    unknown = observed - set(jaxenv.COMPILE_FAMILIES)
+    assert not unknown, (
+        f"compile families outside the COMPILE_FAMILIES vocabulary: {unknown}")
+
+
 @pytest.fixture(autouse=True)
 def jax_sanitizer(request):
     mod = request.module.__name__.rsplit(".", 1)[-1]

@@ -29,7 +29,7 @@ FIXDIR = os.path.join(REPO, "tests", "tpulint_fixtures")
 RULES = ["TPU001", "TPU002", "TPU003", "TPU004", "TPU005",
          "TPU006", "TPU007", "TPU008", "TPU009", "TPU010",
          "TPU011", "TPU012", "TPU013", "TPU014", "TPU015",
-         "TPU016", "TPU017"]
+         "TPU016", "TPU017", "TPU018", "TPU019", "TPU020", "TPU021"]
 
 
 def _marked_lines(path: str) -> set:
@@ -153,6 +153,21 @@ def test_interproc_collective_divergence_cross_module():
     assert [(f.path.rsplit("/", 1)[-1], f.line) for f in both] == \
         [("tp_xmod_tpu014_root.py", 25)], [f.to_dict() for f in both]
     assert "tp_xmod_tpu014_helper.py:13" in both[0].message, both[0].message
+
+
+def test_interproc_unbucketed_dim_cross_module():
+    """TPU018 across modules: the raw request length is computed by a helper
+    in another file. The helper alone is silent (no executable constructed
+    there); linted together, the return-calls fixpoint classifies the helper
+    as unbounded-returning and the root's allocation is flagged at its own
+    line."""
+    helper = os.path.join(FIXDIR, "tp_xmod_tpu018_helper.py")
+    root = os.path.join(FIXDIR, "tp_xmod_tpu018_root.py")
+    assert [f for f in lint_paths([helper]) if f.rule == "TPU018"] == []
+    both = [f for f in lint_paths([root, helper]) if f.rule == "TPU018"]
+    assert {(f.path.rsplit("/", 1)[-1], f.line) for f in both} == \
+        {("tp_xmod_tpu018_root.py", ln)
+         for ln in _marked_lines(root)}, [f.to_dict() for f in both]
 
 
 def test_abba_fixture_is_a_tpu004_true_positive():

@@ -1,0 +1,51 @@
+"""tpulint fixture — FALSE positives for TPU018: must stay silent.
+
+The sanctioned shapes: bucket-ladder dims (`_pow2_bucket`/`_k_bucket`),
+`min()`-capped dims, config constants — and raw lengths in host-side
+bookkeeping functions nowhere near a jit boundary (out of the compile-surface
+scope by construction).
+"""
+
+import jax
+import numpy as np
+
+PAD = 128
+
+
+def _pow2_bucket(n, minimum=16):
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def _impl(x):
+    return x * 2
+
+
+def launch_bucketed(hits):
+    n = _pow2_bucket(len(hits), 16)
+    fn = jax.jit(_impl)
+    return fn(np.zeros((n, 128), np.float32))  # bucket ladder: bounded
+
+
+def launch_capped(hits):
+    fn = jax.jit(_impl)
+    k = min(len(hits), 64)
+    return fn(np.zeros((k, 4), np.float32))  # min() bounds the dim
+
+
+def launch_const(x):
+    fn = jax.jit(_impl)
+    return fn(x + np.ones((PAD, 4), np.float32))  # config constant
+
+
+def launch_param(x, n):
+    fn = jax.jit(_impl)
+    return fn(x * np.zeros(n, np.float32))  # bare parameter: unknown, silent
+
+
+def host_bookkeeping(hits):
+    # raw length is FINE here: no executable is constructed in this function
+    # and it calls no factory — host-side numpy never compiles anything
+    return np.zeros(len(hits), np.int64)

@@ -1,0 +1,51 @@
+"""tpulint fixture — FALSE positives for TPU019: must stay silent.
+
+The sanctioned statics: bools, enum strings, config constants, bucketed
+values, and plain parameters (unknown provenance never fires). Static args
+with a handful of distinct values are exactly what static_argnums is FOR.
+"""
+
+from functools import partial
+
+import jax
+
+
+def _pow2_bucket(n, minimum=16):
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def _impl(x, n):
+    return x[:n]
+
+
+_fn = jax.jit(_impl, static_argnums=(1,))
+
+
+@partial(jax.jit, static_argnames=("desc", "mode"))
+def _sorter(x, desc, mode):
+    return x if desc else -x
+
+
+def call_config_const(data):
+    return _fn(data, 128)  # literal config constant
+
+
+def call_bucketed(data, xs):
+    return _fn(data, _pow2_bucket(len(xs), 16))  # bucket ladder bounds it
+
+
+def call_bool_enum(data):
+    return _sorter(data, desc=True, mode="bm25")  # bool/enum statics
+
+
+def call_param(data, k):
+    return _fn(data, k)  # bare parameter: unknown, silent
+
+
+def traced_operand(data, xs):
+    # the len flows into a TRACED (non-static) slot: jit shares executables
+    # per shape there, so only TPU018's bucket discipline applies, not TPU019
+    return _impl(data, len(xs))

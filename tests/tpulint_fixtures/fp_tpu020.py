@@ -1,0 +1,48 @@
+"""tpulint fixture — FALSE positives for TPU020: must stay silent.
+
+The sanctioned patterns: module-level executables (the decorator idiom),
+caches keyed on bucket-ladder dims or config flags, and get-or-build caches
+whose ctor sits under an `if` (not a loop). Unknown key elements (parameters,
+`.shape` reads of already-bucketed arrays) never fire.
+"""
+
+import jax
+
+_cache = {}
+
+
+def _pow2_bucket(n, minimum=16):
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def _impl(x):
+    return x * 2
+
+
+_module_fn = jax.jit(_impl)  # module-level construction: compiles once
+
+
+def bucket_keyed(batch, simple):
+    key = (_pow2_bucket(len(batch), 16), bool(simple))
+    fn = _cache.get(key)
+    if fn is None:
+        fn = jax.jit(_impl)  # under an if, not a loop — get-or-build
+        _cache[key] = fn  # bucketed key: bounded executable family
+    return fn
+
+
+def config_keyed(doc_pad, k):
+    key = (doc_pad, k)  # bare parameters: unknown provenance, silent
+    fn = _cache.get(key)
+    if fn is None:
+        fn = jax.jit(_impl)
+        _cache[key] = fn
+    return fn
+
+
+def shape_keyed(x):
+    key = x.shape[0]  # .shape of an already-padded operand: unknown, silent
+    return _cache.setdefault(key, _module_fn)

@@ -1,0 +1,53 @@
+"""tpulint fixture — FALSE positives for TPU021: must stay silent.
+
+Consistent operand families never fire: every site of one callable committed
+(the `_scalar_f32` idiom), every site of another weak-scalar-only, unknown
+operands (bare parameters, arbitrary calls) contributing nothing. The two
+factories below are DISTINCT origins — their families never merge.
+"""
+
+import jax
+import numpy as np
+
+
+def _impl(x, alpha):
+    return x * alpha
+
+
+def _scalar_f32(v):
+    return jax.device_put(np.float32(v))
+
+
+def _get_committed_fn():
+    fn = jax.jit(_impl)
+    return fn
+
+
+def _get_scalar_fn():
+    fn = jax.jit(_impl)
+    return fn
+
+
+def score_a(x):
+    fn = _get_committed_fn()
+    return fn(x, _scalar_f32(0.5))  # committed via the sanctioned idiom
+
+
+def score_b(x, t):
+    fn = _get_committed_fn()
+    return fn(x, jax.device_put(np.float32(t)))  # also committed: consistent
+
+
+def rank_a(x):
+    fn = _get_scalar_fn()
+    return fn(x, 0.5)  # scalar-only family: one weak executable, consistent
+
+
+def rank_b(x, fast):
+    fn = _get_scalar_fn()
+    return fn(x, 0.5 if fast else 2.0)  # both branches scalar: still one kind
+
+
+def unknown_operand(x, alpha):
+    fn = _get_scalar_fn()
+    return fn(x, alpha)  # bare parameter: unknown kind, never contributes

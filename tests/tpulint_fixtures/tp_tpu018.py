@@ -1,0 +1,44 @@
+"""tpulint fixture — TRUE positives for TPU018 (unbucketed request dims).
+
+Never imported: parsed by tests/test_tpulint.py. Every `TP`-marked line must
+be flagged with TPU018. Raw request-derived lengths (`len(...)` of live data,
+directly or through a helper) shaping arrays inside the compile surface —
+the function constructing the executable, or its direct launch-wrapper
+caller — give every distinct request size its own XLA executable.
+"""
+
+import jax
+import numpy as np
+
+
+def _impl(x):
+    return x * 2
+
+
+def launch_raw_len(hits):
+    fn = jax.jit(_impl)
+    x = np.zeros((len(hits), 128), np.float32)  # TP: raw length shapes operand
+    return fn(x)
+
+
+def launch_raw_arange(qs):
+    fn = jax.jit(_impl)
+    idx = np.arange(len(qs))  # TP: request-sized iota into the launch
+    return fn(idx)
+
+
+def launch_via_name(rows):
+    n = len(rows)
+    fn = jax.jit(_impl)
+    buf = np.ones((4, n), np.float32)  # TP: the raw length flowed through n
+    return fn(buf)
+
+
+def _get_compiled(x):
+    fn = jax.jit(_impl)
+    return fn(x)
+
+
+def wrapper_feeds_factory(entries):
+    pad = np.zeros(len(entries), np.float32)  # TP: direct caller of a factory
+    return _get_compiled(pad)

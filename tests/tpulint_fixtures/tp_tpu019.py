@@ -1,0 +1,37 @@
+"""tpulint fixture — TRUE positives for TPU019 (unbounded static args).
+
+Never imported: parsed by tests/test_tpulint.py. Every `TP`-marked line must
+be flagged with TPU019. Static jit arguments key the executable cache by
+VALUE: binding one to raw request data (`len(...)` of live input) compiles a
+fresh executable per distinct value — positionally, by keyword, and through
+the decorated-def parameter mapping.
+"""
+
+from functools import partial
+
+import jax
+
+
+def _impl(x, n):
+    return x[:n]
+
+
+_fn = jax.jit(_impl, static_argnums=(1,))
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _topk(x, k):
+    return x[:k]
+
+
+def call_static_pos(xs, data):
+    n = len(xs)
+    return _fn(data, n)  # TP: unbounded value bound to static_argnums slot
+
+
+def call_static_kw(xs, data):
+    return _topk(data, k=len(xs))  # TP: unbounded keyword static
+
+
+def call_static_named_pos(xs, data):
+    return _topk(data, len(xs))  # TP: positional binding of a named static

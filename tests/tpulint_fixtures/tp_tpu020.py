@@ -1,0 +1,46 @@
+"""tpulint fixture — TRUE positives for TPU020 (leaky executable caches).
+
+Never imported: parsed by tests/test_tpulint.py. Every `TP`-marked line must
+be flagged with TPU020. Executables constructed per loop iteration, and
+cache stores keyed by raw request shapes (`len(...)` of live data) — the
+cache admits one compiled program per distinct request size and never
+converges.
+"""
+
+import jax
+
+_cache = {}
+
+
+def _impl(x):
+    return x * 2
+
+
+def store_raw_key(batch):
+    n = len(batch)
+    key = (n, 128)
+    fn = jax.jit(_impl)
+    _cache[key] = fn  # TP: cache keyed by the raw request length
+    return fn
+
+
+def setdefault_raw_key(batch):
+    fn = jax.jit(_impl)
+    return _cache.setdefault(len(batch), fn)  # TP: raw-shape setdefault key
+
+
+def build_per_iteration(batches):
+    outs = []
+    for b in batches:
+        fn = jax.jit(_impl)  # TP: fresh executable every iteration
+        outs.append(fn(b))
+    return outs
+
+
+def build_in_while(batches):
+    i = 0
+    while i < len(batches):
+        step = jax.jit(_impl)  # TP: ctor inside the retry loop
+        batches[i] = step(batches[i])
+        i += 1
+    return batches

@@ -1,0 +1,42 @@
+"""tpulint fixture — TRUE positives for TPU021 (weak-type family splits).
+
+Never imported: parsed by tests/test_tpulint.py. Every `TP`-marked line must
+be flagged with TPU021. One compiled callable reached with both a raw Python
+scalar (weak-typed trace) and a committed `device_put` operand traces two
+executables for one program — across call sites sharing a jit factory, on a
+single local executable, and inside one mixed-branch expression.
+"""
+
+import jax
+import numpy as np
+
+
+def _impl(x, alpha):
+    return x * alpha
+
+
+def _get_fn():
+    fn = jax.jit(_impl)
+    return fn
+
+
+def score_committed(x):
+    fn = _get_fn()
+    return fn(x, jax.device_put(np.float32(0.5)))  # committed family anchor
+
+
+def score_scalar(x):
+    fn = _get_fn()
+    return fn(x, 0.5)  # TP: raw scalar splits the factory's executable family
+
+
+def local_split(x):
+    fn = jax.jit(_impl)
+    a = fn(x, jax.device_put(np.float32(2.0)))
+    b = fn(x, 2.0)  # TP: scalar vs committed on one local executable
+    return a + b
+
+
+def mixed_branch(x, fast):
+    fn = _get_fn()
+    return fn(x, jax.device_put(np.float32(0.5)) if fast else 0.5)  # TP: mixed

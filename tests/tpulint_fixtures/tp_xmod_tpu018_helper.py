@@ -1,0 +1,11 @@
+"""tpulint fixture — cross-module half of the TPU018 pair: the raw length.
+
+Linted ALONE this file has no TPU018 findings (no executable is constructed
+here — host-side bookkeeping is out of the compile surface). Linted together
+with tp_xmod_tpu018_root.py, the return-calls fixpoint marks `staged_len` as
+unbounded-returning and the root's allocation is flagged AT ITS OWN LINE.
+"""
+
+
+def staged_len(entries):
+    return len(entries)

@@ -1,0 +1,21 @@
+"""tpulint fixture — cross-module TRUE positive for TPU018: the unbucketed
+length is computed in tp_xmod_tpu018_helper.py, the jit boundary lives HERE.
+The compile-surface return-calls fixpoint classifies `staged_len` as
+unbounded-returning across the module boundary, so the allocation below is a
+request-derived shape with no bucket ladder.
+"""
+
+import jax
+import numpy as np
+
+from tp_xmod_tpu018_helper import staged_len
+
+
+def _impl(x):
+    return x * 2
+
+
+def launch(entries):
+    fn = jax.jit(_impl)
+    m = staged_len(entries)
+    return fn(np.zeros((m, 128), np.float32))  # TP: helper-computed raw length

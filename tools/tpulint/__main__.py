@@ -4,13 +4,29 @@
 `--explain TPU0NN` prints one rule's docstring plus a true/false-positive
 example extracted from its fixture corpus (tests/tpulint_fixtures/), so a
 finding in CI is self-documenting at the terminal; unknown rule ids exit 2.
+`--explain TPU0NN..TPU0MM` explains an inclusive range (e.g.
+`--explain TPU018..TPU021` walks the whole compile-surface family).
+
+`--compile-surface` switches to the compile-surface manifest mode
+(tools/tpulint/compilesurface.py): enumerate every jit/shard_map/pallas_call
+entry point in the default package scan and compare against the committed
+tools/compile_surface.json. With `--json` the manifest is printed to stdout;
+with `--write` the committed file is regenerated in place.
 
 Exit-code contract (stable; CI and the pre-push hook depend on it):
 
   0  clean — no findings outside the baseline (without --check, ALWAYS 0 so
-     ad-hoc runs over fixtures don't fail shells)
-  1  --check given and at least one NEW (non-grandfathered) finding exists
-  2  usage error (bad flag combination, e.g. --update-baseline with paths)
+     ad-hoc runs over fixtures don't fail shells). In --compile-surface
+     mode: manifest matches the committed file, every entry point has at
+     least one owning compile_tag family, and every tag literal is in the
+     jaxenv COMPILE_FAMILIES vocabulary (--write always exits 0 after
+     regenerating).
+  1  --check given and at least one NEW (non-grandfathered) finding exists.
+     In --compile-surface mode: drift vs the committed manifest, an entry
+     point with no owning family (invisible to the compile ledger), or a
+     compile_tag literal outside the runtime vocabulary.
+  2  usage error (bad flag combination, e.g. --update-baseline with paths,
+     or --compile-surface with paths/--check/--update-baseline)
 
 Output formats (--format, default text; --json is an alias for --format json):
 
@@ -33,6 +49,7 @@ import json
 import os
 import sys
 
+from . import compilesurface
 from .engine import (
     DEFAULT_BASELINE,
     REPO,
@@ -101,9 +118,7 @@ def _fixture_snippet(path: str, kind: str) -> str | None:
     return None
 
 
-def _explain(rule_id: str) -> int:
-    """--explain TPU0NN: the rule's docstring plus one tp/fp example from the
-    fixture corpus, so findings are self-documenting at the terminal."""
+def _explain_one(rule_id: str) -> int:
     mod = RULE_MODULES.get(rule_id.upper())
     if mod is None:
         print(f"unknown rule [{rule_id}] — known rules: "
@@ -122,13 +137,85 @@ def _explain(rule_id: str) -> int:
     return 0
 
 
+def _explain(spec: str) -> int:
+    """--explain TPU0NN or --explain TPU0NN..TPU0MM (inclusive range): the
+    rule docstring(s) plus tp/fp examples from the fixture corpus, so
+    findings are self-documenting at the terminal."""
+    if ".." not in spec:
+        return _explain_one(spec)
+    lo, _, hi = spec.partition("..")
+    lo, hi = lo.upper().strip(), hi.upper().strip()
+    ids = sorted(RULE_MODULES)
+    if lo not in RULE_MODULES or hi not in RULE_MODULES or lo > hi:
+        print(f"bad --explain range [{spec}] — both ends must be known rules "
+              "in order; known: " + ", ".join(ids), file=sys.stderr)
+        return 2
+    first = True
+    for rid in ids:
+        if lo <= rid <= hi:
+            if not first:
+                print("\n" + "=" * 72 + "\n")
+            first = False
+            _explain_one(rid)
+    return 0
+
+
+def _compile_surface(write: bool, as_json: bool) -> int:
+    """--compile-surface mode: build the manifest over the default package
+    scan, print (--json) or regenerate (--write) it, else diff against the
+    committed tools/compile_surface.json."""
+    manifest = compilesurface.build_manifest()
+    text = compilesurface.canonical_json(manifest)
+    rc = 0
+    untagged = [r for r in manifest["entry_points"] if not r["families"]]
+    for r in untagged:
+        print(f"{r['file']}:{r['line']}: entry point `{r['qualname']}` "
+              f"({r['kind']}) is reachable from NO compile_tag scope — its "
+              "compiles land in the `untagged` ledger bucket; wrap the "
+              "launch in jaxenv.compile_tag(...)", file=sys.stderr)
+        rc = 1
+    vocab = set(manifest["runtime_families"])
+    if vocab:
+        for fam in manifest["families"]:
+            if fam not in vocab:
+                print(f"compile_tag family {fam!r} is not in "
+                      "jaxenv.COMPILE_FAMILIES — runtime will rebucket it "
+                      "as `untagged`", file=sys.stderr)
+                rc = 1
+    if write:
+        with open(compilesurface.MANIFEST_PATH, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(f"wrote {os.path.relpath(compilesurface.MANIFEST_PATH, REPO)}: "
+              f"{len(manifest['entry_points'])} entry point(s), "
+              f"{len(manifest['families'])} famil(y/ies)", file=sys.stderr)
+        return 0
+    if as_json:
+        sys.stdout.write(text)
+    committed = compilesurface.load_committed()
+    if committed is None:
+        print("no committed manifest at tools/compile_surface.json — run "
+              "`python -m tools.tpulint --compile-surface --write`",
+              file=sys.stderr)
+        return 1
+    if committed != text:
+        print("compile-surface manifest DRIFT: tools/compile_surface.json "
+              "does not match the current package — regenerate with "
+              "`python -m tools.tpulint --compile-surface --write`",
+              file=sys.stderr)
+        return 1
+    if rc == 0 and not as_json:
+        print(f"compile surface clean: {len(manifest['entry_points'])} entry "
+              f"point(s), all tagged, manifest in sync", file=sys.stderr)
+    return rc
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="tools.tpulint",
         description="JAX/TPU hot-path + concurrency static analyzer "
-                    "(TPU001-TPU017)",
-        epilog="exit codes: 0 clean, 1 new findings (--check only), "
-               "2 usage error")
+                    "(TPU001-TPU021)",
+        epilog="exit codes: 0 clean, 1 new findings (--check only) or "
+               "compile-surface drift, 2 usage error")
     ap.add_argument("paths", nargs="*",
                     help="files to lint (default: elasticsearch_tpu/**/*.py)")
     ap.add_argument("--check", action="store_true",
@@ -138,7 +225,8 @@ def main(argv: list[str] | None = None) -> int:
                     help="output format (default text; github = workflow "
                          "annotations)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="alias for --format json")
+                    help="alias for --format json (in --compile-surface "
+                         "mode: print the manifest)")
     ap.add_argument("--baseline", default=None,
                     help=f"baseline path (default {DEFAULT_BASELINE})")
     ap.add_argument("--no-baseline", action="store_true",
@@ -147,9 +235,17 @@ def main(argv: list[str] | None = None) -> int:
                     help="rewrite the baseline to the current findings")
     ap.add_argument("--rules", action="store_true",
                     help="print the rule table and exit")
-    ap.add_argument("--explain", metavar="TPU0NN", default=None,
-                    help="print one rule's docstring + a tp/fp example from "
-                         "the fixture corpus and exit")
+    ap.add_argument("--explain", metavar="TPU0NN[..TPU0MM]", default=None,
+                    help="print rule docstring(s) + tp/fp examples from the "
+                         "fixture corpus and exit (.. = inclusive range)")
+    ap.add_argument("--compile-surface", action="store_true",
+                    dest="compile_surface",
+                    help="enumerate jit/shard_map/pallas_call entry points "
+                         "and diff against tools/compile_surface.json "
+                         "(exit 1 on drift or untagged entry points)")
+    ap.add_argument("--write", action="store_true",
+                    help="with --compile-surface: regenerate the committed "
+                         "manifest in place")
     args = ap.parse_args(argv)
 
     if args.rules:
@@ -159,6 +255,18 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.explain:
         return _explain(args.explain)
+
+    if args.compile_surface:
+        if args.paths or args.check or args.update_baseline:
+            # the manifest is defined over the default package scan only —
+            # a subset manifest would record partial coverage as truth
+            print("--compile-surface takes no paths and conflicts with "
+                  "--check/--update-baseline", file=sys.stderr)
+            return 2
+        return _compile_surface(args.write, args.as_json or args.fmt == "json")
+    if args.write:
+        print("--write requires --compile-surface", file=sys.stderr)
+        return 2
 
     if args.fmt and args.as_json and args.fmt != "json":
         print("--json conflicts with --format " + args.fmt, file=sys.stderr)

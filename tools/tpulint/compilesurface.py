@@ -1,0 +1,559 @@
+"""tpulint pass 1.7: shared compile-surface analysis (TPU018-TPU021 + manifest).
+
+ROADMAP item 5 ("kill the compile stall") needs the compile surface to be an
+ENUMERABLE artifact: first sightings of a plan family pay a full XLA compile on
+the serving path, and the AOT-warming work can only precompile shapes it can
+name. This pass — built once per lint run over project.py's call graph, the
+concurrency.py/spmd.py `analysis()` idiom — computes:
+
+- **entry points** — every `jax.jit` / `shard_map`(/pjit/xmap) / `pallas_call`
+  construction site in the linted set (calls and decorators), each with its
+  immediate owning function.
+- **shape-provenance lattice** — every integer expression classifies as
+  `config` (literal constant), `bucketed` (produced by a recognized bucket
+  ladder: `_pow2_bucket` / `_k_bucket`, or a helper that provably returns one —
+  the batcher's pow-2 Q padding rides these), `unbounded` (request-derived:
+  `len(...)` of live data, or a helper that returns one through the
+  return-calls fixpoint), or `unknown` (bare parameters, attributes — silent,
+  never a finding by itself). `min(x, bounded)` is bounded; `max(x, unbounded)`
+  is unbounded; arithmetic joins upward.
+- **helper fixpoints** — unbounded-length-returning and bucket-returning
+  functions (the TPU001 device-returning idiom), so a raw length computed one
+  module away still classifies at the jit boundary where it lands.
+- **jit factories** — functions that RETURN a jit/pallas executable (directly
+  or via another factory), so `fn = _get_compiled(...)`'s `fn(...)` call sites
+  are recognized as compiled-callable launches (TPU021).
+- **compile_tag family reach** — which `jaxenv.compile_tag("...")` scopes can
+  own each entry point, propagated through the call graph (callees + nested
+  closures, since a factory's escaping wrapper compiles on the tagged caller's
+  thread). Entry points reachable from NO tag scope are the manifest's
+  `families: []` rows — invisible to the PR-13 compile ledger, and exactly what
+  `--compile-surface` exits 1 on.
+- **manifest** — `build_manifest()` renders the machine-readable inventory
+  committed at tools/compile_surface.json (qualname, file:line, bucketed dims +
+  ladder source, static-arg key space, executable-cache key provenance, owning
+  families), cross-checked against the `COMPILE_FAMILIES` vocabulary parsed
+  from common/jaxenv.py's AST. The runtime twin is the conftest
+  `compile_surface_gate` (jaxenv.record_untagged_origins): a tier-1 run must
+  produce zero package-originated untagged compiles.
+
+Like every tpulint pass, resolution is conservative: dynamic constructs stay
+`unknown` and never create findings by themselves.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass
+
+from .engine import REPO, SourceFile, discover_default_paths, parse_file
+from .project import Project, module_name
+
+# provenance lattice: UNKNOWN is silent bottom, joins go upward
+UNKNOWN, CONFIG, BUCKETED, UNBOUNDED = 0, 1, 2, 3
+PROVENANCE_NAMES = {UNKNOWN: "unknown", CONFIG: "config",
+                    BUCKETED: "bucketed", UNBOUNDED: "unbounded"}
+
+# the recognized bucket ladders (ops/device_index._pow2_bucket and
+# ops/scoring._k_bucket feed every executable-cache key in the package)
+BUCKET_LADDERS = frozenset({"_pow2_bucket", "_k_bucket"})
+
+_CTOR_KINDS = {"jit": "jit", "shard_map": "shard_map", "pjit": "shard_map",
+               "xmap": "shard_map", "pallas_call": "pallas_call"}
+
+MANIFEST_PATH = os.path.join(REPO, "tools", "compile_surface.json")
+
+
+def _last_name(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def ctor_kind(call: ast.AST) -> str | None:
+    """jax.jit(...) -> "jit", shard_map/pjit/xmap -> "shard_map",
+    pl.pallas_call(...) -> "pallas_call"; anything else -> None."""
+    if not isinstance(call, ast.Call):
+        return None
+    return _CTOR_KINDS.get(_last_name(call.func))
+
+
+def _src(node: ast.AST, limit: int = 48) -> str:
+    try:
+        s = ast.unparse(node)
+    except Exception:  # noqa: BLE001 — unparse is best-effort display only
+        return "<expr>"
+    return s if len(s) <= limit else s[: limit - 3] + "..."
+
+
+def _join(a: tuple, b: tuple) -> tuple:
+    return a if a[0] >= b[0] else b
+
+
+def classify(node: ast.AST, env: dict, unb_fns: set, bucket_fns: set) -> tuple:
+    """(provenance, why) for an integer-ish expression. `why` is the unbounded
+    source description (for UNBOUNDED) or the ladder name (for BUCKETED)."""
+    if isinstance(node, ast.Constant):
+        return (CONFIG, None)
+    if isinstance(node, ast.Name):
+        return env.get(node.id, (UNKNOWN, None))
+    if isinstance(node, ast.Call):
+        n = _last_name(node.func)
+        if n in BUCKET_LADDERS or n in bucket_fns:
+            return (BUCKETED, n)
+        if n == "len" and isinstance(node.func, ast.Name):
+            return (UNBOUNDED, f"`{_src(node)}`")
+        if n in unb_fns:
+            return (UNBOUNDED, f"`{_src(node)}` (request-length-returning "
+                               "helper)")
+        if isinstance(node.func, ast.Name) and n in ("min", "max") and node.args:
+            provs = [classify(a, env, unb_fns, bucket_fns) for a in node.args]
+            if n == "min":  # min() BOUNDS: the tightest class wins
+                return min(provs, key=lambda p: p[0])
+            out = (UNKNOWN, None)
+            for p in provs:
+                out = _join(out, p)
+            return out
+        return (UNKNOWN, None)
+    if isinstance(node, ast.BinOp):
+        return _join(classify(node.left, env, unb_fns, bucket_fns),
+                     classify(node.right, env, unb_fns, bucket_fns))
+    if isinstance(node, ast.UnaryOp):
+        return classify(node.operand, env, unb_fns, bucket_fns)
+    if isinstance(node, ast.IfExp):
+        return _join(classify(node.body, env, unb_fns, bucket_fns),
+                     classify(node.orelse, env, unb_fns, bucket_fns))
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = (UNKNOWN, None)
+        for el in node.elts:
+            out = _join(out, classify(el, env, unb_fns, bucket_fns))
+        return out
+    return (UNKNOWN, None)
+
+
+class EnvScan(ast.NodeVisitor):
+    """Sequential single-assignment provenance env over ONE function body
+    (the TPU001/TPU014 dataflow idiom). Nested defs are separate scopes with
+    their own FuncInfo — skipped. Rule visitors subclass this and layer their
+    sink checks on top of the shared env."""
+
+    def __init__(self, unb_fns: set, bucket_fns: set):
+        self.env: dict[str, tuple] = {}
+        self.unb_fns = unb_fns
+        self.bucket_fns = bucket_fns
+
+    def classify(self, node: ast.AST) -> tuple:
+        return classify(node, self.env, self.unb_fns, self.bucket_fns)
+
+    def visit_Assign(self, node: ast.Assign):
+        p = self.classify(node.value)
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                self.env[t.id] = p
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None and isinstance(node.target, ast.Name):
+            self.env[node.target.id] = self.classify(node.value)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+class _ReturnScan(EnvScan):
+    """Collect the provenance of every `return <expr>` in one function."""
+
+    def __init__(self, unb_fns, bucket_fns):
+        super().__init__(unb_fns, bucket_fns)
+        self.provs: list[tuple] = []
+
+    def visit_Return(self, node: ast.Return):
+        if node.value is not None:
+            self.provs.append(self.classify(node.value))
+        self.generic_visit(node)
+
+
+class _FactoryScan(ast.NodeVisitor):
+    """Does this function RETURN a jit/pallas executable it constructed?"""
+
+    def __init__(self):
+        self.jit_names: set[str] = set()
+        self.is_factory = False
+
+    def visit_Assign(self, node: ast.Assign):
+        if ctor_kind(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.jit_names.add(t.id)
+        self.generic_visit(node)
+
+    def visit_Return(self, node: ast.Return):
+        v = node.value
+        if ctor_kind(v) or (isinstance(v, ast.Name) and v.id in self.jit_names):
+            self.is_factory = True
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+class _OwnerScan(EnvScan):
+    """Per-owner detail for the manifest: local jit names, executable-cache
+    store keys, and the final provenance env (bucketed dims)."""
+
+    def __init__(self, unb_fns, bucket_fns):
+        super().__init__(unb_fns, bucket_fns)
+        self.jit_names: set[str] = set()
+        self.store_keys: list[ast.AST] = []
+
+    def visit_Assign(self, node: ast.Assign):
+        is_ctor = ctor_kind(node.value) is not None
+        from_jit = isinstance(node.value, ast.Name) \
+            and node.value.id in self.jit_names
+        for t in node.targets:
+            if isinstance(t, ast.Name) and is_ctor:
+                self.jit_names.add(t.id)
+            elif isinstance(t, ast.Subscript) and (is_ctor or from_jit):
+                self.store_keys.append(t.slice)
+        super().visit_Assign(node)
+
+
+@dataclass
+class EntryPoint:
+    """One jit/shard_map/pallas_call construction site."""
+
+    kind: str
+    sf: SourceFile
+    line: int
+    owner: int | None  # fid of the immediately-enclosing function
+    call: ast.Call | None  # None for bare-decorator entries
+
+
+class CompileSurfaceAnalysis:
+    """Per-lint-run compile-surface context — rules and the manifest share it."""
+
+    def __init__(self, files: list[SourceFile], project: Project):
+        self.project = project
+        self.files = files
+        self._owner: dict[int, int] = {}  # id(ast node) -> enclosing fid
+        self.children: dict[int, set[int]] = {}  # fid -> nested-def fids
+        self.entries: list[EntryPoint] = []
+        self.tag_sites: list[tuple] = []  # (owner fid|None, family, sf, line)
+        self.runtime_families: tuple[str, ...] | None = None
+        self.unbounded_returning: set[int] = set()
+        self.bucket_returning: set[int] = set()
+        self.jit_factories: set[int] = set()
+        self.families: dict[int, set[str]] = {}
+        self._owner_scans: dict[int, _OwnerScan] = {}
+
+        for sf in files:
+            self._index_file(sf)
+        self._fix_returns()
+        self._fix_factories()
+        self._propagate_families()
+        owners = {e.owner for e in self.entries if e.owner is not None}
+        # TPU018 scope: functions that construct an executable, plus their
+        # DIRECT callers (the launch wrappers that feed factory boundaries)
+        self.jit_scope = owners | {fi.fid for fi in project.functions
+                                   if fi.calls & owners}
+        self.unknown_tag_sites = [
+            (fam, sf.relpath, line) for (_o, fam, sf, line) in self.tag_sites
+            if self.runtime_families is not None
+            and fam not in self.runtime_families]
+
+    # -- pass: owners, entries, tag scopes, vocabulary -----------------------
+    def _index_file(self, sf: SourceFile) -> None:
+        project = self.project
+
+        def rec(node: ast.AST, owner: int | None):
+            for ch in ast.iter_child_nodes(node):
+                if isinstance(ch, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fi = project.func_at(ch)
+                    if owner is not None:
+                        self._owner[id(ch)] = owner
+                    if fi is not None:
+                        if owner is not None:
+                            self.children.setdefault(owner, set()).add(fi.fid)
+                        rec(ch, fi.fid)
+                    else:
+                        rec(ch, owner)
+                else:
+                    if owner is not None:
+                        self._owner[id(ch)] = owner
+                    rec(ch, owner)
+
+        rec(sf.tree, None)
+
+        for node in ast.walk(sf.tree):
+            kind = ctor_kind(node)
+            if kind is not None:
+                self.entries.append(EntryPoint(
+                    kind=kind, sf=sf, line=node.lineno,
+                    owner=self._owner.get(id(node)), call=node))
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    ce = item.context_expr
+                    if isinstance(ce, ast.Call) \
+                            and _last_name(ce.func) == "compile_tag" \
+                            and ce.args \
+                            and isinstance(ce.args[0], ast.Constant) \
+                            and isinstance(ce.args[0].value, str):
+                        self.tag_sites.append((self._owner.get(id(node)),
+                                               ce.args[0].value, sf,
+                                               node.lineno))
+            elif isinstance(node, ast.Assign) \
+                    and sf.relpath.endswith("common/jaxenv.py") \
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "COMPILE_FAMILIES"
+                            for t in node.targets) \
+                    and isinstance(node.value, ast.Tuple):
+                vals = [el.value for el in node.value.elts
+                        if isinstance(el, ast.Constant)
+                        and isinstance(el.value, str)]
+                if vals:
+                    self.runtime_families = tuple(vals)
+
+        # decorator entries: @jax.jit / @partial(jax.jit, ...) on defs
+        for fi in project.functions:
+            if fi.sf is not sf:
+                continue
+            for deco in fi.node.decorator_list:
+                if _last_name(deco) in _CTOR_KINDS and \
+                        not isinstance(deco, ast.Call):
+                    self.entries.append(EntryPoint(
+                        kind=_CTOR_KINDS[_last_name(deco)], sf=sf,
+                        line=deco.lineno, owner=self._owner.get(id(fi.node)),
+                        call=None))
+                elif isinstance(deco, ast.Call) \
+                        and _last_name(deco.func) == "partial" \
+                        and any(_last_name(a) in _CTOR_KINDS
+                                for a in deco.args):
+                    self.entries.append(EntryPoint(
+                        kind="jit", sf=sf, line=deco.lineno,
+                        owner=self._owner.get(id(fi.node)), call=deco))
+
+    # -- fixpoints ------------------------------------------------------------
+    def _names_for(self, sf: SourceFile, fids: set[int]) -> set[str]:
+        mod = module_name(sf.relpath)
+        out = {fi.name for fi in self.project.functions
+               if fi.fid in fids and fi.module == mod}
+        for alias, target in self.project._imports.get(mod, {}).items():
+            if "." in target:
+                tmod, tname = target.rsplit(".", 1)
+                if any(fid in fids
+                       for fid in self.project._lookup(tmod, tname)):
+                    out.add(alias)
+        return out
+
+    def _fix_returns(self) -> None:
+        """Unbounded-length-returning and bucket-returning helper fixpoints.
+        Iterated because helper knowledge feeds the classifier that derives
+        more helper knowledge (`return staged_len(x) + 1` style chains)."""
+        for _ in range(12):
+            changed = False
+            name_cache: dict[str, tuple[set, set]] = {}
+            for fi in self.project.functions:
+                mod_key = fi.sf.relpath
+                if mod_key not in name_cache:
+                    name_cache[mod_key] = (
+                        self._names_for(fi.sf, self.unbounded_returning),
+                        self._names_for(fi.sf, self.bucket_returning))
+                unb, bkt = name_cache[mod_key]
+                scan = _ReturnScan(unb, bkt)
+                for stmt in fi.node.body:
+                    scan.visit(stmt)
+                if scan.provs:
+                    if any(p[0] == UNBOUNDED for p in scan.provs):
+                        if fi.fid not in self.unbounded_returning:
+                            self.unbounded_returning.add(fi.fid)
+                            changed = True
+                    elif all(p[0] == BUCKETED for p in scan.provs) \
+                            and fi.fid not in self.bucket_returning:
+                        self.bucket_returning.add(fi.fid)
+                        changed = True
+                if fi.return_calls & self.unbounded_returning \
+                        and fi.fid not in self.unbounded_returning:
+                    self.unbounded_returning.add(fi.fid)
+                    changed = True
+                if fi.return_calls \
+                        and fi.return_calls <= self.bucket_returning \
+                        and fi.fid not in self.bucket_returning:
+                    self.bucket_returning.add(fi.fid)
+                    changed = True
+            if not changed:
+                break
+        self.unbounded_returning -= self.bucket_returning
+
+    def _fix_factories(self) -> None:
+        for fi in self.project.functions:
+            scan = _FactoryScan()
+            for stmt in fi.node.body:
+                scan.visit(stmt)
+            if scan.is_factory:
+                self.jit_factories.add(fi.fid)
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.project.functions:
+                if fi.fid in self.jit_factories:
+                    continue
+                if fi.return_calls & self.jit_factories:
+                    self.jit_factories.add(fi.fid)
+                    changed = True
+
+    def _propagate_families(self) -> None:
+        """compile_tag reach, forward through the call graph. Successors are
+        resolved callees PLUS nested defs: a factory's escaping wrapper traces
+        and compiles on the tagged caller's thread (outermost-wins at runtime,
+        union here)."""
+        for owner, fam, _sf, _line in self.tag_sites:
+            if owner is not None:
+                self.families.setdefault(owner, set()).add(fam)
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.project.functions:
+                fams = self.families.get(fi.fid)
+                if not fams:
+                    continue
+                for succ in (fi.calls | self.children.get(fi.fid, set())):
+                    cur = self.families.setdefault(succ, set())
+                    if not fams <= cur:
+                        cur |= fams
+                        changed = True
+
+    # -- per-file name maps (the device_returning_names idiom) ---------------
+    def unbounded_fn_names(self, sf: SourceFile) -> set[str]:
+        return self._names_for(sf, self.unbounded_returning)
+
+    def bucket_fn_names(self, sf: SourceFile) -> set[str]:
+        return self._names_for(sf, self.bucket_returning)
+
+    def factory_name_fids(self, sf: SourceFile) -> dict[str, int]:
+        """name -> fid for jit-factory functions visible in sf."""
+        mod = module_name(sf.relpath)
+        out: dict[str, int] = {}
+        for fi in self.project.functions:
+            if fi.fid in self.jit_factories and fi.module == mod:
+                out[fi.name] = fi.fid
+        for alias, target in self.project._imports.get(mod, {}).items():
+            if "." in target:
+                tmod, tname = target.rsplit(".", 1)
+                for fid in self.project._lookup(tmod, tname):
+                    if fid in self.jit_factories:
+                        out[alias] = fid
+        return out
+
+    # -- manifest detail ------------------------------------------------------
+    def owner_scan(self, fid: int) -> _OwnerScan:
+        scan = self._owner_scans.get(fid)
+        if scan is None:
+            fi = self.project.functions[fid]
+            scan = _OwnerScan(self.unbounded_fn_names(fi.sf),
+                              self.bucket_fn_names(fi.sf))
+            for stmt in fi.node.body:
+                scan.visit(stmt)
+            self._owner_scans[fid] = scan
+        return scan
+
+    def entry_detail(self, e: EntryPoint) -> tuple[list, list | None, list]:
+        """(bucketed_dims, cache_key, static_args) for one manifest row."""
+        static_args = []
+        if e.call is not None:
+            for kw in e.call.keywords:
+                if kw.arg in ("static_argnums", "static_argnames"):
+                    static_args.append(f"{kw.arg}={_src(kw.value)}")
+        if e.owner is None:
+            return [], None, static_args
+        scan = self.owner_scan(e.owner)
+        dims = [{"name": name, "ladder": why or "_pow2_bucket"}
+                for name, (cls, why) in sorted(scan.env.items())
+                if cls == BUCKETED]
+        cache_key = None
+        if scan.store_keys:
+            key = scan.store_keys[0]
+            elts = key.elts if isinstance(key, (ast.Tuple, ast.List)) else [key]
+            cache_key = []
+            for el in elts:
+                cls, _why = classify(el, scan.env, scan.unb_fns,
+                                     scan.bucket_fns)
+                cache_key.append({"expr": _src(el),
+                                  "provenance": PROVENANCE_NAMES[cls]})
+        return dims, cache_key, static_args
+
+
+def analysis(files: list[SourceFile], project: Project) -> CompileSurfaceAnalysis:
+    """Build (or reuse) the CompileSurfaceAnalysis for this lint run."""
+    cached = getattr(project, "_compile_surface", None)
+    if cached is None:
+        cached = CompileSurfaceAnalysis(files, project)
+        project._compile_surface = cached
+    return cached
+
+
+# -- the committed manifest ---------------------------------------------------
+
+
+def build_manifest(files: list[SourceFile] | None = None,
+                   project: Project | None = None) -> dict:
+    """The machine-readable compile-surface inventory for the default package
+    scan (or an explicit file set). Deterministic: entries sort by (file,
+    line), every string derives from source text — two consecutive builds are
+    byte-identical (pinned by tests/test_compile_surface.py)."""
+    if files is None:
+        files = [sf for p in discover_default_paths()
+                 if (sf := parse_file(p)) is not None]
+    if project is None:
+        project = Project(files)
+    sa = analysis(files, project)
+    rows = []
+    for e in sorted(sa.entries, key=lambda e: (e.sf.relpath, e.line, e.kind)):
+        owner_fi = project.functions[e.owner] if e.owner is not None else None
+        mod = module_name(e.sf.relpath)
+        qual = f"{mod}.{owner_fi.qualname}" if owner_fi else f"{mod}.<module>"
+        fams = sorted(sa.families.get(e.owner, set())) \
+            if e.owner is not None else []
+        dims, cache_key, static_args = sa.entry_detail(e)
+        rows.append({
+            "qualname": qual,
+            "kind": e.kind,
+            "file": e.sf.relpath,
+            "line": e.line,
+            "families": fams,
+            "bucketed_dims": dims,
+            "cache_key": cache_key,
+            "static_args": static_args,
+        })
+    return {
+        "comment": "compile-surface manifest — every jit/shard_map/pallas_call "
+                   "entry point, its bucketed dims, cache-key provenance, and "
+                   "owning compile_tag families. Regenerate with `python -m "
+                   "tools.tpulint --compile-surface --write`; CI fails on "
+                   "drift, and the conftest compile_surface_gate is the "
+                   "runtime twin.",
+        "version": 1,
+        "runtime_families": sorted(sa.runtime_families or ()),
+        "families": sorted({f for r in rows for f in r["families"]}),
+        "entry_points": rows,
+    }
+
+
+def canonical_json(manifest: dict) -> str:
+    return json.dumps(manifest, indent=1, sort_keys=True) + "\n"
+
+
+def load_committed(path: str | None = None) -> str | None:
+    try:
+        with open(path or MANIFEST_PATH, encoding="utf-8") as f:
+            return f.read()
+    except OSError:
+        return None

@@ -19,6 +19,10 @@ from . import (
     tpu015_sharding_drift,
     tpu016_host_divergent,
     tpu017_mesh_geometry,
+    tpu018_unbucketed_dims,
+    tpu019_static_args,
+    tpu020_executable_cache,
+    tpu021_weak_type,
 )
 
 ALL_RULES = [
@@ -39,6 +43,10 @@ ALL_RULES = [
     tpu015_sharding_drift,
     tpu016_host_divergent,
     tpu017_mesh_geometry,
+    tpu018_unbucketed_dims,
+    tpu019_static_args,
+    tpu020_executable_cache,
+    tpu021_weak_type,
 ]
 
 RULE_DOCS = {r.RULE_ID: r.DOC for r in ALL_RULES}

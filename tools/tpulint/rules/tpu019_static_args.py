@@ -1,0 +1,153 @@
+"""TPU019 — static_argnums/static_argnames bound to an unbounded host value.
+
+A static argument is part of the jit cache key: each distinct VALUE (not
+shape) traces and compiles a fresh executable. That is the right tool for
+bools, enums, and config constants — a handful of values, a handful of
+executables — and a compile bomb for anything request-derived: marking
+`n_hits` static turns every result count into its own XLA compile, defeating
+the bucket ladders entirely (worse than TPU018, which at least shares
+executables per shape).
+
+This rule finds jit constructions carrying `static_argnums`/`static_argnames`
+(assigned ctors and `@partial(jax.jit, ...)` decorators), maps the static
+positions/names onto each call site in the linted set, and classifies the
+bound expression on the compile-surface provenance lattice
+(tools/tpulint/compilesurface.py). Only `unbounded` bindings are flagged —
+literals, config constants, and bucketed values are the sanctioned uses, and
+`unknown` (bare parameters, attribute reads) stays silent as always.
+
+Fix: bucket the value (`_pow2_bucket`/`_k_bucket`) before binding it, or pass
+it as a traced operand (device scalar via `jax.device_put(np.float32(x))`)
+if the program doesn't need it at trace time.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import compilesurface as cs
+from ..engine import Finding, SourceFile
+
+RULE_ID = "TPU019"
+DOC = ("static jit argument bound to an unbounded host value (each distinct "
+       "value compiles a fresh executable; bool/enum/config statics exempt)")
+
+
+def _int_literals(node: ast.AST) -> list[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [el.value for el in node.elts
+                if isinstance(el, ast.Constant) and isinstance(el.value, int)]
+    return []
+
+
+def _str_literals(node: ast.AST) -> list[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [el.value for el in node.elts
+                if isinstance(el, ast.Constant) and isinstance(el.value, str)]
+    return []
+
+
+def _static_spec(call: ast.Call):
+    """(argnums, argnames) from a jit ctor's keywords, or None if no statics."""
+    nums: list[int] = []
+    names: list[str] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums = _int_literals(kw.value)
+        elif kw.arg == "static_argnames":
+            names = _str_literals(kw.value)
+    return (tuple(nums), tuple(names)) if (nums or names) else None
+
+
+def _collect_specs(sf: SourceFile, project) -> dict:
+    """name -> (argnums, argnames, params|None) for jit-with-statics callables
+    visible in this file: `fn = jax.jit(f, static_argnums=...)` assignments
+    and `@partial(jax.jit, static_argnames=...)`-decorated defs (whose param
+    list lets us map named statics onto positional call-site args)."""
+    specs: dict[str, tuple] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                and cs.ctor_kind(node.value) == "jit":
+            spec = _static_spec(node.value)
+            if spec:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        specs[t.id] = (*spec, None)
+    for fi in project.functions:
+        if fi.sf is not sf:
+            continue
+        for deco in fi.node.decorator_list:
+            if isinstance(deco, ast.Call) and (
+                    cs.ctor_kind(deco) == "jit"
+                    or (cs._last_name(deco.func) == "partial"
+                        and any(cs._last_name(a) == "jit"
+                                for a in deco.args))):
+                spec = _static_spec(deco)
+                if spec:
+                    params = [a.arg for a in fi.node.args.args]
+                    specs[fi.name] = (*spec, params)
+    return specs
+
+
+class _V(cs.EnvScan):
+    def __init__(self, sf: SourceFile, out: list, specs: dict,
+                 unb_fns: set, bucket_fns: set):
+        super().__init__(unb_fns, bucket_fns)
+        self.sf = sf
+        self.out = out
+        self.specs = specs
+
+    def _check(self, node: ast.Call, label: str, expr: ast.AST, fname: str):
+        cls, why = self.classify(expr)
+        if cls == cs.UNBOUNDED:
+            self.out.append(Finding(
+                self.sf.relpath, node.lineno, RULE_ID,
+                f"static argument {label} of `{fname}` bound to unbounded "
+                f"host value {why} — static args key the jit cache by VALUE, "
+                "so each distinct value traces AND compiles a fresh "
+                "executable; bucket it (_pow2_bucket/_k_bucket) or pass it "
+                "as a traced operand (bool/enum/config statics are fine)"))
+
+    def visit_Call(self, node: ast.Call):
+        if isinstance(node.func, ast.Name) and node.func.id in self.specs:
+            nums, names, params = self.specs[node.func.id]
+            for i in nums:
+                if i < len(node.args) \
+                        and not isinstance(node.args[i], ast.Starred):
+                    self._check(node, f"#{i}", node.args[i], node.func.id)
+            for kw in node.keywords:
+                if kw.arg in names:
+                    self._check(node, f"`{kw.arg}`", kw.value, node.func.id)
+            if params:
+                for nm in names:
+                    if nm in params:
+                        i = params.index(nm)
+                        if i < len(node.args) \
+                                and not isinstance(node.args[i], ast.Starred):
+                            self._check(node, f"`{nm}`", node.args[i],
+                                        node.func.id)
+        self.generic_visit(node)
+
+
+def run(files: list[SourceFile], project=None) -> list[Finding]:
+    out: list[Finding] = []
+    if project is None:
+        return out
+    sa = cs.analysis(files, project)
+    for sf in files:
+        specs = _collect_specs(sf, project)
+        if not specs:
+            continue
+        unb_fns = sa.unbounded_fn_names(sf)
+        bucket_fns = sa.bucket_fn_names(sf)
+        for fi in project.functions:
+            if fi.sf is not sf:
+                continue
+            v = _V(sf, out, specs, unb_fns, bucket_fns)
+            for stmt in fi.node.body:
+                v.visit(stmt)
+    return out

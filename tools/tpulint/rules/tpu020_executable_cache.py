@@ -1,0 +1,121 @@
+"""TPU020 — executable constructed per-iteration, or cached under an
+unbounded raw-shape key.
+
+The executable cache is the package's only amortization of XLA compiles: a
+launcher looks its compiled program up by a key of BUCKETED dims and config
+flags, and everything after the first sighting is a dict hit. Two patterns
+silently defeat it:
+
+  a. a `jax.jit` / `shard_map` / `pallas_call` constructed inside a loop —
+     one fresh executable (full trace + compile) per iteration, even when
+     the shapes repeat;
+  b. a cache store (`cache[key] = jit(...)` / `cache.setdefault(key, ...)`)
+     whose key contains an `unbounded` value on the compile-surface
+     provenance lattice (raw `len(request_data)`, or a helper returning one
+     — tools/tpulint/compilesurface.py's cross-module fixpoint). The cache
+     then admits one executable per distinct request shape and never
+     converges — unbounded memory AND unbounded compile bill.
+
+Module-level ctors (the decorator idiom) and bucket-keyed caches are the
+sanctioned patterns and stay silent; `unknown` key elements (parameters,
+`.shape[i]` reads of already-bucketed arrays) are silent as always. This is
+disjoint from TPU002, which flags hot-file jit-then-call-immediately and
+uncached wrapper factories — TPU020 is about caches that EXIST but leak.
+
+Fix: hoist loop ctors; key caches on the bucketed dims
+(`_pow2_bucket`/`_k_bucket`) that actually shape the traced operands.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import compilesurface as cs
+from ..engine import Finding, SourceFile
+
+RULE_ID = "TPU020"
+DOC = ("jit/pallas executable built per-iteration or cached under an "
+       "unbounded raw-shape key (defeats the executable cache; "
+       "module-level and bucket-keyed caches exempt)")
+
+
+class _V(cs.EnvScan):
+    def __init__(self, sf: SourceFile, out: list, unb_fns: set,
+                 bucket_fns: set):
+        super().__init__(unb_fns, bucket_fns)
+        self.sf = sf
+        self.out = out
+        self.jit_names: set[str] = set()
+        self.loop_depth = 0
+
+    def _check_key(self, line: int, key: ast.AST):
+        elts = key.elts if isinstance(key, (ast.Tuple, ast.List)) else [key]
+        for el in elts:
+            cls, why = self.classify(el)
+            if cls == cs.UNBOUNDED:
+                self.out.append(Finding(
+                    self.sf.relpath, line, RULE_ID,
+                    f"executable cached under a request-shaped key ({why} — "
+                    "unbounded value space): the cache admits one compiled "
+                    "program per distinct request shape and never converges; "
+                    "key it on bucketed dims (_pow2_bucket/_k_bucket) "
+                    "instead"))
+                return
+
+    def _loop(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    def visit_For(self, node: ast.For):
+        self._loop(node)
+
+    visit_AsyncFor = visit_For
+
+    def visit_While(self, node: ast.While):
+        self._loop(node)
+
+    def visit_Call(self, node: ast.Call):
+        kind = cs.ctor_kind(node)
+        if kind is not None and self.loop_depth:
+            self.out.append(Finding(
+                self.sf.relpath, node.lineno, RULE_ID,
+                f"{kind}(...) constructed inside a loop — one fresh "
+                "executable (full trace + XLA compile) per iteration even "
+                "when shapes repeat; hoist the construction out of the loop "
+                "or cache it under a bounded bucketed key"))
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "setdefault" and len(node.args) >= 2:
+            val = node.args[1]
+            if cs.ctor_kind(val) or (isinstance(val, ast.Name)
+                                     and val.id in self.jit_names):
+                self._check_key(node.lineno, node.args[0])
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign):
+        is_ctor = cs.ctor_kind(node.value) is not None
+        from_jit = isinstance(node.value, ast.Name) \
+            and node.value.id in self.jit_names
+        for t in node.targets:
+            if isinstance(t, ast.Name) and is_ctor:
+                self.jit_names.add(t.id)
+            elif isinstance(t, ast.Subscript) and (is_ctor or from_jit):
+                self._check_key(t.value.lineno, t.slice)
+        super().visit_Assign(node)
+
+
+def run(files: list[SourceFile], project=None) -> list[Finding]:
+    out: list[Finding] = []
+    if project is None:
+        return out
+    sa = cs.analysis(files, project)
+    for sf in files:
+        unb_fns = sa.unbounded_fn_names(sf)
+        bucket_fns = sa.bucket_fn_names(sf)
+        for fi in project.functions:
+            if fi.sf is not sf:
+                continue
+            v = _V(sf, out, unb_fns, bucket_fns)
+            for stmt in fi.node.body:
+                v.visit(stmt)
+    return out

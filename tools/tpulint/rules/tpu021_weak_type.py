@@ -1,0 +1,174 @@
+"""TPU021 — weak-type/dtype family split at a compiled-callable call site.
+
+JAX types a bare Python scalar operand as WEAK (`float` -> weak f32): a
+compiled callable called once with `fn(x, 0.5)` and once with
+`fn(x, jax.device_put(np.float32(t)))` traces TWO executables for one
+logical program — the weak-typed and the committed-dtype family — doubling
+the compile bill and the executable-cache footprint for that call site. The
+repo's sanctioned device-scalar idiom is `_scalar_f32` /
+`jax.device_put(np.float32(x))` (ROADMAP standing invariants; eager
+`jnp.float32(x)` raises under the hard transfer guard).
+
+Using the compile-surface analysis (tools/tpulint/compilesurface.py), this
+rule identifies compiled callables — names assigned from a
+jit/shard_map/pallas_call ctor, or from a jit FACTORY (a function returning
+an executable, resolved cross-module through the return-calls fixpoint) —
+then groups their call sites by (callable origin, argument position) across
+the whole linted set and flags:
+
+  a. a raw-scalar operand at a position where another call site (possibly in
+     another module, reached via the same factory) passes a committed
+     operand — the cross-site family split;
+  b. an `IfExp` operand mixing a committed array with a raw scalar in a
+     single expression (`x if dev else 0.0`) — the same split at one site.
+
+All-scalar and all-committed groups are consistent and stay silent; operands
+of unknown kind (attributes, arbitrary calls) never contribute.
+
+Fix: route the scalar through `_scalar_f32` / `jax.device_put(np.float32(x))`
+so every site commits the same dtype.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import compilesurface as cs
+from ..engine import Finding, SourceFile
+
+RULE_ID = "TPU021"
+DOC = ("weak-type/dtype family split: compiled callable reached with both a "
+       "raw Python scalar and a committed (device_put) operand — two "
+       "executables for one program")
+
+# operand committers: dtype-committing constructors and the repo's device-
+# scalar idiom
+_COMMIT = {"device_put", "asarray", "array", "float32", "float64", "int32",
+           "int64", "int8", "uint8", "bfloat16", "float16", "_scalar_f32"}
+
+
+def _operand_kind(node: ast.AST, kind_env: dict) -> str | None:
+    """"scalar" | "committed" | "mixed" | None (unknown)."""
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, bool) or not isinstance(node.value,
+                                                          (int, float)):
+            return None
+        return "scalar"
+    if isinstance(node, ast.UnaryOp):
+        return _operand_kind(node.operand, kind_env)
+    if isinstance(node, ast.Name):
+        return kind_env.get(node.id)
+    if isinstance(node, ast.Call):
+        n = cs._last_name(node.func)
+        if n in _COMMIT:
+            return "committed"
+        if n in ("float", "int") and isinstance(node.func, ast.Name):
+            return "scalar"
+        return None
+    if isinstance(node, ast.IfExp):
+        a = _operand_kind(node.body, kind_env)
+        b = _operand_kind(node.orelse, kind_env)
+        if {a, b} == {"scalar", "committed"}:
+            return "mixed"
+        return a if a == b else None
+    return None
+
+
+class _V(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile, out: list, factory_fids: dict,
+                 fi_key, sites: dict):
+        self.sf = sf
+        self.out = out
+        self.factory_fids = factory_fids  # visible factory name -> fid
+        self.fi_key = fi_key  # disambiguates local ctor origins
+        self.sites = sites  # (origin, argpos) -> list of site dicts
+        self.compiled: dict[str, tuple] = {}  # local name -> origin key
+        self.kind_env: dict[str, str] = {}
+
+    def visit_Assign(self, node: ast.Assign):
+        origin = None
+        if cs.ctor_kind(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    origin = ("local", self.fi_key, t.id)
+        elif isinstance(node.value, ast.Call) \
+                and isinstance(node.value.func, ast.Name) \
+                and node.value.func.id in self.factory_fids:
+            origin = ("factory", self.factory_fids[node.value.func.id])
+        if origin is not None:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.compiled[t.id] = origin
+        else:
+            k = _operand_kind(node.value, self.kind_env)
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    if k is not None:
+                        self.kind_env[t.id] = k
+                    else:
+                        self.kind_env.pop(t.id, None)
+                    self.compiled.pop(t.id, None)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in self.compiled:
+            origin = self.compiled[node.func.id]
+            for i, arg in enumerate(node.args):
+                if isinstance(arg, ast.Starred):
+                    break  # positions after a splat are unknowable
+                kind = _operand_kind(arg, self.kind_env)
+                if kind == "mixed":
+                    self.out.append(Finding(
+                        self.sf.relpath, node.lineno, RULE_ID,
+                        f"operand #{i} of compiled callable "
+                        f"`{node.func.id}` mixes a committed array with a "
+                        "raw Python scalar across branches — the two "
+                        "branches trace different (weak-type) executables "
+                        "at one call site; commit both via "
+                        "jax.device_put(np.float32(...)) (`_scalar_f32`)"))
+                elif kind in ("scalar", "committed"):
+                    self.sites.setdefault((origin, i), []).append({
+                        "kind": kind, "sf": self.sf, "line": node.lineno,
+                        "name": node.func.id, "pos": i,
+                        "expr": cs._src(arg, 32)})
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def run(files: list[SourceFile], project=None) -> list[Finding]:
+    out: list[Finding] = []
+    if project is None:
+        return out
+    sa = cs.analysis(files, project)
+    sites: dict = {}
+    for sf in files:
+        factory_fids = sa.factory_name_fids(sf)
+        for fi in project.functions:
+            if fi.sf is not sf:
+                continue
+            v = _V(sf, out, factory_fids, fi.fid, sites)
+            for stmt in fi.node.body:
+                v.visit(stmt)
+    for (_origin, _pos), group in sites.items():
+        kinds = {s["kind"] for s in group}
+        if kinds != {"scalar", "committed"}:
+            continue
+        committed = next(s for s in group if s["kind"] == "committed")
+        for s in group:
+            if s["kind"] != "scalar":
+                continue
+            out.append(Finding(
+                s["sf"].relpath, s["line"], RULE_ID,
+                f"raw Python scalar `{s['expr']}` as operand #{s['pos']} of "
+                f"compiled callable `{s['name']}` traces a WEAK-typed "
+                "executable, but the same callable takes a committed "
+                "(device_put) operand at "
+                f"{committed['sf'].relpath}:{committed['line']} — one "
+                "program, two executables; route the scalar through "
+                "jax.device_put(np.float32(...)) (`_scalar_f32`)"))
+    return out
