@@ -568,7 +568,8 @@ def _serving_queries(rng, n=64):
     return out
 
 
-def _run_serving_pass(client, queries, threads, seconds, rng, picker=None):
+def _run_serving_pass(client, queries, threads, seconds, rng, picker=None,
+                      index="bench_serving"):
     """Closed-loop load: each thread issues searches back-to-back for
     `seconds`; returns (qps, p50_ms, p99_ms). `picker(rng)` overrides the
     uniform query choice (the cache hot-set slice draws zipfian)."""
@@ -587,7 +588,7 @@ def _run_serving_pass(client, queries, threads, seconds, rng, picker=None):
             q = picker(r) if picker is not None else \
                 queries[int(r.integers(len(queries)))]
             t0 = time.perf_counter()
-            client.search("bench_serving", q)
+            client.search(index, q)
             local.append(time.perf_counter() - t0)
         with lock:
             latencies.extend(local)
@@ -1013,6 +1014,153 @@ def writes_main():
     sys.stdout.flush()
 
 
+# ---------------------------------------------------------------------------
+# chaos mode: seeded device faults → degraded serving → probed recovery
+# ---------------------------------------------------------------------------
+
+CHAOS_THREADS = int(os.environ.get("BENCH_CHAOS_THREADS", 8))
+CHAOS_SECONDS = float(os.environ.get("BENCH_CHAOS_SECONDS", 3.0))
+CHAOS_DOCS = int(os.environ.get("BENCH_CHAOS_DOCS", 8000))
+
+
+def run_chaos(threads=CHAOS_THREADS, seconds=CHAOS_SECONDS, n_docs=CHAOS_DOCS):
+    """The device-chaos serving slice (common/devicehealth): healthy QPS,
+    then QPS while a seeded PERSISTENT device fault holds the index's pull
+    domain open — every response must stay 200 with bitwise-identical hits
+    (host scorer) — then the time from fault clear to the probe closing the
+    circuit. `vs_baseline` is a CONTINUITY ratio (degraded vs healthy QPS),
+    not a perf bar: the claim is that a broken device degrades throughput,
+    never availability."""
+    import tempfile
+
+    import jax
+
+    from elasticsearch_tpu.common.devicehealth import DEVICE_HEALTH
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.search.service import SERVING_COUNTERS
+    from elasticsearch_tpu.transport.faults import DEVICE_FAULTS
+
+    tmp = tempfile.mkdtemp(prefix="bench_chaos_")
+    settings = Settings.from_flat({
+        "path.data": tmp,
+        "threadpool.search.size": str(max(threads, 8)),
+        "search.batch.linger_ms": os.environ.get("BENCH_LINGER_MS", "1.5"),
+        "search.batch.max_batch": "64",
+    })
+    node = Node(name="bench_chaos", settings=settings)
+    node.start()
+    DEVICE_HEALTH.reset()
+    try:
+        client = node.client()
+        client.create_index("bench_chaos", {"settings": {
+            "number_of_shards": 1, "number_of_replicas": 0}})
+        rng = np.random.default_rng(5)
+        raw = rng.zipf(1.3, size=(n_docs, 8)).astype(np.int64)
+        terms = (raw - 1) % SERVING_VOCAB
+        bulk = []
+        for i in range(n_docs):
+            bulk.append({"action": {"index": {
+                "_index": "bench_chaos", "_type": "doc", "_id": str(i)}},
+                "source": {"body": " ".join(f"w{int(t)}" for t in terms[i])}})
+            if len(bulk) >= 500:
+                client.bulk(bulk)
+                bulk = []
+        if bulk:
+            client.bulk(bulk)
+        client.refresh("bench_chaos")
+        queries = _serving_queries(rng)
+        for q in queries[:16]:
+            client.search("bench_chaos", q)
+        _run_serving_pass(client, queries, threads, 1.0, rng,
+                          index="bench_chaos")  # warm coalesced
+        # fixed-query hit snapshot for the bitwise-identity check
+        probe_q = queries[0]
+        healthy_hits = client.search("bench_chaos", probe_q)["hits"]["hits"]
+        qps_h, p50_h, p99_h = _run_serving_pass(client, queries, threads,
+                                                seconds, rng,
+                                                index="bench_chaos")
+        # hold the pull domain open for the whole degraded pass: a transfer
+        # fault classifies persistent, so the FIRST failure trips the circuit
+        # and every later search (bar admitted probes, which re-fail) serves
+        # via the host path
+        deg0 = SERVING_COUNTERS["degraded"]
+        DEVICE_FAULTS.arm(error="transfer", domain="pull:bench_chaos",
+                          times=1_000_000)
+        qps_d, p50_d, p99_d = _run_serving_pass(client, queries, threads,
+                                                seconds, rng,
+                                                index="bench_chaos")
+        degraded_hits = client.search("bench_chaos", probe_q)["hits"]["hits"]
+        deg_served = SERVING_COUNTERS["degraded"] - deg0
+        # clear the fault; each search past the backoff window IS the probe —
+        # serve until the circuit closes and time it
+        DEVICE_FAULTS.disarm()
+        t0 = time.perf_counter()
+        recovered = False
+        while time.perf_counter() - t0 < 30.0:
+            client.search("bench_chaos",
+                          queries[int(rng.integers(len(queries)))])
+            if DEVICE_HEALTH.state("pull:bench_chaos") == "closed":
+                recovered = True
+                break
+            time.sleep(0.02)
+        recovery_s = time.perf_counter() - t0
+        dh = DEVICE_HEALTH.stats()
+        platform = jax.devices()[0].platform
+        return {
+            "metric": f"degraded-serving QPS under a persistent device fault "
+                      f"({threads} threads, {platform})",
+            "value": round(qps_d, 1),
+            "unit": "queries/sec",
+            "vs_baseline": round(qps_d / qps_h, 2) if qps_h else 0.0,
+            "healthy_qps": round(qps_h, 1),
+            "healthy_p50_ms": round(p50_h, 2),
+            "healthy_p99_ms": round(p99_h, 2),
+            "degraded_p50_ms": round(p50_d, 2),
+            "degraded_p99_ms": round(p99_d, 2),
+            # the availability invariant: same hits either way, and the
+            # degraded pass actually exercised the host path
+            "hits_identical": bool(healthy_hits == degraded_hits),
+            "degraded_served": int(deg_served),
+            "trips": dh["trips"],
+            "probes": dh["probes"],
+            "recoveries": dh["recoveries"],
+            "failures": dh["failures"],
+            "recovered": bool(recovered),
+            "recovery_s": round(recovery_s, 3),
+            "platform": platform,
+        }
+    finally:
+        DEVICE_FAULTS.disarm()
+        DEVICE_HEALTH.reset()
+        node.close()
+
+
+def chaos_main():
+    """BENCH_MODE=chaos entry: one stdout JSON line, persisted to
+    BENCH_CHAOS.json, with a `# chaos:` stderr tail for the log scan."""
+    platform = BackendProbe().wait()
+    if platform.startswith("cpu"):
+        from elasticsearch_tpu.common.jaxenv import force_cpu_platform
+
+        force_cpu_platform()
+    result = run_chaos()
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_CHAOS.json"), "w") as f:
+            json.dump(result, f, indent=1)
+    except Exception as e:  # noqa: BLE001 — persistence is best-effort
+        print(f"# chaos row persist failed: {e}", file=sys.stderr)
+    print(f"# chaos: degraded {result['value']} qps vs healthy "
+          f"{result['healthy_qps']} ({result['vs_baseline']}x), "
+          f"hits_identical={result['hits_identical']}, "
+          f"recovered={result['recovered']} in {result['recovery_s']}s "
+          f"(trips {result['trips']}, probes {result['probes']})",
+          file=sys.stderr)
+    print(json.dumps(result))
+    sys.stdout.flush()
+
+
 def main():
     global N_DOCS, VOCAB, BATCH, N_BATCHES
     if os.environ.get("BENCH_MODE") == "serving":
@@ -1020,6 +1168,9 @@ def main():
         return
     if os.environ.get("BENCH_MODE") == "writes":
         writes_main()
+        return
+    if os.environ.get("BENCH_MODE") == "chaos":
+        chaos_main()
         return
     t_start = time.time()
     probe = BackendProbe()

@@ -2114,6 +2114,7 @@ class ActionModule:
                         context_id=r.get("ctx_id"),
                         shard_id=candidate.shard_id,
                         timed_out=bool(r.get("timed_out")),
+                        degraded=bool(r.get("degraded")),
                         profile=prof,
                     )
                     result.index_name = candidate.index  # type: ignore[attr-defined]
@@ -2315,7 +2316,11 @@ class ActionModule:
         # never a timed-out partial (an honest partial is not THE answer),
         # and never re-store what a profiled run already found present
         if cache_key is not None and not result.timed_out and not peek_hit:
-            data = _encode_cached_partial(partial)
+            # the stored bytes drop the degraded flag: it describes HOW this
+            # execution was served (host path while a device domain was open),
+            # not the data — the partial itself is bitwise-identical, and a
+            # later cache hit is served from memory, degraded by nothing
+            data = _encode_cached_partial({**partial, "degraded": False})
             # `body` registers the fingerprint in the shard's hot-key memory
             # (hit counts drive the warmer's post-refresh top-N replay)
             if data is not None and rcache.put(cache_key, data, body=body) \
@@ -2763,6 +2768,7 @@ def _shard_partial_dict(result) -> dict:
         "facet_partials": _encode_partials(result.facet_partials),
         "suggest": result.suggest,
         "timed_out": result.timed_out,
+        "degraded": result.degraded,
     }
 
 

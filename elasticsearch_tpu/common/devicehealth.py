@@ -1,0 +1,311 @@
+"""Device fault domains: classify-and-contain accelerator failures.
+
+Every resilience layer so far (deadlines/retries, breakers, hedging, the stall
+watchdog) defends against *host-side* failure; this module makes the device
+itself just another failure domain, exactly like the reference treats a shard
+copy (per-copy `_shards.failures`, failover chains). Four domains cover the
+serving stack's device touchpoints:
+
+- ``pack:<index>``    — segment packing (ops/device_index pack/compact/remask)
+- ``compile:<family>``— a compile family's launch (sparse/dense/mesh/...)
+- ``mesh:<index>``    — the SPMD mesh executor for one index
+- ``pull:<index>``    — the batched device_get that lands results on the host
+
+Each domain carries a circuit: closed → open (after classified failures) →
+half-open (one probe admitted per decorrelated-jitter backoff window, schedule
+from common/retry.RetryPolicy.next_backoff) → closed again on a clean probe.
+An OPEN domain never 500s a search: the serving path degrades to the
+bitwise-identical host scorer / composed path and marks the shard result
+``degraded`` so `_shards` stays honest.
+
+Classification (`classify_device_error`): jax/XLA runtime errors split into
+``transient`` (RESOURCE_EXHAUSTED / OOM, DEADLINE_EXCEEDED, UNAVAILABLE —
+pressure that drains) vs ``persistent`` (INTERNAL launch failures, transfer
+errors, FAILED_PRECONDITION, poisoned executables — broken until re-built).
+A persistent error trips its domain immediately; transients need
+``TRANSIENT_STRIKES`` consecutive hits. Non-device exceptions classify to
+``None`` and never move a circuit — a host-side bug must not quarantine the
+accelerator.
+
+Hot-path contract (the standing telemetry rule): when every domain is closed a
+health check is ONE plain attribute read (`any_open`), no lock, no clock.
+Locks and monotonic reads happen only in degraded states; `_lock` is a leaf
+(journal publishes happen outside it) so locktrace stays clean.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+
+from .retry import RetryPolicy
+
+logger = logging.getLogger("elasticsearch_tpu.devicehealth")
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+# XLA status prefixes (jaxlib surfaces them verbatim in the message:
+# "RESOURCE_EXHAUSTED: Out of memory while trying to allocate ...").
+_TRANSIENT_STATUSES = (
+    "RESOURCE_EXHAUSTED", "DEADLINE_EXCEEDED", "UNAVAILABLE", "ABORTED",
+    "CANCELLED",
+)
+_TRANSIENT_PHRASES = ("OUT OF MEMORY", "RESOURCE EXHAUSTED", "OOM")
+
+
+def _is_device_error(error: BaseException) -> bool:
+    """Duck-typed XlaRuntimeError/JaxRuntimeError detection — jaxlib moves the
+    class between releases and this module must stay importable before jax."""
+    for t in type(error).__mro__:
+        if t.__name__ in ("XlaRuntimeError", "JaxRuntimeError"):
+            return True
+        if getattr(t, "__module__", "").split(".")[0] in ("jaxlib", "jax"):
+            return True
+    return False
+
+
+def classify_device_error(error: BaseException) -> str | None:
+    """"transient" | "persistent" for device/XLA failures, None otherwise.
+
+    Transient: the same launch plausibly succeeds once pressure drains (OOM /
+    resource-exhausted, timeout, device temporarily unavailable). Persistent:
+    launch/transfer errors and poisoned executables (INTERNAL,
+    FAILED_PRECONDITION, INVALID_ARGUMENT, ...) — retrying without a rebuild
+    just burns the budget."""
+    if not isinstance(error, BaseException) or not _is_device_error(error):
+        return None
+    up = str(error).upper()
+    head = up.split(":", 1)[0].strip()
+    if head in _TRANSIENT_STATUSES:
+        return "transient"
+    if any(s in up for s in _TRANSIENT_STATUSES) or \
+            any(p in up for p in _TRANSIENT_PHRASES):
+        return "transient"
+    return "persistent"
+
+
+def tag_domain(error: BaseException, domain: str) -> BaseException:
+    """Stamp `error` with the fault domain of the seam that raised/observed
+    it. First (narrowest) tag wins — an exception crossing several wrappers
+    keeps the most specific attribution. Returns `error` so call sites can
+    `raise tag_domain(e, ...)` without losing the traceback."""
+    if getattr(error, "_estpu_device_domain", None) is None:
+        try:
+            error._estpu_device_domain = domain
+        except Exception:  # noqa: BLE001 — __slots__-ed exotic exceptions
+            pass
+    return error
+
+
+class _DomainCircuit:
+    """One fault domain's breaker state. Mutated only under DeviceHealth._lock."""
+
+    __slots__ = ("domain", "state", "strikes", "failures", "trips", "probes",
+                 "recoveries", "backoff_s", "probe_at", "last_error")
+
+    def __init__(self, domain: str):
+        self.domain = domain
+        self.state = CLOSED
+        self.strikes = 0        # consecutive classified failures while closed
+        self.failures = 0
+        self.trips = 0
+        self.probes = 0
+        self.recoveries = 0
+        self.backoff_s = 0.0
+        self.probe_at = 0.0     # monotonic time the next probe is admitted
+        self.last_error = None
+
+
+class DeviceHealth:
+    """Per-fault-domain circuit tracker with probed recovery.
+
+    `any_open` is THE hot-path read: a plain bool, True iff at least one
+    domain is not closed. `dirty` (also a plain bool) is True once any domain
+    ever recorded a failure, so the success hook costs one attr read on a
+    never-failed process. Everything else — probe scheduling, trip/recover
+    transitions, stats — takes the leaf `_lock`, and journal publishers run
+    OUTSIDE it (journal locks are their own leaves)."""
+
+    TRANSIENT_STRIKES = 3   # consecutive transients to trip a closed domain
+
+    def __init__(self, base_s: float = 0.05, cap_s: float = 5.0,
+                 rng: random.Random | None = None, clock=time.monotonic):
+        self.any_open = False   # the one hot-path read
+        self.dirty = False      # any failure ever recorded (success fast path)
+        self._lock = threading.Lock()
+        self._domains: dict[str, _DomainCircuit] = {}
+        self._policy = RetryPolicy(base_s=base_s, cap_s=cap_s, rng=rng)
+        self._clock = clock
+        self._publishers: dict[object, object] = {}
+        self._failures = {"transient": 0, "persistent": 0}
+        self._trips = 0
+        self._probes = 0
+        self._recoveries = 0
+
+    # --- gate + probe admission (degraded states only) ----------------------
+    def blocked(self, domains) -> str | None:
+        """First domain that is open (probe window not yet due) — the caller
+        degrades to the host path naming it — or None: every listed domain is
+        closed, or due for a probe THIS caller was just admitted as. Call only
+        after reading `any_open` (the closed-world fast path is the caller's
+        one attr read)."""
+        if not self.any_open:
+            return None
+        now = None
+        with self._lock:
+            for d in domains:
+                c = self._domains.get(d)
+                if c is None or c.state == CLOSED:
+                    continue
+                if now is None:
+                    now = self._clock()
+                if now >= c.probe_at:
+                    # admit ONE probe: concurrent callers keep degrading until
+                    # it reports (note_success closes / record_failure
+                    # re-opens); a probe that never reports — lost thread —
+                    # re-arms at the next backoff window rather than wedging
+                    # the domain half-open forever
+                    c.state = HALF_OPEN
+                    c.probes += 1
+                    self._probes += 1
+                    c.probe_at = now + max(c.backoff_s, self._policy.base_s)
+                    continue
+                return d
+        return None
+
+    # --- outcome recording --------------------------------------------------
+    def record_failure(self, domain: str, error: BaseException) -> str | None:
+        """Classify `error` and advance `domain`'s circuit. Returns the
+        classification ("transient"/"persistent") or None when the error is
+        not a device failure (circuit untouched)."""
+        cls = classify_device_error(error)
+        if cls is None:
+            return None
+        events = []
+        with self._lock:
+            self.dirty = True
+            c = self._domains.get(domain)
+            if c is None:
+                c = self._domains[domain] = _DomainCircuit(domain)
+            self._failures[cls] += 1
+            c.failures += 1
+            c.last_error = f"{type(error).__name__}: {error}"[:240]
+            if c.state == HALF_OPEN:
+                # failed probe: back to open with a grown jitter window
+                c.state = OPEN
+                c.backoff_s = self._policy.next_backoff(c.backoff_s)
+                c.probe_at = self._clock() + c.backoff_s
+            elif c.state == CLOSED:
+                # a persistent error spends the whole strike budget at once
+                c.strikes += 1 if cls == "transient" else self.TRANSIENT_STRIKES
+                if c.strikes >= self.TRANSIENT_STRIKES:
+                    c.state = OPEN
+                    c.trips += 1
+                    self._trips += 1
+                    c.backoff_s = self._policy.next_backoff(None)
+                    c.probe_at = self._clock() + c.backoff_s
+                    self.any_open = True
+                    events.append((
+                        "device_degraded", domain, "warn",
+                        f"device domain [{domain}] tripped ({cls}): "
+                        f"{c.last_error} — serving degrades to the host path",
+                        {"domain": domain, "classification": cls,
+                         "failures": c.failures}))
+            # already OPEN: count it; the probe scheduler owns transitions
+        for ev in events:
+            self._publish(*ev)
+        return cls
+
+    def note_success(self, domains) -> None:
+        """Clean device outcome for `domains`: resets closed-domain strikes and
+        closes a half-open domain (the probe reported back healthy). One attr
+        read when no failure was ever recorded."""
+        if not self.dirty:
+            return
+        events = []
+        with self._lock:
+            for d in domains:
+                c = self._domains.get(d)
+                if c is None:
+                    continue
+                if c.state == CLOSED:
+                    c.strikes = 0
+                elif c.state == HALF_OPEN:
+                    c.state = CLOSED
+                    c.strikes = 0
+                    c.backoff_s = 0.0
+                    c.recoveries += 1
+                    self._recoveries += 1
+                    events.append((
+                        "device_recovered", d, "info",
+                        f"device domain [{d}] probe succeeded — device path "
+                        f"restored", {"domain": d, "probes": c.probes}))
+                # OPEN + success = a straggler launched before the trip; the
+                # half-open probe protocol owns closing, not stragglers
+            if events:
+                self.any_open = any(c.state != CLOSED
+                                    for c in self._domains.values())
+        for ev in events:
+            self._publish(*ev)
+
+    # --- event publishing (outside the leaf lock) ---------------------------
+    def register_publisher(self, key, publish) -> None:
+        """`publish(type_, message, severity=..., key=..., **attrs)` — the
+        EventJournal.publish signature; a node registers its journal so
+        trip/recover transitions land next to watchdog events."""
+        with self._lock:
+            self._publishers[key] = publish
+
+    def unregister_publisher(self, key) -> None:
+        with self._lock:
+            self._publishers.pop(key, None)
+
+    def _publish(self, type_, domain, severity, message, attrs) -> None:
+        log = logger.warning if severity == "warn" else logger.info
+        log("%s: %s", type_, message)
+        for publish in list(self._publishers.values()):
+            try:
+                publish(type_, message, severity=severity, key=domain, **attrs)
+            except Exception:  # noqa: BLE001 — telemetry must not fail serving
+                logger.exception("device-health event publish failed")
+
+    # --- introspection ------------------------------------------------------
+    def state(self, domain: str) -> str:
+        with self._lock:
+            c = self._domains.get(domain)
+            return CLOSED if c is None else c.state
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "any_open": self.any_open,
+                "failures": dict(self._failures),
+                "trips": self._trips,
+                "probes": self._probes,
+                "recoveries": self._recoveries,
+                "domains": {
+                    d: {"state": c.state, "failures": c.failures,
+                        "trips": c.trips, "probes": c.probes,
+                        "recoveries": c.recoveries,
+                        "backoff_ms": round(c.backoff_s * 1000.0, 1),
+                        "last_error": c.last_error}
+                    for d, c in sorted(self._domains.items())
+                },
+            }
+
+    def reset(self) -> None:
+        """Forget every domain and counter (test isolation; publishers stay)."""
+        with self._lock:
+            self._domains.clear()
+            self._failures = {"transient": 0, "persistent": 0}
+            self._trips = self._probes = self._recoveries = 0
+            self.any_open = False
+            self.dirty = False
+
+
+# Process-wide singleton, like SERVING_COUNTERS / DEVICE_PULL: the serving path
+# (search/service.py module functions, execute.py) has no node handle, and the
+# device being probed is per-process anyway.
+DEVICE_HEALTH = DeviceHealth()

@@ -31,6 +31,7 @@ that record:
 
 Event type vocabulary (bounded — it is a Prometheus label):
   batch_stall | queue_spike | breaker_pressure | lock_stall | watchdog
+  | device_degraded | device_recovered
 
 Hot-path contract: the watchdog runs ON the management pool and reads
 serving-side state as plain attributes or through existing leaf-locked
@@ -46,7 +47,10 @@ import time
 from collections import deque
 
 EVENT_TYPES = ("batch_stall", "queue_spike", "breaker_pressure",
-               "lock_stall", "watchdog")
+               "lock_stall", "watchdog",
+               # device fault-domain circuit transitions (common/devicehealth):
+               # a domain tripping open / a probe closing it again
+               "device_degraded", "device_recovered")
 
 
 class EventJournal:

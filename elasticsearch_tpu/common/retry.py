@@ -52,6 +52,16 @@ def is_transient(error: BaseException) -> bool:
         return False
     if isinstance(error, ClusterBlockError):
         return error.status == 503  # retryable blocks only (no master / recovering)
+    # jax/XLA exceptions carry their own taxonomy (common/devicehealth):
+    # RESOURCE_EXHAUSTED / timeout drains with pressure and is worth a backed-off
+    # retry; an INTERNAL launch / transfer error is deterministic until the
+    # executable or view is rebuilt — retrying it identically to a network drop
+    # just burns the deadline. Lazy import: devicehealth imports RetryPolicy.
+    from .devicehealth import classify_device_error
+
+    device_cls = classify_device_error(error)
+    if device_cls is not None:
+        return device_cls == "transient"
     return isinstance(error, _TRANSIENT)
 
 

@@ -122,6 +122,12 @@ class Node:
         self.insights = QueryShapeInsights(self.settings)
         self.events = EventJournal(self.settings, node_name=self.name,
                                    node_id=self.node_id)
+        # device fault-domain circuit tracker (common/devicehealth singleton):
+        # register this node's journal so trip/recover transitions
+        # (device_degraded / device_recovered) land next to watchdog events
+        from .common.devicehealth import DEVICE_HEALTH
+
+        DEVICE_HEALTH.register_publisher(self.node_id, self.events.publish)
         # install the process compile listener NOW so the capacity ledger's
         # per-family attribution covers this node's first searches (counts
         # start at install — jaxenv._CompileCounter)
@@ -276,6 +282,9 @@ class Node:
             return
         self._closed = True
         self.plugins.on_node_closed(self)
+        from .common.devicehealth import DEVICE_HEALTH
+
+        DEVICE_HEALTH.unregister_publisher(self.node_id)
         self.watchdog.stop()
         self.rivers.stop()
         self.tribe.stop()
@@ -1035,6 +1044,7 @@ class Client:
             if ms is not None:
                 serving["mesh_spmd"] = ms.mesh_queries
                 serving["mesh_fallbacks"] = ms.mesh_fallbacks
+                serving["mesh_rebuilds"] = ms.mesh_rebuilds
             return serving
 
         # section -> thunk: a narrow `/_nodes/stats/{metric}` request only
@@ -1105,6 +1115,7 @@ class Client:
     def _device_section(self):
         """The `/_nodes/stats` `device` section: the capacity ledger walk
         over this node's live shard searchers + the process compile rollup."""
+        from .common.devicehealth import DEVICE_HEALTH
         from .common.jaxenv import (compile_events_by_family,
                                     compile_events_total)
         from .ops.device_index import capacity_report
@@ -1112,6 +1123,9 @@ class Client:
         out = capacity_report(self.node.indices)
         out["compile"] = {"total": compile_events_total(),
                           "by_family": compile_events_by_family()}
+        # per-fault-domain circuit states (common/devicehealth): the
+        # operator's answer to "is any serving path degraded to host scoring"
+        out["health"] = DEVICE_HEALTH.stats()
         return out
 
     def _resolve_node_ids(self, node_id):

@@ -44,8 +44,10 @@ import numpy as np
 
 from ..common import profile as _profile
 from ..common.breaker import reserve
+from ..common.devicehealth import tag_domain as _tag_domain
 from ..common.errors import CircuitBreakingError
 from ..index.segment import FrozenSegment
+from ..transport.faults import DEVICE_FAULTS as _DEVICE_FAULTS
 
 BLOCK = 128  # lane width
 
@@ -1285,6 +1287,12 @@ def _perform_pack(seg: FrozenSegment, fut, breaker,
     cache = seg._device_cache
     prof = _profile.current()
     try:
+        # seeded device-error seam (transport/faults.DEVICE_FAULTS): one
+        # plain attr read disarmed; armed, the pack fails HERE — before any
+        # publish — so the existing exception path below proves no
+        # half-packed PackedSegment ever lands in the cache
+        if _DEVICE_FAULTS.active:
+            _DEVICE_FAULTS.check(f"pack:{owner}")
         packed: PackedSegment | None = cache.get("packed")
         if packed is None:
             hint = cache.get("pack_hint") or {}
@@ -1349,6 +1357,8 @@ def _perform_pack(seg: FrozenSegment, fut, breaker,
         fut.set_result(packed)
         return packed
     except BaseException as e:  # noqa: BLE001 — waiters must never hang
+        if isinstance(e, Exception):
+            _tag_domain(e, f"pack:{owner}")  # fault-domain attribution
         with _PACK_LOCK:
             if cache.get("pack_future") is fut:
                 cache.pop("pack_future", None)

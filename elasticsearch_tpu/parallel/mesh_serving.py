@@ -25,6 +25,8 @@ import numpy as np
 from ..common import profile as _profile
 from ..common import tracing
 from ..common.breaker import reserve as breaker_reserve
+from ..common.devicehealth import (DEVICE_HEALTH, classify_device_error,
+                                   tag_domain)
 from ..common.errors import CircuitBreakingError
 from ..common.logging import get_logger
 from ..search.execute import lower_flat
@@ -32,6 +34,7 @@ from ..search.filters import segment_mask
 from ..search.queries import FilteredQuery
 from ..search.service import ParsedSearchRequest, ShardQueryResult
 from ..search.similarity import BM25Similarity, TFIDFSimilarity
+from ..transport.faults import DEVICE_FAULTS
 from .mesh_search import MeshSearchExecutor, build_sharded_index
 
 
@@ -51,6 +54,7 @@ class MeshServingService:
         self.batcher = None
         self.mesh_queries = 0  # served via the SPMD program (stats/test hook)
         self.mesh_fallbacks = 0  # eligible-looking but fell back mid-flight
+        self.mesh_rebuilds = 0  # executors rebuilt after a device launch fault
         self._lock = threading.Lock()
         self._meshes: dict[int, object] = {}
         self._executors: dict = {}  # index -> (freshness_key, executor dict)
@@ -119,6 +123,15 @@ class MeshServingService:
         if eligible is None:
             return None
         index, n_total = eligible
+        # device fault-domain gate (common/devicehealth): an OPEN mesh:<index>
+        # domain — launch failures that survived the one-rebuild heal — routes
+        # this search to the transport scatter-gather (same results, host/
+        # single-shard kernels) instead of re-poking a broken mesh; blocked()
+        # admits one probe per backoff window, which IS this search
+        if DEVICE_HEALTH.any_open and \
+                DEVICE_HEALTH.blocked((f"mesh:{index}",)) is not None:
+            self.mesh_fallbacks += 1
+            return None
         self._prune(state)
         # the mesh path runs ON the coordinator (no shard-side _s_query_phase
         # to arm a collector), so a profiled request roots its collector here:
@@ -146,6 +159,10 @@ class MeshServingService:
             self.logger.warning(f"mesh path failed, falling back to transport: {e}")
         if results is None:
             self.mesh_fallbacks += 1  # eligible-looking but fell back mid-flight
+        elif DEVICE_HEALTH.dirty:
+            # mesh program served: clean device outcome (closes a half-open
+            # mesh domain when this search was the admitted probe)
+            DEVICE_HEALTH.note_success((f"mesh:{index}",))
         return results
 
     def _breakers(self):
@@ -355,8 +372,11 @@ class MeshServingService:
                 # fan-out hands back this query's host rows directly
                 out = None
                 (shard_row, score_row, doc_row, totals_col,
-                 qmax_col) = self.batcher.execute_mesh(
-                     plan, executor, k, deadline=deadline)
+                 qmax_col) = self._launch_contained(
+                     index, svc, searchers, kind, default_sim,
+                     use_global_stats, executor,
+                     lambda ex: self.batcher.execute_mesh(
+                         plan, ex, k, deadline=deadline))
             else:
                 # the SPMD launch + its program-output pull, timed as one
                 # mesh span on the request's trace (no extra sync: the span
@@ -368,16 +388,20 @@ class MeshServingService:
                     index=index, shards=S) if cur is not None else None
                 t_launch = time.monotonic() if prof is not None else 0.0
                 try:
-                    out = executor.search(
-                        [plan], k, filter_masks=filter_masks, agg_rows=agg_rows,
-                        use_metric_aggs=bool(metric_fields),
-                        post_masks=post_masks,
-                        min_score=(float(req.min_score)
-                                   if req.min_score is not None else None),
-                        sort_keys=sort_keys,
-                        sort_desc=bool(sort_spec.reverse)
-                        if sort_spec is not None else False,
-                        active=active, bucket_pairs=bucket_pairs or None)
+                    out = self._launch_contained(
+                        index, svc, searchers, kind, default_sim,
+                        use_global_stats, executor,
+                        lambda ex: ex.search(
+                            [plan], k, filter_masks=filter_masks,
+                            agg_rows=agg_rows,
+                            use_metric_aggs=bool(metric_fields),
+                            post_masks=post_masks,
+                            min_score=(float(req.min_score)
+                                       if req.min_score is not None else None),
+                            sort_keys=sort_keys,
+                            sort_desc=bool(sort_spec.reverse)
+                            if sort_spec is not None else False,
+                            active=active, bucket_pairs=bucket_pairs or None))
                 finally:
                     if mesh_span is not None:
                         mesh_span.end()
@@ -574,6 +598,51 @@ class MeshServingService:
             for (i, _l), v in zip(items, vals):
                 out[i] = v
         return out
+
+    def _launch_contained(self, index: str, svc, searchers, kind, default_sim,
+                          use_global_stats: bool, executor, launch):
+        """One SPMD launch with device fault containment.
+
+        The seeded chaos seam (transport/faults.DEVICE_FAULTS, domain
+        ``mesh:<index>``) fires before the launch. A device-classified launch
+        failure invalidates the cached executor and rebuilds it ONCE — a
+        poisoned executable heals with a rebuild, not a retry against the same
+        program — then retries the launch on the fresh executor. A second
+        failure records the ``mesh:<index>`` fault domain and re-raises;
+        try_search's blanket handler degrades this search to the transport
+        scatter-gather, and the now-open circuit keeps later searches off the
+        mesh until a probe succeeds. Host-side exceptions (classify → None)
+        pass straight through: no rebuild, no circuit movement."""
+        try:
+            if DEVICE_FAULTS.active:
+                DEVICE_FAULTS.check(f"mesh:{index}")
+            return launch(executor)
+        except Exception as e:  # noqa: BLE001
+            if classify_device_error(e) is None:
+                raise
+            with self._lock:
+                cached = self._executors.get(index)
+                if cached is not None and cached[2] is not None \
+                        and executor in cached[2].values():
+                    del self._executors[index]
+            self.mesh_rebuilds += 1
+            self.logger.warning(
+                f"mesh launch failed for [{index}] ({type(e).__name__}: {e});"
+                f" rebuilding executor once")
+            rebuilt = self._executor_for(index, svc, searchers, kind,
+                                         default_sim, use_global_stats)
+            if rebuilt is None:
+                DEVICE_HEALTH.record_failure(
+                    f"mesh:{index}", tag_domain(e, f"mesh:{index}"))
+                raise
+            try:
+                if DEVICE_FAULTS.active:
+                    DEVICE_FAULTS.check(f"mesh:{index}")
+                return launch(rebuilt)
+            except Exception as e2:  # noqa: BLE001
+                DEVICE_HEALTH.record_failure(
+                    f"mesh:{index}", tag_domain(e2, f"mesh:{index}"))
+                raise
 
     def _executor_for(self, index: str, svc, searchers, kind, default_sim,
                       use_global_stats: bool):

@@ -211,6 +211,12 @@ class _PromWriter:
         self._type(name, typ)
         self.lines.append(f"{name}{_prom_labels(labels)} {_prom_num(value)}")
 
+    def declare(self, name: str, typ: str):
+        """Force a family's # TYPE header even with zero samples this scrape —
+        a contiguity-strict scraper still learns the name exists (used for
+        label sets that are empty on a healthy node, e.g. device domains)."""
+        self._type(name, typ)
+
     def gauge(self, name: str, value, **labels):
         self.sample(name, "gauge", value, **labels)
 
@@ -395,6 +401,26 @@ def _prometheus_text(node) -> str:
             sum(e["totals"].get("postings", 0)
                 + e["totals"].get("dense_plane", 0)
                 for e in report["indices"].values()))
+    # device fault domains (common/devicehealth): classified failure counters
+    # (fixed class vocabulary, zeros included), circuit transitions, and a
+    # per-domain state gauge (0=closed 1=half_open 2=open). Domain labels are
+    # bounded by construction — indices × the fixed compile-family vocabulary
+    # — and only appear once a domain has recorded a failure; the family is
+    # DECLARED even when empty so dashboards can reference it on healthy nodes
+    from ..common.devicehealth import DEVICE_HEALTH, HALF_OPEN, OPEN
+
+    dh = DEVICE_HEALTH.stats()
+    for cls in ("transient", "persistent"):
+        w.counter("estpu_device_fault_total", dh["failures"].get(cls, 0),
+                  **{"class": cls})
+    w.counter("estpu_device_fault_trips_total", dh["trips"])
+    w.counter("estpu_device_fault_probes_total", dh["probes"])
+    w.counter("estpu_device_fault_recoveries_total", dh["recoveries"])
+    w.declare("estpu_device_domain_state", "gauge")
+    _state_num = {OPEN: 2, HALF_OPEN: 1}
+    for dname, dstat in dh["domains"].items():
+        w.gauge("estpu_device_domain_state",
+                _state_num.get(dstat["state"], 0), domain=dname)
     # stall watchdog + event journal (common/events.py): per-type emission
     # counters (fixed EVENT_TYPES vocabulary) + suppression/ring pressure
     es = node.events.stats()
@@ -1407,10 +1433,24 @@ def build_rest_controller(node) -> RestController:
         return host, "127.0.0.1"
 
     def cat_health(req):
+        from ..common.devicehealth import CLOSED, DEVICE_HEALTH
+
         h = client.cluster_health()
+        # tail column: device fault domains currently not closed (serving
+        # degraded to the host path there) — "device_ok" when every domain
+        # is healthy, else e.g. "device_degraded:pull:idx,mesh:idx"
+        if not DEVICE_HEALTH.any_open:
+            dev = "device_ok"
+        else:
+            open_domains = sorted(
+                d for d, st in DEVICE_HEALTH.stats()["domains"].items()
+                if st["state"] != CLOSED)
+            dev = ("device_degraded:" + ",".join(open_domains)
+                   if open_domains else "device_ok")
         return RestResponse(200, f"{h['cluster_name']} {h['status']} "
                                  f"{h['number_of_nodes']} {h['number_of_data_nodes']} "
-                                 f"{h['active_shards']} {h['unassigned_shards']}\n",
+                                 f"{h['active_shards']} {h['unassigned_shards']} "
+                                 f"{dev}\n",
                             content_type="text/plain")
 
     def cat_nodes(req):

@@ -231,6 +231,9 @@ class DeviceBatcher:
         # counted separately so occupancy regressions aren't blamed on load
         self._profile_bypassed = 0
         self._splits = 0  # coalesced launch failed -> per-item replay
+        self._device_splits = 0  # splits whose trigger classified as a
+        # device fault (common/devicehealth taxonomy) — the containment
+        # counter: one poisoned plan replayed away from its neighbors
         # batch service-time tail (dispatch start -> fan-out done): percentile
         # twin of _ewma_cost, exported in /_nodes/stats + Prometheus
         self.service_hist = HistogramMetric()
@@ -508,12 +511,25 @@ class DeviceBatcher:
     def _split(self, family, items, err):
         """A coalesced launch failed (breaker trip, device error): replay every
         item individually so only the request that actually trips carries the
-        error — its neighbors must not inherit a 429 sized for the batch."""
+        error — its neighbors must not inherit a 429 sized for the batch.
+
+        Device containment (common/devicehealth) rides this same path: a
+        classified XLA error inside a shared launch replays each member, so
+        one poisoned plan degrades ITS request to the host scorer while the
+        N-1 neighbors re-launch and serve from the device. Per-item verdicts
+        reach the circuit tracker through the members' own futures
+        (service._device_failed classifies the tagged exception); the batch-
+        level error is NOT recorded — the replay re-derives who is actually
+        poisoned, and neighbors' collateral must never advance a circuit."""
+        from ..common.devicehealth import classify_device_error
+
         if len(items) == 1:
             items[0].future.set_exception(err)
             return
         with self._stats_lock:
             self._splits += 1
+            if classify_device_error(err) is not None:
+                self._device_splits += 1
         for it in items:
             try:
                 res = family.execute_single(it)
@@ -598,6 +614,7 @@ class DeviceBatcher:
                 "bypassed": self._bypassed,
                 "profile_bypassed": self._profile_bypassed,
                 "splits": self._splits,
+                "device_splits": self._device_splits,
                 "queue": len(self._queue),
                 "ewma_batch_ms": round(self._ewma_cost * 1000.0, 3),
             }

@@ -153,6 +153,13 @@ def merge_responses(req: ParsedSearchRequest, merged: MergedTopDocs,
         "_shards": {
             "total": total_shards,
             "successful": successful,
+            # shards that answered (counted successful — same bitwise hits)
+            # but via the host path because a device fault domain was open
+            # (common/devicehealth): the response stays honest about serving
+            # health without failing anything, like the reference's
+            # timed_out-but-partial contract
+            "degraded": sum(1 for r in shard_results
+                            if getattr(r, "degraded", False)),
             "failed": total_shards - successful,
         },
         "hits": {

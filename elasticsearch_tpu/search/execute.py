@@ -73,7 +73,9 @@ from .queries import (
     WildcardQuery,
 )
 from ..common.breaker import reserve
+from ..common.devicehealth import tag_domain as _tag_domain
 from ..common.jaxenv import compile_tag
+from ..transport.faults import DEVICE_FAULTS as _DEVICE_FAULTS
 from ..transport.faults import DEVICE_PULL as _DEVICE_PULL
 from .similarity import (
     BM25Similarity,
@@ -683,19 +685,32 @@ def _dispatch_flat_plain(plans: list[FlatPlan], ctx: ShardContext,
                                             - seg.post_offsets[tid])
             clause_lists.append(cl)
         # compile_tag: backend compiles triggered by these launches land in
-        # the capacity ledger's per-family attribution (common/jaxenv)
-        with compile_tag("sparse"):
-            launches, overflow, release = launch_flat_sparse(
-                packed, clause_lists, n_must, msm, coord_tbl, k, simple=simple,
-                breaker=ctx.breaker("request"), sim=sim)
+        # the capacity ledger's per-family attribution (common/jaxenv).
+        # Launch failures are tagged with their compile-family fault domain
+        # (and the seeded DEVICE_FAULTS seam injects here) so the circuit
+        # tracker attributes the trip to the right domain.
+        try:
+            if _DEVICE_FAULTS.active:
+                _DEVICE_FAULTS.check("compile:sparse")
+            with compile_tag("sparse"):
+                launches, overflow, release = launch_flat_sparse(
+                    packed, clause_lists, n_must, msm, coord_tbl, k,
+                    simple=simple, breaker=ctx.breaker("request"), sim=sim)
+        except Exception as e:  # noqa: BLE001 — re-raised tagged
+            raise _tag_domain(e, "compile:sparse")
         releases.append(release)
         dense = None
         if overflow:
-            with compile_tag("dense"):
-                dense = _launch_dense_fallback(
-                    overflow, finals, field_idx, all_fields, caches_stack,
-                    n_must, msm, coord_tbl, packed, seg, k,
-                    breaker=ctx.breaker("fielddata"))
+            try:
+                if _DEVICE_FAULTS.active:
+                    _DEVICE_FAULTS.check("compile:dense")
+                with compile_tag("dense"):
+                    dense = _launch_dense_fallback(
+                        overflow, finals, field_idx, all_fields, caches_stack,
+                        n_must, msm, coord_tbl, packed, seg, k,
+                        breaker=ctx.breaker("fielddata"))
+            except Exception as e:  # noqa: BLE001 — re-raised tagged
+                raise _tag_domain(e, "compile:dense")
         seg_work.append((seg, base, packed.doc_pad, launches, dense))
         if prof is not None:
             from ..ops.pallas_kernels import estpu_pallas_enabled
@@ -742,11 +757,33 @@ def _merge_flat_plain(pending: _PendingFlat) -> list[TopDocs]:
         stall = _DEVICE_PULL.delay_for(pending.index)
         if stall > 0.0:
             time.sleep(stall)
-    # stamp the pull window for tracing (host clocks around the pull the
-    # serving path performs anyway — the device span's end rides this)
-    pending.pull_t0 = time.monotonic()
-    pulled = iter(jax.device_get(refs) if refs else [])
-    pending.pull_t1 = time.monotonic()
+    try:
+        # seeded device-error seam (transport/faults.DEVICE_FAULTS): same
+        # one-attr-read gate; armed, the batch pull raises the injected
+        # XlaRuntimeError exactly where a real transfer failure would
+        if _DEVICE_FAULTS.active:
+            _DEVICE_FAULTS.check(f"pull:{pending.index}")
+        # stamp the pull window for tracing (host clocks around the pull the
+        # serving path performs anyway — the device span's end rides this)
+        pending.pull_t0 = time.monotonic()
+        pulled = iter(jax.device_get(refs) if refs else [])
+        pending.pull_t1 = time.monotonic()
+    except Exception as e:  # noqa: BLE001 — abandoning the batch
+        # drain whatever the device will still write into the staging
+        # buffers, then hand them back: a poisoned pull (this failure path is
+        # cold — syncing here is legal) must not leak the scratch pool while
+        # the batcher replays members individually
+        for r in refs:
+            try:
+                jax.block_until_ready(r)
+            except Exception:  # noqa: BLE001 — the launch itself may be poisoned
+                pass
+        for release in pending.releases:
+            try:
+                release()
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+        raise _tag_domain(e, f"pull:{pending.index}")
     # results are on the host — the borrowed staging arrays are reusable now
     for release in pending.releases:
         release()

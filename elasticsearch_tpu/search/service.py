@@ -20,6 +20,7 @@ from ..common import insights as _insights
 from ..common import profile as _profile
 from ..common.breaker import reserve as breaker_reserve
 from ..common.deadline import NO_DEADLINE, Deadline, parse_timevalue
+from ..common.devicehealth import DEVICE_HEALTH
 from ..common.errors import (
     CircuitBreakingError,
     QueryParsingError,
@@ -130,6 +131,10 @@ class ShardQueryResult:
     # white-box execution profile of this shard's query phase (plain scalars —
     # rides the wire like the span list does; None when unprofiled)
     profile: dict | None = None
+    # served by the host fallback because the device path failed or its fault
+    # domain is open (common/devicehealth) — bitwise-identical hits, but the
+    # coordinator's `_shards` rollup must not count this copy as fully healthy
+    degraded: bool = False
 
 
 # process-wide serving-path counters (which executor served the query phase —
@@ -144,6 +149,7 @@ SERVING_COUNTERS = {
     "device_percolate": 0,  # batched percolation launches
     "device_percolate_fallbacks": 0,  # batch failed → host loop
     "device_errors": 0,  # device launch failed → host fallback (see _device_failed)
+    "degraded": 0,  # served host-side on device failure OR an open fault domain
     "host": 0,  # host scorer / mask path
 }
 
@@ -161,13 +167,24 @@ def _count(path: str):
         # thread-local read + attribute write — the insights hook contract)
 
 
-def _device_failed(e: BaseException):
+def _device_failed(e: BaseException, ctx: "ShardContext | None" = None):
     """A device launch failed (broken backend, OOM, plugin init): the search
     must still answer — count it, log each distinct error once, serve host.
-    Mirrors mesh_serving's any-mesh-failure-must-not-fail-the-search rule."""
+    Mirrors mesh_serving's any-mesh-failure-must-not-fail-the-search rule.
+
+    Classified jax/XLA errors also advance the owning fault domain's circuit
+    (common/devicehealth): the raiser tags the exception with its narrowest
+    domain (`_estpu_device_domain`, stamped at the pack/launch/pull seams);
+    untagged device errors attribute to the index's batch-pull domain."""
     from ..common.logging import get_logger
 
     SERVING_COUNTERS["device_errors"] += 1
+    SERVING_COUNTERS["degraded"] += 1
+    domain = getattr(e, "_estpu_device_domain", None)
+    if domain is None and ctx is not None:
+        domain = f"pull:{ctx.index_name}"
+    if domain is not None:
+        DEVICE_HEALTH.record_failure(domain, e)
     prof = _profile.current()
     if prof is not None:
         prof.event("device_error", error=type(e).__name__)
@@ -178,6 +195,42 @@ def _device_failed(e: BaseException):
         get_logger("search.device").warning(
             f"device serving failed ({key}: {e}); falling back to the host "
             f"scorer (logged once per error type)")
+
+
+def _domains_for(ctx: "ShardContext", families: tuple) -> tuple:
+    """The fault domains one device attempt on this shard exercises: the
+    index's pack + batch-pull domains plus each compile family it may launch
+    (the devicehealth domain taxonomy)."""
+    idx = str(ctx.index_name)
+    return (f"pack:{idx}",) + tuple(f"compile:{f}" for f in families) \
+        + (f"pull:{idx}",)
+
+
+def _blocked_domain(ctx: "ShardContext", families: tuple) -> str | None:
+    """The open fault domain that routes this query host-side before any
+    launch, or None (all closed, or this caller was admitted as the probe).
+    One plain attr read when every domain is closed — the standing hot-path
+    contract."""
+    if not DEVICE_HEALTH.any_open:
+        return None
+    return DEVICE_HEALTH.blocked(_domains_for(ctx, families))
+
+
+def _device_degraded(domain: str):
+    """An open fault domain skipped the device path: count + profile the
+    degrade (the result is still bitwise-identical host-scored hits)."""
+    SERVING_COUNTERS["degraded"] += 1
+    prof = _profile.current()
+    if prof is not None:
+        prof.event("device_degraded", domain=domain)
+        prof.fallback(f"device_degraded:{domain}")
+
+
+def _note_device_ok(ctx: "ShardContext", families: tuple):
+    """Clean device outcome: close a half-open domain this query just probed
+    (one attr read when no device failure was ever recorded)."""
+    if DEVICE_HEALTH.dirty:
+        DEVICE_HEALTH.note_success(_domains_for(ctx, families))
 
 
 def _execute_flat_single(ctx: ShardContext, plan, k: int,
@@ -265,31 +318,43 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
         if prof is not None:
             prof.phase_s("lower", time.monotonic() - t_low)
             _prof_record_plan(prof, plan, req, ctx, use_device)
+        degraded = False
         if plan is not None:
-            try:
-                td = _execute_flat_single(ctx, plan, max(k, 1), deadline)
-            except CircuitBreakingError as e:
-                if getattr(e, "breaker", None) != "fielddata":
-                    raise  # request/parent trip: load-shed (429), not degradable
-                _device_failed(e)  # out of device-pack budget → host serves
-            except SearchEngineError:
-                raise  # domain errors (scripts, parsing) are the answer itself
-            except Exception as e:  # noqa: BLE001 — device trouble must not
-                _device_failed(e)   # fail the search; the host scorer answers
+            fams = ("function_score",) if plan.fs is not None else \
+                ("filtered",) if plan.filt is not None else ("sparse", "dense")
+            dom = _blocked_domain(ctx, fams)
+            if dom is not None:
+                _device_degraded(dom)  # open fault domain: host serves, no launch
+                degraded = True
             else:
-                _count("device_function_score" if plan.fs is not None
-                       else "device_filtered" if plan.filt is not None
-                       else "device_sparse")
-                return ShardQueryResult(
-                    total=td.total, docs=[(s, d, None) for s, d in td.hits],
-                    max_score=td.max_score, suggest=suggest_out,
-                    shard_id=shard_id,
-                )
+                try:
+                    td = _execute_flat_single(ctx, plan, max(k, 1), deadline)
+                except CircuitBreakingError as e:
+                    if getattr(e, "breaker", None) != "fielddata":
+                        raise  # request/parent trip: load-shed (429), not degradable
+                    _device_failed(e, ctx)  # out of device-pack budget → host serves
+                    degraded = True
+                except SearchEngineError:
+                    raise  # domain errors (scripts, parsing) are the answer itself
+                except Exception as e:  # noqa: BLE001 — device trouble must not
+                    _device_failed(e, ctx)  # fail the search; the host scorer answers
+                    degraded = True
+                else:
+                    _note_device_ok(ctx, fams)
+                    _count("device_function_score" if plan.fs is not None
+                           else "device_filtered" if plan.filt is not None
+                           else "device_sparse")
+                    return ShardQueryResult(
+                        total=td.total, docs=[(s, d, None) for s, d in td.hits],
+                        max_score=td.max_score, suggest=suggest_out,
+                        shard_id=shard_id,
+                    )
         _count("host")
         td = _host_topk(ctx, req, k, deadline)
         return ShardQueryResult(total=td.total, docs=[(s, d, None) for s, d in td.hits],
                                 max_score=td.max_score, suggest=suggest_out,
-                                shard_id=shard_id, timed_out=td.timed_out)
+                                shard_id=shard_id, timed_out=td.timed_out,
+                                degraded=degraded)
 
     if prof is not None:
         # profiled-only pre-lowering: the mask-needing branches below lower
@@ -300,25 +365,39 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
                           else None, req, ctx, use_device)
         prof.phase_s("lower", time.monotonic() - t_low)
 
+    # device fault-domain state for the mask-needing branches: an open domain
+    # (or a device failure below) degrades to the general host path, which
+    # marks its ShardQueryResult so `_shards` stays honest
+    degraded = False
+
     # device metric-agg path: when the ONLY mask consumer is a set of
     # device-eligible metric aggs, the agg reduction fuses into the scoring
     # kernel (execute.execute_flat_aggs) instead of materializing host masks
     if (use_device and req.aggs and not req.facets and not req.sort
             and req.post_filter is None and not req.rescore
             and req.min_score is None and not req.explain):
-        try:
-            device = _try_device_aggs(ctx, req, k, suggest_out, shard_id)
-        except CircuitBreakingError as e:
-            if getattr(e, "breaker", None) != "fielddata":
-                raise  # request/parent trip: load-shed (429), not degradable
-            _device_failed(e)  # out of device-pack budget → host collectors
+        dom = _blocked_domain(ctx, ("aggs",))
+        if dom is not None:
+            _device_degraded(dom)
+            degraded = True
             device = None
-        except SearchEngineError:
-            raise  # domain errors (scripts, parsing) are the answer itself
-        except Exception as e:  # noqa: BLE001
-            _device_failed(e)
-            device = None
+        else:
+            try:
+                device = _try_device_aggs(ctx, req, k, suggest_out, shard_id)
+            except CircuitBreakingError as e:
+                if getattr(e, "breaker", None) != "fielddata":
+                    raise  # request/parent trip: load-shed (429), not degradable
+                _device_failed(e, ctx)  # out of device-pack budget → host collectors
+                degraded = True
+                device = None
+            except SearchEngineError:
+                raise  # domain errors (scripts, parsing) are the answer itself
+            except Exception as e:  # noqa: BLE001
+                _device_failed(e, ctx)
+                degraded = True
+                device = None
         if device is not None:
+            _note_device_ok(ctx, ("aggs",))
             _count("device_aggs")
             return device
 
@@ -332,24 +411,32 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
         wrapped = FunctionScoreQuery(query=req.query, min_score=req.min_score)
         plan = lower_flat(wrapped, ctx)
         if plan is not None:
-            try:
-                td = _execute_flat_single(ctx, plan, max(k, 1), deadline)
-            except CircuitBreakingError as e:
-                if getattr(e, "breaker", None) != "fielddata":
-                    raise  # request/parent trip: load-shed (429), not degradable
-                _device_failed(e)  # out of device-pack budget → host serves
-            except SearchEngineError:
-                raise  # domain errors are the answer itself
-            except Exception as e:  # noqa: BLE001
-                _device_failed(e)
+            dom = _blocked_domain(ctx, ("function_score",))
+            if dom is not None:
+                _device_degraded(dom)
+                degraded = True
             else:
-                _count("device_filtered")
-                return ShardQueryResult(
-                    total=td.total,
-                    docs=[(s, d, None) for s, d in td.hits[: max(k, 0)]],
-                    max_score=td.max_score, suggest=suggest_out,
-                    shard_id=shard_id,
-                )
+                try:
+                    td = _execute_flat_single(ctx, plan, max(k, 1), deadline)
+                except CircuitBreakingError as e:
+                    if getattr(e, "breaker", None) != "fielddata":
+                        raise  # request/parent trip: load-shed (429), not degradable
+                    _device_failed(e, ctx)  # out of device-pack budget → host serves
+                    degraded = True
+                except SearchEngineError:
+                    raise  # domain errors are the answer itself
+                except Exception as e:  # noqa: BLE001
+                    _device_failed(e, ctx)
+                    degraded = True
+                else:
+                    _note_device_ok(ctx, ("function_score",))
+                    _count("device_filtered")
+                    return ShardQueryResult(
+                        total=td.total,
+                        docs=[(s, d, None) for s, d in td.hits[: max(k, 0)]],
+                        max_score=td.max_score, suggest=suggest_out,
+                        shard_id=shard_id,
+                    )
 
     # device post_filter path: aggs (if any) reduce over the FULL match set while
     # hits gate on the post filter — two composed launches sharing the dense core
@@ -357,19 +444,29 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
     if (use_device and req.post_filter is not None and not req.sort
             and not req.facets and not req.rescore and req.min_score is None
             and not req.explain):
-        try:
-            device = _try_device_post_filter(ctx, req, k, suggest_out, shard_id)
-        except CircuitBreakingError as e:
-            if getattr(e, "breaker", None) != "fielddata":
-                raise  # request/parent trip: load-shed (429), not degradable
-            _device_failed(e)  # out of device-pack budget → host serves
+        dom = _blocked_domain(ctx, ("filtered", "aggs"))
+        if dom is not None:
+            _device_degraded(dom)
+            degraded = True
             device = None
-        except SearchEngineError:
-            raise  # domain errors (scripts, parsing) are the answer itself
-        except Exception as e:  # noqa: BLE001
-            _device_failed(e)
-            device = None
+        else:
+            try:
+                device = _try_device_post_filter(ctx, req, k, suggest_out,
+                                                 shard_id)
+            except CircuitBreakingError as e:
+                if getattr(e, "breaker", None) != "fielddata":
+                    raise  # request/parent trip: load-shed (429), not degradable
+                _device_failed(e, ctx)  # out of device-pack budget → host serves
+                degraded = True
+                device = None
+            except SearchEngineError:
+                raise  # domain errors (scripts, parsing) are the answer itself
+            except Exception as e:  # noqa: BLE001
+                _device_failed(e, ctx)
+                degraded = True
+                device = None
         if device is not None:
+            _note_device_ok(ctx, ("filtered", "aggs"))
             _count("device_filtered")
             return device
 
@@ -379,19 +476,28 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
     if (use_device and req.sort and len(req.sort) == 1
             and not req.facets and req.post_filter is None and not req.rescore
             and req.min_score is None and not req.explain):
-        try:
-            device = _try_device_sort(ctx, req, k, suggest_out, shard_id)
-        except CircuitBreakingError as e:
-            if getattr(e, "breaker", None) != "fielddata":
-                raise  # request/parent trip: load-shed (429), not degradable
-            _device_failed(e)  # out of device-pack budget → host serves
+        dom = _blocked_domain(ctx, ("sorted", "aggs"))
+        if dom is not None:
+            _device_degraded(dom)
+            degraded = True
             device = None
-        except SearchEngineError:
-            raise  # domain errors (scripts, parsing) are the answer itself
-        except Exception as e:  # noqa: BLE001
-            _device_failed(e)
-            device = None
+        else:
+            try:
+                device = _try_device_sort(ctx, req, k, suggest_out, shard_id)
+            except CircuitBreakingError as e:
+                if getattr(e, "breaker", None) != "fielddata":
+                    raise  # request/parent trip: load-shed (429), not degradable
+                _device_failed(e, ctx)  # out of device-pack budget → host serves
+                degraded = True
+                device = None
+            except SearchEngineError:
+                raise  # domain errors (scripts, parsing) are the answer itself
+            except Exception as e:  # noqa: BLE001
+                _device_failed(e, ctx)
+                degraded = True
+                device = None
         if device is not None:
+            _note_device_ok(ctx, ("sorted", "aggs"))
             _count("device_sort")
             return device
 
@@ -493,7 +599,7 @@ def execute_query_phase(ctx: ShardContext, req: ParsedSearchRequest,
         return ShardQueryResult(
             total=total, docs=docs, max_score=max_score, agg_partials=agg_partials,
             facet_partials=facet_partials, suggest=suggest_out, shard_id=shard_id,
-            timed_out=timed_out,
+            timed_out=timed_out, degraded=degraded,
         )
 
 

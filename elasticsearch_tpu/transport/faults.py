@@ -227,3 +227,92 @@ class DevicePullFaults:
 
 
 DEVICE_PULL = DevicePullFaults()
+
+
+# ---------------------------------------------------------------------------
+# device fault injection: seeded XLA-error seams for the fault-domain circuits
+# ---------------------------------------------------------------------------
+
+# error kind -> the XLA status-prefixed message jaxlib would surface; the
+# classification (common/devicehealth.classify_device_error) reads the prefix,
+# so each kind lands deterministically in its transient/persistent bucket.
+_DEVICE_ERROR_MESSAGES = {
+    "oom": "RESOURCE_EXHAUSTED: injected: out of memory allocating scratch",
+    "timeout": "DEADLINE_EXCEEDED: injected: device execution timed out",
+    "unavailable": "UNAVAILABLE: injected: device unreachable",
+    "launch": "INTERNAL: injected: failed to launch executable on device",
+    "transfer": "FAILED_PRECONDITION: injected: device-to-host transfer failed",
+    "internal": "INTERNAL: injected: generic device failure",
+}
+
+DEVICE_ERROR_KINDS = tuple(_DEVICE_ERROR_MESSAGES)
+
+
+def make_device_error(kind: str) -> Exception:
+    """A FRESH injected XlaRuntimeError per injection (same rationale as
+    FaultRule.make_error: shared instances interleave tracebacks across
+    threads). Falls back to RuntimeError where jax is absent so the seam
+    stays importable in host-only tooling."""
+    msg = _DEVICE_ERROR_MESSAGES[kind]
+    try:
+        from jax.errors import JaxRuntimeError
+    except Exception:  # noqa: BLE001 — jax-less environment
+        return RuntimeError(msg)
+    return JaxRuntimeError(msg)
+
+
+class DeviceFaults:
+    """Deterministic device-error injection for the fault-domain circuits
+    (common/devicehealth) — error type × domain glob × count, mirroring
+    DevicePullFaults above. Seam call sites sit at the four domain
+    touchpoints (`pack:<index>` before the pack publishes, `compile:<family>`
+    around the launch, `mesh:<index>` before the mesh launch, `pull:<index>`
+    next to the batched device_get) so every trip/probe/recovery transition
+    replays identically under test.
+
+    Hot-path contract matches the sibling: `active` is ONE plain attribute
+    read and the shipped default is disarmed; `check()` takes only the leaf
+    `_lock` for the countdown when armed, and raises OUTSIDE it."""
+
+    def __init__(self):
+        self.active = False  # the one hot-path read
+        self._lock = threading.Lock()
+        self._error = "internal"
+        self._domain = "*"
+        self._remaining = 0
+        self.injected = 0
+
+    def arm(self, error: str = "internal", domain: str = "*", times: int = 1):
+        if error not in _DEVICE_ERROR_MESSAGES:
+            raise ValueError(f"unknown device error kind [{error}] "
+                             f"(want one of {DEVICE_ERROR_KINDS})")
+        with self._lock:
+            self._error = error
+            self._domain = domain
+            self._remaining = int(times)
+            self.active = True
+        return self
+
+    def disarm(self):
+        with self._lock:
+            self.active = False
+            self._remaining = 0
+
+    def check(self, domain: str) -> None:
+        """Raise the armed error if `domain` matches (decrements the budget,
+        auto-disarms at zero). Call sites guard with the `active` attr read so
+        the disarmed serving path pays exactly that."""
+        with self._lock:
+            if not self.active or self._remaining <= 0:
+                return
+            if not _glob_match(str(domain), self._domain):
+                return
+            self._remaining -= 1
+            if self._remaining <= 0:
+                self.active = False
+            self.injected += 1
+            kind = self._error
+        raise make_device_error(kind)
+
+
+DEVICE_FAULTS = DeviceFaults()

@@ -40,9 +40,12 @@ written but never read keeps its refreshes device-free.
 from __future__ import annotations
 
 import threading
+import time
 
+from .common.devicehealth import DEVICE_HEALTH, classify_device_error
 from .common.errors import SearchEngineError
 from .common.logging import get_logger
+from .common.retry import RetryPolicy
 
 
 class IndexWarmerService:
@@ -60,12 +63,21 @@ class IndexWarmerService:
         # warmer pool thread indefinitely
         self.query_budget_s = settings.get_float(
             "indices.warmer.query_timeout", 5.0)
+        # capped retry budget for DEVICE-classified warm-pack failures
+        # (common/devicehealth taxonomy): a transient OOM on the warmer pool
+        # retries with decorrelated-jitter backoff instead of leaving the
+        # segment unpacked for the query path to cold-pack inline
+        self.pack_retry_budget = max(0, settings.get_int(
+            "indices.warmer.pack_retries", 2))
+        self._retry_policy = RetryPolicy(base_s=settings.get_float(
+            "indices.warmer.pack_retry_base", 0.05), cap_s=1.0)
         self.logger = get_logger("indices.warmer", node=node.name)
         self._lock = threading.Lock()  # leaf: counters only
         self.packs_scheduled = 0
         self.packs_done = 0
         self.packs_stolen = 0  # claimed by a racing search before we ran
         self.pack_failures = 0
+        self.pack_retries = 0  # device-classified failures retried on-pool
         self.reprimes = 0
         self.queries_warmed = 0
         self.query_failures = 0
@@ -135,25 +147,63 @@ class IndexWarmerService:
 
     # -- pool workers ---------------------------------------------------------
     def _run_pack(self, seg, fut, breaker, index: str) -> None:
-        from .ops.device_index import run_warm
+        from .ops.device_index import begin_warm, run_warm
 
-        try:
-            res = run_warm(seg, fut, breaker=breaker, owner=index)
-            with self._lock:
-                # res None = a racing search CLAIMED the work first and packs
-                # it inline (device_index's claimable-future protocol) — the
-                # scheduled work is complete either way, just not by us
-                self.packs_done += 1
-                if res is None:
-                    self.packs_stolen += 1
-        except Exception as e:  # noqa: BLE001 — a warm pack failure (breaker
-            # trip, device trouble) is survivable: waiters saw the exception
-            # through the future and degraded; later searches retry inline
-            with self._lock:
-                self.packs_done += 1
-                self.pack_failures += 1
-            self.logger.debug("warm pack failed [%s][gen %s]: %s", index,
-                              getattr(seg, "gen", "?"), e)
+        attempts = 0
+        prev_sleep = None
+        while True:
+            try:
+                res = run_warm(seg, fut, breaker=breaker, owner=index)
+            except Exception as e:  # noqa: BLE001 — a warm pack failure
+                # (breaker trip, device trouble) is survivable: waiters saw
+                # the exception through the future and degraded; later
+                # searches retry inline
+                attempts += 1
+                if (classify_device_error(e) is not None
+                        and attempts <= self.pack_retry_budget):
+                    # DEVICE-classified failure with retry budget left: back
+                    # off (decorrelated jitter, still on this warmer/merge
+                    # pool thread — never the query path) and re-arm. The
+                    # failed attempt cleared the pack marker and resolved the
+                    # old future (device_index._perform_pack), so no waiter
+                    # ever observes half-packed state; a search racing in
+                    # meanwhile claims the fresh future and we stand down.
+                    prev_sleep = self._retry_policy.next_backoff(prev_sleep)
+                    time.sleep(prev_sleep)
+                    fut = begin_warm(seg)
+                    if fut is None:
+                        with self._lock:
+                            self.packs_done += 1
+                        return  # packed (or claimed) while we backed off
+                    with self._lock:
+                        self.pack_retries += 1
+                    continue
+                with self._lock:
+                    self.packs_done += 1
+                    self.pack_failures += 1
+                # advance the pack fault domain: with no query waiting on the
+                # future, nobody else ever classifies this failure
+                DEVICE_HEALTH.record_failure(
+                    getattr(e, "_estpu_device_domain", None)
+                    or f"pack:{index}", e)
+                self.logger.debug("warm pack failed [%s][gen %s] after %d "
+                                  "attempt(s): %s", index,
+                                  getattr(seg, "gen", "?"), attempts, e)
+                return
+            else:
+                with self._lock:
+                    # res None = a racing search CLAIMED the work first and
+                    # packs it inline (device_index's claimable-future
+                    # protocol) — the scheduled work is complete either way,
+                    # just not by us
+                    self.packs_done += 1
+                    if res is None:
+                        self.packs_stolen += 1
+                if res is not None:
+                    # clean pack: reset the domain's strike count (and close
+                    # it if this was the recovery probe after a trip)
+                    DEVICE_HEALTH.note_success((f"pack:{index}",))
+                return
 
     def _re_prime(self, index: str, shard_id: int, engine, dropped) -> None:
         node = self.node
@@ -197,6 +247,7 @@ class IndexWarmerService:
                 "packs_done": self.packs_done,
                 "packs_stolen": self.packs_stolen,
                 "pack_failures": self.pack_failures,
+                "pack_retries": self.pack_retries,
                 "reprimes": self.reprimes,
                 "queries_warmed": self.queries_warmed,
                 "query_failures": self.query_failures,

@@ -1,0 +1,498 @@
+"""Device fault domains (common/devicehealth): classification, per-domain
+circuits with probed recovery, and the seeded device-chaos invariant.
+
+The pinned invariant (ISSUE 18): with a persistent device fault armed on a
+serving domain, every search keeps returning 200 with bitwise-identical hits
+(the host scorer is the same math), the domain trips within its strike budget,
+`_shards.degraded` stays honest, the degraded window compiles nothing and
+packs nothing on the query path, and disarming the fault recovers the domain
+through the half-open probe protocol — with matching journal events.
+
+ref: the containment stance mirrors how the reference engine treats a shard
+copy (per-copy failures in `_shards`, failover instead of 500s); here the
+accelerator itself is the failing copy."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.devicehealth import (CLOSED, HALF_OPEN, OPEN,
+                                                   DEVICE_HEALTH, DeviceHealth,
+                                                   classify_device_error,
+                                                   tag_domain)
+from elasticsearch_tpu.common.retry import is_transient
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.transport.faults import (DEVICE_ERROR_KINDS,
+                                                DEVICE_FAULTS, DeviceFaults,
+                                                make_device_error)
+from elasticsearch_tpu.transport.local import LocalTransportRegistry
+
+pytestmark = pytest.mark.device
+
+VOCAB = ("alpha beta gamma delta epsilon zeta eta theta iota kappa lamda mu "
+         "nu xi omicron pi rho sigma tau upsilon phi chi psi omega").split()
+
+
+@pytest.fixture(autouse=True)
+def _device_state_hygiene():
+    """The health tracker and fault injector are process-wide singletons —
+    every test starts and ends with closed circuits and disarmed faults."""
+    DEVICE_FAULTS.disarm()
+    DEVICE_HEALTH.reset()
+    yield
+    DEVICE_FAULTS.disarm()
+    DEVICE_HEALTH.reset()
+
+
+# ---------------------------------------------------------------------------
+# unit: classification + tagging
+# ---------------------------------------------------------------------------
+
+class TestClassification:
+    def test_taxonomy(self):
+        expected = {"oom": "transient", "timeout": "transient",
+                    "unavailable": "transient", "launch": "persistent",
+                    "transfer": "persistent", "internal": "persistent"}
+        for kind in DEVICE_ERROR_KINDS:
+            got = classify_device_error(make_device_error(kind))
+            assert got == expected[kind], (kind, got)
+
+    def test_host_errors_never_classify(self):
+        # a host-side bug must not quarantine the accelerator, even when the
+        # message mimics an XLA status prefix
+        for e in (ValueError("INTERNAL: not actually xla"),
+                  KeyError("x"), TimeoutError("deadline")):
+            assert classify_device_error(e) is None
+
+    def test_retry_is_transient_learns_the_taxonomy(self):
+        assert is_transient(make_device_error("oom")) is True
+        assert is_transient(make_device_error("unavailable")) is True
+        assert is_transient(make_device_error("launch")) is False
+        assert is_transient(make_device_error("transfer")) is False
+
+    def test_tag_domain_first_tag_wins(self):
+        e = make_device_error("oom")
+        assert tag_domain(e, "pull:a") is e  # returns the error for re-raise
+        tag_domain(e, "mesh:b")
+        assert e._estpu_device_domain == "pull:a"
+
+
+# ---------------------------------------------------------------------------
+# unit: circuit lifecycle (injected clock + rng — no sleeps)
+# ---------------------------------------------------------------------------
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _fresh_health():
+    clock = _FakeClock()
+    dh = DeviceHealth(base_s=0.05, cap_s=5.0, rng=random.Random(7),
+                      clock=clock)
+    events = []
+    dh.register_publisher("t", lambda type_, message, **kw:
+                          events.append((type_, kw)))
+    return dh, clock, events
+
+
+class TestCircuit:
+    def test_transient_strike_budget(self):
+        dh, clock, events = _fresh_health()
+        for _ in range(DeviceHealth.TRANSIENT_STRIKES - 1):
+            assert dh.record_failure("pull:i", make_device_error("oom")) \
+                == "transient"
+        assert dh.state("pull:i") == CLOSED and not dh.any_open
+        dh.record_failure("pull:i", make_device_error("oom"))
+        assert dh.state("pull:i") == OPEN and dh.any_open
+        assert [t for t, _ in events] == ["device_degraded"]
+        assert events[0][1]["domain"] == "pull:i"
+
+    def test_success_resets_closed_strikes(self):
+        dh, clock, _ = _fresh_health()
+        dh.record_failure("pull:i", make_device_error("oom"))
+        dh.record_failure("pull:i", make_device_error("oom"))
+        dh.note_success(("pull:i",))
+        dh.record_failure("pull:i", make_device_error("oom"))
+        assert dh.state("pull:i") == CLOSED  # strikes restarted from zero
+
+    def test_persistent_trips_immediately(self):
+        dh, clock, _ = _fresh_health()
+        assert dh.record_failure("mesh:i", make_device_error("launch")) \
+            == "persistent"
+        assert dh.state("mesh:i") == OPEN and dh.any_open
+        assert dh.stats()["trips"] == 1
+
+    def test_host_error_never_moves_a_circuit(self):
+        dh, clock, _ = _fresh_health()
+        assert dh.record_failure("pull:i", ValueError("host bug")) is None
+        assert dh.state("pull:i") == CLOSED
+        assert not dh.dirty and not dh.any_open
+
+    def test_probe_admission_one_caller_per_window(self):
+        dh, clock, events = _fresh_health()
+        dh.record_failure("pull:i", make_device_error("transfer"))
+        # inside the backoff window every caller degrades
+        assert dh.blocked(("pull:i",)) == "pull:i"
+        clock.t += 10.0
+        # window due: exactly one caller is admitted as the probe...
+        assert dh.blocked(("pull:i",)) is None
+        assert dh.state("pull:i") == HALF_OPEN
+        # ...and a concurrent caller keeps degrading until it reports
+        assert dh.blocked(("pull:i",)) == "pull:i"
+        dh.note_success(("pull:i",))
+        assert dh.state("pull:i") == CLOSED and not dh.any_open
+        st = dh.stats()
+        assert st["probes"] == 1 and st["recoveries"] == 1
+        assert [t for t, _ in events] == ["device_degraded",
+                                          "device_recovered"]
+
+    def test_failed_probe_reopens_with_grown_backoff(self):
+        dh, clock, _ = _fresh_health()
+        dh.record_failure("pull:i", make_device_error("transfer"))
+        clock.t += 10.0
+        assert dh.blocked(("pull:i",)) is None  # probe admitted
+        dh.record_failure("pull:i", make_device_error("transfer"))
+        assert dh.state("pull:i") == OPEN
+        # the re-armed window is decorrelated jitter (NOT monotonic), but
+        # always at least base_s and capped at cap_s
+        backoff_ms = dh.stats()["domains"]["pull:i"]["backoff_ms"]
+        assert 50.0 <= backoff_ms <= 5000.0, backoff_ms
+        # still blocked until the re-armed window elapses
+        assert dh.blocked(("pull:i",)) == "pull:i"
+        # no duplicate trip event for a failed probe (already open)
+        assert dh.stats()["trips"] == 1
+
+    def test_closed_world_gate_is_lock_free_none(self):
+        dh, clock, _ = _fresh_health()
+        assert dh.blocked(("pull:i", "compile:sparse")) is None
+
+    def test_stats_shape_and_reset(self):
+        dh, clock, _ = _fresh_health()
+        dh.record_failure("pack:i", make_device_error("internal"))
+        st = dh.stats()
+        for key in ("any_open", "failures", "trips", "probes", "recoveries",
+                    "domains"):
+            assert key in st
+        dom = st["domains"]["pack:i"]
+        for key in ("state", "failures", "trips", "probes", "recoveries",
+                    "backoff_ms", "last_error"):
+            assert key in dom
+        assert st["failures"]["persistent"] == 1
+        dh.reset()
+        assert dh.stats()["domains"] == {} and not dh.any_open
+
+
+class TestDeviceFaults:
+    def test_glob_countdown_and_auto_disarm(self):
+        df = DeviceFaults()
+        df.arm(error="oom", domain="pull:*", times=2)
+        df.check("pack:idx")  # no match: budget untouched, nothing raised
+        with pytest.raises(Exception) as ei:
+            df.check("pull:idx")
+        assert classify_device_error(ei.value) == "transient"
+        assert df.active
+        with pytest.raises(Exception):
+            df.check("pull:other")
+        assert not df.active  # budget drained → auto-disarm
+        df.check("pull:idx")  # disarmed: free
+        assert df.injected == 2
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            DeviceFaults().arm(error="gremlins")
+
+
+# ---------------------------------------------------------------------------
+# live chaos: one node, four indices, seeded faults per domain
+# ---------------------------------------------------------------------------
+
+IDX_PIN, IDX_SPLIT, IDX_PACK, IDX_MESH = "dpin", "dsplit", "dpack", "dmesh"
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    registry = LocalTransportRegistry()
+    n = Node(name="device_node", registry=registry,
+             settings={"search.batch.linger_ms": 20.0},
+             data_path=str(tmp_path_factory.mktemp("device_node")))
+    n.start([n.local_node.transport_address])
+    n.wait_for_master()
+    client = n.client()
+    rng = random.Random(18)
+    for name, shards, docs, extra in (
+            (IDX_PIN, 1, 80, {}), (IDX_SPLIT, 1, 60, {}),
+            (IDX_PACK, 1, 50, {"index.refresh_interval": -1}),
+            (IDX_MESH, 4, 120, {})):
+        client.create_index(name, {"settings": {
+            "number_of_shards": shards, "number_of_replicas": 0, **extra}})
+        client.cluster_health(wait_for_status="green")
+        for i in range(docs):
+            body = " ".join(rng.choice(VOCAB)
+                            for _ in range(rng.randint(5, 20)))
+            client.index(name, "doc", {"body": body, "n": i}, id=str(i))
+        client.refresh(name)
+    yield n, client
+    n.close()
+
+
+def _hits(r):
+    return [(h["_id"], h["_score"]) for h in r["hits"]["hits"]]
+
+
+def _wait(pred, timeout=15.0, interval=0.02, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+class TestPinnedDeviceChaosInvariant:
+    def test_degrade_never_500_then_probed_recovery(self, node):
+        from elasticsearch_tpu.common.jaxenv import sanitize
+        from elasticsearch_tpu.ops.device_index import PACK_LEDGER
+        from elasticsearch_tpu.search.service import SERVING_COUNTERS
+
+        n, client = node
+        domain = f"pull:{IDX_PIN}"
+        queries = [{"query": {"match": {"body": f"{a} {b}"}}, "size": 10}
+                   for a, b in zip(VOCAB[:8], VOCAB[8:16])]
+        # warm every shape on the device path and pin the expected hits
+        baseline = [_hits(client.search(IDX_PIN, q)) for q in queries]
+        ev0 = n.events.stats()["by_type"]
+        deg0 = SERVING_COUNTERS["degraded"]
+
+        DEVICE_FAULTS.arm(error="transfer", domain=domain, times=1_000_000)
+        try:
+            # trip within budget: transfer is persistent → the FIRST failing
+            # search trips the domain, and its response is already degraded
+            # with the bitwise-identical host hits
+            r = client.search(IDX_PIN, queries[0])
+            assert _hits(r) == baseline[0]
+            assert r["_shards"]["degraded"] >= 1, r["_shards"]
+            assert DEVICE_HEALTH.state(domain) == OPEN
+            assert DEVICE_HEALTH.stats()["failures"]["persistent"] >= 1
+
+            # degraded window: continuous 200s, identical hits, zero compiles,
+            # zero query-path packs — concurrent callers included
+            PACK_LEDGER.forget(IDX_PIN)
+            errors, degraded_seen = [], []
+
+            def chaos_loop():
+                stop = time.monotonic() + 0.6
+                i = 0
+                try:
+                    while time.monotonic() < stop:
+                        r = client.search(IDX_PIN, queries[i % len(queries)])
+                        assert _hits(r) == baseline[i % len(queries)]
+                        if r["_shards"].get("degraded"):
+                            degraded_seen.append(1)
+                        i += 1
+                except Exception as e:  # noqa: BLE001 — re-raised below
+                    errors.append(e)
+
+            with sanitize(max_compiles=0) as rep:
+                threads = [threading.Thread(target=chaos_loop)
+                           for _ in range(3)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            assert not errors, errors[:1]
+            assert degraded_seen, "no degraded responses during open window"
+            assert rep.compiles == 0
+            # nothing packed on ANY pool during the window — the degraded
+            # path is pure host scoring
+            assert PACK_LEDGER.stats(IDX_PIN) == {}, PACK_LEDGER.stats(IDX_PIN)
+            assert SERVING_COUNTERS["degraded"] > deg0
+            ev = n.events.stats()["by_type"]
+            assert ev.get("device_degraded", 0) > ev0.get("device_degraded", 0)
+        finally:
+            DEVICE_FAULTS.disarm()
+
+        # probed recovery: searches past the backoff window ARE the probes
+        _wait(lambda: (client.search(IDX_PIN, queries[0]),
+                       DEVICE_HEALTH.state(domain) == CLOSED)[1],
+              what=f"{domain} probe recovery")
+        assert not DEVICE_HEALTH.any_open
+        st = DEVICE_HEALTH.stats()
+        assert st["probes"] >= 1 and st["recoveries"] >= 1
+        ev = n.events.stats()["by_type"]
+        assert ev.get("device_recovered", 0) > ev0.get("device_recovered", 0)
+        r = client.search(IDX_PIN, queries[0])
+        assert _hits(r) == baseline[0]
+        assert r["_shards"]["degraded"] == 0
+
+
+class TestCoalescedNeighborContainment:
+    def test_one_poisoned_plan_cannot_fail_or_trip_neighbors(self, node):
+        """A device failure on a coalesced batch replays the members
+        individually: neighbors of the poisoned plan still succeed on the
+        device, only genuinely-failing members degrade, and the batch-level
+        collateral is never recorded against the circuit."""
+        from elasticsearch_tpu.search.service import SERVING_COUNTERS
+
+        n, client = node
+        bat = n.search_batcher
+        body = {"query": {"match": {"body": "alpha beta"}}, "size": 5}
+        expected = _hits(client.search(IDX_SPLIT, body))  # warm + pin
+        deg0 = SERVING_COUNTERS["degraded"]
+        splits0 = bat.stats()["device_splits"]
+
+        DEVICE_FAULTS.arm(error="oom", domain=f"pull:{IDX_SPLIT}", times=2)
+        barrier = threading.Barrier(6)
+        results, errors = [], []
+
+        def worker():
+            barrier.wait()
+            try:
+                results.append(client.search(IDX_SPLIT, body))
+            except Exception as e:  # noqa: BLE001 — asserted below
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        assert not errors, errors[:1]
+        assert len(results) == 6
+        for r in results:
+            assert _hits(r) == expected
+        assert not DEVICE_FAULTS.active  # both injections were consumed
+        # containment: without member replay, 6 neighbor failures would blow
+        # the 3-strike budget; with it at most the 2 injected hits degrade
+        # and the circuit stays closed
+        deg = SERVING_COUNTERS["degraded"] - deg0
+        assert deg <= 2, deg
+        assert DEVICE_HEALTH.state(f"pull:{IDX_SPLIT}") == CLOSED
+        assert not DEVICE_HEALTH.any_open
+        # some failure observably landed: either a multi-member batch was
+        # split for replay or a lone-member batch degraded
+        assert bat.stats()["device_splits"] > splits0 or deg >= 1
+
+
+class TestWarmPackRetry:
+    def test_transient_pack_failure_retries_on_pool(self, node):
+        n, client = node
+        w = n.warmer
+        q = {"query": {"match": {"body": "gamma"}}, "size": 5}
+        client.search(IDX_PACK, q)  # opens the warm gate (search_active)
+        retries0, fails0, done0 = w.pack_retries, w.pack_failures, w.packs_done
+
+        DEVICE_FAULTS.arm(error="oom", domain=f"pack:{IDX_PACK}", times=1)
+        client.index(IDX_PACK, "doc", {"body": "gamma gamma delta", "n": 900},
+                     id="900")
+        client.refresh(IDX_PACK)
+        _wait(lambda: w.pack_retries > retries0 and w.packs_done > done0,
+              what="warmer pack retry")
+        assert w.pack_failures == fails0  # the retry healed it
+        assert DEVICE_HEALTH.state(f"pack:{IDX_PACK}") == CLOSED
+        r = client.search(IDX_PACK, q)
+        assert r["_shards"]["degraded"] == 0
+        assert any(h["_id"] == "900" for h in r["hits"]["hits"])
+
+    def test_persistent_pack_failure_trips_then_degrades_then_recovers(
+            self, node):
+        n, client = node
+        w = n.warmer
+        domain = f"pack:{IDX_PACK}"
+        q = {"query": {"match": {"body": "delta"}}, "size": 10}
+        client.search(IDX_PACK, q)  # gate open, steady state packed
+        fails0 = w.pack_failures
+
+        DEVICE_FAULTS.arm(error="launch", domain=domain, times=1_000)
+        client.index(IDX_PACK, "doc", {"body": "delta delta zeta", "n": 901},
+                     id="901")
+        client.refresh(IDX_PACK)
+        # budget (initial + pack_retry_budget attempts) exhausts → final
+        # failure is recorded and the persistent error trips the domain
+        _wait(lambda: w.pack_failures > fails0, what="warmer final failure")
+        assert DEVICE_HEALTH.state(domain) == OPEN
+        # the index still serves — host path, honest _shards, doc visible
+        # (half-packed state was never published; host scores the live view)
+        r = client.search(IDX_PACK, q)
+        assert any(h["_id"] == "901" for h in r["hits"]["hits"])
+        assert r["_shards"]["failed"] == 0
+
+        DEVICE_FAULTS.disarm()
+        # probe recovery: an admitted search legally packs inline and closes
+        _wait(lambda: (client.search(IDX_PACK, q),
+                       DEVICE_HEALTH.state(domain) == CLOSED)[1],
+              what=f"{domain} probe recovery")
+        r = client.search(IDX_PACK, q)
+        assert r["_shards"]["degraded"] == 0
+        assert any(h["_id"] == "901" for h in r["hits"]["hits"])
+
+
+def _same_mesh_hits(got, expected):
+    """Mesh vs transport agreement contract (same as tests/test_mesh_serving):
+    identical ids/order, scores within f32 kernel-accumulation tolerance."""
+    import numpy as np
+    assert [i for i, _ in got] == [i for i, _ in expected]
+    assert np.allclose([s for _, s in got], [s for _, s in expected],
+                       rtol=2e-6)
+
+
+class TestMeshLaunchContainment:
+    def test_rebuild_once_heals_a_transient_launch_fault(self, node):
+        n, client = node
+        ms = n.actions.mesh_serving
+        body = {"query": {"match": {"body": "alpha beta"}}, "size": 10}
+        mq0 = ms.mesh_queries
+        r0 = client.search(IDX_MESH, body)
+        assert ms.mesh_queries == mq0 + 1, "search did not ride the mesh"
+        expected = _hits(r0)
+        rb0 = ms.mesh_rebuilds
+
+        DEVICE_FAULTS.arm(error="oom", domain=f"mesh:{IDX_MESH}", times=1)
+        r1 = client.search(IDX_MESH, body)
+        _same_mesh_hits(_hits(r1), expected)
+        assert ms.mesh_queries == mq0 + 2  # still served by the mesh program
+        assert ms.mesh_rebuilds == rb0 + 1  # via one executor rebuild
+        assert DEVICE_HEALTH.state(f"mesh:{IDX_MESH}") == CLOSED
+
+    def test_persistent_launch_trips_and_degrades_to_transport(self, node):
+        n, client = node
+        ms = n.actions.mesh_serving
+        domain = f"mesh:{IDX_MESH}"
+        body = {"query": {"match": {"body": "gamma delta"}}, "size": 10}
+        mq0 = ms.mesh_queries
+        baseline = _hits(client.search(IDX_MESH, body))
+        assert ms.mesh_queries == mq0 + 1
+        fb0, rb0 = ms.mesh_fallbacks, ms.mesh_rebuilds
+
+        DEVICE_FAULTS.arm(error="launch", domain=domain, times=1_000)
+        try:
+            # rebuild-once-then-degrade: both launch attempts fail, the
+            # failure is recorded (persistent → trip), the transport
+            # scatter-gather serves the same hits
+            r = client.search(IDX_MESH, body)
+            _same_mesh_hits(_hits(r), baseline)
+            assert ms.mesh_rebuilds == rb0 + 1
+            assert DEVICE_HEALTH.state(domain) == OPEN
+            # while open, searches keep succeeding WITHOUT riding the mesh
+            # (gate fallback, or a failed probe falling back mid-flight)
+            r = client.search(IDX_MESH, body)
+            _same_mesh_hits(_hits(r), baseline)
+            assert ms.mesh_queries == mq0 + 1
+            assert ms.mesh_fallbacks >= fb0 + 2
+        finally:
+            DEVICE_FAULTS.disarm()
+
+        # probe recovery: an admitted search rides the mesh again and closes
+        _wait(lambda: (client.search(IDX_MESH, body),
+                       DEVICE_HEALTH.state(domain) == CLOSED)[1],
+              what=f"{domain} probe recovery")
+        mq = ms.mesh_queries
+        r = client.search(IDX_MESH, body)
+        _same_mesh_hits(_hits(r), baseline)
+        assert ms.mesh_queries == mq + 1  # mesh path restored

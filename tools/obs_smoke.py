@@ -143,6 +143,16 @@ def main() -> int:
                     "estpu_events_suppressed_total",
                     "estpu_watchdog_ticks_total"):
             assert fam in r.body, fam
+        # device fault-domain families (common/devicehealth): class-labeled
+        # failure counters emit zeros on a healthy node, and the per-domain
+        # state gauge's family is DECLARED even with no domains yet
+        for fam in ("estpu_device_fault_total",
+                    "estpu_device_fault_trips_total",
+                    "estpu_device_fault_probes_total",
+                    "estpu_device_fault_recoveries_total",
+                    "estpu_device_domain_state"):
+            assert fam in r.body, fam
+        assert 'estpu_device_fault_total{class="transient"}' in r.body
         assert 'estpu_device_index_bytes{index="smoke",tier="postings"}' \
             in r.body, "per-index device tier gauge missing"
         # adaptive routing + hedging families (contiguity checked above)
@@ -189,6 +199,14 @@ def main() -> int:
         assert smoke_dev["totals"].get("postings", 0) > 0, smoke_dev
         assert smoke_dev["pack"].get("packs", 0) >= 1, smoke_dev["pack"]
         assert "by_family" in dev["compile"], dev["compile"]
+        # device fault-domain health rides the same section: a healthy node
+        # reports no open domains and a full (zeroed) counter set
+        health = dev.get("health")
+        assert health is not None, sorted(dev)
+        for key in ("any_open", "failures", "trips", "probes", "recoveries",
+                    "domains"):
+            assert key in health, (key, health)
+        assert health["any_open"] is False, health
         ev = sections.get("events")
         assert ev is not None and "journal" in ev and "watchdog" in ev, ev
 

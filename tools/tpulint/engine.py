@@ -68,7 +68,12 @@ HOT_FILES = ("elasticsearch_tpu/search/execute.py",
              # + pool submits), with all pack compute/device transfers on the
              # pool workers — and its workers drive the same packed-segment
              # coordination the query path waits on
-             "elasticsearch_tpu/warmer.py")
+             "elasticsearch_tpu/warmer.py",
+             # the device fault-domain tracker is read on EVERY query phase
+             # (one attr when all domains closed) and its leaf lock guards
+             # probe scheduling — it must never grow device traffic, clocks
+             # on the closed-world path, or blocking under the lock
+             "elasticsearch_tpu/common/devicehealth.py")
 PLATFORM_EXEMPT = ("elasticsearch_tpu/common/jaxenv.py",)
 
 _SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*ignore(?:\[([A-Z0-9, ]+)\])?")
