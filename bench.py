@@ -1161,6 +1161,269 @@ def chaos_main():
     sys.stdout.flush()
 
 
+# ---------------------------------------------------------------------------
+# compile mode: cold-start vs warmed-restart compile bill (ROADMAP item 5)
+# ---------------------------------------------------------------------------
+
+COMPILE_DOCS = int(os.environ.get("BENCH_COMPILE_DOCS", 6000))
+
+
+def _compile_queries(rng, n=40):
+    """A mixed-SHAPE body set (unlike _serving_queries' single shape): term
+    counts 1..4, several top-k sizes, a bool body and a size=0 count body —
+    enough distinct (family × bucket) executables that the warm/restart story
+    is about a population of compiles, not one. Returns (body, in_stats)
+    pairs: the bool and count bodies are served (so their shapes record and
+    warm) but excluded from the latency percentiles — their steady-state cost
+    differs from the match core, so including them would make the p99/p50
+    ratio measure query weight instead of compile overhead."""
+    out = []
+    sizes = (10, 20, 40)  # k buckets 16/32/64 ride the off-stats bodies
+    for i in range(n):
+        words = rng.choice(SERVING_VOCAB // 4,
+                           size=1 + (i % 4), replace=False)
+        text = " ".join(f"w{int(w)}" for w in words)
+        if i % 7 == 6:
+            out.append(({"query": {"bool": {
+                "must": [{"match": {"body": text}}],
+                "should": [{"term": {"body": f"w{int(words[0])}"}}]}},
+                "size": sizes[i % len(sizes)]}, False))
+        elif i % 11 == 10:
+            out.append(({"query": {"match": {"body": text}}, "size": 0},
+                        False))
+        else:
+            # the stats core: k-homogeneous (size=10) and mid-frequency
+            # terms (the zipf head's postings dwarf the tail's, so full-range
+            # cores measure term weight, not compile overhead); the off-stats
+            # bodies above still record/warm the other lanes and hot terms,
+            # and the serving-pool compile counter gates the FULL mix
+            mids = rng.choice(np.arange(30, SERVING_VOCAB // 4),
+                              size=1 + (i % 2), replace=False)
+            out.append(({"query": {"match": {
+                "body": " ".join(f"w{int(w)}" for w in mids)}},
+                "size": 10}, True))
+    return out
+
+
+def _compile_pass(client, queries, index, reps=1):
+    """Serve the mix `reps` times, sequentially; returns (per-query ms
+    latencies for the stats core, pooled across reps, package compile-event
+    delta). Pooling stabilizes the percentiles without hiding a compile: an
+    on-path XLA compile costs ~100-400ms against a ~10ms steady query, so
+    even one lands in the pooled p99."""
+    import gc
+
+    from elasticsearch_tpu.common.jaxenv import compile_events_total
+
+    lat = []
+    c0 = compile_events_total()
+    gc.collect()
+    gc.disable()  # a collection pause is ~the size of the signal we measure
+    try:
+        for _ in range(reps):
+            for q, in_stats in queries:
+                t0 = time.perf_counter()
+                client.search(index, q)
+                if in_stats:
+                    lat.append((time.perf_counter() - t0) * 1000.0)
+    finally:
+        gc.enable()
+    return lat, compile_events_total() - c0
+
+
+def _pctl(arr, q):
+    return float(np.percentile(np.asarray(arr, np.float64), q)) if arr else 0.0
+
+
+def run_compile(n_docs=COMPILE_DOCS):
+    """Cold-start vs warmed-restart: boot → serve a mixed query shape set
+    cold (every first sighting pays its XLA compile on-path) → steady pass →
+    close (shape manifest persists under path.data) → simulate a process
+    restart (jax.clear_caches + registry/ladder reset) → boot a SECOND node
+    on the SAME path.data → wait for the startup warm cycle to drain → serve
+    the same mix. The claim under test (ISSUE 20 pinned invariant): the
+    warmed node serves the mix with ZERO serving-path compiles, and its
+    first-sighting p99 sits within 2x the steady p50."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from elasticsearch_tpu.common.compilecache import LADDERS, REGISTRY
+    from elasticsearch_tpu.common.jaxenv import compile_events_by_pool
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.node import Node
+
+    tmp = tempfile.mkdtemp(prefix="bench_compile_")
+    mk_settings = lambda: Settings.from_flat({  # noqa: E731
+        "path.data": tmp,
+        "search.batch.linger_ms": "0.5",
+    })
+    REGISTRY.reset()
+    LADDERS.reset()
+    rng = np.random.default_rng(7)
+    queries = _compile_queries(rng)
+    index = "bench_compile"
+
+    node = Node(name="bench_compile_a", settings=mk_settings())
+    node.start()
+    try:
+        client = node.client()
+        client.create_index(index, {"settings": {
+            "number_of_shards": 1, "number_of_replicas": 0}})
+        raw = rng.zipf(1.3, size=(n_docs, 8)).astype(np.int64)
+        terms = (raw - 1) % SERVING_VOCAB
+        bulk = []
+        for i in range(n_docs):
+            bulk.append({"action": {"index": {
+                "_index": index, "_type": "doc", "_id": str(i)}},
+                "source": {"body": " ".join(f"w{int(t)}" for t in terms[i])}})
+            if len(bulk) >= 500:
+                client.bulk(bulk)
+                bulk = []
+        if bulk:
+            client.bulk(bulk)
+        client.refresh(index)
+        # cold: every shape's first sighting compiles ON the serving path
+        lat_cold, compiles_cold = _compile_pass(client, queries, index)
+        # steady: same shapes, everything cached
+        lat_steady, compiles_steady = _compile_pass(client, queries, index,
+                                                    reps=3)
+        specs = REGISTRY.stats()["specs"]
+    finally:
+        node.close()  # persists the shape manifest under path.data
+
+    # simulated process restart: drop every in-process executable and all
+    # registry/ladder state — the manifest on disk is all that survives
+    # (jax's persistent compilation cache under path.data survives too, which
+    # makes the warm REPLAYS cheap; the replay is still what populates the
+    # jit dispatch cache — see common/compilecache)
+    jax.clear_caches()
+    REGISTRY.reset()
+    LADDERS.reset()
+    pool0 = dict(compile_events_by_pool())
+
+    node = Node(name="bench_compile_b", settings=mk_settings())
+    node.start()
+    try:
+        client = node.client()
+        # the startup warm cycle replays the manifest on the warmer pool;
+        # wait for the registry to drain (bounded)
+        deadline = time.perf_counter() + 120.0
+        while (REGISTRY.pending_count() > 0
+               and time.perf_counter() < deadline):
+            time.sleep(0.05)
+        pending_after_warm = REGISTRY.pending_count()
+        warm_stats = REGISTRY.stats()
+        if os.environ.get("BENCH_COMPILE_DEBUG"):
+            import traceback
+
+            from elasticsearch_tpu.common.jaxenv import \
+                register_compile_observer
+
+            def _dbg(family, pool):
+                print(f"# COMPILE family={family} pool={pool}",
+                      file=sys.stderr)
+                traceback.print_stack(file=sys.stderr)
+
+            register_compile_observer(_dbg)
+        client.refresh(index)  # recovery republish; packs ride the warmer
+        # let the warmer pool drain (pack re-prime, mesh warm) so background
+        # warm work doesn't steal CPU from the measured pass — the invariant
+        # is zero SERVING-path compiles, not a quiet warmer
+        while time.perf_counter() < deadline:
+            w = node.threadpool.stats().get("warmer", {})
+            if not w.get("active") and not w.get("queue"):
+                break
+            time.sleep(0.05)
+        time.sleep(0.2)
+        # one untimed probe with a body FROM the observed mix (a novel body
+        # can route to a novel data-dependent sparse bucket and honestly pay
+        # an on-path compile): post-recovery segment decode is a per-NODE
+        # one-time cost (node A paid it during indexing), not part of the
+        # per-SHAPE first-sighting story this bench measures
+        client.search(index, next(q for q, s in queries if s))
+        lat_warm, compiles_warm_path = _compile_pass(client, queries, index,
+                                                     reps=3)
+        pool1 = dict(compile_events_by_pool())
+        pool_delta = {p: pool1.get(p, 0) - pool0.get(p, 0)
+                      for p in set(pool0) | set(pool1)
+                      if pool1.get(p, 0) != pool0.get(p, 0)}
+        serving_compiles = sum(
+            n for p, n in pool_delta.items()
+            if p not in ("warmer", "merge", "generic", "management", "other"))
+        if os.environ.get("BENCH_COMPILE_DEBUG"):
+            order = np.argsort(lat_warm)[::-1][:6]
+            print("# warm top:", [(int(i), round(lat_warm[int(i)], 1))
+                                  for i in order], file=sys.stderr)
+            order = np.argsort(lat_steady)[::-1][:6]
+            print("# steady top:", [(int(i), round(lat_steady[int(i)], 1))
+                                    for i in order], file=sys.stderr)
+        steady_p50 = _pctl(lat_steady, 50)
+        warm_p99 = _pctl(lat_warm, 99)
+        platform = jax.devices()[0].platform
+        return {
+            "metric": f"warmed-restart first-sighting p99 ({platform})",
+            "value": round(warm_p99, 2),
+            "unit": "ms",
+            # the win: cold first-sighting p99 over warmed first-sighting p99
+            "vs_baseline": round(_pctl(lat_cold, 99) / warm_p99, 2)
+            if warm_p99 else 0.0,
+            "cold_p99_ms": round(_pctl(lat_cold, 99), 2),
+            "cold_p50_ms": round(_pctl(lat_cold, 50), 2),
+            "steady_p50_ms": round(steady_p50, 2),
+            "steady_p99_ms": round(_pctl(lat_steady, 99), 2),
+            "warmed_p50_ms": round(_pctl(lat_warm, 50), 2),
+            "warmed_p99_ms": round(warm_p99, 2),
+            # acceptance: warmed first-sighting p99 within 2x steady p50
+            "warmed_p99_vs_steady_p50": round(warm_p99 / steady_p50, 2)
+            if steady_p50 else 0.0,
+            "compiles_cold": compiles_cold,
+            "compiles_steady": compiles_steady,
+            "specs_recorded": specs,
+            "specs_loaded": warm_stats["specs_loaded"],
+            "warmed_total": warm_stats["warmed_total"],
+            "warm_failures": warm_stats["warm_failures"],
+            "pending_after_warm": pending_after_warm,
+            # the pinned invariant, measured two ways: compile events during
+            # the warmed pass, and the per-pool attribution delta across the
+            # whole restart (warmer/startup pools own every compile)
+            "warmed_restart_compiles": compiles_warm_path,
+            "serving_pool_compiles": serving_compiles,
+            "compiles_by_pool_delta": pool_delta,
+            "platform": platform,
+        }
+    finally:
+        node.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def compile_main():
+    """BENCH_MODE=compile entry: one stdout JSON line, persisted to
+    BENCH_COMPILE.json."""
+    platform = BackendProbe().wait()
+    if platform.startswith("cpu"):
+        from elasticsearch_tpu.common.jaxenv import force_cpu_platform
+
+        force_cpu_platform()
+    result = run_compile()
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_COMPILE.json"), "w") as f:
+            json.dump(result, f, indent=1)
+    except Exception as e:  # noqa: BLE001 — persistence is best-effort
+        print(f"# compile row persist failed: {e}", file=sys.stderr)
+    print(f"# compile: cold p99 {result['cold_p99_ms']}ms -> warmed p99 "
+          f"{result['warmed_p99_ms']}ms (steady p50 "
+          f"{result['steady_p50_ms']}ms); warmed-pass compiles "
+          f"{result['warmed_restart_compiles']} (serving pools "
+          f"{result['serving_pool_compiles']}), warmed "
+          f"{result['warmed_total']}/{result['specs_loaded']} specs",
+          file=sys.stderr)
+    print(json.dumps(result))
+    sys.stdout.flush()
+
+
 def main():
     global N_DOCS, VOCAB, BATCH, N_BATCHES
     if os.environ.get("BENCH_MODE") == "serving":
@@ -1171,6 +1434,9 @@ def main():
         return
     if os.environ.get("BENCH_MODE") == "chaos":
         chaos_main()
+        return
+    if os.environ.get("BENCH_MODE") == "compile":
+        compile_main()
         return
     t_start = time.time()
     probe = BackendProbe()

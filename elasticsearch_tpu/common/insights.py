@@ -246,6 +246,21 @@ class QueryShapeInsights:
         self._other_cost_ms = 0.0
 
     def fingerprint(self, body: dict | None) -> tuple[str, dict]:
+        # feed the top-k bucket ladder from the raw body (compilecache): this
+        # runs on EVERY search — including request-cache hits that never reach
+        # a device launch — so the autotuner's histogram sees the real query
+        # mix, not just the cache-missing tail. Observation only: the bucket
+        # result is discarded here (16 = batcher._K_MIN lane)
+        if self.enabled:
+            from .compilecache import LADDERS
+
+            try:
+                k = (int((body or {}).get("size", 10) or 0)
+                     + int((body or {}).get("from", 0) or 0))
+            except (TypeError, ValueError):
+                k = 0
+            if k > 0:
+                LADDERS.bucket("k", k, 16)
         return shape_fingerprint(body)
 
     # -- write ---------------------------------------------------------------

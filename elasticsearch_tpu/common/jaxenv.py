@@ -48,6 +48,59 @@ def force_cpu_platform(n_devices: int | None = None) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+# the persistent-compilation-cache directory this process is armed with (None
+# = not armed). Re-arming with the SAME dir is a no-op, so multi-boot test
+# processes don't thrash jax's cache state on every node construction.
+_persistent_cache_dir: str | None = None
+
+
+def enable_persistent_compile_cache(cache_dir: str) -> bool:
+    """Point jax's persistent compilation cache at `cache_dir` (node wiring
+    puts it under path.data) so a process restart deserializes executables
+    from disk instead of re-running XLA. Thresholds drop to zero — serving
+    kernels on the CPU test backend compile in milliseconds and must still
+    persist, or the restart warm cycle re-pays full compiles.
+
+    Best-effort by design: this flips jax config (sanctioned here — see the
+    module docstring's single-writer rule) and, when the directory CHANGES
+    mid-process, resets jax's cache singleton so the new dir takes effect
+    (jax checks the config once, at first compile). Any failure leaves the
+    cache disabled/stale, never breaks serving. NOTE a persistent-cache HIT
+    still emits a backend_compile_duration event (pxla times
+    compile_or_get_cached wholesale), so compile counting is unchanged by
+    arming this — the disk cache makes warm-cycle replays cheap, it does not
+    hide them from the sanitizer."""
+    global _persistent_cache_dir
+
+    if not cache_dir or _persistent_cache_dir == cache_dir:
+        return _persistent_cache_dir is not None
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                          ("jax_persistent_cache_min_entry_size_bytes", 0)):
+            try:
+                jax.config.update(knob, val)
+            except (AttributeError, ValueError):  # knob absent in this jax
+                pass
+        _persistent_cache_dir = cache_dir
+        try:
+            # jax reads the dir once, at its first cache use — a compile may
+            # already have happened (test suites boot nodes mid-process), so
+            # drop the singleton and let the next compile re-initialize
+            # against the new dir. Private, hence double-guarded: worst case
+            # the previous (or no) dir sticks and only warm cost is lost.
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:  # noqa: BLE001
+            pass
+        return True
+    except Exception:  # noqa: BLE001 — no jax / unknown config: stay off
+        return False
+
+
 # ---------------------------------------------------------------------------
 # runtime sanitizer: transfer guard + compile-event counting
 # ---------------------------------------------------------------------------
@@ -109,6 +162,26 @@ def compile_tag(tag: str):
         _tag_local.tag = None
 
 
+def current_compile_family() -> str | None:
+    """The compile_tag family active on this thread (None outside any scope)
+    — compilecache.record_launch attributes specs to the workload that
+    actually triggered the launch (percolate owning its inner sparse, etc.)."""
+    return getattr(_tag_local, "tag", None)
+
+
+def _pool_label() -> str:
+    """Which named threadpool the current thread belongs to — pool workers are
+    named "estpu[<pool>]_N" (threadpool._BoundedPool); anything else reads as
+    "other". The compile listener's pool attribution: the warmed-node
+    invariant is that steady-state compile events show pool=warmer/merge
+    only (same parse as device_index._pool_label, kept local so this module
+    stays import-leaf)."""
+    name = threading.current_thread().name
+    if name.startswith("estpu[") and "]" in name:
+        return name[len("estpu["): name.index("]")]
+    return "other"
+
+
 # untagged-origin capture: bounded — a runaway untagged site can't grow the
 # dict past this many distinct call sites
 _ORIGIN_CAP = 64
@@ -153,6 +226,15 @@ class _CompileCounter:
         # compile-surface manifest's families cross-check
         self.untagged_origins: dict = {}
         self._record_origins = False
+        # threadpool attribution (pool -> count): the compile-warming
+        # invariant's runtime surface — a warmed node's steady-state events
+        # must all land on warmer/merge pools, never a serving pool
+        self.by_pool: dict = {}
+        # external observers fed OUTSIDE the lock, e.g. the compilecache
+        # warm-queue feed (family, pool) per compile event. Append-only like
+        # jax.monitoring itself; exceptions are swallowed — telemetry must
+        # never break a compile.
+        self.observers: list = []
 
     def _listener(self, key: str, duration: float, **_kw) -> None:
         if _COMPILE_EVENT_SUBSTR not in key:
@@ -162,11 +244,13 @@ class _CompileCounter:
         # must not extend the critical section other compiling threads share
         origin = _package_origin() \
             if family == "untagged" and self._record_origins else None
+        pool = _pool_label()
         # note() under the lock: concurrent pool-thread compiles must not lose
         # increments, or a blown budget could pass silently
         with self._lock:
             self.total += 1
             self.by_family[family] = self.by_family.get(family, 0) + 1
+            self.by_pool[pool] = self.by_pool.get(pool, 0) + 1
             if origin is not None and (origin in self.untagged_origins
                                        or len(self.untagged_origins)
                                        < _ORIGIN_CAP):
@@ -174,6 +258,12 @@ class _CompileCounter:
                     self.untagged_origins.get(origin, 0) + 1
             for r in self._active:
                 r.note(key)
+            observers = list(self.observers)
+        for cb in observers:
+            try:
+                cb(family, pool)
+            except Exception:  # noqa: BLE001
+                pass
 
     def ensure_installed(self) -> None:
         import jax.monitoring
@@ -220,6 +310,34 @@ def compile_events_by_family() -> dict:
         pass
     with _counter._lock:
         return dict(_counter.by_family)
+
+
+def compile_events_by_pool() -> dict:
+    """Process-lifetime backend-compile counts bucketed by the threadpool the
+    triggering thread belonged to ("estpu[<pool>]" worker naming; "other" for
+    non-pool threads). On a warmed node every increment outside
+    warmer/merge is an on-path compile stall — the compile-warming
+    acceptance invariant reads this surface."""
+    try:
+        _counter.ensure_installed()
+    except Exception:  # noqa: BLE001 — no jax in this process: empty
+        pass
+    with _counter._lock:
+        return dict(_counter.by_pool)
+
+
+def register_compile_observer(cb) -> None:
+    """Register `cb(family, pool)` to run after every backend-compile event
+    (outside the counter lock). Register-only, deduplicated by identity —
+    mirrors jax.monitoring's own semantics. The compilecache registry feeds
+    its warm queue from here."""
+    try:
+        _counter.ensure_installed()
+    except Exception:  # noqa: BLE001 — no jax: nothing will ever fire
+        pass
+    with _counter._lock:
+        if cb not in _counter.observers:
+            _counter.observers.append(cb)
 
 
 def record_untagged_origins(enable: bool = True) -> None:

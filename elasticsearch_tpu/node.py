@@ -176,6 +176,18 @@ class Node:
         from .warmer import IndexWarmerService
 
         self.warmer = IndexWarmerService(self)
+        # compile warming (ROADMAP item 5): configure the process registry
+        # with this node's knobs/path.data — loads the shape manifest a prior
+        # process persisted, arms the persistent XLA compilation cache under
+        # path.data, and registers the per-pool compile-event observer. The
+        # startup warm cycle below replays every manifest spec on the warmer
+        # pool so the first serving sighting of yesterday's query mix is a
+        # dispatch-cache hit, not an on-path compile
+        from .common.compilecache import REGISTRY as _compile_registry
+
+        _compile_registry.configure(self.settings, self.data_path)
+        self.compile_warming = _compile_registry
+        self.warmer.schedule_compile_warm("startup")
         self.indices.node = self
         self.monitor = MonitorService(self)
         # stall watchdog: management-pool periodic comparing live in-flight
@@ -295,6 +307,13 @@ class Node:
         self.discovery.leave()
         self.discovery.stop()
         self.gateway.persist_now()
+        # persist the compile-shape manifest next to the gateway state: the
+        # restarted process warms exactly the executables this one served
+        if self.data_path and self.compile_warming.persist:
+            from .common.compilecache import MANIFEST_NAME
+
+            self.compile_warming.save_manifest(
+                os.path.join(self.data_path, MANIFEST_NAME))
         self.indices.close()
         self.cluster_service.close()
         self.transport.close()
@@ -1117,12 +1136,18 @@ class Client:
         over this node's live shard searchers + the process compile rollup."""
         from .common.devicehealth import DEVICE_HEALTH
         from .common.jaxenv import (compile_events_by_family,
+                                    compile_events_by_pool,
                                     compile_events_total)
         from .ops.device_index import capacity_report
 
         out = capacity_report(self.node.indices)
         out["compile"] = {"total": compile_events_total(),
-                          "by_family": compile_events_by_family()}
+                          "by_family": compile_events_by_family(),
+                          # pool attribution: a warmed node's serving pools
+                          # (search/flat/mesh) should read 0 here — every
+                          # compile lands on warmer/startup threads
+                          "by_pool": compile_events_by_pool()}
+        out["compile_warming"] = self.node.compile_warming.stats()
         # per-fault-domain circuit states (common/devicehealth): the
         # operator's answer to "is any serving path degraded to host scoring"
         out["health"] = DEVICE_HEALTH.stats()

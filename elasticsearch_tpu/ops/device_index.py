@@ -59,6 +59,19 @@ def _pow2_bucket(n: int, minimum: int = 128) -> int:
     return b
 
 
+def _ladder_bucket(dim: str, n: int, minimum: int) -> int:
+    """Autotuned bucket ladder (common/compilecache.LADDERS): records n into
+    the dimension's shape histogram and returns its committed rung, with the
+    exact `_pow2_bucket` as the cold fallback — bit-identical to the fixed
+    pow-2 ladder until a warm-cycle autotune commits a fitted one. Every
+    shape-relevant bucket site routes through here (or _pow2_bucket): the
+    compile-surface lattice (tools/tpulint TPU018+) classifies both as
+    `bucketed`."""
+    from ..common.compilecache import LADDERS
+
+    return LADDERS.bucket(dim, n, minimum)
+
+
 def expand_ranges(starts: np.ndarray, counts: np.ndarray) -> np.ndarray:
     """Flatten half-open ranges [starts[i], starts[i]+counts[i]) into one int64 array
     — the CSR expansion idiom (repeat + within-range offset) shared by segment
@@ -199,8 +212,8 @@ def pack_shape_math(seg: FrozenSegment) -> tuple[int, int, str]:
             return sm
     counts = np.diff(seg.post_offsets)
     nblks = (counts + BLOCK - 1) // BLOCK
-    NBpad = _pow2_bucket(int(nblks.sum()) + 1, 64)
-    Dpad = _pow2_bucket(max(seg.doc_count, 1), 128)
+    NBpad = _ladder_bucket("nb", int(nblks.sum()) + 1, 64)
+    Dpad = _ladder_bucket("docs", max(seg.doc_count, 1), 128)
     sm = (NBpad, Dpad, choose_tf_layout(seg.post_freqs))
     if cache is not None:
         cache["shape_math"] = sm

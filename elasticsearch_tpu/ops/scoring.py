@@ -34,10 +34,13 @@ import numpy as np
 
 from ..common import profile as _profile
 from ..common.breaker import reserve
+from ..common.compilecache import REGISTRY as _WARM
+from ..common.jaxenv import current_compile_family
 from .device_index import (
     BLOCK,
     TFN_BM25,
     PackedSegment,
+    _ladder_bucket,
     _pow2_bucket,
     ensure_blk_freqs,
 )
@@ -187,6 +190,16 @@ def _dense_semantics(scores, flat_idx, valid, group, live_parent, n_must, msm, c
 
 
 _compiled_cache: dict = {}
+
+
+def _record(site: str, family: str, params: tuple, args) -> None:
+    """Register this launch's executable with the compile-warm registry
+    (common/compilecache): first sighting of a (site, params, arg shapes)
+    signature stores a JSON-able WarmSpec the warmer replays at startup /
+    post-restart, so the NEXT process never pays this compile on-path. The
+    active compile_tag family wins attribution (a percolation's inner dense
+    launch warms under its `compile:percolate` circuit)."""
+    _WARM.record_launch(site, current_compile_family() or family, params, args)
 
 
 def _get_compiled(n_queries: int, k: int, doc_pad: int, simple: bool = False):
@@ -366,10 +379,12 @@ def score_fs_rows_batch(packed: PackedSegment, batch: TermBatch, k: int,
     import jax.numpy as jnp
 
     norms_stack, caches = _stack_args(packed, batch)
+    params = (batch.n_queries, min(k, packed.doc_pad), packed.doc_pad,
+              bmode, min_score is not None, no_functions)
     fn = _get_fs_compiled(
-        "rows", batch.n_queries, min(k, packed.doc_pad), packed.doc_pad,
+        "rows", params[0], params[1], params[2],
         bmode=bmode, use_min_score=min_score is not None, no_functions=no_functions)
-    out = fn(
+    args = (
         packed.blk_docs, ensure_blk_freqs(packed), packed.live_parent,
         norms_stack, caches,
         jnp.asarray(batch.qidx), jnp.asarray(batch.blk), jnp.asarray(batch.weight),
@@ -379,6 +394,10 @@ def score_fs_rows_batch(packed: PackedSegment, batch: TermBatch, k: int,
         _scalar_f32(max_boost), _scalar_f32(fboost),
         _scalar_f32(min_score if min_score is not None else 0.0),
     )
+    out = fn(*args)
+    # the script variant is NOT recorded: its executable closes over a live
+    # sandboxed script object that has no JSON form to replay from a manifest
+    _record("scoring.fs_rows", "function_score", params, args)
     return jax.device_get(out)
 
 
@@ -471,6 +490,22 @@ def _dense_sort_impl(blk_docs, blk_freqs, live_parent, norms_stack, caches,
             match.sum(axis=1, dtype=jnp.int32))
 
 
+def _get_sorted_compiled(n_queries: int, k: int, doc_pad: int,
+                         descending: bool):
+    import jax
+
+    key = ("sorted", n_queries, k, doc_pad, descending)
+    fn = _compiled_cache.get(key)
+    if fn is None:
+        def wrapper(*args):
+            return _dense_sort_impl(*args, n_queries=n_queries, k=k,
+                                    doc_pad=doc_pad, descending=descending)
+
+        fn = jax.jit(wrapper)
+        _compiled_cache[key] = fn
+    return fn
+
+
 def score_sorted_batch(packed: PackedSegment, batch: TermBatch, k: int,
                        key_row, descending: bool, fmask=None):
     """Field-sorted dense launch; returns numpy (keys, docs, scores, qmax,
@@ -480,27 +515,21 @@ def score_sorted_batch(packed: PackedSegment, batch: TermBatch, k: int,
     import jax.numpy as jnp
 
     norms_stack, caches = _stack_args(packed, batch)
-    key = ("sorted", batch.n_queries, min(k, packed.doc_pad), packed.doc_pad,
-           descending)
-    fn = _compiled_cache.get(key)
-    if fn is None:
-        def wrapper(*args):
-            return _dense_sort_impl(
-                *args, n_queries=batch.n_queries, k=min(k, packed.doc_pad),
-                doc_pad=packed.doc_pad, descending=descending)
-
-        fn = jax.jit(wrapper)
-        _compiled_cache[key] = fn
+    params = (batch.n_queries, min(k, packed.doc_pad), packed.doc_pad,
+              descending)
+    fn = _get_sorted_compiled(*params)
     if fmask is None:
         fmask = np.ones((1, 1), dtype=bool)
-    top_keys, top_docs, top_scores, qmax, total = fn(
+    args = (
         packed.blk_docs, ensure_blk_freqs(packed), packed.live_parent,
         norms_stack, caches,
         jnp.asarray(batch.qidx), jnp.asarray(batch.blk), jnp.asarray(batch.weight),
         jnp.asarray(batch.fidx), jnp.asarray(batch.group), jnp.asarray(batch.tfmode),
         jnp.asarray(batch.n_must), jnp.asarray(batch.msm), jnp.asarray(batch.coord),
-        jnp.asarray(fmask), key_row,
+        jnp.asarray(fmask), jnp.asarray(key_row),
     )
+    top_keys, top_docs, top_scores, qmax, total = fn(*args)
+    _record("scoring.sorted", "sorted", params, args)
     return (np.asarray(top_keys), np.asarray(top_docs), np.asarray(top_scores),
             np.asarray(qmax), np.asarray(total))
 
@@ -589,6 +618,24 @@ def _dense_aggstats_impl(blk_docs, blk_freqs, live_parent, norms_stack, caches,
     return top_scores, top_docs, total, counts, stats, bucket_counts
 
 
+def _get_agg_compiled(n_queries: int, k: int, doc_pad: int, nb_bucket: int):
+    import jax
+
+    # bucket-agg count rides the pow-2 ladder: the wrapper is generic over the
+    # pairs pytree (jit retraces per structure under ONE cache entry), so a
+    # raw len() here would admit one executable per distinct agg count
+    key = ("aggstats", n_queries, k, doc_pad, nb_bucket)
+    fn = _compiled_cache.get(key)
+    if fn is None:
+        def wrapper(*args):
+            return _dense_aggstats_impl(*args, n_queries=n_queries, k=k,
+                                        doc_pad=doc_pad)
+
+        fn = jax.jit(wrapper)
+        _compiled_cache[key] = fn
+    return fn
+
+
 def score_agg_batch(packed: PackedSegment, batch: TermBatch, k: int,
                     agg_row_stack, bucket_pairs=(), fmask=None):
     """Dense launch returning (scores, docs, total, counts [Q, F] int,
@@ -602,25 +649,14 @@ def score_agg_batch(packed: PackedSegment, batch: TermBatch, k: int,
     import jax.numpy as jnp
 
     norms_stack, caches = _stack_args(packed, batch)
-    # bucket-agg count rides the pow-2 ladder: the wrapper is generic over the
-    # pairs pytree (jit retraces per structure under ONE cache entry), so a
-    # raw len() here would admit one executable per distinct agg count
-    key = ("aggstats", batch.n_queries, min(k, packed.doc_pad), packed.doc_pad,
-           _pow2_bucket(len(bucket_pairs), 1) if bucket_pairs else 0)
-    fn = _compiled_cache.get(key)
-    if fn is None:
-        def wrapper(*args):
-            return _dense_aggstats_impl(
-                *args, n_queries=batch.n_queries, k=min(k, packed.doc_pad),
-                doc_pad=packed.doc_pad)
-
-        fn = jax.jit(wrapper)
-        _compiled_cache[key] = fn
+    params = (batch.n_queries, min(k, packed.doc_pad), packed.doc_pad,
+              _pow2_bucket(len(bucket_pairs), 1) if bucket_pairs else 0)
+    fn = _get_agg_compiled(*params)
     if fmask is None:
         # broadcastable no-op mask: [1, 1] & [Q, Dpad] — avoids allocating and
         # transferring a full all-true mask on the unfiltered aggs hot path
         fmask = np.ones((1, 1), dtype=bool)
-    out = fn(
+    args = (
         packed.blk_docs, ensure_blk_freqs(packed), packed.live_parent,
         norms_stack, caches,
         jnp.asarray(batch.qidx), jnp.asarray(batch.blk), jnp.asarray(batch.weight),
@@ -630,6 +666,8 @@ def score_agg_batch(packed: PackedSegment, batch: TermBatch, k: int,
         # arrays); a raw numpy arg would be an implicit H2D at dispatch
         jnp.asarray(agg_row_stack), tuple(bucket_pairs), jnp.asarray(fmask),
     )
+    out = fn(*args)
+    _record("scoring.aggs", "aggs", params, args)
     # ONE explicit pull for the whole result pytree (None leaves pass through):
     # per-leaf np.asarray was a transfer per output — and an implicit one, which
     # the promoted transfer_guard("disallow") sanitizer now rejects
@@ -661,15 +699,18 @@ def score_term_batch_async(packed: PackedSegment, batch: TermBatch, k: int):
 
     Q = batch.n_queries
     norms_stack, caches = _stack_args(packed, batch)
-    fn = _get_compiled(Q, min(k, packed.doc_pad), packed.doc_pad,
-                       _detect_simple(batch))
-    return fn(
+    params = (Q, min(k, packed.doc_pad), packed.doc_pad, _detect_simple(batch))
+    fn = _get_compiled(*params)
+    args = (
         packed.blk_docs, ensure_blk_freqs(packed), packed.live_parent,
         norms_stack, caches,
         jnp.asarray(batch.qidx), jnp.asarray(batch.blk), jnp.asarray(batch.weight),
         jnp.asarray(batch.fidx), jnp.asarray(batch.group), jnp.asarray(batch.tfmode),
         jnp.asarray(batch.n_must), jnp.asarray(batch.msm), jnp.asarray(batch.coord),
     )
+    out = fn(*args)
+    _record("scoring.dense", "dense", params, args)
+    return out
 
 
 def score_term_batch(packed: PackedSegment, batch: TermBatch, k: int) -> ScoreResult:
@@ -679,15 +720,17 @@ def score_term_batch(packed: PackedSegment, batch: TermBatch, k: int) -> ScoreRe
 
     Q = batch.n_queries
     norms_stack, caches = _stack_args(packed, batch)
-    fn = _get_compiled(Q, min(k, packed.doc_pad), packed.doc_pad,
-                       _detect_simple(batch))
-    top_scores, top_docs, total = fn(
+    params = (Q, min(k, packed.doc_pad), packed.doc_pad, _detect_simple(batch))
+    fn = _get_compiled(*params)
+    args = (
         packed.blk_docs, ensure_blk_freqs(packed), packed.live_parent,
         norms_stack, caches,
         jnp.asarray(batch.qidx), jnp.asarray(batch.blk), jnp.asarray(batch.weight),
         jnp.asarray(batch.fidx), jnp.asarray(batch.group), jnp.asarray(batch.tfmode),
         jnp.asarray(batch.n_must), jnp.asarray(batch.msm), jnp.asarray(batch.coord),
     )
+    top_scores, top_docs, total = fn(*args)
+    _record("scoring.dense", "dense", params, args)
     return finalize_score_result(np.asarray(top_scores), np.asarray(top_docs),
                                  np.asarray(total), packed.doc_pad)
 
@@ -984,14 +1027,18 @@ def score_sparse_batch_async(packed: PackedSegment, sb: SparseBatch, k: int,
     P = TB * BLOCK
     k_eff = min(k, P)
     use_coord = not sb.simple and not bool(np.all(sb.coord == 1.0))
-    fn = _get_sparse_compiled(Qb, TB, k_eff, packed.doc_pad, sb.passes, sb.simple,
-                              use_coord, sb.coord.shape[1])
-    return fn(
+    params = (Qb, TB, k_eff, packed.doc_pad, sb.passes, sb.simple, use_coord,
+              sb.coord.shape[1])
+    fn = _get_sparse_compiled(*params)
+    args = (
         packed.blk_docs, packed.blk_tf, packed.blk_nb, sim.caches, sim.modes,
         jnp.asarray(sb.qblk), jnp.asarray(sb.qw), jnp.asarray(sb.qconst),
         jnp.asarray(sb.qcnt), jnp.asarray(sb.qfid), jnp.asarray(sb.n_must),
         jnp.asarray(sb.msm), jnp.asarray(sb.coord),
     )
+    out = fn(*args)
+    _record("scoring.sparse", "sparse", params, args)
+    return out
 
 
 def plan_sparse_buckets(clause_lists: list, n_must: np.ndarray, msm: np.ndarray,
@@ -1014,12 +1061,13 @@ def plan_sparse_buckets(clause_lists: list, n_must: np.ndarray, msm: np.ndarray,
     tb_q = np.array([sum(b1 - b0 for (b0, b1, _w, _g, _c, _fi) in cl)
                      for cl in clause_lists], dtype=np.int64)
     overflow = [qi for qi in range(Q) if tb_q[qi] > tb_max]
+    tb_host = tb_q.tolist()  # one host conversion, not a per-qi scalar read
     buckets: dict[int, list[int]] = {}
     for qi in range(Q):
-        if 0 < tb_q[qi] <= tb_max:
-            tb = 8
-            while tb < tb_q[qi]:
-                tb *= 2
+        if 0 < tb_host[qi] <= tb_max:
+            # block-count rung rides the autotuned ladder (pow-2 until the
+            # observed histogram commits a tighter fit — common/compilecache)
+            tb = _ladder_bucket("sparse_tb", tb_host[qi], 8)
             buckets.setdefault(tb, []).append(qi)
 
     batches = []
@@ -1027,9 +1075,7 @@ def plan_sparse_buckets(clause_lists: list, n_must: np.ndarray, msm: np.ndarray,
         max_q = max(1, slot_budget // tb)
         for start in range(0, len(qis), max_q):
             chunk = qis[start: start + max_q]
-            Qb = 8
-            while Qb < len(chunk):
-                Qb *= 2
+            Qb = _ladder_bucket("sparse_qb", len(chunk), 8)
             if scratch is not None:
                 qblk, qw, qconst, qcnt, qfid = scratch.take(Qb, tb, sentinel_row)
             else:
@@ -1158,7 +1204,7 @@ def build_term_batch(entries: list, n_queries: int, n_must: np.ndarray, msm: np.
 
     `entries` = list of (qidx, blk_row, weight, fidx, group, tfmode); padding rows point
     at `nb_pad_row` (a row of doc_pad sentinels — contributes nothing)."""
-    M = _pow2_bucket(max(len(entries), 1), 16)
+    M = _ladder_bucket("terms", max(len(entries), 1), 16)
     qidx = np.zeros(M, np.int32)
     blk = np.full(M, nb_pad_row, np.int32)
     weight = np.zeros(M, np.float32)
@@ -1246,3 +1292,41 @@ def concat_pack_planes(blk_term, blk_j0, cum, starts, bases, doc_pads,
     fn = _get_concat_compiled(int(doc_pad_new), tf_layout)
     return fn(blk_term, blk_j0, cum, starts, bases, doc_pads,
               tuple(src_docs), tuple(src_tf), tuple(src_nb))
+
+
+# ---------------------------------------------------------------------------
+# compile-warm builders (common/compilecache)
+# ---------------------------------------------------------------------------
+# Each builder maps a WarmSpec's recorded params back to the SAME jitted
+# callable the launch site uses (same _compiled_cache key), so the warmer's
+# dummy invocation populates exactly the dispatch-cache entry a real query
+# will hit. The script function_score variant has no builder on purpose: its
+# executable closes over a live sandboxed script object.
+
+
+@_WARM.builder("scoring.dense")
+def _build_dense(params):
+    return _get_compiled(*params)
+
+
+@_WARM.builder("scoring.sorted")
+def _build_sorted(params):
+    return _get_sorted_compiled(*params)
+
+
+@_WARM.builder("scoring.aggs")
+def _build_aggs(params):
+    return _get_agg_compiled(*params)
+
+
+@_WARM.builder("scoring.fs_rows")
+def _build_fs_rows(params):
+    n_queries, k, doc_pad, bmode, use_min_score, no_functions = params
+    return _get_fs_compiled("rows", n_queries, k, doc_pad, bmode=bmode,
+                            use_min_score=use_min_score,
+                            no_functions=no_functions)
+
+
+@_WARM.builder("scoring.sparse")
+def _build_sparse(params):
+    return _get_sparse_compiled(*params)

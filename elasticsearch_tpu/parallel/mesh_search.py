@@ -38,7 +38,7 @@ from ..index.engine import Searcher
 from ..ops.device_index import (
     _TF_DTYPE,
     BLOCK,
-    _pow2_bucket,
+    _ladder_bucket,
     choose_tf_layout,
     expand_ranges,
     tf_plane_itemsize,
@@ -180,12 +180,13 @@ def build_sharded_index(searchers: list[Searcher], fields: list[str],
 
     csrs = [_combine_segments(s) for s in searchers]
     S = len(csrs)
-    doc_pad = _pow2_bucket(max(max(c.doc_count for c in csrs), 1), 128)
+    doc_pad = _ladder_bucket("docs", max(max(c.doc_count for c in csrs), 1),
+                             128)
     nb_needed = []
     for c in csrs:
         counts = np.diff(c.post_offsets)
         nb_needed.append(int(((counts + BLOCK - 1) // BLOCK).sum()))
-    nb_pad = _pow2_bucket(max(nb_needed) + 1, 64)
+    nb_pad = _ladder_bucket("nb", max(nb_needed) + 1, 64)
 
     blk_docs = np.full((S, nb_pad, BLOCK), doc_pad, dtype=np.int32)
     blk_freqs = np.zeros((S, nb_pad, BLOCK), dtype=np.float32)  # f32 staging
@@ -637,8 +638,9 @@ class MeshSearchExecutor:
                 np.repeat(np.array([r[5] for r in rows], np.int32), counts),  # group
                 np.repeat(np.array([r[6] for r in rows], np.int32), counts),  # mode
             ))
-        M = _pow2_bucket(max(max((len(p[0]) for p in per_shard if p is not None),
-                                 default=1), 1), 16)
+        M = _ladder_bucket("terms",
+                           max(max((len(p[0]) for p in per_shard
+                                    if p is not None), default=1), 1), 16)
         qidx = np.zeros((S, M), np.int32)
         blk = np.full((S, M), idx.nb_pad - 1, np.int32)
         clause_id = np.zeros((S, M), np.int32)
@@ -651,13 +653,13 @@ class MeshSearchExecutor:
             n = len(p[0])
             qidx[si, :n], blk[si, :n], clause_id[si, :n] = p[0], p[1], p[2]
             fidx[si, :n], group[si, :n], tfmode[si, :n] = p[3], p[4], p[5]
-        # per-query bool semantics — padded to the pow-2 query bucket so the
+        # per-query bool semantics — padded to the "q" ladder bucket so the
         # executable cache in search() keys on the bucket ladder, not raw
         # len(plans) (one compiled program per QUERY-COUNT BUCKET, not per
         # distinct batch size). Padding queries have zero clauses and zero
         # must/msm; their output rows are sliced off before MeshTopDocs.
         Q = len(plans)
-        Qp = _pow2_bucket(Q, 1)
+        Qp = _ladder_bucket("q", Q, 1)
         n_scoring_max = max(
             (sum(1 for c in p.clauses if c.group != GROUP_MUST_NOT) for p in plans),
             default=1) or 1

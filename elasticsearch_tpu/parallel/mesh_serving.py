@@ -38,6 +38,31 @@ from ..transport.faults import DEVICE_FAULTS
 from .mesh_search import MeshSearchExecutor, build_sharded_index
 
 
+def _plan_to_dict(plan) -> dict:
+    """JSON form of a mesh-eligible FlatPlan (never carries fs/filt — the
+    eligibility gate in _search_mesh declined those) for the compile-warm
+    manifest: a restarted node replays these to pre-trace the SPMD program."""
+    return {
+        "clauses": [[c.field, c.term, float(c.boost), int(c.group)]
+                    for c in plan.clauses],
+        "msm": int(plan.msm), "n_must": int(plan.n_must),
+        "coord": bool(plan.coord_enabled), "boost": float(plan.boost),
+        "query_norm": float(plan.query_norm),
+    }
+
+
+def _plan_from_dict(d: dict):
+    from ..search.execute import Clause, FlatPlan
+
+    return FlatPlan(
+        [Clause(str(f), str(t), float(b), int(g))
+         for (f, t, b, g) in d.get("clauses", ())],
+        msm=int(d.get("msm", 0)), n_must=int(d.get("n_must", 0)),
+        coord_enabled=bool(d.get("coord", False)),
+        boost=float(d.get("boost", 1.0)),
+        query_norm=float(d.get("query_norm", 1.0)))
+
+
 class MeshServingService:
     """Decides per search whether the SPMD mesh program can serve it, and does."""
 
@@ -410,6 +435,13 @@ class MeshServingService:
                     # phase (the pull IS the sync — nothing extra added)
                     prof.phase_s("mesh_launch", time.monotonic() - t_launch)
             self.mesh_queries += 1
+            # remember this served plan batch (dict work, ring-deduped): the
+            # compile warmer replays it against a REBUILT executor (refresh /
+            # restart) so the SPMD re-trace happens on the warmer pool, not
+            # under the first post-rebuild query
+            from ..common.compilecache import REGISTRY as _warm_registry
+
+            _warm_registry.record_mesh(index, [plan], k, [_plan_to_dict(plan)])
 
             track = bool(req.track_scores) if req.sort else True
             if out is not None:
@@ -716,7 +748,60 @@ class MeshServingService:
             # but ALWAYS resolve: this generation's waiters park on this
             # future whether or not it is still the freshest
             fut.set_result(execs)
+        if execs is not None:
+            # a fresh executor pack means every program for this index must
+            # re-trace — replay the recently-served plan batches on the warmer
+            # pool so the re-compiles happen off the query path
+            self._schedule_mesh_warm(index, execs)
         return None if execs is None else execs[use_global_stats]
+
+    def _schedule_mesh_warm(self, index: str, execs) -> None:
+        """Leaf: queue a mesh warm replay for a just-built executor pair."""
+        from ..common.compilecache import REGISTRY
+
+        node = getattr(self.indices, "node", None)
+        tp = getattr(node, "threadpool", None)
+        warmer = getattr(node, "warmer", None)
+        if (tp is None or not REGISTRY.enabled
+                or (warmer is not None and not warmer.enabled)):
+            return
+        live, manifest = REGISTRY.mesh_entries(index)
+        if not live and not manifest:
+            return
+        try:
+            tp.submit("warmer", self._run_mesh_warm, index, execs, live,
+                      manifest)
+        except Exception:  # noqa: BLE001 — rejected/shut-down pool
+            pass
+
+    def _run_mesh_warm(self, index: str, execs, live, manifest) -> None:
+        """Warmer-pool worker: replay recorded mesh plan batches against both
+        stats-mode executors (each holds its own compiled-program cache).
+        Live FlatPlan payloads serve same-process rebuilds; after a restart
+        only the manifest's JSON plans exist — same shapes either way (the
+        executable key depends on clause counts/k, not term values)."""
+        from ..common.compilecache import REGISTRY
+
+        # entry k values are plain ints (record_mesh / JSON manifest)
+        batches = [(e["plans"], e["k"]) for e in live]
+        if not batches:
+            batches = [([_plan_from_dict(d) for d in e.get("plans", ())],
+                        e.get("k", 10)) for e in manifest]
+        domain = "compile:mesh"
+        for plans, k in batches:
+            if not plans or DEVICE_HEALTH.blocked((domain,)):
+                continue
+            for ex in execs.values():
+                try:
+                    # executor.search wraps its launch in compile_tag("mesh")
+                    # and pulls the program output itself
+                    ex.search(plans, min(k, ex.index.doc_pad))
+                except Exception as e:  # noqa: BLE001 — warm failure: off-path
+                    REGISTRY.note_mesh_warm(False)
+                    DEVICE_HEALTH.record_failure(domain, e)
+                    return
+                REGISTRY.note_mesh_warm(True)
+            DEVICE_HEALTH.note_success((domain,))
 
     def _build_executors(self, searchers, kind, default_sim):
         """The device-side pack: ShardedIndex + one executor per stats mode.

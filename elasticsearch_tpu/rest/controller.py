@@ -394,6 +394,30 @@ def _prometheus_text(node) -> str:
     for family in COMPILE_FAMILIES:
         w.counter("estpu_jax_compile_family_total",
                   by_family.get(family, 0), family=family)
+    # compile events by OBSERVING POOL (jaxenv._pool_label thread-name parse):
+    # the warmed-node invariant made scrapable — steady state puts every
+    # compile on warmer/startup labels, serving pools read 0. Labels are
+    # bounded (fixed threadpool names + "other"); declared so the family
+    # exists before the first compile
+    from ..common.jaxenv import compile_events_by_pool
+
+    w.declare("estpu_jax_compile_pool_total", "counter")
+    for pool, n in sorted(compile_events_by_pool().items()):
+        w.counter("estpu_jax_compile_pool_total", n, pool=pool)
+    # compile-warming registry (common/compilecache via node.compile_warming):
+    # spec inventory + warm-cycle outcomes + ladder/manifest churn
+    cw = node.compile_warming.stats()
+    w.gauge("estpu_compile_warm_specs", cw["specs"])
+    w.gauge("estpu_compile_warm_pending", cw["pending"])
+    w.counter("estpu_compile_warm_total", cw["warmed_total"])
+    w.counter("estpu_compile_warm_failures_total", cw["warm_failures"])
+    w.counter("estpu_compile_warm_skipped_total", cw["warm_skipped_circuit"])
+    w.counter("estpu_compile_warm_cycles_total", cw["warm_cycles"])
+    w.counter("estpu_compile_warm_ladder_commits_total", cw["ladder_commits"])
+    w.counter("estpu_compile_warm_manifest_saves_total", cw["manifest_saves"])
+    w.counter("estpu_compile_warm_mesh_total", cw["mesh_warms"])
+    w.counter("estpu_compile_warm_mesh_failures_total",
+              cw["mesh_warm_failures"])
     # HBM postings gauge derived from the capacity report computed above —
     # postings + dense_plane tiers ARE packed_resident_bytes over the live
     # packed segments (one engine/segment walk per scrape, not two)

@@ -65,13 +65,15 @@ from ..common.deadline import NO_DEADLINE, Deadline
 from ..common.errors import RejectedExecutionError
 from ..common.logging import get_logger
 from ..common.metrics import HistogramMetric
-from ..ops.device_index import _pow2_bucket
+from ..ops.device_index import _ladder_bucket
 
 _K_MIN = 16  # smallest k bucket (top-10 pages and top-16 share executables)
 
 
 def _k_bucket(k: int) -> int:
-    return _pow2_bucket(k, _K_MIN)
+    # autotuned ladder (compilecache "k" dimension) with pow-2-from-16
+    # fallback while cold — one executable per k RUNG, not per distinct k
+    return _ladder_bucket("k", k, _K_MIN)
 
 
 class _Item:
@@ -142,8 +144,8 @@ class _MeshFamily:
     """Coalesces plain mesh searches into one SPMD program launch.
     payload = (plan, MeshSearchExecutor); results fan out as per-query host
     row tuples (shard_row, score_row, doc_row, shard_totals_col, qmax_col) —
-    exactly what mesh_serving's assembly consumes. The plan list pads to a
-    power-of-two Q with zero-clause plans (msm=1 matches nothing) so batch
+    exactly what mesh_serving's assembly consumes. The plan list pads to the
+    "q" bucket ladder with zero-clause plans (msm=1 matches nothing) so batch
     sizes share compiled programs."""
 
     name = "mesh"
@@ -161,7 +163,7 @@ class _MeshFamily:
         # the k bucket may round past the program's doc space (the request's
         # own k was validated against doc_pad by mesh_serving) — clamp it
         kb = min(kb, executor.index.doc_pad)
-        qb = _pow2_bucket(len(plans), 1)
+        qb = _ladder_bucket("q", len(plans), 1)
         plans += [FlatPlan([], msm=1, n_must=0, coord_enabled=False, boost=1.0)
                   for _ in range(qb - len(plans))]
         # executor.search pulls its program output itself (one device_get for

@@ -51,6 +51,7 @@ from __future__ import annotations
 import hashlib
 import json
 import threading
+import zlib
 from collections import OrderedDict
 
 from ..common.errors import CircuitBreakingError
@@ -126,11 +127,20 @@ class ShardRequestCache:
         self.size_bytes = int(parse_ratio_or_bytes(
             settings.get("indices.requests.cache.size"), int(total_budget),
             default="1%"))
+        # stored-partial compression floor: values at/above it are
+        # zlib-deflated before insertion and the BREAKER is charged the
+        # compressed size — the cache budget buys entries, not padding.
+        # Negative disables; small partials (count-only bodies, a hundred
+        # bytes) stay raw — deflate overhead would beat the win
+        self.compress_min_bytes = settings.get_bytes(
+            "indices.requests.cache.compress_min_bytes", 1024)
         self.breaker = breaker
         self._lock = threading.Lock()
-        # key -> (data bytes, charged size); OrderedDict insertion order IS
-        # the LRU order (move_to_end on hit)
-        self._entries: "OrderedDict[tuple, tuple[bytes, int]]" = OrderedDict()
+        # key -> (blob, charged size, raw_len); raw_len > 0 marks a
+        # zlib-compressed blob (its decompressed length); OrderedDict
+        # insertion order IS the LRU order (move_to_end on hit)
+        self._entries: "OrderedDict[tuple, tuple[bytes, int, int]]" = \
+            OrderedDict()
         # secondary index (index, shard) -> {keys}: invalidation runs on
         # EVERY searcher install of every shard, under the engine lock — it
         # must touch only that shard's entries, not scan the node-wide LRU
@@ -147,6 +157,11 @@ class ShardRequestCache:
         self.evictions = 0
         self.invalidations = 0
         self.rejections = 0  # stores skipped on breaker trip / oversize
+        self.compressions = 0  # lifetime compressed stores
+        # live gauges over the CURRENT compressed entries (drop-adjusted):
+        # stored compressed bytes vs what those entries would occupy raw
+        self._comp_bytes = 0
+        self._comp_raw_bytes = 0
 
     # -- lookup --------------------------------------------------------------
     def get(self, key: tuple) -> bytes | None:
@@ -165,7 +180,10 @@ class ShardRequestCache:
                 if h is not None:
                     h[0] += 1
                     hot.move_to_end(key[3])
-            return entry[0]
+            blob, _charged, raw_len = entry
+        # inflate OUTSIDE the leaf lock: a hot 100 KiB partial must not
+        # serialize every other cache access behind its decompress
+        return zlib.decompress(blob) if raw_len else blob
 
     def peek(self, key: tuple) -> bool:
         """Presence check WITHOUT hit/miss accounting or LRU touch — the
@@ -196,7 +214,15 @@ class ShardRequestCache:
                 else:
                     h[1] = blob
                     hot.move_to_end(key[3])
-        size = len(data) + self.ENTRY_OVERHEAD
+        # deflate above the floor (outside the lock — CPU work), keep raw when
+        # zlib loses (already-compact partials): the breaker and the LRU
+        # budget are charged what is actually RESIDENT
+        blob, raw_len = data, 0
+        if 0 <= self.compress_min_bytes <= len(data):
+            packed = zlib.compress(data, 1)  # level 1: ~90% of the win, ~5x faster
+            if len(packed) < len(data):
+                blob, raw_len = packed, len(data)
+        size = len(blob) + self.ENTRY_OVERHEAD
         if size > self.size_bytes:
             self.rejections += 1
             return False
@@ -213,14 +239,20 @@ class ShardRequestCache:
             if old is not None:
                 self._bytes -= old[1]
                 released += old[1]
-            self._entries[key] = (data, size)
+                self._drop_comp_locked(old)
+            self._entries[key] = (blob, size, raw_len)
             self._by_shard.setdefault(key[:2], set()).add(key)
             self._bytes += size
+            if raw_len:
+                self.compressions += 1
+                self._comp_bytes += len(blob)
+                self._comp_raw_bytes += raw_len
             while self._bytes > self.size_bytes and len(self._entries) > 1:
-                k, (_d, sz) = self._entries.popitem(last=False)
+                k, dropped_entry = self._entries.popitem(last=False)
                 self._drop_index_locked(k)
-                self._bytes -= sz
-                released += sz
+                self._bytes -= dropped_entry[1]
+                released += dropped_entry[1]
+                self._drop_comp_locked(dropped_entry)
                 self.evictions += 1
             self.stores += 1
         if released and self.breaker is not None:
@@ -256,6 +288,12 @@ class ShardRequestCache:
                 continue
         return out
 
+    def _drop_comp_locked(self, entry: tuple) -> None:
+        """Keep the compressed-bytes gauges honest when an entry leaves."""
+        if entry[2]:
+            self._comp_bytes -= len(entry[0])
+            self._comp_raw_bytes -= entry[2]
+
     # -- invalidation --------------------------------------------------------
     def _drop_index_locked(self, key: tuple):
         keys = self._by_shard.get(key[:2])
@@ -283,10 +321,11 @@ class ShardRequestCache:
             shard_keys = self._by_shard.get((index, shard_id))
             for k in [k for k in (shard_keys or ())
                       if current_view is None or k[2] != current_view]:
-                _d, sz = self._entries.pop(k)
+                entry = self._entries.pop(k)
                 self._drop_index_locked(k)
-                self._bytes -= sz
-                released += sz
+                self._bytes -= entry[1]
+                released += entry[1]
+                self._drop_comp_locked(entry)
                 dropped += 1
             self.invalidations += dropped
         if released and self.breaker is not None:
@@ -302,10 +341,11 @@ class ShardRequestCache:
             keys = [k for k in self._entries
                     if index is None or k[0] == index]
             for k in keys:
-                _d, sz = self._entries.pop(k)
+                entry = self._entries.pop(k)
                 self._drop_index_locked(k)
-                self._bytes -= sz
-                released += sz
+                self._bytes -= entry[1]
+                released += entry[1]
+                self._drop_comp_locked(entry)
                 dropped += 1
         if released and self.breaker is not None:
             self.breaker.release(released)
@@ -332,4 +372,12 @@ class ShardRequestCache:
                 "invalidations": self.invalidations,
                 "rejections": self.rejections,
                 "hit_rate": round(self.hit_rate(), 4),
+                "compressions": self.compressions,
+                # resident compressed footprint vs its inflated size; ratio
+                # 1.0 = nothing currently compressed
+                "compressed_bytes": self._comp_bytes,
+                "compressed_raw_bytes": self._comp_raw_bytes,
+                "compression_ratio": (
+                    round(self._comp_bytes / self._comp_raw_bytes, 4)
+                    if self._comp_raw_bytes else 1.0),
             }

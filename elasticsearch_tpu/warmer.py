@@ -83,6 +83,9 @@ class IndexWarmerService:
         self.query_failures = 0
         self.filters_seeded = 0
         self.rejected = 0  # pool rejections (shutdown/saturation)
+        self.compile_warms_scheduled = 0
+        self.compile_warm_cycles = 0
+        self._compile_warm_queued = False  # one in-flight cycle at a time
 
     # -- wiring ---------------------------------------------------------------
     def wire(self, index: str, shard_id: int, engine) -> None:
@@ -134,6 +137,12 @@ class IndexWarmerService:
         # bearing hot keys actually existing for this shard
         if not self.enabled:
             return
+        # compile warming rides the same install event (and the same kill
+        # switch): a refresh that changed mappers/similarity invalidates
+        # executables exactly when it installs the new searcher, so any spec
+        # the registry holds un-warm gets replayed off-path NOW, before a
+        # query sights the new shapes
+        self.schedule_compile_warm(f"searcher-install:{index}")
         rcache = getattr(node, "request_cache", None)
         if (rcache is None or not rcache.enabled
                 or not rcache.has_hot(index, shard_id)):
@@ -205,6 +214,49 @@ class IndexWarmerService:
                     DEVICE_HEALTH.note_success((f"pack:{index}",))
                 return
 
+    def schedule_compile_warm(self, reason: str) -> bool:
+        """Enqueue one compile-warm cycle on the warmer pool (leaf: dict work
+        + submit only — callable under the engine lock). Coalesces: at most
+        one queued cycle at a time, and nothing queues when the registry has
+        no pending (un-warm) specs — the steady-state searcher install costs
+        one counter read."""
+        from .common.compilecache import REGISTRY
+
+        tp = getattr(self.node, "threadpool", None)
+        if (tp is None or not self.enabled or not REGISTRY.enabled
+                or REGISTRY.pending_count() == 0):
+            return False
+        with self._lock:
+            if self._compile_warm_queued:
+                return False
+            self._compile_warm_queued = True
+        try:
+            tp.submit("warmer", self.run_compile_warm, reason)
+            with self._lock:
+                self.compile_warms_scheduled += 1
+            return True
+        except Exception:  # noqa: BLE001 — rejected/shut-down pool
+            with self._lock:
+                self._compile_warm_queued = False
+                self.rejected += 1
+            return False
+
+    def run_compile_warm(self, reason: str) -> dict:
+        """Warmer-pool worker: one registry warm cycle (ladder autotune +
+        pending-spec replay + manifest save under this node's path.data)."""
+        from .common.compilecache import REGISTRY
+
+        with self._lock:
+            self._compile_warm_queued = False
+        res = REGISTRY.warm_cycle(
+            reason, save_path=getattr(self.node, "data_path", None))
+        with self._lock:
+            self.compile_warm_cycles += 1
+        if res.get("warmed") or res.get("failed"):
+            self.logger.debug(
+                "compile warm cycle (%s): %s", reason, res)
+        return res
+
     def _re_prime(self, index: str, shard_id: int, engine, dropped) -> None:
         node = self.node
         try:
@@ -253,4 +305,6 @@ class IndexWarmerService:
                 "query_failures": self.query_failures,
                 "filters_seeded": self.filters_seeded,
                 "rejected": self.rejected,
+                "compile_warms_scheduled": self.compile_warms_scheduled,
+                "compile_warm_cycles": self.compile_warm_cycles,
             }

@@ -124,9 +124,12 @@ class TestShardRequestCacheUnits:
         rc = ShardRequestCache(
             Settings.from_flat({"indices.requests.cache.size": "1mb"}),
             breaker=svc.breaker("request"), total_budget=1 << 20)
-        # fill the breaker so the store trips
+        # fill the breaker so the store trips; incompressible bytes — a
+        # compressible value would (correctly) deflate under the floor and
+        # fit, which is the compression feature, not the trip under test
+        import os as _os
         svc.breaker("request").add_estimate_and_maybe_break(1500, "pin")
-        assert not rc.put(("i", 0, 1, "fp"), b"x" * 1200)
+        assert not rc.put(("i", 0, 1, "fp"), _os.urandom(1200))
         assert rc.stats()["rejections"] == 1
         assert rc.get(("i", 0, 1, "fp")) is None
         svc.breaker("request").release(1500)

@@ -176,6 +176,20 @@ def main() -> int:
                     "estpu_filter_cache_evictions_total",
                     "estpu_filter_cache_bytes"):
             assert fam in r.body, fam
+        # compile warming (ROADMAP item 5): registry families + the per-pool
+        # compile attribution counter (declared even before the first compile)
+        for fam in ("estpu_compile_warm_specs",
+                    "estpu_compile_warm_pending",
+                    "estpu_compile_warm_total",
+                    "estpu_compile_warm_failures_total",
+                    "estpu_compile_warm_skipped_total",
+                    "estpu_compile_warm_cycles_total",
+                    "estpu_compile_warm_ladder_commits_total",
+                    "estpu_compile_warm_manifest_saves_total",
+                    "estpu_compile_warm_mesh_total",
+                    "estpu_compile_warm_mesh_failures_total",
+                    "estpu_jax_compile_pool_total"):
+            assert fam in r.body, fam
 
         r = get("/_traces")
         assert r.body["total"] == len(r.body["traces"])
@@ -199,6 +213,16 @@ def main() -> int:
         assert smoke_dev["totals"].get("postings", 0) > 0, smoke_dev
         assert smoke_dev["pack"].get("packs", 0) >= 1, smoke_dev["pack"]
         assert "by_family" in dev["compile"], dev["compile"]
+        assert "by_pool" in dev["compile"], dev["compile"]
+        # compile-warming registry stats ride the device section
+        cw = dev.get("compile_warming")
+        assert cw is not None, sorted(dev)
+        for key in ("enabled", "specs", "pending", "warmed_total",
+                    "warm_failures", "warm_cycles", "ladders",
+                    "compiles_by_pool"):
+            assert key in cw, (key, cw)
+        # this node served real searches: launch sites recorded warm specs
+        assert cw["specs_recorded"] > 0, cw
         # device fault-domain health rides the same section: a healthy node
         # reports no open domains and a full (zeroed) counter set
         health = dev.get("health")
@@ -226,6 +250,10 @@ def main() -> int:
                         "evictions", "hit_rate"):
                 assert key in t, (tier, key)
         assert sections["indices"]["request_cache"]["hits"] >= 1
+        # entry-compression surfaces (stored partials deflate above the floor)
+        for key in ("compressed_bytes", "compressed_raw_bytes",
+                    "compression_ratio", "compressions"):
+            assert key in sections["indices"]["request_cache"], key
 
         # POST /_cache/clear drains both tiers back to zero resident bytes
         r = get("/_cache/clear", method="POST",

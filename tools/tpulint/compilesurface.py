@@ -56,9 +56,12 @@ UNKNOWN, CONFIG, BUCKETED, UNBOUNDED = 0, 1, 2, 3
 PROVENANCE_NAMES = {UNKNOWN: "unknown", CONFIG: "config",
                     BUCKETED: "bucketed", UNBOUNDED: "unbounded"}
 
-# the recognized bucket ladders (ops/device_index._pow2_bucket and
-# ops/scoring._k_bucket feed every executable-cache key in the package)
-BUCKET_LADDERS = frozenset({"_pow2_bucket", "_k_bucket"})
+# the recognized bucket ladders (ops/device_index._pow2_bucket/_ladder_bucket
+# and ops/scoring._k_bucket feed every executable-cache key in the package).
+# _ladder_bucket is the autotuned generalization (common/compilecache): its
+# rung set is data-fitted but BOUNDED (max_rungs) and monotone, so it keys
+# executables exactly like the fixed pow-2 ladder it replaces
+BUCKET_LADDERS = frozenset({"_pow2_bucket", "_k_bucket", "_ladder_bucket"})
 
 _CTOR_KINDS = {"jit": "jit", "shard_map": "shard_map", "pjit": "shard_map",
                "xmap": "shard_map", "pallas_call": "pallas_call"}
